@@ -1,0 +1,20 @@
+"""container_engine_accelerators_tpu — TPU-native GKE accelerator stack.
+
+A ground-up TPU re-design of the GKE container-engine-accelerators
+stack (reference: pradvenkat/container-engine-accelerators): a kubelet
+device plugin advertising google.com/tpu chips, ICI-topology-aware
+subslice partitioning, a chip-health poller, Prometheus metrics with
+pod attribution, installer/deployment manifests, and JAX/XLA demo
+workloads (ResNet-50 training, serving) scheduled through the plugin.
+
+Layout (mirrors SURVEY.md section 1's layer map):
+  chip/      native chip-info library binding + fake backend (layer 3)
+  plugin/    device manager, kubelet gRPC adapters, health, metrics,
+             subslice manager (layers 4-7)
+  models/    Flax model zoo for the demo workloads (layer 10)
+  ops/       Pallas TPU kernels backing the models
+  parallel/  mesh/sharding/train-step library (dp x tp over ICI)
+  utils/     logging and shared helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,33 @@
+"""Chip-information layer: native libtpuinfo binding + Python fallback.
+
+This is the TPU counterpart of the reference's device-access library
+layer (SURVEY.md section 1, layer 3: the NVML cgo binding). Everything
+above it (manager, health, metrics, subslicing) talks to the
+ChipBackend interface, never to the node directly, which is what makes
+the whole plugin unit-testable without TPU hardware.
+"""
+
+from .backend import (
+    BadShapeError,
+    ChipBackendError,
+    Health,
+    NoSuchChipError,
+    NonUniformPartitionError,
+    ChipBackend,
+)
+from .native import NativeChipBackend, find_tpuinfo_library
+from .pyfake import PyChipBackend
+from .factory import get_backend
+
+__all__ = [
+    "BadShapeError",
+    "ChipBackendError",
+    "Health",
+    "NoSuchChipError",
+    "NonUniformPartitionError",
+    "ChipBackend",
+    "NativeChipBackend",
+    "PyChipBackend",
+    "find_tpuinfo_library",
+    "get_backend",
+]

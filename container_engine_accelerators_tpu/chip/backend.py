@@ -1,0 +1,121 @@
+"""ChipBackend interface and shared types.
+
+The interface shape mirrors what the reference consumes from NVML
+(device enumeration/status/events, vendor/.../nvml/nvml.go:276-744) and
+from its MIG bindings (subslice listing, vendor/.../nvml/mig.go), recast
+for TPU: coordinates on an ICI torus instead of PCI bus IDs, a polled
+health state instead of an event fd, and uniform subslice tiling
+instead of MIG profile IDs.
+"""
+
+import enum
+
+
+class ChipBackendError(Exception):
+    """Base error for chip-backend failures."""
+
+
+class NoSuchChipError(ChipBackendError):
+    pass
+
+
+class BadShapeError(ChipBackendError):
+    """Malformed subslice shape string (want 'AxB' or 'AxBxC')."""
+
+
+class NonUniformPartitionError(ChipBackendError):
+    """Shape does not tile the host topology uniformly.
+
+    Same invariant the reference enforces for MIG partitions
+    (pkg/gpu/nvidia/mig/mig.go:190-201).
+    """
+
+
+class Health(enum.IntEnum):
+    """Chip health states; UNCORRECTABLE_ECC is the Xid-48 analog."""
+
+    OK = 0
+    UNKNOWN = 1
+    UNCORRECTABLE_ECC = 2
+    ICI_LINK_DOWN = 3
+    OVERHEAT = 4
+    WEDGED = 5
+
+
+class ChipBackend:
+    """Abstract chip-information backend.
+
+    Implementations: NativeChipBackend (ctypes over libtpuinfo.so) and
+    PyChipBackend (pure Python, same file-level semantics).
+    """
+
+    def init(self, dev_dir, state_dir):
+        """Scan dev_dir for accel chips; returns chip count."""
+        raise NotImplementedError
+
+    def shutdown(self):
+        raise NotImplementedError
+
+    def rescan(self):
+        """Re-scan for hot-plugged chips; returns new count."""
+        raise NotImplementedError
+
+    def chip_count(self):
+        raise NotImplementedError
+
+    def topology(self):
+        """(x, y, z) physical ICI dims; z == 1 for 2D topologies."""
+        raise NotImplementedError
+
+    def chip_coords(self, chip):
+        raise NotImplementedError
+
+    def chip_at(self, x, y, z):
+        raise NotImplementedError
+
+    def chip_health(self, chip):
+        """Health enum, re-read from the node's state dir."""
+        raise NotImplementedError
+
+    def chip_hbm(self, chip):
+        """(total_bytes, used_bytes) or None if unpublished."""
+        raise NotImplementedError
+
+    def sample_duty(self, chip):
+        """Record a duty-cycle counter sample; False if unpublished."""
+        raise NotImplementedError
+
+    def duty_cycle(self, chip, window_us):
+        """Average duty-cycle percent over window, or None."""
+        raise NotImplementedError
+
+    def subslice_count(self, shape):
+        raise NotImplementedError
+
+    def subslice_chips(self, shape, index):
+        raise NotImplementedError
+
+
+def parse_shape(shape):
+    """Parse 'AxB' / 'AxBxC' into a 3-tuple (z defaults to 1).
+
+    Raises BadShapeError on malformed input. Shared by PyChipBackend
+    and the slice manager's validation layer.
+    """
+    if not isinstance(shape, str) or not shape:
+        raise BadShapeError(f"bad subslice shape: {shape!r}")
+    parts = shape.split("x")
+    if not 1 <= len(parts) <= 3:
+        raise BadShapeError(f"bad subslice shape: {shape!r}")
+    dims = []
+    for p in parts:
+        p = p.strip()
+        if not p.isdigit():
+            raise BadShapeError(f"bad subslice shape: {shape!r}")
+        v = int(p)
+        if not 1 <= v <= 4096:
+            raise BadShapeError(f"bad subslice shape: {shape!r}")
+        dims.append(v)
+    while len(dims) < 3:
+        dims.append(1)
+    return tuple(dims)

@@ -1,0 +1,28 @@
+"""Backend selection: native libtpuinfo when available, Python otherwise."""
+
+import os
+
+from .backend import ChipBackendError
+from .native import NativeChipBackend
+from .pyfake import PyChipBackend
+from ..utils import get_logger
+
+log = get_logger("chip")
+
+
+def get_backend(prefer=None):
+    """Return a fresh ChipBackend.
+
+    prefer: "native", "python", or None (env CEA_CHIP_BACKEND, then
+    native-with-fallback).
+    """
+    choice = prefer or os.environ.get("CEA_CHIP_BACKEND", "")
+    if choice == "python":
+        return PyChipBackend()
+    try:
+        return NativeChipBackend()
+    except (ChipBackendError, OSError) as e:
+        if choice == "native":
+            raise
+        log.warning("native chip backend unavailable (%s); using Python", e)
+        return PyChipBackend()

@@ -1,0 +1,175 @@
+"""ctypes binding over the native libtpuinfo.so chip library.
+
+Counterpart of the reference's cgo NVML binding (nvml_dl.go dlopens
+libnvidia-ml.so at runtime); here ctypes dlopens libtpuinfo.so built
+from native/tpuinfo.
+"""
+
+import ctypes
+import os
+
+from .backend import (
+    BadShapeError,
+    ChipBackend,
+    ChipBackendError,
+    Health,
+    NoSuchChipError,
+    NonUniformPartitionError,
+)
+
+_OK = 0
+_ERR_UNINITIALIZED = -1
+_ERR_NO_SUCH_CHIP = -2
+_ERR_BAD_SHAPE = -3
+_ERR_NONUNIFORM = -4
+_ERR_IO = -5
+_ERR_NO_DATA = -6
+_ERR_RANGE = -7
+
+
+def find_tpuinfo_library():
+    """Locate libtpuinfo.so: $CEA_TPUINFO_LIB, repo build dir, LD path."""
+    env = os.environ.get("CEA_TPUINFO_LIB")
+    if env:
+        return env if os.path.exists(env) else None
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo_build = os.path.join(os.path.dirname(os.path.dirname(here)), "build",
+                              "libtpuinfo.so")
+    if os.path.exists(repo_build):
+        return repo_build
+    for d in ("/usr/local/lib", "/usr/lib"):
+        cand = os.path.join(d, "libtpuinfo.so")
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _raise_for(rc, what):
+    if rc == _ERR_UNINITIALIZED:
+        raise ChipBackendError(f"{what}: backend not initialized")
+    if rc == _ERR_NO_SUCH_CHIP:
+        raise NoSuchChipError(what)
+    if rc == _ERR_BAD_SHAPE:
+        raise BadShapeError(what)
+    if rc == _ERR_NONUNIFORM:
+        raise NonUniformPartitionError(what)
+    if rc == _ERR_RANGE:
+        raise ChipBackendError(f"{what}: index out of range")
+    if rc == _ERR_IO:
+        raise ChipBackendError(f"{what}: malformed state file")
+    raise ChipBackendError(f"{what}: error {rc}")
+
+
+class NativeChipBackend(ChipBackend):
+    def __init__(self, library_path=None):
+        path = library_path or find_tpuinfo_library()
+        if path is None:
+            raise ChipBackendError(
+                "libtpuinfo.so not found; build it with `make native` or "
+                "set CEA_TPUINFO_LIB")
+        self._lib = ctypes.CDLL(path)
+        self._lib.tpuinfo_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        self._lib.tpuinfo_duty_cycle.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.POINTER(ctypes.c_double)]
+        self._lib.tpuinfo_chip_hbm.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        self._lib.tpuinfo_subslice_chips.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int]
+        self._lib.tpuinfo_subslice_count.argtypes = [ctypes.c_char_p]
+        self._lib.tpuinfo_version.restype = ctypes.c_char_p
+
+    def init(self, dev_dir, state_dir):
+        rc = self._lib.tpuinfo_init(dev_dir.encode(), state_dir.encode())
+        if rc < 0:
+            _raise_for(rc, "init")
+        return rc
+
+    def shutdown(self):
+        self._lib.tpuinfo_shutdown()
+
+    def rescan(self):
+        rc = self._lib.tpuinfo_rescan()
+        if rc < 0:
+            _raise_for(rc, "rescan")
+        return rc
+
+    def chip_count(self):
+        rc = self._lib.tpuinfo_chip_count()
+        if rc < 0:
+            _raise_for(rc, "chip_count")
+        return rc
+
+    def topology(self):
+        dims = (ctypes.c_int * 3)()
+        rc = self._lib.tpuinfo_topology(dims)
+        if rc < 0:
+            _raise_for(rc, "topology")
+        return (dims[0], dims[1], dims[2])
+
+    def chip_coords(self, chip):
+        x = ctypes.c_int()
+        y = ctypes.c_int()
+        z = ctypes.c_int()
+        rc = self._lib.tpuinfo_chip_coords(
+            chip, ctypes.byref(x), ctypes.byref(y), ctypes.byref(z))
+        if rc < 0:
+            _raise_for(rc, f"chip_coords({chip})")
+        return (x.value, y.value, z.value)
+
+    def chip_at(self, x, y, z):
+        rc = self._lib.tpuinfo_chip_at(x, y, z)
+        if rc < 0:
+            _raise_for(rc, f"chip_at({x},{y},{z})")
+        return rc
+
+    def chip_health(self, chip):
+        rc = self._lib.tpuinfo_chip_health(chip)
+        if rc < 0:
+            _raise_for(rc, f"chip_health({chip})")
+        return Health(rc)
+
+    def chip_hbm(self, chip):
+        total = ctypes.c_int64()
+        used = ctypes.c_int64()
+        rc = self._lib.tpuinfo_chip_hbm(
+            chip, ctypes.byref(total), ctypes.byref(used))
+        if rc == _ERR_NO_DATA:
+            return None
+        if rc < 0:
+            _raise_for(rc, f"chip_hbm({chip})")
+        return (total.value, used.value)
+
+    def sample_duty(self, chip):
+        rc = self._lib.tpuinfo_sample_duty(chip)
+        if rc == _ERR_NO_DATA:
+            return False
+        if rc < 0:
+            _raise_for(rc, f"sample_duty({chip})")
+        return True
+
+    def duty_cycle(self, chip, window_us):
+        out = ctypes.c_double()
+        rc = self._lib.tpuinfo_duty_cycle(chip, window_us, ctypes.byref(out))
+        if rc == _ERR_NO_DATA:
+            return None
+        if rc < 0:
+            _raise_for(rc, f"duty_cycle({chip})")
+        return out.value
+
+    def subslice_count(self, shape):
+        rc = self._lib.tpuinfo_subslice_count(shape.encode())
+        if rc < 0:
+            _raise_for(rc, f"subslice_count({shape!r})")
+        return rc
+
+    def subslice_chips(self, shape, index):
+        buf = (ctypes.c_int * 4096)()
+        rc = self._lib.tpuinfo_subslice_chips(shape.encode(), index, buf, 4096)
+        if rc < 0:
+            _raise_for(rc, f"subslice_chips({shape!r}, {index})")
+        return [buf[i] for i in range(rc)]
+
+    def version(self):
+        return self._lib.tpuinfo_version().decode()

@@ -1,0 +1,259 @@
+"""Pure-Python ChipBackend with the same node-file semantics as libtpuinfo.
+
+Serves two roles:
+  - fallback when libtpuinfo.so has not been built;
+  - the authoritative executable spec for the native library's behavior
+    (the parity test in tests/test_chip_backend.py runs both against
+    the same synthetic tree).
+"""
+
+import collections
+import os
+import re
+
+from .backend import (
+    ChipBackend,
+    ChipBackendError,
+    Health,
+    NoSuchChipError,
+    NonUniformPartitionError,
+    parse_shape,
+)
+
+_DEV_RE = re.compile(r"^accel([0-9]+)$")
+_MAX_SAMPLES = 128
+
+_HEALTH_TOKENS = {
+    "ok": Health.OK,
+    "": Health.OK,
+    "uncorrectable_ecc": Health.UNCORRECTABLE_ECC,
+    "ici_link_down": Health.ICI_LINK_DOWN,
+    "overheat": Health.OVERHEAT,
+    "wedged": Health.WEDGED,
+}
+
+
+class PyChipBackend(ChipBackend):
+    def __init__(self):
+        self._dev_dir = None
+        self._state_dir = None
+        self._chips = []          # sorted chip indices
+        self._dims = (0, 0, 0)
+        self._coords = {}         # chip -> (x, y, z)
+        self._at = {}             # (x, y, z) -> chip
+        self._samples = collections.defaultdict(collections.deque)
+
+    # -- lifecycle ----------------------------------------------------
+    def init(self, dev_dir, state_dir):
+        self._dev_dir = dev_dir
+        self._state_dir = state_dir
+        self._samples.clear()
+        return self.rescan()
+
+    def shutdown(self):
+        self.__init__()
+
+    def rescan(self):
+        self._require_init()
+        chips = []
+        try:
+            for name in os.listdir(self._dev_dir):
+                m = _DEV_RE.match(name)
+                if m:
+                    chips.append(int(m.group(1)))
+        except FileNotFoundError:
+            pass
+        self._chips = sorted(set(chips))
+        for gone in set(self._samples) - set(self._chips):
+            del self._samples[gone]
+        self._resolve_topology()
+        self._resolve_coords()
+        return len(self._chips)
+
+    # -- introspection ------------------------------------------------
+    def chip_count(self):
+        self._require_init()
+        return len(self._chips)
+
+    def topology(self):
+        self._require_init()
+        return self._dims
+
+    def chip_coords(self, chip):
+        self._require_chip(chip)
+        return self._coords[chip]
+
+    def chip_at(self, x, y, z):
+        self._require_init()
+        dx, dy, dz = self._dims
+        if not (0 <= x < dx and 0 <= y < dy and 0 <= z < dz):
+            raise ChipBackendError(f"chip_at({x},{y},{z}): out of range")
+        try:
+            return self._at[(x, y, z)]
+        except KeyError:
+            raise NoSuchChipError(f"no chip at ({x},{y},{z})")
+
+    def chip_health(self, chip):
+        self._require_chip(chip)
+        raw = self._read_state(chip, "health")
+        if raw is None:
+            return Health.OK
+        return _HEALTH_TOKENS.get(raw.strip(), Health.UNKNOWN)
+
+    def chip_hbm(self, chip):
+        self._require_chip(chip)
+        raw = self._read_state(chip, "hbm")
+        if raw is None:
+            return None
+        parts = raw.split()
+        if len(parts) < 2:
+            raise ChipBackendError(f"chip_hbm({chip}): malformed state file")
+        return (int(parts[0]), int(parts[1]))
+
+    def sample_duty(self, chip):
+        self._require_chip(chip)
+        raw = self._read_state(chip, "duty_cycle")
+        if raw is None:
+            return False
+        parts = raw.split()
+        if len(parts) < 2:
+            raise ChipBackendError(
+                f"sample_duty({chip}): malformed state file")
+        ring = self._samples[chip]
+        ring.append((int(parts[0]), int(parts[1])))
+        while len(ring) > _MAX_SAMPLES:
+            ring.popleft()
+        return True
+
+    def duty_cycle(self, chip, window_us):
+        self._require_chip(chip)
+        ring = self._samples[chip]
+        if len(ring) < 2:
+            return None
+        newest_busy, newest_total = ring[-1]
+        oldest = None
+        for busy, total in reversed(ring):
+            if newest_total - total <= window_us:
+                oldest = (busy, total)
+            else:
+                break
+        if oldest is None:
+            return None
+        dt = newest_total - oldest[1]
+        if dt <= 0:
+            return None
+        pct = 100.0 * (newest_busy - oldest[0]) / dt
+        return max(0.0, min(100.0, pct))
+
+    # -- subslices ----------------------------------------------------
+    def subslice_count(self, shape):
+        self._require_init()
+        sh = parse_shape(shape)
+        tiles = self._tile_grid(sh)
+        return tiles[0] * tiles[1] * tiles[2]
+
+    def subslice_chips(self, shape, index):
+        self._require_init()
+        sh = parse_shape(shape)
+        tiles = self._tile_grid(sh)
+        n_tiles = tiles[0] * tiles[1] * tiles[2]
+        if not 0 <= index < n_tiles:
+            raise ChipBackendError(
+                f"subslice_chips({shape!r}, {index}): index out of range")
+        tz = index % tiles[2]
+        ty = (index // tiles[2]) % tiles[1]
+        tx = index // (tiles[2] * tiles[1])
+        ox, oy, oz = tx * sh[0], ty * sh[1], tz * sh[2]
+        chips = []
+        for dx in range(sh[0]):
+            for dy in range(sh[1]):
+                for dz in range(sh[2]):
+                    coord = (ox + dx, oy + dy, oz + dz)
+                    if coord not in self._at:
+                        raise NoSuchChipError(f"no chip at {coord}")
+                    chips.append(self._at[coord])
+        return chips
+
+    def version(self):
+        return "tpuinfo-py 0.1.0"
+
+    # -- internals ----------------------------------------------------
+    def _require_init(self):
+        if self._dev_dir is None:
+            raise ChipBackendError("backend not initialized")
+
+    def _require_chip(self, chip):
+        self._require_init()
+        if chip not in self._coords:
+            raise NoSuchChipError(f"accel{chip}")
+
+    def _read_state(self, chip, leaf):
+        path = os.path.join(self._state_dir, f"accel{chip}", leaf)
+        try:
+            with open(path) as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def _resolve_topology(self):
+        # Precedence: explicit override env; node-published state file;
+        # ambient TPU_TOPOLOGY (a per-process libtpu hint, least
+        # trustworthy for node-level facts); inference from chip count.
+        spec = os.environ.get("CEA_TPU_TOPOLOGY", "")
+        if not spec:
+            try:
+                with open(os.path.join(self._state_dir, "topology")) as f:
+                    spec = f.read().strip()
+            except OSError:
+                spec = ""
+        if not spec:
+            spec = os.environ.get("TPU_TOPOLOGY", "")
+        if spec:
+            try:
+                self._dims = parse_shape(spec)
+                return
+            except ChipBackendError:
+                pass
+        n = len(self._chips)
+        if n == 0:
+            self._dims = (0, 0, 0)
+            return
+        x = 1
+        cand = 2
+        while cand * cand <= n:
+            if n % cand == 0:
+                x = cand
+            cand += 1
+        self._dims = (x, n // x, 1)
+
+    def _resolve_coords(self):
+        dx, dy, dz = self._dims
+        self._coords = {}
+        self._at = {}
+        for pos, chip in enumerate(self._chips):
+            raw = self._read_state(chip, "coords")
+            coord = None
+            if raw:
+                parts = raw.strip().split(",")
+                if len(parts) in (2, 3):
+                    try:
+                        vals = [int(p) for p in parts]
+                        coord = tuple(vals + [0] * (3 - len(vals)))
+                    except ValueError:
+                        coord = None
+            if coord is None and dy > 0 and dz > 0:
+                coord = (pos // (dz * dy), (pos // dz) % dy, pos % dz)
+            self._coords[chip] = coord
+            if (0 <= coord[0] < dx and 0 <= coord[1] < dy
+                    and 0 <= coord[2] < dz):
+                self._at[coord] = chip
+
+    def _tile_grid(self, shape):
+        dims = self._dims
+        tiles = []
+        for a in range(3):
+            if dims[a] <= 0 or shape[a] > dims[a] or dims[a] % shape[a] != 0:
+                raise NonUniformPartitionError(
+                    f"shape {shape} does not uniformly tile topology {dims}")
+            tiles.append(dims[a] // shape[a])
+        return tuple(tiles)

@@ -1,0 +1,1 @@
+"""TPU kubelet device plugin: manager, gRPC adapters, health, metrics."""

@@ -1,0 +1,53 @@
+"""Kubelet API bindings: protobuf messages + grpcio service bindings.
+
+Message classes are protoc-generated from the protos under /proto (wire
+compatible with the upstream kubelet device-plugin and pod-resources
+APIs). The gRPC stubs/servicers in grpc_bindings.py are hand-written
+because this image ships grpcio but not grpc_tools.
+"""
+
+from . import deviceplugin_v1beta1_pb2 as v1beta1_pb2
+from . import deviceplugin_v1alpha_pb2 as v1alpha_pb2
+from . import podresources_v1alpha1_pb2 as podresources_pb2
+from .grpc_bindings import (
+    V1BETA1_VERSION,
+    V1ALPHA_VERSION,
+    HEALTHY,
+    UNHEALTHY,
+    DevicePluginV1Beta1Servicer,
+    DevicePluginV1AlphaServicer,
+    RegistrationServicer,
+    add_device_plugin_v1beta1,
+    add_device_plugin_v1alpha,
+    add_registration_v1beta1,
+    add_registration_v1alpha,
+    DevicePluginV1Beta1Stub,
+    DevicePluginV1AlphaStub,
+    RegistrationV1Beta1Stub,
+    RegistrationV1AlphaStub,
+    PodResourcesListerStub,
+    add_pod_resources_lister,
+)
+
+__all__ = [
+    "v1beta1_pb2",
+    "v1alpha_pb2",
+    "podresources_pb2",
+    "V1BETA1_VERSION",
+    "V1ALPHA_VERSION",
+    "HEALTHY",
+    "UNHEALTHY",
+    "DevicePluginV1Beta1Servicer",
+    "DevicePluginV1AlphaServicer",
+    "RegistrationServicer",
+    "add_device_plugin_v1beta1",
+    "add_device_plugin_v1alpha",
+    "add_registration_v1beta1",
+    "add_registration_v1alpha",
+    "DevicePluginV1Beta1Stub",
+    "DevicePluginV1AlphaStub",
+    "RegistrationV1Beta1Stub",
+    "RegistrationV1AlphaStub",
+    "PodResourcesListerStub",
+    "add_pod_resources_lister",
+]

@@ -1,0 +1,244 @@
+"""Hand-written grpcio bindings for the kubelet device-plugin APIs.
+
+grpc_tools (the protoc Python-gRPC plugin) is not available in this
+image, so the service registration and client stubs that it would have
+generated are written here directly against grpcio's generic-handler
+API. Method paths follow proto service naming:
+
+    /v1beta1.Registration/Register
+    /v1beta1.DevicePlugin/{GetDevicePluginOptions,ListAndWatch,
+                           GetPreferredAllocation,Allocate,
+                           PreStartContainer}
+    /deviceplugin.Registration/Register
+    /deviceplugin.DevicePlugin/{ListAndWatch,Allocate}
+    /v1alpha1.PodResourcesLister/List
+
+Mirrors the surface the reference consumes from its vendored
+protoc-generated Go code (SURVEY.md section 2.2: deviceplugin API).
+"""
+
+import grpc
+
+from . import deviceplugin_v1beta1_pb2 as b1
+from . import deviceplugin_v1alpha_pb2 as a1
+from . import podresources_v1alpha1_pb2 as pr
+
+# API versions as registered with the kubelet.
+V1BETA1_VERSION = "v1beta1"
+V1ALPHA_VERSION = "v1alpha"
+
+# Device health strings (k8s.io deviceplugin constants).
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+_V1BETA1_DP = "v1beta1.DevicePlugin"
+_V1BETA1_REG = "v1beta1.Registration"
+_V1ALPHA_DP = "deviceplugin.DevicePlugin"
+_V1ALPHA_REG = "deviceplugin.Registration"
+_PODRES = "v1alpha1.PodResourcesLister"
+
+
+class DevicePluginV1Beta1Servicer:
+    """Base class for the v1beta1 DevicePlugin service."""
+
+    def GetDevicePluginOptions(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetDevicePluginOptions")
+
+    def ListAndWatch(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "ListAndWatch")
+
+    def GetPreferredAllocation(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetPreferredAllocation")
+
+    def Allocate(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Allocate")
+
+    def PreStartContainer(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "PreStartContainer")
+
+
+class DevicePluginV1AlphaServicer:
+    """Base class for the v1alpha DevicePlugin service."""
+
+    def ListAndWatch(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "ListAndWatch")
+
+    def Allocate(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Allocate")
+
+
+class RegistrationServicer:
+    """Base class for the kubelet Registration service (both versions).
+
+    Implemented by test kubelet stubs (the real kubelet serves this).
+    """
+
+    def Register(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Register")
+
+
+def add_device_plugin_v1beta1(servicer, server):
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=b1.Empty.FromString,
+            response_serializer=b1.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=b1.Empty.FromString,
+            response_serializer=b1.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=b1.PreferredAllocationRequest.FromString,
+            response_serializer=b1.PreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=b1.AllocateRequest.FromString,
+            response_serializer=b1.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=b1.PreStartContainerRequest.FromString,
+            response_serializer=b1.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_V1BETA1_DP, handlers),)
+    )
+
+
+def add_device_plugin_v1alpha(servicer, server):
+    handlers = {
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=a1.Empty.FromString,
+            response_serializer=a1.ListAndWatchResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=a1.AllocateRequest.FromString,
+            response_serializer=a1.AllocateResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_V1ALPHA_DP, handlers),)
+    )
+
+
+def add_registration_v1beta1(servicer, server):
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=b1.RegisterRequest.FromString,
+            response_serializer=b1.Empty.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_V1BETA1_REG, handlers),)
+    )
+
+
+def add_registration_v1alpha(servicer, server):
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=a1.RegisterRequest.FromString,
+            response_serializer=a1.Empty.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_V1ALPHA_REG, handlers),)
+    )
+
+
+class PodResourcesListerServicer:
+    """Base class for the kubelet PodResources service (test stubs)."""
+
+    def List(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "List")
+
+
+def add_pod_resources_lister(servicer, server):
+    handlers = {
+        "List": grpc.unary_unary_rpc_method_handler(
+            servicer.List,
+            request_deserializer=pr.ListPodResourcesRequest.FromString,
+            response_serializer=pr.ListPodResourcesResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_PODRES, handlers),)
+    )
+
+
+class DevicePluginV1Beta1Stub:
+    def __init__(self, channel):
+        self.GetDevicePluginOptions = channel.unary_unary(
+            f"/{_V1BETA1_DP}/GetDevicePluginOptions",
+            request_serializer=b1.Empty.SerializeToString,
+            response_deserializer=b1.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            f"/{_V1BETA1_DP}/ListAndWatch",
+            request_serializer=b1.Empty.SerializeToString,
+            response_deserializer=b1.ListAndWatchResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            f"/{_V1BETA1_DP}/GetPreferredAllocation",
+            request_serializer=b1.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=b1.PreferredAllocationResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            f"/{_V1BETA1_DP}/Allocate",
+            request_serializer=b1.AllocateRequest.SerializeToString,
+            response_deserializer=b1.AllocateResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            f"/{_V1BETA1_DP}/PreStartContainer",
+            request_serializer=b1.PreStartContainerRequest.SerializeToString,
+            response_deserializer=b1.PreStartContainerResponse.FromString,
+        )
+
+
+class DevicePluginV1AlphaStub:
+    def __init__(self, channel):
+        self.ListAndWatch = channel.unary_stream(
+            f"/{_V1ALPHA_DP}/ListAndWatch",
+            request_serializer=a1.Empty.SerializeToString,
+            response_deserializer=a1.ListAndWatchResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            f"/{_V1ALPHA_DP}/Allocate",
+            request_serializer=a1.AllocateRequest.SerializeToString,
+            response_deserializer=a1.AllocateResponse.FromString,
+        )
+
+
+class RegistrationV1Beta1Stub:
+    def __init__(self, channel):
+        self.Register = channel.unary_unary(
+            f"/{_V1BETA1_REG}/Register",
+            request_serializer=b1.RegisterRequest.SerializeToString,
+            response_deserializer=b1.Empty.FromString,
+        )
+
+
+class RegistrationV1AlphaStub:
+    def __init__(self, channel):
+        self.Register = channel.unary_unary(
+            f"/{_V1ALPHA_REG}/Register",
+            request_serializer=a1.RegisterRequest.SerializeToString,
+            response_deserializer=a1.Empty.FromString,
+        )
+
+
+class PodResourcesListerStub:
+    def __init__(self, channel):
+        self.List = channel.unary_unary(
+            f"/{_PODRES}/List",
+            request_serializer=pr.ListPodResourcesRequest.SerializeToString,
+            response_deserializer=pr.ListPodResourcesResponse.FromString,
+        )

@@ -1,0 +1,6 @@
+"""Shared helpers: structured logging, path utilities."""
+
+from .paths import device_name_from_path
+from .log import get_logger
+
+__all__ = ["device_name_from_path", "get_logger"]

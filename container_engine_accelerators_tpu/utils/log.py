@@ -1,0 +1,35 @@
+"""Structured logging for the plugin stack.
+
+The reference uses glog verbosity levels (SURVEY.md section 5,
+"Tracing / profiling"); here standard logging with a glog-like format
+plays that role. Verbosity maps: -v >= 3 -> DEBUG, else INFO.
+"""
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(levelname).1s%(asctime)s.%(msecs)03d %(name)s] %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+_configured = False
+
+
+def _configure():
+    global _configured
+    if _configured:
+        return
+    verbosity = int(os.environ.get("TPU_PLUGIN_VERBOSITY", "0"))
+    level = logging.DEBUG if verbosity >= 3 else logging.INFO
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+    root = logging.getLogger("cea_tpu")
+    root.setLevel(level)
+    root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name):
+    _configure()
+    return logging.getLogger("cea_tpu").getChild(name)

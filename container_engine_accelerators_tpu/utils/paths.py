@@ -1,0 +1,22 @@
+"""Device path helpers.
+
+Capability parity with the reference's path util
+(pkg/gpu/nvidia/util/util.go:22-29), for TPU accel nodes.
+"""
+
+import os
+import re
+
+_DEVICE_RE = re.compile(r"^accel[0-9]+$")
+
+
+def device_name_from_path(path):
+    """Return the device name for an accel device path.
+
+    "/dev/accel0" -> "accel0". Raises ValueError for paths whose
+    basename is not an accel device node.
+    """
+    name = os.path.basename(path)
+    if not _DEVICE_RE.match(name):
+        raise ValueError(f"not a TPU accel device path: {path!r}")
+    return name

@@ -1,0 +1,423 @@
+// tpuinfo — TPU chip-information library implementation.
+//
+// See tpuinfo.h for the ABI contract and the mapping onto the
+// reference's NVML/MIG native layer.
+
+#include "tpuinfo.h"
+
+#include <dirent.h>
+#include <sys/stat.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct DutySample {
+  int64_t busy_us = 0;
+  int64_t total_us = 0;
+};
+
+struct Chip {
+  int index = 0;        // N in accelN
+  int x = 0, y = 0, z = 0;
+  std::deque<DutySample> samples;  // ring of cumulative counters
+};
+
+struct State {
+  std::string dev_dir;
+  std::string state_dir;
+  int dims[3] = {0, 0, 0};
+  std::vector<Chip> chips;           // sorted by index
+  std::vector<int> coord_to_chip;    // x*dy*dz + y*dz + z -> position in chips
+  bool initialized = false;
+};
+
+std::mutex g_mu;
+State g_state;
+
+constexpr size_t kMaxSamples = 128;
+
+bool ReadFileString(const std::string& path, std::string* out) {
+  std::ifstream f(path);
+  if (!f.good()) return false;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  return true;
+}
+
+std::string Trim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+// Parse "AxB" or "AxBxC" into 3 dims (z defaults to 1). Dims must be
+// positive. Returns false on malformed input.
+bool ParseShape(const char* shape, int dims[3]) {
+  if (shape == nullptr) return false;
+  std::string s(shape);
+  dims[0] = dims[1] = dims[2] = 1;
+  int part = 0;
+  size_t pos = 0;
+  while (pos < s.size() && part < 3) {
+    size_t next = s.find('x', pos);
+    std::string tok = s.substr(pos, next == std::string::npos ? std::string::npos
+                                                              : next - pos);
+    tok = Trim(tok);
+    if (tok.empty() ||
+        !std::all_of(tok.begin(), tok.end(),
+                     [](unsigned char c) { return std::isdigit(c); }))
+      return false;
+    long v = std::strtol(tok.c_str(), nullptr, 10);
+    if (v <= 0 || v > 4096) return false;
+    dims[part++] = static_cast<int>(v);
+    if (next == std::string::npos) {
+      pos = s.size();
+      break;
+    }
+    pos = next + 1;
+    if (pos >= s.size()) return false;  // trailing separator, e.g. "2x"
+  }
+  // Reject trailing garbage ("2x2x2x2") and empty input.
+  return part >= 1 && pos >= s.size();
+}
+
+// Enumerate accel[0-9]+ nodes in dev_dir; returns sorted chip indices.
+std::vector<int> ScanDevDir(const std::string& dev_dir) {
+  std::vector<int> found;
+  DIR* d = opendir(dev_dir.c_str());
+  if (d == nullptr) return found;
+  while (dirent* e = readdir(d)) {
+    const char* name = e->d_name;
+    if (std::strncmp(name, "accel", 5) != 0) continue;
+    const char* digits = name + 5;
+    if (*digits == '\0') continue;
+    bool all_digits = true;
+    for (const char* p = digits; *p; ++p)
+      if (!std::isdigit(static_cast<unsigned char>(*p))) all_digits = false;
+    if (!all_digits) continue;
+    found.push_back(std::atoi(digits));
+  }
+  closedir(d);
+  std::sort(found.begin(), found.end());
+  found.erase(std::unique(found.begin(), found.end()), found.end());
+  return found;
+}
+
+// Topology resolution order: CEA_TPU_TOPOLOGY env (explicit operator
+// override); <state_dir>/topology (node-published); TPU_TOPOLOGY env
+// (ambient runtime hint — last because libtpu runtimes export it for
+// the *process*, not the node); inference from chip count.
+void ResolveTopology(State* st) {
+  std::string spec;
+  const char* override_env = std::getenv("CEA_TPU_TOPOLOGY");
+  if (override_env != nullptr && *override_env != '\0') {
+    spec = override_env;
+  } else {
+    std::string file;
+    if (ReadFileString(st->state_dir + "/topology", &file)) spec = Trim(file);
+    if (spec.empty()) {
+      const char* env = std::getenv("TPU_TOPOLOGY");
+      if (env != nullptr && *env != '\0') spec = env;
+    }
+  }
+  int dims[3];
+  if (!spec.empty() && ParseShape(spec.c_str(), dims)) {
+    st->dims[0] = dims[0];
+    st->dims[1] = dims[1];
+    st->dims[2] = dims[2];
+    return;
+  }
+  // Infer: n = 1 -> 1x1x1; 4 -> 2x2x1; 8 -> 2x4x1; else 1xNx1.
+  int n = static_cast<int>(st->chips.size());
+  if (n <= 0) {
+    st->dims[0] = st->dims[1] = st->dims[2] = 0;
+    return;
+  }
+  int x = 1;
+  for (int cand = 2; cand * cand <= n; ++cand)
+    if (n % cand == 0) x = cand;
+  st->dims[0] = x;
+  st->dims[1] = n / x;
+  st->dims[2] = 1;
+}
+
+// Chip coordinates: <state_dir>/accelN/coords ("x,y,z" or "x,y"),
+// else row-major by chip order over the topology dims.
+void ResolveCoords(State* st) {
+  const int dy = st->dims[1], dz = st->dims[2];
+  for (size_t i = 0; i < st->chips.size(); ++i) {
+    Chip& c = st->chips[i];
+    std::string raw;
+    bool ok = false;
+    if (ReadFileString(
+            st->state_dir + "/accel" + std::to_string(c.index) + "/coords",
+            &raw)) {
+      int x = 0, y = 0, z = 0;
+      int n = std::sscanf(raw.c_str(), "%d,%d,%d", &x, &y, &z);
+      if (n >= 2) {
+        c.x = x;
+        c.y = y;
+        c.z = (n == 3) ? z : 0;
+        ok = true;
+      }
+    }
+    if (!ok && dy > 0 && dz > 0) {
+      int flat = static_cast<int>(i);
+      c.z = flat % dz;
+      c.y = (flat / dz) % dy;
+      c.x = flat / (dz * dy);
+    }
+  }
+  st->coord_to_chip.assign(
+      std::max(1, st->dims[0] * st->dims[1] * st->dims[2]), -1);
+  for (size_t i = 0; i < st->chips.size(); ++i) {
+    const Chip& c = st->chips[i];
+    if (c.x < 0 || c.x >= st->dims[0] || c.y < 0 || c.y >= st->dims[1] ||
+        c.z < 0 || c.z >= st->dims[2])
+      continue;
+    st->coord_to_chip[(c.x * st->dims[1] + c.y) * st->dims[2] + c.z] =
+        static_cast<int>(i);
+  }
+}
+
+int RescanLocked() {
+  std::vector<int> indices = ScanDevDir(g_state.dev_dir);
+  // Preserve sample rings for chips that persist across rescans.
+  std::vector<Chip> next;
+  next.reserve(indices.size());
+  for (int idx : indices) {
+    Chip c;
+    c.index = idx;
+    for (Chip& old : g_state.chips)
+      if (old.index == idx) c.samples = std::move(old.samples);
+    next.push_back(std::move(c));
+  }
+  g_state.chips = std::move(next);
+  ResolveTopology(&g_state);
+  ResolveCoords(&g_state);
+  return static_cast<int>(g_state.chips.size());
+}
+
+Chip* FindChip(int chip) {
+  for (Chip& c : g_state.chips)
+    if (c.index == chip) return &c;
+  return nullptr;
+}
+
+int HealthFromToken(const std::string& token) {
+  if (token == "ok" || token.empty()) return TPUINFO_HEALTH_OK;
+  if (token == "uncorrectable_ecc") return TPUINFO_HEALTH_UNCORRECTABLE_ECC;
+  if (token == "ici_link_down") return TPUINFO_HEALTH_ICI_LINK_DOWN;
+  if (token == "overheat") return TPUINFO_HEALTH_OVERHEAT;
+  if (token == "wedged") return TPUINFO_HEALTH_WEDGED;
+  return TPUINFO_HEALTH_UNKNOWN;
+}
+
+// Validate shape against topology; fill tiles-per-axis. Mirrors the
+// uniform-partitioning invariant of the reference's MIG manager
+// (mig.go:190-201): every chip must land in exactly one subslice.
+int TileGrid(const int shape[3], int tiles[3]) {
+  for (int a = 0; a < 3; ++a) {
+    if (g_state.dims[a] <= 0) return TPUINFO_ERR_NONUNIFORM;
+    if (shape[a] > g_state.dims[a] || g_state.dims[a] % shape[a] != 0)
+      return TPUINFO_ERR_NONUNIFORM;
+    tiles[a] = g_state.dims[a] / shape[a];
+  }
+  return TPUINFO_OK;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuinfo_init(const char* dev_dir, const char* state_dir) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_state = State();
+  g_state.dev_dir = dev_dir ? dev_dir : "/dev";
+  g_state.state_dir = state_dir ? state_dir : "/run/tpu";
+  g_state.initialized = true;
+  return RescanLocked();
+}
+
+void tpuinfo_shutdown(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_state = State();
+}
+
+int tpuinfo_rescan(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  return RescanLocked();
+}
+
+int tpuinfo_chip_count(void) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  return static_cast<int>(g_state.chips.size());
+}
+
+int tpuinfo_topology(int dims[3]) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  dims[0] = g_state.dims[0];
+  dims[1] = g_state.dims[1];
+  dims[2] = g_state.dims[2];
+  return TPUINFO_OK;
+}
+
+int tpuinfo_chip_coords(int chip, int* x, int* y, int* z) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  Chip* c = FindChip(chip);
+  if (c == nullptr) return TPUINFO_ERR_NO_SUCH_CHIP;
+  if (x) *x = c->x;
+  if (y) *y = c->y;
+  if (z) *z = c->z;
+  return TPUINFO_OK;
+}
+
+int tpuinfo_chip_at(int x, int y, int z) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  if (x < 0 || x >= g_state.dims[0] || y < 0 || y >= g_state.dims[1] ||
+      z < 0 || z >= g_state.dims[2])
+    return TPUINFO_ERR_RANGE;
+  int pos =
+      g_state.coord_to_chip[(x * g_state.dims[1] + y) * g_state.dims[2] + z];
+  if (pos < 0) return TPUINFO_ERR_NO_SUCH_CHIP;
+  return g_state.chips[pos].index;
+}
+
+int tpuinfo_chip_health(int chip) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  Chip* c = FindChip(chip);
+  if (c == nullptr) return TPUINFO_ERR_NO_SUCH_CHIP;
+  std::string raw;
+  if (!ReadFileString(
+          g_state.state_dir + "/accel" + std::to_string(chip) + "/health",
+          &raw))
+    return TPUINFO_HEALTH_OK;  // no state published -> healthy
+  return HealthFromToken(Trim(raw));
+}
+
+int tpuinfo_chip_hbm(int chip, int64_t* total, int64_t* used) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  if (FindChip(chip) == nullptr) return TPUINFO_ERR_NO_SUCH_CHIP;
+  std::string raw;
+  if (!ReadFileString(
+          g_state.state_dir + "/accel" + std::to_string(chip) + "/hbm", &raw))
+    return TPUINFO_ERR_NO_DATA;
+  long long t = 0, u = 0;
+  if (std::sscanf(raw.c_str(), "%lld %lld", &t, &u) != 2)
+    return TPUINFO_ERR_IO;
+  if (total) *total = t;
+  if (used) *used = u;
+  return TPUINFO_OK;
+}
+
+int tpuinfo_sample_duty(int chip) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  Chip* c = FindChip(chip);
+  if (c == nullptr) return TPUINFO_ERR_NO_SUCH_CHIP;
+  std::string raw;
+  if (!ReadFileString(g_state.state_dir + "/accel" + std::to_string(chip) +
+                          "/duty_cycle",
+                      &raw))
+    return TPUINFO_ERR_NO_DATA;
+  DutySample s;
+  long long busy = 0, total = 0;
+  if (std::sscanf(raw.c_str(), "%lld %lld", &busy, &total) != 2)
+    return TPUINFO_ERR_IO;
+  s.busy_us = busy;
+  s.total_us = total;
+  c->samples.push_back(s);
+  while (c->samples.size() > kMaxSamples) c->samples.pop_front();
+  return TPUINFO_OK;
+}
+
+int tpuinfo_duty_cycle(int chip, int64_t window_us, double* out_percent) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  Chip* c = FindChip(chip);
+  if (c == nullptr) return TPUINFO_ERR_NO_SUCH_CHIP;
+  if (c->samples.size() < 2) return TPUINFO_ERR_NO_DATA;
+  // Walk back from the newest sample to the oldest one still inside
+  // the window (by the cumulative total_us clock), then average the
+  // busy delta over the elapsed delta — same averaging the reference
+  // does over NVML sample buffers (metrics/util.go:37-72).
+  const DutySample& newest = c->samples.back();
+  const DutySample* oldest = &c->samples.front();
+  for (auto it = c->samples.rbegin(); it != c->samples.rend(); ++it) {
+    if (newest.total_us - it->total_us <= window_us) oldest = &*it;
+    else break;
+  }
+  int64_t dt = newest.total_us - oldest->total_us;
+  if (dt <= 0) return TPUINFO_ERR_NO_DATA;
+  int64_t busy = newest.busy_us - oldest->busy_us;
+  double pct = 100.0 * static_cast<double>(busy) / static_cast<double>(dt);
+  if (pct < 0.0) pct = 0.0;
+  if (pct > 100.0) pct = 100.0;
+  if (out_percent) *out_percent = pct;
+  return TPUINFO_OK;
+}
+
+int tpuinfo_subslice_count(const char* shape) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  int sh[3];
+  if (!ParseShape(shape, sh)) return TPUINFO_ERR_BAD_SHAPE;
+  int tiles[3];
+  int rc = TileGrid(sh, tiles);
+  if (rc != TPUINFO_OK) return rc;
+  return tiles[0] * tiles[1] * tiles[2];
+}
+
+int tpuinfo_subslice_chips(const char* shape, int index, int* chips, int max) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_state.initialized) return TPUINFO_ERR_UNINITIALIZED;
+  int sh[3];
+  if (!ParseShape(shape, sh)) return TPUINFO_ERR_BAD_SHAPE;
+  int tiles[3];
+  int rc = TileGrid(sh, tiles);
+  if (rc != TPUINFO_OK) return rc;
+  int n_tiles = tiles[0] * tiles[1] * tiles[2];
+  if (index < 0 || index >= n_tiles) return TPUINFO_ERR_RANGE;
+  // Tile origin, row-major over the tile grid.
+  int tz = index % tiles[2];
+  int ty = (index / tiles[2]) % tiles[1];
+  int tx = index / (tiles[2] * tiles[1]);
+  int ox = tx * sh[0], oy = ty * sh[1], oz = tz * sh[2];
+  int count = 0;
+  for (int dx = 0; dx < sh[0]; ++dx)
+    for (int dy = 0; dy < sh[1]; ++dy)
+      for (int dz = 0; dz < sh[2]; ++dz) {
+        int pos = g_state.coord_to_chip[((ox + dx) * g_state.dims[1] +
+                                         (oy + dy)) * g_state.dims[2] +
+                                        (oz + dz)];
+        if (pos < 0) return TPUINFO_ERR_NO_SUCH_CHIP;
+        if (count < max && chips != nullptr)
+          chips[count] = g_state.chips[pos].index;
+        ++count;
+      }
+  return count;
+}
+
+const char* tpuinfo_version(void) { return "tpuinfo 0.1.0"; }
+
+}  // extern "C"

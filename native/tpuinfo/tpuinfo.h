@@ -1,0 +1,116 @@
+/* tpuinfo — TPU chip-information library (C ABI).
+ *
+ * TPU-native counterpart of the reference's NVML cgo binding surface
+ * (vendor/.../nvml/nvml.go:276-744 and mig.go:126-414 in
+ * pradvenkat/container-engine-accelerators): chip enumeration, ICI
+ * topology, health, HBM stats, utilization sampling and subslice
+ * (MIG-analog) solving.
+ *
+ * Unlike NVML there is no stable public libtpu C API to dlopen, so this
+ * library defines the ABI itself and sources its facts from the node:
+ *   - chips:    <dev_dir>/accel[0-9]+ device nodes
+ *   - topology: CEA_TPU_TOPOLOGY env override, <state_dir>/topology,
+ *               ambient TPU_TOPOLOGY env, or inferred from the chip
+ *               count (1->1x1, 4->2x2, 8->2x4, ...)
+ *   - health:   <state_dir>/accelN/health ("ok" or an error token)
+ *   - hbm:      <state_dir>/accelN/hbm ("<total> <used>" bytes)
+ *   - duty:     <state_dir>/accelN/duty_cycle cumulative
+ *               "<busy_us> <total_us>" counters
+ * The state_dir seam is what makes the health/metrics path unit-testable
+ * with no TPU attached — the same trick the reference plays with fake
+ * /dev and /proc trees (SURVEY.md section 4).
+ *
+ * All functions return >= 0 on success and a negative TPUINFO_ERR_* on
+ * failure. The library is thread-safe after tpuinfo_init.
+ */
+
+#ifndef TPUINFO_H_
+#define TPUINFO_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Error codes (negative returns). */
+#define TPUINFO_OK 0
+#define TPUINFO_ERR_UNINITIALIZED -1
+#define TPUINFO_ERR_NO_SUCH_CHIP -2
+#define TPUINFO_ERR_BAD_SHAPE -3
+#define TPUINFO_ERR_NONUNIFORM -4 /* shape does not tile the topology */
+#define TPUINFO_ERR_IO -5
+#define TPUINFO_ERR_NO_DATA -6
+#define TPUINFO_ERR_RANGE -7
+
+/* Chip health states (tpuinfo_chip_health return values).
+ * UNCORRECTABLE_ECC is the analog of the reference's Xid-48 double-bit
+ * ECC trigger (health_checker.go:172-211). */
+#define TPUINFO_HEALTH_OK 0
+#define TPUINFO_HEALTH_UNKNOWN 1
+#define TPUINFO_HEALTH_UNCORRECTABLE_ECC 2
+#define TPUINFO_HEALTH_ICI_LINK_DOWN 3
+#define TPUINFO_HEALTH_OVERHEAT 4
+#define TPUINFO_HEALTH_WEDGED 5
+
+/* Initialize from a device dir (e.g. "/dev") and a state dir
+ * (e.g. "/run/tpu"; may be missing — all chips then report OK health
+ * and no data for hbm/duty). Returns chip count. Re-init allowed. */
+int tpuinfo_init(const char* dev_dir, const char* state_dir);
+
+/* Release all state. Safe to call when uninitialized. */
+void tpuinfo_shutdown(void);
+
+/* Re-scan <dev_dir> for hot-plugged/removed chips. Returns new count. */
+int tpuinfo_rescan(void);
+
+int tpuinfo_chip_count(void);
+
+/* Physical ICI topology dims, always 3 ints (z=1 for 2D). */
+int tpuinfo_topology(int dims[3]);
+
+/* Chip's coordinates on the torus. */
+int tpuinfo_chip_coords(int chip, int* x, int* y, int* z);
+
+/* Chip index at given coordinates, or TPUINFO_ERR_NO_SUCH_CHIP. */
+int tpuinfo_chip_at(int x, int y, int z);
+
+/* Health state (TPUINFO_HEALTH_*), re-read from the state dir. */
+int tpuinfo_chip_health(int chip);
+
+/* HBM byte counts. TPUINFO_ERR_NO_DATA if the node publishes none. */
+int tpuinfo_chip_hbm(int chip, int64_t* total, int64_t* used);
+
+/* Record the current duty-cycle counters into the chip's sample ring.
+ * Call periodically (the metrics collector does); samples carry their
+ * own cumulative busy/total microsecond counters. */
+int tpuinfo_sample_duty(int chip);
+
+/* Average duty cycle (percent, 0-100) over the trailing window_us of
+ * recorded samples — counterpart of the reference's C shim averaging
+ * NVML utilization samples (pkg/gpu/nvidia/metrics/util.go:37-72).
+ * TPUINFO_ERR_NO_DATA until two samples spanning the window exist. */
+int tpuinfo_duty_cycle(int chip, int64_t window_us, double* out_percent);
+
+/* ---- Subslice (MIG-analog) API -------------------------------------
+ * A subslice shape is "AxB" or "AxBxC" chips, e.g. "2x2". Shapes must
+ * tile the host topology uniformly — the invariant the reference
+ * enforces for MIG partitions (mig.go:190-201); otherwise
+ * TPUINFO_ERR_NONUNIFORM. Subslices are indexed row-major over the
+ * grid of tiles. */
+
+/* Number of subslices the shape yields, validating uniformity. */
+int tpuinfo_subslice_count(const char* shape);
+
+/* Chip indices belonging to subslice `index`; writes up to max ints.
+ * Returns number of chips in the subslice. */
+int tpuinfo_subslice_chips(const char* shape, int index, int* chips, int max);
+
+/* Library version string, e.g. "tpuinfo 0.1.0". */
+const char* tpuinfo_version(void);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* TPUINFO_H_ */

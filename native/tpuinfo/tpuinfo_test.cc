@@ -1,0 +1,165 @@
+// Minimal C++ unit tests for tpuinfo against a synthetic dev/state tree.
+//
+// Mirrors the reference's fake-/dev and fake-/proc test technique
+// (SURVEY.md section 4) at the native layer. Run via `make test`.
+
+#include "tpuinfo.h"
+
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+
+static int g_failures = 0;
+
+#define CHECK_EQ(a, b)                                                      \
+  do {                                                                      \
+    auto va = (a);                                                          \
+    auto vb = (b);                                                          \
+    if (!(va == vb)) {                                                      \
+      std::fprintf(stderr, "FAIL %s:%d: %s == %s (%lld vs %lld)\n",         \
+                   __FILE__, __LINE__, #a, #b, (long long)va,               \
+                   (long long)vb);                                          \
+      ++g_failures;                                                         \
+    }                                                                       \
+  } while (0)
+
+static void WriteFileAt(const std::string& path, const std::string& body) {
+  std::ofstream f(path);
+  f << body;
+}
+
+static std::string MakeTree(int chips, const char* topology) {
+  char tmpl[] = "/tmp/tpuinfo_test_XXXXXX";
+  std::string root = mkdtemp(tmpl);
+  std::string dev = root + "/dev";
+  std::string state = root + "/state";
+  mkdir(dev.c_str(), 0755);
+  mkdir(state.c_str(), 0755);
+  for (int i = 0; i < chips; ++i) {
+    WriteFileAt(dev + "/accel" + std::to_string(i), "");
+    mkdir((state + "/accel" + std::to_string(i)).c_str(), 0755);
+  }
+  if (topology) WriteFileAt(state + "/topology", topology);
+  return root;
+}
+
+static void TestEnumerationAndTopology() {
+  std::string root = MakeTree(8, "2x4");
+  CHECK_EQ(tpuinfo_init((root + "/dev").c_str(), (root + "/state").c_str()), 8);
+  int dims[3];
+  CHECK_EQ(tpuinfo_topology(dims), TPUINFO_OK);
+  CHECK_EQ(dims[0], 2);
+  CHECK_EQ(dims[1], 4);
+  CHECK_EQ(dims[2], 1);
+  int x, y, z;
+  CHECK_EQ(tpuinfo_chip_coords(5, &x, &y, &z), TPUINFO_OK);
+  CHECK_EQ(x, 1);  // row-major: chip 5 -> (1, 1)
+  CHECK_EQ(y, 1);
+  CHECK_EQ(tpuinfo_chip_at(1, 1, 0), 5);
+  CHECK_EQ(tpuinfo_chip_coords(99, &x, &y, &z), TPUINFO_ERR_NO_SUCH_CHIP);
+  tpuinfo_shutdown();
+}
+
+static void TestSubslices() {
+  std::string root = MakeTree(8, "2x4");
+  tpuinfo_init((root + "/dev").c_str(), (root + "/state").c_str());
+  CHECK_EQ(tpuinfo_subslice_count("2x2"), 2);
+  CHECK_EQ(tpuinfo_subslice_count("1x1"), 8);
+  CHECK_EQ(tpuinfo_subslice_count("2x4"), 1);
+  CHECK_EQ(tpuinfo_subslice_count("2x3"), TPUINFO_ERR_NONUNIFORM);
+  CHECK_EQ(tpuinfo_subslice_count("3x1"), TPUINFO_ERR_NONUNIFORM);
+  CHECK_EQ(tpuinfo_subslice_count("nonsense"), TPUINFO_ERR_BAD_SHAPE);
+  CHECK_EQ(tpuinfo_subslice_count("2x2x2x2"), TPUINFO_ERR_BAD_SHAPE);
+  int chips[8];
+  CHECK_EQ(tpuinfo_subslice_chips("2x2", 0, chips, 8), 4);
+  // Tile 0 covers coords (0..1, 0..1): chips 0,1,4,5 in row-major 2x4.
+  CHECK_EQ(chips[0], 0);
+  CHECK_EQ(chips[1], 1);
+  CHECK_EQ(chips[2], 4);
+  CHECK_EQ(chips[3], 5);
+  CHECK_EQ(tpuinfo_subslice_chips("2x2", 1, chips, 8), 4);
+  CHECK_EQ(chips[0], 2);
+  CHECK_EQ(chips[3], 7);
+  CHECK_EQ(tpuinfo_subslice_chips("2x2", 2, chips, 8), TPUINFO_ERR_RANGE);
+  tpuinfo_shutdown();
+}
+
+static void TestHealthAndHbm() {
+  std::string root = MakeTree(4, "2x2");
+  std::string state = root + "/state";
+  tpuinfo_init((root + "/dev").c_str(), state.c_str());
+  CHECK_EQ(tpuinfo_chip_health(0), TPUINFO_HEALTH_OK);
+  WriteFileAt(state + "/accel2/health", "uncorrectable_ecc\n");
+  CHECK_EQ(tpuinfo_chip_health(2), TPUINFO_HEALTH_UNCORRECTABLE_ECC);
+  WriteFileAt(state + "/accel3/health", "gibberish");
+  CHECK_EQ(tpuinfo_chip_health(3), TPUINFO_HEALTH_UNKNOWN);
+  int64_t total = 0, used = 0;
+  CHECK_EQ(tpuinfo_chip_hbm(0, &total, &used), TPUINFO_ERR_NO_DATA);
+  WriteFileAt(state + "/accel0/hbm", "17179869184 123456\n");
+  CHECK_EQ(tpuinfo_chip_hbm(0, &total, &used), TPUINFO_OK);
+  CHECK_EQ(total, 17179869184LL);
+  CHECK_EQ(used, 123456LL);
+  tpuinfo_shutdown();
+}
+
+static void TestDutyCycle() {
+  std::string root = MakeTree(1, "1x1");
+  std::string state = root + "/state";
+  tpuinfo_init((root + "/dev").c_str(), state.c_str());
+  double pct = -1;
+  CHECK_EQ(tpuinfo_duty_cycle(0, 10000000, &pct), TPUINFO_ERR_NO_DATA);
+  WriteFileAt(state + "/accel0/duty_cycle", "0 0");
+  CHECK_EQ(tpuinfo_sample_duty(0), TPUINFO_OK);
+  WriteFileAt(state + "/accel0/duty_cycle", "600000 1000000");  // 60% busy
+  CHECK_EQ(tpuinfo_sample_duty(0), TPUINFO_OK);
+  CHECK_EQ(tpuinfo_duty_cycle(0, 10000000, &pct), TPUINFO_OK);
+  CHECK_EQ((int)(pct + 0.5), 60);
+  // Narrow window excludes the first sample -> newest-vs-itself = no data,
+  // so extend with a third sample inside the window.
+  WriteFileAt(state + "/accel0/duty_cycle", "650000 1100000");  // 50% marginal
+  CHECK_EQ(tpuinfo_sample_duty(0), TPUINFO_OK);
+  CHECK_EQ(tpuinfo_duty_cycle(0, 150000, &pct), TPUINFO_OK);
+  CHECK_EQ((int)(pct + 0.5), 50);
+  tpuinfo_shutdown();
+}
+
+static void TestRescanHotplug() {
+  std::string root = MakeTree(2, "1x2");
+  std::string dev = root + "/dev";
+  tpuinfo_init(dev.c_str(), (root + "/state").c_str());
+  CHECK_EQ(tpuinfo_chip_count(), 2);
+  WriteFileAt(dev + "/accel2", "");
+  WriteFileAt(dev + "/accel3", "");
+  WriteFileAt((root + "/state/topology"), "2x2");
+  CHECK_EQ(tpuinfo_rescan(), 4);
+  int dims[3];
+  tpuinfo_topology(dims);
+  CHECK_EQ(dims[0] * dims[1] * dims[2], 4);
+  tpuinfo_shutdown();
+}
+
+static void TestUninitialized() {
+  tpuinfo_shutdown();
+  CHECK_EQ(tpuinfo_chip_count(), TPUINFO_ERR_UNINITIALIZED);
+  CHECK_EQ(tpuinfo_rescan(), TPUINFO_ERR_UNINITIALIZED);
+}
+
+int main() {
+  TestEnumerationAndTopology();
+  TestSubslices();
+  TestHealthAndHbm();
+  TestDutyCycle();
+  TestRescanHotplug();
+  TestUninitialized();
+  if (g_failures == 0) {
+    std::printf("tpuinfo_test: all tests passed\n");
+    return 0;
+  }
+  std::fprintf(stderr, "tpuinfo_test: %d failures\n", g_failures);
+  return 1;
+}

@@ -1,0 +1,75 @@
+"""Test harness configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (no TPU in CI), mirroring
+how the reference keeps its whole suite hardware-free (SURVEY.md
+section 4: fake /dev, fake /proc, fake kubelet; `go test -short`).
+The env must be set before the first jax import anywhere in the
+process, hence here at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import subprocess
+
+import pytest
+
+
+def _ensure_native_lib():
+    lib = os.path.join(REPO_ROOT, "build", "libtpuinfo.so")
+    if not os.path.exists(lib):
+        subprocess.run(
+            ["make", "-C", os.path.join(REPO_ROOT, "native", "tpuinfo")],
+            check=False, capture_output=True)
+    return lib if os.path.exists(lib) else None
+
+
+NATIVE_LIB = _ensure_native_lib()
+
+
+@pytest.fixture
+def fake_node(tmp_path):
+    """A synthetic TPU node: dev dir with accel nodes + state dir.
+
+    The TempDir-backed fake /dev is the same technique the reference's
+    plugin tests use (beta_plugin_test.go:34-61).
+    """
+    dev = tmp_path / "dev"
+    state = tmp_path / "state"
+    dev.mkdir()
+    state.mkdir()
+
+    class Node:
+        dev_dir = str(dev)
+        state_dir = str(state)
+
+        @staticmethod
+        def add_chip(i):
+            (dev / f"accel{i}").touch()
+            (state / f"accel{i}").mkdir(exist_ok=True)
+
+        @staticmethod
+        def remove_chip(i):
+            (dev / f"accel{i}").unlink()
+
+        @staticmethod
+        def set_topology(spec):
+            (state / "topology").write_text(spec)
+
+        @staticmethod
+        def set_state(i, leaf, body):
+            d = state / f"accel{i}"
+            d.mkdir(exist_ok=True)
+            (d / leaf).write_text(body)
+
+    return Node

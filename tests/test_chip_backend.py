@@ -1,0 +1,151 @@
+"""Chip-backend tests: native/python parity against one synthetic tree.
+
+Test shape follows the reference's fake-/dev and fake-/proc technique
+(beta_plugin_test.go:34-61, mig/mig_test.go:28-128), applied at the
+chip-library layer.
+"""
+
+import pytest
+
+from container_engine_accelerators_tpu.chip import (
+    BadShapeError,
+    Health,
+    NativeChipBackend,
+    NonUniformPartitionError,
+    NoSuchChipError,
+    PyChipBackend,
+)
+from tests.conftest import NATIVE_LIB
+
+
+def backends():
+    out = [pytest.param(PyChipBackend, id="python")]
+    if NATIVE_LIB:
+        out.append(pytest.param(
+            lambda: NativeChipBackend(NATIVE_LIB), id="native"))
+    return out
+
+
+@pytest.fixture(params=backends())
+def backend(request):
+    b = request.param()
+    yield b
+    b.shutdown()
+
+
+def make_v5e8(node):
+    for i in range(8):
+        node.add_chip(i)
+    node.set_topology("2x4")
+
+
+def test_enumeration_and_topology(backend, fake_node):
+    make_v5e8(fake_node)
+    assert backend.init(fake_node.dev_dir, fake_node.state_dir) == 8
+    assert backend.chip_count() == 8
+    assert backend.topology() == (2, 4, 1)
+    assert backend.chip_coords(5) == (1, 1, 0)
+    assert backend.chip_at(1, 1, 0) == 5
+    with pytest.raises(NoSuchChipError):
+        backend.chip_coords(99)
+
+
+def test_empty_dev_dir(backend, fake_node):
+    assert backend.init(fake_node.dev_dir, fake_node.state_dir) == 0
+    assert backend.chip_count() == 0
+
+
+def test_non_accel_nodes_ignored(backend, fake_node):
+    make_v5e8(fake_node)
+    import os
+    open(os.path.join(fake_node.dev_dir, "accelfoo"), "w").close()
+    open(os.path.join(fake_node.dev_dir, "nvidia0"), "w").close()
+    assert backend.init(fake_node.dev_dir, fake_node.state_dir) == 8
+
+
+def test_subslice_tiling(backend, fake_node):
+    make_v5e8(fake_node)
+    backend.init(fake_node.dev_dir, fake_node.state_dir)
+    assert backend.subslice_count("2x2") == 2
+    assert backend.subslice_count("1x1") == 8
+    assert backend.subslice_count("2x4") == 1
+    assert backend.subslice_chips("2x2", 0) == [0, 1, 4, 5]
+    assert backend.subslice_chips("2x2", 1) == [2, 3, 6, 7]
+
+
+def test_subslice_uniformity_invariant(backend, fake_node):
+    make_v5e8(fake_node)
+    backend.init(fake_node.dev_dir, fake_node.state_dir)
+    for bad in ("2x3", "3x1", "4x4"):
+        with pytest.raises(NonUniformPartitionError):
+            backend.subslice_count(bad)
+
+
+def test_subslice_bad_shapes(backend, fake_node):
+    make_v5e8(fake_node)
+    backend.init(fake_node.dev_dir, fake_node.state_dir)
+    for bad in ("", "x", "2x", "axb", "2x2x2x2", "0x2"):
+        with pytest.raises(BadShapeError):
+            backend.subslice_count(bad)
+
+
+def test_health_states(backend, fake_node):
+    make_v5e8(fake_node)
+    backend.init(fake_node.dev_dir, fake_node.state_dir)
+    assert backend.chip_health(0) == Health.OK
+    fake_node.set_state(2, "health", "uncorrectable_ecc\n")
+    assert backend.chip_health(2) == Health.UNCORRECTABLE_ECC
+    fake_node.set_state(3, "health", "ici_link_down")
+    assert backend.chip_health(3) == Health.ICI_LINK_DOWN
+    fake_node.set_state(4, "health", "something-new")
+    assert backend.chip_health(4) == Health.UNKNOWN
+    fake_node.set_state(2, "health", "ok")
+    assert backend.chip_health(2) == Health.OK
+
+
+def test_hbm(backend, fake_node):
+    make_v5e8(fake_node)
+    backend.init(fake_node.dev_dir, fake_node.state_dir)
+    assert backend.chip_hbm(0) is None
+    fake_node.set_state(0, "hbm", "17179869184 1048576\n")
+    assert backend.chip_hbm(0) == (17179869184, 1048576)
+
+
+def test_duty_cycle_window_average(backend, fake_node):
+    make_v5e8(fake_node)
+    backend.init(fake_node.dev_dir, fake_node.state_dir)
+    assert backend.duty_cycle(0, 10_000_000) is None
+    assert backend.sample_duty(0) is False  # nothing published yet
+    fake_node.set_state(0, "duty_cycle", "0 0")
+    assert backend.sample_duty(0) is True
+    fake_node.set_state(0, "duty_cycle", "600000 1000000")
+    assert backend.sample_duty(0) is True
+    assert backend.duty_cycle(0, 10_000_000) == pytest.approx(60.0)
+
+
+def test_hotplug_rescan(backend, fake_node):
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("1x2")
+    assert backend.init(fake_node.dev_dir, fake_node.state_dir) == 2
+    fake_node.add_chip(2)
+    fake_node.add_chip(3)
+    fake_node.set_topology("2x2")
+    assert backend.rescan() == 4
+    assert backend.topology() == (2, 2, 1)
+    assert backend.chip_at(1, 1, 0) == 3
+
+
+def test_explicit_coords_override(backend, fake_node):
+    for i in range(4):
+        fake_node.add_chip(i)
+    fake_node.set_topology("2x2")
+    # Swap chips 2 and 3 on the torus via published coords.
+    fake_node.set_state(0, "coords", "0,0,0")
+    fake_node.set_state(1, "coords", "0,1,0")
+    fake_node.set_state(2, "coords", "1,1,0")
+    fake_node.set_state(3, "coords", "1,0,0")
+    backend.init(fake_node.dev_dir, fake_node.state_dir)
+    assert backend.chip_at(1, 0, 0) == 3
+    assert backend.chip_at(1, 1, 0) == 2
+    assert backend.subslice_chips("1x2", 1) == [3, 2]
