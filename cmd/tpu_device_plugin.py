@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""TPU device-plugin entry binary.
+
+Capability parity with cmd/nvidia_gpu/nvidia_gpu.go: parse flags and
+the node config file, retry until the TPU driver stack has created
+the accel device nodes, wire up metrics and the health checker, then
+serve the kubelet device-plugin API until stopped.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.chip import get_backend
+from container_engine_accelerators_tpu.plugin import config as cfg
+from container_engine_accelerators_tpu.plugin.health import (
+    TpuHealthChecker,
+)
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+from container_engine_accelerators_tpu.plugin.metrics import (
+    DEFAULT_INTERVAL_MS,
+    DEFAULT_PORT,
+    MetricServer,
+)
+from container_engine_accelerators_tpu.utils import get_logger
+
+log = get_logger("main")
+
+# Flag set mirrors nvidia_gpu.go:38-49.
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="GKE TPU device plugin")
+    p.add_argument("--device-dir", default=cfg.DEVICE_DIR,
+                   help="directory containing accel device nodes")
+    p.add_argument("--state-dir", default=cfg.STATE_DIR,
+                   help="directory with node-published chip state")
+    p.add_argument("--host-path", default="/home/kubernetes/bin/tpu",
+                   help="host path of the libtpu install dir")
+    p.add_argument("--container-path", default="/usr/local/tpu",
+                   help="container mount point for the libtpu dir")
+    p.add_argument("--config-file", default=cfg.CONFIG_PATH,
+                   help="JSON node config ({\"tpuPartitionSize\": \"2x2\"})")
+    p.add_argument("--plugin-directory", default=cfg.DEVICE_PLUGIN_DIR,
+                   help="kubelet device-plugin socket directory")
+    p.add_argument("--enable-container-monitoring", action="store_true",
+                   help="serve per-container Prometheus metrics")
+    p.add_argument("--metrics-port", type=int, default=DEFAULT_PORT)
+    p.add_argument("--metrics-path", default="/metrics")
+    p.add_argument("--metrics-collection-interval", type=int,
+                   default=DEFAULT_INTERVAL_MS, metavar="MS")
+    p.add_argument("--enable-health-monitoring", action="store_true",
+                   help="poll chip health and gate allocations")
+    p.add_argument("--health-poll-interval", type=float, default=5.0,
+                   metavar="SECONDS")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    tpu_config = cfg.parse_tpu_config(args.config_file)
+    log.info("TPU device plugin starting; partition=%r",
+             tpu_config.tpu_partition_size)
+
+    backend = get_backend()
+    mounts = [(args.container_path, args.host_path)] \
+        if os.path.isdir(args.host_path) else []
+    manager = TpuManager(dev_dir=args.device_dir, state_dir=args.state_dir,
+                         mount_paths=mounts, tpu_config=tpu_config,
+                         backend=backend)
+
+    # Retry until the driver stack has surfaced the chips
+    # (nvidia_gpu.go:88-98: 5s cadence).
+    while True:
+        if manager.check_device_paths():
+            try:
+                manager.start()
+                break
+            except Exception as e:
+                log.warning("manager start failed (%s); retrying in 5s", e)
+        else:
+            log.info("no accel devices in %s yet; retrying in 5s",
+                     args.device_dir)
+        time.sleep(5)
+
+    metrics = None
+    if args.enable_container_monitoring:
+        metrics = MetricServer(
+            manager, backend,
+            collection_interval_ms=args.metrics_collection_interval,
+            port=args.metrics_port, metrics_path=args.metrics_path)
+        metrics.start()
+
+    health = None
+    if args.enable_health_monitoring:
+        health = TpuHealthChecker(manager, backend,
+                                  poll_interval_s=args.health_poll_interval)
+        health.start()
+
+    def shutdown(signum, frame):
+        log.info("signal %d; shutting down", signum)
+        manager.stop()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    try:
+        manager.serve(args.plugin_directory, cfg.KUBELET_SOCKET, "tpu")
+    finally:
+        if health is not None:
+            health.stop()
+        if metrics is not None:
+            metrics.stop()
+    log.info("TPU device plugin stopped")
+
+
+if __name__ == "__main__":
+    main()

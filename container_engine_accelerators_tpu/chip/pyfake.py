@@ -10,6 +10,7 @@ Serves two roles:
 import collections
 import os
 import re
+import threading
 
 from .backend import (
     ChipBackend,
@@ -34,7 +35,12 @@ _HEALTH_TOKENS = {
 
 
 class PyChipBackend(ChipBackend):
+    """All public methods serialize on one lock, matching the native
+    library's global mutex (tpuinfo.cc g_mu) — the serve, health and
+    metrics threads share a single backend instance."""
+
     def __init__(self):
+        self._lock = threading.RLock()
         self._dev_dir = None
         self._state_dir = None
         self._chips = []          # sorted chip indices
@@ -51,7 +57,13 @@ class PyChipBackend(ChipBackend):
         return self.rescan()
 
     def shutdown(self):
-        self.__init__()
+        self._dev_dir = None
+        self._state_dir = None
+        self._chips = []
+        self._dims = (0, 0, 0)
+        self._coords = {}
+        self._at = {}
+        self._samples.clear()
 
     def rescan(self):
         self._require_init()
@@ -257,3 +269,19 @@ class PyChipBackend(ChipBackend):
                     f"shape {shape} does not uniformly tile topology {dims}")
             tiles.append(dims[a] // shape[a])
         return tuple(tiles)
+
+
+def _locked(method):
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return method(self, *args, **kwargs)
+    wrapper.__name__ = method.__name__
+    wrapper.__doc__ = method.__doc__
+    return wrapper
+
+
+for _name in ("init", "shutdown", "rescan", "chip_count", "topology",
+              "chip_coords", "chip_at", "chip_health", "chip_hbm",
+              "sample_duty", "duty_cycle", "subslice_count",
+              "subslice_chips"):
+    setattr(PyChipBackend, _name, _locked(getattr(PyChipBackend, _name)))

@@ -1,0 +1,77 @@
+"""v1alpha device-plugin service + registration client.
+
+Capability parity with pkg/gpu/nvidia/alpha_plugin.go: the legacy flat
+Allocate (no per-container nesting) for kubelets that negotiated
+v1alpha, served on the same socket as v1beta1
+(multi-version coexistence, manager.go:253-256).
+"""
+
+import grpc
+
+from ..utils import get_logger
+from .api import (
+    V1ALPHA_VERSION,
+    DevicePluginV1AlphaServicer,
+    RegistrationV1AlphaStub,
+    v1alpha_pb2,
+)
+
+log = get_logger("alpha_plugin")
+
+_STREAM_POLL_S = 5.0
+
+
+class PluginServiceV1Alpha(DevicePluginV1AlphaServicer):
+    def __init__(self, manager):
+        self._m = manager
+
+    def ListAndWatch(self, request, context):
+        log.info("device-plugin (v1alpha): ListAndWatch started")
+        last = None
+        while context.is_active() and not self._m._stop.is_set():
+            if last is None:
+                devices = self._m.list_devices()
+            else:
+                devices = self._m.wait_for_change(_STREAM_POLL_S)
+            if devices != last:
+                yield v1alpha_pb2.ListAndWatchResponse(devices=[
+                    v1alpha_pb2.Device(ID=dev_id, health=health)
+                    for dev_id, health in sorted(devices.items())
+                ])
+                last = devices
+
+    def Allocate(self, request, context):
+        """Flat allocation (alpha_plugin.go:51-85)."""
+        resp = v1alpha_pb2.AllocateResponse()
+        try:
+            for dev_id in request.devicesIDs:
+                for spec in self._m.device_specs(dev_id):
+                    resp.devices.append(v1alpha_pb2.DeviceSpec(
+                        container_path=spec.container_path,
+                        host_path=spec.host_path,
+                        permissions=spec.permissions))
+            for key, val in sorted(
+                    self._m.allocate_envs(list(request.devicesIDs)).items()):
+                resp.envs[key] = val
+        except (KeyError, ValueError) as e:
+            msg = e.args[0] if e.args else str(e)
+            log.warning("Allocate (v1alpha) failed: %s", msg)
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(msg))
+        for mount in self._m.mounts():
+            resp.mounts.append(v1alpha_pb2.Mount(
+                container_path=mount.container_path,
+                host_path=mount.host_path,
+                read_only=mount.read_only))
+        return resp
+
+
+def register_with_kubelet(kubelet_socket, endpoint, resource_name):
+    """Port of RegisterWithKubelet (alpha_plugin.go:92-113)."""
+    with grpc.insecure_channel(f"unix://{kubelet_socket}") as channel:
+        stub = RegistrationV1AlphaStub(channel)
+        stub.Register(
+            v1alpha_pb2.RegisterRequest(
+                version=V1ALPHA_VERSION,
+                endpoint=endpoint,
+                resource_name=resource_name),
+            timeout=5)

@@ -1,0 +1,111 @@
+"""v1beta1 device-plugin service + kubelet registration client.
+
+Capability parity with pkg/gpu/nvidia/beta_plugin.go: per-container
+Allocate batching, streaming ListAndWatch fed by the manager's change
+condition, and registration against the kubelet's Registration
+service. GetPreferredAllocation is a real topology-aware
+implementation (the reference stubs it, beta_plugin.go:95-98).
+"""
+
+import grpc
+
+from ..utils import get_logger
+from .api import (
+    V1BETA1_VERSION,
+    DevicePluginV1Beta1Servicer,
+    RegistrationV1Beta1Stub,
+    v1beta1_pb2,
+)
+
+log = get_logger("beta_plugin")
+
+_STREAM_POLL_S = 5.0
+
+
+class PluginServiceV1Beta1(DevicePluginV1Beta1Servicer):
+    def __init__(self, manager):
+        self._m = manager
+
+    def GetDevicePluginOptions(self, request, context):
+        return v1beta1_pb2.DevicePluginOptions(
+            pre_start_required=False,
+            get_preferred_allocation_available=True)
+
+    def ListAndWatch(self, request, context):
+        """Stream the full device list on every state change.
+
+        Mirrors beta_plugin.go:37-52: send once on connect, then
+        re-send whenever health or population changes.
+        """
+        log.info("device-plugin: ListAndWatch started")
+        last = None
+        while context.is_active() and not self._m._stop.is_set():
+            if last is None:
+                devices = self._m.list_devices()
+            else:
+                devices = self._m.wait_for_change(_STREAM_POLL_S)
+            if devices != last:
+                yield _list_response(devices)
+                last = devices
+
+    def Allocate(self, request, context):
+        """Per-container device handoff (beta_plugin.go:54-88).
+
+        Each container gets its chips' device nodes, the library
+        mounts, and the libtpu topology env contract for its chip set.
+        """
+        resp = v1beta1_pb2.AllocateResponse()
+        for creq in request.container_requests:
+            cresp = v1beta1_pb2.ContainerAllocateResponse()
+            try:
+                for dev_id in creq.devicesIDs:
+                    cresp.devices.extend(self._m.device_specs(dev_id))
+                for key, val in sorted(
+                        self._m.allocate_envs(list(creq.devicesIDs)).items()):
+                    cresp.envs[key] = val
+            except (KeyError, ValueError) as e:
+                msg = e.args[0] if e.args else str(e)
+                log.warning("Allocate failed: %s", msg)
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(msg))
+            cresp.mounts.extend(self._m.mounts())
+            resp.container_responses.append(cresp)
+        return resp
+
+    def GetPreferredAllocation(self, request, context):
+        resp = v1beta1_pb2.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            chosen = self._m.preferred_allocation(
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                creq.allocation_size)
+            resp.container_responses.append(
+                v1beta1_pb2.ContainerPreferredAllocationResponse(
+                    deviceIDs=chosen))
+        return resp
+
+    def PreStartContainer(self, request, context):
+        return v1beta1_pb2.PreStartContainerResponse()
+
+
+def _list_response(devices):
+    return v1beta1_pb2.ListAndWatchResponse(devices=[
+        v1beta1_pb2.Device(ID=dev_id, health=health)
+        for dev_id, health in sorted(devices.items())
+    ])
+
+
+def register_with_kubelet(kubelet_socket, endpoint, resource_name):
+    """Register the plugin's socket with the kubelet.
+
+    Port of RegisterWithV1Beta1Kubelet (beta_plugin.go:105-126).
+    """
+    with grpc.insecure_channel(f"unix://{kubelet_socket}") as channel:
+        stub = RegistrationV1Beta1Stub(channel)
+        stub.Register(
+            v1beta1_pb2.RegisterRequest(
+                version=V1BETA1_VERSION,
+                endpoint=endpoint,
+                resource_name=resource_name,
+                options=v1beta1_pb2.DevicePluginOptions(
+                    get_preferred_allocation_available=True)),
+            timeout=5)

@@ -1,0 +1,62 @@
+"""Plugin configuration.
+
+TPU counterpart of the reference's GPUConfig / gpu_config.json
+(cmd/nvidia_gpu/nvidia_gpu.go:51-63, pkg/gpu/nvidia/manager.go:53-55):
+one JSON file delivered by hostPath mount, soft-failing to defaults on
+parse errors, holding the node-level partitioning choice.
+"""
+
+import dataclasses
+import json
+import os
+
+from ..utils import get_logger
+
+log = get_logger("config")
+
+# Extended-resource name advertised to the kubelet (the reference uses
+# "nvidia.com/gpu", manager.go:49).
+RESOURCE_NAME = "google.com/tpu"
+
+# Default filesystem contract.
+DEVICE_DIR = "/dev"
+STATE_DIR = "/run/tpu"
+DEVICE_PLUGIN_DIR = "/device-plugin"
+KUBELET_SOCKET = "kubelet.sock"
+POD_RESOURCES_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
+CONFIG_PATH = "/etc/tpu/tpu_config.json"
+
+# Health strings are re-exported by api.grpc_bindings (HEALTHY/UNHEALTHY).
+
+
+@dataclasses.dataclass
+class TpuConfig:
+    """Node-level plugin configuration.
+
+    tpu_partition_size: subslice shape such as "2x2"; empty string
+    means whole chips are advertised individually (no partitioning) —
+    the analog of GPUPartitionSize.
+    """
+
+    tpu_partition_size: str = ""
+
+
+def parse_tpu_config(path=CONFIG_PATH):
+    """Load TpuConfig from JSON; missing/invalid file -> defaults.
+
+    Mirrors parseGPUConfig's soft-fail behavior
+    (cmd/nvidia_gpu/nvidia_gpu.go:51-63,77-81).
+    """
+    if not path or not os.path.exists(path):
+        return TpuConfig()
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("failed to parse %s (%s); using defaults", path, e)
+        return TpuConfig()
+    size = raw.get("tpuPartitionSize", "")
+    if not isinstance(size, str):
+        log.warning("tpuPartitionSize must be a string; using defaults")
+        return TpuConfig()
+    return TpuConfig(tpu_partition_size=size)

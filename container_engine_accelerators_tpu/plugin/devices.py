@@ -1,0 +1,50 @@
+"""Container ↔ device attribution via the kubelet PodResources API.
+
+Capability parity with pkg/gpu/nvidia/metrics/devices.go: dial the
+kubelet's pod-resources unix socket and build a map from
+(namespace, pod, container) to the google.com/tpu device IDs assigned
+to it, for metrics labeling.
+"""
+
+import grpc
+
+from ..utils import get_logger
+from . import config as cfg
+from .api import PodResourcesListerStub, podresources_pb2
+
+log = get_logger("devices")
+
+_TIMEOUT_S = 10
+
+
+class ContainerDevices:
+    def __init__(self, namespace, pod, container, device_ids):
+        self.namespace = namespace
+        self.pod = pod
+        self.container = container
+        self.device_ids = list(device_ids)
+
+
+def get_devices_for_all_containers(
+        socket_path=cfg.POD_RESOURCES_SOCKET,
+        resource_name=cfg.RESOURCE_NAME):
+    """List containers holding TPU devices (devices.go:50-96).
+
+    Returns a list of ContainerDevices; raises grpc.RpcError when the
+    kubelet socket is unreachable.
+    """
+    with grpc.insecure_channel(f"unix://{socket_path}") as channel:
+        stub = PodResourcesListerStub(channel)
+        resp = stub.List(
+            podresources_pb2.ListPodResourcesRequest(), timeout=_TIMEOUT_S)
+    out = []
+    for pod in resp.pod_resources:
+        for container in pod.containers:
+            ids = []
+            for dev in container.devices:
+                if dev.resource_name == resource_name:
+                    ids.extend(dev.device_ids)
+            if ids:
+                out.append(ContainerDevices(
+                    pod.namespace, pod.name, container.name, ids))
+    return out

@@ -1,0 +1,64 @@
+"""Topology env-var contract injected at Allocate time.
+
+The reference hands containers device nodes plus library mounts
+(beta_plugin.go:59-84); a TPU container additionally needs the libtpu
+process-topology contract so JAX/XLA can initialize collectives over
+ICI. This module composes those envs from the allocated chip set:
+
+    TPU_VISIBLE_DEVICES          comma-separated chip indices
+    TPU_CHIPS_PER_PROCESS_BOUNDS bounding box of the allocated chips,
+                                 "x,y,z" (only when the set is a full
+                                 contiguous box — else omitted so
+                                 libtpu falls back to flat enumeration)
+    TPU_PROCESS_BOUNDS           process grid, "1,1,1" for single-pod
+    CLOUD_TPU_TASK_ID / TPU_WORKER_ID
+                                 worker index within the job
+    TPU_WORKER_HOSTNAMES         comma-separated coordinator hostnames
+    TPU_SKIP_MDS_QUERY           "true" (no GCE metadata inside pods)
+
+Multi-host jobs override worker id/hostnames via the JobSet/Job
+downward API; the plugin's defaults describe the single-host case.
+This is the "distributed communication backend" surface of SURVEY.md
+section 2.4: the collective transport itself is XLA-over-ICI/DCN,
+outside the plugin, exactly as NCCL was outside the reference.
+"""
+
+
+def _bounding_box(coords):
+    xs = [c[0] for c in coords]
+    ys = [c[1] for c in coords]
+    zs = [c[2] for c in coords]
+    lo = (min(xs), min(ys), min(zs))
+    hi = (max(xs), max(ys), max(zs))
+    size = (hi[0] - lo[0] + 1, hi[1] - lo[1] + 1, hi[2] - lo[2] + 1)
+    return lo, size
+
+
+def chips_form_box(coords):
+    """True when the chip set exactly fills its bounding box."""
+    if not coords:
+        return False
+    lo, size = _bounding_box(coords)
+    if size[0] * size[1] * size[2] != len(set(coords)):
+        return False
+    return True
+
+
+def topology_envs(chips, coords, worker_id=0, worker_hostnames=("localhost",)):
+    """Compose the env map for an allocation.
+
+    chips:  sorted chip indices being handed to the container.
+    coords: parallel list of (x, y, z) torus coordinates.
+    """
+    envs = {
+        "TPU_VISIBLE_DEVICES": ",".join(str(c) for c in chips),
+        "TPU_PROCESS_BOUNDS": "1,1,1",
+        "TPU_WORKER_ID": str(worker_id),
+        "CLOUD_TPU_TASK_ID": str(worker_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(worker_hostnames),
+        "TPU_SKIP_MDS_QUERY": "true",
+    }
+    if chips_form_box(coords):
+        _, size = _bounding_box(coords)
+        envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{size[0]},{size[1]},{size[2]}"
+    return envs

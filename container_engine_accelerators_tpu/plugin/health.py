@@ -1,0 +1,112 @@
+"""Chip-health poller.
+
+Capability parity with the reference's GPUHealthChecker
+(pkg/gpu/nvidia/health_check/health_checker.go), redesigned for TPU:
+NVML delivers Xid events over a blocking event set
+(health_checker.go:163-211); libtpu has no event fd, so health is a
+*polling* loop over the chip backend (SURVEY.md section 7,
+"Health without events"). Semantics preserved:
+  - an unhealthy chip marks its schedulable device Unhealthy on the
+    manager, which re-gates Allocate and wakes ListAndWatch;
+  - a chip belonging to a subslice marks the whole subslice (as MIG
+    children map to their parent partition, health_checker.go:136-160);
+  - a backend-wide failure marks ALL devices unhealthy (the analog of
+    an empty-UUID event, health_checker.go:183-192).
+Departure: polling naturally observes recovery, so a chip that
+returns to OK is marked Healthy again (the reference's event model
+only ever degrades until re-serve).
+"""
+
+import threading
+
+from ..chip.backend import ChipBackendError, Health
+from ..utils import get_logger
+from .api import HEALTHY, UNHEALTHY
+from .slice import is_slice_device_id
+
+log = get_logger("health")
+
+DEFAULT_POLL_INTERVAL_S = 5.0
+
+# Health states that mark a device unschedulable. UNKNOWN is treated
+# as healthy-but-logged, mirroring the reference's decision to only
+# act on specific Xids it considers application-independent
+# (health_checker.go:172-181: only Xid 48 and empty-UUID events).
+_FATAL = {Health.UNCORRECTABLE_ECC, Health.ICI_LINK_DOWN,
+          Health.OVERHEAT, Health.WEDGED}
+
+
+class TpuHealthChecker:
+    """Polls chip health and pushes transitions to the manager."""
+
+    def __init__(self, manager, backend, poll_interval_s=None):
+        self._m = manager
+        self._backend = backend
+        self._interval = poll_interval_s or DEFAULT_POLL_INTERVAL_S
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-health-checker", daemon=True)
+        self._thread.start()
+        log.info("health checker started (interval %.1fs)", self._interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 2)
+            self._thread = None
+
+    def poll_once(self):
+        """One health sweep; exposed for tests and the fault demo."""
+        devices = self._m.list_devices()
+        try:
+            verdicts = {}
+            for dev_id in devices:
+                try:
+                    chips = self._m.device_chips(dev_id)
+                except KeyError:
+                    # Device vanished mid-poll (re-partition/hot-unplug
+                    # race with the serve loop); skip this sweep.
+                    continue
+                bad = None
+                for chip in chips:
+                    state = self._backend.chip_health(chip)
+                    if state in _FATAL:
+                        bad = (chip, state)
+                        break
+                    if state == Health.UNKNOWN:
+                        log.warning("chip %d reports unknown health "
+                                    "state; not marking unhealthy", chip)
+                verdicts[dev_id] = bad
+        except ChipBackendError as e:
+            # Backend-wide failure: every device becomes unschedulable
+            # (empty-UUID analog, health_checker.go:183-192).
+            log.error("chip backend failure during health poll: %s; "
+                      "marking ALL devices unhealthy", e)
+            for dev_id in devices:
+                self._m.set_device_health(dev_id, UNHEALTHY)
+            return
+
+        for dev_id, bad in verdicts.items():
+            current = devices[dev_id]
+            if bad is not None and current != UNHEALTHY:
+                chip, state = bad
+                kind = "subslice" if is_slice_device_id(dev_id) else "chip"
+                log.warning("marking %s %s unhealthy: chip %d reports %s",
+                            kind, dev_id, chip, state.name)
+                self._m.set_device_health(dev_id, UNHEALTHY)
+            elif bad is None and current != HEALTHY:
+                log.info("device %s recovered; marking healthy", dev_id)
+                self._m.set_device_health(dev_id, HEALTHY)
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.poll_once()
+            except Exception:
+                # The poller must outlive any single bad sweep: a dead
+                # health thread would silently re-admit unhealthy chips.
+                log.exception("health poll failed; will retry")

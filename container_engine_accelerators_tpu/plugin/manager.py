@@ -1,0 +1,353 @@
+"""TpuManager — the core device-manager runtime.
+
+Capability parity with the reference's nvidiaGPUManager
+(pkg/gpu/nvidia/manager.go): chip discovery, a guarded device map,
+DeviceSpec construction with a health gate, hot-plug re-discovery,
+and the serve/re-serve loop with a kubelet-socket liveness watch.
+TPU-specific departures:
+  - discovery and topology come from the chip backend (libtpuinfo)
+    rather than a /dev regex + /proc walk;
+  - there are no nvidiactl/nvidia-uvm default nodes — instead each
+    Allocate composes the libtpu topology env contract (envs.py);
+  - MIG partitions become ICI subslices (slice.py).
+"""
+
+import os
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from ..chip import get_backend
+from ..utils import accel_index, get_logger, is_accel_name
+from . import config as cfg
+from .api import (
+    HEALTHY,
+    add_device_plugin_v1alpha,
+    add_device_plugin_v1beta1,
+    v1beta1_pb2,
+)
+from .envs import topology_envs
+from .slice import SliceManager, is_slice_device_id
+
+log = get_logger("manager")
+
+# Cadences mirror the reference (manager.go:44, 291-317).
+CHIP_CHECK_INTERVAL_S = 10.0
+SOCKET_CHECK_INTERVAL_S = 1.0
+
+
+class TpuManager:
+    """Owns chip state and serves the device-plugin gRPC surface."""
+
+    def __init__(self, dev_dir=cfg.DEVICE_DIR, state_dir=cfg.STATE_DIR,
+                 mount_paths=None, tpu_config=None, backend=None):
+        self._dev_dir = dev_dir
+        self._state_dir = state_dir
+        self._mount_paths = list(mount_paths or [])
+        self._config = tpu_config or cfg.TpuConfig()
+        self._backend = backend or get_backend()
+        self._devices = {}          # device id -> health string
+        self._lock = threading.Lock()
+        self._changed = threading.Condition(self._lock)
+        self._slice_mgr = SliceManager(self._backend)
+        self._grpc_server = None
+        self._stop = threading.Event()
+        self._serving = threading.Event()
+
+    # -- discovery ----------------------------------------------------
+
+    def check_device_paths(self):
+        """True when at least one accel chip node exists.
+
+        Driver-readiness probe, analog of CheckDevicePaths statting
+        /dev/nvidiactl (manager.go:192-201): the entry binary retry-
+        loops on this until the libtpu stack has created the nodes.
+        """
+        try:
+            return any(is_accel_name(n) for n in os.listdir(self._dev_dir))
+        except OSError:
+            return False
+
+    def start(self):
+        """Discover chips (and subslices when configured).
+
+        Mirrors Start() (manager.go:204-225): enumerate devices, then
+        start the partition manager if a partition size is configured.
+        """
+        n = self._backend.init(self._dev_dir, self._state_dir)
+        if self._config.tpu_partition_size:
+            self._slice_mgr.start(self._config.tpu_partition_size)
+        self._refresh_devices()
+        log.info("started with %d chips, partition=%r", n,
+                 self._config.tpu_partition_size)
+
+    def _refresh_devices(self):
+        """Rebuild the device map from backend state, keeping health."""
+        partitioned = bool(self._config.tpu_partition_size)
+        with self._changed:
+            old = self._devices
+            if partitioned:
+                fresh = self._slice_mgr.list_devices()
+            else:
+                fresh = {f"accel{i}": HEALTHY for i in self._chip_indices()}
+            self._devices = {
+                dev_id: old.get(dev_id, health)
+                for dev_id, health in fresh.items()
+            }
+            self._changed.notify_all()
+
+    def _chip_indices(self):
+        """Sorted chip indices currently enumerated by the backend."""
+        count = self._backend.chip_count()
+        indices = sorted(accel_index(n) for n in os.listdir(self._dev_dir)
+                         if is_accel_name(n))
+        return indices[:count] if count >= 0 else []
+
+    def has_new_devices(self):
+        """Re-scan for hot-plugged/removed chips.
+
+        Analog of hasAdditionalGPUsInstalled (manager.go:143-157).
+        Returns True when the chip population changed.
+        """
+        before = set(self.list_devices())
+        self._backend.rescan()
+        if self._config.tpu_partition_size:
+            try:
+                self._slice_mgr.start(self._config.tpu_partition_size)
+            except Exception as e:  # non-uniform after hot-plug
+                log.warning("re-partition after rescan failed: %s", e)
+        after_ids = (set(self._slice_mgr.list_devices())
+                     if self._config.tpu_partition_size
+                     else {f"accel{i}" for i in self._chip_indices()})
+
+        return after_ids != before
+
+    # -- device map ---------------------------------------------------
+
+    def list_devices(self):
+        with self._lock:
+            return dict(self._devices)
+
+    def set_device_health(self, device_id, health):
+        """Mark a device healthy/unhealthy and wake ListAndWatch.
+
+        Routes subslice ids to the slice manager, as the reference
+        routes MIG partition names (manager.go:178-188).
+        """
+        with self._changed:
+            if device_id not in self._devices:
+                log.warning("health update for unknown device %s", device_id)
+                return
+            self._devices[device_id] = health
+            if is_slice_device_id(device_id):
+                self._slice_mgr.set_device_health(device_id, health)
+            self._changed.notify_all()
+
+    def wait_for_change(self, timeout):
+        """Block until the device map changes (or timeout). Returns a
+        snapshot of the current map."""
+        with self._changed:
+            self._changed.wait(timeout)
+            return dict(self._devices)
+
+    # -- allocation ---------------------------------------------------
+
+    def device_chips(self, device_id):
+        """Chip indices backing a schedulable device id."""
+        if is_slice_device_id(device_id):
+            chips = self._slice_mgr.slice_chips(device_id)
+            if chips is None:
+                raise KeyError(device_id)
+            return chips
+        if is_accel_name(device_id):
+            return [accel_index(device_id)]
+        raise KeyError(device_id)
+
+    def device_specs(self, device_id):
+        """DeviceSpec protos for one schedulable device, health-gated.
+
+        Mirrors DeviceSpec (manager.go:104-122): unknown device or
+        unhealthy device is an allocation error; the kubelet re-gates
+        via ListAndWatch but Allocate must also refuse.
+        """
+        with self._lock:
+            health = self._devices.get(device_id)
+        if health is None:
+            raise KeyError(f"invalid allocation request: unknown device "
+                           f"{device_id}")
+        if health != HEALTHY:
+            raise ValueError(f"invalid allocation request: unhealthy device "
+                             f"{device_id}")
+        specs = []
+        for chip in self.device_chips(device_id):
+            path = os.path.join(self._dev_dir, f"accel{chip}")
+            specs.append(v1beta1_pb2.DeviceSpec(
+                container_path=path, host_path=path, permissions="mrw"))
+        return specs
+
+    def allocate_envs(self, device_ids):
+        """Topology env contract for the union of the requested devices."""
+        chips = sorted({c for d in device_ids for c in self.device_chips(d)})
+        coords = [self._backend.chip_coords(c) for c in chips]
+        return topology_envs(chips, coords)
+
+    def mounts(self):
+        return [
+            v1beta1_pb2.Mount(container_path=c, host_path=h, read_only=True)
+            for c, h in self._mount_paths
+        ]
+
+    def preferred_allocation(self, available, must_include, size):
+        """Topology-compact preferred set.
+
+        Real implementation of the RPC the reference stubs out
+        (beta_plugin.go:95-98): prefer a chip set forming a contiguous
+        box on the ICI torus (minimal-hop collectives), falling back
+        to first-N when no box fits the availability.
+        """
+        if size <= 0 or size > len(available):
+            return list(available)[:max(size, 0)]
+        if self._config.tpu_partition_size:
+            # Subslices are already topology-compact units.
+            chosen = [d for d in must_include]
+            for d in available:
+                if len(chosen) >= size:
+                    break
+                if d not in chosen:
+                    chosen.append(d)
+            return chosen[:size]
+        avail_chips = {self.device_chips(d)[0]: d for d in available}
+        must_chips = {self.device_chips(d)[0] for d in must_include}
+        dims = self._backend.topology()
+        coord_of = {c: self._backend.chip_coords(c) for c in avail_chips}
+        best = None
+        for bx in range(1, dims[0] + 1):
+            for by in range(1, dims[1] + 1):
+                for bz in range(1, dims[2] + 1):
+                    if bx * by * bz != size:
+                        continue
+                    for ox in range(dims[0] - bx + 1):
+                        for oy in range(dims[1] - by + 1):
+                            for oz in range(dims[2] - bz + 1):
+                                box = set()
+                                for c, xyz in coord_of.items():
+                                    if (ox <= xyz[0] < ox + bx and
+                                            oy <= xyz[1] < oy + by and
+                                            oz <= xyz[2] < oz + bz):
+                                        box.add(c)
+                                if len(box) == size and must_chips <= box:
+                                    # Prefer the most cube-like box.
+                                    score = max(bx, by, bz) - min(bx, by, bz)
+                                    if best is None or score < best[0]:
+                                        best = (score, box)
+        if best is not None:
+            return sorted(avail_chips[c] for c in best[1])
+        chosen = [avail_chips[c] for c in sorted(must_chips)]
+        for c in sorted(avail_chips):
+            d = avail_chips[c]
+            if len(chosen) >= size:
+                break
+            if d not in chosen:
+                chosen.append(d)
+        return chosen[:size]
+
+    # -- serve loop ---------------------------------------------------
+
+    def serve(self, plugin_dir, kubelet_socket_name, endpoint_basename):
+        """Serve the plugin socket and keep it registered.
+
+        Structural port of Serve (manager.go:227-322): bind a fresh
+        timestamped socket, register both API versions, register with
+        the kubelet, then watch (a) our socket path — kubelet restarts
+        wipe the device-plugin dir, requiring a re-serve — and (b) the
+        chip population for hot-plugs.
+        """
+        from .beta_plugin import PluginServiceV1Beta1, register_with_kubelet
+        from .alpha_plugin import PluginServiceV1Alpha
+
+        self._stop.clear()
+        while not self._stop.is_set():
+            endpoint = f"{endpoint_basename}-{int(time.time()*1000)}.sock"
+            socket_path = os.path.join(plugin_dir, endpoint)
+            kubelet_socket = os.path.join(plugin_dir, kubelet_socket_name)
+
+            server = grpc.server(
+                futures.ThreadPoolExecutor(max_workers=8),
+                options=[("grpc.so_reuseport", 0)])
+            add_device_plugin_v1beta1(PluginServiceV1Beta1(self), server)
+            add_device_plugin_v1alpha(PluginServiceV1Alpha(self), server)
+            server.add_insecure_port(f"unix://{socket_path}")
+            server.start()
+            self._grpc_server = server
+            self._serving.set()
+            log.info("serving on %s", socket_path)
+
+            self._register_with_retry(kubelet_socket, endpoint,
+                                      register_with_kubelet)
+
+            restart = False
+            last_chip_check = time.monotonic()
+            while not self._stop.is_set():
+                time.sleep(SOCKET_CHECK_INTERVAL_S)
+                try:
+                    os.lstat(socket_path)
+                except OSError:
+                    log.warning("plugin socket %s vanished (kubelet "
+                                "restart?); re-serving", socket_path)
+                    restart = True
+                    break
+                now = time.monotonic()
+                if now - last_chip_check >= CHIP_CHECK_INTERVAL_S:
+                    last_chip_check = now
+                    if self.has_new_devices():
+                        log.info("chip population changed; re-serving")
+                        self._refresh_devices()
+                        restart = True
+                        break
+
+            self._serving.clear()
+            server.stop(grace=1).wait()
+            self._grpc_server = None
+            try:
+                os.unlink(socket_path)
+            except OSError:
+                pass
+            if not restart:
+                break
+
+    def _register_with_retry(self, kubelet_socket, endpoint, register_fn):
+        """Register with the kubelet, retrying in the background.
+
+        The reference treats registration failure as fatal so the
+        DaemonSet restart retries (its Serve path exits the process);
+        in-process retry achieves the same liveness without losing the
+        already-bound plugin socket: keep attempting every 5s until
+        success, stop, or re-serve.
+        """
+        def attempt_loop():
+            while not self._stop.is_set() and self._serving.is_set():
+                try:
+                    register_fn(kubelet_socket, endpoint, cfg.RESOURCE_NAME)
+                    log.info("registered with kubelet for %s",
+                             cfg.RESOURCE_NAME)
+                    return
+                except grpc.RpcError as e:
+                    code = e.code() if hasattr(e, "code") else e
+                    log.warning("kubelet registration failed (%s); "
+                                "retrying in 5s", code)
+                    if self._stop.wait(5):
+                        return
+
+        threading.Thread(target=attempt_loop, name="tpu-kubelet-register",
+                         daemon=True).start()
+
+    def wait_until_serving(self, timeout=5.0):
+        return self._serving.wait(timeout)
+
+    def stop(self):
+        """Stop serving (manager.go:324-332)."""
+        self._stop.set()
+        with self._changed:
+            self._changed.notify_all()

@@ -1,0 +1,164 @@
+"""Prometheus metrics server with per-container TPU attribution.
+
+Capability parity with pkg/gpu/nvidia/metrics/metrics.go: gauges for
+duty cycle, memory total/used and per-container request counts,
+labeled by (namespace, pod, container, accelerator id), collected on a
+periodic ticker with a slower label-reset cycle to drop stale label
+sets (metrics.go:29-61,109-167). The NVML sampling C shim
+(metrics/util.go:37-72) maps onto libtpuinfo's duty-cycle ring
+(tpuinfo_sample_duty/tpuinfo_duty_cycle).
+
+The GKE HPA story carries over unchanged: the serving demo autoscales
+on the duty_cycle metric exactly as the reference's TF-Serving HPA
+does (demo/serving/tensorflow-serving.yaml:62-80).
+"""
+
+import threading
+import wsgiref.simple_server
+
+import grpc
+import prometheus_client
+from prometheus_client.core import CollectorRegistry
+
+from ..utils import get_logger
+from . import config as cfg
+from .devices import get_devices_for_all_containers
+
+log = get_logger("metrics")
+
+DEFAULT_PORT = 2112
+DEFAULT_INTERVAL_MS = 30000
+RESET_INTERVAL_MS = 60000
+# Duty-cycle averaging window floor; the effective window stretches to
+# 2.5x the collection interval so two consecutive passes always fall
+# inside it (one cumulative-counter sample is recorded per pass — with
+# a fixed 10s window and the default 30s interval the delta would
+# never be computable).
+_DUTY_WINDOW_FLOOR_US = 10_000_000
+
+
+class MetricServer:
+    """Serves /metrics and periodically collects chip stats."""
+
+    def __init__(self, manager, backend, collection_interval_ms=None,
+                 port=DEFAULT_PORT, metrics_path="/metrics",
+                 pod_resources_socket=cfg.POD_RESOURCES_SOCKET):
+        self._m = manager
+        self._backend = backend
+        self._interval_s = (collection_interval_ms
+                            or DEFAULT_INTERVAL_MS) / 1000.0
+        self._duty_window_us = max(_DUTY_WINDOW_FLOOR_US,
+                                   int(2.5 * self._interval_s * 1e6))
+        self._port = port
+        self._path = metrics_path
+        self._pod_resources_socket = pod_resources_socket
+        self._registry = CollectorRegistry()
+        labels = ["namespace", "pod", "container", "tpu_device"]
+        self._duty_cycle = prometheus_client.Gauge(
+            "duty_cycle", "TPU tensorcore duty cycle percent",
+            labels, registry=self._registry)
+        self._memory_total = prometheus_client.Gauge(
+            "memory_total", "Total HBM bytes on the TPU chip",
+            labels, registry=self._registry)
+        self._memory_used = prometheus_client.Gauge(
+            "memory_used", "Allocated HBM bytes on the TPU chip",
+            labels, registry=self._registry)
+        self._request = prometheus_client.Gauge(
+            "request_count", "Number of TPU devices requested",
+            ["namespace", "pod", "container"], registry=self._registry)
+        self._httpd = None
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- HTTP ---------------------------------------------------------
+
+    def start(self):
+        app = prometheus_client.make_wsgi_app(self._registry)
+        path = self._path
+
+        def routed(environ, start_response):
+            if environ.get("PATH_INFO") != path:
+                start_response("404 Not Found",
+                               [("Content-Type", "text/plain")])
+                return [b"not found; metrics at " + path.encode()]
+            return app(environ, start_response)
+
+        self._httpd = wsgiref.simple_server.make_server(
+            "", self._port, routed,
+            handler_class=_QuietHandler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="tpu-metrics-http", daemon=True).start()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-metrics-collect", daemon=True)
+        self._thread.start()
+        log.info("metrics server on :%d%s every %.0fs",
+                 self._port, self._path, self._interval_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval_s + 2)
+            self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    # -- collection ---------------------------------------------------
+
+    def collect_once(self):
+        """One collection pass (metrics.go:126-156); test seam."""
+        try:
+            containers = get_devices_for_all_containers(
+                self._pod_resources_socket)
+        except grpc.RpcError as e:
+            log.warning("pod-resources query failed: %s", e.code())
+            return
+        for cd in containers:
+            self._request.labels(cd.namespace, cd.pod, cd.container).set(
+                len(cd.device_ids))
+            for dev_id in cd.device_ids:
+                try:
+                    chips = self._m.device_chips(dev_id)
+                except KeyError:
+                    log.warning("pod-resources reports unknown device %s",
+                                dev_id)
+                    continue
+                for chip in chips:
+                    self._sample_chip(cd, f"accel{chip}", chip)
+
+    def _sample_chip(self, cd, device_label, chip):
+        base = (cd.namespace, cd.pod, cd.container, device_label)
+        self._backend.sample_duty(chip)
+        duty = self._backend.duty_cycle(chip, self._duty_window_us)
+        if duty is not None:
+            self._duty_cycle.labels(*base).set(duty)
+        hbm = self._backend.chip_hbm(chip)
+        if hbm is not None:
+            self._memory_total.labels(*base).set(hbm[0])
+            self._memory_used.labels(*base).set(hbm[1])
+
+    def _reset(self):
+        """Drop stale label sets (metrics.go:63,158-167)."""
+        self._duty_cycle.clear()
+        self._memory_total.clear()
+        self._memory_used.clear()
+        self._request.clear()
+
+    def _run(self):
+        since_reset = 0.0
+        while not self._stop.wait(self._interval_s):
+            since_reset += self._interval_s
+            if since_reset >= RESET_INTERVAL_MS / 1000.0:
+                self._reset()
+                since_reset = 0.0
+            self.collect_once()
+
+
+class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
+    def log_message(self, *args):
+        pass

@@ -1,0 +1,90 @@
+"""ICI-topology-aware TPU subslice device manager.
+
+Capability parity with the reference's MIG DeviceManager
+(pkg/gpu/nvidia/mig/mig.go), redesigned for TPU: instead of walking
+/proc capability files for per-GPU fractions, a subslice is a
+topology-contiguous *group of chips* (e.g. a 2x2 tile of a v5e-8's
+2x4 torus) computed by the chip backend's tiling solver. The uniform
+partitioning invariant (every chip in exactly one subslice,
+mig.go:190-201) is enforced by the solver; slices are advertised as
+single schedulable devices exactly as MIG partitions are.
+"""
+
+import threading
+
+from ..chip.backend import parse_shape
+from .api import HEALTHY
+from ..utils import get_logger
+
+log = get_logger("slice")
+
+
+def slice_device_id(shape, index):
+    """Schedulable device ID for a subslice, e.g. "tpu-2x2-0"."""
+    return f"tpu-{shape}-{index}"
+
+
+def is_slice_device_id(device_id):
+    return device_id.startswith("tpu-") and device_id.count("-") >= 2
+
+
+class SliceManager:
+    """Tracks subslice devices and their chip membership."""
+
+    def __init__(self, backend):
+        self._backend = backend
+        self._shape = ""
+        self._slices = {}   # device id -> [chip indices]
+        self._health = {}   # device id -> health string
+        self._lock = threading.Lock()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def start(self, partition_size):
+        """Discover subslices for the configured shape.
+
+        Raises BadShapeError/NonUniformPartitionError from the backend
+        when the shape is malformed or does not tile the topology —
+        the same hard failure the reference raises when partition
+        counts don't match the expected table (mig.go:190-201).
+        """
+        parse_shape(partition_size)  # surface BadShapeError early
+        count = self._backend.subslice_count(partition_size)
+        with self._lock:
+            self._shape = partition_size
+            self._slices = {}
+            self._health = {}
+            for i in range(count):
+                dev_id = slice_device_id(partition_size, i)
+                self._slices[dev_id] = self._backend.subslice_chips(
+                    partition_size, i)
+                self._health[dev_id] = HEALTHY
+        log.info("discovered %d %s subslices", count, partition_size)
+        return count
+
+    def list_devices(self):
+        with self._lock:
+            return dict(self._health)
+
+    def slice_chips(self, device_id):
+        """Chip indices backing a subslice device, or None."""
+        with self._lock:
+            chips = self._slices.get(device_id)
+            return list(chips) if chips is not None else None
+
+    def owning_slice(self, chip):
+        """Device ID of the subslice containing a chip, or None."""
+        with self._lock:
+            for dev_id, chips in self._slices.items():
+                if chip in chips:
+                    return dev_id
+        return None
+
+    def set_device_health(self, device_id, health):
+        with self._lock:
+            if device_id not in self._health:
+                return False
+            self._health[device_id] = health
+            return True

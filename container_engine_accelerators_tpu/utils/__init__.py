@@ -1,6 +1,7 @@
 """Shared helpers: structured logging, path utilities."""
 
-from .paths import device_name_from_path
+from .paths import accel_index, device_name_from_path, is_accel_name
 from .log import get_logger
 
-__all__ = ["device_name_from_path", "get_logger"]
+__all__ = ["accel_index", "device_name_from_path", "is_accel_name",
+           "get_logger"]

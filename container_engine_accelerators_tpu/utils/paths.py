@@ -20,3 +20,15 @@ def device_name_from_path(path):
     if not _DEVICE_RE.match(name):
         raise ValueError(f"not a TPU accel device path: {path!r}")
     return name
+
+
+def is_accel_name(name):
+    """True for accel device-node basenames like "accel0"."""
+    return _DEVICE_RE.match(name) is not None
+
+
+def accel_index(name):
+    """Chip index from an accel node name; raises ValueError otherwise."""
+    if not _DEVICE_RE.match(name):
+        raise ValueError(f"not a TPU accel device name: {name!r}")
+    return int(name[5:])

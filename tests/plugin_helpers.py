@@ -1,0 +1,79 @@
+"""Shared helpers for plugin tests.
+
+Reimplements the reference's test topology in Python: a KubeletStub
+Registration service on a unix socket (alpha_plugin_test.go:35-69)
+and a real gRPC loopback against the plugin's served socket
+(beta_plugin_test.go:75-147).
+"""
+
+import os
+import tempfile
+import threading
+from concurrent import futures
+
+import grpc
+
+from container_engine_accelerators_tpu.plugin import api
+
+
+def short_tmpdir():
+    """Unix socket paths must stay under ~108 chars; pytest tmp_path
+    can exceed that, so sockets live in a short mkdtemp."""
+    return tempfile.mkdtemp(prefix="tpu")
+
+
+class KubeletStub(api.RegistrationServicer):
+    """Fake kubelet Registration endpoint recording register calls."""
+
+    def __init__(self, socket_path):
+        self.socket_path = socket_path
+        self.requests = []
+        self.event = threading.Event()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        api.add_registration_v1beta1(self, self._server)
+        api.add_registration_v1alpha(self, self._server)
+        self._server.add_insecure_port(f"unix://{socket_path}")
+
+    def Register(self, request, context):
+        self.requests.append(request)
+        self.event.set()
+        # Same Empty message shape in both packages; pick by version.
+        if request.version == api.V1BETA1_VERSION:
+            return api.v1beta1_pb2.Empty()
+        return api.v1alpha_pb2.Empty()
+
+    def start(self):
+        self._server.start()
+
+    def stop(self):
+        self._server.stop(grace=0)
+
+
+class ServingManager:
+    """Runs manager.serve() in a thread and exposes client channels."""
+
+    def __init__(self, manager, plugin_dir, kubelet_socket="kubelet.sock"):
+        self.manager = manager
+        self.plugin_dir = plugin_dir
+        self.kubelet_socket = kubelet_socket
+        self._thread = threading.Thread(
+            target=manager.serve,
+            args=(plugin_dir, kubelet_socket, "tpu"), daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        assert self.manager.wait_until_serving(10)
+        return self
+
+    def __exit__(self, *exc):
+        self.manager.stop()
+        self._thread.join(timeout=10)
+
+    def socket_path(self):
+        socks = [f for f in os.listdir(self.plugin_dir)
+                 if f.startswith("tpu-") and f.endswith(".sock")]
+        assert len(socks) == 1, socks
+        return os.path.join(self.plugin_dir, socks[0])
+
+    def channel(self):
+        return grpc.insecure_channel(f"unix://{self.socket_path()}")

@@ -1,0 +1,74 @@
+"""v1alpha adapter tests (mirrors alpha_plugin_test.go)."""
+
+import os
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu.chip import PyChipBackend
+from container_engine_accelerators_tpu.plugin import api
+from container_engine_accelerators_tpu.plugin import manager as manager_mod
+from container_engine_accelerators_tpu.plugin.alpha_plugin import (
+    register_with_kubelet,
+)
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+from tests.plugin_helpers import KubeletStub, ServingManager, short_tmpdir
+
+
+@pytest.fixture
+def fast_intervals(monkeypatch):
+    monkeypatch.setattr(manager_mod, "SOCKET_CHECK_INTERVAL_S", 0.1)
+    monkeypatch.setattr(manager_mod, "CHIP_CHECK_INTERVAL_S", 0.5)
+
+
+def make_manager(node):
+    for i in range(4):
+        node.add_chip(i)
+    node.set_topology("2x2")
+    m = TpuManager(dev_dir=node.dev_dir, state_dir=node.state_dir,
+                   backend=PyChipBackend(),
+                   mount_paths=[("/usr/local/tpu", "/tmp/host-tpu")])
+    m.start()
+    return m
+
+
+def test_register_v1alpha(fake_node):
+    plugin_dir = short_tmpdir()
+    sock = os.path.join(plugin_dir, "kubelet.sock")
+    stub = KubeletStub(sock)
+    stub.start()
+    try:
+        register_with_kubelet(sock, "tpu-123.sock", "google.com/tpu")
+        assert stub.requests[0].version == api.V1ALPHA_VERSION
+        assert stub.requests[0].endpoint == "tpu-123.sock"
+    finally:
+        stub.stop()
+
+
+def test_alpha_list_and_watch_and_allocate(fake_node, fast_intervals):
+    plugin_dir = short_tmpdir()
+    with ServingManager(make_manager(fake_node), plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1AlphaStub(ch)
+            first = next(iter(stub.ListAndWatch(api.v1alpha_pb2.Empty())))
+            assert len(first.devices) == 4
+
+            resp = stub.Allocate(api.v1alpha_pb2.AllocateRequest(
+                devicesIDs=["accel0", "accel1", "accel2", "accel3"]))
+            assert len(resp.devices) == 4
+            assert resp.envs["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+            assert resp.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+            assert len(resp.mounts) == 1
+            assert resp.mounts[0].container_path == "/usr/local/tpu"
+            assert resp.mounts[0].read_only
+
+
+def test_alpha_allocate_unknown_fails(fake_node, fast_intervals):
+    plugin_dir = short_tmpdir()
+    with ServingManager(make_manager(fake_node), plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1AlphaStub(ch)
+            with pytest.raises(grpc.RpcError) as err:
+                stub.Allocate(
+                    api.v1alpha_pb2.AllocateRequest(devicesIDs=["accel7"]))
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT

@@ -1,0 +1,208 @@
+"""v1beta1 plugin service tests over real gRPC loopback.
+
+Mirrors beta_plugin_test.go: serve against a fake /dev, dial the
+plugin socket as a DevicePluginClient, drive ListAndWatch and
+Allocate, check hot-plug and negative paths.
+"""
+
+import os
+import time
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu.chip import PyChipBackend
+from container_engine_accelerators_tpu.plugin import api
+from container_engine_accelerators_tpu.plugin import manager as manager_mod
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+from tests.plugin_helpers import KubeletStub, ServingManager, short_tmpdir
+
+
+@pytest.fixture
+def fast_intervals(monkeypatch):
+    monkeypatch.setattr(manager_mod, "SOCKET_CHECK_INTERVAL_S", 0.1)
+    monkeypatch.setattr(manager_mod, "CHIP_CHECK_INTERVAL_S", 0.5)
+
+
+@pytest.fixture
+def node4(fake_node):
+    for i in range(4):
+        fake_node.add_chip(i)
+    fake_node.set_topology("2x2")
+    return fake_node
+
+
+def make_manager(node, **kwargs):
+    m = TpuManager(dev_dir=node.dev_dir, state_dir=node.state_dir,
+                   backend=PyChipBackend(), **kwargs)
+    m.start()
+    return m
+
+
+def test_register_with_kubelet(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    stub = KubeletStub(os.path.join(plugin_dir, "kubelet.sock"))
+    stub.start()
+    try:
+        with ServingManager(make_manager(node4), plugin_dir):
+            assert stub.event.wait(5)
+            req = stub.requests[0]
+            assert req.version == api.V1BETA1_VERSION
+            assert req.resource_name == "google.com/tpu"
+            assert req.endpoint.startswith("tpu-")
+            assert req.options.get_preferred_allocation_available
+    finally:
+        stub.stop()
+
+
+def test_list_and_watch_and_allocate(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    with ServingManager(make_manager(node4), plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            stream = stub.ListAndWatch(api.v1beta1_pb2.Empty())
+            first = next(iter(stream))
+            assert [d.ID for d in first.devices] == [
+                "accel0", "accel1", "accel2", "accel3"]
+            assert all(d.health == api.HEALTHY for d in first.devices)
+
+            resp = stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel0", "accel1"])]))
+            assert len(resp.container_responses) == 1
+            cresp = resp.container_responses[0]
+            paths = [d.host_path for d in cresp.devices]
+            assert paths == [os.path.join(node4.dev_dir, "accel0"),
+                             os.path.join(node4.dev_dir, "accel1")]
+            assert all(d.permissions == "mrw" for d in cresp.devices)
+            assert cresp.envs["TPU_VISIBLE_DEVICES"] == "0,1"
+            assert cresp.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,2,1"
+            assert cresp.envs["TPU_PROCESS_BOUNDS"] == "1,1,1"
+            assert cresp.envs["TPU_WORKER_ID"] == "0"
+
+
+def test_allocate_multi_container(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    with ServingManager(make_manager(node4), plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            resp = stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel0", "accel2"]),
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel1"]),
+                ]))
+            assert len(resp.container_responses) == 2
+            assert resp.container_responses[0].envs[
+                "TPU_VISIBLE_DEVICES"] == "0,2"
+            assert resp.container_responses[1].envs[
+                "TPU_VISIBLE_DEVICES"] == "1"
+
+
+def test_allocate_unknown_device_fails(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    with ServingManager(make_manager(node4), plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            with pytest.raises(grpc.RpcError) as err:
+                stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                    container_requests=[
+                        api.v1beta1_pb2.ContainerAllocateRequest(
+                            devicesIDs=["accel9"])]))
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "accel9" in err.value.details()
+
+
+def test_allocate_unhealthy_device_fails(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    mgr = make_manager(node4)
+    mgr.set_device_health("accel2", api.UNHEALTHY)
+    with ServingManager(mgr, plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            with pytest.raises(grpc.RpcError) as err:
+                stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                    container_requests=[
+                        api.v1beta1_pb2.ContainerAllocateRequest(
+                            devicesIDs=["accel2"])]))
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "unhealthy" in err.value.details()
+
+
+def test_health_change_streams_update(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    mgr = make_manager(node4)
+    with ServingManager(mgr, plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            stream = iter(stub.ListAndWatch(api.v1beta1_pb2.Empty()))
+            first = next(stream)
+            assert all(d.health == api.HEALTHY for d in first.devices)
+            mgr.set_device_health("accel1", api.UNHEALTHY)
+            second = next(stream)
+            by_id = {d.ID: d.health for d in second.devices}
+            assert by_id["accel1"] == api.UNHEALTHY
+            assert by_id["accel0"] == api.HEALTHY
+
+
+def test_hotplug_discovered_while_serving(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    mgr = make_manager(node4)
+    with ServingManager(mgr, plugin_dir):
+        node4.add_chip(4)
+        node4.add_chip(5)
+        node4.set_topology("2x3")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if "accel5" in mgr.list_devices():
+                break
+            time.sleep(0.1)
+        assert "accel5" in mgr.list_devices()
+        # The serve loop re-serves on a fresh socket; the new device
+        # must be allocatable there (beta_plugin_test.go:132-147).
+        assert mgr.wait_until_serving(10)
+        specs = mgr.device_specs("accel5")
+        assert specs[0].host_path == os.path.join(node4.dev_dir, "accel5")
+
+
+def test_get_preferred_allocation_topology_compact(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    with ServingManager(make_manager(node4), plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            resp = stub.GetPreferredAllocation(
+                api.v1beta1_pb2.PreferredAllocationRequest(
+                    container_requests=[
+                        api.v1beta1_pb2.ContainerPreferredAllocationRequest(
+                            available_deviceIDs=[
+                                "accel0", "accel1", "accel2", "accel3"],
+                            allocation_size=2)]))
+            chosen = list(resp.container_responses[0].deviceIDs)
+            # On a 2x2 torus any 2 chips sharing an axis form a 1x2
+            # box; chips 0,1 share x in row-major layout.
+            assert chosen == ["accel0", "accel1"]
+
+
+def test_kubelet_restart_triggers_reserve(node4, fast_intervals):
+    plugin_dir = short_tmpdir()
+    mgr = make_manager(node4)
+    with ServingManager(mgr, plugin_dir) as sm:
+        first_sock = sm.socket_path()
+        # Simulate kubelet restart wiping the device-plugin dir.
+        os.unlink(first_sock)
+        deadline = time.time() + 10
+        second_sock = None
+        while time.time() < deadline:
+            socks = [f for f in os.listdir(plugin_dir)
+                     if f.startswith("tpu-") and f.endswith(".sock")]
+            if socks and os.path.join(plugin_dir, socks[0]) != first_sock:
+                second_sock = os.path.join(plugin_dir, socks[0])
+                break
+            time.sleep(0.1)
+        assert second_sock is not None, "plugin did not re-serve"
+        with grpc.insecure_channel(f"unix://{second_sock}") as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            opts = stub.GetDevicePluginOptions(api.v1beta1_pb2.Empty())
+            assert opts.get_preferred_allocation_available

@@ -1,0 +1,99 @@
+"""Health-propagation tests through the poll loop.
+
+The reference leaves health_checker.go untested because it needs NVML
+(SURVEY.md section 4); the chip-backend seam makes the full path
+unit-testable here: state file -> poller -> manager -> ListAndWatch.
+"""
+
+import pytest
+
+from container_engine_accelerators_tpu.chip import PyChipBackend
+from container_engine_accelerators_tpu.chip.backend import ChipBackendError
+from container_engine_accelerators_tpu.plugin import api
+from container_engine_accelerators_tpu.plugin.config import TpuConfig
+from container_engine_accelerators_tpu.plugin.health import TpuHealthChecker
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+
+
+@pytest.fixture
+def node4(fake_node):
+    for i in range(4):
+        fake_node.add_chip(i)
+    fake_node.set_topology("2x2")
+    return fake_node
+
+
+def make(node, **kwargs):
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=node.dev_dir, state_dir=node.state_dir,
+                     backend=backend, **kwargs)
+    mgr.start()
+    return mgr, backend, TpuHealthChecker(mgr, backend)
+
+
+def test_ecc_error_marks_device_unhealthy(node4):
+    mgr, _, hc = make(node4)
+    node4.set_state(1, "health", "uncorrectable_ecc")
+    hc.poll_once()
+    devices = mgr.list_devices()
+    assert devices["accel1"] == api.UNHEALTHY
+    assert devices["accel0"] == api.HEALTHY
+
+
+def test_recovery_marks_healthy_again(node4):
+    mgr, _, hc = make(node4)
+    node4.set_state(1, "health", "wedged")
+    hc.poll_once()
+    assert mgr.list_devices()["accel1"] == api.UNHEALTHY
+    node4.set_state(1, "health", "ok")
+    hc.poll_once()
+    assert mgr.list_devices()["accel1"] == api.HEALTHY
+
+
+def test_unknown_state_does_not_degrade(node4):
+    mgr, _, hc = make(node4)
+    node4.set_state(2, "health", "some-future-token")
+    hc.poll_once()
+    assert mgr.list_devices()["accel2"] == api.HEALTHY
+
+
+def test_backend_failure_marks_all_unhealthy(node4):
+    mgr, backend, hc = make(node4)
+
+    def boom(chip):
+        raise ChipBackendError("backend gone")
+
+    backend.chip_health = boom
+    hc.poll_once()
+    assert set(mgr.list_devices().values()) == {api.UNHEALTHY}
+
+
+def test_bad_chip_marks_owning_subslice(node4):
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=node4.dev_dir, state_dir=node4.state_dir,
+                     tpu_config=TpuConfig(tpu_partition_size="1x2"),
+                     backend=backend)
+    mgr.start()
+    hc = TpuHealthChecker(mgr, backend)
+    node4.set_state(3, "health", "ici_link_down")
+    hc.poll_once()
+    devices = mgr.list_devices()
+    # Chip 3 lives in the second 1x2 subslice of the 2x2 torus.
+    bad = [d for d, h in devices.items() if h == api.UNHEALTHY]
+    assert len(bad) == 1
+    assert 3 in mgr.device_chips(bad[0])
+
+
+def test_start_stop_thread(node4):
+    mgr, _, hc = make(node4)
+    hc._interval = 0.05
+    hc.start()
+    node4.set_state(0, "health", "overheat")
+    import time
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if mgr.list_devices()["accel0"] == api.UNHEALTHY:
+            break
+        time.sleep(0.05)
+    hc.stop()
+    assert mgr.list_devices()["accel0"] == api.UNHEALTHY

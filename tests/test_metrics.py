@@ -1,0 +1,164 @@
+"""Metrics-server tests: pod-resources stub -> gauges -> HTTP scrape.
+
+The reference's metrics package is untested (needs NVML + kubelet,
+SURVEY.md section 4); here both seams are faked: a PodResourcesLister
+stub on a unix socket and the chip backend's state files.
+"""
+
+import os
+import urllib.request
+from concurrent import futures
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu.chip import PyChipBackend
+from container_engine_accelerators_tpu.plugin import api
+from container_engine_accelerators_tpu.plugin.api.grpc_bindings import (
+    PodResourcesListerServicer,
+    add_pod_resources_lister,
+)
+from container_engine_accelerators_tpu.plugin.devices import (
+    get_devices_for_all_containers,
+)
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+from container_engine_accelerators_tpu.plugin.metrics import MetricServer
+from tests.plugin_helpers import short_tmpdir
+
+
+class PodResourcesStub(PodResourcesListerServicer):
+    """Fake kubelet pod-resources endpoint."""
+
+    def __init__(self, socket_path, payload):
+        self._payload = payload
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        add_pod_resources_lister(self, self._server)
+        self._server.add_insecure_port(f"unix://{socket_path}")
+
+    def List(self, request, context):
+        return self._payload
+
+    def start(self):
+        self._server.start()
+
+    def stop(self):
+        self._server.stop(grace=0)
+
+
+def payload_two_pods():
+    return api.podresources_pb2.ListPodResourcesResponse(pod_resources=[
+        api.podresources_pb2.PodResources(
+            name="train-0", namespace="default", containers=[
+                api.podresources_pb2.ContainerResources(
+                    name="jax", devices=[
+                        api.podresources_pb2.ContainerDevices(
+                            resource_name="google.com/tpu",
+                            device_ids=["accel0", "accel1"])])]),
+        api.podresources_pb2.PodResources(
+            name="other", namespace="default", containers=[
+                api.podresources_pb2.ContainerResources(
+                    name="app", devices=[
+                        api.podresources_pb2.ContainerDevices(
+                            resource_name="nvidia.com/gpu",
+                            device_ids=["nvidia0"])])]),
+    ])
+
+
+@pytest.fixture
+def node2(fake_node):
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("1x2")
+    return fake_node
+
+
+def test_pod_resources_client_filters_resource(node2):
+    sock = os.path.join(short_tmpdir(), "podres.sock")
+    stub = PodResourcesStub(sock, payload_two_pods())
+    stub.start()
+    try:
+        out = get_devices_for_all_containers(sock)
+        assert len(out) == 1
+        assert out[0].pod == "train-0"
+        assert out[0].device_ids == ["accel0", "accel1"]
+    finally:
+        stub.stop()
+
+
+def test_collect_and_scrape(node2):
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=node2.dev_dir, state_dir=node2.state_dir,
+                     backend=backend)
+    mgr.start()
+    node2.set_state(0, "hbm", "17179869184 4096")
+    node2.set_state(1, "hbm", "17179869184 8192")
+    node2.set_state(0, "duty_cycle", "0 0")
+    node2.set_state(1, "duty_cycle", "0 0")
+
+    sock = os.path.join(short_tmpdir(), "podres.sock")
+    stub = PodResourcesStub(sock, payload_two_pods())
+    stub.start()
+    server = MetricServer(mgr, backend, port=0,
+                          pod_resources_socket=sock)
+    server.start()
+    try:
+        server.collect_once()
+        # Advance the duty counters 60% busy and collect again so the
+        # windowed average has two samples.
+        node2.set_state(0, "duty_cycle", "600000 1000000")
+        node2.set_state(1, "duty_cycle", "300000 1000000")
+        server.collect_once()
+        body = urllib.request.urlopen(
+            f"http://localhost:{server.port}/metrics").read().decode()
+        assert ('duty_cycle{container="jax",namespace="default",'
+                'pod="train-0",tpu_device="accel0"} 60.0') in body
+        assert ('memory_used{container="jax",namespace="default",'
+                'pod="train-0",tpu_device="accel1"} 8192.0') in body
+        assert ('request_count{container="jax",namespace="default",'
+                'pod="train-0"} 2.0') in body
+        assert "nvidia0" not in body
+        # Wrong path 404s (the reference serves only metricsPath).
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://localhost:{server.port}/other")
+    finally:
+        server.stop()
+        stub.stop()
+
+
+def test_reset_drops_stale_labels(node2):
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=node2.dev_dir, state_dir=node2.state_dir,
+                     backend=backend)
+    mgr.start()
+    sock = os.path.join(short_tmpdir(), "podres.sock")
+    stub = PodResourcesStub(sock, payload_two_pods())
+    stub.start()
+    server = MetricServer(mgr, backend, port=0, pod_resources_socket=sock)
+    server.start()
+    try:
+        server.collect_once()
+        body = urllib.request.urlopen(
+            f"http://localhost:{server.port}/metrics").read().decode()
+        assert 'pod="train-0"' in body
+        server._reset()
+        body = urllib.request.urlopen(
+            f"http://localhost:{server.port}/metrics").read().decode()
+        assert 'pod="train-0"' not in body
+    finally:
+        server.stop()
+        stub.stop()
+
+
+def test_unreachable_pod_resources_is_soft(node2):
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=node2.dev_dir, state_dir=node2.state_dir,
+                     backend=backend)
+    mgr.start()
+    server = MetricServer(mgr, backend, port=0,
+                          pod_resources_socket="/nonexistent/sock")
+    server.start()
+    try:
+        server.collect_once()  # must not raise
+    finally:
+        server.stop()

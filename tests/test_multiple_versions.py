@@ -1,0 +1,45 @@
+"""Both API versions served on one socket (multiple_versions_test.go)."""
+
+import pytest
+
+from container_engine_accelerators_tpu.chip import PyChipBackend
+from container_engine_accelerators_tpu.plugin import api
+from container_engine_accelerators_tpu.plugin import manager as manager_mod
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+from tests.plugin_helpers import ServingManager, short_tmpdir
+
+
+@pytest.fixture
+def fast_intervals(monkeypatch):
+    monkeypatch.setattr(manager_mod, "SOCKET_CHECK_INTERVAL_S", 0.1)
+    monkeypatch.setattr(manager_mod, "CHIP_CHECK_INTERVAL_S", 5.0)
+
+
+def test_same_socket_serves_both_versions(fake_node, fast_intervals):
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("1x2")
+    mgr = TpuManager(dev_dir=fake_node.dev_dir, state_dir=fake_node.state_dir,
+                     backend=PyChipBackend())
+    mgr.start()
+    plugin_dir = short_tmpdir()
+    with ServingManager(mgr, plugin_dir) as sm:
+        with sm.channel() as ch:
+            beta = api.DevicePluginV1Beta1Stub(ch)
+            alpha = api.DevicePluginV1AlphaStub(ch)
+
+            beta_list = next(iter(beta.ListAndWatch(api.v1beta1_pb2.Empty())))
+            alpha_list = next(iter(
+                alpha.ListAndWatch(api.v1alpha_pb2.Empty())))
+            assert ([d.ID for d in beta_list.devices]
+                    == [d.ID for d in alpha_list.devices]
+                    == ["accel0", "accel1"])
+
+            beta_resp = beta.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel0"])]))
+            alpha_resp = alpha.Allocate(
+                api.v1alpha_pb2.AllocateRequest(devicesIDs=["accel0"]))
+            assert (beta_resp.container_responses[0].devices[0].host_path
+                    == alpha_resp.devices[0].host_path)

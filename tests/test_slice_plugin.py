@@ -1,0 +1,109 @@
+"""Subslice (MIG-analog) plugin behavior.
+
+Mirrors mig/mig_test.go's partition discovery/DeviceSpec assertions,
+recast for topology subslices: partitioned managers advertise slice
+devices, Allocate hands out all member chips plus subslice-shaped
+topology env.
+"""
+
+import os
+
+import pytest
+
+from container_engine_accelerators_tpu.chip import (
+    NonUniformPartitionError,
+    PyChipBackend,
+)
+from container_engine_accelerators_tpu.plugin import api
+from container_engine_accelerators_tpu.plugin import manager as manager_mod
+from container_engine_accelerators_tpu.plugin.config import TpuConfig
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+from container_engine_accelerators_tpu.plugin.slice import (
+    SliceManager,
+    is_slice_device_id,
+    slice_device_id,
+)
+from tests.plugin_helpers import ServingManager, short_tmpdir
+
+
+@pytest.fixture
+def fast_intervals(monkeypatch):
+    monkeypatch.setattr(manager_mod, "SOCKET_CHECK_INTERVAL_S", 0.1)
+    monkeypatch.setattr(manager_mod, "CHIP_CHECK_INTERVAL_S", 5.0)
+
+
+@pytest.fixture
+def node8(fake_node):
+    for i in range(8):
+        fake_node.add_chip(i)
+    fake_node.set_topology("2x4")
+    return fake_node
+
+
+def make_partitioned_manager(node, size="2x2"):
+    m = TpuManager(dev_dir=node.dev_dir, state_dir=node.state_dir,
+                   tpu_config=TpuConfig(tpu_partition_size=size),
+                   backend=PyChipBackend())
+    m.start()
+    return m
+
+
+def test_slice_manager_discovery(node8):
+    backend = PyChipBackend()
+    backend.init(node8.dev_dir, node8.state_dir)
+    sm = SliceManager(backend)
+    assert sm.start("2x2") == 2
+    assert sorted(sm.list_devices()) == ["tpu-2x2-0", "tpu-2x2-1"]
+    assert sm.slice_chips("tpu-2x2-0") == [0, 1, 4, 5]
+    assert sm.slice_chips("tpu-2x2-1") == [2, 3, 6, 7]
+    assert sm.owning_slice(6) == "tpu-2x2-1"
+    assert sm.slice_chips("tpu-2x2-9") is None
+
+
+def test_nonuniform_partition_rejected(node8):
+    backend = PyChipBackend()
+    backend.init(node8.dev_dir, node8.state_dir)
+    sm = SliceManager(backend)
+    with pytest.raises(NonUniformPartitionError):
+        sm.start("2x3")
+
+
+def test_partitioned_manager_advertises_slices(node8, fast_intervals):
+    mgr = make_partitioned_manager(node8)
+    devices = mgr.list_devices()
+    assert sorted(devices) == ["tpu-2x2-0", "tpu-2x2-1"]
+    assert all(h == api.HEALTHY for h in devices.values())
+
+
+def test_partitioned_allocate_returns_all_chip_nodes(node8, fast_intervals):
+    plugin_dir = short_tmpdir()
+    with ServingManager(make_partitioned_manager(node8), plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            resp = stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["tpu-2x2-1"])]))
+            cresp = resp.container_responses[0]
+            assert [d.host_path for d in cresp.devices] == [
+                os.path.join(node8.dev_dir, f"accel{i}")
+                for i in (2, 3, 6, 7)]
+            assert cresp.envs["TPU_VISIBLE_DEVICES"] == "2,3,6,7"
+            # A 2x2 tile is a contiguous box on the torus.
+            assert cresp.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+
+
+def test_slice_health_routing(node8, fast_intervals):
+    mgr = make_partitioned_manager(node8)
+    mgr.set_device_health("tpu-2x2-0", api.UNHEALTHY)
+    assert mgr.list_devices()["tpu-2x2-0"] == api.UNHEALTHY
+    with pytest.raises(ValueError):
+        mgr.device_specs("tpu-2x2-0")
+    # Mirror of manager.go:178-188: the slice manager saw the update.
+    assert mgr._slice_mgr.list_devices()["tpu-2x2-0"] == api.UNHEALTHY
+
+
+def test_slice_id_helpers():
+    assert slice_device_id("2x2", 1) == "tpu-2x2-1"
+    assert is_slice_device_id("tpu-2x2-1")
+    assert not is_slice_device_id("accel0")

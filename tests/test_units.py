@@ -1,0 +1,90 @@
+"""Table-driven unit tests for pure helpers.
+
+Mirrors the reference's table tests (partition_gpu_test.go:19-63,
+util/util_test.go:23-32).
+"""
+
+import json
+
+import pytest
+
+from container_engine_accelerators_tpu.plugin.config import (
+    TpuConfig,
+    parse_tpu_config,
+)
+from container_engine_accelerators_tpu.plugin.envs import (
+    chips_form_box,
+    topology_envs,
+)
+from container_engine_accelerators_tpu.utils import device_name_from_path
+
+
+@pytest.mark.parametrize("path,name", [
+    ("/dev/accel0", "accel0"),
+    ("/dev/accel12", "accel12"),
+    ("accel3", "accel3"),
+])
+def test_device_name_from_path(path, name):
+    assert device_name_from_path(path) == name
+
+
+@pytest.mark.parametrize("path", [
+    "/dev/nvidia0", "/dev/accel", "/dev/accelx", "/dev/", "/dev/accel-1",
+])
+def test_device_name_from_path_rejects(path):
+    with pytest.raises(ValueError):
+        device_name_from_path(path)
+
+
+def test_parse_config_missing_file(tmp_path):
+    assert parse_tpu_config(str(tmp_path / "nope.json")) == TpuConfig()
+
+
+def test_parse_config_valid(tmp_path):
+    p = tmp_path / "tpu_config.json"
+    p.write_text(json.dumps({"tpuPartitionSize": "2x2"}))
+    assert parse_tpu_config(str(p)).tpu_partition_size == "2x2"
+
+
+def test_parse_config_invalid_json_soft_fails(tmp_path):
+    p = tmp_path / "tpu_config.json"
+    p.write_text("{not json")
+    assert parse_tpu_config(str(p)) == TpuConfig()
+
+
+def test_parse_config_wrong_type_soft_fails(tmp_path):
+    p = tmp_path / "tpu_config.json"
+    p.write_text(json.dumps({"tpuPartitionSize": 4}))
+    assert parse_tpu_config(str(p)) == TpuConfig()
+
+
+def test_chips_form_box():
+    assert chips_form_box([(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)])
+    assert chips_form_box([(0, 0, 0)])
+    assert not chips_form_box([])
+    # L-shape: 3 chips of a 2x2 box.
+    assert not chips_form_box([(0, 0, 0), (0, 1, 0), (1, 0, 0)])
+    # Diagonal: bounding box 2x2 but only 2 chips.
+    assert not chips_form_box([(0, 0, 0), (1, 1, 0)])
+
+
+def test_topology_envs_box():
+    envs = topology_envs([0, 1], [(0, 0, 0), (0, 1, 0)])
+    assert envs["TPU_VISIBLE_DEVICES"] == "0,1"
+    assert envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,2,1"
+    assert envs["TPU_PROCESS_BOUNDS"] == "1,1,1"
+    assert envs["TPU_SKIP_MDS_QUERY"] == "true"
+    assert envs["CLOUD_TPU_TASK_ID"] == "0"
+
+
+def test_topology_envs_non_box_omits_bounds():
+    envs = topology_envs([0, 3], [(0, 0, 0), (1, 1, 0)])
+    assert envs["TPU_VISIBLE_DEVICES"] == "0,3"
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS" not in envs
+
+
+def test_topology_envs_worker_override():
+    envs = topology_envs([0], [(0, 0, 0)], worker_id=3,
+                         worker_hostnames=("w0", "w1", "w2", "w3"))
+    assert envs["TPU_WORKER_ID"] == "3"
+    assert envs["TPU_WORKER_HOSTNAMES"] == "w0,w1,w2,w3"
