@@ -1,0 +1,44 @@
+# Build/test/package entry points.
+# Parity with the reference's Makefile targets (test, presubmit,
+# container, push) plus the native library builds.
+
+REGISTRY ?= gcr.io/gke-release
+PLUGIN_IMAGE ?= $(REGISTRY)/tpu-device-plugin
+INSTALLER_IMAGE ?= $(REGISTRY)/libtpu-installer
+VERSION ?= v0.1.0
+
+all: native
+
+native:
+	$(MAKE) -C native/tpuinfo
+	$(MAKE) -C demo/tpu-error
+
+test: native
+	$(MAKE) -C native/tpuinfo test
+	python3 -m pytest tests/ -q
+
+test-native:
+	$(MAKE) -C native/tpuinfo test
+
+presubmit: native
+	./build/check_python.sh
+	./build/check_boilerplate.sh
+	python3 -m pytest tests/ -q
+
+bench:
+	python3 bench.py
+
+container:
+	docker build -t $(PLUGIN_IMAGE):$(VERSION) .
+	docker build -t $(INSTALLER_IMAGE):$(VERSION) \
+		deploy/libtpu-installer/ubuntu
+
+push: container
+	docker push $(PLUGIN_IMAGE):$(VERSION)
+	docker push $(INSTALLER_IMAGE):$(VERSION)
+
+clean:
+	$(MAKE) -C native/tpuinfo clean
+	$(MAKE) -C demo/tpu-error clean
+
+.PHONY: all native test test-native presubmit bench container push clean

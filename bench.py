@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""Headline benchmark: ResNet-50 training throughput per TPU chip.
+
+Runs the flagship demo workload (ResNet-50 v1.5, fake ImageNet,
+bfloat16, fused Pallas loss) through the SPMD trainer on every locally
+visible TPU chip and prints ONE JSON line:
+
+  {"metric": ..., "value": N, "unit": "images/sec/chip",
+   "vs_baseline": N}
+
+Baseline: the reference repo publishes no numbers (BASELINE.md —
+"published": {}); BASELINE.json sets the target at >= 80% of the Cloud
+TPU reference ResNet-50 images/sec/chip on v5e. The Cloud TPU
+reference rate is taken as 2,500 images/sec/chip for v5e (documented
+assumption pending a published figure), so vs_baseline is
+value / (0.8 * 2500).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_IMG_PER_SEC_PER_CHIP = 2500.0
+TARGET_FRACTION = 0.8
+
+BATCH_PER_CHIP = int(os.environ.get("BENCH_BATCH_PER_CHIP", "128"))
+WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", "5"))
+TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", "20"))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from container_engine_accelerators_tpu.models import resnet
+    from container_engine_accelerators_tpu.models.resnet import make_apply_fn
+    from container_engine_accelerators_tpu.ops import mean_cross_entropy_loss
+    from container_engine_accelerators_tpu.parallel import (
+        Trainer,
+        batch_sharding,
+        build_mesh,
+    )
+    from container_engine_accelerators_tpu.parallel.data import (
+        SyntheticLoader,
+    )
+    from container_engine_accelerators_tpu.parallel.mesh import default_spec
+
+    devices = jax.devices()
+    n = len(devices)
+    mesh = build_mesh(default_spec(n))
+    global_batch = BATCH_PER_CHIP * n
+
+    model = resnet(depth=50, num_classes=1000)
+    trainer = Trainer(make_apply_fn(model), mean_cross_entropy_loss,
+                      optax.sgd(0.1, momentum=0.9), mesh=mesh)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3)), train=False)
+    state = trainer.init_state(variables)
+    loader = SyntheticLoader(global_batch, (224, 224, 3), 1000,
+                             sharding=batch_sharding(mesh), pool=2)
+
+    for _, batch in zip(range(max(WARMUP_STEPS, 1)), loader):
+        state, loss = trainer.train_step(state, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _, batch in zip(range(TIMED_STEPS), loader):
+        state, loss = trainer.train_step(state, batch)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    images_per_sec = global_batch * TIMED_STEPS / elapsed
+    per_chip = images_per_sec / n
+    target = REFERENCE_IMG_PER_SEC_PER_CHIP * TARGET_FRACTION
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / target, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""partition_tpu — node-bootstrap TPU subslice partitioner.
+
+Capability parity with partition_gpu/partition_gpu.go, redesigned for
+TPU. The GPU flow is: read gpu_config.json, flip MIG mode (rebooting
+the node if needed), destroy and recreate GI/CI partitions through
+nvidia-smi. TPU subslices are not a driver mode — they are a pure
+scheduling construct over the ICI topology — so the TPU flow is:
+
+  1. read tpu_config.json (absent -> no-op exit, like
+     partition_gpu.go:58-71);
+  2. validate the requested shape against the node's chip population
+     and topology via libtpuinfo (the uniformity invariant replaces
+     the profile-ID table, partition_gpu.go:34-48);
+  3. publish the validated partition plan to <state-dir>/partitions.json
+     for operators/debugging, and verify the device plugin would
+     derive the identical slices;
+  4. print a per-slice plan (the `nvidia-smi` sanity print analog,
+     partition_gpu.go:112-117).
+
+No node reboot is ever needed (the MIG-mode reboot at
+partition_gpu.go:89-95 has no TPU analog). Exit codes: 0 ok / no-op,
+1 invalid config or topology mismatch.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.chip import (
+    BadShapeError,
+    NonUniformPartitionError,
+    get_backend,
+)
+from container_engine_accelerators_tpu.plugin import config as cfg
+from container_engine_accelerators_tpu.plugin.slice import slice_device_id
+from container_engine_accelerators_tpu.utils import get_logger
+
+log = get_logger("partition_tpu")
+
+
+def build_partition_plan(backend, shape):
+    """Slice id -> chip list for the shape; raises on invalid shapes.
+
+    Counterpart of buildPartitionStr (partition_gpu.go:204-220): the
+    pure, table-testable core of the partitioner.
+    """
+    count = backend.subslice_count(shape)
+    return {
+        slice_device_id(shape, i): backend.subslice_chips(shape, i)
+        for i in range(count)
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="TPU subslice partitioner")
+    p.add_argument("--config-file", default=cfg.CONFIG_PATH)
+    p.add_argument("--device-dir", default=cfg.DEVICE_DIR)
+    p.add_argument("--state-dir", default=cfg.STATE_DIR)
+    p.add_argument("--clean", action="store_true",
+                   help="remove a previously published partition plan "
+                        "(cleanupAllGPUPartitions analog)")
+    args = p.parse_args(argv)
+
+    plan_path = os.path.join(args.state_dir, "partitions.json")
+
+    if args.clean:
+        try:
+            os.unlink(plan_path)
+            log.info("removed partition plan %s", plan_path)
+        except FileNotFoundError:
+            pass
+        return 0
+
+    if not os.path.exists(args.config_file):
+        log.info("no %s; nothing to do", args.config_file)
+        return 0
+
+    tpu_config = cfg.parse_tpu_config(args.config_file)
+    if not tpu_config.tpu_partition_size:
+        log.info("no tpuPartitionSize configured; nothing to do")
+        return 0
+    shape = tpu_config.tpu_partition_size
+
+    backend = get_backend()
+    n = backend.init(args.device_dir, args.state_dir)
+    if n == 0:
+        log.error("no TPU chips found in %s", args.device_dir)
+        return 1
+    dims = backend.topology()
+
+    try:
+        plan = build_partition_plan(backend, shape)
+    except BadShapeError:
+        log.error("malformed tpuPartitionSize %r (want e.g. \"2x2\")", shape)
+        return 1
+    except NonUniformPartitionError:
+        log.error("shape %s does not uniformly tile the %dx%dx%d topology",
+                  shape, *dims)
+        return 1
+
+    os.makedirs(args.state_dir, exist_ok=True)
+    with open(plan_path, "w") as f:
+        json.dump({"shape": shape,
+                   "topology": f"{dims[0]}x{dims[1]}x{dims[2]}",
+                   "slices": plan}, f, indent=2, sort_keys=True)
+
+    log.info("partitioned %d chips (%dx%dx%d) into %d %s subslices:",
+             n, dims[0], dims[1], dims[2], len(plan), shape)
+    for dev_id in sorted(plan):
+        log.info("  %s -> chips %s", dev_id,
+                 ",".join(str(c) for c in plan[dev_id]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""Flax model zoo for the demo workloads.
+
+Covers the model families the reference's demos exercise
+(SURVEY.md section 2.3): ResNet-{18,34,50,101,152} for the training
+sweep (demo/gpu-training/generate_job.sh depths {34,50,101,152} and
+demo/tpu-training/resnet-tpu.yaml), Inception-v3
+(demo/tpu-training/inception-v3-tpu.yaml), and an MNIST MLP for the
+single-chip smoke workload.
+"""
+
+from .resnet import ResNet, resnet
+from .inception import InceptionV3
+from .mlp import MnistMLP
+
+__all__ = ["ResNet", "resnet", "InceptionV3", "MnistMLP"]

@@ -1,0 +1,173 @@
+"""Inception-v3 in Flax, bfloat16-first.
+
+Workload parity with demo/tpu-training/inception-v3-tpu.yaml in the
+reference. Standard v3 topology (stem, 3xA, B, 4xC, D, 2xE, 8x8 pool);
+the training-only auxiliary head is omitted — the demo measures
+throughput, and the aux branch only matters for very long convergence
+runs.
+"""
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Sequence[int]
+    strides: Sequence[int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not self.train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(64, (1, 1))(x)
+        b2 = self.conv(48, (1, 1))(x)
+        b2 = self.conv(64, (5, 5))(b2)
+        b3 = self.conv(64, (1, 1))(x)
+        b3 = self.conv(96, (3, 3))(b3)
+        b3 = self.conv(96, (3, 3))(b3)
+        b4 = self.conv(self.pool_features, (1, 1))(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(384, (3, 3), (2, 2), padding="VALID")(x)
+        b2 = self.conv(64, (1, 1))(x)
+        b2 = self.conv(96, (3, 3))(b2)
+        b2 = self.conv(96, (3, 3), (2, 2), padding="VALID")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.channels_7x7
+        b1 = self.conv(192, (1, 1))(x)
+        b2 = self.conv(c7, (1, 1))(x)
+        b2 = self.conv(c7, (1, 7))(b2)
+        b2 = self.conv(192, (7, 1))(b2)
+        b3 = self.conv(c7, (1, 1))(x)
+        b3 = self.conv(c7, (7, 1))(b3)
+        b3 = self.conv(c7, (1, 7))(b3)
+        b3 = self.conv(c7, (7, 1))(b3)
+        b3 = self.conv(192, (1, 7))(b3)
+        b4 = self.conv(192, (1, 1))(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(192, (1, 1))(x)
+        b1 = self.conv(320, (3, 3), (2, 2), padding="VALID")(b1)
+        b2 = self.conv(192, (1, 1))(x)
+        b2 = self.conv(192, (1, 7))(b2)
+        b2 = self.conv(192, (7, 1))(b2)
+        b2 = self.conv(192, (3, 3), (2, 2), padding="VALID")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(320, (1, 1))(x)
+        b2 = self.conv(384, (1, 1))(x)
+        b2 = jnp.concatenate([self.conv(384, (1, 3))(b2),
+                              self.conv(384, (3, 1))(b2)], axis=-1)
+        b3 = self.conv(448, (1, 1))(x)
+        b3 = self.conv(384, (3, 3))(b3)
+        b3 = jnp.concatenate([self.conv(384, (1, 3))(b3),
+                              self.conv(384, (3, 1))(b3)], axis=-1)
+        b4 = self.conv(192, (1, 1))(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception-v3 for 299x299 inputs (also accepts other sizes)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        conv = functools.partial(ConvBN, dtype=self.dtype, train=train)
+        x = x.astype(self.dtype)
+        x = conv(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = conv(32, (3, 3), padding="VALID")(x)
+        x = conv(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80, (1, 1), padding="VALID")(x)
+        x = conv(192, (3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = InceptionA(32, conv=conv)(x)
+        x = InceptionA(64, conv=conv)(x)
+        x = InceptionA(64, conv=conv)(x)
+        x = InceptionB(conv=conv)(x)
+        x = InceptionC(128, conv=conv)(x)
+        x = InceptionC(160, conv=conv)(x)
+        x = InceptionC(160, conv=conv)(x)
+        x = InceptionC(192, conv=conv)(x)
+        x = InceptionD(conv=conv)(x)
+        x = InceptionE(conv=conv)(x)
+        x = InceptionE(conv=conv)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+def make_apply_fn(model):
+    """Trainer apply contract with step-keyed dropout: the Trainer
+    passes the current step and the dropout rng folds it in, so each
+    step samples a fresh mask."""
+
+    def apply_fn(variables, images, train, step=0):
+        if train:
+            rng = jax.random.fold_in(jax.random.PRNGKey(0), step)
+            logits, mutated = model.apply(
+                variables, images, train=True, mutable=["batch_stats"],
+                rngs={"dropout": rng})
+            return logits, mutated["batch_stats"]
+        return model.apply(variables, images, train=False), \
+            variables.get("batch_stats", {})
+
+    return apply_fn

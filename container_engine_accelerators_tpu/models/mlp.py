@@ -1,0 +1,34 @@
+"""MNIST MLP — the single-chip smoke workload.
+
+Analog of the reference's smallest training demo (the TF MNIST job in
+demo/gpu-training, BASELINE.json config 1): proves the plugin-to-
+framework handoff end to end with seconds of compute.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistMLP(nn.Module):
+    hidden: int = 512
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        del train  # no dropout/BN; signature matches the zoo contract
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32))
+
+
+def make_apply_fn(model):
+    def apply_fn(variables, images, train):
+        return model.apply(variables, images, train=train), {}
+    return apply_fn

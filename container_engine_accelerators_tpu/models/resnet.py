@@ -1,0 +1,129 @@
+"""ResNet v1.5 in Flax, bfloat16-first for the MXU.
+
+Workload parity with the reference's ResNet demos
+(demo/tpu-training/resnet-tpu.yaml, demo/gpu-training sweep depths
+{18,34,50,101,152}). TPU-first choices: NHWC layout (XLA-TPU native),
+bfloat16 compute with float32 BatchNorm statistics and final logits,
+and no data-dependent control flow anywhere under jit.
+"""
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+_STAGE_SIZES = {
+    18: [2, 2, 2, 2],
+    34: [3, 4, 6, 3],
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+_BOTTLENECK = {18: False, 34: False, 50: True, 101: True, 152: True}
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        # v1.5: stride lives on the 3x3, not the 1x1.
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5; depth in {18, 34, 50, 101, 152}."""
+
+    depth: int = 50
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 padding="SAME")
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5,
+                                 dtype=self.dtype)
+        block_cls = BottleneckBlock if _BOTTLENECK[self.depth] else BasicBlock
+
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="norm_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, num_blocks in enumerate(_STAGE_SIZES[self.depth]):
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = block_cls(self.width * (2 ** stage), strides,
+                              conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="head")(x.astype(jnp.float32))
+        return x
+
+
+def resnet(depth=50, num_classes=1000, dtype=jnp.bfloat16, width=64):
+    if depth not in _STAGE_SIZES:
+        raise ValueError(f"unsupported ResNet depth {depth}; "
+                         f"want one of {sorted(_STAGE_SIZES)}")
+    return ResNet(depth=depth, num_classes=num_classes, dtype=dtype,
+                  width=width)
+
+
+def make_apply_fn(model):
+    """Adapt a Flax BN model to the Trainer's apply contract:
+    (variables, images, train) -> (logits, new_batch_stats)."""
+
+    def apply_fn(variables, images, train):
+        if train:
+            logits, mutated = model.apply(variables, images, train=True,
+                                          mutable=["batch_stats"])
+            return logits, mutated["batch_stats"]
+        logits = model.apply(variables, images, train=False)
+        return logits, variables.get("batch_stats", {})
+
+    return apply_fn

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels backing the demo workloads."""
+
+from .xent import softmax_cross_entropy, mean_cross_entropy_loss
+
+__all__ = ["softmax_cross_entropy", "mean_cross_entropy_loss"]

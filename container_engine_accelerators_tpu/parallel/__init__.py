@@ -1,0 +1,23 @@
+"""Mesh/sharding/train-step library for the TPU demo workloads.
+
+The reference's workload layer delegates parallelism to TF via device
+counts (demo/gpu-training/generate_job.sh: nvidia.com/gpu: 8); the
+TPU-native counterpart is explicit SPMD: a jax.sharding.Mesh over the
+chips the device plugin handed to the pod, parameter/batch shardings,
+and a pjit-compiled train step whose collectives ride ICI.
+"""
+
+from .mesh import MeshSpec, build_mesh, chips_from_env
+from .sharding import batch_sharding, param_shardings, replicated
+from .train import TrainState, Trainer
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "chips_from_env",
+    "batch_sharding",
+    "param_shardings",
+    "replicated",
+    "TrainState",
+    "Trainer",
+]

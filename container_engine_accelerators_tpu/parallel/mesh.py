@@ -1,0 +1,71 @@
+"""Device-mesh construction over plugin-allocated chips.
+
+Bridges the device plugin's Allocate-time env contract (plugin/envs.py:
+TPU_VISIBLE_DEVICES, TPU_CHIPS_PER_PROCESS_BOUNDS) to a
+jax.sharding.Mesh with ("data", "model") axes. The chip bounds map the
+"model" axis onto physically adjacent chips so tensor-parallel
+collectives take single-hop ICI links while data-parallel gradient
+all-reduce rides the longer dimension.
+"""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """How to factor the visible devices into (data, model)."""
+
+    data: int
+    model: int = 1
+
+    @property
+    def size(self):
+        return self.data * self.model
+
+
+def chips_from_env():
+    """Chip indices granted by the device plugin, or None.
+
+    Reads TPU_VISIBLE_DEVICES as injected via
+    ContainerAllocateResponse.envs (beta_plugin.py Allocate).
+    """
+    raw = os.environ.get("TPU_VISIBLE_DEVICES", "")
+    if not raw:
+        return None
+    try:
+        return [int(tok) for tok in raw.split(",") if tok != ""]
+    except ValueError:
+        return None
+
+
+def default_spec(n_devices, model_parallelism=1):
+    if n_devices % model_parallelism != 0:
+        raise ValueError(
+            f"{n_devices} devices do not factor into model={model_parallelism}")
+    return MeshSpec(data=n_devices // model_parallelism,
+                    model=model_parallelism)
+
+
+def build_mesh(spec=None, devices=None):
+    """Build a ("data", "model") Mesh.
+
+    devices defaults to jax.devices(). The device list is laid out
+    row-major (data-major), so neighboring model-axis entries are
+    adjacent chips under the plugin's contiguous-box allocations.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = default_spec(len(devices))
+    if spec.size != len(devices):
+        raise ValueError(
+            f"mesh spec {spec.data}x{spec.model} != {len(devices)} devices")
+    grid = np.array(devices).reshape(spec.data, spec.model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))

@@ -55,6 +55,7 @@ class TpuManager:
         self._grpc_server = None
         self._stop = threading.Event()
         self._serving = threading.Event()
+        self._known_chips = set()
 
     # -- discovery ----------------------------------------------------
 
@@ -77,6 +78,7 @@ class TpuManager:
         start the partition manager if a partition size is configured.
         """
         n = self._backend.init(self._dev_dir, self._state_dir)
+        self._known_chips = set(self._chip_indices())
         if self._config.tpu_partition_size:
             self._slice_mgr.start(self._config.tpu_partition_size)
         self._refresh_devices()
@@ -113,16 +115,21 @@ class TpuManager:
         """
         before = set(self.list_devices())
         self._backend.rescan()
+        chips_now = set(self._chip_indices())
+        chips_changed = chips_now != self._known_chips
+        self._known_chips = chips_now
         if self._config.tpu_partition_size:
-            try:
-                self._slice_mgr.start(self._config.tpu_partition_size)
-            except Exception as e:  # non-uniform after hot-plug
-                log.warning("re-partition after rescan failed: %s", e)
-        after_ids = (set(self._slice_mgr.list_devices())
-                     if self._config.tpu_partition_size
-                     else {f"accel{i}" for i in self._chip_indices()})
-
-        return after_ids != before
+            if chips_changed:
+                # Only re-solve the tiling when the population actually
+                # changed: SliceManager.start() resets slice health.
+                try:
+                    self._slice_mgr.start(self._config.tpu_partition_size)
+                except Exception as e:  # non-uniform after hot-plug
+                    log.warning("re-partition after rescan failed: %s", e)
+            after_ids = set(self._slice_mgr.list_devices())
+        else:
+            after_ids = {f"accel{i}" for i in chips_now}
+        return chips_changed or after_ids != before
 
     # -- device map ---------------------------------------------------
 

@@ -1,0 +1,5 @@
+"""JAX inference serving stack (TF-Serving demo analog)."""
+
+from .server import InferenceServer
+
+__all__ = ["InferenceServer"]

@@ -1,0 +1,211 @@
+"""JAX/XLA inference server.
+
+Workload parity with the reference's serving demo
+(demo/serving/tensorflow-serving.yaml + Dockerfile.client): an HTTP
+model server whose duty-cycle metric drives the GKE HPA. TPU-first
+design: requests are micro-batched up to a static batch size and run
+through one pre-compiled jit function — a single compiled program,
+padded to a fixed shape, so no recompilation ever happens on the
+serving path.
+
+Endpoints:
+  POST /v1/models/<name>:predict  {"instances": [[...], ...]}
+  GET  /healthz                   liveness/readiness
+  GET  /stats                     request count + latency summary
+"""
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger("serving")
+
+
+class _Batcher:
+    """Groups concurrent requests into fixed-size micro-batches."""
+
+    def __init__(self, run_batch, max_batch, max_wait_ms):
+        self._run = run_batch
+        self._max_batch = max_batch
+        self._max_wait_s = max_wait_ms / 1000.0
+        self._queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-batcher", daemon=True)
+        self._thread.start()
+
+    def submit(self, instance):
+        return self.submit_async(instance).get()
+
+    def submit_async(self, instance):
+        """Enqueue without blocking; returns the result queue."""
+        done = queue.Queue(maxsize=1)
+        self._queue.put((instance, done))
+        return done
+
+    def stop(self):
+        self._stop.set()
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                continue
+            batch = [item]
+            deadline = time.monotonic() + self._max_wait_s
+            while len(batch) < self._max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                batch.append(nxt)
+            instances = np.stack([b[0] for b in batch])
+            try:
+                outputs = self._run(instances)
+                for (_, done), out in zip(batch, outputs):
+                    done.put(("ok", out))
+            except Exception as e:  # surface per-request, keep serving
+                log.exception("batch inference failed")
+                for _, done in batch:
+                    done.put(("error", str(e)))
+
+
+class InferenceServer:
+    """HTTP server around one jitted model apply."""
+
+    def __init__(self, model_name, apply_fn, variables, input_shape,
+                 port=8500, max_batch=8, max_wait_ms=5):
+        self._name = model_name
+        self._input_shape = tuple(input_shape)
+        self._max_batch = max_batch
+        self._requests = 0
+        self._latencies = []
+        self._stats_lock = threading.Lock()
+
+        @jax.jit
+        def predict(images):
+            logits, _ = apply_fn(variables, images, False)
+            probs = jax.nn.softmax(logits, axis=-1)
+            return jnp.argmax(logits, axis=-1), jnp.max(probs, axis=-1)
+
+        def run_batch(instances):
+            n = instances.shape[0]
+            padded = np.zeros((max_batch, *self._input_shape),
+                              dtype=np.float32)
+            padded[:n] = instances
+            classes, scores = predict(padded)
+            classes = np.asarray(classes)[:n]
+            scores = np.asarray(scores)[:n]
+            return [{"class": int(c), "score": float(s)}
+                    for c, s in zip(classes, scores)]
+
+        self._batcher = _Batcher(run_batch, max_batch, max_wait_ms)
+        # Warm the compile cache before accepting traffic.
+        run_batch(np.zeros((1, *self._input_shape), dtype=np.float32))
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"status": "ok",
+                                      "model": server._name})
+                elif self.path == "/stats":
+                    self._reply(200, server.stats())
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != f"/v1/models/{server._name}:predict":
+                    self._reply(404, {"error": "unknown model"})
+                    return
+                t0 = time.perf_counter()
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(length))
+                    instances = payload["instances"]
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                arrays = []
+                for inst in instances:
+                    arr = np.asarray(inst, dtype=np.float32)
+                    if arr.shape != server._input_shape:
+                        self._reply(400, {
+                            "error": f"instance shape {arr.shape} != "
+                                     f"{server._input_shape}"})
+                        return
+                    arrays.append(arr)
+                # Enqueue every instance before waiting on any result
+                # so one request's instances share micro-batches.
+                pending = [server._batcher.submit_async(a) for a in arrays]
+                predictions = []
+                for done in pending:
+                    status, out = done.get()
+                    if status != "ok":
+                        self._reply(500, {"error": out})
+                        return
+                    predictions.append(out)
+                server._record(time.perf_counter() - t0)
+                self._reply(200, {"predictions": predictions})
+
+        self._httpd = ThreadingHTTPServer(("", port), Handler)
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def _record(self, latency_s):
+        with self._stats_lock:
+            self._requests += 1
+            self._latencies.append(latency_s)
+            if len(self._latencies) > 10000:
+                self._latencies = self._latencies[-5000:]
+
+    def stats(self):
+        with self._stats_lock:
+            lat = sorted(self._latencies)
+            n = len(lat)
+            return {
+                "requests": self._requests,
+                "p50_ms": round(lat[n // 2] * 1000, 3) if n else None,
+                "p99_ms": round(lat[int(n * 0.99)] * 1000, 3) if n else None,
+            }
+
+    def serve_forever(self):
+        log.info("serving model %r on :%d", self._name, self.port)
+        self._httpd.serve_forever()
+
+    def start(self):
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="serving-http", daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._batcher.stop()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Load generator + latency profiler for the serving demo.
+
+Counterpart of the reference's load client
+(demo/serving/load_generator.yaml runs inception_profiler.py with -n
+requests and parallel workers): sends POST :predict requests from
+worker threads and prints a latency/QPS summary line.
+"""
+
+import argparse
+import json
+import statistics
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+
+def worker(url, image_size, n, results, errors):
+    payload = json.dumps({
+        "instances": [np.zeros((image_size, image_size, 3)).tolist()]
+    }).encode()
+    for _ in range(n):
+        t0 = time.perf_counter()
+        try:
+            req = urllib.request.Request(
+                url, data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+            results.append(time.perf_counter() - t0)
+        except Exception:
+            errors.append(1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="localhost")
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--model-name", default="resnet")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("-n", "--num-requests", type=int, default=1000)
+    p.add_argument("--parallelism", type=int, default=30)
+    args = p.parse_args(argv)
+
+    url = (f"http://{args.host}:{args.port}/v1/models/"
+           f"{args.model_name}:predict")
+    per_worker = max(args.num_requests // args.parallelism, 1)
+    results, errors = [], []
+    threads = [threading.Thread(
+        target=worker, args=(url, args.image_size, per_worker,
+                             results, errors))
+        for _ in range(args.parallelism)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    lat = sorted(results)
+    summary = {
+        "requests": len(results),
+        "errors": len(errors),
+        "qps": round(len(results) / elapsed, 2) if elapsed else 0,
+        "p50_ms": round(statistics.median(lat) * 1000, 2) if lat else None,
+        "p99_ms": round(lat[int(len(lat) * 0.99)] * 1000, 2) if lat else None,
+    }
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Serving demo entrypoint: ResNet-50 behind the JAX inference server.
+
+Replaces the reference's TF-Serving container
+(demo/serving/tensorflow-serving.yaml command block) with the JAX
+stack; the HPA still scales on the device plugin's duty_cycle metric.
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO_ROOT)
+
+import jax
+import jax.numpy as jnp
+
+from container_engine_accelerators_tpu.models import resnet
+from container_engine_accelerators_tpu.models.resnet import make_apply_fn
+from container_engine_accelerators_tpu.serving import InferenceServer
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-name", default="resnet")
+    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--max-batch", type=int, default=8)
+    args = p.parse_args(argv)
+
+    model = resnet(depth=args.depth)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, args.image_size, args.image_size, 3)), train=False)
+    server = InferenceServer(
+        args.model_name, make_apply_fn(model), variables,
+        (args.image_size, args.image_size, 3),
+        port=args.port, max_batch=args.max_batch)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+/* inject_fault — TPU chip fault injector for health-path testing.
+ *
+ * Counterpart of the reference's Xid injector
+ * (demo/gpu-error/illegal-memory-access/vectorAdd.cu), which runs an
+ * out-of-bounds CUDA kernel to raise Xid 31 and exercise the health
+ * checker. TPUs surface chip faults through the node's published
+ * health state rather than a driver event ring, so the injector
+ * publishes a fault token into the state dir the health poller reads
+ * (see native/tpuinfo/tpuinfo.h: <state_dir>/accelN/health), then the
+ * plugin must mark the chip Unhealthy within one poll interval and
+ * refuse new allocations of it.
+ *
+ * Usage: inject_fault [-s state_dir] [-c chip] [-t token] [-r]
+ *   -s  state dir (default /run/tpu)
+ *   -c  chip index (default 0)
+ *   -t  fault token: uncorrectable_ecc | ici_link_down | overheat |
+ *       wedged (default uncorrectable_ecc)
+ *   -r  recover: publish "ok" instead
+ */
+
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+int main(int argc, char** argv) {
+  const char* state_dir = "/run/tpu";
+  const char* token = "uncorrectable_ecc";
+  int chip = 0;
+  int recover = 0;
+  int opt;
+  while ((opt = getopt(argc, argv, "s:c:t:r")) != -1) {
+    switch (opt) {
+      case 's': state_dir = optarg; break;
+      case 'c': chip = atoi(optarg); break;
+      case 't': token = optarg; break;
+      case 'r': recover = 1; break;
+      default:
+        fprintf(stderr,
+                "usage: %s [-s state_dir] [-c chip] [-t token] [-r]\n",
+                argv[0]);
+        return 2;
+    }
+  }
+  if (recover) token = "ok";
+
+  char dir[512], path[600];
+  snprintf(dir, sizeof(dir), "%s/accel%d", state_dir, chip);
+  if (mkdir(dir, 0755) != 0 && errno != EEXIST) {
+    perror("mkdir");
+    return 1;
+  }
+  snprintf(path, sizeof(path), "%s/health", dir);
+
+  /* Write atomically: the health poller may read concurrently. */
+  char tmp[650];
+  snprintf(tmp, sizeof(tmp), "%s.tmp", path);
+  FILE* f = fopen(tmp, "w");
+  if (f == NULL) {
+    perror("fopen");
+    return 1;
+  }
+  fprintf(f, "%s\n", token);
+  fclose(f);
+  if (rename(tmp, path) != 0) {
+    perror("rename");
+    return 1;
+  }
+  printf("published %s for accel%d in %s\n", token, chip, state_dir);
+  return 0;
+}

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Hyperparameter-sweep Job generator.
+# Workload parity with demo/gpu-training/generate_job.sh: emits one
+# Job manifest per (learning rate, batch size, depth) grid point, each
+# requesting a full 8-chip node through the device plugin.
+set -euo pipefail
+
+LEARNING_RATES=(0.001 0.01 0.1 0.05)
+BATCH_SIZES=(256 1024)
+DEPTHS=(18 34 50 101 152)
+CHIPS_PER_JOB="${CHIPS_PER_JOB:-8}"
+IMAGE="${IMAGE:-gcr.io/gke-release/tpu-jax-demos:v0.1.0}"
+OUT_DIR="${OUT_DIR:-./sweep-jobs}"
+
+mkdir -p "${OUT_DIR}"
+for lr in "${LEARNING_RATES[@]}"; do
+  for bs in "${BATCH_SIZES[@]}"; do
+    for depth in "${DEPTHS[@]}"; do
+      name="resnet${depth}-lr${lr//./-}-bs${bs}"
+      cat > "${OUT_DIR}/${name}.yaml" <<EOF
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: ${name}
+spec:
+  backoffLimit: 1
+  template:
+    spec:
+      restartPolicy: Never
+      containers:
+        - name: train
+          image: ${IMAGE}
+          command:
+            - python3
+            - /demos/tpu-training/train.py
+            - --model=resnet
+            - --depth=${depth}
+            - --lr=${lr}
+            - --batch-size=${bs}
+            - --steps=1000
+          resources:
+            limits:
+              google.com/tpu: ${CHIPS_PER_JOB}
+EOF
+      echo "wrote ${OUT_DIR}/${name}.yaml"
+    done
+  done
+done

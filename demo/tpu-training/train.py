@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""Training driver for the TPU demo jobs.
+
+Workload parity with the reference's training demos: the
+hyperparameter-sweep knobs of demo/gpu-training/generate_job.sh
+(--lr, --batch-size, --depth) and the fake-data TPU jobs of
+demo/tpu-training/{resnet,inception-v3}-tpu.yaml, rebuilt on the JAX
+SPMD stack (parallel.Trainer over a data x model mesh).
+
+Examples:
+  python train.py --model mnist --steps 200
+  python train.py --model resnet --depth 50 --batch-size 1024 \
+      --steps 100 --model-parallelism 1
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO_ROOT)
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from container_engine_accelerators_tpu.models import (
+    InceptionV3,
+    MnistMLP,
+    resnet,
+)
+from container_engine_accelerators_tpu.models import inception as inception_mod
+from container_engine_accelerators_tpu.models import mlp as mlp_mod
+from container_engine_accelerators_tpu.models import resnet as resnet_mod
+from container_engine_accelerators_tpu.ops import mean_cross_entropy_loss
+from container_engine_accelerators_tpu.parallel import (
+    Trainer,
+    batch_sharding,
+    build_mesh,
+)
+from container_engine_accelerators_tpu.parallel.data import SyntheticLoader
+from container_engine_accelerators_tpu.parallel.mesh import default_spec
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="TPU demo training job")
+    p.add_argument("--model", choices=["mnist", "resnet", "inception"],
+                   default="resnet")
+    p.add_argument("--depth", type=int, default=50,
+                   help="ResNet depth (18/34/50/101/152)")
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="global batch size")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--warmup-steps", type=int, default=5,
+                   help="steps excluded from throughput timing")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--model-parallelism", type=int, default=1)
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--pallas-loss", action="store_true", default=True)
+    p.add_argument("--no-pallas-loss", dest="pallas_loss",
+                   action="store_false")
+    p.add_argument("--json", action="store_true",
+                   help="print a single JSON result line")
+    p.add_argument("--model-dir", default=os.environ.get("MODEL_DIR", ""),
+                   help="checkpoint directory (local path; like the "
+                        "reference's --model_dir)")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="also checkpoint every N steps (0 = end only)")
+    return p.parse_args(argv)
+
+
+def save_checkpoint(model_dir, state):
+    """Checkpoint params/opt/batch_stats with orbax (demo parity with
+    the reference's --model_dir GCS checkpoints)."""
+    import orbax.checkpoint as ocp
+
+    step = int(state.step)
+    path = os.path.abspath(os.path.join(model_dir, f"checkpoint_{step}"))
+    ocp.PyTreeCheckpointer().save(
+        path,
+        {"step": step, "params": state.params,
+         "opt_state": state.opt_state, "batch_stats": state.batch_stats},
+        force=True)
+    print(f"saved checkpoint {path}", file=sys.stderr)
+    return path
+
+
+def restore_checkpoint(model_dir, state):
+    """Resume from the newest checkpoint_N under model_dir, if any."""
+    import orbax.checkpoint as ocp
+
+    from container_engine_accelerators_tpu.parallel.train import TrainState
+
+    try:
+        entries = sorted(
+            (int(name.rsplit("_", 1)[1]), name)
+            for name in os.listdir(model_dir)
+            if name.startswith("checkpoint_"))
+    except OSError:
+        return state
+    if not entries:
+        return state
+    path = os.path.abspath(os.path.join(model_dir, entries[-1][1]))
+    restored = ocp.PyTreeCheckpointer().restore(path, item={
+        "step": 0, "params": state.params,
+        "opt_state": state.opt_state, "batch_stats": state.batch_stats})
+    print(f"restored checkpoint {path}", file=sys.stderr)
+    import jax.numpy as _jnp
+    return TrainState(step=_jnp.asarray(restored["step"], _jnp.int32),
+                      params=restored["params"],
+                      opt_state=restored["opt_state"],
+                      batch_stats=restored["batch_stats"])
+
+
+def build_model(args):
+    if args.model == "mnist":
+        model = MnistMLP()
+        return model, mlp_mod.make_apply_fn(model), (28, 28, 1), 10
+    if args.model == "inception":
+        model = InceptionV3(num_classes=args.num_classes)
+        return (model, inception_mod.make_apply_fn(model),
+                (args.image_size, args.image_size, 3), args.num_classes)
+    model = resnet(depth=args.depth, num_classes=args.num_classes)
+    return (model, resnet_mod.make_apply_fn(model),
+            (args.image_size, args.image_size, 3), args.num_classes)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    devices = jax.devices()
+    mesh = build_mesh(default_spec(len(devices), args.model_parallelism))
+    model, apply_fn, image_shape, num_classes = build_model(args)
+
+    if args.pallas_loss and args.model != "inception":
+        loss_fn = mean_cross_entropy_loss
+    else:
+        from container_engine_accelerators_tpu.parallel.train import (
+            cross_entropy_loss,
+        )
+        loss_fn = cross_entropy_loss
+
+    tx = optax.chain(
+        optax.add_decayed_weights(args.weight_decay),
+        optax.sgd(args.lr, momentum=args.momentum),
+    )
+    trainer = Trainer(apply_fn, loss_fn, tx, mesh=mesh, remat=args.remat)
+
+    init_batch = jnp.zeros((1, *image_shape), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), init_batch, train=False)
+    state = trainer.init_state(variables)
+    if args.model_dir:
+        if args.model_dir.startswith("gs://"):
+            print("WARNING: gs:// model dirs need a GCS-enabled image; "
+                  "skipping checkpointing", file=sys.stderr)
+            args.model_dir = ""
+        else:
+            state = jax.device_put(restore_checkpoint(args.model_dir, state),
+                                   trainer.state_shardings(state))
+
+    loader = SyntheticLoader(args.batch_size, image_shape, num_classes,
+                             sharding=batch_sharding(mesh), pool=2)
+
+    losses = []
+    warmup = max(args.warmup_steps, 0)
+    t_start = time.perf_counter() if warmup == 0 else None
+    for step, batch in zip(range(args.steps), loader):
+        state, loss = trainer.train_step(state, batch)
+        if t_start is None and step == warmup - 1:
+            jax.block_until_ready(loss)
+            t_start = time.perf_counter()
+        if step % 20 == 0 or step == args.steps - 1:
+            losses.append(float(loss))
+            print(f"step {step} loss {float(loss):.4f}", file=sys.stderr)
+        if (args.model_dir and args.checkpoint_every
+                and (step + 1) % args.checkpoint_every == 0):
+            save_checkpoint(args.model_dir, state)
+    jax.block_until_ready(state.params)
+    timed_steps = max(args.steps - warmup, 0)
+    if t_start is None or timed_steps == 0:
+        images_per_sec = 0.0
+    else:
+        elapsed = time.perf_counter() - t_start
+        images_per_sec = (args.batch_size * timed_steps / elapsed
+                          if elapsed > 0 else 0.0)
+    result = {
+        "model": args.model,
+        "depth": args.depth if args.model == "resnet" else None,
+        "devices": len(devices),
+        "global_batch": args.batch_size,
+        "steps": args.steps,
+        "images_per_sec": round(images_per_sec, 2),
+        "images_per_sec_per_chip": round(images_per_sec / len(devices), 2),
+        "final_loss": losses[-1] if losses else None,
+    }
+    if args.model_dir:
+        save_checkpoint(args.model_dir, state)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

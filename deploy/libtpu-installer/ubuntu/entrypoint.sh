@@ -1,0 +1,84 @@
+#!/bin/bash
+# libtpu installer for Ubuntu TPU VM nodes.
+#
+# Capability parity with the reference's nvidia-driver-installer
+# (nvidia-driver-installer/ubuntu/entrypoint.sh): idempotent install
+# keyed on a version cache, artifacts staged into a hostPath dir that
+# workload pods mount read-only, and a post-install verification
+# probe. Differences by design: libtpu is a single userspace .so (no
+# kernel module build, no overlayfs gymnastics, no kernel-version
+# cache key), and the accel device nodes come from the platform, so
+# verification is "dlopen succeeds + /dev/accel* present" rather than
+# modprobe + nvidia-smi.
+set -euo pipefail
+
+LIBTPU_VERSION="${LIBTPU_VERSION:-0.0.11}"
+LIBTPU_URL="${LIBTPU_URL:-https://storage.googleapis.com/libtpu-releases/libtpu-${LIBTPU_VERSION}.tar.gz}"
+INSTALL_DIR_HOST="${TPU_INSTALL_DIR_HOST:-/home/kubernetes/bin/tpu}"
+INSTALL_DIR_CONTAINER="${TPU_INSTALL_DIR_CONTAINER:-/usr/local/tpu}"
+CACHE_FILE="${INSTALL_DIR_CONTAINER}/.installed_version"
+ROOT_MOUNT_DIR="${ROOT_MOUNT_DIR:-/root_dir}"
+
+main() {
+  mkdir -p "${INSTALL_DIR_CONTAINER}"
+
+  # Cache check by libtpu version (the reference caches on
+  # kernel+driver version; libtpu is kernel-independent).
+  if [[ -f "${CACHE_FILE}" ]] && \
+     [[ "$(cat "${CACHE_FILE}")" == "${LIBTPU_VERSION}" ]] && \
+     [[ -f "${INSTALL_DIR_CONTAINER}/lib64/libtpu.so" ]]; then
+    echo "libtpu ${LIBTPU_VERSION} already installed; verifying only"
+    verify
+    exit 0
+  fi
+
+  echo "installing libtpu ${LIBTPU_VERSION} into ${INSTALL_DIR_CONTAINER}"
+  workdir="$(mktemp -d)"
+  trap 'rm -rf "${workdir}"' EXIT
+
+  if [[ -n "${LIBTPU_LOCAL_PATH:-}" ]]; then
+    # Air-gapped path: artifact pre-staged on the node image.
+    cp "${LIBTPU_LOCAL_PATH}" "${workdir}/libtpu.tar.gz"
+  else
+    curl --fail --silent --show-error --location \
+      "${LIBTPU_URL}" --output "${workdir}/libtpu.tar.gz"
+  fi
+
+  mkdir -p "${INSTALL_DIR_CONTAINER}/lib64"
+  tar xzf "${workdir}/libtpu.tar.gz" -C "${INSTALL_DIR_CONTAINER}/lib64" \
+    --strip-components=0
+
+  # Make the host's dynamic linker aware of the install dir (the
+  # reference updates host ld.so.conf the same way).
+  if [[ -d "${ROOT_MOUNT_DIR}/etc/ld.so.conf.d" ]]; then
+    echo "${INSTALL_DIR_HOST}/lib64" \
+      > "${ROOT_MOUNT_DIR}/etc/ld.so.conf.d/libtpu.conf"
+    chroot "${ROOT_MOUNT_DIR}" ldconfig || true
+  fi
+
+  verify
+  echo "${LIBTPU_VERSION}" > "${CACHE_FILE}"
+  echo "libtpu ${LIBTPU_VERSION} installed"
+}
+
+verify() {
+  # 1. device nodes present (created by the platform, not by us — but
+  #    their absence means this node cannot run TPU workloads).
+  if ! compgen -G "/dev/accel[0-9]*" > /dev/null; then
+    echo "WARNING: no /dev/accel* nodes visible; TPU runtime will not start"
+  fi
+  # 2. the library loads.
+  python3 - <<'PY'
+import ctypes, os, sys
+path = os.path.join(os.environ.get("TPU_INSTALL_DIR_CONTAINER",
+                                   "/usr/local/tpu"), "lib64", "libtpu.so")
+try:
+    ctypes.CDLL(path)
+except OSError as e:
+    print(f"libtpu verification failed: {e}", file=sys.stderr)
+    sys.exit(1)
+print("libtpu dlopen OK")
+PY
+}
+
+main "$@"

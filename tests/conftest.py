@@ -10,11 +10,22 @@ process, hence here at conftest import time.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment exports JAX_PLATFORMS=axon
+# (a tunneled remote TPU) globally, and tests must never touch it.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize pre-imports jax and pins
+# jax_platforms="axon,cpu" via jax.config (overriding the env), which
+# makes the first backends() call dial the remote TPU tunnel from
+# inside unit tests. Pin the config back to cpu before any backend
+# initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

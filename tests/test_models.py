@@ -1,0 +1,72 @@
+"""Model-zoo shape/param tests on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from container_engine_accelerators_tpu.models import (
+    InceptionV3,
+    MnistMLP,
+    resnet,
+)
+from container_engine_accelerators_tpu.models.resnet import make_apply_fn
+
+
+@pytest.mark.parametrize("depth,bottleneck_params", [
+    (18, None), (50, None),
+])
+def test_resnet_forward_shape(depth, bottleneck_params):
+    model = resnet(depth=depth, num_classes=10, dtype=jnp.float32, width=8)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 64, 64, 3)), train=False)
+    logits = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_resnet50_parameter_count():
+    model = resnet(depth=50, num_classes=1000)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3)), train=False))
+    n = sum(int(jnp.prod(jnp.array(p.shape)))
+            for p in jax.tree_util.tree_leaves(variables["params"]))
+    # Canonical ResNet-50 v1.5: ~25.56M params.
+    assert 25_400_000 < n < 25_700_000, n
+
+
+def test_resnet_train_mode_updates_batch_stats():
+    model = resnet(depth=18, num_classes=4, dtype=jnp.float32, width=8)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    apply_fn = make_apply_fn(model)
+    logits, new_stats = apply_fn(variables, x, True)
+    assert logits.shape == (4, 4)
+    old_mean = jax.tree_util.tree_leaves(variables["batch_stats"])[0]
+    new_mean = jax.tree_util.tree_leaves(new_stats)[0]
+    assert not jnp.allclose(old_mean, new_mean)
+
+
+def test_resnet_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        resnet(depth=42)
+
+
+def test_inception_forward_shape():
+    model = InceptionV3(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((1, 299, 299, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 10)
+    n = sum(int(jnp.prod(jnp.array(p.shape)))
+            for p in jax.tree_util.tree_leaves(variables["params"]))
+    # Torch/TF Inception-v3 without aux head: ~21.8M (+fc 10 here).
+    assert 21_000_000 < n < 24_000_000, n
+
+
+def test_mlp_forward():
+    model = MnistMLP(hidden=32, dtype=jnp.float32)
+    x = jnp.zeros((8, 28, 28, 1))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(variables, x)
+    assert logits.shape == (8, 10)

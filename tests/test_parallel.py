@@ -1,0 +1,138 @@
+"""Mesh/sharding/trainer tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from container_engine_accelerators_tpu.models import MnistMLP, resnet
+from container_engine_accelerators_tpu.models import mlp as mlp_mod
+from container_engine_accelerators_tpu.models.resnet import (
+    make_apply_fn as resnet_apply_fn,
+)
+from container_engine_accelerators_tpu.parallel import (
+    MeshSpec,
+    Trainer,
+    batch_sharding,
+    build_mesh,
+    chips_from_env,
+    param_shardings,
+)
+from container_engine_accelerators_tpu.parallel.data import SyntheticLoader
+from container_engine_accelerators_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+)
+from container_engine_accelerators_tpu.parallel.train import (
+    cross_entropy_loss,
+)
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_build_mesh_default_pure_dp():
+    mesh = build_mesh()
+    assert mesh.shape[DATA_AXIS] == 8
+    assert mesh.shape[MODEL_AXIS] == 1
+
+
+def test_build_mesh_dp_tp():
+    mesh = build_mesh(MeshSpec(data=4, model=2))
+    assert mesh.shape[DATA_AXIS] == 4
+    assert mesh.shape[MODEL_AXIS] == 2
+
+
+def test_build_mesh_wrong_size():
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec(data=3, model=2))
+
+
+def test_chips_from_env(monkeypatch):
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "0,1,4,5")
+    assert chips_from_env() == [0, 1, 4, 5]
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "")
+    assert chips_from_env() is None
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "a,b")
+    assert chips_from_env() is None
+
+
+def test_param_shardings_shard_wide_kernels():
+    mesh = build_mesh(MeshSpec(data=4, model=2))
+    params = {
+        "dense": {"kernel": jnp.zeros((256, 1024)),
+                  "bias": jnp.zeros((1024,))},
+        "small": {"kernel": jnp.zeros((16, 16))},
+    }
+    shardings = param_shardings(mesh, params)
+    assert shardings["dense"]["kernel"].spec == \
+        jax.sharding.PartitionSpec(None, MODEL_AXIS)
+    assert shardings["dense"]["bias"].spec == jax.sharding.PartitionSpec()
+    assert shardings["small"]["kernel"].spec == jax.sharding.PartitionSpec()
+
+
+def _train_mlp(mesh, steps=30):
+    model = MnistMLP(hidden=1024, dtype=jnp.float32)
+    apply_fn = mlp_mod.make_apply_fn(model)
+    trainer = Trainer(apply_fn, cross_entropy_loss,
+                      optax.sgd(0.1, momentum=0.9), mesh=mesh)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    state = trainer.init_state(variables)
+    loader = SyntheticLoader(64, (28, 28, 1), 10,
+                             sharding=batch_sharding(mesh), pool=1)
+    losses = []
+    for _, batch in zip(range(steps), loader):
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    return losses
+
+
+def test_trainer_dp_loss_decreases():
+    losses = _train_mlp(build_mesh())
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_trainer_dp_tp_matches_dp():
+    """Same data, same init: dp and dp x tp runs must agree closely —
+    the sharding layout must not change the math."""
+    dp = _train_mlp(build_mesh(), steps=5)
+    dptp = _train_mlp(build_mesh(MeshSpec(data=4, model=2)), steps=5)
+    np.testing.assert_allclose(dp, dptp, rtol=2e-4)
+
+
+def test_trainer_resnet_step_runs_sharded():
+    mesh = build_mesh(MeshSpec(data=4, model=2))
+    model = resnet(depth=18, num_classes=8, dtype=jnp.float32, width=8)
+    trainer = Trainer(resnet_apply_fn(model), cross_entropy_loss,
+                      optax.sgd(0.01), mesh=mesh)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    state = trainer.init_state(variables)
+    loader = SyntheticLoader(16, (32, 32, 3), 8,
+                             sharding=batch_sharding(mesh), pool=1)
+    batch = next(loader)
+    state, loss1 = trainer.train_step(state, batch)
+    state, loss2 = trainer.train_step(state, batch)
+    assert float(loss2) < float(loss1)
+    assert int(state.step) == 2
+
+
+def test_train_driver_checkpoint_resume(tmp_path):
+    """Checkpoint/resume through the demo training driver (the aux
+    subsystem the reference delegates to --model_dir, SURVEY.md s5)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "demo_train", "demo/tpu-training/train.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    args = ["--model", "mnist", "--steps", "3", "--warmup-steps", "0",
+            "--batch-size", "16", "--model-dir", str(tmp_path)]
+    result1 = mod.main(args)
+    assert result1["final_loss"] is not None
+    import os
+    assert any(n.startswith("checkpoint_") for n in os.listdir(tmp_path))
+    # Second run resumes from step 3 and checkpoints at step 6.
+    mod.main(args)
+    assert any(n == "checkpoint_6" for n in os.listdir(tmp_path))

@@ -1,0 +1,110 @@
+"""partition_tpu CLI tests (mirrors partition_gpu_test.go's table style)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "cmd"))
+
+import partition_tpu  # noqa: E402
+
+from container_engine_accelerators_tpu.chip import (  # noqa: E402
+    BadShapeError,
+    NonUniformPartitionError,
+    PyChipBackend,
+)
+
+
+@pytest.fixture
+def node8(fake_node):
+    for i in range(8):
+        fake_node.add_chip(i)
+    fake_node.set_topology("2x4")
+    return fake_node
+
+
+def backend_for(node):
+    b = PyChipBackend()
+    b.init(node.dev_dir, node.state_dir)
+    return b
+
+
+@pytest.mark.parametrize("shape,expect", [
+    ("2x2", {"tpu-2x2-0": [0, 1, 4, 5], "tpu-2x2-1": [2, 3, 6, 7]}),
+    ("2x4", {"tpu-2x4-0": [0, 1, 2, 3, 4, 5, 6, 7]}),
+    ("1x1", {f"tpu-1x1-{i}": [c] for i, c in enumerate(
+        [0, 1, 2, 3, 4, 5, 6, 7])}),
+])
+def test_build_partition_plan(node8, shape, expect):
+    plan = partition_tpu.build_partition_plan(backend_for(node8), shape)
+    # 1x1 slice order is row-major over tiles, not chip order; compare
+    # as sets of chip groups plus exact ids for the 2x2 case.
+    assert {tuple(v) for v in plan.values()} == \
+        {tuple(v) for v in expect.values()}
+    if shape == "2x2":
+        assert plan == expect
+
+
+@pytest.mark.parametrize("shape,err", [
+    ("2x3", NonUniformPartitionError),
+    ("garbage", BadShapeError),
+])
+def test_build_partition_plan_errors(node8, shape, err):
+    with pytest.raises(err):
+        partition_tpu.build_partition_plan(backend_for(node8), shape)
+
+
+def write_config(tmp_path, body):
+    p = tmp_path / "tpu_config.json"
+    p.write_text(body)
+    return str(p)
+
+
+def test_main_publishes_plan(node8, tmp_path):
+    cfg_file = write_config(tmp_path, '{"tpuPartitionSize": "2x2"}')
+    rc = partition_tpu.main(["--config-file", cfg_file,
+                             "--device-dir", node8.dev_dir,
+                             "--state-dir", node8.state_dir])
+    assert rc == 0
+    plan = json.load(open(os.path.join(node8.state_dir, "partitions.json")))
+    assert plan["shape"] == "2x2"
+    assert plan["topology"] == "2x4x1"
+    assert plan["slices"]["tpu-2x2-1"] == [2, 3, 6, 7]
+
+
+def test_main_no_config_is_noop(node8, tmp_path):
+    rc = partition_tpu.main(["--config-file", str(tmp_path / "none.json"),
+                             "--device-dir", node8.dev_dir,
+                             "--state-dir", node8.state_dir])
+    assert rc == 0
+    assert not os.path.exists(os.path.join(node8.state_dir,
+                                           "partitions.json"))
+
+
+def test_main_invalid_shape_fails(node8, tmp_path):
+    cfg_file = write_config(tmp_path, '{"tpuPartitionSize": "3x3"}')
+    rc = partition_tpu.main(["--config-file", cfg_file,
+                             "--device-dir", node8.dev_dir,
+                             "--state-dir", node8.state_dir])
+    assert rc == 1
+
+
+def test_main_no_chips_fails(fake_node, tmp_path):
+    cfg_file = write_config(tmp_path, '{"tpuPartitionSize": "1x1"}')
+    rc = partition_tpu.main(["--config-file", cfg_file,
+                             "--device-dir", fake_node.dev_dir,
+                             "--state-dir", fake_node.state_dir])
+    assert rc == 1
+
+
+def test_main_clean(node8, tmp_path):
+    cfg_file = write_config(tmp_path, '{"tpuPartitionSize": "2x2"}')
+    partition_tpu.main(["--config-file", cfg_file,
+                        "--device-dir", node8.dev_dir,
+                        "--state-dir", node8.state_dir])
+    rc = partition_tpu.main(["--clean", "--state-dir", node8.state_dir])
+    assert rc == 0
+    assert not os.path.exists(os.path.join(node8.state_dir,
+                                           "partitions.json"))

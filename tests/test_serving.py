@@ -1,0 +1,94 @@
+"""Inference-server tests over real HTTP (serving demo parity)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import MnistMLP
+from container_engine_accelerators_tpu.models import mlp as mlp_mod
+from container_engine_accelerators_tpu.serving import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    model = MnistMLP(hidden=32, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    srv = InferenceServer("mnist", mlp_mod.make_apply_fn(model), variables,
+                          (28, 28, 1), port=0, max_batch=4, max_wait_ms=2)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def post(server, path, payload):
+    req = urllib.request.Request(
+        f"http://localhost:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_predict(server):
+    instance = np.zeros((28, 28, 1)).tolist()
+    out = post(server, "/v1/models/mnist:predict", {"instances": [instance]})
+    assert len(out["predictions"]) == 1
+    pred = out["predictions"][0]
+    assert 0 <= pred["class"] < 10
+    assert 0.0 <= pred["score"] <= 1.0
+
+
+def test_healthz_and_stats(server):
+    with urllib.request.urlopen(
+            f"http://localhost:{server.port}/healthz", timeout=10) as resp:
+        assert json.loads(resp.read())["status"] == "ok"
+    with urllib.request.urlopen(
+            f"http://localhost:{server.port}/stats", timeout=10) as resp:
+        stats = json.loads(resp.read())
+    assert stats["requests"] >= 1
+
+
+def test_bad_shape_rejected(server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post(server, "/v1/models/mnist:predict",
+             {"instances": [np.zeros((4, 4)).tolist()]})
+    assert err.value.code == 400
+
+
+def test_unknown_model_404(server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post(server, "/v1/models/nope:predict", {"instances": []})
+    assert err.value.code == 404
+
+
+def test_malformed_body_400(server):
+    req = urllib.request.Request(
+        f"http://localhost:{server.port}/v1/models/mnist:predict",
+        data=b"{not json", headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req, timeout=10)
+    assert err.value.code == 400
+
+
+def test_concurrent_batching(server):
+    import threading
+    instance = np.zeros((28, 28, 1)).tolist()
+    results = []
+
+    def call():
+        out = post(server, "/v1/models/mnist:predict",
+                   {"instances": [instance]})
+        results.append(out["predictions"][0]["class"])
+
+    threads = [threading.Thread(target=call) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 8
+    assert len(set(results)) == 1  # same input -> same class
