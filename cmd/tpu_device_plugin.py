@@ -58,6 +58,14 @@ def parse_args(argv=None):
                    help="poll chip health and gate allocations")
     p.add_argument("--health-poll-interval", type=float, default=5.0,
                    metavar="SECONDS")
+    p.add_argument("--tpu-worker-id", type=int,
+                   default=int(os.environ.get("TPU_WORKER_ID", "0")),
+                   help="this host's worker index within a multi-host "
+                        "TPU slice (one plugin per host)")
+    p.add_argument("--tpu-worker-hostnames",
+                   default=os.environ.get("TPU_WORKER_HOSTNAMES",
+                                          "localhost"),
+                   help="comma-separated hostnames of all slice workers")
     return p.parse_args(argv)
 
 
@@ -70,9 +78,12 @@ def main(argv=None):
     backend = get_backend()
     mounts = [(args.container_path, args.host_path)] \
         if os.path.isdir(args.host_path) else []
-    manager = TpuManager(dev_dir=args.device_dir, state_dir=args.state_dir,
-                         mount_paths=mounts, tpu_config=tpu_config,
-                         backend=backend)
+    manager = TpuManager(
+        dev_dir=args.device_dir, state_dir=args.state_dir,
+        mount_paths=mounts, tpu_config=tpu_config, backend=backend,
+        worker_id=args.tpu_worker_id,
+        worker_hostnames=tuple(
+            h for h in args.tpu_worker_hostnames.split(",") if h))
 
     # Retry until the driver stack has surfaced the chips
     # (nvidia_gpu.go:88-98: 5s cadence).

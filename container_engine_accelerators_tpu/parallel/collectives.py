@@ -1,0 +1,113 @@
+"""Explicit SPMD collectives over the device mesh (shard_map).
+
+The trainer's standard path lets XLA insert collectives from sharding
+annotations; this module is the explicit counterpart for code that
+wants hand-placed communication — custom training loops, ring-style
+overlapping of compute and ICI transfers, or benchmarks of the
+collective fabric itself. Everything lowers to XLA collectives
+(psum / all_gather / psum_scatter / ppermute) over ICI/DCN; nothing
+NCCL-shaped exists (SURVEY.md section 2.4: the transport belongs to
+XLA, the plugin only hands out topology).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+
+def all_reduce_mean(mesh, x, axis_name=DATA_AXIS):
+    """Mean-reduce x across an axis; x is sharded on its leading dim."""
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis_name),
+        out_specs=P(axis_name))
+    def _mean(shard):
+        return jax.lax.pmean(shard, axis_name)
+
+    return _mean(x)
+
+
+def all_gather(mesh, x, axis_name=DATA_AXIS):
+    """Gather shards along the leading dim onto every device."""
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis_name), out_specs=P(),
+        check_vma=False)
+    def _gather(shard):
+        return jax.lax.all_gather(shard, axis_name, axis=0, tiled=True)
+
+    return _gather(x)
+
+
+def reduce_scatter(mesh, x, axis_name=DATA_AXIS):
+    """Sum-reduce a replicated array, scattering the result's leading
+    dim across the axis (the memory-efficient half of an all-reduce)."""
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(axis_name))
+    def _rs(full):
+        return jax.lax.psum_scatter(full, axis_name, scatter_dimension=0,
+                                    tiled=True)
+
+    return _rs(x)
+
+
+def ring_all_reduce(mesh, x, axis_name=DATA_AXIS):
+    """Bandwidth-optimal ring all-reduce written with ppermute.
+
+    Functionally identical to psum; written out as N-1 reduce-scatter
+    hops + N-1 all-gather hops so each step moves only 1/N of the
+    data to the ring neighbor — the schedule that rides each ICI link
+    exactly once per hop. XLA's own psum already does this on TPU;
+    this explicit version exists for benchmarking the fabric and as
+    the template for custom overlapped schedules.
+    """
+    n = mesh.shape[axis_name]
+    if n == 1:
+        return x
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis_name),
+        out_specs=P(axis_name))
+    def _ring(shard):
+        idx = jax.lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        # Work in n contiguous blocks of the local shard, zero-padding
+        # the flat shard so any size divides (psum parity: zeros are
+        # neutral for the sum and sliced off at the end).
+        flat = shard.reshape(-1)
+        pad = (-flat.shape[0]) % n
+        flat = jnp.pad(flat, (0, pad))
+        blocks = flat.reshape(n, -1)
+
+        # Reduce-scatter phase: after n-1 hops, block (idx+1) holds
+        # the full sum of that block across the ring.
+        def rs_step(k, blocks):
+            send_ix = (idx - k) % n
+            chunk = jnp.take(blocks, send_ix[None], axis=0)
+            received = jax.lax.ppermute(chunk, axis_name, perm)
+            recv_ix = (idx - k - 1) % n
+            return blocks.at[recv_ix].add(received[0])
+
+        blocks = jax.lax.fori_loop(0, n - 1, rs_step, blocks)
+
+        # All-gather phase: circulate each completed block.
+        def ag_step(k, blocks):
+            send_ix = (idx + 1 - k) % n
+            chunk = jnp.take(blocks, send_ix[None], axis=0)
+            received = jax.lax.ppermute(chunk, axis_name, perm)
+            recv_ix = (idx - k) % n
+            return blocks.at[recv_ix].set(received[0])
+
+        blocks = jax.lax.fori_loop(0, n - 1, ag_step, blocks)
+        out = blocks.reshape(-1)
+        if pad:
+            out = out[:-pad]
+        return out.reshape(shard.shape)
+
+    return _ring(x)

@@ -1,0 +1,52 @@
+"""Multi-host initialization from the device plugin's env contract.
+
+On a multi-host TPU slice each host's pod receives TPU_WORKER_ID and
+TPU_WORKER_HOSTNAMES from the plugin's Allocate response
+(plugin/envs.py). This helper turns that contract into a
+jax.distributed.initialize() call so XLA collectives span hosts over
+DCN — the counterpart of the reference delegating cross-node
+communication to the workload's framework (SURVEY.md section 2.4).
+"""
+
+import os
+
+from ..utils import get_logger
+
+log = get_logger("distributed")
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def initialize_from_plugin_env(coordinator_port=DEFAULT_COORDINATOR_PORT):
+    """Initialize jax.distributed from plugin-injected envs.
+
+    No-op (returns False) when the pod holds a single-host slice.
+    Worker 0's hostname serves as the coordinator.
+    """
+    hostnames = [h for h in
+                 os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if len(hostnames) <= 1:
+        log.info("single-host slice; skipping jax.distributed")
+        return False
+    raw_id = os.environ.get("TPU_WORKER_ID")
+    if raw_id is None:
+        raise ValueError(
+            "TPU_WORKER_HOSTNAMES lists multiple hosts but TPU_WORKER_ID "
+            "is unset; every host would claim process 0. Set it via the "
+            "plugin's --tpu-worker-id or the Job downward API.")
+    worker_id = int(raw_id)
+    if not 0 <= worker_id < len(hostnames):
+        raise ValueError(
+            f"TPU_WORKER_ID={worker_id} out of range for "
+            f"{len(hostnames)} workers")
+    coordinator = f"{hostnames[0]}:{coordinator_port}"
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=len(hostnames),
+        process_id=worker_id)
+    log.info("jax.distributed up: process %d/%d via %s",
+             worker_id, len(hostnames), coordinator)
+    return True

@@ -10,7 +10,10 @@ ICI. This module composes those envs from the allocated chip set:
                                  "x,y,z" (only when the set is a full
                                  contiguous box — else omitted so
                                  libtpu falls back to flat enumeration)
-    TPU_PROCESS_BOUNDS           process grid, "1,1,1" for single-pod
+    TPU_PROCESS_BOUNDS           process grid: "1,1,1" single-host;
+                                 "1,1,N" for N hosts (hosts stacked
+                                 along z — non-linear host grids
+                                 override via the Job downward API)
     CLOUD_TPU_TASK_ID / TPU_WORKER_ID
                                  worker index within the job
     TPU_WORKER_HOSTNAMES         comma-separated coordinator hostnames
@@ -50,9 +53,11 @@ def topology_envs(chips, coords, worker_id=0, worker_hostnames=("localhost",)):
     chips:  sorted chip indices being handed to the container.
     coords: parallel list of (x, y, z) torus coordinates.
     """
+    n_workers = max(len(worker_hostnames), 1)
+    process_bounds = "1,1,1" if n_workers == 1 else f"1,1,{n_workers}"
     envs = {
         "TPU_VISIBLE_DEVICES": ",".join(str(c) for c in chips),
-        "TPU_PROCESS_BOUNDS": "1,1,1",
+        "TPU_PROCESS_BOUNDS": process_bounds,
         "TPU_WORKER_ID": str(worker_id),
         "CLOUD_TPU_TASK_ID": str(worker_id),
         "TPU_WORKER_HOSTNAMES": ",".join(worker_hostnames),

@@ -42,11 +42,14 @@ class TpuManager:
     """Owns chip state and serves the device-plugin gRPC surface."""
 
     def __init__(self, dev_dir=cfg.DEVICE_DIR, state_dir=cfg.STATE_DIR,
-                 mount_paths=None, tpu_config=None, backend=None):
+                 mount_paths=None, tpu_config=None, backend=None,
+                 worker_id=0, worker_hostnames=("localhost",)):
         self._dev_dir = dev_dir
         self._state_dir = state_dir
         self._mount_paths = list(mount_paths or [])
         self._config = tpu_config or cfg.TpuConfig()
+        self._worker_id = worker_id
+        self._worker_hostnames = tuple(worker_hostnames)
         self._backend = backend or get_backend()
         self._devices = {}          # device id -> health string
         self._lock = threading.Lock()
@@ -195,10 +198,18 @@ class TpuManager:
         return specs
 
     def allocate_envs(self, device_ids):
-        """Topology env contract for the union of the requested devices."""
+        """Topology env contract for the union of the requested devices.
+
+        On multi-host slices each host runs one plugin; worker_id and
+        worker_hostnames describe this host's place in the slice so
+        jax.distributed / the libtpu process bounds can initialize
+        across hosts (the XLA-over-ICI/DCN counterpart of the
+        reference leaving NCCL to the workload, SURVEY.md s2.4).
+        """
         chips = sorted({c for d in device_ids for c in self.device_chips(d)})
         coords = [self._backend.chip_coords(c) for c in chips]
-        return topology_envs(chips, coords)
+        return topology_envs(chips, coords, worker_id=self._worker_id,
+                             worker_hostnames=self._worker_hostnames)
 
     def mounts(self):
         return [

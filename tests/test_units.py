@@ -88,3 +88,24 @@ def test_topology_envs_worker_override():
                          worker_hostnames=("w0", "w1", "w2", "w3"))
     assert envs["TPU_WORKER_ID"] == "3"
     assert envs["TPU_WORKER_HOSTNAMES"] == "w0,w1,w2,w3"
+
+
+def test_manager_multi_host_envs(tmp_path):
+    from container_engine_accelerators_tpu.chip import PyChipBackend
+    from container_engine_accelerators_tpu.plugin.manager import TpuManager
+    dev = tmp_path / "dev"
+    state = tmp_path / "state"
+    dev.mkdir(); state.mkdir()
+    for i in range(4):
+        (dev / f"accel{i}").touch()
+    (state / "topology").write_text("2x2")
+    mgr = TpuManager(dev_dir=str(dev), state_dir=str(state),
+                     backend=PyChipBackend(), worker_id=2,
+                     worker_hostnames=("w0", "w1", "w2", "w3"))
+    mgr.start()
+    envs = mgr.allocate_envs(["accel0", "accel1", "accel2", "accel3"])
+    assert envs["TPU_WORKER_ID"] == "2"
+    assert envs["CLOUD_TPU_TASK_ID"] == "2"
+    assert envs["TPU_WORKER_HOSTNAMES"] == "w0,w1,w2,w3"
+    assert envs["TPU_PROCESS_BOUNDS"] == "1,1,4"
+    assert envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Allocate-latency microbenchmark (BASELINE.md metric #2).
+
+Measures the plugin's end-to-end Allocate RPC latency over a real
+unix-socket gRPC loopback against a synthetic node — the same path
+the kubelet takes at pod admission (SURVEY.md section 3.2: the
+scheduling-critical RPC, in-memory map lookups + proto marshalling).
+
+Prints one JSON line with p50/p95/p99 in microseconds.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import grpc
+
+from container_engine_accelerators_tpu.chip import get_backend
+from container_engine_accelerators_tpu.plugin import api
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+from tests.plugin_helpers import ServingManager
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--chips", type=int, default=8)
+    p.add_argument("--chips-per-alloc", type=int, default=4)
+    p.add_argument("--iterations", type=int, default=2000)
+    p.add_argument("--warmup", type=int, default=100)
+    args = p.parse_args(argv)
+
+    root = tempfile.mkdtemp(prefix="tpu")
+    dev = os.path.join(root, "dev")
+    state = os.path.join(root, "state")
+    plugin_dir = os.path.join(root, "plugin")
+    for d in (dev, state, plugin_dir):
+        os.mkdir(d)
+    for i in range(args.chips):
+        open(os.path.join(dev, f"accel{i}"), "w").close()
+
+    manager = TpuManager(dev_dir=dev, state_dir=state,
+                         backend=get_backend())
+    manager.start()
+
+    request = api.v1beta1_pb2.AllocateRequest(container_requests=[
+        api.v1beta1_pb2.ContainerAllocateRequest(
+            devicesIDs=[f"accel{i}" for i in range(args.chips_per_alloc)])])
+
+    samples = []
+    with ServingManager(manager, plugin_dir) as sm:
+        with sm.channel() as channel:
+            stub = api.DevicePluginV1Beta1Stub(channel)
+            for _ in range(args.warmup):
+                stub.Allocate(request)
+            for _ in range(args.iterations):
+                t0 = time.perf_counter()
+                stub.Allocate(request)
+                samples.append(time.perf_counter() - t0)
+    samples.sort()
+    us = [s * 1e6 for s in samples]
+    print(json.dumps({
+        "metric": "allocate_latency",
+        "chips_per_alloc": args.chips_per_alloc,
+        "p50_us": round(statistics.median(us), 1),
+        "p95_us": round(us[int(len(us) * 0.95)], 1),
+        "p99_us": round(us[int(len(us) * 0.99)], 1),
+        "iterations": args.iterations,
+    }))
+
+
+if __name__ == "__main__":
+    main()
