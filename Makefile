@@ -20,10 +20,10 @@ test: native
 test-native:
 	$(MAKE) -C native/tpuinfo test
 
-presubmit: native
+presubmit:
 	./build/check_python.sh
+	./build/check_logging.sh
 	./build/check_boilerplate.sh
-	python3 -m pytest tests/ -q
 
 bench:
 	python3 bench.py

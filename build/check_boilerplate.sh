@@ -1,0 +1,39 @@
+#!/bin/bash
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+# Verify the Apache license header is present on every first-party
+# source file (counterpart of the reference's build/check_boilerplate.sh,
+# which walks Go/sh sources excluding vendor/).
+#
+# Generated protobuf modules (*_pb2.py) are exempt, as generated code
+# was in the reference (vendored).
+
+cd "$(dirname "$0")/.." || exit 1
+
+FAIL=0
+while IFS= read -r -d '' f; do
+  if ! head -25 "${f}" | grep -q "Licensed under the Apache License"; then
+    echo "Missing license boilerplate: ${f}"
+    FAIL=1
+  fi
+done < <(find . -path ./.git -prune -o -name "*_pb2.py" -prune -o \
+  \( -name "*.py" -o -name "*.sh" -o -name "*.cc" -o -name "*.c" \
+     -o -name "*.h" -o -name "*.proto" \) -type f -print0)
+
+if [ "${FAIL}" -ne 0 ]; then
+  echo "Add the header from build/boilerplate/ to the files above."
+fi
+exit ${FAIL}

@@ -1,3 +1,5 @@
+#!/bin/bash
+
 # Copyright 2026 The container-engine-accelerators-tpu Authors.
 #
 # Licensed under the Apache License, Version 2.0 (the "License");
@@ -12,18 +14,20 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Flax model zoo for the demo workloads.
+# Logging-discipline lint (counterpart of the reference's
+# build/check_errorf.sh style gate): library code under
+# container_engine_accelerators_tpu/ must log through utils/log.py,
+# never bare print(). Entry binaries, demos, tools, and tests may
+# print.
 
-Covers the model families the reference's demos exercise
-(SURVEY.md section 2.3): ResNet-{18,34,50,101,152} for the training
-sweep (demo/gpu-training/generate_job.sh depths {34,50,101,152} and
-demo/tpu-training/resnet-tpu.yaml), Inception-v3
-(demo/tpu-training/inception-v3-tpu.yaml), and an MNIST MLP for the
-single-chip smoke workload.
-"""
+cd "$(dirname "$0")/.." || exit 1
 
-from .resnet import ResNet, resnet
-from .inception import InceptionV3
-from .mlp import MnistMLP
+BAD=$(grep -rn --include="*.py" "print(" \
+  container_engine_accelerators_tpu 2>/dev/null | grep -v "_pb2.py")
+if [ -n "${BAD}" ]; then
+  echo "Library code must use utils/log.py, not print():"
+  echo "${BAD}"
+  exit 1
+fi
 
-__all__ = ["ResNet", "resnet", "InceptionV3", "MnistMLP"]
+exit 0

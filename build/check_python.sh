@@ -1,0 +1,46 @@
+#!/bin/bash
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+# Python formatting/syntax gate (counterpart of the reference's
+# build/check_gofmt.sh): every first-party .py file must byte-compile,
+# use spaces (no hard tabs), and carry no trailing whitespace.
+
+cd "$(dirname "$0")/.." || exit 1
+
+if ! python3 -m compileall -q \
+    container_engine_accelerators_tpu cmd tests tools demo \
+    bench.py __graft_entry__.py; then
+  echo "Python syntax errors found (see above)."
+  exit 1
+fi
+
+BAD_TABS=$(grep -rl --include="*.py" $'\t' \
+  container_engine_accelerators_tpu cmd tests tools demo 2>/dev/null)
+if [ -n "${BAD_TABS}" ]; then
+  echo "The following files contain hard tabs:"
+  echo "${BAD_TABS}"
+  exit 1
+fi
+
+BAD_WS=$(grep -rl --include="*.py" ' $' \
+  container_engine_accelerators_tpu cmd tests tools demo 2>/dev/null)
+if [ -n "${BAD_WS}" ]; then
+  echo "The following files contain trailing whitespace:"
+  echo "${BAD_WS}"
+  exit 1
+fi
+
+exit 0
