@@ -1,0 +1,136 @@
+#!/bin/bash
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+# Simulated-TPU provisioner for minikube nodes.
+#
+# Parity role: nvidia-driver-installer/minikube/entrypoint.sh, which
+# special-cases desktop hardware so the same device-plugin stack runs
+# on a laptop. Minikube VMs have no TPU at all, so the TPU-idiomatic
+# analog is to provision the chip library's *file-backed node state*
+# (the same seam the unit tests use, native/tpuinfo/tpuinfo.h): stub
+# /dev/accel* nodes plus /run/tpu topology/health/hbm/duty state.
+# The device plugin, partitioner, health poller and metrics server
+# then run unmodified against the simulated node.
+#
+# The reference's kernel-version fixup (entrypoint.sh:35-44) maps to
+# the chip-count/topology consistency fixup below: an inconsistent
+# request is coerced to a valid torus rather than failing the node.
+set -euo pipefail
+
+TPU_SIM_CHIPS="${TPU_SIM_CHIPS:-4}"
+TPU_SIM_TOPOLOGY="${TPU_SIM_TOPOLOGY:-}"
+TPU_SIM_HBM_BYTES="${TPU_SIM_HBM_BYTES:-17179869184}" # 16 GiB (v5e-like)
+DEV_DIR="${TPU_SIM_DEV_DIR:-/dev}"
+STATE_DIR="${TPU_SIM_STATE_DIR:-/run/tpu}"
+CACHE_FILE="${STATE_DIR}/.sim_provisioned"
+
+fix_topology() {
+  # Coerce topology to match the chip count. Accepts "XxY" or
+  # "XxYxZ"; if absent or the product mismatches TPU_SIM_CHIPS, fall
+  # back to the chip library's own inference rule (1->1x1, 4->2x2,
+  # 8->2x4; otherwise 1xN).
+  local topo="${TPU_SIM_TOPOLOGY}"
+  local product=1
+  if [[ "${topo}" =~ ^([0-9]+)x([0-9]+)(x([0-9]+))?$ ]]; then
+    product=$(( BASH_REMATCH[1] * BASH_REMATCH[2] * ${BASH_REMATCH[4]:-1} ))
+  else
+    product=0
+  fi
+  if [[ "${product}" -ne "${TPU_SIM_CHIPS}" ]]; then
+    case "${TPU_SIM_CHIPS}" in
+      1) topo="1x1" ;;
+      4) topo="2x2" ;;
+      8) topo="2x4" ;;
+      *) topo="1x${TPU_SIM_CHIPS}" ;;
+    esac
+    echo "topology fixed up to ${topo} for ${TPU_SIM_CHIPS} chips"
+  fi
+  TPU_SIM_TOPOLOGY="${topo}"
+}
+
+cache_key() {
+  echo "${TPU_SIM_CHIPS} ${TPU_SIM_TOPOLOGY} ${TPU_SIM_HBM_BYTES}"
+}
+
+check_cached_provision() {
+  [[ -f "${CACHE_FILE}" ]] || return 1
+  local cached
+  cached="$(head -1 "${CACHE_FILE}")"
+  if [[ "${cached}" == "$(cache_key)" ]]; then
+    echo "simulated TPU node already provisioned (${cached})"
+    return 0
+  fi
+  echo "cached provision (${cached}) does not match request; rebuilding"
+  return 1
+}
+
+provision() {
+  mkdir -p "${STATE_DIR}"
+
+  # Chips provisioned by a previous run of this script (recorded on
+  # line 2 of the cache file). Only those are ours to delete — a node
+  # that already has real /dev/accel* must never lose them.
+  local prev_chips=0
+  if [[ -f "${CACHE_FILE}" ]]; then
+    prev_chips="$(sed -n '2p' "${CACHE_FILE}")"
+    [[ "${prev_chips}" =~ ^[0-9]+$ ]] || prev_chips=0
+  fi
+
+  # Stub chip device nodes. Regular files suffice: discovery in the
+  # plugin and in libtpuinfo is name-based (accel[0-9]+), exactly as
+  # the reference's tests fake /dev/nvidia* with plain files.
+  local i
+  for i in $(seq 0 $(( TPU_SIM_CHIPS - 1 ))); do
+    [[ -e "${DEV_DIR}/accel${i}" ]] || : > "${DEV_DIR}/accel${i}"
+    mkdir -p "${STATE_DIR}/accel${i}"
+    echo "ok" > "${STATE_DIR}/accel${i}/health"
+    echo "${TPU_SIM_HBM_BYTES} 0" > "${STATE_DIR}/accel${i}/hbm"
+    echo "0 1000000" > "${STATE_DIR}/accel${i}/duty_cycle"
+  done
+
+  # Remove stale chips we provisioned earlier and no longer want.
+  if [[ "${prev_chips}" -gt "${TPU_SIM_CHIPS}" ]]; then
+    for i in $(seq "${TPU_SIM_CHIPS}" $(( prev_chips - 1 ))); do
+      rm -f "${DEV_DIR}/accel${i}"
+      rm -rf "${STATE_DIR}/accel${i}"
+    done
+  fi
+
+  echo "${TPU_SIM_TOPOLOGY}" > "${STATE_DIR}/topology"
+  {
+    cache_key
+    echo "${TPU_SIM_CHIPS}"
+  } > "${CACHE_FILE}"
+}
+
+verify() {
+  # Same one-or-more-digit rule as the chip library's discovery
+  # (accel([0-9]+)); a bare "accel" file is not a chip.
+  local found
+  found=$(ls "${DEV_DIR}" | grep -c '^accel[0-9][0-9]*$' || true)
+  if [[ "${found}" -lt "${TPU_SIM_CHIPS}" ]]; then
+    echo "provisioning failed: found ${found} chips, want ${TPU_SIM_CHIPS}" >&2
+    exit 1
+  fi
+  echo "simulated TPU node ready: ${TPU_SIM_CHIPS} chips," \
+       "topology ${TPU_SIM_TOPOLOGY}, state in ${STATE_DIR}"
+}
+
+fix_topology
+if ! check_cached_provision; then
+  provision
+fi
+verify

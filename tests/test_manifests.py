@@ -1,0 +1,155 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Deployment-manifest sanity tests.
+
+The reference validates cluster behavior only via its demo manifests
+(SURVEY.md section 4); here every shipped YAML is at least parsed and
+the DaemonSet contracts (volumes, initContainer chains) are asserted,
+and installer entrypoints are bash-syntax-checked.
+"""
+
+import glob
+import os
+import subprocess
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_all(path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d is not None]
+
+
+def _all_yaml_paths():
+    pats = ("cmd/*.yaml", "deploy/**/*.yaml", "demo/**/*.yaml",
+            "example/*.yaml", "daemonset.yaml")
+    out = []
+    for p in pats:
+        out.extend(glob.glob(os.path.join(REPO, p), recursive=True))
+    return sorted(set(out))
+
+
+def test_inventory_nonempty():
+    paths = _all_yaml_paths()
+    assert len(paths) >= 15, paths
+
+
+@pytest.mark.parametrize("path", _all_yaml_paths(),
+                         ids=lambda p: os.path.relpath(p, REPO))
+def test_yaml_parses(path):
+    docs = _load_all(path)
+    assert docs, f"{path} contains no documents"
+    for doc in docs:
+        assert "kind" in doc and "apiVersion" in doc, path
+
+
+def _daemonset(path):
+    (doc,) = [d for d in _load_all(path) if d.get("kind") == "DaemonSet"]
+    return doc
+
+
+def test_partitioned_ds_chains_installer_then_partitioner():
+    # Parity with daemonset-nvidia-mig.yaml: driver initContainer runs
+    # before the partitioner initContainer, then a pause container.
+    ds = _daemonset(os.path.join(
+        REPO, "deploy/libtpu-installer/cos/daemonset-tpu-partitioned.yaml"))
+    spec = ds["spec"]["template"]["spec"]
+    inits = [c["name"] for c in spec["initContainers"]]
+    assert inits == ["verify-preload", "partition-tpus"]
+    assert spec["containers"][0]["name"] == "pause"
+    part = spec["initContainers"][1]
+    mounts = {m["mountPath"] for m in part["volumeMounts"]}
+    assert {"/dev", "/run/tpu", "/etc/tpu"} <= mounts
+
+
+def test_minikube_ds_provisions_sim_chips():
+    ds = _daemonset(os.path.join(
+        REPO, "deploy/libtpu-installer/minikube/daemonset.yaml"))
+    spec = ds["spec"]["template"]["spec"]
+    init = spec["initContainers"][0]
+    envs = {e["name"]: e.get("value") for e in init["env"]}
+    assert envs["TPU_SIM_CHIPS"] == "4"
+    assert envs["TPU_SIM_TOPOLOGY"] == "2x2"
+    host_paths = {v["hostPath"]["path"]
+                  for v in spec["volumes"] if "hostPath" in v}
+    assert {"/dev", "/run/tpu"} <= host_paths
+
+
+def test_pinned_ds_pins_libtpu_version():
+    ds = _daemonset(os.path.join(
+        REPO, "deploy/libtpu-installer/cos/daemonset-libtpu-pinned.yaml"))
+    init = ds["spec"]["template"]["spec"]["initContainers"][0]
+    envs = {e["name"]: e.get("value") for e in init["env"]}
+    assert envs.get("LIBTPU_VERSION")
+
+
+@pytest.mark.parametrize("script", sorted(
+    glob.glob(os.path.join(REPO, "deploy/**/*.sh"), recursive=True) +
+    glob.glob(os.path.join(REPO, "build/*.sh"))),
+    ids=lambda p: os.path.relpath(p, REPO))
+def test_shell_scripts_parse(script):
+    subprocess.run(["bash", "-n", script], check=True)
+
+
+def test_minikube_provisioner_end_to_end(tmp_path):
+    """Run the real entrypoint against temp dirs and verify it builds
+    the exact state tree the chip backends consume."""
+    dev = tmp_path / "dev"
+    state = tmp_path / "state"
+    dev.mkdir()
+    env = dict(os.environ,
+               TPU_SIM_CHIPS="4",
+               TPU_SIM_TOPOLOGY="8x8",  # inconsistent: must be fixed up
+               TPU_SIM_DEV_DIR=str(dev),
+               TPU_SIM_STATE_DIR=str(state))
+    script = os.path.join(
+        REPO, "deploy/libtpu-installer/minikube/entrypoint.sh")
+    out = subprocess.run(["bash", script], env=env, check=True,
+                         capture_output=True, text=True).stdout
+    assert "topology fixed up to 2x2" in out
+
+    from container_engine_accelerators_tpu.chip.pyfake import (
+        PyChipBackend,
+    )
+    be = PyChipBackend()
+    be.init(str(dev), str(state))
+    try:
+        assert be.chip_count() == 4
+        assert be.topology() == (2, 2, 1)
+        assert be.chip_health(0).name == "OK"
+        total, used = be.chip_hbm(0)
+        assert total == 17179869184 and used == 0
+    finally:
+        be.shutdown()
+
+    # Idempotency: second run is a cached no-op.
+    out2 = subprocess.run(["bash", script], env=env, check=True,
+                          capture_output=True, text=True).stdout
+    assert "already provisioned" in out2
+
+    # Shrink: re-provision with fewer chips removes stale ones.
+    env["TPU_SIM_CHIPS"] = "1"
+    env["TPU_SIM_TOPOLOGY"] = "1x1"
+    subprocess.run(["bash", script], env=env, check=True,
+                   capture_output=True)
+    be2 = PyChipBackend()
+    be2.init(str(dev), str(state))
+    try:
+        assert be2.chip_count() == 1
+    finally:
+        be2.shutdown()
