@@ -21,14 +21,24 @@ chips the device plugin handed to the pod, parameter/batch shardings,
 and a pjit-compiled train step whose collectives ride ICI.
 """
 
+from .context import (
+    build_context_mesh,
+    dot_product_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .mesh import MeshSpec, build_mesh, chips_from_env
 from .sharding import batch_sharding, param_shardings, replicated
 from .train import TrainState, Trainer
 
 __all__ = [
     "MeshSpec",
+    "build_context_mesh",
     "build_mesh",
     "chips_from_env",
+    "dot_product_attention",
+    "ring_attention",
+    "ulysses_attention",
     "batch_sharding",
     "param_shardings",
     "replicated",

@@ -1,0 +1,194 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Sequence/context parallelism: ring attention and Ulysses.
+
+Long-context scaling for workloads scheduled through the device
+plugin. The reference sits below the model layer and has no sequence
+parallelism (SURVEY.md section 5, "Long-context"); in the TPU-native
+stack it is a first-class workload capability because the plugin's
+topology contract (contiguous ICI boxes, plugin/envs.py) is exactly
+what makes these schedules fast:
+
+- ``ring_attention``: keys/values circulate around the context axis
+  via ``ppermute`` (one neighbor hop per step — rides each ICI link
+  once), queries stay put, and softmax is accumulated online in f32
+  so no device ever materializes the full [S, S] score matrix or the
+  full K/V sequence. Memory per chip is O(S/P); sequence length
+  scales linearly with the ring size.
+- ``ulysses_attention``: one ``all_to_all`` re-shards from
+  sequence-parallel to head-parallel, each chip computes dense
+  attention for H/P heads over the full sequence, and a second
+  ``all_to_all`` re-shards back. Two collectives total — cheaper than
+  the ring's P-1 hops when the head count divides well and S*S/P
+  scores fit in HBM.
+
+Both are exact (not approximations) and match
+``dot_product_attention`` on a single device bit-for-bit up to f32
+reduction order. Everything is shard_map + lax collectives: XLA sees
+static shapes and lowers the hops onto ICI/DCN itself.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import grid_mesh
+
+CONTEXT_AXIS = "context"
+
+_NEG = -1e9
+
+
+def build_context_mesh(context, data=None, devices=None):
+    """A ("data", "context") mesh; context-axis neighbors are adjacent
+    devices so the ring's ppermute hops are single-hop ICI."""
+    return grid_mesh(devices, data, context, CONTEXT_AXIS)
+
+
+def _mask_causal(scores, q_offset, k_offset):
+    """Apply a causal mask to [.., s_q, s_k] scores whose rows/cols
+    start at global positions q_offset/k_offset."""
+    s_q, s_k = scores.shape[-2], scores.shape[-1]
+    q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+    k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+    return jnp.where(q_pos >= k_pos, scores, _NEG)
+
+
+def dot_product_attention(q, k, v, causal=False):
+    """Dense single-device attention; the correctness reference for
+    the parallel schedules. [B, S, H, D] layout, f32 accumulation."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        scores = _mask_causal(scores, 0, 0)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _block_accumulate(q, k, v, q_offset, k_offset, m, num, den, causal):
+    """Online-softmax accumulation of one K/V block into (m, num, den).
+
+    q: [B, s, H, D] local queries (never move);
+    k/v: [B, s, H, D] the K/V block currently resident on this device;
+    offsets: global sequence positions of q[0] / k[0], for causal
+    masking across blocks.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        scores = _mask_causal(scores, q_offset, k_offset)
+
+    block_max = jnp.max(scores, axis=-1, keepdims=True)  # [B,H,q,1]
+    new_m = jnp.maximum(m, block_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m)  # [B,H,q,k]
+    num = num * correction.swapaxes(1, 2) + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    den = den * correction + jnp.sum(p, axis=-1, keepdims=True)
+    return new_m, num, den
+
+
+def ring_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
+                   causal=False):
+    """Exact attention with K/V circulating the context-axis ring.
+
+    q/k/v: [B, S, H, D], sequence-sharded over ``axis_name``. Each of
+    the P-1 hops sends the resident K/V block to the next ring
+    neighbor (ppermute) while the local queries fold the block they
+    just received into the online softmax — the blockwise schedule of
+    Liu & Abbeel's Ring Attention, built from lax primitives.
+    """
+    p_size = mesh.shape[axis_name]
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def _ring(q, k, v):
+        idx = jax.lax.axis_index(axis_name)
+        s_local = q.shape[1]
+        q_offset = idx * s_local
+        b, _, h, d = q.shape
+        m = jnp.full((b, h, s_local, 1), _NEG, jnp.float32)
+        num = jnp.zeros((b, s_local, h, d), jnp.float32)
+        den = jnp.zeros((b, h, s_local, 1), jnp.float32)
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+        def hop(t, carry):
+            k_blk, v_blk, m, num, den = carry
+            # After t forward hops the resident block originated on
+            # ring rank (idx - t) mod P.
+            k_offset = ((idx - t) % p_size) * s_local
+            m, num, den = _block_accumulate(
+                q, k_blk, v_blk, q_offset, k_offset, m, num, den,
+                causal)
+            k_blk, v_blk = jax.lax.ppermute(
+                (k_blk, v_blk), axis_name, perm)
+            return k_blk, v_blk, m, num, den
+
+        # P-1 accumulate+permute hops, then a final accumulate of the
+        # last arriving block — no P-th permute whose result nobody
+        # would read.
+        k, v, m, num, den = jax.lax.fori_loop(
+            0, p_size - 1, hop, (k, v, m, num, den))
+        k_offset = ((idx - (p_size - 1)) % p_size) * s_local
+        m, num, den = _block_accumulate(
+            q, k, v, q_offset, k_offset, m, num, den, causal)
+        return (num / den.swapaxes(1, 2)).astype(q.dtype)
+
+    return _ring(q, k, v)
+
+
+def ulysses_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
+                      causal=False):
+    """Exact attention via all-to-all head re-sharding (Ulysses).
+
+    q/k/v: [B, S, H, D], sequence-sharded over ``axis_name``; H must
+    be divisible by the axis size. One all_to_all turns the sequence
+    sharding into a head sharding (full S, H/P heads per chip), dense
+    attention runs locally, and a second all_to_all restores the
+    sequence sharding.
+    """
+    p_size = mesh.shape[axis_name]
+    if q.shape[2] % p_size != 0:
+        raise ValueError(
+            f"{q.shape[2]} heads not divisible by {axis_name} axis "
+            f"size {p_size}")
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def _ulysses(q, k, v):
+        def seq_to_heads(x):
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+        out = dot_product_attention(
+            seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+            causal=causal)
+        return heads_to_seq(out)
+
+    return _ulysses(q, k, v)

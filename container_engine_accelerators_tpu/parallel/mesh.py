@@ -68,18 +68,31 @@ def default_spec(n_devices, model_parallelism=1):
                     model=model_parallelism)
 
 
-def build_mesh(spec=None, devices=None):
-    """Build a ("data", "model") Mesh.
+def grid_mesh(devices, major, minor, minor_axis):
+    """Factor devices into a row-major (DATA_AXIS, minor_axis) grid.
 
-    devices defaults to jax.devices(). The device list is laid out
-    row-major (data-major), so neighboring model-axis entries are
-    adjacent chips under the plugin's contiguous-box allocations.
+    Shared constructor for every 2-axis mesh in the package: the
+    device list is laid out data-major, so neighboring minor-axis
+    entries (model- or context-parallel peers) are adjacent chips
+    under the plugin's contiguous-box allocations.
     """
+    devices = list(devices if devices is not None else jax.devices())
+    if major is None:
+        if len(devices) % minor != 0:
+            raise ValueError(
+                f"{len(devices)} devices do not factor into "
+                f"{minor_axis}={minor}")
+        major = len(devices) // minor
+    if major * minor != len(devices):
+        raise ValueError(
+            f"mesh spec {major}x{minor} != {len(devices)} devices")
+    grid = np.array(devices).reshape(major, minor)
+    return Mesh(grid, (DATA_AXIS, minor_axis))
+
+
+def build_mesh(spec=None, devices=None):
+    """Build a ("data", "model") Mesh."""
     devices = list(devices if devices is not None else jax.devices())
     if spec is None:
         spec = default_spec(len(devices))
-    if spec.size != len(devices):
-        raise ValueError(
-            f"mesh spec {spec.data}x{spec.model} != {len(devices)} devices")
-    grid = np.array(devices).reshape(spec.data, spec.model)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+    return grid_mesh(devices, spec.data, spec.model, MODEL_AXIS)

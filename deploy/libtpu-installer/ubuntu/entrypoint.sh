@@ -44,6 +44,7 @@ main() {
      [[ -f "${INSTALL_DIR_CONTAINER}/lib64/libtpu.so" ]]; then
     echo "libtpu ${LIBTPU_VERSION} already installed; verifying only"
     verify
+    publish_topology
     exit 0
   fi
 
@@ -72,6 +73,7 @@ main() {
   fi
 
   verify
+  publish_topology
   echo "${LIBTPU_VERSION}" > "${CACHE_FILE}"
   echo "libtpu ${LIBTPU_VERSION} installed"
 }
@@ -94,6 +96,29 @@ except OSError as e:
     sys.exit(1)
 print("libtpu dlopen OK")
 PY
+}
+
+publish_topology() {
+  # Publish the node ICI topology for the chip library (read as
+  # <state_dir>/topology, native/tpuinfo/tpuinfo.h). The downward API
+  # cannot read node labels, so the node-local source of truth is the
+  # GCE metadata server's tpu-topology instance attribute; an
+  # explicit TPU_TOPOLOGY_OVERRIDE env wins. Absent both, the chip
+  # library infers from the chip count.
+  local state_dir="${TPU_STATE_DIR:-/run/tpu}"
+  [[ -d "${state_dir}" ]] || return 0
+  local topo="${TPU_TOPOLOGY_OVERRIDE:-}"
+  if [[ -z "${topo}" ]]; then
+    topo="$(curl -sf -H 'Metadata-Flavor: Google' \
+      http://metadata.google.internal/computeMetadata/v1/instance/attributes/tpu-topology \
+      || true)"
+  fi
+  if [[ -n "${topo}" ]]; then
+    echo "${topo}" > "${state_dir}/topology"
+    echo "published node topology: ${topo}"
+  else
+    echo "no tpu-topology metadata; topology will be inferred"
+  fi
 }
 
 main "$@"

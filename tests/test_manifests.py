@@ -96,6 +96,10 @@ def test_pinned_ds_pins_libtpu_version():
     init = ds["spec"]["template"]["spec"]["initContainers"][0]
     envs = {e["name"]: e.get("value") for e in init["env"]}
     assert envs.get("LIBTPU_VERSION")
+    # The pinned path must keep the /run/tpu topology contract the
+    # sibling COS manifests establish (installer publish_topology).
+    mounts = {m["mountPath"] for m in init["volumeMounts"]}
+    assert "/run/tpu" in mounts
 
 
 @pytest.mark.parametrize("script", sorted(
