@@ -31,7 +31,8 @@ bench:
 container:
 	docker build -t $(PLUGIN_IMAGE):$(VERSION) .
 	docker build -t $(INSTALLER_IMAGE):$(VERSION) \
-		deploy/libtpu-installer/ubuntu
+		-f deploy/libtpu-installer/ubuntu/Dockerfile \
+		deploy/libtpu-installer
 
 push: container
 	docker push $(PLUGIN_IMAGE):$(VERSION)

@@ -18,12 +18,15 @@ Covers the model families the reference's demos exercise
 (SURVEY.md section 2.3): ResNet-{18,34,50,101,152} for the training
 sweep (demo/gpu-training/generate_job.sh depths {34,50,101,152} and
 demo/tpu-training/resnet-tpu.yaml), Inception-v3
-(demo/tpu-training/inception-v3-tpu.yaml), and an MNIST MLP for the
-single-chip smoke workload.
+(demo/tpu-training/inception-v3-tpu.yaml), an MNIST MLP for the
+single-chip smoke workload, and a decoder-only TransformerLM for the
+long-context / sequence-parallel workloads the TPU stack adds.
 """
 
 from .resnet import ResNet, resnet
 from .inception import InceptionV3
 from .mlp import MnistMLP
+from .transformer import TransformerLM
 
-__all__ = ["ResNet", "resnet", "InceptionV3", "MnistMLP"]
+__all__ = ["ResNet", "resnet", "InceptionV3", "MnistMLP",
+           "TransformerLM"]

@@ -12,10 +12,15 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Pallas TPU kernels backing the demo workloads."""
+"""Shared zoo adapters for the Trainer's apply contract."""
 
-from .attention import flash_attention
-from .xent import softmax_cross_entropy, mean_cross_entropy_loss
 
-__all__ = ["flash_attention", "softmax_cross_entropy",
-           "mean_cross_entropy_loss"]
+def make_stateless_apply_fn(model):
+    """(variables, inputs, train) -> (outputs, {}) for models with no
+    mutable collections (no BatchNorm state). The BN counterpart
+    lives in resnet.make_apply_fn."""
+
+    def apply_fn(variables, inputs, train):
+        return model.apply(variables, inputs, train=train), {}
+
+    return apply_fn

@@ -24,6 +24,8 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
+from .common import make_stateless_apply_fn
+
 
 class MnistMLP(nn.Module):
     hidden: int = 512
@@ -42,7 +44,4 @@ class MnistMLP(nn.Module):
             x.astype(jnp.float32))
 
 
-def make_apply_fn(model):
-    def apply_fn(variables, images, train):
-        return model.apply(variables, images, train=train), {}
-    return apply_fn
+make_apply_fn = make_stateless_apply_fn

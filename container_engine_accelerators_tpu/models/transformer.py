@@ -1,0 +1,114 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Decoder-only transformer LM — the long-context demo family.
+
+The reference's model zoo stops at CNNs because its demos predate the
+LLM era (SURVEY.md section 2.3); the TPU-native stack adds the family
+today's accelerator clusters actually run. Architecture choices are
+all TPU-motivated: bf16 compute with f32 logits, pre-norm residuals
+(stable without warmup tricks), and a pluggable attention function so
+the same module runs dense (`dot_product_attention`), single-chip
+flash (`ops.flash_attention`), or sequence-parallel
+(`parallel.context.ring_attention` bound to a mesh) without touching
+parameters — the weights are attention-schedule-agnostic.
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+from .common import make_stateless_apply_fn
+
+
+class Block(nn.Module):
+    """Pre-norm attention + MLP residual block, [B, S, E] in/out."""
+
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention_fn: Callable = flash_attention
+
+    @nn.compact
+    def __call__(self, x):
+        e = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.DenseGeneral((3, self.num_heads, e // self.num_heads),
+                              dtype=self.dtype, name="qkv")(h)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, D] each
+        attn = self.attention_fn(q, k, v, causal=True)
+        attn = attn.reshape(x.shape)
+        x = x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
+                                name="proj")(attn)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(e, dtype=self.dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    """Causal LM. Input [B, S] int32 tokens -> [B, S, V] f32 logits."""
+
+    vocab_size: int = 32000
+    embed_dim: int = 512
+    num_layers: int = 8
+    num_heads: int = 8
+    max_seq_len: int = 2048
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, train=True):
+        del train  # no dropout; signature matches the zoo contract
+        attention_fn = self.attention_fn or flash_attention
+        s = tokens.shape[1]
+        if s > self.max_seq_len:
+            # nn.Embed would silently clamp out-of-range positions —
+            # plausible logits, wrong model. Fail loudly instead.
+            raise ValueError(
+                f"sequence length {s} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     dtype=self.dtype, name="tok_embed")(tokens)
+        pos = nn.Embed(self.max_seq_len, self.embed_dim,
+                       dtype=self.dtype, name="pos_embed")(
+            jnp.arange(s, dtype=jnp.int32))
+        x = x + pos[None]
+        for i in range(self.num_layers):
+            x = Block(num_heads=self.num_heads,
+                      mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                      attention_fn=attention_fn, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        # f32 logits: the xent kernel's numerics want full precision,
+        # and the [B*S, V] matmul stays MXU-shaped either way.
+        return nn.Dense(self.vocab_size, dtype=jnp.float32,
+                        name="lm_head")(x.astype(jnp.float32))
+
+
+make_apply_fn = make_stateless_apply_fn
+
+
+def next_token_loss_fn(loss):
+    """Shift-by-one LM objective over a fused per-example loss:
+    logits [B, S, V] + tokens [B, S] -> scalar."""
+
+    def loss_fn(logits, tokens):
+        v = logits.shape[-1]
+        return loss(logits[:, :-1].reshape(-1, v),
+                    tokens[:, 1:].reshape(-1))
+
+    return loss_fn

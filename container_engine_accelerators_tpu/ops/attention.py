@@ -1,0 +1,268 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+The attention hot op for the transformer workloads: blockwise
+softmax(QK^T)V with online renormalization, so the [S, S] score
+matrix only ever exists one (BLOCK_Q, BLOCK_K) VMEM tile at a time —
+scores stream through the MXU and never touch HBM. The backward pass
+is the standard flash split: one kernel accumulates dQ over K blocks,
+one accumulates dK/dV over Q blocks, both recomputing probabilities
+from the saved logsumexp instead of storing them.
+
+Combined with parallel/context.py this composes into the long-context
+stack: ring/Ulysses shard the sequence across chips, this kernel does
+each chip's block products. Off-TPU the kernels run in interpreter
+mode so the CPU test mesh exercises identical code.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK = 128  # seq-dim tile for both Q and K loops
+_NEG = -1e9
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _positions(offset, rows, cols, axis):
+    return offset + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), axis)
+
+
+def _masked_scores(q, k, q_off, k_off, s_orig, causal, scale):
+    """(BQ, D) x (BK, D) -> masked f32 (BQ, BK) scores."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    bq, bk = s.shape
+    k_pos = _positions(k_off, bq, bk, 1)
+    mask = k_pos < s_orig  # padded key rows contribute nothing
+    if causal:
+        mask &= _positions(q_off, bq, bk, 0) >= k_pos
+    return jnp.where(mask, s, _NEG)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, s_orig,
+                scale):
+    q = q_ref[0].astype(jnp.float32)
+    iq = pl.program_id(1)
+    bq = q.shape[0]
+    n_k = k_ref.shape[1] // _BLOCK
+
+    def body(j, carry):
+        m, num, den = carry
+        k = k_ref[0, pl.ds(j * _BLOCK, _BLOCK), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * _BLOCK, _BLOCK), :].astype(jnp.float32)
+        s = _masked_scores(q, k, iq * bq, j * _BLOCK, s_orig, causal,
+                           scale)
+        block_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, block_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        num = num * corr + p @ v
+        den = den * corr + jnp.sum(p, axis=-1, keepdims=True)
+        return new_m, num, den
+
+    d = q.shape[1]
+    init = (jnp.full((bq, 1), _NEG, jnp.float32),
+            jnp.zeros((bq, d), jnp.float32),
+            jnp.zeros((bq, 1), jnp.float32))
+    # Causal: K blocks strictly after this Q block are fully masked;
+    # don't visit them (block tiles are square, so block iq needs
+    # exactly iq+1 K blocks). Dynamic bound lowers to while_loop.
+    upper = jnp.minimum(iq + 1, n_k) if causal else n_k
+    m, num, den = jax.lax.fori_loop(0, upper, body, init)
+    o_ref[0] = (num / den).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(den)).reshape(1, bq, 1)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, causal, s_orig, scale):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[...].reshape(-1, 1)
+    delta = delta_ref[...].reshape(-1, 1)
+    iq = pl.program_id(1)
+    bq = q.shape[0]
+    n_k = k_ref.shape[1] // _BLOCK
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * _BLOCK, _BLOCK), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * _BLOCK, _BLOCK), :].astype(jnp.float32)
+        s = _masked_scores(q, k, iq * bq, j * _BLOCK, s_orig, causal,
+                           scale)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + ds @ k
+
+    upper = jnp.minimum(iq + 1, n_k) if causal else n_k
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros_like(q, jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, causal, s_orig, scale):
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    jk = pl.program_id(1)
+    bk = k.shape[0]
+    n_q = q_ref.shape[1] // _BLOCK
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * _BLOCK, _BLOCK), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * _BLOCK, _BLOCK), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * _BLOCK, _BLOCK), :]
+        delta = delta_ref[0, pl.ds(i * _BLOCK, _BLOCK), :]
+        s = _masked_scores(q, k, i * _BLOCK, jk * bk, s_orig, causal,
+                           scale)
+        p = jnp.exp(s - lse)  # (BQ, BK)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    # Causal: Q blocks strictly before this K block see none of it.
+    lower = jk if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lower, n_q, body,
+        (jnp.zeros_like(k, jnp.float32), jnp.zeros_like(v, jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pad_seq(x):
+    pad = (-x.shape[1]) % _BLOCK
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _specs(sp, d):
+    block = pl.BlockSpec((1, _BLOCK, d), lambda bh, i: (bh, i, 0),
+                         memory_space=pltpu.VMEM)
+    full = pl.BlockSpec((1, sp, d), lambda bh, i: (bh, 0, 0),
+                        memory_space=pltpu.VMEM)
+    # lse/delta ride as [BH, Sp, 1] so their (1, 128, 1) blocks meet
+    # the TPU (8, 128) tiling rule on the last two dims.
+    vec_block = pl.BlockSpec((1, _BLOCK, 1), lambda bh, i: (bh, i, 0),
+                             memory_space=pltpu.VMEM)
+    vec_full = pl.BlockSpec((1, sp, 1), lambda bh, i: (bh, 0, 0),
+                            memory_space=pltpu.VMEM)
+    return block, full, vec_block, vec_full
+
+
+def _flash_fwd(q3, k3, v3, causal, s_orig):
+    """q3/k3/v3: [BH, Sp, D] padded. Returns (o3, lse)."""
+    bh, sp, d = q3.shape
+    scale = 1.0 / math.sqrt(d)
+    block, full, vec_block, _ = _specs(sp, d)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, s_orig=s_orig,
+                          scale=scale),
+        grid=(bh, sp // _BLOCK),
+        in_specs=[block, full, full],
+        out_specs=[block, vec_block],
+        out_shape=[jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig):
+    bh, sp, d = q3.shape
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [BH, Sp, 1]
+    block, full, vec_block, vec_full = _specs(sp, d)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, s_orig=s_orig,
+                          scale=scale),
+        grid=(bh, sp // _BLOCK),
+        in_specs=[block, full, full, block, vec_block, vec_block],
+        out_specs=block,
+        out_shape=jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, s_orig=s_orig,
+                          scale=scale),
+        grid=(bh, sp // _BLOCK),
+        in_specs=[full, block, block, full, vec_full, vec_full],
+        out_specs=[block, block],
+        out_shape=[jax.ShapeDtypeStruct((bh, sp, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, sp, d), v3.dtype)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+def _to3d(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _to4d(x3, b, h):
+    bh, s, d = x3.shape
+    return x3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, causal):
+    o, _ = _flash_vjp_fwd(q, k, v, causal)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal):
+    b, s, h, d = q.shape
+    q3, k3, v3 = (_pad_seq(_to3d(x)) for x in (q, k, v))
+    o3, lse = _flash_fwd(q3, k3, v3, causal, s)
+    return _to4d(o3, b, h)[:, :s], (q3, k3, v3, o3, lse, b, s, h)
+
+
+def _flash_vjp_bwd(causal, res, g):
+    q3, k3, v3, o3, lse, b, s, h = res
+    do3 = _pad_seq(_to3d(g))
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s)
+    return tuple(_to4d(x3, b, h)[:, :s] for x3 in (dq3, dk3, dv3))
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False):
+    """Exact attention, O(S) memory. q/k/v: [B, S, H, D]."""
+    if not (q.shape == k.shape == v.shape):
+        raise ValueError(
+            f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    return _flash(q, k, v, bool(causal))

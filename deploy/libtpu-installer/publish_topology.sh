@@ -1,0 +1,42 @@
+#!/bin/bash
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+# Publish the node ICI topology for the chip library (read as
+# <state_dir>/topology, native/tpuinfo/tpuinfo.h). The single shared
+# publisher for every installer variant — the downward API cannot
+# read node labels, so the node-local source of truth is the GCE
+# metadata server's tpu-topology instance attribute; an explicit
+# TPU_TOPOLOGY_OVERRIDE env wins. Absent both, the chip library
+# infers topology from the chip count.
+set -u
+
+state_dir="${TPU_STATE_DIR:-/run/tpu}"
+if [[ ! -d "${state_dir}" ]]; then
+  echo "state dir ${state_dir} not mounted; skipping topology publish"
+  exit 0
+fi
+topo="${TPU_TOPOLOGY_OVERRIDE:-}"
+if [[ -z "${topo}" ]]; then
+  topo="$(curl -sf -H 'Metadata-Flavor: Google' \
+    http://metadata.google.internal/computeMetadata/v1/instance/attributes/tpu-topology \
+    || true)"
+fi
+if [[ -n "${topo}" ]]; then
+  echo "${topo}" > "${state_dir}/topology"
+  echo "published node topology: ${topo}"
+else
+  echo "no tpu-topology metadata; topology will be inferred"
+fi

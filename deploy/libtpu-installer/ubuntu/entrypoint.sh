@@ -99,26 +99,12 @@ PY
 }
 
 publish_topology() {
-  # Publish the node ICI topology for the chip library (read as
-  # <state_dir>/topology, native/tpuinfo/tpuinfo.h). The downward API
-  # cannot read node labels, so the node-local source of truth is the
-  # GCE metadata server's tpu-topology instance attribute; an
-  # explicit TPU_TOPOLOGY_OVERRIDE env wins. Absent both, the chip
-  # library infers from the chip count.
-  local state_dir="${TPU_STATE_DIR:-/run/tpu}"
-  [[ -d "${state_dir}" ]] || return 0
-  local topo="${TPU_TOPOLOGY_OVERRIDE:-}"
-  if [[ -z "${topo}" ]]; then
-    topo="$(curl -sf -H 'Metadata-Flavor: Google' \
-      http://metadata.google.internal/computeMetadata/v1/instance/attributes/tpu-topology \
-      || true)"
-  fi
-  if [[ -n "${topo}" ]]; then
-    echo "${topo}" > "${state_dir}/topology"
-    echo "published node topology: ${topo}"
-  else
-    echo "no tpu-topology metadata; topology will be inferred"
-  fi
+  # Shared publisher shipped in the installer image; falls back to
+  # the repo-relative copy so the script also runs outside the image.
+  local script="/publish_topology.sh"
+  [[ -x "${script}" ]] || \
+    script="$(dirname "${BASH_SOURCE[0]}")/../publish_topology.sh"
+  bash "${script}"
 }
 
 main "$@"
