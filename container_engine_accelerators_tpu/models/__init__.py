@@ -26,7 +26,8 @@ long-context / sequence-parallel workloads the TPU stack adds.
 from .resnet import ResNet, resnet
 from .inception import InceptionV3
 from .mlp import MnistMLP
+from .moe import MoETransformerLM
 from .transformer import TransformerLM
 
 __all__ = ["ResNet", "resnet", "InceptionV3", "MnistMLP",
-           "TransformerLM"]
+           "MoETransformerLM", "TransformerLM"]

@@ -1,0 +1,194 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Mixture-of-Experts transformer LM — the expert-parallel family.
+
+Extends the dense TransformerLM (transformer.py) with GShard-style
+MoE MLP blocks on alternating layers. The same weights run on one
+chip (``dense_moe``) or expert-parallel over an "expert" mesh axis
+(``expert_parallel_moe``) — the routing scheme is identical, only
+the dispatch transport changes, so checkpoints are
+parallelism-agnostic exactly like the attention-schedule-agnostic
+dense model.
+
+Router aux losses are returned alongside the logits (not sown) so
+the Trainer's opaque-logits contract carries them to the loss
+without any extra plumbing: ``make_apply_fn`` yields
+``((logits, aux), {})`` and ``with_router_loss`` folds aux into any
+base LM loss.
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+from ..parallel.expert import dense_moe, expert_parallel_moe
+from .transformer import Block, CausalSelfAttention
+
+
+class MoEMlp(nn.Module):
+    """Top-k routed expert MLP, [B, S, E] in/out (+ aux loss).
+
+    With ``mesh=None`` the experts run locally (the correctness
+    reference); with a mesh that has an "expert" axis, dispatch rides
+    ``expert_parallel_moe``'s all_to_all pair.
+
+    Naming contract: when trained through parallel.Trainer, the
+    module's flax name must start with "moe" (the default auto-name
+    "MoEMlp_N" and MoEBlock's explicit name="moe" both qualify) —
+    parallel.sharding keys the expert-axis param sharding on that
+    path prefix.
+    """
+
+    num_experts: int
+    mlp_ratio: int = 4
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        f = self.mlp_ratio * d
+        gate_w = self.param(
+            "gate", nn.initializers.lecun_normal(),
+            (d, self.num_experts), jnp.float32)
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(),
+            (self.num_experts, d, f), jnp.float32)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(),
+            (self.num_experts, f, d), jnp.float32)
+        tokens = x.reshape(-1, d)
+        kwargs = dict(capacity_factor=self.capacity_factor,
+                      top_k=self.top_k)
+        if self.mesh is None:
+            out, aux = dense_moe(tokens, gate_w,
+                                 w_in.astype(self.dtype),
+                                 w_out.astype(self.dtype), **kwargs)
+        else:
+            out, aux = expert_parallel_moe(
+                self.mesh, tokens, gate_w, w_in.astype(self.dtype),
+                w_out.astype(self.dtype), **kwargs)
+        return out.reshape(x.shape), aux
+
+
+class MoEBlock(nn.Module):
+    """Pre-norm attention + routed-MLP residual block."""
+
+    num_heads: int
+    num_experts: int
+    mlp_ratio: int = 4
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    attention_fn: Callable = flash_attention
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = CausalSelfAttention(num_heads=self.num_heads,
+                                dtype=self.dtype,
+                                attention_fn=self.attention_fn,
+                                name="attn")(x)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h, aux = MoEMlp(num_experts=self.num_experts,
+                        mlp_ratio=self.mlp_ratio, top_k=self.top_k,
+                        capacity_factor=self.capacity_factor,
+                        dtype=self.dtype, mesh=self.mesh,
+                        name="moe")(h)
+        return x + h, aux
+
+
+class MoETransformerLM(nn.Module):
+    """Causal MoE LM: [B, S] tokens -> ([B, S, V] logits, aux).
+
+    Alternating dense/MoE layers (odd layers routed, GShard's
+    every-other placement); aux is the mean router load-balance loss
+    over the MoE layers.
+    """
+
+    vocab_size: int = 32000
+    embed_dim: int = 512
+    num_layers: int = 8
+    num_heads: int = 8
+    num_experts: int = 8
+    max_seq_len: int = 2048
+    mlp_ratio: int = 4
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, train=True):
+        del train
+        attention_fn = self.attention_fn or flash_attention
+        s = tokens.shape[1]
+        if s > self.max_seq_len:
+            raise ValueError(
+                f"sequence length {s} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     dtype=self.dtype, name="tok_embed")(tokens)
+        pos = nn.Embed(self.max_seq_len, self.embed_dim,
+                       dtype=self.dtype, name="pos_embed")(
+            jnp.arange(s, dtype=jnp.int32))
+        x = x + pos[None]
+        aux_losses = []
+        for i in range(self.num_layers):
+            if i % 2 == 1:
+                x, aux = MoEBlock(
+                    num_heads=self.num_heads,
+                    num_experts=self.num_experts,
+                    mlp_ratio=self.mlp_ratio, top_k=self.top_k,
+                    capacity_factor=self.capacity_factor,
+                    dtype=self.dtype, attention_fn=attention_fn,
+                    mesh=self.mesh, name=f"block{i}")(x)
+                aux_losses.append(aux)
+            else:
+                x = Block(num_heads=self.num_heads,
+                          mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                          attention_fn=attention_fn,
+                          name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
+                          name="lm_head")(x.astype(jnp.float32))
+        aux = (sum(aux_losses) / len(aux_losses) if aux_losses
+               else jnp.zeros((), jnp.float32))
+        return logits, aux
+
+
+def make_apply_fn(model):
+    """Trainer adapter: outputs are the (logits, aux) pair, opaque
+    to the Trainer, unpacked by ``with_router_loss``."""
+
+    def apply_fn(variables, inputs, train):
+        return model.apply(variables, inputs, train=train), {}
+
+    return apply_fn
+
+
+def with_router_loss(loss_fn, aux_weight=0.01):
+    """Wrap a (logits, labels) loss to add the router aux loss."""
+
+    def wrapped(outputs, labels):
+        logits, aux = outputs
+        return loss_fn(logits, labels) + aux_weight * aux
+
+    return wrapped

@@ -34,11 +34,11 @@ from ..ops.attention import flash_attention
 from .common import make_stateless_apply_fn
 
 
-class Block(nn.Module):
-    """Pre-norm attention + MLP residual block, [B, S, E] in/out."""
+class CausalSelfAttention(nn.Module):
+    """Pre-norm causal attention residual, [B, S, E] in/out — the
+    sublayer shared by the dense Block and the MoE block."""
 
     num_heads: int
-    mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     attention_fn: Callable = flash_attention
 
@@ -51,8 +51,25 @@ class Block(nn.Module):
         q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, D] each
         attn = self.attention_fn(q, k, v, causal=True)
         attn = attn.reshape(x.shape)
-        x = x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
-                                name="proj")(attn)
+        return x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
+                                   name="proj")(attn)
+
+
+class Block(nn.Module):
+    """Pre-norm attention + MLP residual block, [B, S, E] in/out."""
+
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention_fn: Callable = flash_attention
+
+    @nn.compact
+    def __call__(self, x):
+        e = x.shape[-1]
+        x = CausalSelfAttention(num_heads=self.num_heads,
+                                dtype=self.dtype,
+                                attention_fn=self.attention_fn,
+                                name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
         h = nn.gelu(h)

@@ -27,17 +27,35 @@ from .context import (
     ring_attention,
     ulysses_attention,
 )
+from .expert import (
+    build_expert_mesh,
+    dense_moe,
+    expert_parallel_moe,
+)
 from .mesh import MeshSpec, build_mesh, chips_from_env
+from .pipeline import (
+    build_pipeline_mesh,
+    pipeline_apply,
+    stack_stage_params,
+    stage_sharding,
+)
 from .sharding import batch_sharding, param_shardings, replicated
 from .train import TrainState, Trainer
 
 __all__ = [
     "MeshSpec",
     "build_context_mesh",
+    "build_expert_mesh",
     "build_mesh",
+    "build_pipeline_mesh",
     "chips_from_env",
+    "dense_moe",
     "dot_product_attention",
+    "expert_parallel_moe",
+    "pipeline_apply",
     "ring_attention",
+    "stack_stage_params",
+    "stage_sharding",
     "ulysses_attention",
     "batch_sharding",
     "param_shardings",

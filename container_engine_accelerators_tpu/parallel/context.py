@@ -107,7 +107,7 @@ def _block_accumulate(q, k, v, q_offset, k_offset, m, num, den, causal):
 
 
 def ring_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
-                   causal=False):
+                   causal=False, batch_axis=None):
     """Exact attention with K/V circulating the context-axis ring.
 
     q/k/v: [B, S, H, D], sequence-sharded over ``axis_name``. Each of
@@ -115,9 +115,13 @@ def ring_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
     neighbor (ppermute) while the local queries fold the block they
     just received into the online softmax — the blockwise schedule of
     Liu & Abbeel's Ring Attention, built from lax primitives.
+
+    ``batch_axis`` additionally shards the batch dim (compose with
+    data parallelism on a multi-axis mesh); rings then run per data
+    shard.
     """
     p_size = mesh.shape[axis_name]
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),
@@ -158,21 +162,21 @@ def ring_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
 
 
 def ulysses_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
-                      causal=False):
+                      causal=False, batch_axis=None):
     """Exact attention via all-to-all head re-sharding (Ulysses).
 
     q/k/v: [B, S, H, D], sequence-sharded over ``axis_name``; H must
     be divisible by the axis size. One all_to_all turns the sequence
     sharding into a head sharding (full S, H/P heads per chip), dense
     attention runs locally, and a second all_to_all restores the
-    sequence sharding.
+    sequence sharding. ``batch_axis`` as in ``ring_attention``.
     """
     p_size = mesh.shape[axis_name]
     if q.shape[2] % p_size != 0:
         raise ValueError(
             f"{q.shape[2]} heads not divisible by {axis_name} axis "
             f"size {p_size}")
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),

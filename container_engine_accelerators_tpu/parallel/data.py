@@ -38,23 +38,15 @@ def synthetic_batch(batch_size, image_shape, num_classes, seed=0,
     return images, labels
 
 
-class SyntheticLoader:
+class _PoolLoader:
     """Infinite loader cycling a small pool of device-resident batches.
 
     A pool > 1 keeps XLA from constant-folding the input while still
     costing zero host work per step.
     """
 
-    def __init__(self, batch_size, image_shape, num_classes,
-                 sharding=None, pool=2, dtype=np.float32):
-        self._pool = []
-        for seed in range(pool):
-            images, labels = synthetic_batch(
-                batch_size, image_shape, num_classes, seed=seed, dtype=dtype)
-            if sharding is not None:
-                images = jax.device_put(images, sharding)
-                labels = jax.device_put(labels, sharding)
-            self._pool.append((images, labels))
+    def __init__(self, batches):
+        self._pool = list(batches)
         self._i = 0
 
     def __iter__(self):
@@ -64,3 +56,37 @@ class SyntheticLoader:
         batch = self._pool[self._i % len(self._pool)]
         self._i += 1
         return batch
+
+
+class SyntheticLoader(_PoolLoader):
+    """Image-classification batches: (images, labels) pairs."""
+
+    def __init__(self, batch_size, image_shape, num_classes,
+                 sharding=None, pool=2, dtype=np.float32):
+        batches = []
+        for seed in range(pool):
+            images, labels = synthetic_batch(
+                batch_size, image_shape, num_classes, seed=seed, dtype=dtype)
+            if sharding is not None:
+                images = jax.device_put(images, sharding)
+                labels = jax.device_put(labels, sharding)
+            batches.append((images, labels))
+        super().__init__(batches)
+
+
+class SyntheticTokenLoader(_PoolLoader):
+    """LM batches: (tokens, tokens) pairs for the shift-by-one
+    next-token objective (transformer.next_token_loss_fn)."""
+
+    def __init__(self, batch_size, seq_len, vocab_size, sharding=None,
+                 pool=2):
+        batches = []
+        for seed in range(pool):
+            rng = np.random.default_rng(seed)
+            tokens = rng.integers(0, vocab_size,
+                                  size=(batch_size, seq_len),
+                                  dtype=np.int32)
+            if sharding is not None:
+                tokens = jax.device_put(tokens, sharding)
+            batches.append((tokens, tokens))
+        super().__init__(batches)

@@ -1,0 +1,197 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Expert parallelism: Mixture-of-Experts dispatch over an ICI axis.
+
+The reference has no model-level parallelism at all (SURVEY.md
+section 2.4 — its "partitioning of compute" is MIG space-sharing);
+the TPU-native stack adds MoE as a first-class workload capability
+because expert parallelism is the schedule that most directly rides
+the plugin's contiguous-ICI-box allocations: one ``all_to_all`` pair
+along the "expert" mesh axis moves token slots to expert owners and
+back, and everything else is batched einsums on the MXU.
+
+TPU-first design decisions:
+  - **Static shapes everywhere.** Routing is the GShard/Switch
+    capacity scheme: every expert receives exactly ``capacity`` token
+    slots per device group, over-capacity tokens are dropped, and
+    dispatch/combine are dense one-hot einsums — no gather/scatter,
+    no data-dependent shapes, so XLA tiles the whole layer onto the
+    MXU.
+  - **Token-local routing groups.** Each device routes its own
+    tokens (the GShard "group" = the local shard), so the router
+    needs no collective at all; only the dispatched slots travel.
+  - **Symmetric all_to_all pair.** [E, C, d] slots split the expert
+    dim and concatenate the slot dim (exactly the Ulysses head
+    re-shard pattern, context.py), so the collective cost is one
+    bidirectional ICI pass each way.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import grid_mesh
+
+EXPERT_AXIS = "expert"
+
+
+def build_expert_mesh(expert, data=None, devices=None):
+    """A ("data", "expert") mesh; expert-axis peers are adjacent
+    devices so the dispatch all_to_all is single-hop ICI."""
+    return grid_mesh(devices, data, expert, EXPERT_AXIS)
+
+
+def expert_capacity(num_tokens, num_experts, capacity_factor, top_k):
+    """Slots each expert reserves for a group of ``num_tokens``."""
+    return max(1, math.ceil(
+        top_k * num_tokens * capacity_factor / num_experts))
+
+
+def top_k_routing(gate_logits, capacity, top_k=2, normalize=True):
+    """Static-shape top-k capacity routing (GShard sec. 3.2 scheme).
+
+    gate_logits: [T, E] router scores for one token group.
+    Returns (dispatch [T, E, C], combine [T, E, C], aux) where
+    ``dispatch`` is a 0/1 slot assignment, ``combine`` carries the
+    gate weights on the same slots, and ``aux`` is the Switch
+    load-balancing loss (E * mean_e(frac_e * prob_e), =1 at uniform).
+    """
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    t, e = probs.shape
+
+    masked = probs
+    counts = jnp.zeros((e,), jnp.float32)  # slots already taken
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    chosen_mass = jnp.zeros((t,), jnp.float32)
+    assign_frac = jnp.zeros((e,), jnp.float32)
+
+    for _ in range(top_k):  # static small k — unrolled
+        idx = jnp.argmax(masked, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
+        assign_frac = assign_frac + jnp.mean(onehot, axis=0) / top_k
+        # Position of each token within its expert's slot queue:
+        # tokens earlier in the group (and earlier routing rounds)
+        # fill earlier slots.
+        pos_grid = jnp.cumsum(onehot, axis=0) - onehot + counts
+        pos = jnp.sum(pos_grid * onehot, axis=-1)  # [T]
+        keep = (pos < capacity).astype(jnp.float32)
+        w = jnp.sum(probs * onehot, axis=-1)  # [T] gate prob
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)  # [T, C]
+        contrib = onehot[:, :, None] * slot[:, None, :] \
+            * keep[:, None, None]
+        dispatch = dispatch + contrib
+        combine = combine + contrib * w[:, None, None]
+        chosen_mass = chosen_mass + w
+        counts = counts + jnp.sum(onehot * keep[:, None], axis=0)
+        masked = masked * (1.0 - onehot)  # next round: other experts
+
+    if normalize and top_k > 1:
+        combine = combine / jnp.maximum(chosen_mass, 1e-9)[:, None, None]
+
+    aux = e * jnp.sum(assign_frac * jnp.mean(probs, axis=0))
+    return dispatch, combine, aux
+
+
+def _expert_ffn(slots, w_in, w_out, activation):
+    """Batched per-expert MLP on dispatched slots [E, C, d]."""
+    h = jnp.einsum("ecd,edf->ecf", slots, w_in,
+                   preferred_element_type=jnp.float32)
+    h = activation(h).astype(slots.dtype)
+    return jnp.einsum("ecf,efd->ecd", h, w_out,
+                      preferred_element_type=jnp.float32)
+
+
+def dense_moe(tokens, gate_w, w_in, w_out, *, capacity_factor=1.25,
+              top_k=2, activation=jax.nn.gelu):
+    """Single-group MoE reference: no mesh, no collectives.
+
+    tokens [T, d], gate_w [d, E], w_in [E, d, f], w_out [E, f, d].
+    Returns (out [T, d], aux scalar). The correctness reference for
+    ``expert_parallel_moe`` (same role dot_product_attention plays
+    for the context-parallel schedules).
+    """
+    e = w_in.shape[0]
+    cap = expert_capacity(tokens.shape[0], e, capacity_factor, top_k)
+    logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    dispatch, combine, aux = top_k_routing(logits, cap, top_k=top_k)
+    slots = jnp.einsum("td,tec->ecd", tokens,
+                       dispatch.astype(tokens.dtype))
+    out = _expert_ffn(slots, w_in, w_out, activation)
+    out = jnp.einsum("ecd,tec->td", out.astype(jnp.float32), combine)
+    return out.astype(tokens.dtype), aux
+
+
+def expert_parallel_moe(mesh, tokens, gate_w, w_in, w_out, *,
+                        capacity_factor=1.25, top_k=2,
+                        axis_name=EXPERT_AXIS,
+                        activation=jax.nn.gelu, token_spec=None):
+    """MoE layer with experts sharded over ``axis_name``.
+
+    tokens: [T, d] flattened token batch, sharded over every mesh
+    axis jointly (default ``token_spec``) so each device routes a
+    distinct group; expert weights [E, ...] are sharded over the
+    expert axis (leading dim) and replicated elsewhere.
+
+    Per-shard schedule: local top-k routing -> dispatch einsum
+    [E, C, d] -> all_to_all (expert dim split, slot dim concat) ->
+    batched FFN on the E/P local experts -> reverse all_to_all ->
+    combine einsum. Matches ``dense_moe`` exactly whenever capacity
+    is not exceeded (slot positions differ, slot *sums* do not).
+
+    Returns (out [T, d], aux) with aux pmean-replicated.
+    """
+    p_size = mesh.shape[axis_name]
+    e = w_in.shape[0]
+    if e % p_size != 0:
+        raise ValueError(
+            f"{e} experts not divisible by {axis_name} axis size "
+            f"{p_size}")
+    if token_spec is None:
+        token_spec = P(tuple(mesh.axis_names))
+    w_spec = P(axis_name)
+    all_axes = tuple(mesh.axis_names)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(token_spec, P(), w_spec, w_spec),
+        out_specs=(token_spec, P()), check_vma=False)
+    def _moe(tokens, gate_w, w_in, w_out):
+        cap = expert_capacity(tokens.shape[0], e, capacity_factor,
+                              top_k)
+        logits = tokens.astype(jnp.float32) @ gate_w.astype(
+            jnp.float32)
+        dispatch, combine, aux = top_k_routing(logits, cap,
+                                               top_k=top_k)
+        slots = jnp.einsum("td,tec->ecd", tokens,
+                           dispatch.astype(tokens.dtype))
+        # [E, C, d] -> [E/P, P*C, d]: each expert owner receives its
+        # slots from every group member in one collective.
+        slots = jax.lax.all_to_all(slots, axis_name, split_axis=0,
+                                   concat_axis=1, tiled=True)
+        out = _expert_ffn(slots, w_in, w_out, activation)
+        # [E/P, P*C, d] -> [E, C, d]: slots return to their tokens.
+        out = jax.lax.all_to_all(out, axis_name, split_axis=1,
+                                 concat_axis=0, tiled=True)
+        out = jnp.einsum("ecd,tec->td", out.astype(jnp.float32),
+                         combine)
+        return out.astype(tokens.dtype), jax.lax.pmean(aux, all_axes)
+
+    return _moe(tokens, gate_w, w_in, w_out)

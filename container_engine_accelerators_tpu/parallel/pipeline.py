@@ -1,0 +1,142 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Pipeline parallelism: GPipe microbatching over an ICI axis.
+
+Stages live on consecutive devices along the "pipe" mesh axis, and
+activations advance one stage per tick via ``ppermute`` — each tick
+moves every in-flight microbatch across exactly one ICI link, so the
+steady state keeps all stages busy and every link carrying one
+activation per tick.
+
+TPU-first design decisions:
+  - The schedule is a single ``lax.scan`` over M + P - 1 ticks with
+    static shapes; XLA compiles one loop body in which the stage
+    compute and the neighbor ``ppermute`` overlap.
+  - Stage weights are a *stacked* pytree (leading stage axis, sharded
+    over the pipe axis), so "which stage am I" is data, not code —
+    every device runs the identical program, as SPMD requires.
+  - The backward schedule is not hand-written: ``jax.grad`` through
+    the scan reverses the ppermutes automatically, yielding the
+    GPipe backward pass (all-forward then all-backward) for free.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DATA_AXIS, grid_mesh
+
+PIPELINE_AXIS = "pipe"
+
+
+def build_pipeline_mesh(stages, data=None, devices=None):
+    """A ("data", "pipe") mesh; pipe-axis neighbors are adjacent
+    devices so per-tick activation hops are single-hop ICI."""
+    return grid_mesh(devices, data, stages, PIPELINE_AXIS)
+
+
+def stack_stage_params(stage_params):
+    """Stack a list of per-stage param pytrees along a new leading
+    stage axis — the layout ``pipeline_apply`` expects."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def stage_sharding(mesh, params, axis_name=PIPELINE_AXIS):
+    """NamedSharding pytree for stacked stage params: leading stage
+    axis over the pipe axis, replicated elsewhere."""
+    from jax.sharding import NamedSharding
+    shard = NamedSharding(mesh, P(axis_name))
+    return jax.tree_util.tree_map(lambda _: shard, params)
+
+
+def pipeline_apply(mesh, stage_fn, params, x, *, num_microbatches,
+                   axis_name=PIPELINE_AXIS, batch_axis=DATA_AXIS):
+    """Run ``stage_fn`` P times over the pipe axis, microbatched.
+
+    stage_fn(stage_params, x_mb) -> y_mb, same activation shape in
+    and out (stages must be shape-preserving so every device runs the
+    one compiled body; width changes belong inside a stage).
+    params: stacked stage pytree (leading axis = pipe size).
+    x: [B, ...] global batch, sharded over ``batch_axis``; B along
+    each data shard must divide into ``num_microbatches``.
+
+    Tick t: stage 0 ingests microbatch t (while t < M), every stage
+    transforms its resident activation, the result ppermutes to the
+    next stage, and stage P-1 retires microbatch t-(P-1). Output is
+    restored to the input sharding (the trailing psum broadcasts the
+    last stage's retirement buffer across the pipe axis).
+    """
+    p_size = mesh.shape[axis_name]
+    m = num_microbatches
+    n_stages = jax.tree_util.tree_leaves(params)[0].shape[0]
+    if n_stages != p_size:
+        # A divisible mismatch would otherwise silently run only
+        # every (n_stages/p_size)-th stage (each shard keeps w[0]).
+        raise ValueError(
+            f"{n_stages} stacked stages != {axis_name} axis size "
+            f"{p_size}")
+    x_spec = P(batch_axis)
+    w_spec = P(axis_name)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(w_spec, x_spec),
+        out_specs=x_spec, check_vma=False)
+    def _pipeline(params, x):
+        stage = jax.lax.axis_index(axis_name)
+        is_first = (stage == 0)
+        is_last = (stage == p_size - 1)
+        b_local = x.shape[0]
+        if b_local % m != 0:
+            raise ValueError(
+                f"local batch {b_local} not divisible into "
+                f"{m} microbatches")
+        x_mb = x.reshape((m, b_local // m) + x.shape[1:])
+        local = jax.tree_util.tree_map(lambda w: w[0], params)
+        fwd = [(i, i + 1) for i in range(p_size - 1)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            inp_t = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            inp = jnp.where(is_first, inp_t, state)
+            out = stage_fn(local, inp)
+            # Stage P-1 retires microbatch t-(P-1) once it exists.
+            ridx = jnp.clip(t - (p_size - 1), 0, m - 1)
+            valid = is_last & (t >= p_size - 1)
+            cur = jax.lax.dynamic_index_in_dim(outputs, ridx, 0,
+                                               keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, out, cur), ridx, 0)
+            # Advance: stage s's activation becomes stage s+1's input
+            # next tick; stage 0's next input comes from x_mb instead.
+            state = jax.lax.ppermute(out, axis_name, fwd)
+            return (state, outputs), None
+
+        state0 = jnp.zeros_like(x_mb[0])
+        out0 = jnp.zeros_like(x_mb)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, out0), jnp.arange(m + p_size - 1))
+        # Only the last stage holds real outputs; broadcast them so
+        # the result is pipe-replicated as out_specs promises.
+        outputs = jax.lax.psum(
+            jnp.where(is_last, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs.reshape(x.shape)
+
+    return _pipeline(params, x)

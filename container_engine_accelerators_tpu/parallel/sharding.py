@@ -21,6 +21,7 @@ resolved once per parameter pytree, no per-step Python logic.
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .expert import EXPERT_AXIS
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 # Parameters whose trailing (output-feature) dim is at least this wide
@@ -39,10 +40,21 @@ def batch_sharding(mesh):
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def _param_spec(path, value, model_parallel):
+def _param_spec(path, value, model_parallel, expert_parallel):
+    shape = getattr(value, "shape", ())
+    # Stacked per-expert kernels ([E, in, out]) shard their expert
+    # dim over EXPERT_AXIS — the layout expert_parallel_moe expects.
+    # Naming contract (documented on models.moe.MoEMlp): the routed
+    # module's path component starts with "moe" ("moe", "MoEMlp_0");
+    # a component prefix, not a substring, so unrelated names can't
+    # opt in accidentally.
+    if (expert_parallel and len(shape) >= 3
+            and shape[0] % expert_parallel == 0
+            and any(str(getattr(k, "key", k)).lower().startswith("moe")
+                    for k in path)):
+        return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
     if not model_parallel:
         return P()
-    shape = getattr(value, "shape", ())
     if len(shape) < 2:
         return P()
     # Shard the output-features dim (last axis for both conv HWIO and
@@ -57,12 +69,16 @@ def param_shardings(mesh, params):
 
     With a 1-wide model axis everything is replicated (pure DP); with
     model parallelism, wide kernels are sharded column-wise over
-    MODEL_AXIS. XLA inserts the matching all-gathers/reduce-scatters.
+    MODEL_AXIS; on meshes with an expert axis, stacked MoE expert
+    kernels shard their leading expert dim over EXPERT_AXIS. XLA
+    inserts the matching all-gathers/reduce-scatters.
     """
-    model_parallel = mesh.shape[MODEL_AXIS]
+    model_parallel = dict(mesh.shape).get(MODEL_AXIS, 1)
     mp = model_parallel if model_parallel > 1 else 0
+    expert_parallel = dict(mesh.shape).get(EXPERT_AXIS, 1)
+    ep = expert_parallel if expert_parallel > 1 else 0
 
     def to_sharding(path, value):
-        return NamedSharding(mesh, _param_spec(path, value, mp))
+        return NamedSharding(mesh, _param_spec(path, value, mp, ep))
 
     return jax.tree_util.tree_map_with_path(to_sharding, params)

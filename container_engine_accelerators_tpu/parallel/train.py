@@ -163,9 +163,11 @@ class Trainer:
             logits, _ = apply(variables, images, False)
             return logits
 
+        # No out_shardings: model outputs may be pytrees with scalar
+        # leaves (e.g. the MoE (logits, aux) pair), which a broadcast
+        # batch sharding would reject.
         b_shard = batch_sharding(self.mesh)
-        return jax.jit(step_fn, in_shardings=(None, b_shard),
-                       out_shardings=b_shard)
+        return jax.jit(step_fn, in_shardings=(None, b_shard))
 
 
 def cross_entropy_loss(logits, labels, label_smoothing=0.0):

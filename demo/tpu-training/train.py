@@ -39,33 +39,67 @@ REPO_ROOT = os.path.dirname(
 sys.path.insert(0, REPO_ROOT)
 
 import jax
+
+# Honor an explicit JAX_PLATFORMS from the pod spec: some runtimes
+# (e.g. the axon sitecustomize) pin jax.config to a remote TPU
+# platform after import, which must not override operator intent.
+if os.environ.get("JAX_PLATFORMS"):
+    if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import optax
 
 from container_engine_accelerators_tpu.models import (
     InceptionV3,
     MnistMLP,
+    MoETransformerLM,
+    TransformerLM,
     resnet,
 )
 from container_engine_accelerators_tpu.models import inception as inception_mod
 from container_engine_accelerators_tpu.models import mlp as mlp_mod
+from container_engine_accelerators_tpu.models import moe as moe_mod
 from container_engine_accelerators_tpu.models import resnet as resnet_mod
+from container_engine_accelerators_tpu.models.transformer import (
+    next_token_loss_fn,
+)
+from container_engine_accelerators_tpu.models import transformer as \
+    transformer_mod
 from container_engine_accelerators_tpu.ops import mean_cross_entropy_loss
 from container_engine_accelerators_tpu.parallel import (
     Trainer,
     batch_sharding,
+    build_expert_mesh,
     build_mesh,
 )
-from container_engine_accelerators_tpu.parallel.data import SyntheticLoader
+from container_engine_accelerators_tpu.parallel.data import (
+    SyntheticLoader,
+    SyntheticTokenLoader,
+)
 from container_engine_accelerators_tpu.parallel.mesh import default_spec
+
+LM_MODELS = ("transformer", "moe")
 
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="TPU demo training job")
-    p.add_argument("--model", choices=["mnist", "resnet", "inception"],
+    p.add_argument("--model",
+                   choices=["mnist", "resnet", "inception",
+                            "transformer", "moe"],
                    default="resnet")
     p.add_argument("--depth", type=int, default=50,
                    help="ResNet depth (18/34/50/101/152)")
+    p.add_argument("--seq-len", type=int, default=512,
+                   help="LM sequence length")
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--embed-dim", type=int, default=512)
+    p.add_argument("--num-layers", type=int, default=8)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--num-experts", type=int, default=8,
+                   help="MoE expert count")
+    p.add_argument("--expert-parallelism", type=int, default=1,
+                   help="size of the expert mesh axis (moe model)")
     p.add_argument("--batch-size", type=int, default=256,
                    help="global batch size")
     p.add_argument("--lr", type=float, default=0.1)
@@ -134,6 +168,33 @@ def restore_checkpoint(model_dir, state):
                       batch_stats=restored["batch_stats"])
 
 
+def build_lm(args, mesh):
+    """LM families: (model, apply_fn, loss_fn). The moe model binds
+    the mesh so expert dispatch rides the expert axis."""
+    base_loss = next_token_loss_fn(
+        mean_cross_entropy_loss if args.pallas_loss
+        else _dense_lm_loss)
+    common = dict(vocab_size=args.vocab_size, embed_dim=args.embed_dim,
+                  num_layers=args.num_layers, num_heads=args.num_heads,
+                  max_seq_len=args.seq_len)
+    if args.model == "moe":
+        model = MoETransformerLM(
+            num_experts=args.num_experts,
+            mesh=mesh if args.expert_parallelism > 1 else None,
+            **common)
+        return (model, moe_mod.make_apply_fn(model),
+                moe_mod.with_router_loss(base_loss))
+    model = TransformerLM(**common)
+    return model, transformer_mod.make_apply_fn(model), base_loss
+
+
+def _dense_lm_loss(logits, labels):
+    from container_engine_accelerators_tpu.parallel.train import (
+        cross_entropy_loss,
+    )
+    return cross_entropy_loss(logits, labels)
+
+
 def build_model(args):
     if args.model == "mnist":
         model = MnistMLP()
@@ -150,16 +211,36 @@ def build_model(args):
 def main(argv=None):
     args = parse_args(argv)
     devices = jax.devices()
-    mesh = build_mesh(default_spec(len(devices), args.model_parallelism))
-    model, apply_fn, image_shape, num_classes = build_model(args)
-
-    if args.pallas_loss and args.model != "inception":
-        loss_fn = mean_cross_entropy_loss
+    if args.model == "moe" and args.expert_parallelism > 1:
+        if args.model_parallelism > 1:
+            raise SystemExit(
+                "--model-parallelism cannot combine with "
+                "--expert-parallelism: the expert mesh has no "
+                "'model' axis")
+        mesh = build_expert_mesh(expert=args.expert_parallelism)
     else:
-        from container_engine_accelerators_tpu.parallel.train import (
-            cross_entropy_loss,
-        )
-        loss_fn = cross_entropy_loss
+        mesh = build_mesh(default_spec(len(devices),
+                                       args.model_parallelism))
+
+    if args.model in LM_MODELS:
+        model, apply_fn, loss_fn = build_lm(args, mesh)
+        init_batch = jnp.zeros((1, args.seq_len), jnp.int32)
+        loader = SyntheticTokenLoader(
+            args.batch_size, args.seq_len, args.vocab_size,
+            sharding=batch_sharding(mesh), pool=2)
+    else:
+        model, apply_fn, image_shape, num_classes = build_model(args)
+        if args.pallas_loss and args.model != "inception":
+            loss_fn = mean_cross_entropy_loss
+        else:
+            from container_engine_accelerators_tpu.parallel.train import (
+                cross_entropy_loss,
+            )
+            loss_fn = cross_entropy_loss
+        init_batch = jnp.zeros((1, *image_shape), jnp.float32)
+        loader = SyntheticLoader(args.batch_size, image_shape,
+                                 num_classes,
+                                 sharding=batch_sharding(mesh), pool=2)
 
     tx = optax.chain(
         optax.add_decayed_weights(args.weight_decay),
@@ -167,7 +248,6 @@ def main(argv=None):
     )
     trainer = Trainer(apply_fn, loss_fn, tx, mesh=mesh, remat=args.remat)
 
-    init_batch = jnp.zeros((1, *image_shape), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), init_batch, train=False)
     state = trainer.init_state(variables)
     if args.model_dir:
@@ -178,9 +258,6 @@ def main(argv=None):
         else:
             state = jax.device_put(restore_checkpoint(args.model_dir, state),
                                    trainer.state_shardings(state))
-
-    loader = SyntheticLoader(args.batch_size, image_shape, num_classes,
-                             sharding=batch_sharding(mesh), pool=2)
 
     losses = []
     warmup = max(args.warmup_steps, 0)
@@ -214,6 +291,9 @@ def main(argv=None):
         "images_per_sec_per_chip": round(images_per_sec / len(devices), 2),
         "final_loss": losses[-1] if losses else None,
     }
+    if args.model in LM_MODELS:
+        result["tokens_per_sec"] = round(
+            images_per_sec * args.seq_len, 2)
     if args.model_dir:
         save_checkpoint(args.model_dir, state)
     print(json.dumps(result))

@@ -150,3 +150,21 @@ def test_train_driver_checkpoint_resume(tmp_path):
     # Second run resumes from step 3 and checkpoints at step 6.
     mod.main(args)
     assert any(n == "checkpoint_6" for n in os.listdir(tmp_path))
+
+
+def test_train_driver_moe_expert_parallel():
+    """The LM demo path end-to-end: MoE model, expert mesh axis,
+    router loss, token loader — through the same CLI surface the
+    K8s job manifests invoke."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "demo_train_moe", "demo/tpu-training/train.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    result = mod.main([
+        "--model", "moe", "--seq-len", "32", "--vocab-size", "64",
+        "--embed-dim", "32", "--num-layers", "2", "--num-heads", "4",
+        "--num-experts", "4", "--expert-parallelism", "4",
+        "--batch-size", "8", "--steps", "3", "--warmup-steps", "1"])
+    assert result["final_loss"] is not None
+    assert result["tokens_per_sec"] > 0
