@@ -5,6 +5,7 @@
 REGISTRY ?= gcr.io/gke-release
 PLUGIN_IMAGE ?= $(REGISTRY)/tpu-device-plugin
 INSTALLER_IMAGE ?= $(REGISTRY)/libtpu-installer
+PARTITIONER_IMAGE ?= $(REGISTRY)/tpu-partitioner
 VERSION ?= v0.1.0
 
 all: native
@@ -34,12 +35,18 @@ container:
 		-f deploy/libtpu-installer/ubuntu/Dockerfile \
 		deploy/libtpu-installer
 
-push: container
+partition-tpu:
+	docker build -t $(PARTITIONER_IMAGE):$(VERSION) \
+		-f deploy/partition-tpu/Dockerfile .
+
+push: container partition-tpu
 	docker push $(PLUGIN_IMAGE):$(VERSION)
 	docker push $(INSTALLER_IMAGE):$(VERSION)
+	docker push $(PARTITIONER_IMAGE):$(VERSION)
 
 clean:
 	$(MAKE) -C native/tpuinfo clean
 	$(MAKE) -C demo/tpu-error clean
 
-.PHONY: all native test test-native presubmit bench container push clean
+.PHONY: all native test test-native presubmit bench container \
+	partition-tpu push clean

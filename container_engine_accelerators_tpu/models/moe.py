@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import flash_attention
 from ..parallel.expert import dense_moe, expert_parallel_moe
+from .common import make_stateless_apply_fn
 from .transformer import Block, CausalSelfAttention
 
 
@@ -47,10 +48,9 @@ class MoEMlp(nn.Module):
     ``expert_parallel_moe``'s all_to_all pair.
 
     Naming contract: when trained through parallel.Trainer, the
-    module's flax name must start with "moe" (the default auto-name
-    "MoEMlp_N" and MoEBlock's explicit name="moe" both qualify) —
-    parallel.sharding keys the expert-axis param sharding on that
-    path prefix.
+    module's flax name must be "moe" or the default auto-name
+    "MoEMlp_N" (MoEBlock uses name="moe") — parallel.sharding keys
+    the expert-axis param sharding on exactly that path component.
     """
 
     num_experts: int
@@ -174,14 +174,10 @@ class MoETransformerLM(nn.Module):
         return logits, aux
 
 
-def make_apply_fn(model):
-    """Trainer adapter: outputs are the (logits, aux) pair, opaque
-    to the Trainer, unpacked by ``with_router_loss``."""
-
-    def apply_fn(variables, inputs, train):
-        return model.apply(variables, inputs, train=train), {}
-
-    return apply_fn
+# Trainer adapter: the model's (logits, aux) output pair rides the
+# shared stateless contract opaquely and is unpacked by
+# ``with_router_loss``.
+make_apply_fn = make_stateless_apply_fn
 
 
 def with_router_loss(loss_fn, aux_weight=0.01):

@@ -45,12 +45,15 @@ def _param_spec(path, value, model_parallel, expert_parallel):
     # Stacked per-expert kernels ([E, in, out]) shard their expert
     # dim over EXPERT_AXIS — the layout expert_parallel_moe expects.
     # Naming contract (documented on models.moe.MoEMlp): the routed
-    # module's path component starts with "moe" ("moe", "MoEMlp_0");
-    # a component prefix, not a substring, so unrelated names can't
-    # opt in accidentally.
+    # MLP module itself is named "moe" or auto-named "MoEMlp_N".
+    # Matching that exact component (not a prefix of enclosing
+    # blocks like "MoEBlock_N") keeps attention/norm params inside
+    # MoE blocks replicated as the attention shard_map expects.
     if (expert_parallel and len(shape) >= 3
             and shape[0] % expert_parallel == 0
-            and any(str(getattr(k, "key", k)).lower().startswith("moe")
+            and any(str(getattr(k, "key", k)).lower() == "moe"
+                    or str(getattr(k, "key", k)).lower().startswith(
+                        "moemlp")
                     for k in path)):
         return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
     if not model_parallel:

@@ -120,6 +120,9 @@ def parse_args(argv=None):
     p.add_argument("--model-dir", default=os.environ.get("MODEL_DIR", ""),
                    help="checkpoint directory (local path; like the "
                         "reference's --model_dir)")
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax.profiler trace (TensorBoard "
+                        "format) covering the timed steps")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="also checkpoint every N steps (0 = end only)")
     return p.parse_args(argv)
@@ -261,12 +264,21 @@ def main(argv=None):
 
     losses = []
     warmup = max(args.warmup_steps, 0)
-    t_start = time.perf_counter() if warmup == 0 else None
+    profiling = False
+
+    def start_timed_region():
+        nonlocal profiling
+        if args.profile_dir:
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
+        return time.perf_counter()
+
+    t_start = start_timed_region() if warmup == 0 else None
     for step, batch in zip(range(args.steps), loader):
         state, loss = trainer.train_step(state, batch)
         if t_start is None and step == warmup - 1:
             jax.block_until_ready(loss)
-            t_start = time.perf_counter()
+            t_start = start_timed_region()
         if step % 20 == 0 or step == args.steps - 1:
             losses.append(float(loss))
             print(f"step {step} loss {float(loss):.4f}", file=sys.stderr)
@@ -274,6 +286,10 @@ def main(argv=None):
                 and (step + 1) % args.checkpoint_every == 0):
             save_checkpoint(args.model_dir, state)
     jax.block_until_ready(state.params)
+    if profiling:
+        jax.profiler.stop_trace()
+        print(f"wrote profiler trace to {args.profile_dir}",
+              file=sys.stderr)
     timed_steps = max(args.steps - warmup, 0)
     if t_start is None or timed_steps == 0:
         images_per_sec = 0.0
