@@ -1,0 +1,109 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Autoregressive decoding for the LM families (KV cache).
+
+TPU-first design: the entire generation — prompt prefill and new
+tokens alike — is ONE ``lax.scan`` over single-token steps against a
+preallocated KV cache (transformer.CausalSelfAttention decode mode).
+Static shapes everywhere: the cache is sized once for
+prompt + max_new_tokens, each step is a fixed [B, 1] program, and the
+prompt/generated boundary is data (a ``jnp.where`` on the step
+index), not control flow — so XLA compiles exactly one program per
+(batch, length) shape, reused across all requests.
+
+Works for both TransformerLM and MoETransformerLM (the (logits, aux)
+pair is unwrapped); MoE decode uses the dense dispatch path
+(mesh=None) since a 1-token-per-example step has no expert-axis
+batch to shard.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(model, batch, length):
+    """Size the KV cache: a decode-mode init at full length creates
+    per-layer [B, length, H, D] cache buffers plus step counters."""
+    decode_model = model.clone(decode=True)
+    variables = decode_model.init(
+        jax.random.PRNGKey(0), jnp.zeros((batch, length), jnp.int32),
+        train=False)
+    return decode_model, variables["cache"]
+
+
+def _logits_of(outputs):
+    # MoE models return (logits, aux); dense models return logits.
+    return outputs[0] if isinstance(outputs, tuple) else outputs
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("model", "max_new_tokens",
+                                    "sample"))
+def _decode_impl(model, params, prompt, max_new_tokens, temperature,
+                 rng, *, sample):
+    b, p_len = prompt.shape
+    total = p_len + max_new_tokens
+    decode_model, cache = init_cache(model, b, total)
+    padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+
+    def step(carry, t):
+        cache, tok, rng = carry
+        outputs, updated = decode_model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            train=False, mutable=["cache"])
+        logits = _logits_of(outputs)[:, 0]  # [B, V]
+        if sample:
+            rng, sub = jax.random.split(rng)
+            sampled = jax.random.categorical(
+                sub, logits / temperature, axis=-1)
+        else:
+            sampled = jnp.argmax(logits, axis=-1)
+        sampled = sampled.astype(prompt.dtype)
+        # While still inside the prompt, the model's prediction is
+        # discarded and the actual prompt token is fed (prefill).
+        forced = jax.lax.dynamic_index_in_dim(
+            padded, jnp.minimum(t + 1, total - 1), 1, keepdims=False)
+        nxt = jnp.where(t + 1 < p_len, forced, sampled)
+        return (updated["cache"], nxt, rng), nxt
+
+    (_, _, _), produced = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1))
+    # produced[t] is the token at position t+1.
+    return jnp.concatenate([prompt[:, :1], produced.T], axis=1)
+
+
+def decode(model, params, prompt, max_new_tokens, *,
+           temperature=0.0, rng=None):
+    """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
+
+    temperature == 0 is greedy argmax; > 0 samples from
+    softmax(logits / temperature) using ``rng``. Returns the full
+    [B, P + max_new_tokens] sequence (prompt included). Only the
+    greedy/sampling *mode* is compiled in; the temperature itself is
+    a traced scalar, so serving arbitrary client temperatures reuses
+    one compiled program per shape.
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _decode_impl(model, params, prompt, max_new_tokens,
+                        jnp.asarray(temperature, jnp.float32), rng,
+                        sample=temperature > 0.0)
+
+
+def greedy_decode(model, params, prompt, max_new_tokens):
+    """Greedy generation (temperature 0)."""
+    return decode(model, params, prompt, max_new_tokens)

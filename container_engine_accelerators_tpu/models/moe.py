@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from ..ops.attention import flash_attention
 from ..parallel.expert import dense_moe, expert_parallel_moe
 from .common import make_stateless_apply_fn
-from .transformer import Block, CausalSelfAttention
+from .transformer import Block, CausalSelfAttention, cached_positions
 
 
 class MoEMlp(nn.Module):
@@ -98,12 +98,14 @@ class MoEBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Callable = flash_attention
     mesh: Any = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
         x = CausalSelfAttention(num_heads=self.num_heads,
                                 dtype=self.dtype,
                                 attention_fn=self.attention_fn,
+                                decode=self.decode,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -134,6 +136,7 @@ class MoETransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
     mesh: Any = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -146,9 +149,9 @@ class MoETransformerLM(nn.Module):
                 f"{self.max_seq_len}")
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype, name="tok_embed")(tokens)
+        pos = cached_positions(self, s, self.decode)
         pos = nn.Embed(self.max_seq_len, self.embed_dim,
-                       dtype=self.dtype, name="pos_embed")(
-            jnp.arange(s, dtype=jnp.int32))
+                       dtype=self.dtype, name="pos_embed")(pos)
         x = x + pos[None]
         aux_losses = []
         for i in range(self.num_layers):
@@ -159,12 +162,14 @@ class MoETransformerLM(nn.Module):
                     mlp_ratio=self.mlp_ratio, top_k=self.top_k,
                     capacity_factor=self.capacity_factor,
                     dtype=self.dtype, attention_fn=attention_fn,
-                    mesh=self.mesh, name=f"block{i}")(x)
+                    mesh=self.mesh, decode=self.decode,
+                    name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
                 x = Block(num_heads=self.num_heads,
                           mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                           attention_fn=attention_fn,
+                          decode=self.decode,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -28,19 +28,45 @@ parameters — the weights are attention-schedule-agnostic.
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..ops.attention import flash_attention
 from .common import make_stateless_apply_fn
 
 
+def cached_positions(module, s, decode):
+    """Position ids for a pos embed: arange normally; in decode mode,
+    offset by a step counter kept in ``module``'s cache collection
+    (shared by the dense and MoE LMs)."""
+    if not decode:
+        return jnp.arange(s, dtype=jnp.int32)
+    is_init = not module.has_variable("cache", "pos_index")
+    index = module.variable("cache", "pos_index",
+                            lambda: jnp.zeros((), jnp.int32))
+    if is_init:
+        return jnp.arange(s, dtype=jnp.int32)
+    pos = index.value + jnp.arange(s, dtype=jnp.int32)
+    index.value = index.value + s
+    return pos
+
+
 class CausalSelfAttention(nn.Module):
     """Pre-norm causal attention residual, [B, S, E] in/out — the
-    sublayer shared by the dense Block and the MoE block."""
+    sublayer shared by the dense Block and the MoE block.
+
+    With ``decode=True`` the module keeps a KV cache in the "cache"
+    variable collection (flax decode idiom): init with the
+    full-length sequence sizes the cache, then each apply consumes
+    one token, writes its K/V at the cache index, and attends over
+    the prefix — static shapes throughout, so the whole decode loop
+    compiles to one XLA program (models/decode.py drives it).
+    """
 
     num_heads: int
     dtype: Any = jnp.bfloat16
     attention_fn: Callable = flash_attention
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -49,10 +75,55 @@ class CausalSelfAttention(nn.Module):
         qkv = nn.DenseGeneral((3, self.num_heads, e // self.num_heads),
                               dtype=self.dtype, name="qkv")(h)
         q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, D] each
-        attn = self.attention_fn(q, k, v, causal=True)
+        if self.decode:
+            attn = self._cached_attention(q, k, v)
+        else:
+            attn = self.attention_fn(q, k, v, causal=True)
         attn = attn.reshape(x.shape)
         return x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
                                    name="proj")(attn)
+
+    def _cached_attention(self, q, k, v):
+        """One-token decode step against the KV cache.
+
+        At cache-init time (first call, full-length input) this just
+        sizes the cache and runs dense causal attention; afterwards
+        the input is [B, 1, H, D] and attention runs q against the
+        cached prefix with a <= cache-index mask.
+        """
+        from ..parallel.context import dot_product_attention
+
+        is_init = not self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 k.shape, k.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 v.shape, v.dtype)
+        index = self.variable("cache", "cache_index",
+                              lambda: jnp.zeros((), jnp.int32))
+        if is_init:
+            return dot_product_attention(q, k, v, causal=True)
+
+        i = index.value
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cached_k.value.dtype),
+            (0, i, 0, 0))
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cached_v.value.dtype),
+            (0, i, 0, 0))
+        index.value = i + q.shape[1]
+
+        d = q.shape[-1]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, cached_k.value,
+            preferred_element_type=jnp.float32) / jnp.sqrt(
+                jnp.asarray(d, jnp.float32))
+        k_pos = jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, dimension=3)
+        scores = jnp.where(k_pos <= i, scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd",
+                          probs.astype(cached_v.value.dtype),
+                          cached_v.value)
 
 
 class Block(nn.Module):
@@ -62,6 +133,7 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     attention_fn: Callable = flash_attention
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -69,6 +141,7 @@ class Block(nn.Module):
         x = CausalSelfAttention(num_heads=self.num_heads,
                                 dtype=self.dtype,
                                 attention_fn=self.attention_fn,
+                                decode=self.decode,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
@@ -87,6 +160,7 @@ class TransformerLM(nn.Module):
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -101,14 +175,15 @@ class TransformerLM(nn.Module):
                 f"{self.max_seq_len}")
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype, name="tok_embed")(tokens)
+        pos = cached_positions(self, s, self.decode)
         pos = nn.Embed(self.max_seq_len, self.embed_dim,
-                       dtype=self.dtype, name="pos_embed")(
-            jnp.arange(s, dtype=jnp.int32))
+                       dtype=self.dtype, name="pos_embed")(pos)
         x = x + pos[None]
         for i in range(self.num_layers):
             x = Block(num_heads=self.num_heads,
                       mlp_ratio=self.mlp_ratio, dtype=self.dtype,
-                      attention_fn=attention_fn, name=f"block{i}")(x)
+                      attention_fn=attention_fn, decode=self.decode,
+                      name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,
         # and the [B*S, V] matmul stays MXU-shaped either way.

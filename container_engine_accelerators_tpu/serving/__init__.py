@@ -14,6 +14,6 @@
 
 """JAX inference serving stack (TF-Serving demo analog)."""
 
-from .server import InferenceServer
+from .server import GenerationServer, InferenceServer
 
-__all__ = ["InferenceServer"]
+__all__ = ["GenerationServer", "InferenceServer"]

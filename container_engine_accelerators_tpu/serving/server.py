@@ -99,39 +99,15 @@ class _Batcher:
                     done.put(("error", str(e)))
 
 
-class InferenceServer:
-    """HTTP server around one jitted model apply."""
+class _BaseServer:
+    """HTTP scaffolding shared by the predict and generate servers:
+    /healthz, /stats, latency bookkeeping, and one POST route."""
 
-    def __init__(self, model_name, apply_fn, variables, input_shape,
-                 port=8500, max_batch=8, max_wait_ms=5):
+    def __init__(self, model_name, port):
         self._name = model_name
-        self._input_shape = tuple(input_shape)
-        self._max_batch = max_batch
         self._requests = 0
         self._latencies = []
         self._stats_lock = threading.Lock()
-
-        @jax.jit
-        def predict(images):
-            logits, _ = apply_fn(variables, images, False)
-            probs = jax.nn.softmax(logits, axis=-1)
-            return jnp.argmax(logits, axis=-1), jnp.max(probs, axis=-1)
-
-        def run_batch(instances):
-            n = instances.shape[0]
-            padded = np.zeros((max_batch, *self._input_shape),
-                              dtype=np.float32)
-            padded[:n] = instances
-            classes, scores = predict(padded)
-            classes = np.asarray(classes)[:n]
-            scores = np.asarray(scores)[:n]
-            return [{"class": int(c), "score": float(s)}
-                    for c, s in zip(classes, scores)]
-
-        self._batcher = _Batcher(run_batch, max_batch, max_wait_ms)
-        # Warm the compile cache before accepting traffic.
-        run_batch(np.zeros((1, *self._input_shape), dtype=np.float32))
-
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -156,40 +132,35 @@ class InferenceServer:
                     self._reply(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != f"/v1/models/{server._name}:predict":
+                if self.path != server._post_path():
                     self._reply(404, {"error": "unknown model"})
                     return
                 t0 = time.perf_counter()
                 try:
-                    length = int(self.headers.get("Content-Length", "0"))
+                    length = int(self.headers.get("Content-Length",
+                                                  "0"))
                     payload = json.loads(self.rfile.read(length))
-                    instances = payload["instances"]
-                except (ValueError, KeyError, TypeError) as e:
+                except (ValueError, TypeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
-                arrays = []
-                for inst in instances:
-                    arr = np.asarray(inst, dtype=np.float32)
-                    if arr.shape != server._input_shape:
-                        self._reply(400, {
-                            "error": f"instance shape {arr.shape} != "
-                                     f"{server._input_shape}"})
-                        return
-                    arrays.append(arr)
-                # Enqueue every instance before waiting on any result
-                # so one request's instances share micro-batches.
-                pending = [server._batcher.submit_async(a) for a in arrays]
-                predictions = []
-                for done in pending:
-                    status, out = done.get()
-                    if status != "ok":
-                        self._reply(500, {"error": out})
-                        return
-                    predictions.append(out)
-                server._record(time.perf_counter() - t0)
-                self._reply(200, {"predictions": predictions})
+                try:
+                    code, resp = server._handle_post(payload)
+                except (KeyError, TypeError, ValueError) as e:
+                    code, resp = 400, {"error": f"bad request: {e}"}
+                except Exception as e:  # model/runtime failure
+                    log.exception("POST handler failed")
+                    code, resp = 500, {"error": str(e)}
+                if code == 200:
+                    server._record(time.perf_counter() - t0)
+                self._reply(code, resp)
 
         self._httpd = ThreadingHTTPServer(("", port), Handler)
+
+    def _post_path(self):
+        raise NotImplementedError
+
+    def _handle_post(self, payload):
+        raise NotImplementedError
 
     @property
     def port(self):
@@ -209,7 +180,8 @@ class InferenceServer:
             return {
                 "requests": self._requests,
                 "p50_ms": round(lat[n // 2] * 1000, 3) if n else None,
-                "p99_ms": round(lat[int(n * 0.99)] * 1000, 3) if n else None,
+                "p99_ms": round(lat[int(n * 0.99)] * 1000, 3)
+                if n else None,
             }
 
     def serve_forever(self):
@@ -222,4 +194,121 @@ class InferenceServer:
 
     def stop(self):
         self._httpd.shutdown()
+
+
+class InferenceServer(_BaseServer):
+    """HTTP server around one jitted model apply."""
+
+    def __init__(self, model_name, apply_fn, variables, input_shape,
+                 port=8500, max_batch=8, max_wait_ms=5):
+        super().__init__(model_name, port)
+        self._input_shape = tuple(input_shape)
+        self._max_batch = max_batch
+
+        @jax.jit
+        def predict(images):
+            logits, _ = apply_fn(variables, images, False)
+            probs = jax.nn.softmax(logits, axis=-1)
+            return jnp.argmax(logits, axis=-1), jnp.max(probs, axis=-1)
+
+        def run_batch(instances):
+            n = instances.shape[0]
+            padded = np.zeros((max_batch, *self._input_shape),
+                              dtype=np.float32)
+            padded[:n] = instances
+            classes, scores = predict(padded)
+            classes = np.asarray(classes)[:n]
+            scores = np.asarray(scores)[:n]
+            return [{"class": int(c), "score": float(s)}
+                    for c, s in zip(classes, scores)]
+
+        self._batcher = _Batcher(run_batch, max_batch, max_wait_ms)
+        # Warm the compile cache before accepting traffic.
+        run_batch(np.zeros((1, *self._input_shape), dtype=np.float32))
+
+    def _post_path(self):
+        return f"/v1/models/{self._name}:predict"
+
+    def _handle_post(self, payload):
+        try:
+            instances = payload["instances"]
+        except (KeyError, TypeError) as e:
+            return 400, {"error": f"bad request: {e}"}
+        arrays = []
+        for inst in instances:
+            arr = np.asarray(inst, dtype=np.float32)
+            if arr.shape != self._input_shape:
+                return 400, {
+                    "error": f"instance shape {arr.shape} != "
+                             f"{self._input_shape}"}
+            arrays.append(arr)
+        # Enqueue every instance before waiting on any result so one
+        # request's instances share micro-batches.
+        pending = [self._batcher.submit_async(a) for a in arrays]
+        predictions = []
+        for done in pending:
+            status, out = done.get()
+            if status != "ok":
+                return 500, {"error": out}
+            predictions.append(out)
+        return 200, {"predictions": predictions}
+
+    def stop(self):
+        super().stop()
         self._batcher.stop()
+
+
+class GenerationServer(_BaseServer):
+    """HTTP server for autoregressive LM generation (KV cache).
+
+    POST /v1/models/<name>:generate
+      {"prompts": [[ids...], ...], "max_new_tokens": N,
+       "temperature": T}
+
+    All prompts in one request must share a length; the jitted
+    decode program is cached per (batch, prompt_len, max_new_tokens,
+    temperature) — a production deployment would bucket lengths, a
+    demo just warms its working set.
+    """
+
+    def __init__(self, model_name, model, params, port=8500,
+                 max_new_tokens=64, max_batch=8):
+        super().__init__(model_name, port)
+        from ..models.decode import decode
+        self._decode = decode
+        self._model = model
+        self._params = params
+        self._max_new = max_new_tokens
+        self._max_batch = max_batch
+        self._seed = 0
+
+    def _post_path(self):
+        return f"/v1/models/{self._name}:generate"
+
+    def _handle_post(self, payload):
+        try:
+            prompts = payload["prompts"]
+            new = int(payload.get("max_new_tokens", self._max_new))
+            temperature = float(payload.get("temperature", 0.0))
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": f"bad request: {e}"}
+        if not prompts or len(prompts) > self._max_batch:
+            return 400, {"error": f"need 1..{self._max_batch} prompts"}
+        if len({len(p) for p in prompts}) != 1:
+            return 400, {"error": "prompts must share one length"}
+        if new < 1 or new > self._max_new:
+            return 400, {"error": f"max_new_tokens must be in "
+                                  f"1..{self._max_new}"}
+        prompt = jnp.asarray(prompts, jnp.int32)
+        total = prompt.shape[1] + new
+        if total > self._model.max_seq_len:
+            return 400, {"error": f"prompt+new {total} exceeds "
+                                  f"max_seq_len "
+                                  f"{self._model.max_seq_len}"}
+        with self._stats_lock:
+            self._seed += 1
+            seed = self._seed
+        seq = self._decode(self._model, self._params, prompt, new,
+                           temperature=temperature,
+                           rng=jax.random.PRNGKey(seed))
+        return 200, {"sequences": np.asarray(seq).tolist()}

@@ -14,11 +14,14 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Serving demo entrypoint: ResNet-50 behind the JAX inference server.
+"""Serving demo entrypoint: ResNet-50 classification or LM
+generation behind the JAX inference servers.
 
 Replaces the reference's TF-Serving container
 (demo/serving/tensorflow-serving.yaml command block) with the JAX
 stack; the HPA still scales on the device plugin's duty_cycle metric.
+The `transformer` model serves `:generate` (KV-cache decode) instead
+of `:predict`.
 """
 
 import argparse
@@ -30,30 +33,64 @@ REPO_ROOT = os.path.dirname(
 sys.path.insert(0, REPO_ROOT)
 
 import jax
+
+# Honor an explicit JAX_PLATFORMS from the pod spec: some runtimes
+# (e.g. the axon sitecustomize) pin jax.config to a remote TPU
+# platform after import, which must not override operator intent.
+if os.environ.get("JAX_PLATFORMS"):
+    if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 
-from container_engine_accelerators_tpu.models import resnet
+from container_engine_accelerators_tpu.models import TransformerLM, resnet
 from container_engine_accelerators_tpu.models.resnet import make_apply_fn
-from container_engine_accelerators_tpu.serving import InferenceServer
+from container_engine_accelerators_tpu.serving import (
+    GenerationServer,
+    InferenceServer,
+)
 
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--model-name", default="resnet")
+    p.add_argument("--model", choices=["resnet", "transformer"],
+                   default="resnet")
+    p.add_argument("--model-name", default="")
     p.add_argument("--depth", type=int, default=50)
     p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--embed-dim", type=int, default=512)
+    p.add_argument("--num-layers", type=int, default=8)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--max-batch", type=int, default=8)
     args = p.parse_args(argv)
+    name = args.model_name or args.model
 
-    model = resnet(depth=args.depth)
-    variables = model.init(
-        jax.random.PRNGKey(0),
-        jnp.zeros((1, args.image_size, args.image_size, 3)), train=False)
-    server = InferenceServer(
-        args.model_name, make_apply_fn(model), variables,
-        (args.image_size, args.image_size, 3),
-        port=args.port, max_batch=args.max_batch)
+    if args.model == "transformer":
+        model = TransformerLM(
+            vocab_size=args.vocab_size, embed_dim=args.embed_dim,
+            num_layers=args.num_layers, num_heads=args.num_heads,
+            max_seq_len=args.max_seq_len)
+        params = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), jnp.int32))["params"]
+        server = GenerationServer(
+            name, model, params, port=args.port,
+            max_new_tokens=args.max_new_tokens,
+            max_batch=args.max_batch)
+    else:
+        model = resnet(depth=args.depth)
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, args.image_size, args.image_size, 3)),
+            train=False)
+        server = InferenceServer(
+            name, make_apply_fn(model), variables,
+            (args.image_size, args.image_size, 3),
+            port=args.port, max_batch=args.max_batch)
     server.serve_forever()
 
 

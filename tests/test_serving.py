@@ -106,3 +106,49 @@ def test_concurrent_batching(server):
         t.join()
     assert len(results) == 8
     assert len(set(results)) == 1  # same input -> same class
+
+
+@pytest.fixture(scope="module")
+def lm_server():
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import GenerationServer
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=4)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_generate(lm_server):
+    out = post(lm_server, "/v1/models/lm:generate",
+               {"prompts": [[1, 2, 3, 4]], "max_new_tokens": 6})
+    seqs = out["sequences"]
+    assert len(seqs) == 1 and len(seqs[0]) == 10
+    assert seqs[0][:4] == [1, 2, 3, 4]
+    assert all(0 <= t < 64 for t in seqs[0])
+
+
+def test_generate_sampling_and_batch(lm_server):
+    out = post(lm_server, "/v1/models/lm:generate",
+               {"prompts": [[5, 6], [7, 8]], "max_new_tokens": 4,
+                "temperature": 1.0})
+    assert len(out["sequences"]) == 2
+    assert all(len(s) == 6 for s in out["sequences"])
+
+
+def test_generate_validation(lm_server):
+    for payload in (
+            {"prompts": []},
+            {"prompts": [[1, 2], [1, 2, 3]]},          # ragged
+            {"prompts": [[1]], "max_new_tokens": 999},  # over limit
+            {"prompts": [[0] * 30], "max_new_tokens": 8},  # > max_seq
+    ):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(lm_server, "/v1/models/lm:generate", payload)
+        assert err.value.code == 400
