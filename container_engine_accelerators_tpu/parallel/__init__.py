@@ -32,7 +32,7 @@ from .expert import (
     dense_moe,
     expert_parallel_moe,
 )
-from .mesh import MeshSpec, build_mesh, chips_from_env
+from .mesh import MeshSpec, build_hybrid_mesh, build_mesh, chips_from_env
 from .pipeline import (
     build_pipeline_mesh,
     pipeline_apply,
@@ -46,6 +46,7 @@ __all__ = [
     "MeshSpec",
     "build_context_mesh",
     "build_expert_mesh",
+    "build_hybrid_mesh",
     "build_mesh",
     "build_pipeline_mesh",
     "chips_from_env",

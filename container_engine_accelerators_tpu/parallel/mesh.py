@@ -96,3 +96,62 @@ def build_mesh(spec=None, devices=None):
     if spec is None:
         spec = default_spec(len(devices))
     return grid_mesh(devices, spec.data, spec.model, MODEL_AXIS)
+
+
+def _granules(devices, num_granules):
+    """Split devices into DCN granules (slices/hosts).
+
+    Groups by the runtime's slice_index (multislice) or
+    process_index (multi-host) when those distinguish devices;
+    otherwise falls back to even chunks in enumeration order — which
+    makes the layout testable on a virtual single-process mesh.
+    """
+    for attr in ("slice_index", "process_index"):
+        keys = {getattr(d, attr, None) for d in devices}
+        if len(keys) > 1:
+            groups = {}
+            for d in devices:
+                groups.setdefault(getattr(d, attr), []).append(d)
+            granules = [groups[k] for k in sorted(groups)]
+            if num_granules is not None and len(granules) != num_granules:
+                raise ValueError(
+                    f"found {len(granules)} {attr} granules, expected "
+                    f"{num_granules}")
+            return granules
+    if num_granules is None:
+        raise ValueError(
+            "single-granule device set: pass num_granules to emulate "
+            "a DCN split")
+    if len(devices) % num_granules != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not split into "
+            f"{num_granules} granules")
+    per = len(devices) // num_granules
+    return [devices[i * per:(i + 1) * per]
+            for i in range(num_granules)]
+
+
+def build_hybrid_mesh(model=1, num_granules=None, devices=None):
+    """("data", "model") mesh spanning DCN granules (hybrid ICI x DCN).
+
+    The model axis is confined to one granule (slice/host), so its
+    collectives ride ICI; the data axis is ordered granule-major, so
+    the gradient all-reduce decomposes into fast intra-granule ICI
+    reductions plus one slower DCN ring across granules — the
+    standard multislice layout (scaling-book recipe). On a single
+    process, ``num_granules`` emulates the split for testing.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    granules = _granules(devices, num_granules)
+    per = len(granules[0])
+    if any(len(g) != per for g in granules):
+        raise ValueError("granules are unevenly sized")
+    if per % model != 0:
+        raise ValueError(
+            f"model={model} does not divide the {per} devices of a "
+            f"granule; tensor parallelism cannot span DCN")
+    # Granule-major flattening: rows (data) enumerate granule-local
+    # model groups first, so data-axis neighbors are mostly
+    # intra-granule.
+    flat = [d for granule in granules for d in granule]
+    return grid_mesh(flat, None, model, MODEL_AXIS)

@@ -71,6 +71,7 @@ from container_engine_accelerators_tpu.parallel import (
     Trainer,
     batch_sharding,
     build_expert_mesh,
+    build_hybrid_mesh,
     build_mesh,
 )
 from container_engine_accelerators_tpu.parallel.data import (
@@ -111,6 +112,10 @@ def parse_args(argv=None):
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--model-parallelism", type=int, default=1)
+    p.add_argument("--dcn-granules", type=int, default=0,
+                   help="multislice: spread the data axis over this "
+                        "many DCN granules (slices/hosts), keeping "
+                        "model parallelism inside each granule")
     p.add_argument("--remat", action="store_true")
     p.add_argument("--pallas-loss", action="store_true", default=True)
     p.add_argument("--no-pallas-loss", dest="pallas_loss",
@@ -220,7 +225,15 @@ def main(argv=None):
                 "--model-parallelism cannot combine with "
                 "--expert-parallelism: the expert mesh has no "
                 "'model' axis")
+        if args.dcn_granules > 1:
+            raise SystemExit(
+                "--dcn-granules cannot combine with "
+                "--expert-parallelism: the expert mesh is not "
+                "DCN-granule aware")
         mesh = build_expert_mesh(expert=args.expert_parallelism)
+    elif args.dcn_granules > 1:
+        mesh = build_hybrid_mesh(model=args.model_parallelism,
+                                 num_granules=args.dcn_granules)
     else:
         mesh = build_mesh(default_spec(len(devices),
                                        args.model_parallelism))
