@@ -70,6 +70,7 @@ from container_engine_accelerators_tpu.ops import mean_cross_entropy_loss
 from container_engine_accelerators_tpu.parallel import (
     Trainer,
     batch_sharding,
+    build_context_mesh,
     build_expert_mesh,
     build_hybrid_mesh,
     build_mesh,
@@ -101,6 +102,13 @@ def parse_args(argv=None):
                    help="MoE expert count")
     p.add_argument("--expert-parallelism", type=int, default=1,
                    help="size of the expert mesh axis (moe model)")
+    p.add_argument("--context-parallelism", type=int, default=1,
+                   help="size of the context (sequence) mesh axis "
+                        "for long-context LM training")
+    p.add_argument("--attention", choices=["flash", "ring", "ulysses"],
+                   default="flash",
+                   help="attention schedule; ring/ulysses require "
+                        "--context-parallelism > 1")
     p.add_argument("--batch-size", type=int, default=256,
                    help="global batch size")
     p.add_argument("--lr", type=float, default=0.1)
@@ -178,13 +186,33 @@ def restore_checkpoint(model_dir, state):
 
 def build_lm(args, mesh):
     """LM families: (model, apply_fn, loss_fn). The moe model binds
-    the mesh so expert dispatch rides the expert axis."""
+    the mesh so expert dispatch rides the expert axis; with context
+    parallelism the chosen sequence-parallel attention schedule is
+    bound to the mesh instead."""
+    import functools
+
+    from container_engine_accelerators_tpu.parallel import (
+        ring_attention,
+        ulysses_attention,
+    )
+    from container_engine_accelerators_tpu.parallel.context import (
+        CONTEXT_AXIS,
+    )
+    from container_engine_accelerators_tpu.parallel.mesh import DATA_AXIS
+
     base_loss = next_token_loss_fn(
         mean_cross_entropy_loss if args.pallas_loss
         else _dense_lm_loss)
+    attention_fn = None
+    if args.context_parallelism > 1:
+        schedule = (ulysses_attention if args.attention == "ulysses"
+                    else ring_attention)
+        attention_fn = functools.partial(
+            schedule, mesh, axis_name=CONTEXT_AXIS,
+            batch_axis=DATA_AXIS)
     common = dict(vocab_size=args.vocab_size, embed_dim=args.embed_dim,
                   num_layers=args.num_layers, num_heads=args.num_heads,
-                  max_seq_len=args.seq_len)
+                  max_seq_len=args.seq_len, attention_fn=attention_fn)
     if args.model == "moe":
         model = MoETransformerLM(
             num_experts=args.num_experts,
@@ -219,18 +247,36 @@ def build_model(args):
 def main(argv=None):
     args = parse_args(argv)
     devices = jax.devices()
+    if args.context_parallelism > 1 and args.model not in LM_MODELS:
+        raise SystemExit(
+            "--context-parallelism only applies to the LM models")
+    if args.expert_parallelism > 1 and args.model != "moe":
+        raise SystemExit(
+            "--expert-parallelism only applies to --model moe")
+    if (args.attention != "flash") != (args.context_parallelism > 1):
+        raise SystemExit(
+            "--attention ring/ulysses and --context-parallelism > 1 "
+            "go together: sequence-parallel schedules need a context "
+            "axis, and a context axis needs one of them")
+    exclusive = {
+        "--expert-parallelism": args.expert_parallelism > 1,
+        "--context-parallelism": args.context_parallelism > 1,
+        "--dcn-granules": args.dcn_granules > 1,
+    }
+    chosen = [flag for flag, on in exclusive.items() if on]
+    if len(chosen) > 1:
+        raise SystemExit(
+            f"{' and '.join(chosen)} cannot combine: each builds its "
+            f"own mesh axes")
+    if args.model_parallelism > 1 and chosen and \
+            chosen != ["--dcn-granules"]:
+        raise SystemExit(
+            f"--model-parallelism cannot combine with {chosen[0]}: "
+            f"that mesh has no 'model' axis")
     if args.model == "moe" and args.expert_parallelism > 1:
-        if args.model_parallelism > 1:
-            raise SystemExit(
-                "--model-parallelism cannot combine with "
-                "--expert-parallelism: the expert mesh has no "
-                "'model' axis")
-        if args.dcn_granules > 1:
-            raise SystemExit(
-                "--dcn-granules cannot combine with "
-                "--expert-parallelism: the expert mesh is not "
-                "DCN-granule aware")
         mesh = build_expert_mesh(expert=args.expert_parallelism)
+    elif args.context_parallelism > 1:
+        mesh = build_context_mesh(context=args.context_parallelism)
     elif args.dcn_granules > 1:
         mesh = build_hybrid_mesh(model=args.model_parallelism,
                                  num_granules=args.dcn_granules)
@@ -240,7 +286,13 @@ def main(argv=None):
 
     if args.model in LM_MODELS:
         model, apply_fn, loss_fn = build_lm(args, mesh)
-        init_batch = jnp.zeros((1, args.seq_len), jnp.int32)
+        # Sequence-parallel attention shards the batch dim over
+        # "data" even inside model.init, so init with one row per
+        # data-axis entry (not the full global batch, which would
+        # materialize an unsharded forward).
+        init_rows = (dict(mesh.shape).get("data", 1)
+                     if args.context_parallelism > 1 else 1)
+        init_batch = jnp.zeros((init_rows, args.seq_len), jnp.int32)
         loader = SyntheticTokenLoader(
             args.batch_size, args.seq_len, args.vocab_size,
             sharding=batch_sharding(mesh), pool=2)
