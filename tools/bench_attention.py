@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Attention-schedule microbenchmark.
+
+Times dense attention, the Pallas flash kernel, and (multi-device)
+the ring / Ulysses context-parallel schedules at a given shape, and
+prints one JSON line per schedule:
+
+  {"schedule": "flash", "seq_len": 4096, "ms_per_call": ...,
+   "tflops": ...}
+
+Run on the TPU chip for kernel numbers, or on a virtual CPU mesh
+(JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)
+for schedule-correctness timing.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+
+def _time(fn, *args, iters):
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=4096)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--causal", action="store_true", default=True)
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args(argv)
+
+    from container_engine_accelerators_tpu.ops.attention import (
+        flash_attention,
+    )
+    from container_engine_accelerators_tpu.parallel import (
+        build_context_mesh,
+        dot_product_attention,
+        ring_attention,
+        ulysses_attention,
+    )
+
+    b, s, h, d = (args.batch, args.seq_len, args.num_heads,
+                  args.head_dim)
+    dtype = jnp.dtype(args.dtype)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(key, (b, s, h, d), dtype)
+               for key in ks)
+    # 4*b*h*s^2*d matmul FLOPs (QK^T + PV), halved by causality.
+    flops = 4 * b * h * s * s * d * (0.5 if args.causal else 1.0)
+
+    schedules = {
+        "dense": jax.jit(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=args.causal)),
+        "flash": jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=args.causal)),
+    }
+    n = len(jax.devices())
+    if n > 1:
+        mesh = build_context_mesh(context=n)
+        schedules["ring"] = jax.jit(
+            lambda q, k, v: ring_attention(mesh, q, k, v,
+                                           causal=args.causal))
+        if h % n == 0:
+            schedules["ulysses"] = jax.jit(
+                lambda q, k, v: ulysses_attention(mesh, q, k, v,
+                                                  causal=args.causal))
+
+    for name, fn in schedules.items():
+        try:
+            sec = _time(fn, q, k, v, iters=args.iters)
+        except Exception as e:  # dense at long S can OOM; keep going
+            print(json.dumps({"schedule": name, "seq_len": s,
+                              "error": str(e)[:200]}))
+            continue
+        print(json.dumps({
+            "schedule": name,
+            "seq_len": s,
+            "batch": b,
+            "heads": h,
+            "head_dim": d,
+            "devices": n,
+            "ms_per_call": round(sec * 1000, 3),
+            "tflops": round(flops / sec / 1e12, 2),
+        }))
+
+
+if __name__ == "__main__":
+    main()
