@@ -15,6 +15,49 @@
 """Shared zoo adapters for the Trainer's apply contract."""
 
 
+def residual_constraint(x, mesh):
+    """Pin [B, S, ...] activations to their (data, context) sharding.
+
+    SPMD hygiene for the dp+sp+ep composition: the MoE dispatch
+    (parallel.expert.expert_parallel_moe) shards its token batch over
+    *every* mesh axis jointly, and without explicit constraints XLA's
+    backward-pass sharding propagation adopts that fully-sharded
+    layout for the residual stream too — then has to reconcile it
+    with the ring attention's (data, context) layout via "Involuntary
+    full rematerialization" (replicate-then-reshard) on the gradient
+    adds. Pinning the residual stream at block boundaries keeps both
+    passes on one layout, so XLA inserts targeted collectives only at
+    the MoE dispatch edges where the reshard is real.
+
+    No-op when ``mesh`` is None or has no data/context axes, so
+    single-chip and pure-DP paths (and their checkpoints) are
+    untouched.
+    """
+    if mesh is None:
+        return x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.context import CONTEXT_AXIS
+    from ..parallel.mesh import DATA_AXIS
+
+    axes = dict(mesh.shape)
+
+    def usable(axis, dim):
+        # Skip axes the dim can't tile (e.g. batch-1 shape probes at
+        # model.init time) — a constraint there would be an error,
+        # not a layout.
+        size = axes.get(axis, 1)
+        return axis if size > 1 and dim % size == 0 else None
+
+    batch = usable(DATA_AXIS, x.shape[0])
+    seq = usable(CONTEXT_AXIS, x.shape[1]) if x.ndim > 1 else None
+    if batch is None and seq is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(batch, seq)))
+
+
 def make_stateless_apply_fn(model):
     """(variables, inputs, train) -> (outputs, {}) for models with no
     mutable collections (no BatchNorm state). The BN counterpart

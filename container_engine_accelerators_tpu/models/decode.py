@@ -37,8 +37,18 @@ import jax.numpy as jnp
 
 def init_cache(model, batch, length):
     """Size the KV cache: a decode-mode init at full length creates
-    per-layer [B, length, H, D] cache buffers plus step counters."""
-    decode_model = model.clone(decode=True)
+    per-layer [B, length, H, D] cache buffers plus step counters.
+
+    Any training mesh on the model is dropped: a mesh-bound MoE
+    model would route its [B*1] decode token group through the
+    expert shard_map and hit a divisibility error, and the residual
+    sharding pins are pointless for single-chip decode. The params
+    are mesh-agnostic, so the dense dispatch path is always valid.
+    """
+    clone_kwargs = {"decode": True}
+    if getattr(model, "mesh", None) is not None:
+        clone_kwargs["mesh"] = None
+    decode_model = model.clone(**clone_kwargs)
     variables = decode_model.init(
         jax.random.PRNGKey(0), jnp.zeros((batch, length), jnp.int32),
         train=False)
@@ -54,9 +64,9 @@ def _logits_of(outputs):
                    static_argnames=("model", "max_new_tokens",
                                     "sample"))
 def _decode_impl(model, params, prompt, max_new_tokens, temperature,
-                 rng, *, sample):
-    b, p_len = prompt.shape
-    total = p_len + max_new_tokens
+                 rng, prompt_len, *, sample):
+    b, p_pad = prompt.shape
+    total = p_pad + max_new_tokens
     decode_model, cache = init_cache(model, b, total)
     padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
 
@@ -75,9 +85,11 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
         sampled = sampled.astype(prompt.dtype)
         # While still inside the prompt, the model's prediction is
         # discarded and the actual prompt token is fed (prefill).
+        # prompt_len is TRACED, so one compiled program serves every
+        # true prompt length padded into this shape bucket.
         forced = jax.lax.dynamic_index_in_dim(
             padded, jnp.minimum(t + 1, total - 1), 1, keepdims=False)
-        nxt = jnp.where(t + 1 < p_len, forced, sampled)
+        nxt = jnp.where(t + 1 < prompt_len, forced, sampled)
         return (updated["cache"], nxt, rng), nxt
 
     (_, _, _), produced = jax.lax.scan(
@@ -87,7 +99,7 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
 
 
 def decode(model, params, prompt, max_new_tokens, *,
-           temperature=0.0, rng=None):
+           temperature=0.0, rng=None, prompt_len=None):
     """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
 
     temperature == 0 is greedy argmax; > 0 samples from
@@ -96,11 +108,21 @@ def decode(model, params, prompt, max_new_tokens, *,
     greedy/sampling *mode* is compiled in; the temperature itself is
     a traced scalar, so serving arbitrary client temperatures reuses
     one compiled program per shape.
+
+    ``prompt_len`` (traced scalar, default P) is where generation
+    takes over from prefill: pass the true shared prompt length when
+    ``prompt`` is right-padded into a shape bucket (serving). The
+    generated tokens then occupy positions
+    [prompt_len, prompt_len + max_new_tokens) and the tail of the
+    returned sequence is scratch.
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if prompt_len is None:
+        prompt_len = prompt.shape[1]
     return _decode_impl(model, params, prompt, max_new_tokens,
                         jnp.asarray(temperature, jnp.float32), rng,
+                        jnp.asarray(prompt_len, jnp.int32),
                         sample=temperature > 0.0)
 
 

@@ -35,9 +35,42 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..ops.attention import flash_attention
-from ..parallel.expert import dense_moe, expert_parallel_moe
-from .common import make_stateless_apply_fn
+from ..parallel.expert import (
+    EXPERT_AXIS,
+    dense_moe,
+    expert_parallel_moe,
+)
+from .common import make_stateless_apply_fn, residual_constraint
 from .transformer import Block, CausalSelfAttention, cached_positions
+
+
+def _residual_token_spec(mesh, num_tokens):
+    """PartitionSpec of the flat [T, d] token batch as the residual
+    stream shards it: T over (data, context), expert axis unused.
+
+    Handing this to ``expert_parallel_moe`` keeps the token layout at
+    the dispatch boundary identical to the surrounding activations —
+    the expert-axis routing-group subdivision then happens inside the
+    shard_map (slice in, all_gather out), and XLA never has to
+    reconcile a fully-sharded token layout with the (data, context)
+    residual through a reshape (the round-1 "Involuntary full
+    rematerialization" failure, MULTICHIP_r01 tail).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.context import CONTEXT_AXIS
+    from ..parallel.mesh import DATA_AXIS
+
+    axes = dict(mesh.shape)
+    group, tile = [], axes.get(EXPERT_AXIS, 1)
+    for a in (DATA_AXIS, CONTEXT_AXIS):
+        size = axes.get(a, 1)
+        # The token dim must tile over the group axes AND the
+        # expert-axis subdivision inside the dispatch.
+        if size > 1 and num_tokens % (tile * size) == 0:
+            group.append(a)
+            tile *= size
+    return P(tuple(group) if group else None)
 
 
 class MoEMlp(nn.Module):
@@ -73,6 +106,7 @@ class MoEMlp(nn.Module):
         w_out = self.param(
             "w_out", nn.initializers.lecun_normal(),
             (self.num_experts, f, d), jnp.float32)
+        x = residual_constraint(x, self.mesh)
         tokens = x.reshape(-1, d)
         kwargs = dict(capacity_factor=self.capacity_factor,
                       top_k=self.top_k)
@@ -83,7 +117,9 @@ class MoEMlp(nn.Module):
         else:
             out, aux = expert_parallel_moe(
                 self.mesh, tokens, gate_w, w_in.astype(self.dtype),
-                w_out.astype(self.dtype), **kwargs)
+                w_out.astype(self.dtype),
+                token_spec=_residual_token_spec(
+                    self.mesh, tokens.shape[0]), **kwargs)
         return out.reshape(x.shape), aux
 
 
@@ -105,7 +141,7 @@ class MoEBlock(nn.Module):
         x = CausalSelfAttention(num_heads=self.num_heads,
                                 dtype=self.dtype,
                                 attention_fn=self.attention_fn,
-                                decode=self.decode,
+                                decode=self.decode, mesh=self.mesh,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -113,7 +149,7 @@ class MoEBlock(nn.Module):
                         capacity_factor=self.capacity_factor,
                         dtype=self.dtype, mesh=self.mesh,
                         name="moe")(h)
-        return x + h, aux
+        return residual_constraint(x + h, self.mesh), aux
 
 
 class MoETransformerLM(nn.Module):
@@ -152,7 +188,7 @@ class MoETransformerLM(nn.Module):
         pos = cached_positions(self, s, self.decode)
         pos = nn.Embed(self.max_seq_len, self.embed_dim,
                        dtype=self.dtype, name="pos_embed")(pos)
-        x = x + pos[None]
+        x = residual_constraint(x + pos[None], self.mesh)
         aux_losses = []
         for i in range(self.num_layers):
             if i % 2 == 1:
@@ -169,7 +205,7 @@ class MoETransformerLM(nn.Module):
                 x = Block(num_heads=self.num_heads,
                           mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                           attention_fn=attention_fn,
-                          decode=self.decode,
+                          decode=self.decode, mesh=self.mesh,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import flash_attention
-from .common import make_stateless_apply_fn
+from .common import make_stateless_apply_fn, residual_constraint
 
 
 def cached_positions(module, s, decode):
@@ -61,16 +61,23 @@ class CausalSelfAttention(nn.Module):
     one token, writes its K/V at the cache index, and attends over
     the prefix — static shapes throughout, so the whole decode loop
     compiles to one XLA program (models/decode.py drives it).
+
+    Param-tree note: factoring attention into this submodule (name
+    "attn") nests qkv/proj/LayerNorm paths one level deeper than the
+    pre-refactor flat Block layout; checkpoints from before that
+    change need a one-time key remap on restore.
     """
 
     num_heads: int
     dtype: Any = jnp.bfloat16
     attention_fn: Callable = flash_attention
     decode: bool = False
+    mesh: Any = None  # residual-stream sharding pin (no extra params)
 
     @nn.compact
     def __call__(self, x):
         e = x.shape[-1]
+        x = residual_constraint(x, self.mesh)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         qkv = nn.DenseGeneral((3, self.num_heads, e // self.num_heads),
                               dtype=self.dtype, name="qkv")(h)
@@ -80,8 +87,9 @@ class CausalSelfAttention(nn.Module):
         else:
             attn = self.attention_fn(q, k, v, causal=True)
         attn = attn.reshape(x.shape)
-        return x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
-                                   name="proj")(attn)
+        out = x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
+                                  name="proj")(attn)
+        return residual_constraint(out, self.mesh)
 
     def _cached_attention(self, q, k, v):
         """One-token decode step against the KV cache.
@@ -134,6 +142,7 @@ class Block(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Callable = flash_attention
     decode: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -141,12 +150,13 @@ class Block(nn.Module):
         x = CausalSelfAttention(num_heads=self.num_heads,
                                 dtype=self.dtype,
                                 attention_fn=self.attention_fn,
-                                decode=self.decode,
+                                decode=self.decode, mesh=self.mesh,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
         h = nn.gelu(h)
-        return x + nn.Dense(e, dtype=self.dtype)(h)
+        return residual_constraint(x + nn.Dense(e, dtype=self.dtype)(h),
+                                   self.mesh)
 
 
 class TransformerLM(nn.Module):
@@ -161,6 +171,7 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Optional[Callable] = None
     decode: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -178,12 +189,12 @@ class TransformerLM(nn.Module):
         pos = cached_positions(self, s, self.decode)
         pos = nn.Embed(self.max_seq_len, self.embed_dim,
                        dtype=self.dtype, name="pos_embed")(pos)
-        x = x + pos[None]
+        x = residual_constraint(x + pos[None], self.mesh)
         for i in range(self.num_layers):
             x = Block(num_heads=self.num_heads,
                       mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                       attention_fn=attention_fn, decode=self.decode,
-                      name=f"block{i}")(x)
+                      mesh=self.mesh, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,
         # and the [B*S, V] matmul stays MXU-shaped either way.

@@ -145,10 +145,23 @@ def expert_parallel_moe(mesh, tokens, gate_w, w_in, w_out, *,
                         activation=jax.nn.gelu, token_spec=None):
     """MoE layer with experts sharded over ``axis_name``.
 
-    tokens: [T, d] flattened token batch, sharded over every mesh
-    axis jointly (default ``token_spec``) so each device routes a
-    distinct group; expert weights [E, ...] are sharded over the
-    expert axis (leading dim) and replicated elsewhere.
+    tokens: [T, d] flattened token batch; expert weights [E, ...] are
+    sharded over the expert axis (leading dim) and replicated
+    elsewhere. ``token_spec`` controls the token layout at the
+    shard_map boundary:
+
+      - default (``None``): tokens sharded over every mesh axis
+        jointly; each device routes a distinct group.
+      - a spec WITHOUT ``axis_name`` (e.g. the residual stream's
+        (data, context) sharding): tokens arrive replicated along the
+        expert axis and the routing-group subdivision happens INSIDE
+        the manual region — each expert-axis member slices its T/P
+        subgroup, and the outputs are re-assembled with an
+        all_gather. Identical math (same groups, same capacity), but
+        the jit-level program never reshards the token batch, so
+        XLA's sharding propagation cannot collide with the
+        surrounding activation layout (the round-1 "Involuntary full
+        rematerialization" failure mode — MULTICHIP_r01).
 
     Per-shard schedule: local top-k routing -> dispatch einsum
     [E, C, d] -> all_to_all (expert dim split, slot dim concat) ->
@@ -166,6 +179,13 @@ def expert_parallel_moe(mesh, tokens, gate_w, w_in, w_out, *,
             f"{p_size}")
     if token_spec is None:
         token_spec = P(tuple(mesh.axis_names))
+    spec_axes = []
+    for entry in token_spec:
+        if entry is None:
+            continue
+        spec_axes.extend(entry if isinstance(entry, (tuple, list))
+                         else (entry,))
+    subdivide = axis_name not in spec_axes
     w_spec = P(axis_name)
     all_axes = tuple(mesh.axis_names)
 
@@ -174,14 +194,23 @@ def expert_parallel_moe(mesh, tokens, gate_w, w_in, w_out, *,
         in_specs=(token_spec, P(), w_spec, w_spec),
         out_specs=(token_spec, P()), check_vma=False)
     def _moe(tokens, gate_w, w_in, w_out):
-        cap = expert_capacity(tokens.shape[0], e, capacity_factor,
+        if subdivide:
+            # Expert-axis members share one token block; each routes
+            # its own contiguous T/P subgroup (the same groups the
+            # fully-sharded layout would form, in the same order).
+            t_sub = tokens.shape[0] // p_size
+            start = jax.lax.axis_index(axis_name) * t_sub
+            toks = jax.lax.dynamic_slice_in_dim(tokens, start, t_sub, 0)
+        else:
+            toks = tokens
+        cap = expert_capacity(toks.shape[0], e, capacity_factor,
                               top_k)
-        logits = tokens.astype(jnp.float32) @ gate_w.astype(
+        logits = toks.astype(jnp.float32) @ gate_w.astype(
             jnp.float32)
         dispatch, combine, aux = top_k_routing(logits, cap,
                                                top_k=top_k)
-        slots = jnp.einsum("td,tec->ecd", tokens,
-                           dispatch.astype(tokens.dtype))
+        slots = jnp.einsum("td,tec->ecd", toks,
+                           dispatch.astype(toks.dtype))
         # [E, C, d] -> [E/P, P*C, d]: each expert owner receives its
         # slots from every group member in one collective.
         slots = jax.lax.all_to_all(slots, axis_name, split_axis=0,
@@ -192,6 +221,19 @@ def expert_parallel_moe(mesh, tokens, gate_w, w_in, w_out, *,
                                  concat_axis=0, tiled=True)
         out = jnp.einsum("ecd,tec->td", out.astype(jnp.float32),
                          combine)
-        return out.astype(tokens.dtype), jax.lax.pmean(aux, all_axes)
+        out = out.astype(tokens.dtype)
+        if subdivide:
+            # Re-assemble the block (subgroup g from member g), in
+            # order — the output is then expert-axis replicated as
+            # the out_spec promises.
+            out = jax.lax.all_gather(out, axis_name, axis=0,
+                                     tiled=True)
+        return out, jax.lax.pmean(aux, all_axes)
 
+    if subdivide and tokens.shape[0] % (
+            p_size * math.prod(
+                mesh.shape[a] for a in spec_axes)) != 0:
+        raise ValueError(
+            f"token count {tokens.shape[0]} not divisible by "
+            f"{p_size}x the token_spec shards")
     return _moe(tokens, gate_w, w_in, w_out)

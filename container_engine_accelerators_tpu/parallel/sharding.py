@@ -21,8 +21,22 @@ resolved once per parameter pytree, no per-step Python logic.
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils import get_logger
 from .expert import EXPERT_AXIS
 from .mesh import DATA_AXIS, MODEL_AXIS
+
+log = get_logger("sharding")
+
+# The expert-kernel naming contract (single authority, documented on
+# models.moe.MoEMlp): stacked per-expert kernels are parameters named
+# exactly one of these, inside a module whose flax name is "moe" or
+# auto-named "MoEMlp_N".
+_EXPERT_PARAM_NAMES = frozenset({"w_in", "w_out"})
+
+
+def _is_expert_module(name):
+    name = str(name).lower()
+    return name == "moe" or name.startswith("moemlp")
 
 # Parameters whose trailing (output-feature) dim is at least this wide
 # get sharded over the model axis; small params are replicated —
@@ -44,18 +58,25 @@ def _param_spec(path, value, model_parallel, expert_parallel):
     shape = getattr(value, "shape", ())
     # Stacked per-expert kernels ([E, in, out]) shard their expert
     # dim over EXPERT_AXIS — the layout expert_parallel_moe expects.
-    # Naming contract (documented on models.moe.MoEMlp): the routed
-    # MLP module itself is named "moe" or auto-named "MoEMlp_N".
-    # Matching that exact component (not a prefix of enclosing
-    # blocks like "MoEBlock_N") keeps attention/norm params inside
-    # MoE blocks replicated as the attention shard_map expects.
-    if (expert_parallel and len(shape) >= 3
-            and shape[0] % expert_parallel == 0
-            and any(str(getattr(k, "key", k)).lower() == "moe"
-                    or str(getattr(k, "key", k)).lower().startswith(
-                        "moemlp")
-                    for k in path)):
-        return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
+    # The rule fires only on the exact (module, param) names MoEMlp
+    # creates (module component "moe"/"MoEMlp_N", not a prefix of
+    # enclosing blocks, so attention/norm params inside MoE blocks
+    # stay replicated), AND the param name w_in/w_out — an unrelated
+    # module merely named "moe" cannot be silently expert-sharded.
+    # Near-misses under an expert module are logged so a renamed
+    # kernel fails loudly in review, not silently at scale.
+    keys = [str(getattr(k, "key", k)) for k in path]
+    in_expert_module = any(_is_expert_module(k) for k in keys[:-1])
+    if expert_parallel and in_expert_module and len(shape) >= 3:
+        if (keys[-1] in _EXPERT_PARAM_NAMES
+                and shape[0] % expert_parallel == 0):
+            return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
+        log.warning(
+            "param %s (shape %s) sits in an expert module but does "
+            "not match the expert-kernel contract (names %s, leading "
+            "dim divisible by %d); leaving it replicated",
+            "/".join(keys), shape, sorted(_EXPERT_PARAM_NAMES),
+            expert_parallel)
     if not model_parallel:
         return P()
     if len(shape) < 2:

@@ -265,14 +265,19 @@ class GenerationServer(_BaseServer):
       {"prompts": [[ids...], ...], "max_new_tokens": N,
        "temperature": T}
 
-    All prompts in one request must share a length; the jitted
-    decode program is cached per (batch, prompt_len, max_new_tokens,
-    temperature) — a production deployment would bucket lengths, a
-    demo just warms its working set.
+    All prompts in one request must share a length. Client-visible
+    shapes never reach the compiler: prompts are right-padded into a
+    fixed set of length buckets, the batch is padded to ``max_batch``,
+    and the decode horizon is always ``max_new_tokens`` (the response
+    is sliced to what was asked). The jit cache is therefore bounded
+    at 2 programs per bucket (greedy/sampling), and every bucket's
+    greedy program is optionally compiled before traffic
+    (``warm=True``) so no request ever blocks on a compile.
     """
 
     def __init__(self, model_name, model, params, port=8500,
-                 max_new_tokens=64, max_batch=8):
+                 max_new_tokens=64, max_batch=8, buckets=None,
+                 warm=False):
         super().__init__(model_name, port)
         from ..models.decode import decode
         self._decode = decode
@@ -281,9 +286,40 @@ class GenerationServer(_BaseServer):
         self._max_new = max_new_tokens
         self._max_batch = max_batch
         self._seed = 0
+        max_prompt = model.max_seq_len - max_new_tokens
+        if max_prompt < 1:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} leaves no room for "
+                f"a prompt within max_seq_len {model.max_seq_len}")
+        if buckets is None:
+            buckets, b = [], 16
+            while b < max_prompt:
+                buckets.append(b)
+                b *= 2
+            buckets.append(max_prompt)
+        self._buckets = sorted(
+            {b for b in buckets if 1 <= b <= max_prompt})
+        if not self._buckets:
+            raise ValueError("no valid prompt-length buckets")
+        if warm:
+            for b in self._buckets:
+                self._run(np.zeros((1, b), np.int32), b, 0.0, 0)
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"
+
+    def _run(self, prompts, prompt_len, temperature, seed):
+        """Decode through the (max_batch, bucket) padded program."""
+        n = prompts.shape[0]
+        padded = np.zeros((self._max_batch, prompts.shape[1]),
+                          np.int32)
+        padded[:n] = prompts
+        seq = self._decode(self._model, self._params,
+                           jnp.asarray(padded), self._max_new,
+                           temperature=temperature,
+                           rng=jax.random.PRNGKey(seed),
+                           prompt_len=prompt_len)
+        return np.asarray(seq)[:n]
 
     def _handle_post(self, payload):
         try:
@@ -299,16 +335,26 @@ class GenerationServer(_BaseServer):
         if new < 1 or new > self._max_new:
             return 400, {"error": f"max_new_tokens must be in "
                                   f"1..{self._max_new}"}
-        prompt = jnp.asarray(prompts, jnp.int32)
-        total = prompt.shape[1] + new
-        if total > self._model.max_seq_len:
-            return 400, {"error": f"prompt+new {total} exceeds "
-                                  f"max_seq_len "
-                                  f"{self._model.max_seq_len}"}
+        try:
+            arr = np.asarray(prompts, dtype=np.int32)
+        except (ValueError, TypeError) as e:
+            return 400, {"error": f"bad prompt tokens: {e}"}
+        if arr.ndim != 2 or arr.shape[1] < 1:
+            return 400, {"error": "prompts must be non-empty id lists"}
+        # Out-of-range ids would be silently clamped by the embedding
+        # gather — plausible output, wrong model. Reject instead.
+        vocab = self._model.vocab_size
+        if arr.min() < 0 or arr.max() >= vocab:
+            return 400, {"error": f"token ids must be in 0..{vocab - 1}"}
+        p_len = arr.shape[1]
+        bucket = next((b for b in self._buckets if b >= p_len), None)
+        if bucket is None:
+            return 400, {"error": f"prompt length {p_len} exceeds "
+                                  f"max {self._buckets[-1]}"}
+        padded = np.zeros((arr.shape[0], bucket), np.int32)
+        padded[:, :p_len] = arr
         with self._stats_lock:
             self._seed += 1
             seed = self._seed
-        seq = self._decode(self._model, self._params, prompt, new,
-                           temperature=temperature,
-                           rng=jax.random.PRNGKey(seed))
-        return 200, {"sequences": np.asarray(seq).tolist()}
+        seq = self._run(padded, p_len, temperature, seed)
+        return 200, {"sequences": seq[:, :p_len + new].tolist()}

@@ -1,0 +1,86 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Detect XLA SPMD partitioner distress during compilation.
+
+XLA reports sharding-propagation failures ("Involuntary full
+rematerialization": it replicates a tensor and re-partitions it
+because no efficient reshard exists) as C++ log lines on the stderr
+file descriptor — invisible to Python-level warning machinery. These
+helpers capture fd 2 across a compile and scan for the phrases that
+mean a sharding layout is silently wrecking scale-out throughput, so
+tests and the multi-chip dryrun can FAIL on them instead of shipping
+a "passing" program that replicates its activations.
+"""
+
+import contextlib
+import os
+import sys
+import tempfile
+
+# Phrases that indicate the SPMD partitioner fell back to
+# replicate-then-reshard; any of these in a compile log is a bug in
+# our sharding annotations, not a warning to tolerate.
+RESHARD_DISTRESS_PHRASES = (
+    "Involuntary full rematerialization",
+)
+
+
+@contextlib.contextmanager
+def capture_stderr_fd(echo=True):
+    """Capture everything written to fd 2 (Python *and* C++).
+
+    Yields an object whose ``.text`` holds the captured output after
+    the block exits. With ``echo=True`` the captured bytes are
+    re-written to the original stderr afterwards so outer harnesses
+    (the driver, pytest -s) still see the full log.
+    """
+
+    class Captured:
+        text = ""
+
+    cap = Captured()
+    sys.stderr.flush()
+    saved_fd = os.dup(2)
+    with tempfile.TemporaryFile(mode="w+b") as tmp:
+        os.dup2(tmp.fileno(), 2)
+        try:
+            yield cap
+        finally:
+            sys.stderr.flush()
+            os.dup2(saved_fd, 2)
+            os.close(saved_fd)
+            tmp.seek(0)
+            data = tmp.read()
+            cap.text = data.decode("utf-8", errors="replace")
+            if echo and data:
+                sys.stderr.buffer.write(data)
+                sys.stderr.flush()
+
+
+def find_resharding_warnings(log_text):
+    """Lines in ``log_text`` matching a distress phrase."""
+    return [line for line in log_text.splitlines()
+            if any(p in line for p in RESHARD_DISTRESS_PHRASES)]
+
+
+def check_no_resharding(log_text, context=""):
+    """Raise RuntimeError when a compile log shows SPMD distress."""
+    hits = find_resharding_warnings(log_text)
+    if hits:
+        preview = "\n".join(hits[:5])
+        raise RuntimeError(
+            f"XLA SPMD partitioner fell back to full rematerialization"
+            f"{' in ' + context if context else ''} "
+            f"({len(hits)} occurrence(s)):\n{preview}")

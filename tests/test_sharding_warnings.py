@@ -1,0 +1,101 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The dp+sp+ep composition must compile without SPMD distress.
+
+Round-1 verdict: the MoE (data, context, expert) train step compiled
+with repeated "Involuntary full rematerialization" warnings — XLA
+replicating LayerNorm/attention gradient tensors because the residual
+stream had no explicit sharding while the MoE dispatch pinned its
+tokens to a fully-sharded layout. These tests compile the composed
+step with fd-level stderr capture and fail on any recurrence.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from container_engine_accelerators_tpu.models import MoETransformerLM
+from container_engine_accelerators_tpu.models import moe as moe_mod
+from container_engine_accelerators_tpu.models.transformer import (
+    next_token_loss_fn,
+)
+from container_engine_accelerators_tpu.parallel import (
+    Trainer,
+    batch_sharding,
+    ring_attention,
+)
+from container_engine_accelerators_tpu.parallel.context import CONTEXT_AXIS
+from container_engine_accelerators_tpu.parallel.expert import EXPERT_AXIS
+from container_engine_accelerators_tpu.parallel.mesh import DATA_AXIS
+from container_engine_accelerators_tpu.parallel.train import (
+    cross_entropy_loss,
+)
+from container_engine_accelerators_tpu.utils.xla_warnings import (
+    capture_stderr_fd,
+    check_no_resharding,
+    find_resharding_warnings,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+
+
+def _moe_step_log():
+    """Compile one dp+sp+ep MoE train step, returning the stderr log."""
+    from jax.sharding import Mesh
+
+    devices = jax.devices()[:8]
+    mesh3 = Mesh(np.array(devices).reshape(2, 2, 2),
+                 (DATA_AXIS, CONTEXT_AXIS, EXPERT_AXIS))
+    attn = functools.partial(ring_attention, mesh3,
+                             axis_name=CONTEXT_AXIS,
+                             batch_axis=DATA_AXIS)
+    lm = MoETransformerLM(
+        vocab_size=32, embed_dim=32, num_layers=2, num_heads=4,
+        num_experts=4, max_seq_len=16, dtype=jnp.float32,
+        attention_fn=attn, mesh=mesh3)
+    trainer = Trainer(
+        moe_mod.make_apply_fn(lm),
+        moe_mod.with_router_loss(next_token_loss_fn(cross_entropy_loss)),
+        optax.adam(1e-3), mesh=mesh3)
+
+    with capture_stderr_fd(echo=False) as cap:
+        tokens = jnp.zeros((8, 16), jnp.int32)
+        variables = lm.init(jax.random.PRNGKey(0), tokens)
+        state = trainer.init_state(variables)
+        batch = (jax.device_put(tokens, batch_sharding(mesh3)),
+                 jax.device_put(tokens, batch_sharding(mesh3)))
+        state, loss = trainer.train_step(state, batch)
+        jax.block_until_ready(loss)
+    return cap.text
+
+
+def test_moe_dp_sp_ep_compiles_without_full_remat():
+    log = _moe_step_log()
+    check_no_resharding(log, context="dp+sp+ep MoE train step")
+
+
+def test_find_resharding_warnings_detects_phrase():
+    log = ("something fine\n"
+           "2026-01-01 spmd_partitioner.cc: Involuntary full "
+           "rematerialization for add_any\nmore\n")
+    assert len(find_resharding_warnings(log)) == 1
+    with pytest.raises(RuntimeError, match="rematerialization"):
+        check_no_resharding(log)
+    check_no_resharding("clean compile log")

@@ -63,7 +63,8 @@ def main(argv=None):
     p.add_argument("--num-heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
     p.add_argument("--iters", type=int, default=10)
-    p.add_argument("--causal", action="store_true", default=True)
+    p.add_argument("--causal", action=argparse.BooleanOptionalAction,
+                   default=True)
     p.add_argument("--dtype", default="bfloat16")
     args = p.parse_args(argv)
 
