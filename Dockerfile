@@ -6,6 +6,7 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 COPY native /src/native
 COPY demo/tpu-error /src/demo/tpu-error
 RUN make -C /src/native/tpuinfo OUT=/src/build && \
+    make -C /src/native/sampler OUT=/src/build && \
     make -C /src/demo/tpu-error OUT=/src/build
 
 FROM python:3.12-slim
@@ -13,6 +14,7 @@ RUN pip install --no-cache-dir grpcio protobuf prometheus-client
 COPY container_engine_accelerators_tpu /plugin/container_engine_accelerators_tpu
 COPY cmd /plugin/cmd
 COPY --from=build /src/build/libtpuinfo.so /plugin/build/libtpuinfo.so
+COPY --from=build /src/build/tpu_state_sampler /plugin/build/tpu_state_sampler
 COPY --from=build /src/build/inject_fault /plugin/build/inject_fault
 ENV CEA_TPUINFO_LIB=/plugin/build/libtpuinfo.so
 # Suggested: -v equivalent via TPU_PLUGIN_VERBOSITY=3 for debug logs.

@@ -12,6 +12,7 @@ all: native
 
 native:
 	$(MAKE) -C native/tpuinfo
+	$(MAKE) -C native/sampler
 	$(MAKE) -C demo/tpu-error
 
 test: native
@@ -46,6 +47,7 @@ push: container partition-tpu
 
 clean:
 	$(MAKE) -C native/tpuinfo clean
+	$(MAKE) -C native/sampler clean
 	$(MAKE) -C demo/tpu-error clean
 
 .PHONY: all native test test-native presubmit bench container \

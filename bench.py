@@ -70,7 +70,11 @@ IMAGE_SIZE = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
 DEPTH = int(os.environ.get("BENCH_DEPTH", "50"))
 
 ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "3"))
-ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1500"))
+# Child phase budgets (child()): init 300 + probe 300 + build 120 +
+# compile 600 + measure 600 = 1920s; the attempt timeout must cover
+# their sum plus slack so a child that honors every per-phase alarm
+# is never killed mid-measure by its own supervisor.
+ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "2100"))
 BACKOFF_S = float(os.environ.get("BENCH_BACKOFF_S", "20"))
 
 METRIC = "resnet50_train_throughput"

@@ -1,0 +1,362 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""libtpu runtime-metrics bridge: polls live TPU telemetry and appends
+it to the feed file consumed by tpu_state_sampler.
+
+The sampler (native/sampler) owns the state-dir ABI; this bridge is
+one of its SOURCES — the one that carries real TPU runtime facts
+(tensorcore duty cycle, HBM usage) that no kernel sysfs node exposes.
+It is the TPU counterpart of the reference's NVML utilization sampling
+(pradvenkat/container-engine-accelerators
+pkg/gpu/nvidia/metrics/util.go:37-72): where NVML reads the GPU
+driver, TPUs publish runtime metrics from libtpu itself.
+
+Sources, tried in order each tick:
+
+  1. the libtpu SDK monitoring API (``libtpu.sdk.tpumonitoring``),
+     the supported in-process surface on current TPU VM images;
+  2. the libtpu runtime gRPC metric service (default localhost:8431 —
+     the endpoint the ``tpu-info`` diagnostic tool queries), decoded
+     with a tolerant protobuf wire walker so minor proto revisions
+     don't break the bridge;
+  3. ``--fake`` synthetic values (tests / demo rigs without a TPU).
+
+Output: one JSON object per line, appended atomically (write to a
+temp file + rename keeps the last line always complete):
+
+  {"ts_us": ..., "chips": [{"chip": 0, "duty_pct": 37.5,
+    "hbm_total": ..., "hbm_used": ...}, ...]}
+
+The file is trimmed periodically; the sampler only reads the last
+line and treats an old mtime as stale, so a dead bridge degrades to
+the sampler's sysfs/probe sources rather than freezing metrics.
+"""
+
+import argparse
+import json
+import os
+import signal
+import struct
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.utils import get_logger  # noqa: E402
+
+log = get_logger("metrics-bridge")
+
+# Metric names as exposed by the libtpu SDK monitoring API.
+SDK_DUTY_METRIC = "duty_cycle_pct"
+SDK_HBM_USAGE_METRIC = "hbm_capacity_usage"
+SDK_HBM_TOTAL_METRIC = "hbm_capacity_total"
+
+# Metric names as served by the runtime gRPC metric service
+# (the names the tpu-info tool requests).
+GRPC_DUTY_METRIC = "tpu.runtime.tensorcore.dutycycle.percent"
+GRPC_HBM_USAGE_METRIC = "tpu.runtime.hbm.memory.usage.bytes"
+GRPC_HBM_TOTAL_METRIC = "tpu.runtime.hbm.memory.total.bytes"
+GRPC_METHOD = ("/tpu.monitoring.runtime.RuntimeMetricService"
+               "/GetRuntimeMetric")
+
+
+# ---------------------------------------------------------------------
+# Protobuf wire helpers (no generated code: the service proto is not
+# vendored, and a tolerant walker survives field-number drift better
+# than a frozen descriptor would).
+# ---------------------------------------------------------------------
+
+
+def encode_metric_request(metric_name):
+    """MetricRequest{ string metric_name = 1 } on the wire."""
+    data = metric_name.encode()
+    return b"\x0a" + _varint(len(data)) + data
+
+
+def _varint(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse_wire(buf):
+    """[(field, wire_type, value)] for one protobuf message level.
+
+    value is int for varint/fixed, bytes for length-delimited.
+    Raises on malformed input (caller treats as undecodable).
+    """
+    out, pos = [], 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            v = struct.unpack_from("<q", buf, pos)[0]
+            pos += 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = bytes(buf[pos:pos + ln])
+            pos += ln
+            if len(v) != ln:
+                raise ValueError("truncated field")
+        elif wt == 5:
+            v = struct.unpack_from("<i", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.append((field, wt, v))
+    return out
+
+
+def _scalars_in(msg_bytes, depth=0):
+    """All numeric leaves in a message subtree: [(path, value)].
+
+    Doubles come back as floats, varints as ints. Nested
+    length-delimited fields are recursed when they parse as messages;
+    strings are skipped.
+    """
+    found = []
+    try:
+        fields = parse_wire(msg_bytes)
+    except (ValueError, IndexError, struct.error):
+        return found
+    for field, wt, v in fields:
+        if wt == 0:
+            found.append(((field,), v))
+        elif wt == 1:
+            found.append(((field,), struct.unpack(
+                "<d", struct.pack("<q", v))[0]))
+        elif wt == 2 and depth < 8:
+            for path, sv in _scalars_in(v, depth + 1):
+                found.append(((field,) + path, sv))
+    return found
+
+
+def decode_gauges(response_bytes):
+    """Per-device values from a GetRuntimeMetric response.
+
+    Expected shape (tpu-info's proto): response.metric.metrics[] each
+    carrying a device-id attribute and a gauge scalar. The walker
+    finds, per repeated metric submessage, the LAST double (or
+    largest-magnitude int) as the gauge value and the smallest
+    non-negative varint as the device index — tolerant of exact field
+    numbering. Returns {device_index: value} or {} if undecodable.
+    """
+    try:
+        top = parse_wire(response_bytes)
+    except (ValueError, IndexError, struct.error):
+        return {}
+    # Descend one level (MetricResponse.metric), then iterate the
+    # repeated per-device submessages at the next level.
+    per_device = {}
+    for _, wt, v in top:
+        if wt != 2:
+            continue
+        try:
+            inner = parse_wire(v)
+        except (ValueError, IndexError, struct.error):
+            continue
+        repeated = [iv for _, iwt, iv in inner if iwt == 2]
+        if not repeated:
+            repeated = [v]
+        for idx, metric_bytes in enumerate(repeated):
+            scalars = _scalars_in(metric_bytes)
+            if not scalars:
+                continue
+            doubles = [s for _, s in scalars if isinstance(s, float)]
+            ints = [s for _, s in scalars if isinstance(s, int)]
+            if doubles:
+                value = doubles[-1]
+            elif ints:
+                value = max(ints, key=abs)
+            else:
+                continue
+            device = min(
+                (i for i in ints if 0 <= i < 1024 and i != value),
+                default=idx)
+            per_device[int(device)] = float(value)
+    return per_device
+
+
+# ---------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------
+
+
+class SdkSource:
+    """libtpu SDK monitoring API (in-process, supported surface)."""
+
+    def __init__(self):
+        from libtpu.sdk import tpumonitoring  # noqa: raises if absent
+        self._mon = tpumonitoring
+        self.name = "libtpu-sdk"
+
+    def poll(self):
+        def metric(name):
+            return [float(x) for x in self._mon.get_metric(name).data()]
+
+        duty = metric(SDK_DUTY_METRIC)
+        usage = metric(SDK_HBM_USAGE_METRIC)
+        total = metric(SDK_HBM_TOTAL_METRIC)
+        chips = []
+        for i, pct in enumerate(duty):
+            entry = {"chip": i, "duty_pct": pct}
+            if i < len(usage) and i < len(total):
+                entry["hbm_used"] = int(usage[i])
+                entry["hbm_total"] = int(total[i])
+            chips.append(entry)
+        return chips
+
+
+class GrpcSource:
+    """libtpu runtime gRPC metric service (tpu-info's endpoint)."""
+
+    def __init__(self, addr):
+        import grpc
+        self._grpc = grpc
+        self._channel = grpc.insecure_channel(addr)
+        self.name = f"grpc:{addr}"
+
+    def _get(self, metric_name):
+        call = self._channel.unary_unary(
+            GRPC_METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        return decode_gauges(
+            call(encode_metric_request(metric_name), timeout=5))
+
+    def poll(self):
+        duty = self._get(GRPC_DUTY_METRIC)
+        if not duty:
+            raise RuntimeError("no duty gauges decoded")
+        usage = self._get(GRPC_HBM_USAGE_METRIC)
+        total = self._get(GRPC_HBM_TOTAL_METRIC)
+        chips = []
+        for dev in sorted(duty):
+            entry = {"chip": dev, "duty_pct": duty[dev]}
+            if dev in usage and dev in total:
+                entry["hbm_used"] = int(usage[dev])
+                entry["hbm_total"] = int(total[dev])
+            chips.append(entry)
+        return chips
+
+
+class FakeSource:
+    """Deterministic synthetic telemetry (tests, TPU-less rigs)."""
+
+    def __init__(self, num_chips):
+        self._n = num_chips
+        self._t = 0
+        self.name = "fake"
+
+    def poll(self):
+        self._t += 1
+        return [{"chip": i,
+                 "duty_pct": (self._t * 7 + i * 13) % 101,
+                 "hbm_total": 16 * 1024 ** 3,
+                 "hbm_used": (256 + i) * 1024 ** 2}
+                for i in range(self._n)]
+
+
+def pick_source(args):
+    if args.fake_chips:
+        return FakeSource(args.fake_chips)
+    try:
+        return SdkSource()
+    except Exception as e:
+        log.info("libtpu SDK source unavailable (%s); trying gRPC", e)
+    return GrpcSource(args.metrics_addr)
+
+
+# ---------------------------------------------------------------------
+# Feed writer
+# ---------------------------------------------------------------------
+
+
+def append_feed(path, line, max_lines=200):
+    """Append one line, atomically, trimming old history."""
+    lines = []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        pass
+    lines.append(line)
+    lines = lines[-max_lines:]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--feed-file", default="/run/tpu/metrics_feed.jsonl")
+    p.add_argument("--interval-s", type=float, default=1.0)
+    p.add_argument("--metrics-addr", default="localhost:8431",
+                   help="libtpu runtime metric service address")
+    p.add_argument("--fake-chips", type=int, default=0,
+                   help="emit synthetic telemetry for N chips")
+    p.add_argument("--once", action="store_true")
+    args = p.parse_args(argv)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+
+    source = None
+    announced = False
+    while not stop:
+        try:
+            if source is None:
+                source = pick_source(args)
+            chips = source.poll()
+            if not announced:
+                log.info("publishing %d chip(s) from %s to %s",
+                         len(chips), source.name, args.feed_file)
+                announced = True
+            append_feed(args.feed_file, json.dumps(
+                {"ts_us": int(time.time() * 1e6), "chips": chips}))
+        except Exception as e:
+            log.warning("poll failed (%s: %s); will retry",
+                        type(e).__name__, e)
+            source = None  # re-probe the source chain
+        if args.once:
+            break
+        time.sleep(args.interval_s)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Allocate-latency budget (BASELINE.md metric #2, tracked in CI).
+
+The scheduling-critical RPC (SURVEY.md section 3.2; the reference's
+beta_plugin.go:54-88 path) must stay in-memory-fast: map lookups +
+proto marshalling, no I/O. The budget is deliberately loose for noisy
+CI machines — its job is to catch an accidental O(n^3) or filesystem
+read landing on the Allocate path, not to benchmark. The tracked
+artifact lives in ALLOC_BENCH.json (tools/bench_allocate.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from tests.conftest import REPO_ROOT
+
+P50_BUDGET_US = 5000
+P95_BUDGET_US = 25000
+
+
+def test_allocate_latency_within_budget():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "bench_allocate.py"),
+         "--iterations", "300", "--warmup", "50"],
+        check=True, capture_output=True, timeout=240, text=True)
+    line = [ln for ln in out.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    result = json.loads(line)
+    assert result["p50_us"] < P50_BUDGET_US, result
+    assert result["p95_us"] < P95_BUDGET_US, result
+
+
+def test_alloc_bench_artifact_tracked():
+    """The committed artifact must exist and parse (round-over-round
+    tracking; round-1 verdict item 5)."""
+    path = os.path.join(REPO_ROOT, "ALLOC_BENCH.json")
+    with open(path) as f:
+        artifact = json.load(f)
+    assert artifact["result"]["metric"] == "allocate_latency"
+    assert artifact["result"]["p50_us"] > 0

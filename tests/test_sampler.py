@@ -1,0 +1,285 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""tpu_state_sampler + tpu_metrics_bridge: the telemetry producers.
+
+Round-1 verdict item 3: the state-dir ABI had no producer on a real
+node. These tests drive the C++ sampler binary against synthetic
+sysfs trees / metric feeds (the same fake-hardware technique the
+reference uses for /dev and /proc — SURVEY.md section 4) and check
+the full loop: producer writes -> native chip backend reads ->
+health/duty/hbm surface correct values.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.conftest import NATIVE_LIB, REPO_ROOT
+
+SAMPLER = os.path.join(REPO_ROOT, "build", "tpu_state_sampler")
+BRIDGE = os.path.join(REPO_ROOT, "cmd", "tpu_metrics_bridge.py")
+
+
+def _ensure_sampler():
+    if not os.path.exists(SAMPLER):
+        subprocess.run(
+            ["make", "-C", os.path.join(REPO_ROOT, "native", "sampler")],
+            check=False, capture_output=True)
+    return os.path.exists(SAMPLER)
+
+
+pytestmark = pytest.mark.skipif(
+    not _ensure_sampler(), reason="sampler binary failed to build")
+
+
+def _mknode(tmp_path, chips=2):
+    dev = tmp_path / "dev"
+    state = tmp_path / "state"
+    sysfs = tmp_path / "sysfs"
+    dev.mkdir()
+    state.mkdir()
+    sysfs.mkdir()
+    for i in range(chips):
+        (dev / f"accel{i}").touch()
+    return dev, state, sysfs
+
+
+def _run_once(dev, state, sysfs, *extra):
+    subprocess.run(
+        [SAMPLER, "--dev-dir", str(dev), "--state-dir", str(state),
+         "--sysfs-root", str(sysfs), "--once", *extra],
+        check=True, capture_output=True, timeout=30)
+
+
+def test_health_probe_marks_present_chips_ok(tmp_path):
+    dev, state, sysfs = _mknode(tmp_path)
+    _run_once(dev, state, sysfs)
+    for i in range(2):
+        health = (state / f"accel{i}" / "health").read_text().strip()
+        assert health == "ok"
+
+
+def test_sysfs_error_counter_marks_chip_wedged(tmp_path):
+    dev, state, sysfs = _mknode(tmp_path)
+    d = sysfs / "accel1" / "device"
+    d.mkdir(parents=True)
+    (d / "errors").write_text("3\n")
+    _run_once(dev, state, sysfs)
+    assert (state / "accel0" / "health").read_text().strip() == "ok"
+    assert (state / "accel1" / "health").read_text().strip() == "wedged"
+
+
+def test_sysfs_counters_published_verbatim(tmp_path):
+    dev, state, sysfs = _mknode(tmp_path, chips=1)
+    d = sysfs / "accel0" / "device"
+    d.mkdir(parents=True)
+    (d / "tc_busy_time_us").write_text("500000\n")
+    (d / "tc_total_time_us").write_text("1000000\n")
+    (d / "hbm_total_bytes").write_text(str(16 * 1024 ** 3))
+    (d / "hbm_used_bytes").write_text(str(1024 ** 3))
+    _run_once(dev, state, sysfs)
+    busy, total = map(
+        int, (state / "accel0" / "duty_cycle").read_text().split())
+    assert (busy, total) == (500000, 1000000)
+    hbm_total, hbm_used = map(
+        int, (state / "accel0" / "hbm").read_text().split())
+    assert (hbm_total, hbm_used) == (16 * 1024 ** 3, 1024 ** 3)
+
+
+def test_feed_duty_integrates_to_cumulative_counters(tmp_path):
+    """A steady 50% feed must integrate into counters whose ratio the
+    native backend reads back as ~50%."""
+    dev, state, sysfs = _mknode(tmp_path, chips=1)
+    feed = tmp_path / "feed.jsonl"
+    feed.write_text(json.dumps(
+        {"ts_us": int(time.time() * 1e6),
+         "chips": [{"chip": 0, "duty_pct": 50.0,
+                    "hbm_total": 1000, "hbm_used": 10}]}) + "\n")
+    proc = subprocess.Popen(
+        [SAMPLER, "--dev-dir", str(dev), "--state-dir", str(state),
+         "--sysfs-root", str(sysfs), "--feed-file", str(feed),
+         "--interval-ms", "50"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 20
+        duty_path = state / "accel0" / "duty_cycle"
+        while time.monotonic() < deadline:
+            # Refresh mtime so the feed never goes stale mid-test.
+            os.utime(feed)
+            if duty_path.exists():
+                busy, total = map(int, duty_path.read_text().split())
+                if total > 200000:  # >= ~4 integration ticks
+                    break
+            time.sleep(0.05)
+        else:
+            pytest.fail("duty_cycle never accumulated")
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+    assert busy == pytest.approx(total * 0.5, rel=0.15)
+    hbm_total, hbm_used = map(
+        int, (state / "accel0" / "hbm").read_text().split())
+    assert (hbm_total, hbm_used) == (1000, 10)
+
+
+def test_large_feed_last_line_wins(tmp_path):
+    """The bridge trims the feed at ~200 lines (tens of KB); the
+    sampler must read the true last line, not a truncated prefix."""
+    dev, state, sysfs = _mknode(tmp_path, chips=1)
+    feed = tmp_path / "feed.jsonl"
+    lines = [json.dumps({"ts_us": i, "chips": [
+        {"chip": 0, "health": "wedged",
+         "hbm_total": 1, "hbm_used": 1}]}) for i in range(199)]
+    lines.append(json.dumps({"ts_us": 199, "chips": [
+        {"chip": 0, "health": "ok",
+         "hbm_total": 4000, "hbm_used": 40}]}))
+    feed.write_text("\n".join(lines) + "\n")
+    assert feed.stat().st_size > 8192
+    _run_once(dev, state, sysfs, "--feed-file", str(feed))
+    assert (state / "accel0" / "health").read_text().strip() == "ok"
+    hbm_total, hbm_used = map(
+        int, (state / "accel0" / "hbm").read_text().split())
+    assert (hbm_total, hbm_used) == (4000, 40)
+
+
+def test_feed_health_overrides_probe(tmp_path):
+    dev, state, sysfs = _mknode(tmp_path, chips=2)
+    feed = tmp_path / "feed.jsonl"
+    feed.write_text(json.dumps(
+        {"ts_us": 1, "chips": [
+            {"chip": 0, "health": "uncorrectable_ecc"},
+            {"chip": 1, "health": "ok"}]}) + "\n")
+    _run_once(dev, state, sysfs, "--feed-file", str(feed))
+    assert ((state / "accel0" / "health").read_text().strip()
+            == "uncorrectable_ecc")
+    assert (state / "accel1" / "health").read_text().strip() == "ok"
+
+
+def test_stale_feed_ignored(tmp_path):
+    dev, state, sysfs = _mknode(tmp_path, chips=1)
+    feed = tmp_path / "feed.jsonl"
+    feed.write_text(json.dumps(
+        {"ts_us": 1, "chips": [{"chip": 0, "health": "wedged"}]}) + "\n")
+    old = time.time() - 3600
+    os.utime(feed, (old, old))
+    _run_once(dev, state, sysfs, "--feed-file", str(feed))
+    # Stale feed -> fall back to the probe (regular file opens fine).
+    assert (state / "accel0" / "health").read_text().strip() == "ok"
+
+
+def test_counters_monotonic_across_restarts(tmp_path):
+    dev, state, sysfs = _mknode(tmp_path, chips=1)
+    d = sysfs / "accel0" / "device"
+    d.mkdir(parents=True)
+    (d / "tc_busy_time_us").write_text("100\n")
+    (d / "tc_total_time_us").write_text("200\n")
+    _run_once(dev, state, sysfs)
+    (d / "tc_busy_time_us").write_text("300\n")
+    (d / "tc_total_time_us").write_text("600\n")
+    _run_once(dev, state, sysfs)
+    busy, total = map(
+        int, (state / "accel0" / "duty_cycle").read_text().split())
+    assert (busy, total) == (300, 600)
+
+
+def test_native_backend_reads_sampler_output(tmp_path):
+    """Producer -> consumer loop: the backend that health/metrics use
+    must read what the sampler wrote."""
+    if NATIVE_LIB is None:
+        pytest.skip("native lib unavailable")
+    dev, state, sysfs = _mknode(tmp_path, chips=2)
+    d = sysfs / "accel0" / "device"
+    d.mkdir(parents=True)
+    (d / "hbm_total_bytes").write_text(str(32 * 1024 ** 3))
+    (d / "hbm_used_bytes").write_text(str(2 * 1024 ** 3))
+    derr = sysfs / "accel1" / "device"
+    derr.mkdir(parents=True)
+    (derr / "errors").write_text("1\n")
+    (state / "topology").write_text("1x2")
+    _run_once(dev, state, sysfs)
+
+    from container_engine_accelerators_tpu.chip import get_backend
+    from container_engine_accelerators_tpu.chip.backend import Health
+    b = get_backend()
+    b.init(str(dev), str(state))
+    assert b.chip_health(0) == Health.OK
+    assert b.chip_health(1) == Health.WEDGED
+    assert b.chip_hbm(0) == (32 * 1024 ** 3, 2 * 1024 ** 3)
+
+
+def test_bridge_fake_source_feeds_sampler(tmp_path):
+    """Full producer chain: bridge (fake telemetry) -> feed file ->
+    sampler -> state dir."""
+    dev, state, sysfs = _mknode(tmp_path, chips=2)
+    feed = tmp_path / "feed.jsonl"
+    subprocess.run(
+        [sys.executable, BRIDGE, "--feed-file", str(feed),
+         "--fake-chips", "2", "--once"],
+        check=True, capture_output=True, timeout=60)
+    line = json.loads(feed.read_text().splitlines()[-1])
+    assert [c["chip"] for c in line["chips"]] == [0, 1]
+    _run_once(dev, state, sysfs, "--feed-file", str(feed))
+    hbm_total, hbm_used = map(
+        int, (state / "accel0" / "hbm").read_text().split())
+    assert hbm_total == 16 * 1024 ** 3
+    assert hbm_used == 256 * 1024 ** 2
+
+
+def test_bridge_wire_codec_roundtrip():
+    """The tolerant decoder must extract per-device gauges from a
+    response shaped like the runtime metric service's."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "cmd"))
+    from tpu_metrics_bridge import (
+        decode_gauges,
+        encode_metric_request,
+        parse_wire,
+    )
+
+    req = encode_metric_request("tpu.runtime.tensorcore.dutycycle.percent")
+    fields = parse_wire(req)
+    assert fields[0][0] == 1
+    assert fields[0][2].decode().endswith("percent")
+
+    # MetricResponse{ metric { metrics[] { attr{device=N} gauge{double} } } }
+    def varint(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(field, payload):
+        return bytes([(field << 3) | 2]) + varint(len(payload)) + payload
+
+    def vint(field, v):
+        return bytes([(field << 3) | 0]) + varint(v)
+
+    def dbl(field, v):
+        import struct as s
+        return bytes([(field << 3) | 1]) + s.pack("<d", v)
+
+    metrics = b"".join(
+        ld(2, ld(1, vint(2, dev)) + ld(3, dbl(1, 25.0 * (dev + 1))))
+        for dev in range(2))
+    resp = ld(1, ld(1, b"name") + metrics)
+    gauges = decode_gauges(resp)
+    assert gauges == {0: 25.0, 1: 50.0}
