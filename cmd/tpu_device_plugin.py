@@ -36,6 +36,9 @@ from container_engine_accelerators_tpu.plugin import config as cfg
 from container_engine_accelerators_tpu.plugin.health import (
     TpuHealthChecker,
 )
+from container_engine_accelerators_tpu.plugin.envs import (
+    parse_process_bounds,
+)
 from container_engine_accelerators_tpu.plugin.manager import TpuManager
 from container_engine_accelerators_tpu.plugin.metrics import (
     DEFAULT_INTERVAL_MS,
@@ -81,6 +84,11 @@ def parse_args(argv=None):
                    default=os.environ.get("TPU_WORKER_HOSTNAMES",
                                           "localhost"),
                    help="comma-separated hostnames of all slice workers")
+    p.add_argument("--tpu-process-bounds",
+                   default=os.environ.get("TPU_PROCESS_BOUNDS", ""),
+                   help="host grid of the slice as x,y,z (e.g. 2,2,1 "
+                        "for a 4-host v5e-16); empty selects the "
+                        "linear 1,1,N default")
     return p.parse_args(argv)
 
 
@@ -93,12 +101,16 @@ def main(argv=None):
     backend = get_backend()
     mounts = [(args.container_path, args.host_path)] \
         if os.path.isdir(args.host_path) else []
+    process_bounds = None
+    if args.tpu_process_bounds:
+        process_bounds = parse_process_bounds(args.tpu_process_bounds)
     manager = TpuManager(
         dev_dir=args.device_dir, state_dir=args.state_dir,
         mount_paths=mounts, tpu_config=tpu_config, backend=backend,
         worker_id=args.tpu_worker_id,
         worker_hostnames=tuple(
-            h for h in args.tpu_worker_hostnames.split(",") if h))
+            h for h in args.tpu_worker_hostnames.split(",") if h),
+        process_bounds=process_bounds)
 
     # Retry until the driver stack has surfaced the chips
     # (nvidia_gpu.go:88-98: 5s cadence).

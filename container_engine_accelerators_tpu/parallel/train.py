@@ -70,14 +70,26 @@ class Trainer:
     # -- state --------------------------------------------------------
 
     def init_state(self, init_variables):
-        """Create TrainState laid out per the mesh sharding rules."""
+        """Create TrainState laid out per the mesh sharding rules.
+
+        The optimizer init runs inside a single jit with explicit
+        out_shardings: optax builds its state with one eager op per
+        parameter leaf, which on a remote/tunneled backend costs one
+        host round trip each — compiled, the whole init is one XLA
+        program and the state materializes already laid out.
+        """
         params = init_variables["params"]
         batch_stats = init_variables.get("batch_stats", {})
-        opt_state = self._tx.init(params)
-        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                           opt_state=opt_state, batch_stats=batch_stats)
-        shardings = self.state_shardings(state)
-        return jax.device_put(state, shardings)
+
+        def make_state(params, batch_stats):
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=self._tx.init(params),
+                              batch_stats=batch_stats)
+
+        abstract = jax.eval_shape(make_state, params, batch_stats)
+        shardings = self.state_shardings(abstract)
+        return jax.jit(make_state, out_shardings=shardings)(
+            params, batch_stats)
 
     def state_shardings(self, state):
         if self._state_shardings is None:

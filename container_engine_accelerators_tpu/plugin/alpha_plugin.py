@@ -42,7 +42,7 @@ class PluginServiceV1Alpha(DevicePluginV1AlphaServicer):
     def ListAndWatch(self, request, context):
         log.info("device-plugin (v1alpha): ListAndWatch started")
         last = None
-        while context.is_active() and not self._m._stop.is_set():
+        while context.is_active() and not self._m.is_stopping():
             if last is None:
                 devices = self._m.list_devices()
             else:

@@ -53,7 +53,7 @@ class PluginServiceV1Beta1(DevicePluginV1Beta1Servicer):
         """
         log.info("device-plugin: ListAndWatch started")
         last = None
-        while context.is_active() and not self._m._stop.is_set():
+        while context.is_active() and not self._m.is_stopping():
             if last is None:
                 devices = self._m.list_devices()
             else:

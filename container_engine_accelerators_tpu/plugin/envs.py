@@ -25,9 +25,11 @@ ICI. This module composes those envs from the allocated chip set:
                                  contiguous box — else omitted so
                                  libtpu falls back to flat enumeration)
     TPU_PROCESS_BOUNDS           process grid: "1,1,1" single-host;
-                                 "1,1,N" for N hosts (hosts stacked
-                                 along z — non-linear host grids
-                                 override via the Job downward API)
+                                 "1,1,N" for N hosts by default, or an
+                                 explicit non-linear grid ("x,y,z")
+                                 when the plugin is started with
+                                 --tpu-process-bounds (e.g. "2,2,1"
+                                 for a 4-host v5e-16)
     CLOUD_TPU_TASK_ID / TPU_WORKER_ID
                                  worker index within the job
     TPU_WORKER_HOSTNAMES         comma-separated coordinator hostnames
@@ -61,14 +63,42 @@ def chips_form_box(coords):
     return True
 
 
-def topology_envs(chips, coords, worker_id=0, worker_hostnames=("localhost",)):
+def parse_process_bounds(text):
+    """Parse a process grid spec ("2,2,1" or "2x2x1") into (x, y, z).
+
+    Raises ValueError on malformed specs; pads missing trailing dims
+    with 1 so "2,2" means a 2x2x1 host grid. Delegates to the one
+    shape-grammar authority (chip.backend.parse_shape) so the two
+    spec languages cannot drift apart.
+    """
+    from ..chip.backend import BadShapeError, parse_shape
+    try:
+        return parse_shape(text.replace(",", "x") if isinstance(text, str)
+                           else text)
+    except BadShapeError:
+        raise ValueError(f"bad process bounds: {text!r}")
+
+
+def topology_envs(chips, coords, worker_id=0, worker_hostnames=("localhost",),
+                  process_bounds=None):
     """Compose the env map for an allocation.
 
     chips:  sorted chip indices being handed to the container.
     coords: parallel list of (x, y, z) torus coordinates.
+    process_bounds: optional (x, y, z) host grid; the product must
+        equal the worker count. None means the linear default.
     """
     n_workers = max(len(worker_hostnames), 1)
-    process_bounds = "1,1,1" if n_workers == 1 else f"1,1,{n_workers}"
+    if process_bounds is not None:
+        px, py, pz = process_bounds
+        if px * py * pz != n_workers:
+            raise ValueError(
+                f"process bounds {px}x{py}x{pz} do not cover "
+                f"{n_workers} workers")
+        bounds = (px, py, pz)
+    else:
+        bounds = (1, 1, 1) if n_workers == 1 else (1, 1, n_workers)
+    process_bounds = f"{bounds[0]},{bounds[1]},{bounds[2]}"
     envs = {
         "TPU_VISIBLE_DEVICES": ",".join(str(c) for c in chips),
         "TPU_PROCESS_BOUNDS": process_bounds,

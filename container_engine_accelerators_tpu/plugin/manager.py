@@ -57,13 +57,21 @@ class TpuManager:
 
     def __init__(self, dev_dir=cfg.DEVICE_DIR, state_dir=cfg.STATE_DIR,
                  mount_paths=None, tpu_config=None, backend=None,
-                 worker_id=0, worker_hostnames=("localhost",)):
+                 worker_id=0, worker_hostnames=("localhost",),
+                 process_bounds=None):
         self._dev_dir = dev_dir
         self._state_dir = state_dir
         self._mount_paths = list(mount_paths or [])
         self._config = tpu_config or cfg.TpuConfig()
         self._worker_id = worker_id
         self._worker_hostnames = tuple(worker_hostnames)
+        if process_bounds is not None:
+            # Validate the host grid covers the worker set at startup,
+            # not per-Allocate.
+            topology_envs([], [], worker_id=worker_id,
+                          worker_hostnames=self._worker_hostnames,
+                          process_bounds=process_bounds)
+        self._process_bounds = process_bounds
         self._backend = backend or get_backend()
         self._devices = {}          # device id -> health string
         self._lock = threading.Lock()
@@ -176,6 +184,15 @@ class TpuManager:
             self._changed.wait(timeout)
             return dict(self._devices)
 
+    def is_stopping(self):
+        """True once stop() was called; streams must terminate.
+
+        Public liveness API for the gRPC service layers — ListAndWatch
+        loops key off this (not manager internals) so serve/stop
+        refactors can't silently break stream termination.
+        """
+        return self._stop.is_set()
+
     # -- allocation ---------------------------------------------------
 
     def device_chips(self, device_id):
@@ -223,7 +240,8 @@ class TpuManager:
         chips = sorted({c for d in device_ids for c in self.device_chips(d)})
         coords = [self._backend.chip_coords(c) for c in chips]
         return topology_envs(chips, coords, worker_id=self._worker_id,
-                             worker_hostnames=self._worker_hostnames)
+                             worker_hostnames=self._worker_hostnames,
+                             process_bounds=self._process_bounds)
 
     def mounts(self):
         return [
@@ -238,42 +256,30 @@ class TpuManager:
         (beta_plugin.go:95-98): prefer a chip set forming a contiguous
         box on the ICI torus (minimal-hop collectives), falling back
         to first-N when no box fits the availability.
+
+        Cost: box shapes are the divisor triples of `size` (not all
+        dims^3 shapes) and each candidate box is checked with O(size)
+        membership lookups, so a 256-chip slice costs thousands of set
+        probes, not millions of per-chip scans.
         """
         if size <= 0 or size > len(available):
             return list(available)[:max(size, 0)]
         if self._config.tpu_partition_size:
-            # Subslices are already topology-compact units.
-            chosen = [d for d in must_include]
-            for d in available:
-                if len(chosen) >= size:
-                    break
-                if d not in chosen:
-                    chosen.append(d)
-            return chosen[:size]
+            return self._preferred_slices(available, must_include, size)
         avail_chips = {self.device_chips(d)[0]: d for d in available}
         must_chips = {self.device_chips(d)[0] for d in must_include}
         dims = self._backend.topology()
-        coord_of = {c: self._backend.chip_coords(c) for c in avail_chips}
+        chip_at = {self._backend.chip_coords(c): c for c in avail_chips}
         best = None
-        for bx in range(1, dims[0] + 1):
-            for by in range(1, dims[1] + 1):
-                for bz in range(1, dims[2] + 1):
-                    if bx * by * bz != size:
-                        continue
-                    for ox in range(dims[0] - bx + 1):
-                        for oy in range(dims[1] - by + 1):
-                            for oz in range(dims[2] - bz + 1):
-                                box = set()
-                                for c, xyz in coord_of.items():
-                                    if (ox <= xyz[0] < ox + bx and
-                                            oy <= xyz[1] < oy + by and
-                                            oz <= xyz[2] < oz + bz):
-                                        box.add(c)
-                                if len(box) == size and must_chips <= box:
-                                    # Prefer the most cube-like box.
-                                    score = max(bx, by, bz) - min(bx, by, bz)
-                                    if best is None or score < best[0]:
-                                        best = (score, box)
+        for bx, by, bz in _box_shapes(size, dims):
+            # Prefer the most cube-like box; skip shapes that cannot
+            # beat the current best.
+            score = max(bx, by, bz) - min(bx, by, bz)
+            if best is not None and score >= best[0]:
+                continue
+            box = _find_full_box((bx, by, bz), dims, chip_at, must_chips)
+            if box is not None:
+                best = (score, box)
         if best is not None:
             return sorted(avail_chips[c] for c in best[1])
         chosen = [avail_chips[c] for c in sorted(must_chips)]
@@ -283,6 +289,30 @@ class TpuManager:
                 break
             if d not in chosen:
                 chosen.append(d)
+        return chosen[:size]
+
+    def _preferred_slices(self, available, must_include, size):
+        """Preferred set of subslice devices: greedy, ICI-adjacent.
+
+        Each subslice is already a topology-compact unit; when a pod
+        asks for several, prefer slices whose chip sets pack into the
+        smallest union bounding box (adjacent tiles share ICI links,
+        so inter-slice traffic stays short-hop) instead of first-N.
+        """
+        coords_of = {}
+        for d in available:
+            chips = self._slice_mgr.slice_chips(d) or []
+            coords_of[d] = [self._backend.chip_coords(c) for c in chips]
+        chosen = list(must_include)
+        while len(chosen) < size:
+            pool = [d for d in available if d not in chosen]
+            if not pool:
+                break
+            picked = min(pool, key=lambda d: (
+                _union_box_volume([xyz for s in chosen + [d]
+                                   for xyz in coords_of.get(s, [])]),
+                d))
+            chosen.append(picked)
         return chosen[:size]
 
     # -- serve loop ---------------------------------------------------
@@ -383,3 +413,51 @@ class TpuManager:
         self._stop.set()
         with self._changed:
             self._changed.notify_all()
+
+
+def _box_shapes(size, dims):
+    """Divisor triples (bx, by, bz) of `size` that fit inside `dims`."""
+    shapes = []
+    for bx in range(1, min(size, dims[0]) + 1):
+        if size % bx:
+            continue
+        rest = size // bx
+        for by in range(1, min(rest, dims[1]) + 1):
+            if rest % by:
+                continue
+            bz = rest // by
+            if bz <= dims[2]:
+                shapes.append((bx, by, bz))
+    return shapes
+
+
+def _find_full_box(shape, dims, chip_at, must_chips):
+    """First fully-available `shape` box containing `must_chips`.
+
+    chip_at maps (x, y, z) -> chip index for available chips only; a
+    box qualifies when every cell is available. Returns the chip set
+    or None.
+    """
+    bx, by, bz = shape
+    for ox in range(dims[0] - bx + 1):
+        for oy in range(dims[1] - by + 1):
+            for oz in range(dims[2] - bz + 1):
+                cells = [(x, y, z)
+                         for x in range(ox, ox + bx)
+                         for y in range(oy, oy + by)
+                         for z in range(oz, oz + bz)]
+                if not all(cell in chip_at for cell in cells):
+                    continue
+                box = {chip_at[cell] for cell in cells}
+                if must_chips <= box:
+                    return box
+    return None
+
+
+def _union_box_volume(coords):
+    """Volume of the bounding box of a coordinate set (0 when empty)."""
+    if not coords:
+        return 0
+    spans = [max(c[i] for c in coords) - min(c[i] for c in coords) + 1
+             for i in range(3)]
+    return spans[0] * spans[1] * spans[2]

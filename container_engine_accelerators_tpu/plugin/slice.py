@@ -24,6 +24,7 @@ mig.go:190-201) is enforced by the solver; slices are advertised as
 single schedulable devices exactly as MIG partitions are.
 """
 
+import re
 import threading
 
 from ..chip.backend import parse_shape
@@ -32,14 +33,34 @@ from ..utils import get_logger
 
 log = get_logger("slice")
 
+# The single authority for the subslice device-id namespace. Every
+# module that needs to classify a device id (manager routing, health
+# labeling, the partitioner CLI) goes through slice_device_id /
+# parse_slice_device_id below rather than matching strings itself —
+# the namespace contract lives in exactly one place. The shape
+# grammar (1-3 x-separated dims) matches chip.backend.parse_shape.
+_SLICE_ID_RE = re.compile(r"^tpu-(\d+(?:x\d+){0,2})-(\d+)$")
+
 
 def slice_device_id(shape, index):
     """Schedulable device ID for a subslice, e.g. "tpu-2x2-0"."""
-    return f"tpu-{shape}-{index}"
+    dev_id = f"tpu-{shape}-{index}"
+    if _SLICE_ID_RE.match(dev_id) is None:
+        raise ValueError(f"malformed subslice id components: "
+                         f"shape={shape!r} index={index!r}")
+    return dev_id
+
+
+def parse_slice_device_id(device_id):
+    """(shape, index) for a well-formed subslice id, else None."""
+    m = _SLICE_ID_RE.match(device_id)
+    if m is None:
+        return None
+    return m.group(1), int(m.group(2))
 
 
 def is_slice_device_id(device_id):
-    return device_id.startswith("tpu-") and device_id.count("-") >= 2
+    return parse_slice_device_id(device_id) is not None
 
 
 class SliceManager:

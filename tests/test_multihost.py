@@ -1,0 +1,180 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Multi-host slice end-to-end: plugin env contract -> jax.distributed.
+
+The reference never faces this (NCCL setup is the workload's problem);
+for TPU the plugin's Allocate response is what lets JAX initialize
+collectives across hosts (SURVEY.md section 7, "Allocate-time env
+composition"). These tests simulate a 2-host x 4-chip slice: one
+TpuManager per host (as one plugin runs per host), and the exported
+env contract must be sufficient to boot jax.distributed and run a
+sharded pjit step spanning all 8 devices — executed here as two real
+processes on the virtual CPU mesh, 4 local devices each.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from container_engine_accelerators_tpu.chip.pyfake import PyChipBackend
+from container_engine_accelerators_tpu.plugin.envs import (
+    parse_process_bounds,
+    topology_envs,
+)
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _host_manager(fake_node, worker_id, hostnames, process_bounds=None):
+    mgr = TpuManager(
+        dev_dir=fake_node.dev_dir, state_dir=fake_node.state_dir,
+        backend=PyChipBackend(), worker_id=worker_id,
+        worker_hostnames=hostnames, process_bounds=process_bounds)
+    mgr.start()
+    return mgr
+
+
+def _two_host_envs(fake_node, process_bounds=None):
+    """Env contracts for host 0 and host 1 of a 2-host x 4-chip slice.
+
+    Each host's plugin sees only its local 4 chips (a 2x2 tile of the
+    global 2x4 slice); worker identity distinguishes the hosts.
+    """
+    for i in range(4):
+        fake_node.add_chip(i)
+    fake_node.set_topology("2x2x1")
+    hostnames = ("host0", "host1")
+    out = []
+    for wid in (0, 1):
+        mgr = _host_manager(fake_node, wid, hostnames, process_bounds)
+        out.append(mgr.allocate_envs([f"accel{i}" for i in range(4)]))
+    return out
+
+
+def test_env_contract_two_hosts(fake_node):
+    envs0, envs1 = _two_host_envs(fake_node)
+    for wid, envs in enumerate((envs0, envs1)):
+        assert envs["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+        assert envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+        assert envs["TPU_PROCESS_BOUNDS"] == "1,1,2"
+        assert envs["TPU_WORKER_ID"] == str(wid)
+        assert envs["CLOUD_TPU_TASK_ID"] == str(wid)
+        assert envs["TPU_WORKER_HOSTNAMES"] == "host0,host1"
+
+
+def test_env_contract_nonlinear_process_bounds(fake_node):
+    envs0, envs1 = _two_host_envs(fake_node, process_bounds=(2, 1, 1))
+    assert envs0["TPU_PROCESS_BOUNDS"] == "2,1,1"
+    assert envs1["TPU_PROCESS_BOUNDS"] == "2,1,1"
+
+
+def test_process_bounds_must_cover_workers(fake_node):
+    with pytest.raises(ValueError):
+        _host_manager(fake_node, 0, ("host0", "host1"),
+                      process_bounds=(2, 2, 1))
+
+
+def test_parse_process_bounds():
+    assert parse_process_bounds("2,2,1") == (2, 2, 1)
+    assert parse_process_bounds("2x2x1") == (2, 2, 1)
+    assert parse_process_bounds("4") == (4, 1, 1)
+    assert parse_process_bounds("2,2") == (2, 2, 1)
+    for bad in ("", "1,2,3,4", "a,b", "0,1,1"):
+        with pytest.raises(ValueError):
+            parse_process_bounds(bad)
+
+
+def test_topology_envs_rejects_short_bounds():
+    with pytest.raises(ValueError):
+        topology_envs([0], [(0, 0, 0)], worker_hostnames=("h0", "h1", "h2"),
+                      process_bounds=(2, 1, 1))
+
+
+_WORKER_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+
+    # Everything below derives from the plugin's Allocate env contract.
+    wid = int(os.environ["TPU_WORKER_ID"])
+    hosts = os.environ["TPU_WORKER_HOSTNAMES"].split(",")
+    local_chips = os.environ["TPU_VISIBLE_DEVICES"].split(",")
+    port = sys.argv[1]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=%d" % len(local_chips))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:" + port,
+        num_processes=len(hosts), process_id=wid)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) == len(hosts) * len(local_chips), devs
+    assert len(jax.local_devices()) == len(local_chips)
+    mesh = Mesh(
+        np.array(devs).reshape(len(hosts), len(local_chips)),
+        ("host", "chip"))
+    sharding = NamedSharding(mesh, P(("host", "chip")))
+
+    n = len(devs) * 2
+    data = np.arange(n, dtype=np.float32)
+    x = jax.make_array_from_callback(
+        (n,), sharding, lambda idx: data[idx])
+    y = jax.jit(lambda a: jnp.sum(a * 2.0),
+                out_shardings=NamedSharding(mesh, P()))(x)
+    print(json.dumps({"worker": wid, "sum": float(y)}), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_pjit_step(fake_node, tmp_path):
+    """Boot two real processes from the plugin env contract and run a
+    pjit reduction over the global 2x4 device mesh."""
+    envs0, envs1 = _two_host_envs(fake_node)
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_SCRIPT)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+
+    procs = []
+    for envs in (envs0, envs1):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("TPU_", "XLA_", "JAX_"))}
+        env.update(envs)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), port],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()[-2000:]
+        line = json.loads(out.decode().strip().splitlines()[-1])
+        results[line["worker"]] = line["sum"]
+
+    n = 16  # 8 devices x 2 elements
+    expected = float(2 * sum(range(n)))
+    assert results == {0: expected, 1: expected}

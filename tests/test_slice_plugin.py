@@ -121,3 +121,19 @@ def test_slice_id_helpers():
     assert slice_device_id("2x2", 1) == "tpu-2x2-1"
     assert is_slice_device_id("tpu-2x2-1")
     assert not is_slice_device_id("accel0")
+
+
+def test_slice_id_one_authority():
+    """The id grammar must accept every shape parse_shape accepts
+    (1-3 dims) and reject everything outside the namespace."""
+    from container_engine_accelerators_tpu.plugin.slice import (
+        parse_slice_device_id,
+    )
+    # 1-dim partition shapes are valid configs (parse_shape("4") ok).
+    assert slice_device_id("4", 0) == "tpu-4-0"
+    assert is_slice_device_id("tpu-4-0")
+    assert parse_slice_device_id("tpu-4-0") == ("4", 0)
+    assert parse_slice_device_id("tpu-2x2x2-3") == ("2x2x2", 3)
+    for bad in ("tpu-2x2", "tpu--0", "tpu-2x-1", "tpu-2x2-", "tpu-2x2-a",
+                "tpu-2x2x2x2-0", "xtpu-2x2-0"):
+        assert not is_slice_device_id(bad), bad
