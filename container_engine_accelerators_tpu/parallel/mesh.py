@@ -29,8 +29,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ..utils import get_logger
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+log = get_logger("mesh")
 
 
 @dataclasses.dataclass
@@ -75,18 +79,32 @@ def grid_mesh(devices, major, minor, minor_axis):
     device list is laid out data-major, so neighboring minor-axis
     entries (model- or context-parallel peers) are adjacent chips
     under the plugin's contiguous-box allocations.
+
+    When both factors are given explicitly and name fewer devices
+    than are visible, the mesh uses the leading major*minor devices —
+    a 2x2 dp x pp grid is a legitimate ask on an 8-chip host. An
+    inferred factor (major=None) always spans every device, and
+    asking for more devices than exist is still an error.
     """
     devices = list(devices if devices is not None else jax.devices())
+    if minor < 1 or (major is not None and major < 1):
+        raise ValueError(f"mesh factors must be >= 1: {major}x{minor}")
     if major is None:
         if len(devices) % minor != 0:
             raise ValueError(
                 f"{len(devices)} devices do not factor into "
                 f"{minor_axis}={minor}")
         major = len(devices) // minor
-    if major * minor != len(devices):
+    if major * minor > len(devices):
         raise ValueError(
-            f"mesh spec {major}x{minor} != {len(devices)} devices")
-    grid = np.array(devices).reshape(major, minor)
+            f"mesh spec {major}x{minor} needs {major * minor} devices; "
+            f"only {len(devices)} visible")
+    if major * minor < len(devices):
+        # Legitimate for a deliberate submesh, but loud so a typo'd
+        # spec that idles allocated chips is visible at startup.
+        log.warning("mesh %dx%d uses %d of %d visible devices",
+                    major, minor, major * minor, len(devices))
+    grid = np.array(devices[:major * minor]).reshape(major, minor)
     return Mesh(grid, (DATA_AXIS, minor_axis))
 
 

@@ -59,9 +59,22 @@ def test_build_mesh_dp_tp():
     assert mesh.shape[MODEL_AXIS] == 2
 
 
-def test_build_mesh_wrong_size():
+def test_build_mesh_oversubscribed():
     with pytest.raises(ValueError):
-        build_mesh(MeshSpec(data=3, model=2))
+        build_mesh(MeshSpec(data=3, model=3))  # 9 > 8 visible
+
+
+def test_build_mesh_rejects_nonpositive_factors():
+    for data, model in ((0, 2), (-2, 2), (2, 0), (2, -2)):
+        with pytest.raises(ValueError):
+            build_mesh(MeshSpec(data=data, model=model))
+
+
+def test_build_mesh_explicit_submesh():
+    # Explicit factors may use a leading subset of the visible
+    # devices (e.g. a 2x2 dp x pp grid on an 8-chip host).
+    mesh = build_mesh(MeshSpec(data=3, model=2))
+    assert mesh.devices.shape == (3, 2)
 
 
 def test_chips_from_env(monkeypatch):

@@ -56,6 +56,7 @@ def sequential_apply(params, x):
     (4, 2, 4),   # dp x pp
     (8, 1, 4),   # pure pp, fewer microbatches than stages
     (2, 4, 8),   # shallow pipe, deep microbatching
+    (2, 2, 8),   # round-1 flake suspect: dp=2 x pp=2, deep microbatching
 ])
 def test_pipeline_matches_sequential(stages, data, microbatches):
     mesh = build_pipeline_mesh(stages, data=data)
