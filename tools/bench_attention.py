@@ -66,6 +66,11 @@ def main(argv=None):
     p.add_argument("--causal", action=argparse.BooleanOptionalAction,
                    default=True)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--check-numerics", action="store_true",
+                   help="compare each schedule against dense and "
+                        "report max abs error in the JSON (validates "
+                        "the Pallas kernel on the real MXU, where "
+                        "interpret-mode tests cannot)")
     args = p.parse_args(argv)
 
     from container_engine_accelerators_tpu.ops.attention import (
@@ -104,6 +109,15 @@ def main(argv=None):
                 lambda q, k, v: ulysses_attention(mesh, q, k, v,
                                                   causal=args.causal))
 
+    reference = None
+    if args.check_numerics:
+        try:
+            reference = schedules["dense"](q, k, v)
+            jax.block_until_ready(reference)
+        except Exception as e:
+            print(json.dumps({"schedule": "dense", "seq_len": s,
+                              "numerics_error": str(e)[:200]}))
+
     for name, fn in schedules.items():
         try:
             sec = _time(fn, q, k, v, iters=args.iters)
@@ -111,16 +125,23 @@ def main(argv=None):
             print(json.dumps({"schedule": name, "seq_len": s,
                               "error": str(e)[:200]}))
             continue
-        print(json.dumps({
+        row = {
             "schedule": name,
             "seq_len": s,
             "batch": b,
             "heads": h,
             "head_dim": d,
             "devices": n,
+            "platform": jax.devices()[0].platform,
             "ms_per_call": round(sec * 1000, 3),
             "tflops": round(flops / sec / 1e12, 2),
-        }))
+        }
+        if reference is not None and name != "dense":
+            err = float(jnp.max(jnp.abs(
+                fn(q, k, v).astype(jnp.float32)
+                - reference.astype(jnp.float32))))
+            row["max_abs_err_vs_dense"] = round(err, 6)
+        print(json.dumps(row))
 
 
 if __name__ == "__main__":

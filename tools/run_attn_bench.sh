@@ -1,0 +1,46 @@
+#!/bin/bash
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+# Sweep the attention microbenchmark across sequence lengths and
+# collect the per-schedule JSON rows into one artifact
+# (ATTN_BENCH.json by default). Run on the TPU chip for real Pallas
+# kernel numbers; each row carries the platform it measured on.
+#
+# Usage: tools/run_attn_bench.sh [out.json]
+
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-ATTN_BENCH.json}"
+TMP="$(mktemp)"
+
+for SEQ in 2048 4096 8192; do
+  echo "[attn-bench] seq_len=${SEQ}" >&2
+  timeout 900 python tools/bench_attention.py \
+    --seq-len "${SEQ}" --check-numerics >> "${TMP}" \
+    || echo "{\"seq_len\": ${SEQ}, \"error\": \"run failed/timeout\"}" \
+       >> "${TMP}"
+done
+
+python - "$TMP" "$OUT" <<'EOF'
+import json, sys, datetime
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+json.dump({"generated_utc":
+           datetime.datetime.now(datetime.timezone.utc).isoformat(
+               timespec="seconds"),
+           "rows": rows}, open(sys.argv[2], "w"), indent=1)
+print(f"wrote {sys.argv[2]} with {len(rows)} rows", file=sys.stderr)
+EOF
+rm -f "${TMP}"
