@@ -31,11 +31,14 @@ log = get_logger("distributed")
 DEFAULT_COORDINATOR_PORT = 8476
 
 
-def initialize_from_plugin_env(coordinator_port=DEFAULT_COORDINATOR_PORT):
+def initialize_from_plugin_env(coordinator_port=None):
     """Initialize jax.distributed from plugin-injected envs.
 
     No-op (returns False) when the pod holds a single-host slice.
-    Worker 0's hostname serves as the coordinator.
+    Worker 0's hostname serves as the coordinator by default;
+    CEA_COORDINATOR_ADDRESS (full host:port) or CEA_COORDINATOR_PORT
+    override it for Jobs whose coordinator lives behind a different
+    Service name or port.
     """
     hostnames = [h for h in
                  os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
@@ -53,7 +56,12 @@ def initialize_from_plugin_env(coordinator_port=DEFAULT_COORDINATOR_PORT):
         raise ValueError(
             f"TPU_WORKER_ID={worker_id} out of range for "
             f"{len(hostnames)} workers")
-    coordinator = f"{hostnames[0]}:{coordinator_port}"
+    coordinator = os.environ.get("CEA_COORDINATOR_ADDRESS", "")
+    if not coordinator:
+        if coordinator_port is None:
+            coordinator_port = int(os.environ.get(
+                "CEA_COORDINATOR_PORT", DEFAULT_COORDINATOR_PORT))
+        coordinator = f"{hostnames[0]}:{coordinator_port}"
 
     import jax
 

@@ -246,6 +246,13 @@ def build_model(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    # On a multi-host slice the plugin's Allocate envs identify this
+    # pod's place; boot jax.distributed before the first backend
+    # query so jax.devices() spans every host.
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        initialize_from_plugin_env,
+    )
+    initialize_from_plugin_env()
     devices = jax.devices()
     if args.context_parallelism > 1 and args.model not in LM_MODELS:
         raise SystemExit(

@@ -111,6 +111,8 @@ def test_topology_envs_rejects_short_bounds():
 _WORKER_SCRIPT = textwrap.dedent("""
     import json, os, sys
 
+    sys.path.insert(0, "@REPO_ROOT@")
+
     # Everything below derives from the plugin's Allocate env contract.
     wid = int(os.environ["TPU_WORKER_ID"])
     hosts = os.environ["TPU_WORKER_HOSTNAMES"].split(",")
@@ -122,9 +124,14 @@ _WORKER_SCRIPT = textwrap.dedent("""
         "--xla_force_host_platform_device_count=%d" % len(local_chips))
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(
-        coordinator_address="127.0.0.1:" + port,
-        num_processes=len(hosts), process_id=wid)
+    # The framework's own bootstrap consumes the contract; the test
+    # redirects the coordinator to loopback via the env override the
+    # helper documents (hostnames are not resolvable in this harness).
+    os.environ["CEA_COORDINATOR_ADDRESS"] = "127.0.0.1:" + port
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        initialize_from_plugin_env,
+    )
+    assert initialize_from_plugin_env() is True
 
     import numpy as np
     import jax.numpy as jnp
@@ -154,7 +161,7 @@ def test_two_process_pjit_step(fake_node, tmp_path):
     pjit reduction over the global 2x4 device mesh."""
     envs0, envs1 = _two_host_envs(fake_node)
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER_SCRIPT)
+    script.write_text(_WORKER_SCRIPT.replace("@REPO_ROOT@", REPO_ROOT))
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = str(s.getsockname()[1])
