@@ -76,6 +76,7 @@ ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "3"))
 # is never killed mid-measure by its own supervisor.
 ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "2600"))
 BACKOFF_S = float(os.environ.get("BENCH_BACKOFF_S", "20"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
 
 METRIC = "resnet50_train_throughput"
 UNIT = "images/sec/chip"
@@ -91,10 +92,53 @@ def _log(msg):
 # ---------------------------------------------------------------------------
 
 
+def _backend_probe():
+    """Cheap subprocess probe: can the backend run a matmul at all?
+
+    A hard-hung tunnel blocks jax.devices() inside C where SIGALRM
+    never fires, so a full child attempt would only die at the
+    supervisor's attempt timeout (~43 min). Probing in a short-lived
+    subprocess first turns a dead backend into a fast attempt failure.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=PROBE_TIMEOUT_S)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def probe():
+    import jax
+
+    plat = os.environ.get("BENCH_PLATFORMS")
+    if plat and jax.config.jax_platforms != plat:
+        jax.config.update("jax_platforms", plat)
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    jax.block_until_ready(x @ x)
+    _log(f"probe ok: {[str(d) for d in devices]}")
+    return 0
+
+
 def supervise():
     errors = []
     phase = "unknown"
     for attempt in range(1, ATTEMPTS + 1):
+        if not _backend_probe():
+            errors.append(f"attempt {attempt}: backend probe "
+                          f"failed/hung (limit {PROBE_TIMEOUT_S:.0f}s)")
+            _log(errors[-1])
+            phase = "backend-probe"
+            if attempt < ATTEMPTS:
+                delay = BACKOFF_S * attempt
+                _log(f"backing off {delay:.0f}s before retry")
+                time.sleep(delay)
+            continue
         fd, status_path = tempfile.mkstemp(prefix="bench_status_")
         os.close(fd)
         env = dict(os.environ, BENCH_STATUS_FILE=status_path)
@@ -319,6 +363,8 @@ def child():
 def main():
     if "--child" in sys.argv[1:]:
         sys.exit(child())
+    if "--probe" in sys.argv[1:]:
+        sys.exit(probe())
     sys.exit(supervise())
 
 
