@@ -27,6 +27,12 @@ from .context import (
     ring_attention,
     ulysses_attention,
 )
+from .data import (
+    NpzShardDataset,
+    PrefetchLoader,
+    SyntheticLoader,
+    SyntheticTokenLoader,
+)
 from .expert import (
     build_expert_mesh,
     dense_moe,
@@ -44,6 +50,10 @@ from .train import TrainState, Trainer
 
 __all__ = [
     "MeshSpec",
+    "NpzShardDataset",
+    "PrefetchLoader",
+    "SyntheticLoader",
+    "SyntheticTokenLoader",
     "build_context_mesh",
     "build_expert_mesh",
     "build_hybrid_mesh",

@@ -12,16 +12,26 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Synthetic input pipeline for the demo workloads.
+"""Input pipelines for the demo workloads.
 
 The reference's TPU demos train on fake ImageNet data
 (demo/tpu-training/resnet-tpu.yaml: fake_imagenet model_dir); the
 equivalent here generates deterministic random batches on the host
 and keeps them resident on device, so benchmarks measure the
-accelerator path rather than host RNG. For real-data training the
-iterator protocol is the seam: anything yielding (images, labels)
-device-put to the same shardings drops in.
+accelerator path rather than host RNG.
+
+For real data the pipeline is PrefetchLoader over any host-batch
+iterator (NpzShardDataset reads .npz shard files): a background
+thread stages batches onto the devices through a bounded queue, so
+the host-side read/decode and the device transfer overlap the
+previous step's compute — the TPU never waits on the host in steady
+state. This is the input-pipeline "hard part" SURVEY.md section 7
+budgets for the ResNet target.
 """
+
+import os
+import queue
+import threading
 
 import jax
 import numpy as np
@@ -72,6 +82,151 @@ class SyntheticLoader(_PoolLoader):
                 labels = jax.device_put(labels, sharding)
             batches.append((images, labels))
         super().__init__(batches)
+
+
+class PrefetchLoader:
+    """Stage host batches onto devices ahead of the consumer.
+
+    Wraps any iterator yielding pytrees of numpy arrays. A daemon
+    thread device_puts each batch (to ``sharding`` when given) into a
+    bounded queue of depth ``prefetch``; jax transfers are async, so
+    while the consumer runs step N on device, batch N+1 is already in
+    flight over PCIe/DMA and batch N+2 is being read/decoded on the
+    host. Exceptions from the source iterator re-raise at the
+    consuming ``next()`` (stickily: every later ``next()`` re-raises
+    the same error); exhaustion propagates as StopIteration.
+
+    A consumer that stops early must ``close()`` the loader (or use
+    it as a context manager) — otherwise the stage thread would keep
+    ``prefetch``+1 staged global batches pinned in device memory for
+    the rest of the process (e.g. through checkpointing, exactly when
+    peak HBM matters).
+    """
+
+    _DONE = object()
+
+    def __init__(self, source, sharding=None, prefetch=2):
+        if prefetch < 1:
+            raise ValueError(f"prefetch must be >= 1: {prefetch}")
+        self._sharding = sharding
+        self._q = queue.Queue(maxsize=prefetch)
+        self._closed = threading.Event()
+        self._exc = None
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._stage, args=(iter(source),),
+            name="tpu-data-prefetch", daemon=True)
+        self._thread.start()
+
+    def _put(self, item):
+        """Blocking put that gives up once the loader is closed."""
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _stage(self, it):
+        try:
+            for batch in it:
+                if self._closed.is_set():
+                    return
+                if self._sharding is not None:
+                    batch = jax.tree_util.tree_map(
+                        lambda a: jax.device_put(a, self._sharding),
+                        batch)
+                if not self._put(batch):
+                    return
+        except BaseException as e:  # re-raise on the consumer side
+            self._put(e)
+            return
+        self._put(self._DONE)
+
+    def close(self):
+        """Stop staging and release queued device batches."""
+        self._closed.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exc is not None:
+            raise self._exc
+        if self._done or self._closed.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._exc = item
+            raise item
+        return item
+
+
+class NpzShardDataset:
+    """Host-side reader over a directory of .npz shard files.
+
+    Each shard is an ``np.savez`` archive with ``images`` and
+    ``labels`` arrays (any leading length). Iteration yields
+    fixed-size (images, labels) batches, reshuffling the shard order
+    each epoch with a deterministic per-epoch seed; ``epochs=None``
+    repeats forever. Pair with PrefetchLoader for the device side.
+    """
+
+    def __init__(self, data_dir, batch_size, epochs=None, seed=0,
+                 dtype=None):
+        self._paths = sorted(
+            os.path.join(data_dir, f) for f in os.listdir(data_dir)
+            if f.endswith(".npz"))
+        if not self._paths:
+            raise FileNotFoundError(f"no .npz shards under {data_dir}")
+        self._batch = batch_size
+        self._epochs = epochs
+        self._seed = seed
+        self._dtype = dtype
+
+    def __iter__(self):
+        epoch = 0
+        leftover = None
+        while self._epochs is None or epoch < self._epochs:
+            order = np.random.default_rng(
+                self._seed + epoch).permutation(len(self._paths))
+            for idx in order:
+                with np.load(self._paths[idx]) as shard:
+                    images = shard["images"]
+                    labels = shard["labels"]
+                if self._dtype is not None:
+                    images = images.astype(self._dtype)
+                if leftover is not None:
+                    images = np.concatenate([leftover[0], images])
+                    labels = np.concatenate([leftover[1], labels])
+                    leftover = None
+                n_full = len(images) // self._batch * self._batch
+                for lo in range(0, n_full, self._batch):
+                    yield (images[lo:lo + self._batch],
+                           labels[lo:lo + self._batch])
+                if n_full < len(images):
+                    leftover = (images[n_full:], labels[n_full:])
+            # Drop any tail smaller than a batch at the epoch
+            # boundary — carrying it over would re-yield those
+            # samples when their shard is re-read next epoch.
+            leftover = None
+            epoch += 1
 
 
 class SyntheticTokenLoader(_PoolLoader):

@@ -60,7 +60,13 @@ from container_engine_accelerators_tpu.models import (
 from container_engine_accelerators_tpu.models import inception as inception_mod
 from container_engine_accelerators_tpu.models import mlp as mlp_mod
 from container_engine_accelerators_tpu.models import moe as moe_mod
-from container_engine_accelerators_tpu.models import resnet as resnet_mod
+# NOTE: the models package also exports a *function* named resnet
+# that shadows the submodule under both `from models import resnet`
+# and `import models.resnet as x` (getattr binding); import the
+# needed symbol from the submodule path directly.
+from container_engine_accelerators_tpu.models.resnet import (
+    make_apply_fn as resnet_make_apply_fn,
+)
 from container_engine_accelerators_tpu.models.transformer import (
     next_token_loss_fn,
 )
@@ -76,6 +82,8 @@ from container_engine_accelerators_tpu.parallel import (
     build_mesh,
 )
 from container_engine_accelerators_tpu.parallel.data import (
+    NpzShardDataset,
+    PrefetchLoader,
     SyntheticLoader,
     SyntheticTokenLoader,
 )
@@ -130,6 +138,11 @@ def parse_args(argv=None):
                    action="store_false")
     p.add_argument("--json", action="store_true",
                    help="print a single JSON result line")
+    p.add_argument("--data-dir", default="",
+                   help="directory of .npz shards (images/labels "
+                        "arrays) for real-data image training; empty "
+                        "uses the synthetic fake-ImageNet loader, as "
+                        "the reference demos do")
     p.add_argument("--model-dir", default=os.environ.get("MODEL_DIR", ""),
                    help="checkpoint directory (local path; like the "
                         "reference's --model_dir)")
@@ -240,7 +253,7 @@ def build_model(args):
         return (model, inception_mod.make_apply_fn(model),
                 (args.image_size, args.image_size, 3), args.num_classes)
     model = resnet(depth=args.depth, num_classes=args.num_classes)
-    return (model, resnet_mod.make_apply_fn(model),
+    return (model, resnet_make_apply_fn(model),
             (args.image_size, args.image_size, 3), args.num_classes)
 
 
@@ -313,9 +326,14 @@ def main(argv=None):
             )
             loss_fn = cross_entropy_loss
         init_batch = jnp.zeros((1, *image_shape), jnp.float32)
-        loader = SyntheticLoader(args.batch_size, image_shape,
-                                 num_classes,
-                                 sharding=batch_sharding(mesh), pool=2)
+        if args.data_dir:
+            loader = PrefetchLoader(
+                NpzShardDataset(args.data_dir, args.batch_size),
+                sharding=batch_sharding(mesh))
+        else:
+            loader = SyntheticLoader(args.batch_size, image_shape,
+                                     num_classes,
+                                     sharding=batch_sharding(mesh), pool=2)
 
     tx = optax.chain(
         optax.add_decayed_weights(args.weight_decay),
@@ -358,6 +376,10 @@ def main(argv=None):
                 and (step + 1) % args.checkpoint_every == 0):
             save_checkpoint(args.model_dir, state)
     jax.block_until_ready(state.params)
+    # A prefetching loader would otherwise keep staged batches pinned
+    # in HBM through checkpointing below.
+    if hasattr(loader, "close"):
+        loader.close()
     if profiling:
         jax.profiler.stop_trace()
         print(f"wrote profiler trace to {args.profile_dir}",

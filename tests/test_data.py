@@ -1,0 +1,211 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Input pipeline: PrefetchLoader + NpzShardDataset on the CPU mesh."""
+
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.parallel import (
+    NpzShardDataset,
+    PrefetchLoader,
+    batch_sharding,
+    build_mesh,
+)
+from container_engine_accelerators_tpu.parallel.mesh import default_spec
+
+
+def _shards(tmp_path, sizes, dim=4, classes=10):
+    """Write .npz shards with globally increasing labels for ordering
+    checks; images[i] encodes its global index."""
+    idx = 0
+    for s, size in enumerate(sizes):
+        images = np.stack([np.full((dim,), idx + i, np.float32)
+                           for i in range(size)])
+        labels = np.arange(idx, idx + size, dtype=np.int32) % classes
+        np.savez(tmp_path / f"shard{s}.npz", images=images, labels=labels)
+        idx += size
+    return str(tmp_path)
+
+
+def test_prefetch_preserves_order_and_values():
+    source = [(np.full((2, 3), i, np.float32),
+               np.full((2,), i, np.int32)) for i in range(7)]
+    out = list(PrefetchLoader(iter(source)))
+    assert len(out) == 7
+    for i, (images, labels) in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(images), source[i][0])
+        np.testing.assert_array_equal(np.asarray(labels), source[i][1])
+
+
+def test_prefetch_device_puts_to_sharding():
+    import jax
+
+    mesh = build_mesh(default_spec(8))
+    sharding = batch_sharding(mesh)
+    source = [(np.ones((16, 3), np.float32), np.ones((16,), np.int32))]
+    (images, labels), = list(PrefetchLoader(iter(source),
+                                            sharding=sharding))
+    assert isinstance(images, jax.Array)
+    assert images.sharding.is_equivalent_to(sharding, images.ndim)
+
+
+def test_prefetch_propagates_source_error():
+    def bad():
+        yield (np.zeros(2), np.zeros(2))
+        raise RuntimeError("disk on fire")
+
+    it = PrefetchLoader(bad())
+    next(it)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        next(it)
+
+
+def test_prefetch_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        PrefetchLoader(iter([]), prefetch=0)
+
+
+def test_prefetch_error_is_sticky_not_deadlock():
+    def bad():
+        raise RuntimeError("boom")
+        yield  # pragma: no cover
+
+    it = PrefetchLoader(bad())
+    for _ in range(3):  # every retry re-raises; never blocks
+        with pytest.raises(RuntimeError, match="boom"):
+            next(it)
+
+
+def test_prefetch_close_releases_stage_thread():
+    def infinite():
+        i = 0
+        while True:
+            yield (np.full((2,), i, np.float32),)
+            i += 1
+
+    loader = PrefetchLoader(infinite(), prefetch=2)
+    next(loader)
+    loader.close()
+    assert not loader._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(loader)
+
+
+def test_prefetch_context_manager_closes():
+    with PrefetchLoader(iter([(np.zeros(2),)] * 100)) as loader:
+        next(loader)
+    assert not loader._thread.is_alive()
+
+
+def test_npz_shards_batches_span_shard_boundaries(tmp_path):
+    # 5 + 3 + 6 = 14 samples; batch 4 -> 3 batches/epoch, 2 dropped.
+    data_dir = _shards(tmp_path, [5, 3, 6])
+    batches = list(NpzShardDataset(data_dir, batch_size=4, epochs=1))
+    assert len(batches) == 3
+    seen = np.concatenate([b[0][:, 0] for b in batches])
+    # Every yielded sample is distinct and self-consistent.
+    assert len(set(seen.tolist())) == 12
+    for images, labels in batches:
+        assert images.shape == (4, 4)
+        assert labels.shape == (4,)
+        np.testing.assert_array_equal(images[:, 0].astype(np.int32) % 10,
+                                      labels)
+
+
+def test_npz_shards_epochs_and_determinism(tmp_path):
+    data_dir = _shards(tmp_path, [4, 4])
+    two = list(NpzShardDataset(data_dir, batch_size=4, epochs=2))
+    assert len(two) == 4
+    again = list(NpzShardDataset(data_dir, batch_size=4, epochs=2))
+    for (a, _), (b, _) in zip(two, again):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_npz_shards_no_duplicates_across_epochs(tmp_path):
+    # 14 samples, batch 4: the 2-sample tail must be DROPPED at the
+    # epoch boundary, not carried over (which would re-yield those
+    # samples when their shard is re-read next epoch).
+    data_dir = _shards(tmp_path, [5, 3, 6])
+    batches = list(NpzShardDataset(data_dir, batch_size=4, epochs=2))
+    assert len(batches) == 6  # 3 full batches per epoch
+    per_epoch = [np.concatenate([b[0][:, 0] for b in batches[:3]]),
+                 np.concatenate([b[0][:, 0] for b in batches[3:]])]
+    for seen in per_epoch:
+        assert len(set(seen.tolist())) == 12  # no dupes inside epoch
+
+
+def test_npz_shards_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        NpzShardDataset(str(tmp_path), batch_size=2)
+
+
+@pytest.mark.slow
+def test_train_driver_resnet_real_data(tmp_path):
+    """The resnet CLI path end-to-end with .npz shards — regression
+    for the models-package name shadowing that broke
+    `--model resnet` (function `resnet` hid the submodule), which no
+    other test drove."""
+    import importlib.util
+
+    rng = np.random.default_rng(0)
+    for s in range(2):
+        np.savez(tmp_path / f"s{s}.npz",
+                 images=rng.standard_normal(
+                     (24, 32, 32, 3)).astype(np.float32),
+                 labels=rng.integers(0, 10, size=(24,), dtype=np.int32))
+    spec = importlib.util.spec_from_file_location(
+        "demo_train_resnet", "demo/tpu-training/train.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    result = mod.main([
+        "--model", "resnet", "--depth", "18", "--image-size", "32",
+        "--num-classes", "10", "--batch-size", "16", "--steps", "2",
+        "--warmup-steps", "0", "--data-dir", str(tmp_path)])
+    assert np.isfinite(result["final_loss"])
+
+
+def test_file_pipeline_feeds_trainer(tmp_path):
+    """NpzShardDataset -> PrefetchLoader -> one sharded train step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from container_engine_accelerators_tpu.parallel import Trainer
+
+    dim, classes = 8, 4
+    data_dir = _shards(tmp_path, [20, 20], dim=dim, classes=classes)
+    mesh = build_mesh(default_spec(8))
+
+    def apply_fn(variables, x, train, *_):
+        return x @ variables["params"]["w"], {}
+
+    def loss_fn(logits, labels):
+        onehot = jax.nn.one_hot(labels, classes)
+        return -jnp.mean(jnp.sum(
+            onehot * jax.nn.log_softmax(logits), axis=-1))
+
+    trainer = Trainer(apply_fn, loss_fn, optax.sgd(0.1), mesh=mesh)
+    state = trainer.init_state(
+        {"params": {"w": jnp.zeros((dim, classes), jnp.float32)}})
+    loader = PrefetchLoader(
+        NpzShardDataset(data_dir, batch_size=16, epochs=1),
+        sharding=batch_sharding(mesh))
+    steps = 0
+    for batch in loader:
+        state, loss = trainer.train_step(state, batch)
+        steps += 1
+    assert steps == 2  # 40 samples / 16 -> 2 full batches
+    assert float(state.step) == 2
+    assert np.isfinite(float(loss))
