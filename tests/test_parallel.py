@@ -165,6 +165,31 @@ def test_train_driver_checkpoint_resume(tmp_path):
     assert any(n == "checkpoint_6" for n in os.listdir(tmp_path))
 
 
+def test_train_driver_async_periodic_checkpoints(tmp_path):
+    """--checkpoint-every saves run async (overlapping later steps);
+    every periodic checkpoint must still be fully written and
+    restorable once main() returns."""
+    import importlib.util
+    import os
+
+    import orbax.checkpoint as ocp
+
+    spec = importlib.util.spec_from_file_location(
+        "demo_train_async_ckpt", "demo/tpu-training/train.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main(["--model", "mnist", "--steps", "3", "--warmup-steps", "0",
+              "--batch-size", "16", "--model-dir", str(tmp_path),
+              "--checkpoint-every", "1"])
+    names = sorted(n for n in os.listdir(tmp_path)
+                   if n.startswith("checkpoint_"))
+    assert names == ["checkpoint_1", "checkpoint_2", "checkpoint_3"]
+    for name in names:
+        restored = ocp.PyTreeCheckpointer().restore(
+            str(tmp_path / name))
+        assert restored["step"] == int(name.rsplit("_", 1)[1])
+
+
 def test_train_driver_moe_expert_parallel():
     """The LM demo path end-to-end: MoE model, expert mesh axis,
     router loss, token loader — through the same CLI surface the
