@@ -1,0 +1,99 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""LM decode-throughput microbenchmark (the serving hot path).
+
+Times KV-cache autoregressive generation (prefill + N new tokens,
+one compiled lax.scan — models/decode.py) and prints one JSON line
+per (batch, prompt_len, new_tokens) point:
+
+  {"batch": 8, "prompt_len": 128, "new_tokens": 128,
+   "decode_tokens_per_sec": ..., "ms_per_token": ...}
+
+Run on the TPU chip for real numbers; runs identically on CPU for
+schedule sanity. This is the per-replica throughput behind the
+serving demo's HPA sizing (demo/serving/jax-serving.yaml).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, nargs="+", default=[1, 8])
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--new-tokens", type=int, default=128)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--embed-dim", type=int, default=512)
+    p.add_argument("--num-layers", type=int, default=8)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--iters", type=int, default=5)
+    args = p.parse_args(argv)
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.models.decode import decode
+
+    model = TransformerLM(
+        vocab_size=args.vocab_size, embed_dim=args.embed_dim,
+        num_layers=args.num_layers, num_heads=args.num_heads,
+        max_seq_len=args.prompt_len + args.new_tokens)
+    params = jax.jit(lambda key: model.init(
+        key, jnp.zeros((1, 8), jnp.int32), train=False)["params"],
+    )(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+
+    for b in args.batch:
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (b, args.prompt_len), 0,
+            args.vocab_size, dtype=jnp.int32)
+        out = decode(model, params, prompt, args.new_tokens)
+        jax.block_until_ready(out)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = decode(model, params, prompt, args.new_tokens)
+        jax.block_until_ready(out)
+        sec = (time.perf_counter() - t0) / args.iters
+        tokens = b * args.new_tokens
+        print(json.dumps({
+            "batch": b,
+            "prompt_len": args.prompt_len,
+            "new_tokens": args.new_tokens,
+            "layers": args.num_layers,
+            "embed_dim": args.embed_dim,
+            "platform": jax.devices()[0].platform,
+            "sec_per_call": round(sec, 4),
+            "decode_tokens_per_sec": round(tokens / sec, 1),
+            "ms_per_token": round(sec / args.new_tokens * 1000, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()
