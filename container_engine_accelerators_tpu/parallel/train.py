@@ -33,8 +33,9 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import optax
+from jax.sharding import NamedSharding, PartitionSpec
 
-from .mesh import build_mesh
+from .mesh import DATA_AXIS, build_mesh
 from .sharding import batch_sharding, param_shardings, replicated
 
 
@@ -57,13 +58,16 @@ class Trainer:
     """
 
     def __init__(self, apply_fn, loss_fn, optimizer, mesh=None,
-                 donate_state=True, remat=False):
+                 donate_state=True, remat=False, grad_accum=1):
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1: {grad_accum}")
         self._apply = apply_fn
         self._loss = loss_fn
         self._tx = optimizer
         self.mesh = mesh if mesh is not None else build_mesh()
         self._donate = donate_state
         self._remat = remat
+        self._grad_accum = grad_accum
         self._train_step = None
         self._state_shardings = None
 
@@ -126,22 +130,74 @@ class Trainer:
         loss_fn = self._loss
         tx = self._tx
 
+        accum = self._grad_accum
+
         def step_fn(state, batch):
             images, labels = batch
 
-            def compute_loss(params):
-                variables = {"params": params}
-                if state.batch_stats:
-                    variables["batch_stats"] = state.batch_stats
-                if wants_step:
-                    logits, new_stats = apply(variables, images, True,
-                                              state.step)
-                else:
-                    logits, new_stats = apply(variables, images, True)
-                return loss_fn(logits, labels), new_stats
+            def loss_and_grads(params, batch_stats, step, images, labels):
+                def compute_loss(params):
+                    variables = {"params": params}
+                    if batch_stats:
+                        variables["batch_stats"] = batch_stats
+                    if wants_step:
+                        logits, new_stats = apply(variables, images, True,
+                                                  step)
+                    else:
+                        logits, new_stats = apply(variables, images, True)
+                    return loss_fn(logits, labels), new_stats
 
-            grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-            (loss, new_stats), grads = grad_fn(state.params)
+                return jax.value_and_grad(compute_loss, has_aux=True)(params)
+
+            if accum == 1:
+                (loss, new_stats), grads = loss_and_grads(
+                    state.params, state.batch_stats, state.step,
+                    images, labels)
+            else:
+                # Microbatch the global batch inside one compiled step:
+                # lax.scan accumulates the mean of per-chunk grads (equal
+                # chunks, so it equals the full-batch mean exactly), and
+                # BatchNorm stats thread chunk-to-chunk as they would
+                # across real steps. Activation memory drops by ~accum x
+                # while the optimizer still sees one update.
+                if images.shape[0] % accum != 0:
+                    raise ValueError(
+                        f"global batch {images.shape[0]} not divisible "
+                        f"into grad_accum={accum} microbatches")
+
+                def split(x):
+                    # Keep each microbatch sharded exactly like the
+                    # full batch (chunk dim replicated, rows over the
+                    # data axis) — without the constraint GSPMD
+                    # all-gathers the batch inside every scan
+                    # iteration, since a contiguous row range spans
+                    # device shards.
+                    x = x.reshape((accum, x.shape[0] // accum)
+                                  + x.shape[1:])
+                    return jax.lax.with_sharding_constraint(
+                        x, NamedSharding(self.mesh,
+                                         PartitionSpec(None, DATA_AXIS)))
+
+                def accum_fn(carry, chunk):
+                    loss_sum, grads_sum, stats = carry
+                    # Distinct virtual step per chunk: a step-keyed
+                    # apply_fn (dropout) must not reuse one mask
+                    # across microbatches.
+                    idx, images_c, labels_c = chunk
+                    (loss, new_stats), grads = loss_and_grads(
+                        state.params, stats, state.step * accum + idx,
+                        images_c, labels_c)
+                    grads_sum = jax.tree_util.tree_map(
+                        lambda a, g: a + g / accum, grads_sum, grads)
+                    return (loss_sum + loss.astype(jnp.float32) / accum,
+                            grads_sum, new_stats), None
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+                (loss, grads, new_stats), _ = jax.lax.scan(
+                    accum_fn, (jnp.zeros((), jnp.float32), zeros,
+                               state.batch_stats),
+                    (jnp.arange(accum), split(images), split(labels)))
+
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = TrainState(step=state.step + 1, params=new_params,

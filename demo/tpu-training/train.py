@@ -133,6 +133,10 @@ def parse_args(argv=None):
                         "many DCN granules (slices/hosts), keeping "
                         "model parallelism inside each granule")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="accumulate gradients over N equal microbatches "
+                        "inside one compiled step (one optimizer update; "
+                        "~N x lower activation memory)")
     p.add_argument("--pallas-loss", action="store_true", default=True)
     p.add_argument("--no-pallas-loss", dest="pallas_loss",
                    action="store_false")
@@ -366,7 +370,8 @@ def main(argv=None):
         optax.add_decayed_weights(args.weight_decay),
         optax.sgd(args.lr, momentum=args.momentum),
     )
-    trainer = Trainer(apply_fn, loss_fn, tx, mesh=mesh, remat=args.remat)
+    trainer = Trainer(apply_fn, loss_fn, tx, mesh=mesh, remat=args.remat,
+                      grad_accum=args.grad_accum)
 
     variables = model.init(jax.random.PRNGKey(0), init_batch, train=False)
     state = trainer.init_state(variables)

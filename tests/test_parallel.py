@@ -165,6 +165,84 @@ def test_train_driver_checkpoint_resume(tmp_path):
     assert any(n == "checkpoint_6" for n in os.listdir(tmp_path))
 
 
+def test_grad_accum_matches_full_batch():
+    """One grad_accum=4 step equals one full-batch step: equal-size
+    microbatch chunks make the accumulated mean gradient exactly the
+    full-batch mean (up to fp reassociation)."""
+    import optax
+
+    from container_engine_accelerators_tpu.parallel.train import Trainer
+
+    def apply_fn(variables, x, train):
+        w = variables["params"]["w"]
+        return jnp.tanh(x @ w), {}
+
+    def loss_fn(logits, labels):
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        return -jnp.mean(jnp.sum(
+            onehot * jax.nn.log_softmax(logits.astype(jnp.float32)),
+            axis=-1))
+
+    mesh = build_mesh(MeshSpec(data=8))
+    variables = {"params": {"w": jax.random.normal(
+        jax.random.PRNGKey(0), (16, 4), jnp.float32) * 0.3}}
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+    y = jax.random.randint(jax.random.PRNGKey(2), (32,), 0, 4)
+
+    results = {}
+    for accum in (1, 4):
+        tr = Trainer(apply_fn, loss_fn, optax.sgd(0.5), mesh=mesh,
+                     grad_accum=accum)
+        state = tr.init_state(variables)
+        state, loss = tr.train_step(state, (x, y))
+        results[accum] = (np.asarray(state.params["w"]), float(loss))
+    np.testing.assert_allclose(results[1][0], results[4][0],
+                               rtol=1e-6, atol=1e-6)
+    assert abs(results[1][1] - results[4][1]) < 1e-5
+
+
+def test_grad_accum_distinct_step_per_microbatch():
+    """Step-keyed apply_fns (dropout) must see a distinct virtual
+    step per chunk — reusing one step would reuse one dropout mask
+    across all microbatches. The probe returns logits == step, so
+    the accumulated loss is the mean of the per-chunk steps."""
+    import optax
+
+    from container_engine_accelerators_tpu.parallel.train import Trainer
+
+    def apply_fn(variables, x, train, step):
+        del variables
+        return jnp.full(x.shape[:1], step, jnp.float32), {}
+
+    tr = Trainer(apply_fn, lambda lo, la: jnp.mean(lo), optax.sgd(0.0),
+                 mesh=build_mesh(MeshSpec(data=8)), grad_accum=4)
+    state = tr.init_state(
+        {"params": {"w": jnp.zeros((1,), jnp.float32)}})
+    x = jnp.zeros((32, 2))
+    _, loss = tr.train_step(state, (x, jnp.zeros((32,))))
+    # state.step=0, accum=4 -> virtual steps 0,1,2,3 -> mean 1.5.
+    assert float(loss) == 1.5
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    import optax
+
+    from container_engine_accelerators_tpu.parallel.train import Trainer
+
+    def apply_fn(variables, x, train):
+        return x @ variables["params"]["w"], {}
+
+    tr = Trainer(apply_fn, lambda lo, la: jnp.mean(lo), optax.sgd(0.1),
+                 mesh=build_mesh(MeshSpec(data=8)), grad_accum=3)
+    state = tr.init_state(
+        {"params": {"w": jnp.zeros((4, 2), jnp.float32)}})
+    with pytest.raises(ValueError, match="not divisible"):
+        tr.train_step(state, (jnp.zeros((16, 4)), jnp.zeros((16,))))
+    with pytest.raises(ValueError):
+        Trainer(apply_fn, lambda lo, la: jnp.mean(lo), optax.sgd(0.1),
+                grad_accum=0)
+
+
 def test_train_driver_async_periodic_checkpoints(tmp_path):
     """--checkpoint-every saves run async (overlapping later steps);
     every periodic checkpoint must still be fully written and
