@@ -120,6 +120,12 @@ def parse_args(argv=None):
     p.add_argument("--batch-size", type=int, default=256,
                    help="global batch size")
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--lr-schedule",
+                   choices=["constant", "cosine", "linear"],
+                   default="constant",
+                   help="learning-rate schedule over --steps (peak "
+                        "at --lr after --lr-warmup-steps)")
+    p.add_argument("--lr-warmup-steps", type=int, default=0)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--steps", type=int, default=100)
@@ -366,9 +372,23 @@ def main(argv=None):
                                      num_classes,
                                      sharding=batch_sharding(mesh), pool=2)
 
+    if args.lr_schedule == "constant":
+        lr = args.lr
+    elif args.lr_schedule == "cosine":
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.lr,
+            warmup_steps=args.lr_warmup_steps,
+            decay_steps=max(args.steps, args.lr_warmup_steps + 1))
+    else:  # linear
+        lr = optax.join_schedules(
+            [optax.linear_schedule(0.0, args.lr, args.lr_warmup_steps),
+             optax.linear_schedule(
+                 args.lr, 0.0,
+                 max(args.steps - args.lr_warmup_steps, 1))],
+            [args.lr_warmup_steps])
     tx = optax.chain(
         optax.add_decayed_weights(args.weight_decay),
-        optax.sgd(args.lr, momentum=args.momentum),
+        optax.sgd(lr, momentum=args.momentum),
     )
     trainer = Trainer(apply_fn, loss_fn, tx, mesh=mesh, remat=args.remat,
                       grad_accum=args.grad_accum)
