@@ -135,6 +135,7 @@ class MoEBlock(nn.Module):
     attention_fn: Callable = flash_attention
     mesh: Any = None
     decode: bool = False
+    kv_cache_dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -142,6 +143,7 @@ class MoEBlock(nn.Module):
                                 dtype=self.dtype,
                                 attention_fn=self.attention_fn,
                                 decode=self.decode, mesh=self.mesh,
+                                kv_cache_dtype=self.kv_cache_dtype,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -173,6 +175,7 @@ class MoETransformerLM(nn.Module):
     attention_fn: Optional[Callable] = None
     mesh: Any = None
     decode: bool = False
+    kv_cache_dtype: Any = None
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -199,6 +202,7 @@ class MoETransformerLM(nn.Module):
                     capacity_factor=self.capacity_factor,
                     dtype=self.dtype, attention_fn=attention_fn,
                     mesh=self.mesh, decode=self.decode,
+                    kv_cache_dtype=self.kv_cache_dtype,
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -206,6 +210,7 @@ class MoETransformerLM(nn.Module):
                           mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                           attention_fn=attention_fn,
                           decode=self.decode, mesh=self.mesh,
+                          kv_cache_dtype=self.kv_cache_dtype,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -51,6 +51,19 @@ def cached_positions(module, s, decode):
     return pos
 
 
+def _quantize_rows_int8(x):
+    """Symmetric int8 quantization per trailing-dim row.
+
+    Returns (int8 values, f32 scale with a keepdim trailing axis);
+    x ~= values * scale.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 class CausalSelfAttention(nn.Module):
     """Pre-norm causal attention residual, [B, S, E] in/out — the
     sublayer shared by the dense Block and the MoE block.
@@ -73,6 +86,10 @@ class CausalSelfAttention(nn.Module):
     attention_fn: Callable = flash_attention
     decode: bool = False
     mesh: Any = None  # residual-stream sharding pin (no extra params)
+    # "int8" quantizes the decode KV cache (symmetric per-token/head
+    # scales): cache residency halves vs bf16, so a serving replica
+    # holds ~2x the context or batch. None keeps the compute dtype.
+    kv_cache_dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -98,40 +115,78 @@ class CausalSelfAttention(nn.Module):
         sizes the cache and runs dense causal attention; afterwards
         the input is [B, 1, H, D] and attention runs q against the
         cached prefix with a <= cache-index mask.
+
+        With kv_cache_dtype="int8" the cache holds symmetric int8
+        values plus one f32 scale per (batch, position, head) row.
+        The scales are constant along the head dim, so they fold into
+        the attention scores and probabilities (O(B*S*H) work) rather
+        than into a dequantized full-size copy of the cache.
         """
         from ..parallel.context import dot_product_attention
 
+        quantized = self.kv_cache_dtype in ("int8", jnp.int8)
+        if self.kv_cache_dtype is not None and not quantized:
+            # A typo'd dtype silently serving a full-size cache would
+            # falsify the operator's capacity planning.
+            raise ValueError(
+                f"unsupported kv_cache_dtype {self.kv_cache_dtype!r}; "
+                f"use None or \"int8\"")
+        cache_dtype = jnp.int8 if quantized else k.dtype
         is_init = not self.has_variable("cache", "cached_key")
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                 k.shape, k.dtype)
+                                 k.shape, cache_dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                 v.shape, v.dtype)
+                                 v.shape, cache_dtype)
+        if quantized:
+            scale_shape = k.shape[:-1] + (1,)
+            k_scale = self.variable("cache", "key_scale", jnp.zeros,
+                                    scale_shape, jnp.float32)
+            v_scale = self.variable("cache", "value_scale", jnp.zeros,
+                                    scale_shape, jnp.float32)
         index = self.variable("cache", "cache_index",
                               lambda: jnp.zeros((), jnp.int32))
         if is_init:
             return dot_product_attention(q, k, v, causal=True)
 
         i = index.value
-        cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cached_k.value.dtype),
-            (0, i, 0, 0))
-        cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cached_v.value.dtype),
-            (0, i, 0, 0))
+        if quantized:
+            kq, ks = _quantize_rows_int8(k)
+            vq, vs = _quantize_rows_int8(v)
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, kq, (0, i, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, vq, (0, i, 0, 0))
+            k_scale.value = jax.lax.dynamic_update_slice(
+                k_scale.value, ks, (0, i, 0, 0))
+            v_scale.value = jax.lax.dynamic_update_slice(
+                v_scale.value, vs, (0, i, 0, 0))
+        else:
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cache_dtype), (0, i, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cache_dtype), (0, i, 0, 0))
         index.value = i + q.shape[1]
 
         d = q.shape[-1]
+        # The int8->compute-dtype convert below fuses into the dot's
+        # operand read; only the O(B*S*H) score/prob scaling is extra.
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, cached_k.value,
+            "bqhd,bkhd->bhqk", q, cached_k.value.astype(self.dtype),
             preferred_element_type=jnp.float32) / jnp.sqrt(
                 jnp.asarray(d, jnp.float32))
+        if quantized:
+            # k_scale [B,S,H,1] -> [B,H,1,S] broadcast over queries.
+            scores = scores * jnp.transpose(
+                k_scale.value[..., 0], (0, 2, 1))[:, :, None, :]
         k_pos = jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=3)
         scores = jnp.where(k_pos <= i, scores, -1e9)
         probs = jax.nn.softmax(scores, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd",
-                          probs.astype(cached_v.value.dtype),
-                          cached_v.value)
+        if quantized:
+            probs = probs * jnp.transpose(
+                v_scale.value[..., 0], (0, 2, 1))[:, :, None, :]
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(self.dtype),
+                          cached_v.value.astype(self.dtype))
 
 
 class Block(nn.Module):
@@ -143,6 +198,7 @@ class Block(nn.Module):
     attention_fn: Callable = flash_attention
     decode: bool = False
     mesh: Any = None
+    kv_cache_dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -151,6 +207,7 @@ class Block(nn.Module):
                                 dtype=self.dtype,
                                 attention_fn=self.attention_fn,
                                 decode=self.decode, mesh=self.mesh,
+                                kv_cache_dtype=self.kv_cache_dtype,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
@@ -172,6 +229,7 @@ class TransformerLM(nn.Module):
     attention_fn: Optional[Callable] = None
     decode: bool = False
     mesh: Any = None
+    kv_cache_dtype: Any = None
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -194,7 +252,9 @@ class TransformerLM(nn.Module):
             x = Block(num_heads=self.num_heads,
                       mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                       attention_fn=attention_fn, decode=self.decode,
-                      mesh=self.mesh, name=f"block{i}")(x)
+                      mesh=self.mesh,
+                      kv_cache_dtype=self.kv_cache_dtype,
+                      name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,
         # and the [B*S, V] matmul stays MXU-shaped either way.

@@ -66,6 +66,10 @@ def main(argv=None):
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--kv-cache-dtype", choices=["bfloat16", "int8"],
+                   default="bfloat16",
+                   help="int8 halves KV-cache residency per replica "
+                        "(~2x servable context/batch)")
     args = p.parse_args(argv)
     name = args.model_name or args.model
 
@@ -73,7 +77,9 @@ def main(argv=None):
         model = TransformerLM(
             vocab_size=args.vocab_size, embed_dim=args.embed_dim,
             num_layers=args.num_layers, num_heads=args.num_heads,
-            max_seq_len=args.max_seq_len)
+            max_seq_len=args.max_seq_len,
+            kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
+                            else args.kv_cache_dtype))
         params = model.init(
             jax.random.PRNGKey(0),
             jnp.zeros((1, 8), jnp.int32))["params"]

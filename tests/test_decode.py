@@ -88,6 +88,40 @@ def test_sampled_tokens_in_vocab(dense_lm):
     assert not np.array_equal(np.asarray(seq2), np.asarray(seq))
 
 
+def test_int8_kv_cache_matches_bf16_greedy(dense_lm):
+    """int8 KV cache halves cache residency; greedy text on a small
+    model must match the full-precision cache (per-row symmetric
+    quantization keeps attention logits within argmax tolerance at
+    these scales), and the cache leaves must actually be int8."""
+    model, params, prompt = dense_lm
+    q_model = model.clone(kv_cache_dtype="int8")
+    seq_q = greedy_decode(q_model, params, prompt, N)
+    seq_f = greedy_decode(model, params, prompt, N)
+    np.testing.assert_array_equal(np.asarray(seq_q[:, :P]),
+                                  np.asarray(prompt))
+    # Near-tie argmaxes may legitimately flip under ~0.4% quant
+    # error; demand strong (not bit-exact) agreement so the test
+    # survives numerics-neutral JAX/seed changes.
+    agree = np.mean(np.asarray(seq_q[:, P:]) == np.asarray(seq_f[:, P:]))
+    assert agree >= 0.9, f"token agreement {agree:.2f}"
+
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        greedy_decode(model.clone(kv_cache_dtype="fp8"), params,
+                      prompt, N)
+
+    # Inspect the materialized cache collection dtype directly.
+    d_model = model.clone(decode=True, kv_cache_dtype="int8")
+    variables = d_model.init(jax.random.PRNGKey(2),
+                             jnp.zeros((B, MAXLEN), jnp.int32),
+                             train=False)
+    leaves = jax.tree_util.tree_leaves_with_path(variables["cache"])
+    kv = [(p, a) for p, a in leaves
+          if "cached_key" in str(p) or "cached_value" in str(p)]
+    assert kv and all(a.dtype == jnp.int8 for _, a in kv)
+    scales = [a for p, a in leaves if "scale" in str(p)]
+    assert scales and all(a.dtype == jnp.float32 for a in scales)
+
+
 def test_moe_greedy_matches_dense_forward():
     model = MoETransformerLM(vocab_size=V, embed_dim=E, num_layers=2,
                              num_heads=H, num_experts=4,

@@ -56,6 +56,8 @@ def main(argv=None):
     p.add_argument("--num-layers", type=int, default=8)
     p.add_argument("--num-heads", type=int, default=8)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--kv-cache-dtype", choices=["bfloat16", "int8"],
+                   default="bfloat16")
     args = p.parse_args(argv)
 
     from container_engine_accelerators_tpu.models import TransformerLM
@@ -64,7 +66,9 @@ def main(argv=None):
     model = TransformerLM(
         vocab_size=args.vocab_size, embed_dim=args.embed_dim,
         num_layers=args.num_layers, num_heads=args.num_heads,
-        max_seq_len=args.prompt_len + args.new_tokens)
+        max_seq_len=args.prompt_len + args.new_tokens,
+        kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
+                        else args.kv_cache_dtype))
     params = jax.jit(lambda key: model.init(
         key, jnp.zeros((1, 8), jnp.int32), train=False)["params"],
     )(jax.random.PRNGKey(0))
@@ -88,6 +92,7 @@ def main(argv=None):
             "new_tokens": args.new_tokens,
             "layers": args.num_layers,
             "embed_dim": args.embed_dim,
+            "kv_cache_dtype": args.kv_cache_dtype,
             "platform": jax.devices()[0].platform,
             "sec_per_call": round(sec, 4),
             "decode_tokens_per_sec": round(tokens / sec, 1),
