@@ -61,6 +61,11 @@ def test_healthz_and_stats(server):
     with urllib.request.urlopen(
             f"http://localhost:{server.port}/healthz", timeout=10) as resp:
         assert json.loads(resp.read())["status"] == "ok"
+    # Issue a request of our own: the module fixture is shared, and
+    # counting on earlier tests' traffic makes this fail when run
+    # alone (pytest tests/test_serving.py::test_healthz_and_stats).
+    post(server, "/v1/models/mnist:predict",
+         {"instances": [np.zeros((28, 28, 1)).tolist()]})
     with urllib.request.urlopen(
             f"http://localhost:{server.port}/stats", timeout=10) as resp:
         stats = json.loads(resp.read())
