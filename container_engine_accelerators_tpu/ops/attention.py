@@ -30,13 +30,18 @@ mode so the CPU test mesh exercises identical code.
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_BLOCK = 128  # seq-dim tile for both Q and K loops
+# Default seq-dim tile for both Q and K loops; override per call
+# (flash_attention(block=...)) or process-wide via CEA_FLASH_BLOCK —
+# the attention sweep (tools/run_attn_bench.sh) tunes this on real
+# hardware. Must be a multiple of 128 (MXU lane width).
+_DEFAULT_BLOCK = int(os.environ.get("CEA_FLASH_BLOCK", "128"))
 _NEG = -1e9
 
 
@@ -62,17 +67,17 @@ def _masked_scores(q, k, q_off, k_off, s_orig, causal, scale):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, s_orig,
-                scale):
+                scale, block):
     q = q_ref[0].astype(jnp.float32)
     iq = pl.program_id(1)
     bq = q.shape[0]
-    n_k = k_ref.shape[1] // _BLOCK
+    n_k = k_ref.shape[1] // block
 
     def body(j, carry):
         m, num, den = carry
-        k = k_ref[0, pl.ds(j * _BLOCK, _BLOCK), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * _BLOCK, _BLOCK), :].astype(jnp.float32)
-        s = _masked_scores(q, k, iq * bq, j * _BLOCK, s_orig, causal,
+        k = k_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
+        s = _masked_scores(q, k, iq * bq, j * block, s_orig, causal,
                            scale)
         block_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, block_max)
@@ -96,19 +101,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, s_orig,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               *, causal, s_orig, scale):
+               *, causal, s_orig, scale, block):
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[...].reshape(-1, 1)
     delta = delta_ref[...].reshape(-1, 1)
     iq = pl.program_id(1)
     bq = q.shape[0]
-    n_k = k_ref.shape[1] // _BLOCK
+    n_k = k_ref.shape[1] // block
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * _BLOCK, _BLOCK), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * _BLOCK, _BLOCK), :].astype(jnp.float32)
-        s = _masked_scores(q, k, iq * bq, j * _BLOCK, s_orig, causal,
+        k = k_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
+        s = _masked_scores(q, k, iq * bq, j * block, s_orig, causal,
                            scale)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
@@ -124,20 +129,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, causal, s_orig, scale):
+                dk_ref, dv_ref, *, causal, s_orig, scale, block):
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     jk = pl.program_id(1)
     bk = k.shape[0]
-    n_q = q_ref.shape[1] // _BLOCK
+    n_q = q_ref.shape[1] // block
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * _BLOCK, _BLOCK), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * _BLOCK, _BLOCK), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * _BLOCK, _BLOCK), :]
-        delta = delta_ref[0, pl.ds(i * _BLOCK, _BLOCK), :]
-        s = _masked_scores(q, k, i * _BLOCK, jk * bk, s_orig, causal,
+        q = q_ref[0, pl.ds(i * block, block), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block, block), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block, block), :]
+        delta = delta_ref[0, pl.ds(i * block, block), :]
+        s = _masked_scores(q, k, i * block, jk * bk, s_orig, causal,
                            scale)
         p = jnp.exp(s - lse)  # (BQ, BK)
         dv = dv + jax.lax.dot_general(
@@ -161,65 +166,65 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _pad_seq(x):
-    pad = (-x.shape[1]) % _BLOCK
+def _pad_seq(x, block):
+    pad = (-x.shape[1]) % block
     if pad:
         x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
     return x
 
 
-def _specs(sp, d):
-    block = pl.BlockSpec((1, _BLOCK, d), lambda bh, i: (bh, i, 0),
-                         memory_space=pltpu.VMEM)
+def _specs(sp, d, block):
+    tile = pl.BlockSpec((1, block, d), lambda bh, i: (bh, i, 0),
+                        memory_space=pltpu.VMEM)
     full = pl.BlockSpec((1, sp, d), lambda bh, i: (bh, 0, 0),
                         memory_space=pltpu.VMEM)
-    # lse/delta ride as [BH, Sp, 1] so their (1, 128, 1) blocks meet
+    # lse/delta ride as [BH, Sp, 1] so their (1, block, 1) blocks meet
     # the TPU (8, 128) tiling rule on the last two dims.
-    vec_block = pl.BlockSpec((1, _BLOCK, 1), lambda bh, i: (bh, i, 0),
-                             memory_space=pltpu.VMEM)
+    vec_tile = pl.BlockSpec((1, block, 1), lambda bh, i: (bh, i, 0),
+                            memory_space=pltpu.VMEM)
     vec_full = pl.BlockSpec((1, sp, 1), lambda bh, i: (bh, 0, 0),
                             memory_space=pltpu.VMEM)
-    return block, full, vec_block, vec_full
+    return tile, full, vec_tile, vec_full
 
 
-def _flash_fwd(q3, k3, v3, causal, s_orig):
+def _flash_fwd(q3, k3, v3, causal, s_orig, block):
     """q3/k3/v3: [BH, Sp, D] padded. Returns (o3, lse)."""
     bh, sp, d = q3.shape
     scale = 1.0 / math.sqrt(d)
-    block, full, vec_block, _ = _specs(sp, d)
+    tile, full, vec_tile, _ = _specs(sp, d, block)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, s_orig=s_orig,
-                          scale=scale),
-        grid=(bh, sp // _BLOCK),
-        in_specs=[block, full, full],
-        out_specs=[block, vec_block],
+                          scale=scale, block=block),
+        grid=(bh, sp // block),
+        in_specs=[tile, full, full],
+        out_specs=[tile, vec_tile],
         out_shape=[jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
                    jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3)
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block):
     bh, sp, d = q3.shape
     scale = 1.0 / math.sqrt(d)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, Sp, 1]
-    block, full, vec_block, vec_full = _specs(sp, d)
+    tile, full, vec_tile, vec_full = _specs(sp, d, block)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, s_orig=s_orig,
-                          scale=scale),
-        grid=(bh, sp // _BLOCK),
-        in_specs=[block, full, full, block, vec_block, vec_block],
-        out_specs=block,
+                          scale=scale, block=block),
+        grid=(bh, sp // block),
+        in_specs=[tile, full, full, tile, vec_tile, vec_tile],
+        out_specs=tile,
         out_shape=jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, s_orig=s_orig,
-                          scale=scale),
-        grid=(bh, sp // _BLOCK),
-        in_specs=[full, block, block, full, vec_full, vec_full],
-        out_specs=[block, block],
+                          scale=scale, block=block),
+        grid=(bh, sp // block),
+        in_specs=[full, tile, tile, full, vec_full, vec_full],
+        out_specs=[tile, tile],
         out_shape=[jax.ShapeDtypeStruct((bh, sp, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, sp, d), v3.dtype)],
         interpret=_interpret(),
@@ -237,32 +242,44 @@ def _to4d(x3, b, h):
     return x3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, causal):
-    o, _ = _flash_vjp_fwd(q, k, v, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, block):
+    o, _ = _flash_vjp_fwd(q, k, v, causal, block)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal):
+def _flash_vjp_fwd(q, k, v, causal, block):
     b, s, h, d = q.shape
-    q3, k3, v3 = (_pad_seq(_to3d(x)) for x in (q, k, v))
-    o3, lse = _flash_fwd(q3, k3, v3, causal, s)
+    q3, k3, v3 = (_pad_seq(_to3d(x), block) for x in (q, k, v))
+    o3, lse = _flash_fwd(q3, k3, v3, causal, s, block)
     return _to4d(o3, b, h)[:, :s], (q3, k3, v3, o3, lse, b, s, h)
 
 
-def _flash_vjp_bwd(causal, res, g):
+def _flash_vjp_bwd(causal, block, res, g):
     q3, k3, v3, o3, lse, b, s, h = res
-    do3 = _pad_seq(_to3d(g))
-    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s)
+    do3 = _pad_seq(_to3d(g), block)
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s,
+                               block)
     return tuple(_to4d(x3, b, h)[:, :s] for x3 in (dq3, dk3, dv3))
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False):
-    """Exact attention, O(S) memory. q/k/v: [B, S, H, D]."""
+def flash_attention(q, k, v, causal=False, block=None):
+    """Exact attention, O(S) memory. q/k/v: [B, S, H, D].
+
+    block: seq-dim VMEM tile for the Q/K loops (multiple of 128);
+    None uses CEA_FLASH_BLOCK or 128. Larger tiles amortize loop
+    overhead at the cost of VMEM — tune with tools/run_attn_bench.sh.
+    """
     if not (q.shape == k.shape == v.shape):
         raise ValueError(
             f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
-    return _flash(q, k, v, bool(causal))
+    if block is None:
+        block = _DEFAULT_BLOCK
+    block = int(block)
+    if block < 128 or block % 128:
+        raise ValueError(f"block must be a positive multiple of 128: "
+                         f"{block}")
+    return _flash(q, k, v, bool(causal), block)

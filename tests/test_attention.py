@@ -59,6 +59,25 @@ def test_flash_matches_dense(qkv, causal):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_block_size_invariance(qkv):
+    """The tunable seq tile must not change results (fwd + bwd)."""
+    q, k, v = qkv
+    want = flash_attention(q, k, v, causal=True, block=128)
+    got = flash_attention(q, k, v, causal=True, block=256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(block):
+        return jax.grad(lambda x: jnp.sum(flash_attention(
+            x, k, v, causal=True, block=block) ** 2))(q)
+
+    np.testing.assert_allclose(np.asarray(loss(256)),
+                               np.asarray(loss(128)),
+                               rtol=2e-4, atol=2e-4)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention(q, k, v, block=100)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_gradients_match_dense(qkv, causal):
     q, k, v = qkv

@@ -66,6 +66,9 @@ def main(argv=None):
     p.add_argument("--causal", action=argparse.BooleanOptionalAction,
                    default=True)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--block", type=int, default=None,
+                   help="flash kernel seq tile (multiple of 128); "
+                        "None = CEA_FLASH_BLOCK or 128")
     p.add_argument("--check-numerics", action="store_true",
                    help="compare each schedule against dense and "
                         "report max abs error in the JSON (validates "
@@ -96,7 +99,7 @@ def main(argv=None):
         "dense": jax.jit(lambda q, k, v: dot_product_attention(
             q, k, v, causal=args.causal)),
         "flash": jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, causal=args.causal)),
+            q, k, v, causal=args.causal, block=args.block)),
     }
     n = len(jax.devices())
     if n > 1:
@@ -132,6 +135,7 @@ def main(argv=None):
             "heads": h,
             "head_dim": d,
             "devices": n,
+            "block": args.block,
             "platform": jax.devices()[0].platform,
             "ms_per_call": round(sec * 1000, 3),
             "tflops": round(flops / sec / 1e12, 2),

@@ -34,6 +34,15 @@ for SEQ in 2048 4096 8192; do
        >> "${TMP}"
 done
 
+# Tile-size tuning sweep at the middle sequence length.
+for BLK in 256 512; do
+  echo "[attn-bench] seq_len=4096 block=${BLK}" >&2
+  timeout 900 python tools/bench_attention.py \
+    --seq-len 4096 --block "${BLK}" >> "${TMP}" \
+    || echo "{\"seq_len\": 4096, \"block\": ${BLK}, \
+\"error\": \"run failed/timeout\"}" >> "${TMP}"
+done
+
 python - "$TMP" "$OUT" <<'EOF'
 import json, sys, datetime
 rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
