@@ -80,6 +80,13 @@ class MetricServer:
         self._request = prometheus_client.Gauge(
             "request_count", "Number of TPU devices requested",
             ["namespace", "pod", "container"], registry=self._registry)
+        # Beyond the reference's gauge set: the manager's health gate
+        # as a scrapeable signal (1 healthy / 0 unhealthy per
+        # schedulable device), so alerting does not need to watch the
+        # kubelet's allocatable counts.
+        self._health = prometheus_client.Gauge(
+            "device_healthy", "1 when the device passes the health "
+            "gate, else 0", ["tpu_device"], registry=self._registry)
         self._httpd = None
         self._thread = None
         self._stop = threading.Event()
@@ -126,6 +133,11 @@ class MetricServer:
 
     def collect_once(self):
         """One collection pass (metrics.go:126-156); test seam."""
+        from .api import HEALTHY
+
+        for dev_id, health in sorted(self._m.list_devices().items()):
+            self._health.labels(dev_id).set(
+                1 if health == HEALTHY else 0)
         try:
             containers = get_devices_for_all_containers(
                 self._pod_resources_socket)
@@ -162,6 +174,7 @@ class MetricServer:
         self._memory_total.clear()
         self._memory_used.clear()
         self._request.clear()
+        self._health.clear()
 
     def _run(self):
         since_reset = 0.0

@@ -130,7 +130,17 @@ def test_collect_and_scrape(node2):
                 'pod="train-0",tpu_device="accel1"} 8192.0') in body
         assert ('request_count{container="jax",namespace="default",'
                 'pod="train-0"} 2.0') in body
+        assert 'device_healthy{tpu_device="accel0"} 1.0' in body
         assert "nvidia0" not in body
+        # The gauge tracks the manager's health gate.
+        from container_engine_accelerators_tpu.plugin.api import (
+            UNHEALTHY,
+        )
+        mgr.set_device_health("accel1", UNHEALTHY)
+        server.collect_once()
+        body = urllib.request.urlopen(
+            f"http://localhost:{server.port}/metrics").read().decode()
+        assert 'device_healthy{tpu_device="accel1"} 0.0' in body
         # Wrong path 404s (the reference serves only metricsPath).
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(
