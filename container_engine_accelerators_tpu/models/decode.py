@@ -33,6 +33,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def init_cache(model, batch, length):
@@ -78,18 +79,26 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
         logits = _logits_of(outputs)[:, 0]  # [B, V]
         if sample:
             rng, sub = jax.random.split(rng)
+            # temperature is a traced scalar or a [B] vector (one
+            # entry per row — cross-request batching in the serving
+            # layer shares one compiled program across client temps).
+            temp = jnp.reshape(jnp.asarray(temperature, jnp.float32),
+                               (-1, 1))
             sampled = jax.random.categorical(
-                sub, logits / temperature, axis=-1)
+                sub, logits / temp, axis=-1)
         else:
             sampled = jnp.argmax(logits, axis=-1)
         sampled = sampled.astype(prompt.dtype)
         # While still inside the prompt, the model's prediction is
         # discarded and the actual prompt token is fed (prefill).
-        # prompt_len is TRACED, so one compiled program serves every
-        # true prompt length padded into this shape bucket.
+        # prompt_len is TRACED (scalar or [B] per-row vector), so one
+        # compiled program serves every true prompt length padded
+        # into this shape bucket — and a cross-request batch may mix
+        # rows of different true lengths.
         forced = jax.lax.dynamic_index_in_dim(
             padded, jnp.minimum(t + 1, total - 1), 1, keepdims=False)
-        nxt = jnp.where(t + 1 < prompt_len, forced, sampled)
+        nxt = jnp.where(t + 1 < jnp.reshape(prompt_len, (-1,)),
+                        forced, sampled)
         return (updated["cache"], nxt, rng), nxt
 
     (_, _, _), produced = jax.lax.scan(
@@ -103,27 +112,41 @@ def decode(model, params, prompt, max_new_tokens, *,
     """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
 
     temperature == 0 is greedy argmax; > 0 samples from
-    softmax(logits / temperature) using ``rng``. Returns the full
+    softmax(logits / temperature) using ``rng``. A [B] temperature
+    vector applies per row (all entries must be > 0) — the serving
+    layer uses this to batch concurrent sampling requests with
+    different client temperatures into one call. Returns the full
     [B, P + max_new_tokens] sequence (prompt included). Only the
     greedy/sampling *mode* is compiled in; the temperature itself is
-    a traced scalar, so serving arbitrary client temperatures reuses
-    one compiled program per shape.
+    traced, so one compiled program per shape serves any temperature.
 
-    ``prompt_len`` (traced scalar, default P) is where generation
-    takes over from prefill: pass the true shared prompt length when
-    ``prompt`` is right-padded into a shape bucket (serving). The
-    generated tokens then occupy positions
-    [prompt_len, prompt_len + max_new_tokens) and the tail of the
-    returned sequence is scratch.
+    ``prompt_len`` (traced scalar or [B] per-row vector, default P)
+    is where generation takes over from prefill: pass true prompt
+    lengths when ``prompt`` is right-padded into a shape bucket
+    (serving). Row i's generated tokens then occupy positions
+    [prompt_len[i], prompt_len[i] + max_new_tokens) and the tail of
+    the returned sequence is scratch.
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
     if prompt_len is None:
         prompt_len = prompt.shape[1]
+    t_host = np.asarray(temperature, np.float32)
+    if t_host.ndim == 0:
+        sample = bool(t_host > 0.0)
+    elif (t_host > 0.0).all():
+        sample = True
+    elif (t_host == 0.0).all():
+        sample = False
+    else:
+        raise ValueError(
+            "per-row temperatures must be all zero (greedy) or all "
+            "positive (sampling); greedy and sampling rows compile "
+            "to different programs")
     return _decode_impl(model, params, prompt, max_new_tokens,
                         jnp.asarray(temperature, jnp.float32), rng,
                         jnp.asarray(prompt_len, jnp.int32),
-                        sample=temperature > 0.0)
+                        sample=sample)
 
 
 def greedy_decode(model, params, prompt, max_new_tokens):

@@ -28,6 +28,7 @@ Endpoints:
   GET  /stats                     request count + latency summary
 """
 
+import functools
 import json
 import queue
 import threading
@@ -69,6 +70,15 @@ class _Batcher:
         self._stop.set()
         self._queue.put(None)
         self._thread.join(timeout=5)
+        # Rows enqueued behind the shutdown sentinel would otherwise
+        # leave their handler threads blocked on done.get() forever.
+        try:
+            while True:
+                item = self._queue.get_nowait()
+                if item is not None:
+                    item[1].put(("error", "server stopping"))
+        except queue.Empty:
+            pass
 
     def _loop(self):
         while not self._stop.is_set():
@@ -88,7 +98,7 @@ class _Batcher:
                 if nxt is None:
                     break
                 batch.append(nxt)
-            instances = np.stack([b[0] for b in batch])
+            instances = [b[0] for b in batch]
             try:
                 outputs = self._run(instances)
                 for (_, done), out in zip(batch, outputs):
@@ -212,10 +222,10 @@ class InferenceServer(_BaseServer):
             return jnp.argmax(logits, axis=-1), jnp.max(probs, axis=-1)
 
         def run_batch(instances):
-            n = instances.shape[0]
+            n = len(instances)
             padded = np.zeros((max_batch, *self._input_shape),
                               dtype=np.float32)
-            padded[:n] = instances
+            padded[:n] = np.stack(instances)
             classes, scores = predict(padded)
             classes = np.asarray(classes)[:n]
             scores = np.asarray(scores)[:n]
@@ -224,7 +234,7 @@ class InferenceServer(_BaseServer):
 
         self._batcher = _Batcher(run_batch, max_batch, max_wait_ms)
         # Warm the compile cache before accepting traffic.
-        run_batch(np.zeros((1, *self._input_shape), dtype=np.float32))
+        run_batch([np.zeros(self._input_shape, dtype=np.float32)])
 
     def _post_path(self):
         return f"/v1/models/{self._name}:predict"
@@ -247,7 +257,10 @@ class InferenceServer(_BaseServer):
         pending = [self._batcher.submit_async(a) for a in arrays]
         predictions = []
         for done in pending:
-            status, out = done.get()
+            try:
+                status, out = done.get(timeout=120)
+            except queue.Empty:
+                return 500, {"error": "inference timed out"}
             if status != "ok":
                 return 500, {"error": out}
             predictions.append(out)
@@ -277,7 +290,7 @@ class GenerationServer(_BaseServer):
 
     def __init__(self, model_name, model, params, port=8500,
                  max_new_tokens=64, max_batch=8, buckets=None,
-                 warm=False):
+                 warm=False, max_wait_ms=5):
         super().__init__(model_name, port)
         from ..models.decode import decode
         self._decode = decode
@@ -285,6 +298,7 @@ class GenerationServer(_BaseServer):
         self._params = params
         self._max_new = max_new_tokens
         self._max_batch = max_batch
+        self._max_wait_ms = max_wait_ms
         self._seed = 0
         max_prompt = model.max_seq_len - max_new_tokens
         if max_prompt < 1:
@@ -301,25 +315,69 @@ class GenerationServer(_BaseServer):
             {b for b in buckets if 1 <= b <= max_prompt})
         if not self._buckets:
             raise ValueError("no valid prompt-length buckets")
+        # Cross-request batching: one _Batcher per (bucket, sampling
+        # mode) — rows from concurrent requests in the same bucket
+        # share one decode call. Rows carry per-row temperature and
+        # true prompt length (decode accepts [B] vectors for both),
+        # so clients with different temperatures and lengths still
+        # batch together; greedy and sampling stay separate (they are
+        # different compiled programs). The map is bounded at
+        # 2 x len(buckets) batcher threads.
+        self._batchers = {}
+        self._batchers_lock = threading.Lock()
+        self._stopping = False
         if warm:
             for b in self._buckets:
-                self._run(np.zeros((1, b), np.int32), b, 0.0, 0)
+                self._run([(np.zeros((b,), np.int32), 0.0, b)], 0.0)
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"
 
-    def _run(self, prompts, prompt_len, temperature, seed):
-        """Decode through the (max_batch, bucket) padded program."""
-        n = prompts.shape[0]
-        padded = np.zeros((self._max_batch, prompts.shape[1]),
-                          np.int32)
-        padded[:n] = prompts
+    def _run(self, instances, pad_temp):
+        """Decode a micro-batch of (row, temperature, prompt_len)
+        instances through the (max_batch, bucket) padded program."""
+        n = len(instances)
+        bucket = instances[0][0].shape[0]
+        padded = np.zeros((self._max_batch, bucket), np.int32)
+        temps = np.full((self._max_batch,), pad_temp, np.float32)
+        plens = np.full((self._max_batch,), bucket, np.int32)
+        for row, (tokens, temp, p_len) in enumerate(instances):
+            padded[row] = tokens
+            temps[row] = temp
+            plens[row] = p_len
+        with self._stats_lock:
+            self._seed += 1
+            seed = self._seed
         seq = self._decode(self._model, self._params,
                            jnp.asarray(padded), self._max_new,
-                           temperature=temperature,
+                           temperature=temps if pad_temp else 0.0,
                            rng=jax.random.PRNGKey(seed),
-                           prompt_len=prompt_len)
+                           prompt_len=plens)
         return np.asarray(seq)[:n]
+
+    def _batcher_for(self, bucket, sampling):
+        key = (bucket, sampling)
+        with self._batchers_lock:
+            if self._stopping:
+                return None
+            batcher = self._batchers.get(key)
+            if batcher is None:
+                batcher = _Batcher(
+                    functools.partial(
+                        self._run,
+                        pad_temp=1.0 if sampling else 0.0),
+                    self._max_batch, self._max_wait_ms)
+                self._batchers[key] = batcher
+            return batcher
+
+    def stop(self):
+        super().stop()
+        with self._batchers_lock:
+            self._stopping = True
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for batcher in batchers:
+            batcher.stop()
 
     def _handle_post(self, payload):
         try:
@@ -353,8 +411,19 @@ class GenerationServer(_BaseServer):
                                   f"max {self._buckets[-1]}"}
         padded = np.zeros((arr.shape[0], bucket), np.int32)
         padded[:, :p_len] = arr
-        with self._stats_lock:
-            self._seed += 1
-            seed = self._seed
-        seq = self._run(padded, p_len, temperature, seed)
+        batcher = self._batcher_for(bucket, temperature > 0.0)
+        if batcher is None:
+            return 503, {"error": "server is shutting down"}
+        pending = [batcher.submit_async((row, temperature, p_len))
+                   for row in padded]
+        rows = []
+        for done in pending:
+            try:
+                status, out = done.get(timeout=120)
+            except queue.Empty:
+                return 500, {"error": "decode timed out"}
+            if status != "ok":
+                return 500, {"error": out}
+            rows.append(out)
+        seq = np.stack(rows)
         return 200, {"sequences": seq[:, :p_len + new].tolist()}

@@ -88,6 +88,29 @@ def test_sampled_tokens_in_vocab(dense_lm):
     assert not np.array_equal(np.asarray(seq2), np.asarray(seq))
 
 
+def test_per_row_prompt_len_matches_single_row(dense_lm):
+    """A batch mixing true prompt lengths (per-row prompt_len vector)
+    must generate, per row, exactly what that row produces alone —
+    the property cross-request batching in the serving layer relies
+    on."""
+    model, params, _ = dense_lm
+    bucket = 6
+    lens = [3, 5]
+    rows = []
+    for i, n_true in enumerate(lens):
+        row = jax.random.randint(jax.random.PRNGKey(10 + i),
+                                 (1, n_true), 0, V)
+        rows.append(jnp.pad(row, ((0, 0), (0, bucket - n_true))))
+    batch = jnp.concatenate(rows, axis=0)
+    seq = decode(model, params, batch, N,
+                 prompt_len=jnp.asarray(lens, jnp.int32))
+    for i, n_true in enumerate(lens):
+        alone = decode(model, params, rows[i], N, prompt_len=n_true)
+        np.testing.assert_array_equal(
+            np.asarray(seq[i, :n_true + N]),
+            np.asarray(alone[0, :n_true + N]))
+
+
 def test_int8_kv_cache_matches_bf16_greedy(dense_lm):
     """int8 KV cache halves cache residency; greedy text on a small
     model must match the full-precision cache (per-row symmetric

@@ -147,6 +147,63 @@ def test_generate_sampling_and_batch(lm_server):
     assert all(len(s) == 6 for s in out["sequences"])
 
 
+def test_generate_cross_request_batching():
+    """Concurrent same-bucket generate requests share one decode
+    call — even with different temperatures AND different true
+    prompt lengths, which ride as per-row vectors."""
+    import threading
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=4,
+                           max_wait_ms=300)
+    calls = []
+    inner = srv._decode
+
+    def counting_decode(*args, **kwargs):
+        calls.append(kwargs.get("temperature"))
+        return inner(*args, **kwargs)
+
+    srv._decode = counting_decode
+    srv.start()
+    try:
+        results = {}
+
+        def fire(tag, prompt, temp):
+            results[tag] = post(
+                srv, "/v1/models/lm:generate",
+                {"prompts": [prompt], "max_new_tokens": 4,
+                 "temperature": temp})
+
+        threads = [
+            threading.Thread(target=fire, args=("a", [1, 2, 3], 0.7)),
+            threading.Thread(target=fire,
+                             args=("b", [4, 5, 6, 7], 1.3)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1, calls  # one decode for both requests
+        temps = sorted(np.asarray(calls[0])[:2].tolist())
+        np.testing.assert_allclose(temps, [0.7, 1.3], rtol=1e-6)
+        assert len(results["a"]["sequences"][0]) == 7
+        assert results["a"]["sequences"][0][:3] == [1, 2, 3]
+        assert len(results["b"]["sequences"][0]) == 8
+        assert results["b"]["sequences"][0][:4] == [4, 5, 6, 7]
+    finally:
+        srv.stop()
+
+
 def test_generate_validation(lm_server):
     for payload in (
             {"prompts": []},
