@@ -18,8 +18,10 @@
 
 Counterpart of the reference's load client
 (demo/serving/load_generator.yaml runs inception_profiler.py with -n
-requests and parallel workers): sends POST :predict requests from
-worker threads and prints a latency/QPS summary line.
+requests and parallel workers): sends POST :predict (image models) or
+:generate (LMs, --mode generate with randomized prompt lengths and
+temperatures to exercise the cross-request batcher) from worker
+threads and prints a latency/QPS summary line.
 """
 
 import argparse
@@ -32,17 +34,39 @@ import urllib.request
 import numpy as np
 
 
-def worker(url, image_size, n, results, errors):
+def _predict_payloads(args, rng):
     payload = json.dumps({
-        "instances": [np.zeros((image_size, image_size, 3)).tolist()]
+        "instances": [np.zeros((args.image_size, args.image_size,
+                                3)).tolist()]
     }).encode()
-    for _ in range(n):
+    while True:
+        yield payload
+
+
+def _generate_payloads(args, rng):
+    """Randomized prompt lengths/temperatures: same-bucket requests
+    from concurrent workers land in one decode micro-batch."""
+    while True:
+        p_len = int(rng.integers(1, args.max_prompt_len + 1))
+        prompt = rng.integers(0, args.vocab_size,
+                              size=(p_len,)).tolist()
+        temperature = (0.0 if rng.random() < 0.5
+                       else round(float(rng.uniform(0.5, 1.5)), 2))
+        yield json.dumps({
+            "prompts": [prompt],
+            "max_new_tokens": args.max_new_tokens,
+            "temperature": temperature,
+        }).encode()
+
+
+def worker(url, payloads, n, results, errors):
+    for payload, _ in zip(payloads, range(n)):
         t0 = time.perf_counter()
         try:
             req = urllib.request.Request(
                 url, data=payload,
                 headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=30) as resp:
+            with urllib.request.urlopen(req, timeout=60) as resp:
                 resp.read()
             results.append(time.perf_counter() - t0)
         except Exception:
@@ -54,19 +78,29 @@ def main(argv=None):
     p.add_argument("--host", default="localhost")
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--model-name", default="resnet")
+    p.add_argument("--mode", choices=["predict", "generate"],
+                   default="predict")
     p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--max-prompt-len", type=int, default=64)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--seed", type=int, default=0)
     p.add_argument("-n", "--num-requests", type=int, default=1000)
     p.add_argument("--parallelism", type=int, default=30)
     args = p.parse_args(argv)
 
     url = (f"http://{args.host}:{args.port}/v1/models/"
-           f"{args.model_name}:predict")
+           f"{args.model_name}:{args.mode}")
+    make_payloads = (_predict_payloads if args.mode == "predict"
+                     else _generate_payloads)
     per_worker = max(args.num_requests // args.parallelism, 1)
     results, errors = [], []
     threads = [threading.Thread(
-        target=worker, args=(url, args.image_size, per_worker,
-                             results, errors))
-        for _ in range(args.parallelism)]
+        target=worker,
+        args=(url, make_payloads(args,
+                                 np.random.default_rng(args.seed + i)),
+              per_worker, results, errors))
+        for i in range(args.parallelism)]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
