@@ -63,20 +63,15 @@ def _logits_of(outputs):
 
 @functools.partial(jax.jit,
                    static_argnames=("model", "max_new_tokens",
-                                    "sample"))
+                                    "sample", "fast_prefill"))
 def _decode_impl(model, params, prompt, max_new_tokens, temperature,
-                 rng, prompt_len, *, sample):
+                 rng, prompt_len, *, sample, fast_prefill=False):
     b, p_pad = prompt.shape
     total = p_pad + max_new_tokens
     decode_model, cache = init_cache(model, b, total)
     padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
 
-    def step(carry, t):
-        cache, tok, rng = carry
-        outputs, updated = decode_model.apply(
-            {"params": params, "cache": cache}, tok[:, None],
-            train=False, mutable=["cache"])
-        logits = _logits_of(outputs)[:, 0]  # [B, V]
+    def pick(logits, rng):
         if sample:
             rng, sub = jax.random.split(rng)
             # temperature is a traced scalar or a [B] vector (one
@@ -84,11 +79,18 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             # layer shares one compiled program across client temps).
             temp = jnp.reshape(jnp.asarray(temperature, jnp.float32),
                                (-1, 1))
-            sampled = jax.random.categorical(
-                sub, logits / temp, axis=-1)
+            chosen = jax.random.categorical(sub, logits / temp,
+                                            axis=-1)
         else:
-            sampled = jnp.argmax(logits, axis=-1)
-        sampled = sampled.astype(prompt.dtype)
+            chosen = jnp.argmax(logits, axis=-1)
+        return chosen.astype(prompt.dtype), rng
+
+    def step(carry, t):
+        cache, tok, rng = carry
+        outputs, updated = decode_model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            train=False, mutable=["cache"])
+        sampled, rng = pick(_logits_of(outputs)[:, 0], rng)
         # While still inside the prompt, the model's prediction is
         # discarded and the actual prompt token is fed (prefill).
         # prompt_len is TRACED (scalar or [B] per-row vector), so one
@@ -101,6 +103,25 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
                         forced, sampled)
         return (updated["cache"], nxt, rng), nxt
 
+    if fast_prefill and max_new_tokens > 0:
+        # The whole prompt runs as ONE forward pass that fills the
+        # cache (valid when every row's true length equals the prompt
+        # width): time-to-first-token is a single batched apply
+        # instead of P sequential single-token steps. The chunked
+        # cache write and intra-chunk causal mask live in
+        # CausalSelfAttention._cached_attention. (max_new_tokens == 0
+        # falls through: the fast path would emit one unrequested
+        # token.)
+        outputs, updated = decode_model.apply(
+            {"params": params, "cache": cache}, prompt,
+            train=False, mutable=["cache"])
+        first, rng = pick(_logits_of(outputs)[:, -1], rng)
+        (_, _, _), produced = jax.lax.scan(
+            step, (updated["cache"], first, rng),
+            jnp.arange(p_pad, total - 1))
+        return jnp.concatenate(
+            [prompt, first[:, None], produced.T], axis=1)
+
     (_, _, _), produced = jax.lax.scan(
         step, (cache, prompt[:, 0], rng), jnp.arange(total - 1))
     # produced[t] is the token at position t+1.
@@ -108,7 +129,8 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
 
 
 def decode(model, params, prompt, max_new_tokens, *,
-           temperature=0.0, rng=None, prompt_len=None):
+           temperature=0.0, rng=None, prompt_len=None,
+           fast_prefill=None):
     """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
 
     temperature == 0 is greedy argmax; > 0 samples from
@@ -131,6 +153,19 @@ def decode(model, params, prompt, max_new_tokens, *,
         rng = jax.random.PRNGKey(0)
     if prompt_len is None:
         prompt_len = prompt.shape[1]
+    # When every row's true length equals the prompt width there is
+    # no padding for generation to overwrite, so the prompt can
+    # prefill the cache in one forward pass (host-side decision: one
+    # extra compiled program per shape at most). Callers that must
+    # keep a fixed program set per shape (GenerationServer's warm
+    # guarantee) pass fast_prefill=False explicitly.
+    full_width = bool((np.asarray(prompt_len) == prompt.shape[1]).all())
+    if fast_prefill is None:
+        fast_prefill = full_width
+    elif fast_prefill and not full_width:
+        raise ValueError(
+            "fast_prefill=True requires every row's prompt_len to "
+            "equal the prompt width (no right-padding)")
     t_host = np.asarray(temperature, np.float32)
     if t_host.ndim == 0:
         sample = bool(t_host > 0.0)
@@ -146,7 +181,7 @@ def decode(model, params, prompt, max_new_tokens, *,
     return _decode_impl(model, params, prompt, max_new_tokens,
                         jnp.asarray(temperature, jnp.float32), rng,
                         jnp.asarray(prompt_len, jnp.int32),
-                        sample=sample)
+                        sample=sample, fast_prefill=fast_prefill)
 
 
 def greedy_decode(model, params, prompt, max_new_tokens):

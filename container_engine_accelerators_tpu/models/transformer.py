@@ -178,9 +178,14 @@ class CausalSelfAttention(nn.Module):
             # k_scale [B,S,H,1] -> [B,H,1,S] broadcast over queries.
             scores = scores * jnp.transpose(
                 k_scale.value[..., 0], (0, 2, 1))[:, :, None, :]
+        # Queries in a multi-token chunk (one-shot prefill) sit at
+        # positions i..i+Q-1; each attends causally to its own
+        # prefix. Single-token decode (Q=1) reduces to k_pos <= i.
         k_pos = jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=3)
-        scores = jnp.where(k_pos <= i, scores, -1e9)
+        q_pos = i + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, dimension=2)
+        scores = jnp.where(k_pos <= q_pos, scores, -1e9)
         probs = jax.nn.softmax(scores, axis=-1)
         if quantized:
             probs = probs * jnp.transpose(

@@ -348,11 +348,15 @@ class GenerationServer(_BaseServer):
         with self._stats_lock:
             self._seed += 1
             seed = self._seed
+        # fast_prefill=False keeps the per-bucket program set fixed
+        # (warm=True precompiles exactly these programs; the
+        # auto-selected one-shot-prefill variant would flip in and
+        # out with batch composition and stall requests on compiles).
         seq = self._decode(self._model, self._params,
                            jnp.asarray(padded), self._max_new,
                            temperature=temps if pad_temp else 0.0,
                            rng=jax.random.PRNGKey(seed),
-                           prompt_len=plens)
+                           prompt_len=plens, fast_prefill=False)
         return np.asarray(seq)[:n]
 
     def _batcher_for(self, bucket, sampling):

@@ -88,6 +88,20 @@ def test_sampled_tokens_in_vocab(dense_lm):
     assert not np.array_equal(np.asarray(seq2), np.asarray(seq))
 
 
+def test_fast_prefill_matches_stepwise(dense_lm):
+    """The one-shot-prefill program must produce exactly the
+    step-by-step program's greedy text, and zero-token requests keep
+    the documented [B, P] shape."""
+    model, params, prompt = dense_lm
+    fast = decode(model, params, prompt, N, fast_prefill=True)
+    slow = decode(model, params, prompt, N, fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+    assert decode(model, params, prompt, 0).shape == (B, P)
+    with pytest.raises(ValueError, match="fast_prefill"):
+        decode(model, params, prompt, N, prompt_len=P - 1,
+               fast_prefill=True)
+
+
 def test_per_row_prompt_len_matches_single_row(dense_lm):
     """A batch mixing true prompt lengths (per-row prompt_len vector)
     must generate, per row, exactly what that row produces alone —
