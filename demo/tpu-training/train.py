@@ -161,6 +161,9 @@ def parse_args(argv=None):
                         "format) covering the timed steps")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="also checkpoint every N steps (0 = end only)")
+    p.add_argument("--keep-checkpoints", type=int, default=0,
+                   help="retain only the newest N finished "
+                        "checkpoints (0 = keep all)")
     return p.parse_args(argv)
 
 
@@ -207,19 +210,47 @@ def finalize_checkpoints():
         _async_checkpointer.wait_until_finished()
 
 
+def _list_checkpoints(model_dir):
+    """Sorted (step, name) pairs of finished checkpoint_N dirs.
+
+    Skips names whose suffix is not an integer — orbax async writes
+    go through "checkpoint_N.orbax-checkpoint-tmp-*" siblings that
+    must be neither restored from nor pruned.
+    """
+    entries = []
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        return entries
+    for name in names:
+        if not name.startswith("checkpoint_"):
+            continue
+        try:
+            entries.append((int(name.rsplit("_", 1)[1]), name))
+        except ValueError:
+            continue
+    return sorted(entries)
+
+
+def prune_checkpoints(model_dir, keep):
+    """Delete all but the newest ``keep`` finished checkpoints."""
+    import shutil
+
+    if keep < 1:
+        return
+    for _, name in _list_checkpoints(model_dir)[:-keep]:
+        path = os.path.join(model_dir, name)
+        shutil.rmtree(path, ignore_errors=True)
+        print(f"pruned checkpoint {path}", file=sys.stderr)
+
+
 def restore_checkpoint(model_dir, state):
     """Resume from the newest checkpoint_N under model_dir, if any."""
     import orbax.checkpoint as ocp
 
     from container_engine_accelerators_tpu.parallel.train import TrainState
 
-    try:
-        entries = sorted(
-            (int(name.rsplit("_", 1)[1]), name)
-            for name in os.listdir(model_dir)
-            if name.startswith("checkpoint_"))
-    except OSError:
-        return state
+    entries = _list_checkpoints(model_dir)
     if not entries:
         return state
     path = os.path.abspath(os.path.join(model_dir, entries[-1][1]))
@@ -427,6 +458,8 @@ def main(argv=None):
         if (args.model_dir and args.checkpoint_every
                 and (step + 1) % args.checkpoint_every == 0):
             save_checkpoint(args.model_dir, state)
+            if args.keep_checkpoints:
+                prune_checkpoints(args.model_dir, args.keep_checkpoints)
     jax.block_until_ready(state.params)
     # A prefetching loader would otherwise keep staged batches pinned
     # in HBM through checkpointing below.
@@ -459,6 +492,8 @@ def main(argv=None):
     if args.model_dir:
         save_checkpoint(args.model_dir, state)
         finalize_checkpoints()
+        if args.keep_checkpoints:
+            prune_checkpoints(args.model_dir, args.keep_checkpoints)
     print(json.dumps(result))
     return result
 

@@ -268,6 +268,29 @@ def test_train_driver_async_periodic_checkpoints(tmp_path):
         assert restored["step"] == int(name.rsplit("_", 1)[1])
 
 
+def test_train_driver_checkpoint_retention(tmp_path):
+    """--keep-checkpoints prunes old finished checkpoints; the final
+    (newest) one survives and restores."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "demo_train_retention", "demo/tpu-training/train.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main(["--model", "mnist", "--steps", "4", "--warmup-steps", "0",
+              "--batch-size", "16", "--model-dir", str(tmp_path),
+              "--checkpoint-every", "1", "--keep-checkpoints", "2"])
+    names = sorted(n for n in os.listdir(tmp_path)
+                   if n.startswith("checkpoint_"))
+    assert names == ["checkpoint_3", "checkpoint_4"]
+    # Non-integer suffixes (orbax tmp dirs) are ignored by listing,
+    # pruning, and restore.
+    (tmp_path / "checkpoint_9.orbax-checkpoint-tmp-1").mkdir()
+    assert mod._list_checkpoints(str(tmp_path)) == [
+        (3, "checkpoint_3"), (4, "checkpoint_4")]
+
+
 def test_train_driver_moe_expert_parallel():
     """The LM demo path end-to-end: MoE model, expert mesh axis,
     router loss, token loader — through the same CLI surface the
