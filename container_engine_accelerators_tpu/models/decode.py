@@ -61,11 +61,37 @@ def _logits_of(outputs):
     return outputs[0] if isinstance(outputs, tuple) else outputs
 
 
+def _mask_top_k(logits, top_k):
+    """Keep each row's top_k logits; mask the rest. top_k static.
+
+    Masked tokens get -inf (exactly zero probability) — any finite
+    sentinel would flip sign under extreme temperature scaling and
+    invert the filter.
+    """
+    kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _mask_top_p(logits, top_p):
+    """Nucleus mask: keep the smallest prefix of the probability-
+    sorted vocab whose mass reaches top_p. top_p is a traced scalar
+    or per-row [B] vector (1.0 is a no-op row)."""
+    desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < jnp.reshape(top_p, (-1, 1))
+    cutoff = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("model", "max_new_tokens",
-                                    "sample", "fast_prefill"))
+                                    "sample", "fast_prefill",
+                                    "top_k", "use_top_p"))
 def _decode_impl(model, params, prompt, max_new_tokens, temperature,
-                 rng, prompt_len, *, sample, fast_prefill=False):
+                 rng, prompt_len, top_p, *, sample,
+                 fast_prefill=False, top_k=0, use_top_p=False):
     b, p_pad = prompt.shape
     total = p_pad + max_new_tokens
     decode_model, cache = init_cache(model, b, total)
@@ -79,8 +105,12 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             # layer shares one compiled program across client temps).
             temp = jnp.reshape(jnp.asarray(temperature, jnp.float32),
                                (-1, 1))
-            chosen = jax.random.categorical(sub, logits / temp,
-                                            axis=-1)
+            logits = logits / temp
+            if top_k:
+                logits = _mask_top_k(logits, top_k)
+            if use_top_p:
+                logits = _mask_top_p(logits, top_p)
+            chosen = jax.random.categorical(sub, logits, axis=-1)
         else:
             chosen = jnp.argmax(logits, axis=-1)
         return chosen.astype(prompt.dtype), rng
@@ -130,7 +160,7 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
 
 def decode(model, params, prompt, max_new_tokens, *,
            temperature=0.0, rng=None, prompt_len=None,
-           fast_prefill=None):
+           fast_prefill=None, top_k=0, top_p=1.0):
     """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
 
     temperature == 0 is greedy argmax; > 0 samples from
@@ -141,6 +171,12 @@ def decode(model, params, prompt, max_new_tokens, *,
     [B, P + max_new_tokens] sequence (prompt included). Only the
     greedy/sampling *mode* is compiled in; the temperature itself is
     traced, so one compiled program per shape serves any temperature.
+
+    Sampling filters: ``top_k`` (static — each value compiles its own
+    program) keeps the k most likely tokens; ``top_p`` (traced scalar
+    or per-row [B] vector, 1.0 = off) keeps the smallest nucleus of
+    probability mass >= top_p. Both apply after temperature, and
+    compose (top_k first).
 
     ``prompt_len`` (traced scalar or [B] per-row vector, default P)
     is where generation takes over from prefill: pass true prompt
@@ -178,10 +214,21 @@ def decode(model, params, prompt, max_new_tokens, *,
             "per-row temperatures must be all zero (greedy) or all "
             "positive (sampling); greedy and sampling rows compile "
             "to different programs")
+    top_k = int(top_k)
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0: {top_k}")
+    p_host = np.asarray(top_p, np.float32)
+    if (p_host <= 0.0).any() or (p_host > 1.0).any():
+        raise ValueError("top_p entries must be in (0, 1]")
+    # top_p == 1.0 everywhere is the identity; skip the mask so the
+    # common no-nucleus case costs nothing and compiles no variant.
+    use_top_p = bool((p_host < 1.0).any())
     return _decode_impl(model, params, prompt, max_new_tokens,
                         jnp.asarray(temperature, jnp.float32), rng,
                         jnp.asarray(prompt_len, jnp.int32),
-                        sample=sample, fast_prefill=fast_prefill)
+                        jnp.asarray(top_p, jnp.float32),
+                        sample=sample, fast_prefill=fast_prefill,
+                        top_k=top_k, use_top_p=use_top_p)
 
 
 def greedy_decode(model, params, prompt, max_new_tokens):

@@ -276,16 +276,20 @@ class GenerationServer(_BaseServer):
 
     POST /v1/models/<name>:generate
       {"prompts": [[ids...], ...], "max_new_tokens": N,
-       "temperature": T}
+       "temperature": T, "top_k": K, "top_p": P}
 
     All prompts in one request must share a length. Client-visible
     shapes never reach the compiler: prompts are right-padded into a
     fixed set of length buckets, the batch is padded to ``max_batch``,
     and the decode horizon is always ``max_new_tokens`` (the response
-    is sliced to what was asked). The jit cache is therefore bounded
-    at 2 programs per bucket (greedy/sampling), and every bucket's
-    greedy program is optionally compiled before traffic
-    (``warm=True``) so no request ever blocks on a compile.
+    is sliced to what was asked). Default traffic (no top_k) costs
+    2 programs per bucket (greedy/sampling, both optionally compiled
+    before traffic via ``warm=True`` so no such request blocks on a
+    compile); sampling filters add bounded variants compiled on first
+    use — top_p one nucleus variant per (bucket, top_k), top_k one
+    program per power-of-two value (client values quantize up, so at
+    most log2(vocab) per bucket). Batcher threads follow the same
+    bound: one per (bucket, mode, effective top_k) actually seen.
     """
 
     def __init__(self, model_name, model, params, port=8500,
@@ -316,35 +320,39 @@ class GenerationServer(_BaseServer):
         if not self._buckets:
             raise ValueError("no valid prompt-length buckets")
         # Cross-request batching: one _Batcher per (bucket, sampling
-        # mode) — rows from concurrent requests in the same bucket
-        # share one decode call. Rows carry per-row temperature and
-        # true prompt length (decode accepts [B] vectors for both),
-        # so clients with different temperatures and lengths still
-        # batch together; greedy and sampling stay separate (they are
-        # different compiled programs). The map is bounded at
-        # 2 x len(buckets) batcher threads.
+        # mode, effective top_k) — rows from concurrent requests with
+        # the same key share one decode call. Rows carry per-row
+        # temperature, true prompt length, and top_p (decode accepts
+        # [B] vectors for all three), so clients differing only in
+        # those still batch together; greedy and sampling stay
+        # separate (different compiled programs), as does each
+        # power-of-two top_k. See the class docstring for the bound.
         self._batchers = {}
         self._batchers_lock = threading.Lock()
         self._stopping = False
         if warm:
             for b in self._buckets:
-                self._run([(np.zeros((b,), np.int32), 0.0, b)], 0.0)
+                self._run([(np.zeros((b,), np.int32), 0.0, b, 1.0)],
+                          0.0)
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"
 
-    def _run(self, instances, pad_temp):
-        """Decode a micro-batch of (row, temperature, prompt_len)
-        instances through the (max_batch, bucket) padded program."""
+    def _run(self, instances, pad_temp, top_k=0):
+        """Decode a micro-batch of (row, temperature, prompt_len,
+        top_p) instances through the (max_batch, bucket) padded
+        program."""
         n = len(instances)
         bucket = instances[0][0].shape[0]
         padded = np.zeros((self._max_batch, bucket), np.int32)
         temps = np.full((self._max_batch,), pad_temp, np.float32)
         plens = np.full((self._max_batch,), bucket, np.int32)
-        for row, (tokens, temp, p_len) in enumerate(instances):
+        top_ps = np.ones((self._max_batch,), np.float32)
+        for row, (tokens, temp, p_len, top_p) in enumerate(instances):
             padded[row] = tokens
             temps[row] = temp
             plens[row] = p_len
+            top_ps[row] = top_p
         with self._stats_lock:
             self._seed += 1
             seed = self._seed
@@ -352,15 +360,19 @@ class GenerationServer(_BaseServer):
         # (warm=True precompiles exactly these programs; the
         # auto-selected one-shot-prefill variant would flip in and
         # out with batch composition and stall requests on compiles).
+        # A per-row top_p rides as a vector in the same program; any
+        # top_p < 1.0 in the batch selects the nucleus variant (one
+        # extra program per bucket, compiled on first use).
         seq = self._decode(self._model, self._params,
                            jnp.asarray(padded), self._max_new,
                            temperature=temps if pad_temp else 0.0,
                            rng=jax.random.PRNGKey(seed),
-                           prompt_len=plens, fast_prefill=False)
+                           prompt_len=plens, fast_prefill=False,
+                           top_k=top_k, top_p=top_ps)
         return np.asarray(seq)[:n]
 
-    def _batcher_for(self, bucket, sampling):
-        key = (bucket, sampling)
+    def _batcher_for(self, bucket, sampling, top_k):
+        key = (bucket, sampling, top_k)
         with self._batchers_lock:
             if self._stopping:
                 return None
@@ -369,7 +381,8 @@ class GenerationServer(_BaseServer):
                 batcher = _Batcher(
                     functools.partial(
                         self._run,
-                        pad_temp=1.0 if sampling else 0.0),
+                        pad_temp=1.0 if sampling else 0.0,
+                        top_k=top_k),
                     self._max_batch, self._max_wait_ms)
                 self._batchers[key] = batcher
             return batcher
@@ -388,8 +401,24 @@ class GenerationServer(_BaseServer):
             prompts = payload["prompts"]
             new = int(payload.get("max_new_tokens", self._max_new))
             temperature = float(payload.get("temperature", 0.0))
+            top_k = int(payload.get("top_k", 0))
+            top_p = float(payload.get("top_p", 1.0))
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"error": f"bad request: {e}"}
+        if not 0 <= top_k <= self._model.vocab_size:
+            return 400, {"error": f"top_k must be in "
+                                  f"0..{self._model.vocab_size}"}
+        if not 0.0 < top_p <= 1.0:
+            return 400, {"error": "top_p must be in (0, 1]"}
+        if (top_k or top_p < 1.0) and temperature <= 0.0:
+            return 400, {"error": "top_k/top_p require temperature > 0"}
+        if top_k:
+            # Quantize to the next power of two (a superset of the
+            # requested support) so untrusted clients cannot mint an
+            # unbounded set of compiled programs / batcher threads —
+            # distinct effective values are bounded at log2(vocab).
+            top_k = min(1 << (top_k - 1).bit_length(),
+                        self._model.vocab_size)
         if not prompts or len(prompts) > self._max_batch:
             return 400, {"error": f"need 1..{self._max_batch} prompts"}
         if len({len(p) for p in prompts}) != 1:
@@ -415,10 +444,11 @@ class GenerationServer(_BaseServer):
                                   f"max {self._buckets[-1]}"}
         padded = np.zeros((arr.shape[0], bucket), np.int32)
         padded[:, :p_len] = arr
-        batcher = self._batcher_for(bucket, temperature > 0.0)
+        batcher = self._batcher_for(bucket, temperature > 0.0, top_k)
         if batcher is None:
             return 503, {"error": "server is shutting down"}
-        pending = [batcher.submit_async((row, temperature, p_len))
+        pending = [batcher.submit_async((row, temperature, p_len,
+                                         top_p))
                    for row in padded]
         rows = []
         for done in pending:

@@ -88,6 +88,45 @@ def test_sampled_tokens_in_vocab(dense_lm):
     assert not np.array_equal(np.asarray(seq2), np.asarray(seq))
 
 
+def test_top_k_one_and_tiny_top_p_are_greedy(dense_lm):
+    """top_k=1 and a nucleus containing only the argmax both reduce
+    sampling to greedy — exact token equality, any seed."""
+    model, params, prompt = dense_lm
+    want = greedy_decode(model, params, prompt, N)
+    for kwargs in ({"top_k": 1}, {"top_p": 1e-6}):
+        got = decode(model, params, prompt, N, temperature=1.0,
+                     rng=jax.random.PRNGKey(11), **kwargs)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+
+
+def test_top_k_restricts_support(dense_lm):
+    """Sampled continuations with top_k must land in each step's
+    top-k token set of the dense forward."""
+    model, params, prompt = dense_lm
+    k = 3
+    seq = decode(model, params, prompt, N, temperature=1.0,
+                 rng=jax.random.PRNGKey(12), top_k=k)
+    outputs = model.apply({"params": params}, seq, train=False)
+    logits = outputs[0] if isinstance(outputs, tuple) else outputs
+    top = np.asarray(
+        jax.lax.top_k(logits, k)[1])  # [B, S, k] token ids
+    got = np.asarray(seq)
+    for t in range(P - 1, seq.shape[1] - 1):
+        for b in range(B):
+            assert got[b, t + 1] in top[b, t]
+
+
+def test_sampling_filter_validation(dense_lm):
+    model, params, prompt = dense_lm
+    with pytest.raises(ValueError):
+        decode(model, params, prompt, N, temperature=1.0, top_k=-1)
+    for bad_p in (0.0, 1.5, -0.1):
+        with pytest.raises(ValueError):
+            decode(model, params, prompt, N, temperature=1.0,
+                   top_p=bad_p)
+
+
 def test_fast_prefill_matches_stepwise(dense_lm):
     """The one-shot-prefill program must produce exactly the
     step-by-step program's greedy text, and zero-token requests keep

@@ -204,12 +204,24 @@ def test_generate_cross_request_batching():
         srv.stop()
 
 
+def test_generate_top_k_top_p(lm_server):
+    out = post(lm_server, "/v1/models/lm:generate",
+               {"prompts": [[5, 6, 7]], "max_new_tokens": 4,
+                "temperature": 0.9, "top_k": 4, "top_p": 0.8})
+    seq = out["sequences"][0]
+    assert len(seq) == 7 and seq[:3] == [5, 6, 7]
+    assert all(0 <= t < 64 for t in seq)
+
+
 def test_generate_validation(lm_server):
     for payload in (
             {"prompts": []},
             {"prompts": [[1, 2], [1, 2, 3]]},          # ragged
             {"prompts": [[1]], "max_new_tokens": 999},  # over limit
             {"prompts": [[0] * 30], "max_new_tokens": 8},  # > max_seq
+            {"prompts": [[1]], "top_k": -1, "temperature": 1.0},
+            {"prompts": [[1]], "top_p": 0.0, "temperature": 1.0},
+            {"prompts": [[1]], "top_k": 5},  # filters need temp > 0
     ):
         with pytest.raises(urllib.error.HTTPError) as err:
             post(lm_server, "/v1/models/lm:generate", payload)
