@@ -234,3 +234,113 @@ def decode(model, params, prompt, max_new_tokens, *,
 def greedy_decode(model, params, prompt, max_new_tokens):
     """Greedy generation (temperature 0)."""
     return decode(model, params, prompt, max_new_tokens)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("model", "max_new_tokens",
+                                    "num_beams"))
+def _beam_impl(model, params, prompt, max_new_tokens, *, num_beams):
+    b, p = prompt.shape
+    k = num_beams
+    total = p + max_new_tokens
+
+    # Prefill ONCE on [B] rows, then fan the cache out to [B*K]
+    # beam rows — beams are identical until the first expansion, so
+    # prefilling per beam would waste (K-1)/K of the prefill FLOPs.
+    decode_model, cache = init_cache(model, b, total)
+    outputs, updated = decode_model.apply(
+        {"params": params, "cache": cache}, prompt,
+        train=False, mutable=["cache"])
+    logprobs = jax.nn.log_softmax(
+        _logits_of(outputs)[:, -1].astype(jnp.float32), axis=-1)
+    v = logprobs.shape[-1]
+
+    def fan_out(a):
+        if a.ndim and a.shape[0] == b:
+            return jnp.repeat(a, k, axis=0)
+        return a  # scalars (pos_index/cache_index) are shared
+
+    # Beam rows of one batch element are adjacent (row b*k + j); the
+    # [B, total] cache init means the per-row buffers already have
+    # full length, so fan-out is a pure gather.
+    cache = jax.tree_util.tree_map(fan_out, updated["cache"])
+    logprobs = fan_out(logprobs)  # [B*K, V]
+
+    # All beams start identical: only beam 0 is live, so the first
+    # expansion picks K distinct tokens instead of K copies.
+    scores0 = jnp.where(jnp.arange(k) == 0, 0.0, -jnp.inf)
+    scores0 = jnp.broadcast_to(scores0, (b, k))
+    seqs0 = jnp.zeros((b, k, max_new_tokens), prompt.dtype)
+
+    def select(seqs, scores, logprobs, t):
+        # Combine beam scores with next-token logprobs; pick the K
+        # best (beam, token) pairs per batch element. Beams whose
+        # score is -inf (k exceeds the number of distinct
+        # continuations so far) get token 0 as defined padding.
+        totals = (scores[:, :, None]
+                  + logprobs.reshape(b, k, v)).reshape(b, k * v)
+        new_scores, idx = jax.lax.top_k(totals, k)      # [B, K]
+        parent = idx // v
+        token = (idx % v).astype(prompt.dtype)
+        token = jnp.where(jnp.isfinite(new_scores), token, 0)
+        flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
+        seqs = jax.lax.dynamic_update_index_in_dim(
+            seqs, token, t, axis=2)
+        return seqs, new_scores, token, flat_parent
+
+    def reorder(tree, flat_parent):
+        # Gather beam-major leaves; scalars (pos_index) are shared.
+        return jax.tree_util.tree_map(
+            lambda a: a[flat_parent] if a.ndim and
+            a.shape[0] == b * k else a, tree)
+
+    def expand(carry, t):
+        cache, seqs, scores, logprobs = carry
+        seqs, scores, token, flat_parent = select(
+            seqs, scores, logprobs, t)
+        cache = reorder(cache, flat_parent)
+        outputs, updated = decode_model.apply(
+            {"params": params, "cache": cache},
+            token.reshape(b * k, 1), train=False, mutable=["cache"])
+        logprobs = jax.nn.log_softmax(
+            _logits_of(outputs)[:, 0].astype(jnp.float32), axis=-1)
+        return (updated["cache"], seqs, scores, logprobs), None
+
+    # The final expansion needs no model apply (its logprobs would be
+    # discarded), so the scan runs max_new_tokens - 1 applies and the
+    # last selection happens outside.
+    if max_new_tokens > 1:
+        (cache, seqs0, scores0, logprobs), _ = jax.lax.scan(
+            expand, (cache, seqs0, scores0, logprobs),
+            jnp.arange(max_new_tokens - 1))
+    seqs, scores, _, _ = select(seqs0, scores0, logprobs,
+                                max_new_tokens - 1)
+    full = jnp.concatenate(
+        [jnp.broadcast_to(prompt[:, None], (b, k, p)), seqs], axis=2)
+    return full, scores
+
+
+def beam_search(model, params, prompt, max_new_tokens, *, num_beams=4):
+    """Beam-search generation: the num_beams highest sum-logprob
+    continuations per batch element.
+
+    One compiled program per shape: the prompt prefills a [B]-row
+    cache in one forward pass, the cache fans out to [B*K] beam
+    rows, and a lax.scan expands every beam, selects the global
+    top-K (beam, token) pairs, and gathers the cache rows onto the
+    surviving beams (the final selection runs outside the scan — its
+    logprobs would need no further model apply). Returns
+    (sequences [B, K, P + max_new_tokens], scores [B, K]), beams
+    sorted best-first; num_beams=1 is exactly greedy. When num_beams
+    exceeds the number of distinct continuations (k > V^n), the
+    surplus beams come back with score -inf and token-0 padding. No
+    EOS handling — the demo models have no end-of-sequence
+    semantics; callers that need it can post-trim.
+    """
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1: {num_beams}")
+    if max_new_tokens < 1:
+        raise ValueError("beam_search needs max_new_tokens >= 1")
+    return _beam_impl(model, params, prompt, max_new_tokens,
+                      num_beams=int(num_beams))

@@ -31,6 +31,7 @@ from container_engine_accelerators_tpu.models import (
     TransformerLM,
 )
 from container_engine_accelerators_tpu.models.decode import (
+    beam_search,
     decode,
     greedy_decode,
 )
@@ -196,6 +197,67 @@ def test_int8_kv_cache_matches_bf16_greedy(dense_lm):
     assert kv and all(a.dtype == jnp.int8 for _, a in kv)
     scales = [a for p, a in leaves if "scale" in str(p)]
     assert scales and all(a.dtype == jnp.float32 for a in scales)
+
+
+def test_beam_one_is_greedy(dense_lm):
+    model, params, prompt = dense_lm
+    seqs, scores = beam_search(model, params, prompt, N, num_beams=1)
+    want = greedy_decode(model, params, prompt, N)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
+                                  np.asarray(want))
+    assert scores.shape == (B, 1)
+
+
+def test_beam_scores_sorted_and_consistent(dense_lm):
+    """Beams come best-first, and each beam's score equals the sum
+    of its tokens' logprobs under the dense forward."""
+    model, params, prompt = dense_lm
+    k = 3
+    seqs, scores = beam_search(model, params, prompt, N, num_beams=k)
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-5).all()  # descending
+    for bi in range(B):
+        for j in range(k):
+            outputs = model.apply({"params": params}, seqs[bi:bi + 1, j],
+                                  train=False)
+            logits = (outputs[0] if isinstance(outputs, tuple)
+                      else outputs)
+            lp = jax.nn.log_softmax(
+                logits[0].astype(jnp.float32), axis=-1)
+            got = np.asarray(seqs[bi, j])
+            want = sum(float(lp[t, got[t + 1]])
+                       for t in range(P - 1, P + N - 1))
+            np.testing.assert_allclose(float(s[bi, j]), want,
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_beam_wide_equals_exhaustive():
+    """With num_beams >= V^N every path survives, so the best beam
+    must equal the exhaustive argmax over all continuations."""
+    import itertools
+
+    v, n = 5, 2
+    model = TransformerLM(vocab_size=v, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=8,
+                          dtype=jnp.float32)
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(5), prompt)["params"]
+    seqs, scores = beam_search(model, params, prompt, n,
+                               num_beams=v ** n)
+
+    best_score, best_path = -np.inf, None
+    for path in itertools.product(range(v), repeat=n):
+        seq = jnp.asarray([[1, 2, *path]], jnp.int32)
+        logits = model.apply({"params": params}, seq, train=False)
+        lp = jax.nn.log_softmax(
+            np.asarray(logits)[0].astype(np.float32), axis=-1)
+        score = sum(lp[t, seq[0, t + 1]] for t in range(1, n + 1))
+        if score > best_score:
+            best_score, best_path = score, path
+    np.testing.assert_array_equal(np.asarray(seqs[0, 0, 2:]),
+                                  np.asarray(best_path))
+    np.testing.assert_allclose(float(scores[0, 0]), best_score,
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_moe_greedy_matches_dense_forward():
