@@ -164,6 +164,10 @@ def parse_args(argv=None):
     p.add_argument("--keep-checkpoints", type=int, default=0,
                    help="retain only the newest N finished "
                         "checkpoints (0 = keep all)")
+    p.add_argument("--eval-batches", type=int, default=0,
+                   help="after training, report top-1 accuracy "
+                        "(next-token accuracy for LMs) over N "
+                        "batches through the compiled eval step")
     return p.parse_args(argv)
 
 
@@ -323,6 +327,28 @@ def build_model(args):
     model = resnet(depth=args.depth, num_classes=args.num_classes)
     return (model, resnet_make_apply_fn(model),
             (args.image_size, args.image_size, 3), args.num_classes)
+
+
+def evaluate(trainer, state, loader, args):
+    """Top-1 accuracy over --eval-batches through the compiled eval
+    step (next-token accuracy for the LM families)."""
+    import numpy as np
+
+    correct, total = 0, 0
+    for _, batch in zip(range(args.eval_batches), loader):
+        inputs, labels = batch
+        logits = trainer.eval_step(state, inputs)
+        if isinstance(logits, tuple):  # MoE: (logits, aux)
+            logits = logits[0]
+        logits = np.asarray(logits)
+        labels = np.asarray(labels)
+        if args.model in LM_MODELS:
+            pred, want = logits[:, :-1].argmax(-1), labels[:, 1:]
+        else:
+            pred, want = logits.argmax(-1), labels
+        correct += int((pred == want).sum())
+        total += want.size
+    return correct / max(total, 1)
 
 
 def main(argv=None):
@@ -489,6 +515,11 @@ def main(argv=None):
     if args.model in LM_MODELS:
         result["tokens_per_sec"] = round(
             images_per_sec * args.seq_len, 2)
+    if args.eval_batches:
+        result["eval_accuracy"] = round(evaluate(
+            trainer, state, loader, args), 4)
+        print(f"eval accuracy {result['eval_accuracy']}",
+              file=sys.stderr)
     if args.model_dir:
         save_checkpoint(args.model_dir, state)
         finalize_checkpoints()
