@@ -187,12 +187,18 @@ class _BaseServer:
         with self._stats_lock:
             lat = sorted(self._latencies)
             n = len(lat)
-            return {
+            out = {
                 "requests": self._requests,
                 "p50_ms": round(lat[n // 2] * 1000, 3) if n else None,
                 "p99_ms": round(lat[int(n * 0.99)] * 1000, 3)
                 if n else None,
             }
+            out.update(self._extra_stats())
+            return out
+
+    def _extra_stats(self):
+        """Subclass hook; called under _stats_lock."""
+        return {}
 
     def serve_forever(self):
         log.info("serving model %r on :%d", self._name, self.port)
@@ -304,6 +310,8 @@ class GenerationServer(_BaseServer):
         self._max_batch = max_batch
         self._max_wait_ms = max_wait_ms
         self._seed = 0
+        self._decode_calls = 0
+        self._decode_rows = 0
         max_prompt = model.max_seq_len - max_new_tokens
         if max_prompt < 1:
             raise ValueError(
@@ -356,6 +364,8 @@ class GenerationServer(_BaseServer):
         with self._stats_lock:
             self._seed += 1
             seed = self._seed
+            self._decode_calls += 1
+            self._decode_rows += n
         # fast_prefill=False keeps the per-bucket program set fixed
         # (warm=True precompiles exactly these programs; the
         # auto-selected one-shot-prefill variant would flip in and
@@ -386,6 +396,17 @@ class GenerationServer(_BaseServer):
                     self._max_batch, self._max_wait_ms)
                 self._batchers[key] = batcher
             return batcher
+
+    def _extra_stats(self):
+        """Decode-batch occupancy: rows served per compiled call —
+        the batching-efficiency signal for load tests."""
+        calls = self._decode_calls
+        return {
+            "decode_calls": calls,
+            "decode_rows": self._decode_rows,
+            "avg_batch_occupancy": (
+                round(self._decode_rows / calls, 3) if calls else None),
+        }
 
     def stop(self):
         super().stop()

@@ -200,6 +200,13 @@ def test_generate_cross_request_batching():
         assert results["a"]["sequences"][0][:3] == [1, 2, 3]
         assert len(results["b"]["sequences"][0]) == 8
         assert results["b"]["sequences"][0][:4] == [4, 5, 6, 7]
+        with urllib.request.urlopen(
+                f"http://localhost:{srv.port}/stats",
+                timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["decode_calls"] == 1
+        assert stats["decode_rows"] == 2
+        assert stats["avg_batch_occupancy"] == 2.0
     finally:
         srv.stop()
 
