@@ -168,6 +168,11 @@ def parse_args(argv=None):
                    help="after training, report top-1 accuracy "
                         "(next-token accuracy for LMs) over N "
                         "batches through the compiled eval step")
+    p.add_argument("--compilation-cache-dir",
+                   default=os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                          ""),
+                   help="persistent XLA compile cache; Job restarts "
+                        "and resumed sweeps skip recompiles")
     return p.parse_args(argv)
 
 
@@ -353,6 +358,11 @@ def evaluate(trainer, state, loader, args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir",
+                          args.compilation_cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
     # On a multi-host slice the plugin's Allocate envs identify this
     # pod's place; boot jax.distributed before the first backend
     # query so jax.devices() spans every host.
