@@ -55,7 +55,8 @@ from container_engine_accelerators_tpu.serving import (
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--model", choices=["resnet", "transformer"],
+    p.add_argument("--model",
+                   choices=["resnet", "transformer", "moe"],
                    default="resnet")
     p.add_argument("--model-name", default="")
     p.add_argument("--depth", type=int, default=50)
@@ -65,6 +66,8 @@ def main(argv=None):
     p.add_argument("--num-layers", type=int, default=8)
     p.add_argument("--num-heads", type=int, default=8)
     p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--num-experts", type=int, default=8,
+                   help="MoE expert count (--model moe)")
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--max-batch", type=int, default=8)
@@ -86,13 +89,21 @@ def main(argv=None):
                           1.0)
     name = args.model_name or args.model
 
-    if args.model == "transformer":
-        model = TransformerLM(
+    if args.model in ("transformer", "moe"):
+        lm_kwargs = dict(
             vocab_size=args.vocab_size, embed_dim=args.embed_dim,
             num_layers=args.num_layers, num_heads=args.num_heads,
             max_seq_len=args.max_seq_len,
             kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
                             else args.kv_cache_dtype))
+        if args.model == "moe":
+            from container_engine_accelerators_tpu.models import (
+                MoETransformerLM,
+            )
+            model = MoETransformerLM(num_experts=args.num_experts,
+                                     **lm_kwargs)
+        else:
+            model = TransformerLM(**lm_kwargs)
         params = model.init(
             jax.random.PRNGKey(0),
             jnp.zeros((1, 8), jnp.int32))["params"]
