@@ -74,7 +74,7 @@ class _Failures:
             self.items.append(what)
 
 
-def _allocate_hammer(plugin_dir, stop, failures, stats, seed):
+def _allocate_hammer(plugin_dir, stop, failures, stats, seed, id_pool):
     rng = random.Random(seed)
     while not stop.is_set():
         sock = _current_socket(plugin_dir)
@@ -87,8 +87,9 @@ def _allocate_hammer(plugin_dir, stop, failures, stats, seed):
                 for _ in range(20):
                     if stop.is_set():
                         break
-                    ids = [f"accel{i}" for i in
-                           rng.sample(range(6), rng.randint(1, 3))]
+                    ids = rng.sample(id_pool,
+                                     rng.randint(1, min(3,
+                                                        len(id_pool))))
                     try:
                         resp = stub.Allocate(
                             api.v1beta1_pb2.AllocateRequest(
@@ -141,12 +142,12 @@ def _watch_loop(plugin_dir, stop, failures, stats):
             time.sleep(0.01)
 
 
-def _health_flapper(manager, stop, stats):
+def _health_flapper(manager, stop, stats, flap_devices):
     flip = False
     while not stop.is_set():
         flip = not flip
         health = api.UNHEALTHY if flip else api.HEALTHY
-        for dev in ("accel1", "accel2"):
+        for dev in flap_devices:
             manager.set_device_health(dev, health)
             stats["flaps"] += 1
         time.sleep(0.005)
@@ -170,14 +171,34 @@ def _hot_plugger(node, stop, stats):
 
 
 @pytest.mark.slow
-def test_allocate_listandwatch_under_churn(fake_node, fast_intervals):
+@pytest.mark.parametrize("partition", ["", "2x2"])
+def test_allocate_listandwatch_under_churn(fake_node, fast_intervals,
+                                           partition):
+    """Whole-chip mode and subslice mode (SliceManager re-solves the
+    tiling on hot-plug churn — including non-uniform transients the
+    manager must survive — and health flaps route through it)."""
+    from container_engine_accelerators_tpu.plugin.config import (
+        TpuConfig,
+    )
+
     for i in range(4):
         fake_node.add_chip(i)
     fake_node.set_topology("2x2")
     manager = TpuManager(dev_dir=fake_node.dev_dir,
                          state_dir=fake_node.state_dir,
-                         backend=PyChipBackend())
+                         backend=PyChipBackend(),
+                         tpu_config=TpuConfig(
+                             tpu_partition_size=partition))
     manager.start()
+    if partition:
+        # 2x2 tiling of the 2x2 node -> one subslice device.
+        id_pool = ["tpu-2x2-0", "tpu-2x2-1", "accel0"]
+        flap_devices = ("tpu-2x2-0",)
+        settle_device = "tpu-2x2-0"
+    else:
+        id_pool = [f"accel{i}" for i in range(6)]
+        flap_devices = ("accel1", "accel2")
+        settle_device = "accel1"
 
     plugin_dir = short_tmpdir()
     stop = threading.Event()
@@ -187,7 +208,8 @@ def test_allocate_listandwatch_under_churn(fake_node, fast_intervals):
     with ServingManager(manager, plugin_dir):
         threads = [
             threading.Thread(target=_allocate_hammer,
-                             args=(plugin_dir, stop, failures, stats, s),
+                             args=(plugin_dir, stop, failures, stats,
+                                   s, id_pool),
                              daemon=True)
             for s in (1, 2, 3)
         ] + [
@@ -195,7 +217,8 @@ def test_allocate_listandwatch_under_churn(fake_node, fast_intervals):
                              args=(plugin_dir, stop, failures, stats),
                              daemon=True),
             threading.Thread(target=_health_flapper,
-                             args=(manager, stop, stats), daemon=True),
+                             args=(manager, stop, stats, flap_devices),
+                             daemon=True),
             threading.Thread(target=_hot_plugger,
                              args=(fake_node, stop, stats), daemon=True),
         ]
@@ -216,10 +239,10 @@ def test_allocate_listandwatch_under_churn(fake_node, fast_intervals):
             assert not t.is_alive(), f"thread {t} wedged"
 
         # The node must end functional: settle health and allocate.
-        for dev in ("accel1", "accel2"):
+        for dev in flap_devices:
             manager.set_device_health(dev, api.HEALTHY)
-        specs = manager.device_specs("accel1")
-        assert len(specs) == 1
+        specs = manager.device_specs(settle_device)
+        assert len(specs) == (4 if partition else 1)
 
     assert not failures.items, (failures.items[:10], stats)
     # The churn must actually have exercised every axis.
