@@ -56,6 +56,34 @@ def test_ring_matches_dense(mesh, qkv, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_ring_kv_subblocking_exact(monkeypatch, causal):
+    """Force multiple within-hop K sub-blocks (the long-context
+    memory path) and require exactness — fwd and bwd — vs dense."""
+    from container_engine_accelerators_tpu.parallel import context as ctx
+
+    monkeypatch.setattr(ctx, "_KV_BLOCK", 8)  # S/P = 32 -> 4 blocks
+    mesh = build_context_mesh(context=2)
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q, k, v = (jax.random.normal(key, (1, 64, 2, 8), jnp.float32)
+               for key in ks)
+    want = dot_product_attention(q, k, v, causal=causal)
+    got = ring_attention(mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(fn, *args):
+        return jnp.sum(fn(*args) ** 2)
+
+    g_want = jax.grad(lambda x: loss(
+        dot_product_attention, x, k, v, causal))(q)
+    g_got = jax.grad(lambda x: loss(
+        lambda a, b, c, cz: ring_attention(mesh, a, b, c, causal=cz),
+        x, k, v, causal))(q)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_matches_dense(qkv, causal):
     mesh = build_context_mesh(context=4)  # H=4 divides
     q, k, v = qkv
