@@ -190,15 +190,29 @@ def ring_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
     return _ring(q, k, v)
 
 
+def _blockwise_attention(q, k, v, causal):
+    """Single-device attention through the online-softmax K-block
+    scan — same results as dot_product_attention with peak score
+    memory [B, H, S, _KV_BLOCK] instead of [B, H, S, S]."""
+    b, s, h, d = q.shape
+    m = jnp.full((b, h, s, 1), _NEG, jnp.float32)
+    num = jnp.zeros((b, s, h, d), jnp.float32)
+    den = jnp.zeros((b, h, s, 1), jnp.float32)
+    m, num, den = _block_accumulate(q, k, v, 0, 0, m, num, den, causal)
+    return (num / den.swapaxes(1, 2)).astype(q.dtype)
+
+
 def ulysses_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
                       causal=False, batch_axis=None):
     """Exact attention via all-to-all head re-sharding (Ulysses).
 
     q/k/v: [B, S, H, D], sequence-sharded over ``axis_name``; H must
     be divisible by the axis size. One all_to_all turns the sequence
-    sharding into a head sharding (full S, H/P heads per chip), dense
-    attention runs locally, and a second all_to_all restores the
-    sequence sharding. ``batch_axis`` as in ``ring_attention``.
+    sharding into a head sharding (full S, H/P heads per chip),
+    blockwise attention runs locally (full-sequence dense scores
+    would be the exact memory blowup sequence parallelism exists to
+    avoid), and a second all_to_all restores the sequence sharding.
+    ``batch_axis`` as in ``ring_attention``.
     """
     p_size = mesh.shape[axis_name]
     if q.shape[2] % p_size != 0:
@@ -219,7 +233,7 @@ def ulysses_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
             return jax.lax.all_to_all(
                 x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-        out = dot_product_attention(
+        out = _blockwise_attention(
             seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
             causal=causal)
         return heads_to_seq(out)

@@ -82,6 +82,11 @@ def test_ring_kv_subblocking_exact(monkeypatch, causal):
     np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
                                rtol=2e-4, atol=2e-4)
 
+    # Ulysses' local attention runs the same sub-blocked schedule.
+    got_u = ulysses_attention(mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got_u), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_matches_dense(qkv, causal):
