@@ -340,8 +340,12 @@ class GenerationServer(_BaseServer):
         self._stopping = False
         if warm:
             for b in self._buckets:
+                # Both default programs per bucket: greedy and plain
+                # sampling (pad_temp selects the mode).
                 self._run([(np.zeros((b,), np.int32), 0.0, b, 1.0)],
                           0.0)
+                self._run([(np.zeros((b,), np.int32), 1.0, b, 1.0)],
+                          1.0)
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"

@@ -211,6 +211,32 @@ def test_generate_cross_request_batching():
         srv.stop()
 
 
+def test_generate_warm_compiles_both_modes():
+    """warm=True runs one greedy and one sampling decode per bucket
+    before traffic, as the class docstring promises."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=2,
+                           buckets=[8, 16], warm=True)
+    assert srv._decode_calls == 4  # 2 buckets x (greedy + sampling)
+    srv.start()
+    try:
+        out = post(srv, "/v1/models/lm:generate",
+                   {"prompts": [[1, 2, 3]], "max_new_tokens": 2})
+        assert len(out["sequences"][0]) == 5
+    finally:
+        srv.stop()
+
+
 def test_generate_top_k_top_p(lm_server):
     out = post(lm_server, "/v1/models/lm:generate",
                {"prompts": [[5, 6, 7]], "max_new_tokens": 4,
