@@ -1,0 +1,52 @@
+#!/bin/bash
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+# The full on-TPU measurement suite, for when the (flaky) tunneled
+# chip is up: headline bench at two batch sizes, the attention
+# schedule/tile sweep, and decode throughput (bf16 + int8 cache).
+# Each section is individually time-capped; artifacts land in the
+# repo root / stdout.
+#
+# Usage: tools/run_tpu_suite.sh [outdir]
+
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-.}"
+
+echo "[suite] headline bench (default batch)" >&2
+BENCH_ATTEMPTS=2 timeout 5400 python bench.py \
+  > "${OUT}/TPU_BENCH_DEFAULT.json" 2>> "${OUT}/tpu_suite.log"
+cat "${OUT}/TPU_BENCH_DEFAULT.json" >&2
+
+echo "[suite] headline bench (batch 256/chip)" >&2
+BENCH_ATTEMPTS=1 BENCH_BATCH_PER_CHIP=256 timeout 3600 python bench.py \
+  > "${OUT}/TPU_BENCH_B256.json" 2>> "${OUT}/tpu_suite.log"
+cat "${OUT}/TPU_BENCH_B256.json" >&2
+
+echo "[suite] attention sweep" >&2
+timeout 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json" \
+  2>> "${OUT}/tpu_suite.log"
+
+echo "[suite] decode bench (bf16 + int8 cache)" >&2
+{
+  timeout 1800 python tools/bench_decode.py --batch 1 8 \
+    --prompt-len 128 --new-tokens 128
+  timeout 1800 python tools/bench_decode.py --batch 1 8 \
+    --prompt-len 128 --new-tokens 128 --kv-cache-dtype int8
+} > "${OUT}/DECODE_BENCH.json" 2>> "${OUT}/tpu_suite.log"
+cat "${OUT}/DECODE_BENCH.json" >&2
+
+echo "[suite] done" >&2
