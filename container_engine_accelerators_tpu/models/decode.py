@@ -88,14 +88,16 @@ def _mask_top_p(logits, top_p):
 @functools.partial(jax.jit,
                    static_argnames=("model", "max_new_tokens",
                                     "sample", "fast_prefill",
-                                    "top_k", "use_top_p"))
+                                    "top_k", "use_top_p", "use_eos"))
 def _decode_impl(model, params, prompt, max_new_tokens, temperature,
-                 rng, prompt_len, top_p, *, sample,
-                 fast_prefill=False, top_k=0, use_top_p=False):
+                 rng, prompt_len, top_p, eos_id, *, sample,
+                 fast_prefill=False, top_k=0, use_top_p=False,
+                 use_eos=False):
     b, p_pad = prompt.shape
     total = p_pad + max_new_tokens
     decode_model, cache = init_cache(model, b, total)
     padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+    eos_row = jnp.reshape(eos_id, (-1,)) if use_eos else None
 
     def pick(logits, rng):
         if sample:
@@ -116,7 +118,7 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
         return chosen.astype(prompt.dtype), rng
 
     def step(carry, t):
-        cache, tok, rng = carry
+        cache, tok, rng, done = carry
         outputs, updated = decode_model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             train=False, mutable=["cache"])
@@ -129,9 +131,16 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
         # rows of different true lengths.
         forced = jax.lax.dynamic_index_in_dim(
             padded, jnp.minimum(t + 1, total - 1), 1, keepdims=False)
-        nxt = jnp.where(t + 1 < jnp.reshape(prompt_len, (-1,)),
-                        forced, sampled)
-        return (updated["cache"], nxt, rng), nxt
+        in_prompt = t + 1 < jnp.reshape(prompt_len, (-1,))
+        nxt = jnp.where(in_prompt, forced, sampled)
+        if use_eos:
+            # A row whose GENERATED text reached its EOS keeps
+            # emitting it (rows stay static-shaped; the caller trims
+            # at the first EOS). Prompt-resident EOS ids don't
+            # trigger.
+            nxt = jnp.where(done, eos_row.astype(prompt.dtype), nxt)
+            done = done | (~in_prompt & (nxt == eos_row))
+        return (updated["cache"], nxt, rng, done), nxt
 
     if fast_prefill and max_new_tokens > 0:
         # The whole prompt runs as ONE forward pass that fills the
@@ -146,21 +155,24 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             {"params": params, "cache": cache}, prompt,
             train=False, mutable=["cache"])
         first, rng = pick(_logits_of(outputs)[:, -1], rng)
-        (_, _, _), produced = jax.lax.scan(
-            step, (updated["cache"], first, rng),
+        done0 = ((first == eos_row) if use_eos
+                 else jnp.zeros((b,), bool))
+        (_, _, _, _), produced = jax.lax.scan(
+            step, (updated["cache"], first, rng, done0),
             jnp.arange(p_pad, total - 1))
         return jnp.concatenate(
             [prompt, first[:, None], produced.T], axis=1)
 
-    (_, _, _), produced = jax.lax.scan(
-        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1))
+    (_, _, _, _), produced = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng, jnp.zeros((b,), bool)),
+        jnp.arange(total - 1))
     # produced[t] is the token at position t+1.
     return jnp.concatenate([prompt[:, :1], produced.T], axis=1)
 
 
 def decode(model, params, prompt, max_new_tokens, *,
            temperature=0.0, rng=None, prompt_len=None,
-           fast_prefill=None, top_k=0, top_p=1.0):
+           fast_prefill=None, top_k=0, top_p=1.0, eos_id=None):
     """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
 
     temperature == 0 is greedy argmax; > 0 samples from
@@ -177,6 +189,11 @@ def decode(model, params, prompt, max_new_tokens, *,
     or per-row [B] vector, 1.0 = off) keeps the smallest nucleus of
     probability mass >= top_p. Both apply after temperature, and
     compose (top_k first).
+
+    ``eos_id`` (traced scalar or per-row [B] vector; None = off):
+    once a row's GENERATED text emits its EOS, the row keeps
+    emitting EOS — shapes stay static; trim at the first EOS.
+    Prompt-resident EOS ids don't trigger.
 
     ``prompt_len`` (traced scalar or [B] per-row vector, default P)
     is where generation takes over from prefill: pass true prompt
@@ -223,12 +240,16 @@ def decode(model, params, prompt, max_new_tokens, *,
     # top_p == 1.0 everywhere is the identity; skip the mask so the
     # common no-nucleus case costs nothing and compiles no variant.
     use_top_p = bool((p_host < 1.0).any())
+    use_eos = eos_id is not None
     return _decode_impl(model, params, prompt, max_new_tokens,
                         jnp.asarray(temperature, jnp.float32), rng,
                         jnp.asarray(prompt_len, jnp.int32),
                         jnp.asarray(top_p, jnp.float32),
+                        jnp.asarray(eos_id if use_eos else -1,
+                                    jnp.int32),
                         sample=sample, fast_prefill=fast_prefill,
-                        top_k=top_k, use_top_p=use_top_p)
+                        top_k=top_k, use_top_p=use_top_p,
+                        use_eos=use_eos)
 
 
 def greedy_decode(model, params, prompt, max_new_tokens):

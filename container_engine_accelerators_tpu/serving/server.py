@@ -342,10 +342,10 @@ class GenerationServer(_BaseServer):
             for b in self._buckets:
                 # Both default programs per bucket: greedy and plain
                 # sampling (pad_temp selects the mode).
-                self._run([(np.zeros((b,), np.int32), 0.0, b, 1.0)],
-                          0.0)
-                self._run([(np.zeros((b,), np.int32), 1.0, b, 1.0)],
-                          1.0)
+                self._run([(np.zeros((b,), np.int32), 0.0, b, 1.0,
+                            -1)], 0.0)
+                self._run([(np.zeros((b,), np.int32), 1.0, b, 1.0,
+                            -1)], 1.0)
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"
@@ -360,11 +360,14 @@ class GenerationServer(_BaseServer):
         temps = np.full((self._max_batch,), pad_temp, np.float32)
         plens = np.full((self._max_batch,), bucket, np.int32)
         top_ps = np.ones((self._max_batch,), np.float32)
-        for row, (tokens, temp, p_len, top_p) in enumerate(instances):
+        eos_ids = np.full((self._max_batch,), -1, np.int32)
+        for row, (tokens, temp, p_len, top_p,
+                  eos_id) in enumerate(instances):
             padded[row] = tokens
             temps[row] = temp
             plens[row] = p_len
             top_ps[row] = top_p
+            eos_ids[row] = eos_id
         with self._stats_lock:
             self._seed += 1
             seed = self._seed
@@ -374,7 +377,9 @@ class GenerationServer(_BaseServer):
         # (warm=True precompiles exactly these programs; the
         # auto-selected one-shot-prefill variant would flip in and
         # out with batch composition and stall requests on compiles).
-        # A per-row top_p rides as a vector in the same program; any
+        # Per-row top_p and eos_id ride as vectors in the same
+        # program (eos is ALWAYS on with -1 = never-matches padding,
+        # so batch composition can't flip program variants); any
         # top_p < 1.0 in the batch selects the nucleus variant (one
         # extra program per bucket, compiled on first use).
         seq = self._decode(self._model, self._params,
@@ -382,7 +387,8 @@ class GenerationServer(_BaseServer):
                            temperature=temps if pad_temp else 0.0,
                            rng=jax.random.PRNGKey(seed),
                            prompt_len=plens, fast_prefill=False,
-                           top_k=top_k, top_p=top_ps)
+                           top_k=top_k, top_p=top_ps,
+                           eos_id=eos_ids)
         return np.asarray(seq)[:n]
 
     def _batcher_for(self, bucket, sampling, top_k):
@@ -428,8 +434,12 @@ class GenerationServer(_BaseServer):
             temperature = float(payload.get("temperature", 0.0))
             top_k = int(payload.get("top_k", 0))
             top_p = float(payload.get("top_p", 1.0))
+            eos_id = int(payload.get("eos_id", -1))
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"error": f"bad request: {e}"}
+        if not -1 <= eos_id < self._model.vocab_size:
+            return 400, {"error": f"eos_id must be -1 (off) or in "
+                                  f"0..{self._model.vocab_size - 1}"}
         if not 0 <= top_k <= self._model.vocab_size:
             return 400, {"error": f"top_k must be in "
                                   f"0..{self._model.vocab_size}"}
@@ -473,7 +483,7 @@ class GenerationServer(_BaseServer):
         if batcher is None:
             return 503, {"error": "server is shutting down"}
         pending = [batcher.submit_async((row, temperature, p_len,
-                                         top_p))
+                                         top_p, eos_id))
                    for row in padded]
         rows = []
         for done in pending:

@@ -255,6 +255,8 @@ def test_generate_validation(lm_server):
             {"prompts": [[1]], "top_k": -1, "temperature": 1.0},
             {"prompts": [[1]], "top_p": 0.0, "temperature": 1.0},
             {"prompts": [[1]], "top_k": 5},  # filters need temp > 0
+            {"prompts": [[1]], "eos_id": 64},  # >= vocab
+            {"prompts": [[1]], "eos_id": -2},
     ):
         with pytest.raises(urllib.error.HTTPError) as err:
             post(lm_server, "/v1/models/lm:generate", payload)
