@@ -53,6 +53,44 @@ from container_engine_accelerators_tpu.serving import (
 )
 
 
+def load_checkpoint_variables(model_dir, init_variables):
+    """Restore {"params"[, "batch_stats"]} from the newest finished
+    checkpoint_N under model_dir (train.py's layout); falls back to
+    the given init when the directory has no checkpoints."""
+    import orbax.checkpoint as ocp
+
+    entries = []
+    try:
+        names = os.listdir(model_dir)
+    except OSError:
+        names = []
+    for name in names:
+        if not name.startswith("checkpoint_"):
+            continue
+        try:
+            entries.append((int(name.rsplit("_", 1)[1]), name))
+        except ValueError:
+            continue
+    if not entries:
+        print(f"no checkpoints under {model_dir!r}; serving "
+              f"initialized weights", file=sys.stderr)
+        return init_variables
+    path = os.path.abspath(
+        os.path.join(model_dir, sorted(entries)[-1][1]))
+    # Serving needs only the model variables; leave opt_state on disk.
+    template = {"params": init_variables["params"]}
+    if "batch_stats" in init_variables:
+        template["batch_stats"] = init_variables["batch_stats"]
+    restored = ocp.PyTreeCheckpointer().restore(
+        path, args=ocp.args.PyTreeRestore(item=template,
+                                          partial_restore=True))
+    print(f"serving weights from {path}", file=sys.stderr)
+    out = {"params": restored["params"]}
+    if "batch_stats" in init_variables:
+        out["batch_stats"] = restored["batch_stats"]
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model",
@@ -75,6 +113,13 @@ def main(argv=None):
                    default="bfloat16",
                    help="int8 halves KV-cache residency per replica "
                         "(~2x servable context/batch)")
+    p.add_argument("--model-dir",
+                   default=os.environ.get("MODEL_DIR", ""),
+                   help="restore weights from the newest "
+                        "checkpoint_N under this directory (as "
+                        "written by demo/tpu-training/train.py); "
+                        "empty serves randomly-initialized weights "
+                        "(load-testing only)")
     p.add_argument("--compilation-cache-dir",
                    default=os.environ.get("JAX_COMPILATION_CACHE_DIR",
                                           ""),
@@ -104,11 +149,14 @@ def main(argv=None):
                                      **lm_kwargs)
         else:
             model = TransformerLM(**lm_kwargs)
-        params = model.init(
+        variables = {"params": model.init(
             jax.random.PRNGKey(0),
-            jnp.zeros((1, 8), jnp.int32))["params"]
+            jnp.zeros((1, 8), jnp.int32))["params"]}
+        if args.model_dir:
+            variables = load_checkpoint_variables(args.model_dir,
+                                                  variables)
         server = GenerationServer(
-            name, model, params, port=args.port,
+            name, model, variables["params"], port=args.port,
             max_new_tokens=args.max_new_tokens,
             max_batch=args.max_batch)
     else:
@@ -117,6 +165,9 @@ def main(argv=None):
             jax.random.PRNGKey(0),
             jnp.zeros((1, args.image_size, args.image_size, 3)),
             train=False)
+        if args.model_dir:
+            variables = dict(load_checkpoint_variables(
+                args.model_dir, dict(variables)))
         server = InferenceServer(
             name, make_apply_fn(model), variables,
             (args.image_size, args.image_size, 3),
