@@ -1,0 +1,113 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Property-based checks over the scheduler-critical allocation path.
+
+The example-driven tests pin known-good cases; these sweep randomized
+(topology, availability, must-include, size) points and assert the
+invariants that kubelet correctness depends on:
+
+  * preferred_allocation returns exactly `size` devices drawn from
+    `available`, containing `must_include`;
+  * when the whole node is free and the size factors into the
+    topology, the choice is a contiguous box (bounding-box volume ==
+    size) — the minimal-hop guarantee;
+  * topology_envs reports TPU_CHIPS_PER_PROCESS_BOUNDS exactly when
+    the chip set fills its bounding box, and TPU_VISIBLE_DEVICES
+    always matches the chips handed out.
+"""
+
+import numpy as np
+
+from container_engine_accelerators_tpu.chip import PyChipBackend
+from container_engine_accelerators_tpu.plugin.envs import (
+    chips_form_box,
+    topology_envs,
+)
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+
+TOPOLOGIES = ["2x2", "2x4", "4x4", "2x2x2", "4x4x2"]
+
+
+def _node(fake_node, topo):
+    dims = [int(d) for d in topo.split("x")]
+    while len(dims) < 3:
+        dims.append(1)
+    n = dims[0] * dims[1] * dims[2]
+    for i in range(n):
+        fake_node.add_chip(i)
+    fake_node.set_topology(topo)
+    mgr = TpuManager(dev_dir=fake_node.dev_dir,
+                     state_dir=fake_node.state_dir,
+                     backend=PyChipBackend())
+    mgr.start()
+    return mgr, n
+
+
+def _bounding_volume(coords):
+    spans = [max(c[i] for c in coords) - min(c[i] for c in coords) + 1
+             for i in range(3)]
+    return spans[0] * spans[1] * spans[2]
+
+
+def test_preferred_allocation_invariants(fake_node):
+    rng = np.random.default_rng(0)
+    topo = "4x4"
+    mgr, n = _node(fake_node, topo)
+    all_devs = [f"accel{i}" for i in range(n)]
+    for _ in range(150):
+        n_avail = int(rng.integers(1, n + 1))
+        available = sorted(
+            rng.choice(all_devs, size=n_avail, replace=False).tolist())
+        size = int(rng.integers(1, n_avail + 1))
+        n_must = int(rng.integers(0, size + 1))
+        must = sorted(
+            rng.choice(available, size=n_must, replace=False).tolist())
+        chosen = mgr.preferred_allocation(available, must, size)
+        assert len(chosen) == size, (available, must, size, chosen)
+        assert len(set(chosen)) == size
+        assert set(chosen) <= set(available)
+        assert set(must) <= set(chosen)
+
+
+def test_preferred_allocation_full_node_is_contiguous(fake_node):
+    """With the whole node free, any size that factors into the
+    topology must come back as a contiguous box."""
+    mgr, n = _node(fake_node, "4x4")
+    all_devs = [f"accel{i}" for i in range(n)]
+    backend = mgr._backend
+    for size in (1, 2, 4, 8, 16):
+        chosen = mgr.preferred_allocation(all_devs, [], size)
+        coords = [backend.chip_coords(int(d[5:])) for d in chosen]
+        assert _bounding_volume(coords) == size, (size, chosen)
+
+
+def test_topology_envs_invariants(fake_node):
+    rng = np.random.default_rng(1)
+    mgr, n = _node(fake_node, "2x2x2")
+    backend = mgr._backend
+    for _ in range(100):
+        k = int(rng.integers(1, n + 1))
+        chips = sorted(
+            rng.choice(np.arange(n), size=k, replace=False).tolist())
+        coords = [backend.chip_coords(c) for c in chips]
+        envs = topology_envs(chips, coords)
+        assert envs["TPU_VISIBLE_DEVICES"] == ",".join(
+            str(c) for c in chips)
+        has_bounds = "TPU_CHIPS_PER_PROCESS_BOUNDS" in envs
+        assert has_bounds == chips_form_box(coords)
+        if has_bounds:
+            bx, by, bz = (int(x) for x in
+                          envs["TPU_CHIPS_PER_PROCESS_BOUNDS"].split(","))
+            assert bx * by * bz == len(chips)
