@@ -93,6 +93,41 @@ def test_preferred_allocation_full_node_is_contiguous(fake_node):
         assert _bounding_volume(coords) == size, (size, chosen)
 
 
+def test_subslice_solver_invariants(fake_node):
+    """Every uniform tiling partitions the chips exactly (each chip
+    in one contiguous subslice); every non-tiling shape raises."""
+    from container_engine_accelerators_tpu.chip.backend import (
+        NonUniformPartitionError,
+    )
+
+    mgr, n = _node(fake_node, "4x4x2")
+    backend = mgr._backend
+    shapes = ["1x1", "2x1", "1x2", "2x2", "4x1", "4x4", "2x2x2",
+              "4x4x2", "1x1x2", "3x1", "2x3", "4x3x2", "5x1"]
+    for shape in shapes:
+        dims = [int(d) for d in shape.split("x")]
+        while len(dims) < 3:
+            dims.append(1)
+        tiles = all(t % s == 0 for t, s in zip((4, 4, 2), dims))
+        if not tiles:
+            try:
+                backend.subslice_count(shape)
+            except NonUniformPartitionError:
+                continue
+            raise AssertionError(f"{shape} should not tile 4x4x2")
+        count = backend.subslice_count(shape)
+        vol = dims[0] * dims[1] * dims[2]
+        assert count == n // vol, (shape, count)
+        seen = []
+        for i in range(count):
+            chips = backend.subslice_chips(shape, i)
+            assert len(chips) == vol
+            coords = [backend.chip_coords(c) for c in chips]
+            assert _bounding_volume(coords) == vol, (shape, i, chips)
+            seen.extend(chips)
+        assert sorted(seen) == list(range(n)), shape  # exact partition
+
+
 def test_topology_envs_invariants(fake_node):
     rng = np.random.default_rng(1)
     mgr, n = _node(fake_node, "2x2x2")
