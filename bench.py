@@ -62,9 +62,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REFERENCE_IMG_PER_SEC_PER_CHIP = 2500.0
 TARGET_FRACTION = 0.8
 
+# Batch 128/chip measured faster than 256/chip on v5e (2,696 vs
+# 2,564 img/s); 100 timed steps (~5s) amortizes the ~50ms tunnel
+# round trip of the final wall_sync to <1% of the measurement.
 BATCH_PER_CHIP = int(os.environ.get("BENCH_BATCH_PER_CHIP", "128"))
-WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", "5"))
-TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", "20"))
+WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", "10"))
+TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", "100"))
 # Smoke-test knobs only — the headline number is 224px ResNet-50.
 IMAGE_SIZE = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
 DEPTH = int(os.environ.get("BENCH_DEPTH", "50"))
@@ -118,10 +121,12 @@ def probe():
         jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
 
+    from container_engine_accelerators_tpu.utils.sync import wall_sync
+
     devices = jax.devices()
     x = jnp.ones((256, 256), jnp.bfloat16)
-    jax.block_until_ready(x @ x)
-    _log(f"probe ok: {[str(d) for d in devices]}")
+    val = wall_sync(x @ x)
+    _log(f"probe ok: {[str(d) for d in devices]} (got {val})")
     return 0
 
 
@@ -282,6 +287,7 @@ def child():
         SyntheticLoader,
     )
     from container_engine_accelerators_tpu.parallel.mesh import default_spec
+    from container_engine_accelerators_tpu.utils.sync import wall_sync
 
     devices = _devices_with_retry(jax)
     n = len(devices)
@@ -292,7 +298,7 @@ def child():
     # "backend cannot run anything" from "ResNet compile is slow".
     phases.enter("probe", 300)
     x = jnp.ones((1024, 1024), jnp.bfloat16)
-    jax.block_until_ready(x @ x)
+    wall_sync(x @ x)
     phases.done()
 
     # The build runs two compiled programs (model init, state init);
@@ -310,11 +316,11 @@ def child():
     variables = jax.jit(
         lambda key: model.init(key, jnp.zeros((1,) + shape), train=False)
     )(jax.random.PRNGKey(0))
-    jax.block_until_ready(variables)
+    wall_sync(variables)
     _log(f"model.init {time.monotonic() - t0:.1f}s")
     t0 = time.monotonic()
     state = trainer.init_state(variables)
-    jax.block_until_ready(state)
+    wall_sync(state)
     _log(f"init_state {time.monotonic() - t0:.1f}s")
     loader = SyntheticLoader(global_batch, shape, 1000,
                              sharding=batch_sharding(mesh), pool=2)
@@ -324,27 +330,35 @@ def child():
     batch = next(loader)
     t0 = time.monotonic()
     state, loss = trainer.train_step(state, batch)
-    jax.block_until_ready(loss)
-    _log(f"first step (compile) {time.monotonic() - t0:.1f}s")
+    loss_val = wall_sync(loss)
+    _log(f"first step (compile) {time.monotonic() - t0:.1f}s "
+         f"loss={loss_val}")
     phases.done()
 
+    # All waits below are wall_sync (a forced device->host scalar
+    # transfer), NOT block_until_ready: the tunneled axon backend acks
+    # dispatch as "ready", so block_until_ready-based timing reported
+    # 700x the chip's peak FLOPs. A value transfer cannot lie.
     phases.enter("measure", 600)
     for i, (_, batch) in enumerate(zip(range(WARMUP_STEPS), loader)):
         t0 = time.monotonic()
         state, loss = trainer.train_step(state, batch)
-        jax.block_until_ready(loss)
+        wall_sync(loss)
         _log(f"warmup step {i}: {time.monotonic() - t0:.3f}s")
 
-    # Timed loop: dispatch every step asynchronously and block once at
-    # the end. Blocking per step would charge one host<->device round
-    # trip to every step — dominant over a tunneled backend — while
-    # dispatch-ahead matches how the real training loop pipelines.
+    # Timed loop: dispatch every step asynchronously and sync once at
+    # the end. Syncing per step would charge one host<->device round
+    # trip (~50ms over the tunnel) to every step, while dispatch-ahead
+    # matches how the real training loop pipelines. The final
+    # wall_sync(loss) bounds the whole chain: step i+1 consumes step
+    # i's state, so the last loss transfers only after every step ran.
     t_all = time.perf_counter()
     for i, (_, batch) in enumerate(zip(range(TIMED_STEPS), loader)):
         state, loss = trainer.train_step(state, batch)
         _log(f"step {i} dispatched at +{time.perf_counter() - t_all:.3f}s")
-    jax.block_until_ready((state, loss))
+    final_loss = wall_sync(loss)
     elapsed = time.perf_counter() - t_all
+    _log(f"final loss {final_loss}")
     _log(f"{TIMED_STEPS} steps in {elapsed:.3f}s "
          f"({global_batch * TIMED_STEPS / elapsed:.0f} img/s global)")
     phases.done()

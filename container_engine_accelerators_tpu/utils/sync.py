@@ -1,0 +1,55 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Device-completion barriers that survive fully-async backends.
+
+``jax.block_until_ready`` is the documented way to wait for device
+work, but on remote/tunneled backends (the ``axon`` plugin that fronts
+the TPU chip here) the buffer is marked "ready" when the *dispatch* is
+acknowledged, not when the computation finishes — a timing loop built
+on it measures Python dispatch overhead and reports physically
+impossible throughput (we observed 700x the chip's peak FLOP rate).
+
+The only barrier such a backend cannot fake is a device-to-host value
+transfer: the bytes of the result cannot exist on the host before the
+computation that produces them has run.  ``wall_sync`` therefore pulls
+one scalar from (a leaf of) the tree to the host and returns it.
+
+Cost: one host<->device round trip (~50 ms over the tunnel), so call
+it once around a batch of dispatched steps — never per step — and
+amortize.  On well-behaved local backends it degrades to an ordinary
+tiny transfer after an implicit block_until_ready.
+"""
+
+import jax
+import numpy as np
+
+
+def wall_sync(tree):
+    """Barrier until the computation producing ``tree`` has finished.
+
+    Transfers one scalar from the first non-empty leaf to the host,
+    which (unlike ``block_until_ready``) cannot complete before the
+    device program producing it has run.  One leaf is sufficient: all
+    outputs of a jitted executable materialize when that executable
+    finishes, and data dependence chains earlier dispatched steps
+    behind it.  Returns the fetched scalar (handy for NaN spotting),
+    or None if the tree holds no non-empty arrays.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "dtype") and leaf.size:
+            # ravel()[:1] stages a tiny gather on device; np.asarray
+            # forces the device->host copy of its result.
+            return np.asarray(jax.numpy.ravel(leaf)[:1])[0]
+    return None

@@ -88,6 +88,7 @@ from container_engine_accelerators_tpu.parallel.data import (
     SyntheticTokenLoader,
 )
 from container_engine_accelerators_tpu.parallel.mesh import default_spec
+from container_engine_accelerators_tpu.utils.sync import wall_sync
 
 LM_MODELS = ("transformer", "moe")
 
@@ -486,17 +487,24 @@ def main(argv=None):
     for step, batch in zip(range(args.steps), loader):
         state, loss = trainer.train_step(state, batch)
         if t_start is None and step == warmup - 1:
-            jax.block_until_ready(loss)
+            # wall_sync (forced transfer), not block_until_ready: the
+            # tunneled backend acks dispatch as "ready", which would
+            # start the timer with warmup work still in flight.
+            wall_sync(loss)
             t_start = start_timed_region()
         if step % 20 == 0 or step == args.steps - 1:
-            losses.append(float(loss))
-            print(f"step {step} loss {float(loss):.4f}", file=sys.stderr)
+            # One transfer, reused: each float(loss) is a full
+            # device->host round trip on the tunneled backend.
+            loss_val = float(loss)
+            losses.append(loss_val)
+            print(f"step {step} loss {loss_val:.4f}", file=sys.stderr)
         if (args.model_dir and args.checkpoint_every
                 and (step + 1) % args.checkpoint_every == 0):
             save_checkpoint(args.model_dir, state)
             if args.keep_checkpoints:
                 prune_checkpoints(args.model_dir, args.keep_checkpoints)
-    jax.block_until_ready(state.params)
+    wall_sync(state.params)
+    t_end = time.perf_counter()
     # A prefetching loader would otherwise keep staged batches pinned
     # in HBM through checkpointing below.
     if hasattr(loader, "close"):
@@ -509,7 +517,7 @@ def main(argv=None):
     if t_start is None or timed_steps == 0:
         images_per_sec = 0.0
     else:
-        elapsed = time.perf_counter() - t_start
+        elapsed = t_end - t_start
         images_per_sec = (args.batch_size * timed_steps / elapsed
                           if elapsed > 0 else 0.0)
     result = {

@@ -1,0 +1,48 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""wall_sync: the async-backend-proof completion barrier."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from container_engine_accelerators_tpu.utils.sync import wall_sync
+
+
+def test_returns_first_scalar():
+    x = jnp.arange(6.0).reshape(2, 3) + 1.0
+    assert wall_sync(x) == 1.0
+
+
+def test_tree_returns_first_leaf_scalar():
+    tree = {"a": jnp.full((3,), 7.0), "b": jnp.zeros((2, 2))}
+    first = wall_sync(tree)
+    leaves = jax.tree_util.tree_leaves(tree)
+    assert first == np.ravel(np.asarray(leaves[0]))[0]
+
+
+def test_empty_and_sizeless_trees():
+    assert wall_sync({}) is None
+    assert wall_sync(jnp.zeros((0,))) is None
+    assert wall_sync([jnp.zeros((0,)), jnp.full((1,), 3.0)]) == 3.0
+
+
+def test_forces_computation_of_jitted_output():
+    out = jax.jit(lambda x: x * 2 + 1)(jnp.ones((4, 4)))
+    assert wall_sync(out) == 3.0
+
+
+def test_non_array_leaves_are_skipped():
+    assert wall_sync({"n": 5, "s": "x", "a": jnp.full((2,), 9.0)}) == 9.0

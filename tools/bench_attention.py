@@ -45,14 +45,21 @@ if os.environ.get("JAX_PLATFORMS"):
 
 import jax.numpy as jnp
 
+from container_engine_accelerators_tpu.utils.sync import wall_sync
+
 
 def _time(fn, *args, iters):
+    # wall_sync, not block_until_ready: the tunneled axon backend acks
+    # dispatch as "ready", so only a forced device->host transfer
+    # times real execution. Device programs run in order, so syncing
+    # the last dispatch bounds the whole batch; its ~50ms round trip
+    # is amortized across iters.
     out = fn(*args)
-    jax.block_until_ready(out)  # compile + warm
+    wall_sync(out)  # compile + warm
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    wall_sync(out)
     return (time.perf_counter() - t0) / iters
 
 
