@@ -69,8 +69,12 @@ def worker(url, payloads, n, results, errors):
             with urllib.request.urlopen(req, timeout=60) as resp:
                 resp.read()
             results.append(time.perf_counter() - t0)
-        except Exception:
-            errors.append(1)
+        except Exception as e:
+            # Categorize so a misconfigured run (e.g. wrong
+            # --model-name -> all 404s) is diagnosable from the
+            # summary instead of an opaque error count.
+            errors.append(f"HTTP {e.code}" if hasattr(e, "code")
+                          else type(e).__name__)
 
 
 def main(argv=None):
@@ -116,6 +120,11 @@ def main(argv=None):
         "p50_ms": round(statistics.median(lat) * 1000, 2) if lat else None,
         "p99_ms": round(lat[int(len(lat) * 0.99)] * 1000, 2) if lat else None,
     }
+    if errors:
+        by_kind = {}
+        for kind in errors:
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+        summary["errors_by_kind"] = by_kind
     print(json.dumps(summary))
 
 
