@@ -67,6 +67,54 @@ def _masked_scores(q, k, q_off, k_off, s_orig, causal, scale):
     return jnp.where(mask, s, _NEG)
 
 
+# Shared per-tile math. Exactly one implementation of each numerically
+# delicate step — the resident kernels call these from fori_loop
+# bodies, the streaming kernels from @pl.when(run) blocks, so the two
+# modes cannot drift apart.
+
+
+def _fwd_step(q, k, v, m, num, den, q_off, k_off, s_orig, causal,
+              scale):
+    """One online-softmax accumulation step. All operands f32."""
+    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale)
+    block_max = jnp.max(s, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, block_max)
+    corr = jnp.exp(m - new_m)
+    p = jnp.exp(s - new_m)
+    return (new_m, num * corr + p @ v,
+            den * corr + jnp.sum(p, axis=-1, keepdims=True))
+
+
+def _dq_step(q, k, v, do, lse, delta, q_off, k_off, s_orig, causal,
+             scale):
+    """One dQ accumulation term: ds @ k for one K/V tile."""
+    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return ds @ k
+
+
+def _dkv_step(q, k, v, do, lse, delta, dk, dv, q_off, k_off, s_orig,
+              causal, scale):
+    """Accumulate one Q/dO tile's contribution into (dk, dv)."""
+    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale)
+    p = jnp.exp(s - lse)  # (BQ, BK)
+    dv = dv + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dk = dk + jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return dk, dv
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, s_orig,
                 scale, block):
     q = q_ref[0].astype(jnp.float32)
@@ -78,15 +126,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, s_orig,
         m, num, den = carry
         k = k_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
-        s = _masked_scores(q, k, iq * bq, j * block, s_orig, causal,
-                           scale)
-        block_max = jnp.max(s, axis=-1, keepdims=True)
-        new_m = jnp.maximum(m, block_max)
-        corr = jnp.exp(m - new_m)
-        p = jnp.exp(s - new_m)
-        num = num * corr + p @ v
-        den = den * corr + jnp.sum(p, axis=-1, keepdims=True)
-        return new_m, num, den
+        return _fwd_step(q, k, v, m, num, den, iq * bq, j * block,
+                         s_orig, causal, scale)
 
     d = q.shape[1]
     init = (jnp.full((bq, 1), _NEG, jnp.float32),
@@ -114,14 +155,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
-        s = _masked_scores(q, k, iq * bq, j * block, s_orig, causal,
-                           scale)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        return dq + ds @ k
+        return dq + _dq_step(q, k, v, do, lse, delta, iq * bq,
+                             j * block, s_orig, causal, scale)
 
     upper = jnp.minimum(iq + 1, n_k) if causal else n_k
     dq = jax.lax.fori_loop(0, upper, body,
@@ -143,20 +178,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, pl.ds(i * block, block), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(i * block, block), :]
         delta = delta_ref[0, pl.ds(i * block, block), :]
-        s = _masked_scores(q, k, i * block, jk * bk, s_orig, causal,
-                           scale)
-        p = jnp.exp(s - lse)  # (BQ, BK)
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk, dv
+        return _dkv_step(q, k, v, do, lse, delta, dk, dv, i * block,
+                         jk * bk, s_orig, causal, scale)
 
     # Causal: Q blocks strictly before this K block see none of it.
     lower = jk if causal else 0
@@ -165,6 +188,124 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         (jnp.zeros_like(k, jnp.float32), jnp.zeros_like(v, jnp.float32)))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------
+# Streaming variants: the resident kernels above map the full K/V (or
+# Q/dO) sequence into VMEM per (batch*head) program — fastest while it
+# fits, but with double-buffering that is ~4*Sp*D*itemsize bytes and
+# the v5e compiler rejects it above seq ~8k (bf16, D=128). The
+# streaming kernels put the inner loop on a third grid axis instead:
+# each step sees one (block, D) K/V tile, online-softmax state lives
+# in VMEM scratch that persists across grid steps (TPU grids execute
+# sequentially, innermost axis fastest), and the output tile is
+# emitted on the axis's last step. Causal skipping uses pl.when — the
+# masked tile's DMA still happens, but its compute is skipped.
+# --------------------------------------------------------------------
+
+
+def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_scr, num_scr, den_scr, *, causal, s_orig,
+                       scale, block):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG, m_scr.dtype)
+        num_scr[...] = jnp.zeros(num_scr.shape, num_scr.dtype)
+        den_scr[...] = jnp.zeros(den_scr.shape, den_scr.dtype)
+
+    run = ik * block < s_orig  # fully-padded K tiles contribute nothing
+    if causal:
+        run = jnp.logical_and(run, iq >= ik)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        m, num, den = _fwd_step(
+            q, k, v, m_scr[...], num_scr[...], den_scr[...],
+            iq * block, ik * block, s_orig, causal, scale)
+        m_scr[...] = m
+        num_scr[...] = num
+        den_scr[...] = den
+
+    @pl.when(ik == n_k - 1)
+    def _emit():
+        o_ref[0] = (num_scr[...] / den_scr[...]).astype(o_ref.dtype)
+        lse_ref[...] = (m_scr[...] + jnp.log(den_scr[...])
+                        ).reshape(1, block, 1)
+
+
+def _dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, causal, s_orig, scale, block):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, dq_scr.dtype)
+
+    run = ik * block < s_orig
+    if causal:
+        run = jnp.logical_and(run, iq >= ik)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[...].reshape(-1, 1)
+        delta = delta_ref[...].reshape(-1, 1)
+        dq_scr[...] = dq_scr[...] + _dq_step(
+            q, k, v, do, lse, delta, iq * block, ik * block, s_orig,
+            causal, scale)
+
+    @pl.when(ik == n_k - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, causal,
+                       s_orig, scale, block):
+    ikb = pl.program_id(1)
+    iqb = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(iqb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, dk_scr.dtype)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, dv_scr.dtype)
+
+    # Padded-Q tiles have do == 0, so their contribution is zero; skip.
+    run = iqb * block < s_orig
+    if causal:
+        run = jnp.logical_and(run, iqb >= ikb)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[...].reshape(-1, 1)
+        delta = delta_ref[...].reshape(-1, 1)
+        dk, dv = _dkv_step(q, k, v, do, lse, delta, dk_scr[...],
+                           dv_scr[...], iqb * block, ikb * block,
+                           s_orig, causal, scale)
+        dk_scr[...] = dk
+        dv_scr[...] = dv
+
+    @pl.when(iqb == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _pad_seq(x, block):
@@ -188,10 +329,55 @@ def _specs(sp, d, block):
     return tile, full, vec_tile, vec_full
 
 
-def _flash_fwd(q3, k3, v3, causal, s_orig, block):
+def _stream_specs(d, block):
+    """3D-grid specs: axis 1 indexes the accumulated (output) tile,
+    axis 2 the streamed tile."""
+    outer = pl.BlockSpec((1, block, d), lambda bh, i, j: (bh, i, 0),
+                         memory_space=pltpu.VMEM)
+    inner = pl.BlockSpec((1, block, d), lambda bh, i, j: (bh, j, 0),
+                         memory_space=pltpu.VMEM)
+    vec_outer = pl.BlockSpec((1, block, 1), lambda bh, i, j: (bh, i, 0),
+                             memory_space=pltpu.VMEM)
+    vec_inner = pl.BlockSpec((1, block, 1), lambda bh, i, j: (bh, j, 0),
+                             memory_space=pltpu.VMEM)
+    return outer, inner, vec_outer, vec_inner
+
+
+# Resident mode holds K/V (or Q/dO) for the whole padded sequence in
+# VMEM, double-buffered across batch*head programs: ~4*Sp*D*itemsize
+# bytes. Measured limit on v5e: seq 8192 bf16 D=128 (8.4 MB) compiles,
+# 16384 does not.
+_RESIDENT_VMEM_BUDGET = 9 * 1024 * 1024
+
+
+def _use_streaming(sp, d, itemsize, streaming):
+    if streaming is not None:
+        return streaming
+    return 4 * sp * d * itemsize > _RESIDENT_VMEM_BUDGET
+
+
+def _flash_fwd(q3, k3, v3, causal, s_orig, block, streaming=None):
     """q3/k3/v3: [BH, Sp, D] padded. Returns (o3, lse)."""
     bh, sp, d = q3.shape
     scale = 1.0 / math.sqrt(d)
+    out_shape = [jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
+                 jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32)]
+    if _use_streaming(sp, d, q3.dtype.itemsize, streaming):
+        outer, inner, vec_outer, _ = _stream_specs(d, block)
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel_stream, causal=causal,
+                              s_orig=s_orig, scale=scale, block=block),
+            grid=(bh, sp // block, sp // block),
+            in_specs=[outer, inner, inner],
+            out_specs=[outer, vec_outer],
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((block, 1), jnp.float32),
+                pltpu.VMEM((block, d), jnp.float32),
+                pltpu.VMEM((block, 1), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(q3, k3, v3)
     tile, full, vec_tile, _ = _specs(sp, d, block)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, s_orig=s_orig,
@@ -199,17 +385,45 @@ def _flash_fwd(q3, k3, v3, causal, s_orig, block):
         grid=(bh, sp // block),
         in_specs=[tile, full, full],
         out_specs=[tile, vec_tile],
-        out_shape=[jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
-                   jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32)],
+        out_shape=out_shape,
         interpret=_interpret(),
     )(q3, k3, v3)
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
+               streaming=None):
     bh, sp, d = q3.shape
     scale = 1.0 / math.sqrt(d)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, Sp, 1]
+    if _use_streaming(sp, d, q3.dtype.itemsize, streaming):
+        outer, inner, vec_outer, vec_inner = _stream_specs(d, block)
+        n = sp // block
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel_stream, causal=causal,
+                              s_orig=s_orig, scale=scale, block=block),
+            grid=(bh, n, n),
+            in_specs=[outer, inner, inner, outer, vec_outer, vec_outer],
+            out_specs=outer,
+            out_shape=jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
+            scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+            interpret=_interpret(),
+        )(q3, k3, v3, do3, lse, delta)
+        # dk/dv accumulate per K tile (axis 1) while Q/dO stream
+        # (axis 2): swap the outer/inner roles of the q-side operands.
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel_stream, causal=causal,
+                              s_orig=s_orig, scale=scale, block=block),
+            grid=(bh, n, n),
+            in_specs=[inner, outer, outer, inner, vec_inner, vec_inner],
+            out_specs=[outer, outer],
+            out_shape=[jax.ShapeDtypeStruct((bh, sp, d), k3.dtype),
+                       jax.ShapeDtypeStruct((bh, sp, d), v3.dtype)],
+            scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                            pltpu.VMEM((block, d), jnp.float32)],
+            interpret=_interpret(),
+        )(q3, k3, v3, do3, lse, delta)
+        return dq, dk, dv
     tile, full, vec_tile, vec_full = _specs(sp, d, block)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, s_orig=s_orig,
@@ -243,31 +457,31 @@ def _to4d(x3, b, h):
     return x3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, block):
-    o, _ = _flash_vjp_fwd(q, k, v, causal, block)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block, streaming):
+    o, _ = _flash_vjp_fwd(q, k, v, causal, block, streaming)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, block):
+def _flash_vjp_fwd(q, k, v, causal, block, streaming):
     b, s, h, d = q.shape
     q3, k3, v3 = (_pad_seq(_to3d(x), block) for x in (q, k, v))
-    o3, lse = _flash_fwd(q3, k3, v3, causal, s, block)
+    o3, lse = _flash_fwd(q3, k3, v3, causal, s, block, streaming)
     return _to4d(o3, b, h)[:, :s], (q3, k3, v3, o3, lse, b, s, h)
 
 
-def _flash_vjp_bwd(causal, block, res, g):
+def _flash_vjp_bwd(causal, block, streaming, res, g):
     q3, k3, v3, o3, lse, b, s, h = res
     do3 = _pad_seq(_to3d(g), block)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s,
-                               block)
+                               block, streaming)
     return tuple(_to4d(x3, b, h)[:, :s] for x3 in (dq3, dk3, dv3))
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False, block=None):
+def flash_attention(q, k, v, causal=False, block=None, streaming=None):
     """Exact attention, O(S) memory. q/k/v: [B, S, H, D].
 
     block: seq-dim VMEM tile for the Q/K loops (multiple of 128);
@@ -276,6 +490,12 @@ def flash_attention(q, k, v, causal=False, block=None):
     than 128 at seq 8192 (65 vs 17 TFLOP/s) and within noise at 2k,
     while 1024 exceeds VMEM at 8k. Larger tiles amortize loop
     overhead at the cost of VMEM.
+
+    streaming: None (default) picks per shape — VMEM-resident K/V up
+    to the measured v5e budget (seq 8192 at bf16/D=128), the
+    grid-streamed kernels beyond, which keep single-chip attention
+    working at 16k/32k+ where the resident layout cannot compile.
+    True/False force a mode (tests, tuning).
     """
     if not (q.shape == k.shape == v.shape):
         raise ValueError(
@@ -290,4 +510,5 @@ def flash_attention(q, k, v, causal=False, block=None):
     if block < 128 or block % 128:
         raise ValueError(f"block must be a positive multiple of 128: "
                          f"{block}")
-    return _flash(q, k, v, bool(causal), block)
+    return _flash(q, k, v, bool(causal), block,
+                  None if streaming is None else bool(streaming))

@@ -166,3 +166,70 @@ def test_transformer_next_token_training_step():
                                     grads)
     loss1 = objective(params)
     assert loss1 < loss0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_streaming_matches_resident(qkv, causal):
+    """Grid-streamed kernels (seq > VMEM budget) == resident kernels,
+    forward and backward, including the ragged final tile.
+
+    block=128 so S=200 pads to 2 tiles: the cross-grid-step machinery
+    (scratch persistence, the exp(m - new_m) correction against a
+    real prior max, the causal/padding run-skip) actually executes —
+    at the default block the grid would be 1x1 and none of it would.
+    """
+    q, k, v = qkv
+    want = flash_attention(q, k, v, causal=causal, block=128,
+                           streaming=False)
+    got = flash_attention(q, k, v, causal=causal, block=128,
+                          streaming=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(streaming):
+        return jax.grad(
+            lambda t: jnp.sum(flash_attention(
+                t[0], t[1], t[2], causal=causal, block=128,
+                streaming=streaming) ** 2))((q, k, v))
+
+    for g, w in zip(loss(True), loss(False)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_streaming_multitile_matches_dense(causal):
+    """4+ streamed tiles against the dense reference, fwd + grad."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (1, 512, 2, 32), jnp.float32)
+               for kk in ks)
+
+    want = dot_product_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block=128,
+                          streaming=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def f_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block=128, streaming=True) ** 2)
+
+    def d_loss(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v,
+                                             causal=causal) ** 2)
+
+    want_g = jax.grad(d_loss, argnums=(0, 1, 2))(q, k, v)
+    got_g = jax.grad(f_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got_g, want_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_streaming_auto_threshold():
+    """Auto mode streams only above the resident VMEM budget."""
+    from container_engine_accelerators_tpu.ops import attention as A
+
+    assert not A._use_streaming(8192, 128, 2, None)
+    assert A._use_streaming(16384, 128, 2, None)
+    assert A._use_streaming(256, 128, 2, True)  # explicit override
+    assert not A._use_streaming(10 ** 9, 128, 2, False)
