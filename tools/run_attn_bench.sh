@@ -34,6 +34,16 @@ for SEQ in 2048 4096 8192; do
        >> "${TMP}"
 done
 
+# Long-context (streaming kernels; dense cannot compile here, which
+# the rows record). batch 1 keeps the dense comparison attempt cheap.
+for SEQ in 16384 32768; do
+  echo "[attn-bench] seq_len=${SEQ} (streaming)" >&2
+  timeout 900 python tools/bench_attention.py \
+    --seq-len "${SEQ}" --batch 1 >> "${TMP}" \
+    || echo "{\"seq_len\": ${SEQ}, \"error\": \"run failed/timeout\"}" \
+       >> "${TMP}"
+done
+
 # Tile-size tuning sweep at the middle sequence length.
 for BLK in 256 512; do
   echo "[attn-bench] seq_len=4096 block=${BLK}" >&2
