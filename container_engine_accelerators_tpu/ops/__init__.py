@@ -14,8 +14,8 @@
 
 """Pallas TPU kernels backing the demo workloads."""
 
-from .attention import flash_attention
+from .attention import flash_attention, flash_attention_lse
 from .xent import softmax_cross_entropy, mean_cross_entropy_loss
 
-__all__ = ["flash_attention", "softmax_cross_entropy",
-           "mean_cross_entropy_loss"]
+__all__ = ["flash_attention", "flash_attention_lse",
+           "softmax_cross_entropy", "mean_cross_entropy_loss"]

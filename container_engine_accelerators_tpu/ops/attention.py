@@ -87,7 +87,13 @@ def _fwd_step(q, k, v, m, num, den, q_off, k_off, s_orig, causal,
 
 def _dq_step(q, k, v, do, lse, delta, q_off, k_off, s_orig, causal,
              scale):
-    """One dQ accumulation term: ds @ k for one K/V tile."""
+    """One dQ accumulation term: ds @ k for one K/V tile.
+
+    ``delta`` is the *effective* per-row term sum(do*o) - g_lse: the
+    cotangent of the lse output enters the score gradient as
+    ds_ij += g_lse_i * p_ij (d lse_i / d s_ij = p_ij), which folds
+    into the same subtraction.
+    """
     s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale)
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
@@ -99,7 +105,8 @@ def _dq_step(q, k, v, do, lse, delta, q_off, k_off, s_orig, causal,
 
 def _dkv_step(q, k, v, do, lse, delta, dk, dv, q_off, k_off, s_orig,
               causal, scale):
-    """Accumulate one Q/dO tile's contribution into (dk, dv)."""
+    """Accumulate one Q/dO tile's contribution into (dk, dv).
+    ``delta`` as in _dq_step (effective: sum(do*o) - g_lse)."""
     s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale)
     p = jnp.exp(s - lse)  # (BQ, BK)
     dv = dv + jax.lax.dot_general(
@@ -391,11 +398,15 @@ def _flash_fwd(q3, k3, v3, causal, s_orig, block, streaming=None):
 
 
 def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
-               streaming=None):
+               streaming=None, glse3=None):
     bh, sp, d = q3.shape
     scale = 1.0 / math.sqrt(d)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [BH, Sp, 1]
+    if glse3 is not None:
+        # lse cotangent: ds_ij += g_lse_i * p_ij, folded into delta
+        # (see _dq_step). glse3: [BH, Sp, 1] f32.
+        delta = delta - glse3
     if _use_streaming(sp, d, q3.dtype.itemsize, streaming):
         outer, inner, vec_outer, vec_inner = _stream_specs(d, block)
         n = sp // block
@@ -481,6 +492,41 @@ def _flash_vjp_bwd(causal, block, streaming, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_lse(q, k, v, causal, block, streaming):
+    out, _ = _flash_lse_vjp_fwd(q, k, v, causal, block, streaming)
+    return out
+
+
+def _lse_to4d(lse, b, s, h):
+    """[BH, Sp, 1] f32 -> [B, S, H]."""
+    return lse.reshape(b, h, -1).transpose(0, 2, 1)[:, :s]
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, block, streaming):
+    b, s, h, d = q.shape
+    q3, k3, v3 = (_pad_seq(_to3d(x), block) for x in (q, k, v))
+    o3, lse = _flash_fwd(q3, k3, v3, causal, s, block, streaming)
+    out = (_to4d(o3, b, h)[:, :s], _lse_to4d(lse, b, s, h))
+    return out, (q3, k3, v3, o3, lse, b, s, h)
+
+
+def _flash_lse_vjp_bwd(causal, block, streaming, res, g):
+    q3, k3, v3, o3, lse, b, s, h = res
+    g_o, g_lse = g
+    do3 = _pad_seq(_to3d(g_o), block)
+    # [B, S, H] -> padded [BH, Sp, 1]; padded rows get zero cotangent.
+    glse3 = _pad_seq(
+        g_lse.astype(jnp.float32).transpose(0, 2, 1).reshape(
+            b * h, s, 1), block)
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s,
+                               block, streaming, glse3=glse3)
+    return tuple(_to4d(x3, b, h)[:, :s] for x3 in (dq3, dk3, dv3))
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
 def flash_attention(q, k, v, causal=False, block=None, streaming=None):
     """Exact attention, O(S) memory. q/k/v: [B, S, H, D].
 
@@ -497,6 +543,29 @@ def flash_attention(q, k, v, causal=False, block=None, streaming=None):
     working at 16k/32k+ where the resident layout cannot compile.
     True/False force a mode (tests, tuning).
     """
+    causal, block, streaming = _check_args(q, k, v, causal, block,
+                                           streaming)
+    return _flash(q, k, v, causal, block, streaming)
+
+
+def flash_attention_lse(q, k, v, causal=False, block=None,
+                        streaming=None):
+    """flash_attention that also returns the per-row logsumexp.
+
+    Returns (o [B, S, H, D], lse [B, S, H] f32) where
+    lse = log sum_j exp(q_i . k_j / sqrt(D)) over unmasked j. The lse
+    output is fully differentiable (its cotangent folds into the
+    score gradient as ds += g_lse * p), which is what lets partial
+    attention results combine exactly across K/V shards:
+    ring attention runs this kernel per hop and merges hops by
+    logsumexp weighting (parallel/context.py).
+    """
+    causal, block, streaming = _check_args(q, k, v, causal, block,
+                                           streaming)
+    return _flash_lse(q, k, v, causal, block, streaming)
+
+
+def _check_args(q, k, v, causal, block, streaming):
     if not (q.shape == k.shape == v.shape):
         raise ValueError(
             f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
@@ -510,5 +579,5 @@ def flash_attention(q, k, v, causal=False, block=None, streaming=None):
     if block < 128 or block % 128:
         raise ValueError(f"block must be a positive multiple of 128: "
                          f"{block}")
-    return _flash(q, k, v, bool(causal), block,
-                  None if streaming is None else bool(streaming))
+    return (bool(causal), block,
+            None if streaming is None else bool(streaming))

@@ -48,11 +48,19 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..ops.attention import flash_attention, flash_attention_lse
 from .mesh import grid_mesh
 
 CONTEXT_AXIS = "context"
 
 _NEG = -1e9
+
+
+def _default_use_flash():
+    """The Pallas kernels are the fast path on the MXU; the lax
+    schedule stays the default off-TPU (interpret-mode Pallas is much
+    slower than XLA:CPU for the big shapes CI exercises)."""
+    return jax.default_backend() == "tpu"
 
 
 def build_context_mesh(context, data=None, devices=None):
@@ -135,22 +143,101 @@ def _block_accumulate(q, k, v, q_offset, k_offset, m, num, den, causal):
     return m, num, den
 
 
+def _flash_hop(q, k_blk, v_blk, q_offset, k_offset, causal):
+    """One ring hop through the Pallas kernel: partial attention of
+    the local queries against one K/V block, as (o, lse) — [B,s,H,D]
+    f32, [B,s,H] f32. Cross-block causality reduces to three cases on
+    block offsets (blocks are uniform s_local tiles): the diagonal
+    block is causal within itself, earlier blocks are fully visible,
+    later blocks contribute nothing (lse forced to -inf so the
+    logsumexp merge zeroes them exactly, gradients included)."""
+    diag = k_offset == q_offset
+
+    def diag_call(q, k_blk, v_blk):
+        return flash_attention_lse(q, k_blk, v_blk, causal=True)
+
+    def full_call(q, k_blk, v_blk):
+        return flash_attention_lse(q, k_blk, v_blk, causal=False)
+
+    if causal:
+        o, lse = jax.lax.cond(diag, diag_call, full_call,
+                              q, k_blk, v_blk)
+        lse = jnp.where(k_offset > q_offset, -jnp.inf, lse)
+    else:
+        o, lse = full_call(q, k_blk, v_blk)
+    return o.astype(jnp.float32), lse
+
+
+def _lse_merge(acc, m, den, o_t, lse_t):
+    """Fold one hop's partial (o_t, lse_t) into the running
+    (acc, m, den): unnormalized numerators weighted by exp(lse),
+    tracked against a running max for stability."""
+    new_m = jnp.maximum(m, lse_t)
+    # new_m == -inf means no unmasked key seen yet at this row; both
+    # subtractions would be -inf - -inf = nan there. Route them to
+    # exp(-inf) = 0 instead (also zeroes the cotangent).
+    empty = jnp.isneginf(new_m)
+    corr = jnp.exp(jnp.where(empty, -jnp.inf, m - new_m))
+    w_t = jnp.exp(jnp.where(empty, -jnp.inf, lse_t - new_m))
+    acc = acc * corr[..., None] + o_t * w_t[..., None]
+    den = den * corr + w_t
+    return acc, new_m, den
+
+
 def ring_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
-                   causal=False, batch_axis=None):
+                   causal=False, batch_axis=None, use_flash=None):
     """Exact attention with K/V circulating the context-axis ring.
 
     q/k/v: [B, S, H, D], sequence-sharded over ``axis_name``. Each of
     the P-1 hops sends the resident K/V block to the next ring
     neighbor (ppermute) while the local queries fold the block they
     just received into the online softmax — the blockwise schedule of
-    Liu & Abbeel's Ring Attention, built from lax primitives.
+    Liu & Abbeel's Ring Attention.
+
+    ``use_flash`` (None = auto: on TPU) computes each hop with the
+    Pallas flash kernel via ``flash_attention_lse`` and merges hops
+    by logsumexp weighting — the scores of a hop never leave VMEM.
+    Off-TPU the lax einsum schedule avoids interpret-mode overhead.
+    Both paths are exact and differentiable.
 
     ``batch_axis`` additionally shards the batch dim (compose with
     data parallelism on a multi-axis mesh); rings then run per data
     shard.
     """
+    if use_flash is None:
+        use_flash = _default_use_flash()
     p_size = mesh.shape[axis_name]
     spec = P(batch_axis, axis_name, None, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def _ring_flash(q, k, v):
+        idx = jax.lax.axis_index(axis_name)
+        s_local = q.shape[1]
+        q_offset = idx * s_local
+        b, _, h, d = q.shape
+        acc = jnp.zeros((b, s_local, h, d), jnp.float32)
+        m = jnp.full((b, s_local, h), -jnp.inf, jnp.float32)
+        den = jnp.zeros((b, s_local, h), jnp.float32)
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+        def hop(t, carry):
+            k_blk, v_blk, acc, m, den = carry
+            k_offset = ((idx - t) % p_size) * s_local
+            o_t, lse_t = _flash_hop(q, k_blk, v_blk, q_offset,
+                                    k_offset, causal)
+            acc, m, den = _lse_merge(acc, m, den, o_t, lse_t)
+            k_blk, v_blk = jax.lax.ppermute(
+                (k_blk, v_blk), axis_name, perm)
+            return k_blk, v_blk, acc, m, den
+
+        k, v, acc, m, den = jax.lax.fori_loop(
+            0, p_size - 1, hop, (k, v, acc, m, den))
+        k_offset = ((idx - (p_size - 1)) % p_size) * s_local
+        o_t, lse_t = _flash_hop(q, k, v, q_offset, k_offset, causal)
+        acc, m, den = _lse_merge(acc, m, den, o_t, lse_t)
+        return (acc / den[..., None]).astype(q.dtype)
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),
@@ -187,7 +274,7 @@ def ring_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
             q, k, v, q_offset, k_offset, m, num, den, causal)
         return (num / den.swapaxes(1, 2)).astype(q.dtype)
 
-    return _ring(q, k, v)
+    return (_ring_flash if use_flash else _ring)(q, k, v)
 
 
 def _blockwise_attention(q, k, v, causal):
@@ -203,17 +290,19 @@ def _blockwise_attention(q, k, v, causal):
 
 
 def ulysses_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
-                      causal=False, batch_axis=None):
+                      causal=False, batch_axis=None, use_flash=None):
     """Exact attention via all-to-all head re-sharding (Ulysses).
 
     q/k/v: [B, S, H, D], sequence-sharded over ``axis_name``; H must
     be divisible by the axis size. One all_to_all turns the sequence
-    sharding into a head sharding (full S, H/P heads per chip),
-    blockwise attention runs locally (full-sequence dense scores
-    would be the exact memory blowup sequence parallelism exists to
-    avoid), and a second all_to_all restores the sequence sharding.
-    ``batch_axis`` as in ``ring_attention``.
+    sharding into a head sharding (full S, H/P heads per chip), local
+    attention runs over the full sequence — through the Pallas flash
+    kernel on TPU (``use_flash``, None = auto), or the lax blockwise
+    schedule off-TPU — and a second all_to_all restores the sequence
+    sharding. ``batch_axis`` as in ``ring_attention``.
     """
+    if use_flash is None:
+        use_flash = _default_use_flash()
     p_size = mesh.shape[axis_name]
     if q.shape[2] % p_size != 0:
         raise ValueError(
@@ -233,9 +322,11 @@ def ulysses_attention(mesh, q, k, v, *, axis_name=CONTEXT_AXIS,
             return jax.lax.all_to_all(
                 x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-        out = _blockwise_attention(
-            seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
-            causal=causal)
+        qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        if use_flash:
+            out = flash_attention(qh, kh, vh, causal=causal)
+        else:
+            out = _blockwise_attention(qh, kh, vh, causal=causal)
         return heads_to_seq(out)
 
     return _ulysses(q, k, v)

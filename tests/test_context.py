@@ -145,3 +145,65 @@ def test_context_mesh_axes():
     assert mesh.shape["data"] == 2
     with pytest.raises(ValueError, match="do not factor"):
         build_context_mesh(context=3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_hops_match_dense(qkv, causal):
+    """The Pallas-per-hop path (TPU default): each hop computes
+    (o, lse) with the flash kernel, hops merge by logsumexp weighting.
+    Must equal dense exactly — fwd and bwd — including hops that are
+    fully causally masked (lse forced to -inf)."""
+    mesh = build_context_mesh(context=4)
+    q, k, v = qkv
+    want = dot_product_attention(q, k, v, causal=causal)
+    got = ring_attention(mesh, q, k, v, causal=causal, use_flash=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    def dense_loss(t):
+        return jnp.sum(dot_product_attention(
+            t[0], t[1], t[2], causal=causal) ** 2)
+
+    def flash_loss(t):
+        return jnp.sum(ring_attention(
+            mesh, t[0], t[1], t[2], causal=causal,
+            use_flash=True) ** 2)
+
+    want_g = jax.grad(dense_loss)((q, k, v))
+    got_g = jax.grad(flash_loss)((q, k, v))
+    for g, w in zip(got_g, want_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_matches_dense(qkv, causal):
+    mesh = build_context_mesh(context=4)
+    q, k, v = qkv
+    want = dot_product_attention(q, k, v, causal=causal)
+    got = ulysses_attention(mesh, q, k, v, causal=causal,
+                            use_flash=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_lse_matches_logsumexp():
+    """flash_attention_lse's second output is the row logsumexp of
+    the (scaled, masked) scores — the contract the ring merge relies
+    on."""
+    from container_engine_accelerators_tpu.ops import (
+        flash_attention_lse,
+    )
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(key, (1, 40, 2, 8), jnp.float32)
+               for key in ks)
+    _, lse = flash_attention_lse(q, k, v, causal=True, block=128)
+    scale = 1.0 / np.sqrt(8)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    qp = jax.lax.broadcasted_iota(jnp.int32, (40, 40), 0)
+    kp = jax.lax.broadcasted_iota(jnp.int32, (40, 40), 1)
+    s = jnp.where(qp >= kp, s, -1e9)
+    want = jax.scipy.special.logsumexp(s, axis=-1).transpose(0, 2, 1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)

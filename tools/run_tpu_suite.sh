@@ -49,4 +49,41 @@ echo "[suite] decode bench (bf16 + int8 cache)" >&2
 } > "${OUT}/DECODE_BENCH.json" 2>> "${OUT}/tpu_suite.log"
 cat "${OUT}/DECODE_BENCH.json" >&2
 
+echo "[suite] serving bench (LM generate, cold + warm)" >&2
+python demo/serving/serve.py --model transformer --port 8519 \
+  --max-seq-len 256 --max-new-tokens 32 \
+  2>> "${OUT}/tpu_suite.log" &
+SERVE_PID=$!
+trap 'kill "${SERVE_PID}" 2>/dev/null' EXIT
+READY=0
+for i in $(seq 1 60); do
+  curl -s -m 2 localhost:8519/stats > /dev/null 2>&1 && { READY=1; break; }
+  kill -0 "${SERVE_PID}" 2>/dev/null || break  # server died
+  sleep 5
+done
+serving_run() {  # $1 = num requests; emits one JSON object, always
+  local row
+  row="$(timeout 1200 python demo/serving/load_generator.py \
+    --mode generate --port 8519 --model-name transformer \
+    --max-prompt-len 48 --max-new-tokens 32 -n "$1" --parallelism 8 \
+    2>/dev/null | tail -1)"
+  case "${row}" in
+    {*) echo -n "${row}" ;;
+    *)  echo -n '{"error": "load generator produced no result"}' ;;
+  esac
+}
+if [ "${READY}" = 1 ]; then
+  {
+    echo -n '{"cold": '; serving_run 300
+    echo -n ', "warm": '; serving_run 600
+    echo '}'
+  } > "${OUT}/SERVING_BENCH_RAW.json"
+else
+  echo '{"error": "server never became ready"}' \
+    > "${OUT}/SERVING_BENCH_RAW.json"
+fi
+kill "${SERVE_PID}" 2>/dev/null
+trap - EXIT
+cat "${OUT}/SERVING_BENCH_RAW.json" >&2
+
 echo "[suite] done" >&2
