@@ -1,0 +1,32 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Jupyter server config for the in-cluster notebook.
+
+Binds on all interfaces (the pod IP is what the Service routes to)
+and honors NOTEBOOK_TOKEN when the operator sets one; an empty token
+keeps the reference's open-behind-LoadBalancer behavior, which is
+only sane on a private cluster network.
+"""
+
+import os
+
+c = get_config()  # noqa: F821 - injected by jupyter at load time
+
+c.ServerApp.ip = "0.0.0.0"
+c.ServerApp.port = 8888
+c.ServerApp.open_browser = False
+c.ServerApp.allow_root = True
+c.ServerApp.token = os.environ.get("NOTEBOOK_TOKEN", "")
+c.ServerApp.root_dir = os.environ.get("NOTEBOOK_DIR", "/home/jovyan")

@@ -1,0 +1,28 @@
+#!/bin/bash
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+# Entry point: standalone JupyterLab, or the JupyterHub single-user
+# server when spawned by a Hub (JUPYTERHUB_API_TOKEN present).
+
+set -e
+
+mkdir -p "${NOTEBOOK_DIR:-/home/jovyan}"
+
+if [ -n "${JUPYTERHUB_API_TOKEN}" ]; then
+  exec /usr/local/bin/start-singleuser.sh "$@"
+fi
+
+exec jupyter lab --config=/etc/jupyter/jupyter_server_config.py "$@"
