@@ -195,11 +195,11 @@ def decode(model, params, prompt, max_new_tokens, *,
     emitting EOS — shapes stay static; trim at the first EOS.
     Prompt-resident EOS ids don't trigger.
 
-    Memory note: the one-shot prefill materializes attention scores
-    of shape [B, H, P, P + max_new_tokens] per layer transiently
-    (~143MB at P=2048, H=8, f32). For very long unpadded prompts
-    (8k+) prefer ``fast_prefill=False`` (stepwise, O(P) memory) or
-    the bucketed serving layer.
+    Memory note: the one-shot prefill runs the Pallas flash kernel
+    over the prompt chunk (the cache is empty, so chunk-causal
+    attention is exact), keeping transient score memory O(P * block)
+    per layer instead of [B, H, P, P + max_new_tokens] — long
+    prompts prefill without a quadratic spike.
 
     ``prompt_len`` (traced scalar or [B] per-row vector, default P)
     is where generation takes over from prefill: pass true prompt

@@ -146,7 +146,12 @@ class CausalSelfAttention(nn.Module):
         index = self.variable("cache", "cache_index",
                               lambda: jnp.zeros((), jnp.int32))
         if is_init:
-            return dot_product_attention(q, k, v, causal=True)
+            # Cache sizing pass (init_cache runs the model over the
+            # full max_seq_len input): the output is discarded, but
+            # dense attention here would still materialize [B,H,S,S]
+            # scores — at 32k that is the difference between init
+            # working and OOM. The flash kernel keeps it O(S*block).
+            return flash_attention(q, k, v, causal=True)
 
         i = index.value
         if quantized:
@@ -166,6 +171,18 @@ class CausalSelfAttention(nn.Module):
             cached_v.value = jax.lax.dynamic_update_slice(
                 cached_v.value, v.astype(cache_dtype), (0, i, 0, 0))
         index.value = i + q.shape[1]
+
+        if q.shape[1] > 1:
+            # Multi-token chunks only occur at one-shot prefill, where
+            # the cache was empty (decode.py feeds single tokens after
+            # prefill; a multi-token chunk against a non-empty cache
+            # is outside the decode API's contract). Attention then
+            # reduces to causal attention among the incoming tokens —
+            # every padded cache position is masked — so run the
+            # Pallas kernel on the raw chunk: O(P*block) score memory
+            # instead of [B, H, P, S_max] against the cache, and no
+            # int8 round-trip for the prefill tokens' own scores.
+            return flash_attention(q, k, v, causal=True)
 
         d = q.shape[-1]
         # The int8->compute-dtype convert below fuses into the dot's
