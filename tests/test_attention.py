@@ -233,3 +233,36 @@ def test_streaming_auto_threshold():
     assert A._use_streaming(16384, 128, 2, None)
     assert A._use_streaming(256, 128, 2, True)  # explicit override
     assert not A._use_streaming(10 ** 9, 128, 2, False)
+
+
+def test_flash_property_sweep():
+    """Randomized shapes x modes x causality vs dense attention.
+
+    One seed per case, shapes chosen to cross tile boundaries
+    (ragged final tiles, S < block, S == block, multi-tile) — the
+    places where padding/masking bugs live.
+    """
+    rng = np.random.RandomState(0)
+    cases = [
+        # (B, S, H, D, block)
+        (1, 64, 1, 8, 128),     # S < block -> single padded tile
+        (2, 128, 2, 16, 128),   # S == block exactly
+        (1, 129, 1, 8, 128),    # one ragged row over the boundary
+        (3, 384, 2, 8, 128),    # 3 exact tiles
+        (1, 300, 4, 32, 256),   # ragged with a larger block
+    ]
+    for (b, s, h, d, block) in cases:
+        for causal in (False, True):
+            for streaming in (False, True):
+                q, k, v = (
+                    jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+                    for _ in range(3))
+                want = dot_product_attention(q, k, v, causal=causal)
+                got = flash_attention(q, k, v, causal=causal,
+                                      block=block,
+                                      streaming=streaming)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want),
+                    rtol=3e-5, atol=3e-5,
+                    err_msg=f"case {(b, s, h, d, block)} "
+                            f"causal={causal} streaming={streaming}")
