@@ -126,6 +126,11 @@ def main(argv=None):
                    help="persistent XLA compile cache (hostPath or "
                         "PVC); replica restarts then skip the "
                         "20-40s per-program compiles")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="shard wide parameters over an N-way model "
+                        "axis (all visible chips of the replica's "
+                        "subslice); XLA inserts the collectives. "
+                        "1 = single-chip replica")
     args = p.parse_args(argv)
     if args.compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir",
@@ -155,6 +160,24 @@ def main(argv=None):
         if args.model_dir:
             variables = load_checkpoint_variables(args.model_dir,
                                                   variables)
+        if args.tensor_parallel > 1:
+            # Weights shard column-wise over the model axis
+            # (parallel/sharding.py rules); decode stays an ordinary
+            # jit — GSPMD propagates the shardings through the scan
+            # and KV cache and inserts the ICI collectives.
+            from container_engine_accelerators_tpu.parallel import (
+                build_mesh,
+            )
+            from container_engine_accelerators_tpu.parallel.mesh import (
+                MeshSpec,
+            )
+            from container_engine_accelerators_tpu.parallel.sharding \
+                import param_shardings
+            mesh = build_mesh(
+                MeshSpec(data=1, model=args.tensor_parallel))
+            variables = {"params": jax.device_put(
+                variables["params"],
+                param_shardings(mesh, variables["params"]))}
         server = GenerationServer(
             name, model, variables["params"], port=args.port,
             max_new_tokens=args.max_new_tokens,
