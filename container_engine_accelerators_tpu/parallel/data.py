@@ -32,6 +32,7 @@ budgets for the ResNet target.
 import os
 import queue
 import threading
+import zipfile
 
 import jax
 import numpy as np
@@ -178,6 +179,25 @@ class PrefetchLoader:
         return item
 
 
+def _npz_rows(path, name="images"):
+    """Leading-dim length of one array in an .npz, header-only.
+
+    np.load would decompress the whole member; reading the .npy
+    header out of the zip entry costs a few hundred bytes, which is
+    what makes checkpoint-resume fast-forward cheap on big shards.
+    """
+    from numpy.lib import format as npfmt
+
+    with zipfile.ZipFile(path) as zf:
+        with zf.open(name + ".npy") as f:
+            version = npfmt.read_magic(f)
+            if version == (1, 0):
+                shape, _, _ = npfmt.read_array_header_1_0(f)
+            else:
+                shape, _, _ = npfmt.read_array_header_2_0(f)
+    return shape[0]
+
+
 class NpzShardDataset:
     """Host-side reader over a directory of .npz shard files.
 
@@ -186,10 +206,19 @@ class NpzShardDataset:
     fixed-size (images, labels) batches, reshuffling the shard order
     each epoch with a deterministic per-epoch seed; ``epochs=None``
     repeats forever. Pair with PrefetchLoader for the device side.
+
+    ``skip_batches`` fast-forwards the stream for checkpoint resume:
+    whole shards are skipped by reading only their .npy headers (no
+    decompression), then the first loaded shard is sliced. Skipping
+    is shard-aligned in its accounting — cross-shard leftovers inside
+    the skipped region are dropped rather than reconstructed, so up
+    to (shards-skipped) * (batch-1) samples near those boundaries are
+    not re-yielded; the epoch schedule and everything after the
+    resume point stay deterministic.
     """
 
     def __init__(self, data_dir, batch_size, epochs=None, seed=0,
-                 dtype=None):
+                 dtype=None, skip_batches=0):
         self._paths = sorted(
             os.path.join(data_dir, f) for f in os.listdir(data_dir)
             if f.endswith(".npz"))
@@ -199,19 +228,35 @@ class NpzShardDataset:
         self._epochs = epochs
         self._seed = seed
         self._dtype = dtype
+        self._skip = int(skip_batches)
 
     def __iter__(self):
         epoch = 0
         leftover = None
+        to_skip = self._skip
         while self._epochs is None or epoch < self._epochs:
             order = np.random.default_rng(
                 self._seed + epoch).permutation(len(self._paths))
             for idx in order:
-                with np.load(self._paths[idx]) as shard:
+                path = self._paths[idx]
+                if to_skip:
+                    # Shard-aligned accounting (leftover dropped):
+                    # how many batches this shard alone yields.
+                    own = _npz_rows(path) // self._batch
+                    if own <= to_skip:
+                        to_skip -= own
+                        leftover = None
+                        continue
+                with np.load(path) as shard:
                     images = shard["images"]
                     labels = shard["labels"]
                 if self._dtype is not None:
                     images = images.astype(self._dtype)
+                if to_skip:
+                    images = images[to_skip * self._batch:]
+                    labels = labels[to_skip * self._batch:]
+                    to_skip = 0
+                    leftover = None
                 if leftover is not None:
                     images = np.concatenate([leftover[0], images])
                     labels = np.concatenate([leftover[1], labels])

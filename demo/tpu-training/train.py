@@ -432,9 +432,14 @@ def main(argv=None):
             loss_fn = cross_entropy_loss
         init_batch = jnp.zeros((1, *image_shape), jnp.float32)
         if args.data_dir:
-            loader = PrefetchLoader(
-                NpzShardDataset(args.data_dir, args.batch_size),
-                sharding=batch_sharding(mesh))
+            # Deferred: skip_batches needs the restored step, and
+            # PrefetchLoader starts staging the moment it exists.
+            def make_loader(skip):
+                return PrefetchLoader(
+                    NpzShardDataset(args.data_dir, args.batch_size,
+                                    skip_batches=skip),
+                    sharding=batch_sharding(mesh))
+            loader = None
         else:
             loader = SyntheticLoader(args.batch_size, image_shape,
                                      num_classes,
@@ -471,6 +476,11 @@ def main(argv=None):
         else:
             state = jax.device_put(restore_checkpoint(args.model_dir, state),
                                    trainer.state_shardings(state))
+    if loader is None:
+        # Real-data loader, deferred above: resume fast-forwards the
+        # shard stream past the batches the restored step already
+        # consumed (header-only shard skipping; see NpzShardDataset).
+        loader = make_loader(int(state.step))
 
     losses = []
     warmup = max(args.warmup_steps, 0)

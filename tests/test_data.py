@@ -209,3 +209,61 @@ def test_file_pipeline_feeds_trainer(tmp_path):
     assert steps == 2  # 40 samples / 16 -> 2 full batches
     assert float(state.step) == 2
     assert np.isfinite(float(loss))
+
+
+def test_npz_skip_batches_exact_with_aligned_shards(tmp_path):
+    """Shards whose sizes are batch multiples: skip_batches=k resumes
+    the stream exactly at batch k (checkpoint-resume contract)."""
+    data_dir = _shards(tmp_path, [8, 8, 8])
+    full = list(NpzShardDataset(data_dir, batch_size=4, epochs=1))
+    for k in (1, 2, 3, 5):
+        resumed = list(NpzShardDataset(data_dir, batch_size=4,
+                                       epochs=1, skip_batches=k))
+        assert len(resumed) == len(full) - k
+        for (gi, gl), (wi, wl) in zip(resumed, full[k:]):
+            np.testing.assert_array_equal(gi, wi)
+            np.testing.assert_array_equal(gl, wl)
+
+
+def test_npz_skip_batches_header_only_shard_skip(tmp_path):
+    """Whole skipped shards are only header-read; the remaining
+    stream is deterministic and never re-yields skipped samples."""
+    from unittest import mock
+
+    from container_engine_accelerators_tpu.parallel import data as D
+
+    data_dir = _shards(tmp_path, [8, 8, 8])
+    loaded = []
+    real_load = np.load
+
+    def spy_load(path, *a, **kw):
+        loaded.append(str(path))
+        return real_load(path, *a, **kw)
+
+    with mock.patch.object(D.np, "load", side_effect=spy_load):
+        out = list(D.NpzShardDataset(data_dir, batch_size=4,
+                                     epochs=1, skip_batches=2))
+    # 2 batches = the first whole shard in this epoch's order: it
+    # must not have been np.load-ed (header path only).
+    assert len(out) == 4
+    assert len(loaded) == 2
+
+
+def test_npz_skip_batches_unaligned_is_shard_conservative(tmp_path):
+    """Non-multiple shard sizes: skipping stays shard-aligned in its
+    accounting — the resumed stream skips at least the requested
+    batches' worth of *per-shard* batches and stays deterministic."""
+    data_dir = _shards(tmp_path, [10, 7, 9])
+    a = list(NpzShardDataset(data_dir, batch_size=4, epochs=1,
+                             skip_batches=3))
+    b = list(NpzShardDataset(data_dir, batch_size=4, epochs=1,
+                             skip_batches=3))
+    assert len(a) == len(b)
+    for (ai, al), (bi, bl) in zip(a, b):
+        np.testing.assert_array_equal(ai, bi)
+    # No sample before the skip point may reappear: batches 0..2 of
+    # the unskipped stream are gone.
+    full = list(NpzShardDataset(data_dir, batch_size=4, epochs=1))
+    skipped_ids = {float(x) for img, _ in full[:3] for x in img[:, 0]}
+    resumed_ids = {float(x) for img, _ in a for x in img[:, 0]}
+    assert not (skipped_ids & resumed_ids)
