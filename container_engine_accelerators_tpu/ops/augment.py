@@ -1,0 +1,71 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Device-side image augmentation (jit-compatible, batched).
+
+The reference's training demos get augmentation from the tf.data host
+pipeline; the TPU-first layout runs it on device instead — the batch
+is already in HBM, the ops are a pad + two gathers that XLA fuses
+into the step, and the host stays free for input IO. Randomness
+derives from the training step (``Trainer(augment_fn=...)`` folds the
+step into the key), so runs are reproducible and checkpoint-resume
+continues the exact augmentation stream.
+
+All functions take [B, H, W, C] image batches.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def random_flip(rng, images):
+    """Horizontal flip, per-image iid with probability 1/2."""
+    flip = jax.random.bernoulli(rng, 0.5, (images.shape[0],))
+    return jnp.where(flip[:, None, None, None],
+                     images[:, :, ::-1, :], images)
+
+
+def random_crop(rng, images, padding):
+    """Pad by ``padding`` (reflect) and take a random [H, W] window
+    per image — the standard shift augmentation."""
+    b, h, w, c = images.shape
+    padded = jnp.pad(
+        images,
+        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        mode="reflect")
+    ky, kx = jax.random.split(rng)
+    oy = jax.random.randint(ky, (b,), 0, 2 * padding + 1)
+    ox = jax.random.randint(kx, (b,), 0, 2 * padding + 1)
+
+    def crop(img, oy, ox):
+        return jax.lax.dynamic_slice(img, (oy, ox, 0), (h, w, c))
+
+    return jax.vmap(crop)(padded, oy, ox)
+
+
+def make_augment_fn(flip=True, crop_padding=0):
+    """Compose the enabled augmentations into one (rng, images) fn
+    for ``Trainer(augment_fn=...)``; None if nothing is enabled."""
+    if not flip and not crop_padding:
+        return None
+
+    def augment(rng, images):
+        if crop_padding:
+            rng, sub = jax.random.split(rng)
+            images = random_crop(sub, images, crop_padding)
+        if flip:
+            images = random_flip(rng, images)
+        return images
+
+    return augment

@@ -58,7 +58,8 @@ class Trainer:
     """
 
     def __init__(self, apply_fn, loss_fn, optimizer, mesh=None,
-                 donate_state=True, remat=False, grad_accum=1):
+                 donate_state=True, remat=False, grad_accum=1,
+                 augment_fn=None):
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1: {grad_accum}")
         self._apply = apply_fn
@@ -68,6 +69,11 @@ class Trainer:
         self._donate = donate_state
         self._remat = remat
         self._grad_accum = grad_accum
+        # augment_fn(rng, images) -> images, applied inside the
+        # compiled train step (train only, never eval) with a key
+        # folded from the step counter — reproducible, and resume
+        # continues the exact augmentation stream.
+        self._augment = augment_fn
         self._train_step = None
         self._state_shardings = None
 
@@ -132,8 +138,14 @@ class Trainer:
 
         accum = self._grad_accum
 
+        augment = self._augment
+
         def step_fn(state, batch):
             images, labels = batch
+            if augment is not None:
+                images = augment(
+                    jax.random.fold_in(jax.random.PRNGKey(17),
+                                       state.step), images)
 
             def loss_and_grads(params, batch_stats, step, images, labels):
                 def compute_loss(params):

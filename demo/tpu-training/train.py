@@ -144,6 +144,11 @@ def parse_args(argv=None):
                    help="accumulate gradients over N equal microbatches "
                         "inside one compiled step (one optimizer update; "
                         "~N x lower activation memory)")
+    p.add_argument("--augment", action="store_true",
+                   help="device-side augmentation for image models "
+                        "(random crop via --crop-padding + horizontal "
+                        "flip), applied inside the compiled step")
+    p.add_argument("--crop-padding", type=int, default=4)
     p.add_argument("--pallas-loss", action="store_true", default=True)
     p.add_argument("--no-pallas-loss", dest="pallas_loss",
                    action="store_false")
@@ -463,8 +468,19 @@ def main(argv=None):
         optax.add_decayed_weights(args.weight_decay),
         optax.sgd(lr, momentum=args.momentum),
     )
+    augment_fn = None
+    if args.augment:
+        if args.model in ("transformer", "moe"):
+            print("--augment only applies to image models; ignoring",
+                  file=sys.stderr)
+        else:
+            from container_engine_accelerators_tpu.ops.augment import (
+                make_augment_fn,
+            )
+            augment_fn = make_augment_fn(
+                flip=True, crop_padding=args.crop_padding)
     trainer = Trainer(apply_fn, loss_fn, tx, mesh=mesh, remat=args.remat,
-                      grad_accum=args.grad_accum)
+                      grad_accum=args.grad_accum, augment_fn=augment_fn)
 
     variables = model.init(jax.random.PRNGKey(0), init_batch, train=False)
     state = trainer.init_state(variables)

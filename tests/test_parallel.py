@@ -397,3 +397,49 @@ def test_checkpoint_portable_across_meshes(tmp_path):
     result = mod.main(base + ["--model-parallelism", "2"])
     assert any(n == "checkpoint_6" for n in os.listdir(tmp_path))
     assert result["final_loss"] is not None
+
+
+def test_device_side_augmentation():
+    """ops.augment: shape/dtype preserved, crop stays in bounds,
+    determinism per key, and the Trainer hook trains."""
+    import optax
+
+    from container_engine_accelerators_tpu.models import MnistMLP
+    mlp_apply_fn = mlp_mod.make_apply_fn
+    from container_engine_accelerators_tpu.ops.augment import (
+        make_augment_fn,
+        random_crop,
+        random_flip,
+    )
+    from container_engine_accelerators_tpu.parallel.train import (
+        cross_entropy_loss,
+    )
+
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (8, 12, 12, 3))
+    out = random_crop(rng, images, 2)
+    assert out.shape == images.shape and out.dtype == images.dtype
+    flipped = random_flip(rng, images)
+    # Every row is either identical or exactly mirrored.
+    same = np.isclose(np.asarray(flipped), np.asarray(images)).all(
+        axis=(1, 2, 3))
+    mirrored = np.isclose(np.asarray(flipped),
+                          np.asarray(images[:, :, ::-1, :])).all(
+        axis=(1, 2, 3))
+    assert (same | mirrored).all()
+    fn = make_augment_fn(flip=True, crop_padding=2)
+    np.testing.assert_array_equal(np.asarray(fn(rng, images)),
+                                  np.asarray(fn(rng, images)))
+    assert make_augment_fn(flip=False, crop_padding=0) is None
+
+    model = MnistMLP()
+    mesh = build_mesh()
+    trainer = Trainer(mlp_apply_fn(model), cross_entropy_loss,
+                      optax.sgd(0.1), mesh=mesh, augment_fn=fn)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 12, 12, 3)), train=False)
+    state = trainer.init_state(variables)
+    batch = (images, jnp.zeros((8,), jnp.int32))
+    state, loss0 = trainer.train_step(state, batch)
+    state, loss1 = trainer.train_step(state, batch)
+    assert np.isfinite(float(loss0)) and np.isfinite(float(loss1))
