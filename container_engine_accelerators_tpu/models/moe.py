@@ -136,6 +136,7 @@ class MoEBlock(nn.Module):
     mesh: Any = None
     decode: bool = False
     kv_cache_dtype: Any = None
+    num_kv_heads: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -144,6 +145,7 @@ class MoEBlock(nn.Module):
                                 attention_fn=self.attention_fn,
                                 decode=self.decode, mesh=self.mesh,
                                 kv_cache_dtype=self.kv_cache_dtype,
+                                num_kv_heads=self.num_kv_heads,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -176,6 +178,7 @@ class MoETransformerLM(nn.Module):
     mesh: Any = None
     decode: bool = False
     kv_cache_dtype: Any = None
+    num_kv_heads: Any = None
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -203,6 +206,7 @@ class MoETransformerLM(nn.Module):
                     dtype=self.dtype, attention_fn=attention_fn,
                     mesh=self.mesh, decode=self.decode,
                     kv_cache_dtype=self.kv_cache_dtype,
+                    num_kv_heads=self.num_kv_heads,
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -211,6 +215,7 @@ class MoETransformerLM(nn.Module):
                           attention_fn=attention_fn,
                           decode=self.decode, mesh=self.mesh,
                           kv_cache_dtype=self.kv_cache_dtype,
+                          num_kv_heads=self.num_kv_heads,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -64,6 +64,16 @@ def _quantize_rows_int8(x):
     return q, scale
 
 
+def _expand_kv(x, heads):
+    """[B, S, Hkv, D] -> [B, S, H, D] by repeating each KV head over
+    its query group (no-op for MHA). The repeat only exists at
+    attention-compute time; caches and parameters stay at Hkv."""
+    kv_heads = x.shape[2]
+    if kv_heads == heads:
+        return x
+    return jnp.repeat(x, heads // kv_heads, axis=2)
+
+
 class CausalSelfAttention(nn.Module):
     """Pre-norm causal attention residual, [B, S, E] in/out — the
     sublayer shared by the dense Block and the MoE block.
@@ -90,19 +100,42 @@ class CausalSelfAttention(nn.Module):
     # scales): cache residency halves vs bf16, so a serving replica
     # holds ~2x the context or batch. None keeps the compute dtype.
     kv_cache_dtype: Any = None
+    # Grouped-query attention: K/V projected to this many heads
+    # (must divide num_heads); the KV cache shrinks by the same
+    # factor, multiplying with the int8 option. None = MHA, which
+    # keeps the fused qkv parameter layout (checkpoint-compatible).
+    num_kv_heads: Any = None
+
+    def _kv_heads(self):
+        kv = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv:
+            raise ValueError(
+                f"num_kv_heads {kv} must divide num_heads "
+                f"{self.num_heads}")
+        return kv
 
     @nn.compact
     def __call__(self, x):
         e = x.shape[-1]
+        heads, kv_heads = self.num_heads, self._kv_heads()
+        d = e // heads
         x = residual_constraint(x, self.mesh)
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        qkv = nn.DenseGeneral((3, self.num_heads, e // self.num_heads),
-                              dtype=self.dtype, name="qkv")(h)
-        q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, D] each
+        if kv_heads == heads:
+            qkv = nn.DenseGeneral((3, heads, d), dtype=self.dtype,
+                                  name="qkv")(h)
+            q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, D]
+        else:
+            q = nn.DenseGeneral((heads, d), dtype=self.dtype,
+                                name="q")(h)
+            kv = nn.DenseGeneral((2, kv_heads, d), dtype=self.dtype,
+                                 name="kv")(h)
+            k, v = kv[:, :, 0], kv[:, :, 1]  # [B, S, Hkv, D]
         if self.decode:
             attn = self._cached_attention(q, k, v)
         else:
-            attn = self.attention_fn(q, k, v, causal=True)
+            attn = self.attention_fn(q, _expand_kv(k, heads),
+                                     _expand_kv(v, heads), causal=True)
         attn = attn.reshape(x.shape)
         out = x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
                                   name="proj")(attn)
@@ -151,7 +184,9 @@ class CausalSelfAttention(nn.Module):
             # dense attention here would still materialize [B,H,S,S]
             # scores — at 32k that is the difference between init
             # working and OOM. The flash kernel keeps it O(S*block).
-            return flash_attention(q, k, v, causal=True)
+            heads = q.shape[2]
+            return flash_attention(q, _expand_kv(k, heads),
+                                   _expand_kv(v, heads), causal=True)
 
         i = index.value
         if quantized:
@@ -182,33 +217,44 @@ class CausalSelfAttention(nn.Module):
             # Pallas kernel on the raw chunk: O(P*block) score memory
             # instead of [B, H, P, S_max] against the cache, and no
             # int8 round-trip for the prefill tokens' own scores.
-            return flash_attention(q, k, v, causal=True)
+            heads = q.shape[2]
+            return flash_attention(q, _expand_kv(k, heads),
+                                   _expand_kv(v, heads), causal=True)
 
-        d = q.shape[-1]
-        # The int8->compute-dtype convert below fuses into the dot's
-        # operand read; only the O(B*S*H) score/prob scaling is extra.
+        b, q_len, heads, d = q.shape
+        kv_heads = k.shape[2]
+        g = heads // kv_heads
+        # Grouped form (g == 1 is plain MHA): queries reshape to
+        # [B, Q, Hkv, G, D] and attend their KV head directly — no
+        # repeated/materialized copy of the cache, which at decode
+        # time is the whole memory-bandwidth story of GQA. The
+        # int8->compute-dtype convert fuses into the dot's operand
+        # read; only the O(B*S*Hkv) score/prob scaling is extra.
+        qg = q.reshape(b, q_len, kv_heads, g, d)
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, cached_k.value.astype(self.dtype),
+            "bqhgd,bkhd->bhgqk", qg, cached_k.value.astype(self.dtype),
             preferred_element_type=jnp.float32) / jnp.sqrt(
                 jnp.asarray(d, jnp.float32))
         if quantized:
-            # k_scale [B,S,H,1] -> [B,H,1,S] broadcast over queries.
+            # k_scale [B,S,Hkv,1] -> [B,Hkv,1,1,S] broadcast over
+            # (group, query).
             scores = scores * jnp.transpose(
-                k_scale.value[..., 0], (0, 2, 1))[:, :, None, :]
+                k_scale.value[..., 0], (0, 2, 1))[:, :, None, None, :]
         # Queries in a multi-token chunk (one-shot prefill) sit at
         # positions i..i+Q-1; each attends causally to its own
         # prefix. Single-token decode (Q=1) reduces to k_pos <= i.
         k_pos = jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, dimension=3)
+            jnp.int32, scores.shape, dimension=4)
         q_pos = i + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, dimension=2)
+            jnp.int32, scores.shape, dimension=3)
         scores = jnp.where(k_pos <= q_pos, scores, -1e9)
         probs = jax.nn.softmax(scores, axis=-1)
         if quantized:
             probs = probs * jnp.transpose(
-                v_scale.value[..., 0], (0, 2, 1))[:, :, None, :]
-        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(self.dtype),
-                          cached_v.value.astype(self.dtype))
+                v_scale.value[..., 0], (0, 2, 1))[:, :, None, None, :]
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(self.dtype),
+                         cached_v.value.astype(self.dtype))
+        return out.reshape(b, q_len, heads, d)
 
 
 class Block(nn.Module):
@@ -221,6 +267,7 @@ class Block(nn.Module):
     decode: bool = False
     mesh: Any = None
     kv_cache_dtype: Any = None
+    num_kv_heads: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -230,6 +277,7 @@ class Block(nn.Module):
                                 attention_fn=self.attention_fn,
                                 decode=self.decode, mesh=self.mesh,
                                 kv_cache_dtype=self.kv_cache_dtype,
+                                num_kv_heads=self.num_kv_heads,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
@@ -252,6 +300,7 @@ class TransformerLM(nn.Module):
     decode: bool = False
     mesh: Any = None
     kv_cache_dtype: Any = None
+    num_kv_heads: Any = None
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -276,6 +325,7 @@ class TransformerLM(nn.Module):
                       attention_fn=attention_fn, decode=self.decode,
                       mesh=self.mesh,
                       kv_cache_dtype=self.kv_cache_dtype,
+                      num_kv_heads=self.num_kv_heads,
                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,

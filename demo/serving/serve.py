@@ -103,6 +103,10 @@ def main(argv=None):
     p.add_argument("--embed-dim", type=int, default=512)
     p.add_argument("--num-layers", type=int, default=8)
     p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--num-kv-heads", type=int, default=0,
+                   help="grouped-query attention: K/V heads (must "
+                        "divide --num-heads); shrinks the KV cache "
+                        "by H/Hkv, multiplying with int8. 0 = MHA")
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--num-experts", type=int, default=8,
                    help="MoE expert count (--model moe)")
@@ -143,6 +147,7 @@ def main(argv=None):
         lm_kwargs = dict(
             vocab_size=args.vocab_size, embed_dim=args.embed_dim,
             num_layers=args.num_layers, num_heads=args.num_heads,
+            num_kv_heads=args.num_kv_heads or None,
             max_seq_len=args.max_seq_len,
             kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
                             else args.kv_cache_dtype))

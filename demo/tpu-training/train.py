@@ -107,6 +107,9 @@ def parse_args(argv=None):
     p.add_argument("--embed-dim", type=int, default=512)
     p.add_argument("--num-layers", type=int, default=8)
     p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--num-kv-heads", type=int, default=0,
+                   help="grouped-query attention for the LM models "
+                        "(0 = MHA)")
     p.add_argument("--num-experts", type=int, default=8,
                    help="MoE expert count")
     p.add_argument("--expert-parallelism", type=int, default=1,
@@ -308,6 +311,7 @@ def build_lm(args, mesh):
             batch_axis=DATA_AXIS)
     common = dict(vocab_size=args.vocab_size, embed_dim=args.embed_dim,
                   num_layers=args.num_layers, num_heads=args.num_heads,
+                  num_kv_heads=args.num_kv_heads or None,
                   max_seq_len=args.seq_len, attention_fn=attention_fn)
     if args.model == "moe":
         model = MoETransformerLM(

@@ -307,3 +307,60 @@ def test_moe_greedy_matches_dense_forward():
     seq = greedy_decode(model, params, tokens, N)
     assert seq.shape == (B, P + N)
     _check_greedy_consistency(model, params, seq, P)
+
+
+def test_gqa_decode_matches_dense_forward():
+    """Grouped-query attention (num_kv_heads < num_heads): greedy
+    decode must stay argmax-consistent with the model's own dense
+    forward, the KV cache must actually shrink to Hkv heads, and the
+    one-shot prefill path must agree with stepwise decode."""
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, num_kv_heads=2,
+                          max_seq_len=MAXLEN, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    # GQA uses split q / kv projections instead of the fused qkv.
+    attn0 = params["block0"]["attn"]
+    assert "q" in attn0 and "kv" in attn0 and "qkv" not in attn0
+
+    seq = greedy_decode(model, params, tokens, N)
+    _check_greedy_consistency(model, params, seq, P)
+
+    from container_engine_accelerators_tpu.models.decode import (
+        init_cache,
+    )
+    _, cache = init_cache(model, B, MAXLEN)
+    assert cache["block0"]["attn"]["cached_key"].shape == (
+        B, MAXLEN, 2, E // H)
+
+    fast = decode(model, params, tokens, N, fast_prefill=True)
+    step = decode(model, params, tokens, N, fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(step))
+
+
+def test_gqa_int8_cache_matches_f32_greedy():
+    model_kwargs = dict(vocab_size=V, embed_dim=E, num_layers=L,
+                        num_heads=H, num_kv_heads=2,
+                        max_seq_len=MAXLEN, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, P), 0, V)
+    base = TransformerLM(**model_kwargs)
+    params = base.init(jax.random.PRNGKey(1), tokens)["params"]
+    want = greedy_decode(base, params, tokens, N)
+    got = greedy_decode(TransformerLM(kv_cache_dtype="int8",
+                                      **model_kwargs),
+                        params, tokens, N)
+    # int8 quantization perturbs logits; greedy picks usually agree
+    # at these sizes — require exact agreement on the prompt + first
+    # tokens and full shape agreement overall.
+    assert got.shape == want.shape
+    np.testing.assert_array_equal(np.asarray(got[:, :P + 1]),
+                                  np.asarray(want[:, :P + 1]))
+
+
+def test_gqa_rejects_indivisible_heads():
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=1,
+                          num_heads=4, num_kv_heads=3,
+                          max_seq_len=MAXLEN, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="must divide"):
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 4), jnp.int32))
