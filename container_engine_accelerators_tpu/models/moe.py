@@ -137,6 +137,7 @@ class MoEBlock(nn.Module):
     decode: bool = False
     kv_cache_dtype: Any = None
     num_kv_heads: Any = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -146,6 +147,7 @@ class MoEBlock(nn.Module):
                                 decode=self.decode, mesh=self.mesh,
                                 kv_cache_dtype=self.kv_cache_dtype,
                                 num_kv_heads=self.num_kv_heads,
+                                rope=self.rope,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -179,10 +181,15 @@ class MoETransformerLM(nn.Module):
     decode: bool = False
     kv_cache_dtype: Any = None
     num_kv_heads: Any = None
+    pos_embedding: str = "learned"
 
     @nn.compact
     def __call__(self, tokens, train=True):
         del train
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embedding must be 'learned' or 'rope': "
+                f"{self.pos_embedding!r}")
         attention_fn = self.attention_fn or flash_attention
         s = tokens.shape[1]
         if s > self.max_seq_len:
@@ -191,10 +198,12 @@ class MoETransformerLM(nn.Module):
                 f"{self.max_seq_len}")
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype, name="tok_embed")(tokens)
-        pos = cached_positions(self, s, self.decode)
-        pos = nn.Embed(self.max_seq_len, self.embed_dim,
-                       dtype=self.dtype, name="pos_embed")(pos)
-        x = residual_constraint(x + pos[None], self.mesh)
+        if self.pos_embedding == "learned":
+            pos = cached_positions(self, s, self.decode)
+            pos = nn.Embed(self.max_seq_len, self.embed_dim,
+                           dtype=self.dtype, name="pos_embed")(pos)
+            x = x + pos[None]
+        x = residual_constraint(x, self.mesh)
         aux_losses = []
         for i in range(self.num_layers):
             if i % 2 == 1:
@@ -207,6 +216,7 @@ class MoETransformerLM(nn.Module):
                     mesh=self.mesh, decode=self.decode,
                     kv_cache_dtype=self.kv_cache_dtype,
                     num_kv_heads=self.num_kv_heads,
+                    rope=self.pos_embedding == "rope",
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -216,6 +226,7 @@ class MoETransformerLM(nn.Module):
                           decode=self.decode, mesh=self.mesh,
                           kv_cache_dtype=self.kv_cache_dtype,
                           num_kv_heads=self.num_kv_heads,
+                          rope=self.pos_embedding == "rope",
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -64,6 +64,27 @@ def _quantize_rows_int8(x):
     return q, scale
 
 
+def apply_rope(x, positions, base=10000.0):
+    """Rotary position embedding. x: [B, S, H, D]; positions: [S]
+    int32 (global sequence positions of the S axis).
+
+    Pairs dimension i with i + D/2 (the split layout); attention
+    scores then depend only on relative positions, so there is no
+    learned position table to outgrow — the property long-context
+    scaling wants. Keys are rotated before caching, which keeps the
+    decode step an ordinary dot product against the cache.
+    """
+    d2 = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
+    angles = positions.astype(jnp.float32)[:, None] * freqs  # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :d2], x[..., d2:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin,
+                               x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
 def _expand_kv(x, heads):
     """[B, S, Hkv, D] -> [B, S, H, D] by repeating each KV head over
     its query group (no-op for MHA). The repeat only exists at
@@ -105,6 +126,9 @@ class CausalSelfAttention(nn.Module):
     # factor, multiplying with the int8 option. None = MHA, which
     # keeps the fused qkv parameter layout (checkpoint-compatible).
     num_kv_heads: Any = None
+    # Rotary position embedding on q/k (the LM skips its learned
+    # position table when set). Keys are rotated before caching.
+    rope: bool = False
 
     def _kv_heads(self):
         kv = self.num_kv_heads or self.num_heads
@@ -134,6 +158,9 @@ class CausalSelfAttention(nn.Module):
         if self.decode:
             attn = self._cached_attention(q, k, v)
         else:
+            if self.rope:
+                pos = jnp.arange(q.shape[1], dtype=jnp.int32)
+                q, k = apply_rope(q, pos), apply_rope(k, pos)
             attn = self.attention_fn(q, _expand_kv(k, heads),
                                      _expand_kv(v, heads), causal=True)
         attn = attn.reshape(x.shape)
@@ -185,10 +212,19 @@ class CausalSelfAttention(nn.Module):
             # scores — at 32k that is the difference between init
             # working and OOM. The flash kernel keeps it O(S*block).
             heads = q.shape[2]
+            if self.rope:
+                pos = jnp.arange(q.shape[1], dtype=jnp.int32)
+                q, k = apply_rope(q, pos), apply_rope(k, pos)
             return flash_attention(q, _expand_kv(k, heads),
                                    _expand_kv(v, heads), causal=True)
 
         i = index.value
+        if self.rope:
+            # Rotate at the tokens' global positions before the cache
+            # write: the cache then holds rotated keys and the step
+            # stays an ordinary dot product against it.
+            pos = i + jnp.arange(q.shape[1], dtype=jnp.int32)
+            q, k = apply_rope(q, pos), apply_rope(k, pos)
         if quantized:
             kq, ks = _quantize_rows_int8(k)
             vq, vs = _quantize_rows_int8(v)
@@ -268,6 +304,7 @@ class Block(nn.Module):
     mesh: Any = None
     kv_cache_dtype: Any = None
     num_kv_heads: Any = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -278,6 +315,7 @@ class Block(nn.Module):
                                 decode=self.decode, mesh=self.mesh,
                                 kv_cache_dtype=self.kv_cache_dtype,
                                 num_kv_heads=self.num_kv_heads,
+                                rope=self.rope,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
@@ -301,10 +339,17 @@ class TransformerLM(nn.Module):
     mesh: Any = None
     kv_cache_dtype: Any = None
     num_kv_heads: Any = None
+    # "learned" adds a max_seq_len position table at the input;
+    # "rope" rotates q/k per layer instead (no table to outgrow).
+    pos_embedding: str = "learned"
 
     @nn.compact
     def __call__(self, tokens, train=True):
         del train  # no dropout; signature matches the zoo contract
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embedding must be 'learned' or 'rope': "
+                f"{self.pos_embedding!r}")
         attention_fn = self.attention_fn or flash_attention
         s = tokens.shape[1]
         if s > self.max_seq_len:
@@ -315,10 +360,12 @@ class TransformerLM(nn.Module):
                 f"{self.max_seq_len}")
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype, name="tok_embed")(tokens)
-        pos = cached_positions(self, s, self.decode)
-        pos = nn.Embed(self.max_seq_len, self.embed_dim,
-                       dtype=self.dtype, name="pos_embed")(pos)
-        x = residual_constraint(x + pos[None], self.mesh)
+        if self.pos_embedding == "learned":
+            pos = cached_positions(self, s, self.decode)
+            pos = nn.Embed(self.max_seq_len, self.embed_dim,
+                           dtype=self.dtype, name="pos_embed")(pos)
+            x = x + pos[None]
+        x = residual_constraint(x, self.mesh)
         for i in range(self.num_layers):
             x = Block(num_heads=self.num_heads,
                       mlp_ratio=self.mlp_ratio, dtype=self.dtype,
@@ -326,6 +373,7 @@ class TransformerLM(nn.Module):
                       mesh=self.mesh,
                       kv_cache_dtype=self.kv_cache_dtype,
                       num_kv_heads=self.num_kv_heads,
+                      rope=self.pos_embedding == "rope",
                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,

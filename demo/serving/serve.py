@@ -107,6 +107,10 @@ def main(argv=None):
                    help="grouped-query attention: K/V heads (must "
                         "divide --num-heads); shrinks the KV cache "
                         "by H/Hkv, multiplying with int8. 0 = MHA")
+    p.add_argument("--pos-embedding", choices=["learned", "rope"],
+                   default="learned",
+                   help="rope rotates q/k per layer (no learned "
+                        "position table to outgrow)")
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--num-experts", type=int, default=8,
                    help="MoE expert count (--model moe)")
@@ -148,6 +152,7 @@ def main(argv=None):
             vocab_size=args.vocab_size, embed_dim=args.embed_dim,
             num_layers=args.num_layers, num_heads=args.num_heads,
             num_kv_heads=args.num_kv_heads or None,
+            pos_embedding=args.pos_embedding,
             max_seq_len=args.max_seq_len,
             kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
                             else args.kv_cache_dtype))

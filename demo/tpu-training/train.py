@@ -110,6 +110,10 @@ def parse_args(argv=None):
     p.add_argument("--num-kv-heads", type=int, default=0,
                    help="grouped-query attention for the LM models "
                         "(0 = MHA)")
+    p.add_argument("--pos-embedding", choices=["learned", "rope"],
+                   default="learned",
+                   help="LM position encoding (rope = rotary q/k, "
+                        "no learned table)")
     p.add_argument("--num-experts", type=int, default=8,
                    help="MoE expert count")
     p.add_argument("--expert-parallelism", type=int, default=1,
@@ -312,6 +316,7 @@ def build_lm(args, mesh):
     common = dict(vocab_size=args.vocab_size, embed_dim=args.embed_dim,
                   num_layers=args.num_layers, num_heads=args.num_heads,
                   num_kv_heads=args.num_kv_heads or None,
+                  pos_embedding=args.pos_embedding,
                   max_seq_len=args.seq_len, attention_fn=attention_fn)
     if args.model == "moe":
         model = MoETransformerLM(

@@ -364,3 +364,65 @@ def test_gqa_rejects_indivisible_heads():
     with pytest.raises(ValueError, match="must divide"):
         model.init(jax.random.PRNGKey(0),
                    jnp.zeros((1, 4), jnp.int32))
+
+
+def test_rope_decode_matches_dense_forward():
+    """RoPE position encoding: decode must stay argmax-consistent
+    with the dense forward (the cache holds rotated keys, so the
+    step is an ordinary dot product), one-shot prefill must agree
+    with stepwise, and there must be no learned position table."""
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, pos_embedding="rope",
+                          max_seq_len=MAXLEN, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    assert "pos_embed" not in params
+
+    seq = greedy_decode(model, params, tokens, N)
+    _check_greedy_consistency(model, params, seq, P)
+
+    fast = decode(model, params, tokens, N, fast_prefill=True)
+    step = decode(model, params, tokens, N, fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(step))
+
+
+def test_rope_relative_shift_property():
+    """RoPE scores depend only on relative position: rotating q/k at
+    positions p and p + delta gives identical attention weights."""
+    from container_engine_accelerators_tpu.models.transformer import (
+        apply_rope,
+    )
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 2)
+    q = jax.random.normal(ks[0], (1, 6, 2, 8))
+    k = jax.random.normal(ks[1], (1, 6, 2, 8))
+
+    def scores(offset):
+        pos = offset + jnp.arange(6, dtype=jnp.int32)
+        qr, kr = apply_rope(q, pos), apply_rope(k, pos)
+        return jnp.einsum("bqhd,bkhd->bhqk", qr, kr)
+
+    np.testing.assert_allclose(np.asarray(scores(0)),
+                               np.asarray(scores(11)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rope_gqa_int8_compose():
+    """All three LM options together: rope + GQA + int8 cache."""
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, num_kv_heads=2,
+                          pos_embedding="rope", kv_cache_dtype="int8",
+                          max_seq_len=MAXLEN, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    seq = greedy_decode(model, params, tokens, N)
+    assert seq.shape == (B, P + N)
+    assert np.asarray(seq).min() >= 0 and np.asarray(seq).max() < V
+
+
+def test_bad_pos_embedding_rejected():
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=1,
+                          num_heads=2, pos_embedding="alibi",
+                          max_seq_len=MAXLEN)
+    with pytest.raises(ValueError, match="pos_embedding"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
