@@ -474,7 +474,13 @@ def main(argv=None):
                  max(args.steps - args.lr_warmup_steps, 1))],
             [args.lr_warmup_steps])
     tx = optax.chain(
-        optax.add_decayed_weights(args.weight_decay),
+        # Decay kernels only: biases and norm scales (ndim < 2) pull
+        # toward zero under decay with no regularization benefit —
+        # the standard mask.
+        optax.add_decayed_weights(
+            args.weight_decay,
+            mask=lambda params: jax.tree_util.tree_map(
+                lambda p: getattr(p, "ndim", 0) >= 2, params)),
         optax.sgd(lr, momentum=args.momentum),
     )
     augment_fn = None

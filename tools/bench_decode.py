@@ -58,6 +58,10 @@ def main(argv=None):
     p.add_argument("--num-layers", type=int, default=8)
     p.add_argument("--num-heads", type=int, default=8)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--num-kv-heads", type=int, default=0,
+                   help="grouped-query attention (0 = MHA)")
+    p.add_argument("--pos-embedding",
+                   choices=["learned", "rope"], default="learned")
     p.add_argument("--kv-cache-dtype", choices=["bfloat16", "int8"],
                    default="bfloat16")
     args = p.parse_args(argv)
@@ -68,6 +72,8 @@ def main(argv=None):
     model = TransformerLM(
         vocab_size=args.vocab_size, embed_dim=args.embed_dim,
         num_layers=args.num_layers, num_heads=args.num_heads,
+        num_kv_heads=args.num_kv_heads or None,
+        pos_embedding=args.pos_embedding,
         max_seq_len=args.prompt_len + args.new_tokens,
         kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
                         else args.kv_cache_dtype))
@@ -98,6 +104,8 @@ def main(argv=None):
             "layers": args.num_layers,
             "embed_dim": args.embed_dim,
             "kv_cache_dtype": args.kv_cache_dtype,
+            "num_kv_heads": args.num_kv_heads or args.num_heads,
+            "pos_embedding": args.pos_embedding,
             "platform": jax.devices()[0].platform,
             "sec_per_call": round(sec, 4),
             "decode_tokens_per_sec": round(tokens / sec, 1),
