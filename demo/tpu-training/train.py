@@ -350,11 +350,12 @@ def build_model(args):
 
 
 def evaluate(trainer, state, loader, args):
-    """Top-1 accuracy over --eval-batches through the compiled eval
-    step (next-token accuracy for the LM families)."""
+    """Top-1 and top-5 accuracy over --eval-batches through the
+    compiled eval step (next-token accuracy for the LM families).
+    Returns (top1, top5)."""
     import numpy as np
 
-    correct, total = 0, 0
+    correct, correct5, total = 0, 0, 0
     for _, batch in zip(range(args.eval_batches), loader):
         inputs, labels = batch
         logits = trainer.eval_step(state, inputs)
@@ -363,12 +364,16 @@ def evaluate(trainer, state, loader, args):
         logits = np.asarray(logits)
         labels = np.asarray(labels)
         if args.model in LM_MODELS:
-            pred, want = logits[:, :-1].argmax(-1), labels[:, 1:]
+            logits, want = logits[:, :-1], labels[:, 1:]
         else:
-            pred, want = logits.argmax(-1), labels
+            want = labels
+        pred = logits.argmax(-1)
+        k = min(5, logits.shape[-1])
+        top5 = np.argpartition(logits, -k, axis=-1)[..., -k:]
         correct += int((pred == want).sum())
+        correct5 += int((top5 == want[..., None]).any(-1).sum())
         total += want.size
-    return correct / max(total, 1)
+    return correct / max(total, 1), correct5 / max(total, 1)
 
 
 def main(argv=None):
@@ -575,10 +580,11 @@ def main(argv=None):
         result["tokens_per_sec"] = round(
             images_per_sec * args.seq_len, 2)
     if args.eval_batches:
-        result["eval_accuracy"] = round(evaluate(
-            trainer, state, loader, args), 4)
-        print(f"eval accuracy {result['eval_accuracy']}",
-              file=sys.stderr)
+        top1, top5 = evaluate(trainer, state, loader, args)
+        result["eval_accuracy"] = round(top1, 4)
+        result["eval_top5_accuracy"] = round(top5, 4)
+        print(f"eval accuracy top1 {result['eval_accuracy']} "
+              f"top5 {result['eval_top5_accuracy']}", file=sys.stderr)
     if args.model_dir:
         save_checkpoint(args.model_dir, state)
         finalize_checkpoints()
