@@ -138,6 +138,17 @@ class _BaseServer:
                                       "model": server._name})
                 elif self.path == "/stats":
                     self._reply(200, server.stats())
+                elif self.path == f"/v1/models/{server._name}":
+                    # TF-Serving model-status shape (the reference's
+                    # serving demo queries this on its containers).
+                    self._reply(200, {
+                        "model_version_status": [{
+                            "version": "1",
+                            "state": "AVAILABLE",
+                            "status": {"error_code": "OK",
+                                       "error_message": ""},
+                            "metadata": server._model_metadata(),
+                        }]})
                 else:
                     self._reply(404, {"error": "not found"})
 
@@ -171,6 +182,11 @@ class _BaseServer:
 
     def _handle_post(self, payload):
         raise NotImplementedError
+
+    def _model_metadata(self):
+        """Subclass hook: shape/config facts for the model-status
+        endpoint."""
+        return {}
 
     @property
     def port(self):
@@ -244,6 +260,11 @@ class InferenceServer(_BaseServer):
 
     def _post_path(self):
         return f"/v1/models/{self._name}:predict"
+
+    def _model_metadata(self):
+        return {"kind": "predict",
+                "input_shape": list(self._input_shape),
+                "max_batch": self._max_batch}
 
     def _handle_post(self, payload):
         try:
@@ -349,6 +370,14 @@ class GenerationServer(_BaseServer):
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"
+
+    def _model_metadata(self):
+        return {"kind": "generate",
+                "vocab_size": self._model.vocab_size,
+                "max_prompt_len": self._buckets[-1],
+                "prompt_buckets": self._buckets,
+                "max_new_tokens": self._max_new,
+                "max_batch": self._max_batch}
 
     def _run(self, instances, pad_temp, top_k=0):
         """Decode a micro-batch of (row, temperature, prompt_len,

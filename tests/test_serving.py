@@ -356,3 +356,21 @@ def test_generate_tensor_parallel_params():
         assert out["sequences"][0] == want[0, :10].tolist()
     finally:
         srv.stop()
+
+
+def test_model_status_endpoint(lm_server):
+    """GET /v1/models/<name> — TF-Serving model-status parity, with
+    the generation limits a client needs to shape requests."""
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://localhost:{lm_server.port}/v1/models/lm",
+            timeout=30) as resp:
+        out = json.loads(resp.read())
+    status = out["model_version_status"][0]
+    assert status["state"] == "AVAILABLE"
+    meta = status["metadata"]
+    assert meta["kind"] == "generate"
+    assert meta["vocab_size"] == 64
+    assert meta["max_batch"] == 4
+    assert meta["prompt_buckets"] == sorted(meta["prompt_buckets"])
