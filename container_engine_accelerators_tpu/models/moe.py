@@ -138,6 +138,7 @@ class MoEBlock(nn.Module):
     kv_cache_dtype: Any = None
     num_kv_heads: Any = None
     rope: bool = False
+    window: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -148,6 +149,7 @@ class MoEBlock(nn.Module):
                                 kv_cache_dtype=self.kv_cache_dtype,
                                 num_kv_heads=self.num_kv_heads,
                                 rope=self.rope,
+                                window=self.window,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -182,6 +184,7 @@ class MoETransformerLM(nn.Module):
     kv_cache_dtype: Any = None
     num_kv_heads: Any = None
     pos_embedding: str = "learned"
+    attention_window: int = 0
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -217,6 +220,7 @@ class MoETransformerLM(nn.Module):
                     kv_cache_dtype=self.kv_cache_dtype,
                     num_kv_heads=self.num_kv_heads,
                     rope=self.pos_embedding == "rope",
+                    window=self.attention_window,
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -227,6 +231,7 @@ class MoETransformerLM(nn.Module):
                           kv_cache_dtype=self.kv_cache_dtype,
                           num_kv_heads=self.num_kv_heads,
                           rope=self.pos_embedding == "rope",
+                          window=self.attention_window,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

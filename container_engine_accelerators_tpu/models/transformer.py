@@ -129,6 +129,9 @@ class CausalSelfAttention(nn.Module):
     # Rotary position embedding on q/k (the LM skips its learned
     # position table when set). Keys are rotated before caching.
     rope: bool = False
+    # Sliding-window attention: query p sees keys in (p - W, p].
+    # Only the flash kernel path supports it (0 = full causal).
+    window: int = 0
 
     def _kv_heads(self):
         kv = self.num_kv_heads or self.num_heads
@@ -155,14 +158,25 @@ class CausalSelfAttention(nn.Module):
             kv = nn.DenseGeneral((2, kv_heads, d), dtype=self.dtype,
                                  name="kv")(h)
             k, v = kv[:, :, 0], kv[:, :, 1]  # [B, S, Hkv, D]
+        if self.window and self.attention_fn is not flash_attention:
+            raise ValueError(
+                "window (sliding-window attention) requires the "
+                "flash_attention path; ring/Ulysses/dense schedules "
+                "do not take a window")
         if self.decode:
             attn = self._cached_attention(q, k, v)
         else:
             if self.rope:
                 pos = jnp.arange(q.shape[1], dtype=jnp.int32)
                 q, k = apply_rope(q, pos), apply_rope(k, pos)
-            attn = self.attention_fn(q, _expand_kv(k, heads),
-                                     _expand_kv(v, heads), causal=True)
+            if self.window:
+                attn = self.attention_fn(
+                    q, _expand_kv(k, heads), _expand_kv(v, heads),
+                    causal=True, window=self.window)
+            else:
+                attn = self.attention_fn(
+                    q, _expand_kv(k, heads), _expand_kv(v, heads),
+                    causal=True)
         attn = attn.reshape(x.shape)
         out = x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
                                   name="proj")(attn)
@@ -216,7 +230,8 @@ class CausalSelfAttention(nn.Module):
                 pos = jnp.arange(q.shape[1], dtype=jnp.int32)
                 q, k = apply_rope(q, pos), apply_rope(k, pos)
             return flash_attention(q, _expand_kv(k, heads),
-                                   _expand_kv(v, heads), causal=True)
+                                   _expand_kv(v, heads), causal=True,
+                                   window=self.window or None)
 
         i = index.value
         if self.rope:
@@ -255,7 +270,8 @@ class CausalSelfAttention(nn.Module):
             # int8 round-trip for the prefill tokens' own scores.
             heads = q.shape[2]
             return flash_attention(q, _expand_kv(k, heads),
-                                   _expand_kv(v, heads), causal=True)
+                                   _expand_kv(v, heads), causal=True,
+                                   window=self.window or None)
 
         b, q_len, heads, d = q.shape
         kv_heads = k.shape[2]
@@ -283,7 +299,10 @@ class CausalSelfAttention(nn.Module):
             jnp.int32, scores.shape, dimension=4)
         q_pos = i + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=3)
-        scores = jnp.where(k_pos <= q_pos, scores, -1e9)
+        keep = k_pos <= q_pos
+        if self.window:
+            keep &= k_pos > q_pos - self.window
+        scores = jnp.where(keep, scores, -1e9)
         probs = jax.nn.softmax(scores, axis=-1)
         if quantized:
             probs = probs * jnp.transpose(
@@ -305,6 +324,7 @@ class Block(nn.Module):
     kv_cache_dtype: Any = None
     num_kv_heads: Any = None
     rope: bool = False
+    window: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -316,6 +336,7 @@ class Block(nn.Module):
                                 kv_cache_dtype=self.kv_cache_dtype,
                                 num_kv_heads=self.num_kv_heads,
                                 rope=self.rope,
+                                window=self.window,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
@@ -342,6 +363,8 @@ class TransformerLM(nn.Module):
     # "learned" adds a max_seq_len position table at the input;
     # "rope" rotates q/k per layer instead (no table to outgrow).
     pos_embedding: str = "learned"
+    # Sliding-window attention width (0 = full causal); flash path.
+    attention_window: int = 0
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -374,6 +397,7 @@ class TransformerLM(nn.Module):
                       kv_cache_dtype=self.kv_cache_dtype,
                       num_kv_heads=self.num_kv_heads,
                       rope=self.pos_embedding == "rope",
+                      window=self.attention_window,
                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,

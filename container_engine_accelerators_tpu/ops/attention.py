@@ -54,8 +54,13 @@ def _positions(offset, rows, cols, axis):
     return offset + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), axis)
 
 
-def _masked_scores(q, k, q_off, k_off, s_orig, causal, scale):
-    """(BQ, D) x (BK, D) -> masked f32 (BQ, BK) scores."""
+def _masked_scores(q, k, q_off, k_off, s_orig, causal, scale,
+                   window=0):
+    """(BQ, D) x (BK, D) -> masked f32 (BQ, BK) scores.
+
+    window > 0 (requires causal): query at position p sees keys in
+    (p - window, p] — Mistral-style sliding-window attention.
+    """
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -63,7 +68,10 @@ def _masked_scores(q, k, q_off, k_off, s_orig, causal, scale):
     k_pos = _positions(k_off, bq, bk, 1)
     mask = k_pos < s_orig  # padded key rows contribute nothing
     if causal:
-        mask &= _positions(q_off, bq, bk, 0) >= k_pos
+        q_pos = _positions(q_off, bq, bk, 0)
+        mask &= q_pos >= k_pos
+        if window:
+            mask &= k_pos > q_pos - window
     return jnp.where(mask, s, _NEG)
 
 
@@ -74,9 +82,10 @@ def _masked_scores(q, k, q_off, k_off, s_orig, causal, scale):
 
 
 def _fwd_step(q, k, v, m, num, den, q_off, k_off, s_orig, causal,
-              scale):
+              scale, window=0):
     """One online-softmax accumulation step. All operands f32."""
-    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale)
+    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale,
+                       window)
     block_max = jnp.max(s, axis=-1, keepdims=True)
     new_m = jnp.maximum(m, block_max)
     corr = jnp.exp(m - new_m)
@@ -86,7 +95,7 @@ def _fwd_step(q, k, v, m, num, den, q_off, k_off, s_orig, causal,
 
 
 def _dq_step(q, k, v, do, lse, delta, q_off, k_off, s_orig, causal,
-             scale):
+             scale, window=0):
     """One dQ accumulation term: ds @ k for one K/V tile.
 
     ``delta`` is the *effective* per-row term sum(do*o) - g_lse: the
@@ -94,7 +103,8 @@ def _dq_step(q, k, v, do, lse, delta, q_off, k_off, s_orig, causal,
     ds_ij += g_lse_i * p_ij (d lse_i / d s_ij = p_ij), which folds
     into the same subtraction.
     """
-    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale)
+    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale,
+                       window)
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
@@ -104,10 +114,11 @@ def _dq_step(q, k, v, do, lse, delta, q_off, k_off, s_orig, causal,
 
 
 def _dkv_step(q, k, v, do, lse, delta, dk, dv, q_off, k_off, s_orig,
-              causal, scale):
+              causal, scale, window=0):
     """Accumulate one Q/dO tile's contribution into (dk, dv).
     ``delta`` as in _dq_step (effective: sum(do*o) - g_lse)."""
-    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale)
+    s = _masked_scores(q, k, q_off, k_off, s_orig, causal, scale,
+                       window)
     p = jnp.exp(s - lse)  # (BQ, BK)
     dv = dv + jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())),
@@ -123,7 +134,7 @@ def _dkv_step(q, k, v, do, lse, delta, dk, dv, q_off, k_off, s_orig,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, s_orig,
-                scale, block):
+                scale, block, window=0):
     q = q_ref[0].astype(jnp.float32)
     iq = pl.program_id(1)
     bq = q.shape[0]
@@ -134,7 +145,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, s_orig,
         k = k_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
         return _fwd_step(q, k, v, m, num, den, iq * bq, j * block,
-                         s_orig, causal, scale)
+                         s_orig, causal, scale, window)
 
     d = q.shape[1]
     init = (jnp.full((bq, 1), _NEG, jnp.float32),
@@ -143,14 +154,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, s_orig,
     # Causal: K blocks strictly after this Q block are fully masked;
     # don't visit them (block tiles are square, so block iq needs
     # exactly iq+1 K blocks). Dynamic bound lowers to while_loop.
+    # Sliding window additionally skips K blocks entirely below the
+    # window of this Q block's first row.
     upper = jnp.minimum(iq + 1, n_k) if causal else n_k
-    m, num, den = jax.lax.fori_loop(0, upper, body, init)
+    lower = (jnp.maximum(0, (iq * block - window + 1) // block)
+             if causal and window else 0)
+    m, num, den = jax.lax.fori_loop(lower, upper, body, init)
     o_ref[0] = (num / den).astype(o_ref.dtype)
     lse_ref[...] = (m + jnp.log(den)).reshape(1, bq, 1)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               *, causal, s_orig, scale, block):
+               *, causal, s_orig, scale, block, window=0):
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[...].reshape(-1, 1)
@@ -163,16 +178,19 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * block, block), :].astype(jnp.float32)
         return dq + _dq_step(q, k, v, do, lse, delta, iq * bq,
-                             j * block, s_orig, causal, scale)
+                             j * block, s_orig, causal, scale, window)
 
     upper = jnp.minimum(iq + 1, n_k) if causal else n_k
-    dq = jax.lax.fori_loop(0, upper, body,
+    lower = (jnp.maximum(0, (iq * block - window + 1) // block)
+             if causal and window else 0)
+    dq = jax.lax.fori_loop(lower, upper, body,
                            jnp.zeros_like(q, jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, causal, s_orig, scale, block):
+                dk_ref, dv_ref, *, causal, s_orig, scale, block,
+                window=0):
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     jk = pl.program_id(1)
@@ -186,12 +204,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, pl.ds(i * block, block), :]
         delta = delta_ref[0, pl.ds(i * block, block), :]
         return _dkv_step(q, k, v, do, lse, delta, dk, dv, i * block,
-                         jk * bk, s_orig, causal, scale)
+                         jk * bk, s_orig, causal, scale, window)
 
     # Causal: Q blocks strictly before this K block see none of it.
+    # Sliding window: Q blocks whose first row is already past this
+    # K block's last key + window contribute nothing either.
     lower = jk if causal else 0
+    upper = (jnp.minimum(n_q, ((jk + 1) * block + window - 2)
+                         // block + 1)
+             if causal and window else n_q)
     dk, dv = jax.lax.fori_loop(
-        lower, n_q, body,
+        lower, upper, body,
         (jnp.zeros_like(k, jnp.float32), jnp.zeros_like(v, jnp.float32)))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -213,7 +236,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref,
                        m_scr, num_scr, den_scr, *, causal, s_orig,
-                       scale, block):
+                       scale, block, window=0):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -227,6 +250,10 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref,
     run = ik * block < s_orig  # fully-padded K tiles contribute nothing
     if causal:
         run = jnp.logical_and(run, iq >= ik)
+        if window:
+            # K tiles entirely below the Q tile's window: skip.
+            run = jnp.logical_and(
+                run, (ik + 1) * block - 1 >= iq * block - window + 1)
 
     @pl.when(run)
     def _step():
@@ -235,7 +262,7 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref,
         v = v_ref[0].astype(jnp.float32)
         m, num, den = _fwd_step(
             q, k, v, m_scr[...], num_scr[...], den_scr[...],
-            iq * block, ik * block, s_orig, causal, scale)
+            iq * block, ik * block, s_orig, causal, scale, window)
         m_scr[...] = m
         num_scr[...] = num
         den_scr[...] = den
@@ -248,7 +275,8 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dq_scr, *, causal, s_orig, scale, block):
+                      dq_ref, dq_scr, *, causal, s_orig, scale, block,
+                      window=0):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -260,6 +288,9 @@ def _dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     run = ik * block < s_orig
     if causal:
         run = jnp.logical_and(run, iq >= ik)
+        if window:
+            run = jnp.logical_and(
+                run, (ik + 1) * block - 1 >= iq * block - window + 1)
 
     @pl.when(run)
     def _step():
@@ -271,7 +302,7 @@ def _dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[...].reshape(-1, 1)
         dq_scr[...] = dq_scr[...] + _dq_step(
             q, k, v, do, lse, delta, iq * block, ik * block, s_orig,
-            causal, scale)
+            causal, scale, window)
 
     @pl.when(ik == n_k - 1)
     def _emit():
@@ -280,7 +311,7 @@ def _dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        dk_ref, dv_ref, dk_scr, dv_scr, *, causal,
-                       s_orig, scale, block):
+                       s_orig, scale, block, window=0):
     ikb = pl.program_id(1)
     iqb = pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -294,6 +325,10 @@ def _dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     run = iqb * block < s_orig
     if causal:
         run = jnp.logical_and(run, iqb >= ikb)
+        if window:
+            # Q tiles entirely past this K tile's window: skip.
+            run = jnp.logical_and(
+                run, iqb * block <= (ikb + 1) * block - 2 + window)
 
     @pl.when(run)
     def _step():
@@ -305,7 +340,7 @@ def _dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[...].reshape(-1, 1)
         dk, dv = _dkv_step(q, k, v, do, lse, delta, dk_scr[...],
                            dv_scr[...], iqb * block, ikb * block,
-                           s_orig, causal, scale)
+                           s_orig, causal, scale, window)
         dk_scr[...] = dk
         dv_scr[...] = dv
 
@@ -363,7 +398,8 @@ def _use_streaming(sp, d, itemsize, streaming):
     return 4 * sp * d * itemsize > _RESIDENT_VMEM_BUDGET
 
 
-def _flash_fwd(q3, k3, v3, causal, s_orig, block, streaming=None):
+def _flash_fwd(q3, k3, v3, causal, s_orig, block, streaming=None,
+               window=0):
     """q3/k3/v3: [BH, Sp, D] padded. Returns (o3, lse)."""
     bh, sp, d = q3.shape
     scale = 1.0 / math.sqrt(d)
@@ -373,7 +409,8 @@ def _flash_fwd(q3, k3, v3, causal, s_orig, block, streaming=None):
         outer, inner, vec_outer, _ = _stream_specs(d, block)
         return pl.pallas_call(
             functools.partial(_fwd_kernel_stream, causal=causal,
-                              s_orig=s_orig, scale=scale, block=block),
+                              s_orig=s_orig, scale=scale, block=block,
+                              window=window),
             grid=(bh, sp // block, sp // block),
             in_specs=[outer, inner, inner],
             out_specs=[outer, vec_outer],
@@ -388,7 +425,7 @@ def _flash_fwd(q3, k3, v3, causal, s_orig, block, streaming=None):
     tile, full, vec_tile, _ = _specs(sp, d, block)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, s_orig=s_orig,
-                          scale=scale, block=block),
+                          scale=scale, block=block, window=window),
         grid=(bh, sp // block),
         in_specs=[tile, full, full],
         out_specs=[tile, vec_tile],
@@ -398,7 +435,7 @@ def _flash_fwd(q3, k3, v3, causal, s_orig, block, streaming=None):
 
 
 def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
-               streaming=None, glse3=None):
+               streaming=None, glse3=None, window=0):
     bh, sp, d = q3.shape
     scale = 1.0 / math.sqrt(d)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
@@ -412,7 +449,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
         n = sp // block
         dq = pl.pallas_call(
             functools.partial(_dq_kernel_stream, causal=causal,
-                              s_orig=s_orig, scale=scale, block=block),
+                              s_orig=s_orig, scale=scale, block=block,
+                              window=window),
             grid=(bh, n, n),
             in_specs=[outer, inner, inner, outer, vec_outer, vec_outer],
             out_specs=outer,
@@ -424,7 +462,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
         # (axis 2): swap the outer/inner roles of the q-side operands.
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel_stream, causal=causal,
-                              s_orig=s_orig, scale=scale, block=block),
+                              s_orig=s_orig, scale=scale, block=block,
+                              window=window),
             grid=(bh, n, n),
             in_specs=[inner, outer, outer, inner, vec_inner, vec_inner],
             out_specs=[outer, outer],
@@ -438,7 +477,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
     tile, full, vec_tile, vec_full = _specs(sp, d, block)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, s_orig=s_orig,
-                          scale=scale, block=block),
+                          scale=scale, block=block, window=window),
         grid=(bh, sp // block),
         in_specs=[tile, full, full, tile, vec_tile, vec_tile],
         out_specs=tile,
@@ -447,7 +486,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
     )(q3, k3, v3, do3, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, s_orig=s_orig,
-                          scale=scale, block=block),
+                          scale=scale, block=block, window=window),
         grid=(bh, sp // block),
         in_specs=[full, tile, tile, full, vec_full, vec_full],
         out_specs=[tile, tile],
@@ -468,33 +507,35 @@ def _to4d(x3, b, h):
     return x3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block, streaming):
-    o, _ = _flash_vjp_fwd(q, k, v, causal, block, streaming)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block, streaming, window):
+    o, _ = _flash_vjp_fwd(q, k, v, causal, block, streaming, window)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, block, streaming):
+def _flash_vjp_fwd(q, k, v, causal, block, streaming, window):
     b, s, h, d = q.shape
     q3, k3, v3 = (_pad_seq(_to3d(x), block) for x in (q, k, v))
-    o3, lse = _flash_fwd(q3, k3, v3, causal, s, block, streaming)
+    o3, lse = _flash_fwd(q3, k3, v3, causal, s, block, streaming,
+                         window)
     return _to4d(o3, b, h)[:, :s], (q3, k3, v3, o3, lse, b, s, h)
 
 
-def _flash_vjp_bwd(causal, block, streaming, res, g):
+def _flash_vjp_bwd(causal, block, streaming, window, res, g):
     q3, k3, v3, o3, lse, b, s, h = res
     do3 = _pad_seq(_to3d(g), block)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s,
-                               block, streaming)
+                               block, streaming, window=window)
     return tuple(_to4d(x3, b, h)[:, :s] for x3 in (dq3, dk3, dv3))
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_lse(q, k, v, causal, block, streaming):
-    out, _ = _flash_lse_vjp_fwd(q, k, v, causal, block, streaming)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, block, streaming, window):
+    out, _ = _flash_lse_vjp_fwd(q, k, v, causal, block, streaming,
+                                window)
     return out
 
 
@@ -503,15 +544,16 @@ def _lse_to4d(lse, b, s, h):
     return lse.reshape(b, h, -1).transpose(0, 2, 1)[:, :s]
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, block, streaming):
+def _flash_lse_vjp_fwd(q, k, v, causal, block, streaming, window):
     b, s, h, d = q.shape
     q3, k3, v3 = (_pad_seq(_to3d(x), block) for x in (q, k, v))
-    o3, lse = _flash_fwd(q3, k3, v3, causal, s, block, streaming)
+    o3, lse = _flash_fwd(q3, k3, v3, causal, s, block, streaming,
+                         window)
     out = (_to4d(o3, b, h)[:, :s], _lse_to4d(lse, b, s, h))
     return out, (q3, k3, v3, o3, lse, b, s, h)
 
 
-def _flash_lse_vjp_bwd(causal, block, streaming, res, g):
+def _flash_lse_vjp_bwd(causal, block, streaming, window, res, g):
     q3, k3, v3, o3, lse, b, s, h = res
     g_o, g_lse = g
     do3 = _pad_seq(_to3d(g_o), block)
@@ -520,14 +562,16 @@ def _flash_lse_vjp_bwd(causal, block, streaming, res, g):
         g_lse.astype(jnp.float32).transpose(0, 2, 1).reshape(
             b * h, s, 1), block)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s,
-                               block, streaming, glse3=glse3)
+                               block, streaming, glse3=glse3,
+                               window=window)
     return tuple(_to4d(x3, b, h)[:, :s] for x3 in (dq3, dk3, dv3))
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False, block=None, streaming=None):
+def flash_attention(q, k, v, causal=False, block=None, streaming=None,
+                    window=None):
     """Exact attention, O(S) memory. q/k/v: [B, S, H, D].
 
     block: seq-dim VMEM tile for the Q/K loops (multiple of 128);
@@ -543,13 +587,13 @@ def flash_attention(q, k, v, causal=False, block=None, streaming=None):
     working at 16k/32k+ where the resident layout cannot compile.
     True/False force a mode (tests, tuning).
     """
-    causal, block, streaming = _check_args(q, k, v, causal, block,
-                                           streaming)
-    return _flash(q, k, v, causal, block, streaming)
+    causal, block, streaming, window = _check_args(
+        q, k, v, causal, block, streaming, window)
+    return _flash(q, k, v, causal, block, streaming, window)
 
 
 def flash_attention_lse(q, k, v, causal=False, block=None,
-                        streaming=None):
+                        streaming=None, window=None):
     """flash_attention that also returns the per-row logsumexp.
 
     Returns (o [B, S, H, D], lse [B, S, H] f32) where
@@ -560,12 +604,12 @@ def flash_attention_lse(q, k, v, causal=False, block=None,
     ring attention runs this kernel per hop and merges hops by
     logsumexp weighting (parallel/context.py).
     """
-    causal, block, streaming = _check_args(q, k, v, causal, block,
-                                           streaming)
-    return _flash_lse(q, k, v, causal, block, streaming)
+    causal, block, streaming, window = _check_args(
+        q, k, v, causal, block, streaming, window)
+    return _flash_lse(q, k, v, causal, block, streaming, window)
 
 
-def _check_args(q, k, v, causal, block, streaming):
+def _check_args(q, k, v, causal, block, streaming, window=None):
     if not (q.shape == k.shape == v.shape):
         raise ValueError(
             f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
@@ -579,5 +623,10 @@ def _check_args(q, k, v, causal, block, streaming):
     if block < 128 or block % 128:
         raise ValueError(f"block must be a positive multiple of 128: "
                          f"{block}")
+    window = int(window or 0)
+    if window < 0:
+        raise ValueError(f"window must be >= 0: {window}")
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     return (bool(causal), block,
-            None if streaming is None else bool(streaming))
+            None if streaming is None else bool(streaming), window)

@@ -111,6 +111,9 @@ def main(argv=None):
                    default="learned",
                    help="rope rotates q/k per layer (no learned "
                         "position table to outgrow)")
+    p.add_argument("--attention-window", type=int, default=0,
+                   help="sliding-window attention width (0 = full "
+                        "causal)")
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--num-experts", type=int, default=8,
                    help="MoE expert count (--model moe)")
@@ -153,6 +156,7 @@ def main(argv=None):
             num_layers=args.num_layers, num_heads=args.num_heads,
             num_kv_heads=args.num_kv_heads or None,
             pos_embedding=args.pos_embedding,
+            attention_window=args.attention_window,
             max_seq_len=args.max_seq_len,
             kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
                             else args.kv_cache_dtype))

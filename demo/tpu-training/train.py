@@ -114,6 +114,9 @@ def parse_args(argv=None):
                    default="learned",
                    help="LM position encoding (rope = rotary q/k, "
                         "no learned table)")
+    p.add_argument("--attention-window", type=int, default=0,
+                   help="sliding-window attention width for the LM "
+                        "models (0 = full causal; flash path only)")
     p.add_argument("--num-experts", type=int, default=8,
                    help="MoE expert count")
     p.add_argument("--expert-parallelism", type=int, default=1,
@@ -317,6 +320,7 @@ def build_lm(args, mesh):
                   num_layers=args.num_layers, num_heads=args.num_heads,
                   num_kv_heads=args.num_kv_heads or None,
                   pos_embedding=args.pos_embedding,
+                  attention_window=args.attention_window,
                   max_seq_len=args.seq_len, attention_fn=attention_fn)
     if args.model == "moe":
         model = MoETransformerLM(

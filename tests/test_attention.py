@@ -266,3 +266,53 @@ def test_flash_property_sweep():
                     rtol=3e-5, atol=3e-5,
                     err_msg=f"case {(b, s, h, d, block)} "
                             f"causal={causal} streaming={streaming}")
+
+
+@pytest.mark.parametrize("streaming", [False, True])
+def test_sliding_window_matches_masked_dense(streaming):
+    """window=W == dense attention with the (p - W, p] band mask,
+    fwd and bwd, across tile boundaries (W not a block multiple)."""
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q, k, v = (jax.random.normal(kk, (1, 300, 2, 16), jnp.float32)
+               for kk in ks)
+    W = 70
+
+    def dense_window(q, k, v):
+        s = 300
+        scale = 1.0 / np.sqrt(16)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        qp = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        kp = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        mask = (qp >= kp) & (kp > qp - W)
+        scores = jnp.where(mask, scores, -1e9)
+        p = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    want = dense_window(q, k, v)
+    got = flash_attention(q, k, v, causal=True, block=128,
+                          streaming=streaming, window=W)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    want_g = jax.grad(lambda t: jnp.sum(
+        dense_window(t[0], t[1], t[2]) ** 2))((q, k, v))
+    got_g = jax.grad(lambda t: jnp.sum(flash_attention(
+        t[0], t[1], t[2], causal=True, block=128,
+        streaming=streaming, window=W) ** 2))((q, k, v))
+    for g, w in zip(got_g, want_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_sliding_window_validation(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError, match="requires causal"):
+        flash_attention(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match=">= 0"):
+        flash_attention(q, k, v, causal=True, window=-1)
+    # window >= seq is plain causal attention.
+    want = flash_attention(q, k, v, causal=True, block=128)
+    got = flash_attention(q, k, v, causal=True, block=128,
+                          window=10 ** 6)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

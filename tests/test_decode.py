@@ -426,3 +426,19 @@ def test_bad_pos_embedding_rejected():
                           max_seq_len=MAXLEN)
     with pytest.raises(ValueError, match="pos_embedding"):
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_sliding_window_decode_matches_dense_forward():
+    """attention_window: decode (windowed cache mask) must stay
+    argmax-consistent with the model's own dense forward (windowed
+    flash), and fast prefill must agree with stepwise."""
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, attention_window=6,
+                          max_seq_len=MAXLEN, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    seq = greedy_decode(model, params, tokens, N)
+    _check_greedy_consistency(model, params, seq, P)
+    fast = decode(model, params, tokens, N, fast_prefill=True)
+    step = decode(model, params, tokens, N, fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(step))
