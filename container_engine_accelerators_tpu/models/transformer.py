@@ -74,6 +74,10 @@ def apply_rope(x, positions, base=10000.0):
     scaling wants. Keys are rotated before caching, which keeps the
     decode step an ordinary dot product against the cache.
     """
+    if x.shape[-1] % 2:
+        raise ValueError(
+            f"rope needs an even head dim, got {x.shape[-1]} "
+            f"(embed_dim must be divisible by 2*num_heads)")
     d2 = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
     angles = positions.astype(jnp.float32)[:, None] * freqs  # [S, D/2]

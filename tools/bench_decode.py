@@ -62,6 +62,8 @@ def main(argv=None):
                    help="grouped-query attention (0 = MHA)")
     p.add_argument("--pos-embedding",
                    choices=["learned", "rope"], default="learned")
+    p.add_argument("--attention-window", type=int, default=0,
+                   help="sliding-window attention width (0 = full)")
     p.add_argument("--kv-cache-dtype", choices=["bfloat16", "int8"],
                    default="bfloat16")
     args = p.parse_args(argv)
@@ -74,6 +76,7 @@ def main(argv=None):
         num_layers=args.num_layers, num_heads=args.num_heads,
         num_kv_heads=args.num_kv_heads or None,
         pos_embedding=args.pos_embedding,
+        attention_window=args.attention_window,
         max_seq_len=args.prompt_len + args.new_tokens,
         kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
                         else args.kv_cache_dtype))
@@ -106,6 +109,7 @@ def main(argv=None):
             "kv_cache_dtype": args.kv_cache_dtype,
             "num_kv_heads": args.num_kv_heads or args.num_heads,
             "pos_embedding": args.pos_embedding,
+            "attention_window": args.attention_window,
             "platform": jax.devices()[0].platform,
             "sec_per_call": round(sec, 4),
             "decode_tokens_per_sec": round(tokens / sec, 1),
