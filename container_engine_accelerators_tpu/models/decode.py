@@ -72,6 +72,17 @@ def _mask_top_k(logits, top_k):
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
+def _apply_repetition_penalty(logits, seen, penalty):
+    """CTRL-style repetition penalty: logits of already-seen tokens
+    divide by ``penalty`` when positive and multiply when negative
+    (both directions push the token away for penalty > 1). penalty
+    is a traced scalar or per-row [B] vector; 1.0 is a no-op row.
+    ``seen``: [B, V] bool."""
+    p = jnp.reshape(penalty, (-1, 1))
+    penalized = jnp.where(logits > 0, logits / p, logits * p)
+    return jnp.where(seen, penalized, logits)
+
+
 def _mask_top_p(logits, top_p):
     """Nucleus mask: keep the smallest prefix of the probability-
     sorted vocab whose mass reaches top_p. top_p is a traced scalar
@@ -88,18 +99,32 @@ def _mask_top_p(logits, top_p):
 @functools.partial(jax.jit,
                    static_argnames=("model", "max_new_tokens",
                                     "sample", "fast_prefill",
-                                    "top_k", "use_top_p", "use_eos"))
+                                    "top_k", "use_top_p", "use_eos",
+                                    "use_rp"))
 def _decode_impl(model, params, prompt, max_new_tokens, temperature,
-                 rng, prompt_len, top_p, eos_id, *, sample,
-                 fast_prefill=False, top_k=0, use_top_p=False,
-                 use_eos=False):
+                 rng, prompt_len, top_p, eos_id, rep_penalty, *,
+                 sample, fast_prefill=False, top_k=0, use_top_p=False,
+                 use_eos=False, use_rp=False):
     b, p_pad = prompt.shape
     total = p_pad + max_new_tokens
     decode_model, cache = init_cache(model, b, total)
     padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
     eos_row = jnp.reshape(eos_id, (-1,)) if use_eos else None
+    rows = jnp.arange(b)
 
-    def pick(logits, rng):
+    def mark_seen(seen, tok):
+        # seen: [B, V] bool of tokens the penalty pushes away from
+        # (prompt + generated so far); zero-width when off so the
+        # scan carry keeps one static structure either way.
+        if not use_rp:
+            return seen
+        return seen.at[rows, tok].set(True)
+
+    def pick(logits, rng, seen):
+        if use_rp:
+            # On raw logits, before temperature/filters (CTRL).
+            logits = _apply_repetition_penalty(logits, seen,
+                                               rep_penalty)
         if sample:
             rng, sub = jax.random.split(rng)
             # temperature is a traced scalar or a [B] vector (one
@@ -118,11 +143,11 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
         return chosen.astype(prompt.dtype), rng
 
     def step(carry, t):
-        cache, tok, rng, done = carry
+        cache, tok, rng, done, seen = carry
         outputs, updated = decode_model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             train=False, mutable=["cache"])
-        sampled, rng = pick(_logits_of(outputs)[:, 0], rng)
+        sampled, rng = pick(_logits_of(outputs)[:, 0], rng, seen)
         # While still inside the prompt, the model's prediction is
         # discarded and the actual prompt token is fed (prefill).
         # prompt_len is TRACED (scalar or [B] per-row vector), so one
@@ -140,7 +165,10 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             # trigger.
             nxt = jnp.where(done, eos_row.astype(prompt.dtype), nxt)
             done = done | (~in_prompt & (nxt == eos_row))
-        return (updated["cache"], nxt, rng, done), nxt
+        return (updated["cache"], nxt, rng, done,
+                mark_seen(seen, nxt)), nxt
+
+    seen0 = jnp.zeros((b, model.vocab_size if use_rp else 0), bool)
 
     if fast_prefill and max_new_tokens > 0:
         # The whole prompt runs as ONE forward pass that fills the
@@ -151,20 +179,28 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
         # CausalSelfAttention._cached_attention. (max_new_tokens == 0
         # falls through: the fast path would emit one unrequested
         # token.)
+        if use_rp:
+            # fast_prefill requires full-width prompts, so every
+            # prompt token is real — scatter them all at once.
+            seen0 = seen0.at[rows[:, None], prompt].set(True)
         outputs, updated = decode_model.apply(
             {"params": params, "cache": cache}, prompt,
             train=False, mutable=["cache"])
-        first, rng = pick(_logits_of(outputs)[:, -1], rng)
+        first, rng = pick(_logits_of(outputs)[:, -1], rng, seen0)
         done0 = ((first == eos_row) if use_eos
                  else jnp.zeros((b,), bool))
-        (_, _, _, _), produced = jax.lax.scan(
-            step, (updated["cache"], first, rng, done0),
+        (_, _, _, _, _), produced = jax.lax.scan(
+            step, (updated["cache"], first, rng, done0,
+                   mark_seen(seen0, first)),
             jnp.arange(p_pad, total - 1))
         return jnp.concatenate(
             [prompt, first[:, None], produced.T], axis=1)
 
-    (_, _, _, _), produced = jax.lax.scan(
-        step, (cache, prompt[:, 0], rng, jnp.zeros((b,), bool)),
+    # Stepwise: prompt tokens enter `seen` as the scan feeds them;
+    # seed with the first token, which never rides `nxt`.
+    (_, _, _, _, _), produced = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng, jnp.zeros((b,), bool),
+               mark_seen(seen0, prompt[:, 0])),
         jnp.arange(total - 1))
     # produced[t] is the token at position t+1.
     return jnp.concatenate([prompt[:, :1], produced.T], axis=1)
@@ -172,7 +208,8 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
 
 def decode(model, params, prompt, max_new_tokens, *,
            temperature=0.0, rng=None, prompt_len=None,
-           fast_prefill=None, top_k=0, top_p=1.0, eos_id=None):
+           fast_prefill=None, top_k=0, top_p=1.0, eos_id=None,
+           repetition_penalty=1.0):
     """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
 
     temperature == 0 is greedy argmax; > 0 samples from
@@ -189,6 +226,13 @@ def decode(model, params, prompt, max_new_tokens, *,
     or per-row [B] vector, 1.0 = off) keeps the smallest nucleus of
     probability mass >= top_p. Both apply after temperature, and
     compose (top_k first).
+
+    ``repetition_penalty`` (traced scalar or per-row [B] vector,
+    1.0 = off): CTRL-style — logits of tokens already in the row
+    (prompt + generated) divide by the penalty when positive and
+    multiply when negative, pushing generation away from repeats.
+    Applies to greedy and sampling alike, before temperature and
+    filters.
 
     ``eos_id`` (traced scalar or per-row [B] vector; None = off):
     once a row's GENERATED text emits its EOS, the row keeps
@@ -247,15 +291,22 @@ def decode(model, params, prompt, max_new_tokens, *,
     # common no-nucleus case costs nothing and compiles no variant.
     use_top_p = bool((p_host < 1.0).any())
     use_eos = eos_id is not None
+    rp_host = np.asarray(repetition_penalty, np.float32)
+    if (rp_host <= 0.0).any():
+        raise ValueError("repetition_penalty entries must be > 0")
+    # 1.0 everywhere is the identity; skip the [B, V] seen-token
+    # bookkeeping so the common case costs nothing.
+    use_rp = bool((rp_host != 1.0).any())
     return _decode_impl(model, params, prompt, max_new_tokens,
                         jnp.asarray(temperature, jnp.float32), rng,
                         jnp.asarray(prompt_len, jnp.int32),
                         jnp.asarray(top_p, jnp.float32),
                         jnp.asarray(eos_id if use_eos else -1,
                                     jnp.int32),
+                        jnp.asarray(repetition_penalty, jnp.float32),
                         sample=sample, fast_prefill=fast_prefill,
                         top_k=top_k, use_top_p=use_top_p,
-                        use_eos=use_eos)
+                        use_eos=use_eos, use_rp=use_rp)
 
 
 def greedy_decode(model, params, prompt, max_new_tokens):

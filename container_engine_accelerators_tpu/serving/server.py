@@ -364,9 +364,9 @@ class GenerationServer(_BaseServer):
                 # Both default programs per bucket: greedy and plain
                 # sampling (pad_temp selects the mode).
                 self._run([(np.zeros((b,), np.int32), 0.0, b, 1.0,
-                            -1)], 0.0)
+                            -1, 1.0)], 0.0)
                 self._run([(np.zeros((b,), np.int32), 1.0, b, 1.0,
-                            -1)], 1.0)
+                            -1, 1.0)], 1.0)
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"
@@ -381,8 +381,8 @@ class GenerationServer(_BaseServer):
 
     def _run(self, instances, pad_temp, top_k=0):
         """Decode a micro-batch of (row, temperature, prompt_len,
-        top_p) instances through the (max_batch, bucket) padded
-        program."""
+        top_p, eos_id, rep_penalty) instances through the
+        (max_batch, bucket) padded program."""
         n = len(instances)
         bucket = instances[0][0].shape[0]
         padded = np.zeros((self._max_batch, bucket), np.int32)
@@ -390,13 +390,15 @@ class GenerationServer(_BaseServer):
         plens = np.full((self._max_batch,), bucket, np.int32)
         top_ps = np.ones((self._max_batch,), np.float32)
         eos_ids = np.full((self._max_batch,), -1, np.int32)
-        for row, (tokens, temp, p_len, top_p,
-                  eos_id) in enumerate(instances):
+        rep_pens = np.ones((self._max_batch,), np.float32)
+        for row, (tokens, temp, p_len, top_p, eos_id,
+                  rep_pen) in enumerate(instances):
             padded[row] = tokens
             temps[row] = temp
             plens[row] = p_len
             top_ps[row] = top_p
             eos_ids[row] = eos_id
+            rep_pens[row] = rep_pen
         with self._stats_lock:
             self._seed += 1
             seed = self._seed
@@ -417,7 +419,8 @@ class GenerationServer(_BaseServer):
                            rng=jax.random.PRNGKey(seed),
                            prompt_len=plens, fast_prefill=False,
                            top_k=top_k, top_p=top_ps,
-                           eos_id=eos_ids)
+                           eos_id=eos_ids,
+                           repetition_penalty=rep_pens)
         return np.asarray(seq)[:n]
 
     def _batcher_for(self, bucket, sampling, top_k):
@@ -464,6 +467,7 @@ class GenerationServer(_BaseServer):
             top_k = int(payload.get("top_k", 0))
             top_p = float(payload.get("top_p", 1.0))
             eos_id = int(payload.get("eos_id", -1))
+            rep_pen = float(payload.get("repetition_penalty", 1.0))
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"error": f"bad request: {e}"}
         if not -1 <= eos_id < self._model.vocab_size:
@@ -474,6 +478,9 @@ class GenerationServer(_BaseServer):
                                   f"0..{self._model.vocab_size}"}
         if not 0.0 < top_p <= 1.0:
             return 400, {"error": "top_p must be in (0, 1]"}
+        if not 0.0 < rep_pen <= 100.0:
+            return 400, {"error": "repetition_penalty must be in "
+                                  "(0, 100]"}
         if (top_k or top_p < 1.0) and temperature <= 0.0:
             return 400, {"error": "top_k/top_p require temperature > 0"}
         if top_k:
@@ -512,7 +519,7 @@ class GenerationServer(_BaseServer):
         if batcher is None:
             return 503, {"error": "server is shutting down"}
         pending = [batcher.submit_async((row, temperature, p_len,
-                                         top_p, eos_id))
+                                         top_p, eos_id, rep_pen))
                    for row in padded]
         rows = []
         for done in pending:

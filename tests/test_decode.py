@@ -442,3 +442,30 @@ def test_sliding_window_decode_matches_dense_forward():
     fast = decode(model, params, tokens, N, fast_prefill=True)
     step = decode(model, params, tokens, N, fast_prefill=False)
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(step))
+
+
+def test_repetition_penalty(dense_lm):
+    """penalty=1.0 is exactly the unpenalized program; a strong
+    penalty changes greedy output and suppresses repeats; fast
+    prefill matches stepwise with the penalty on."""
+    model, params, prompt = dense_lm
+    base = decode(model, params, prompt, N)
+    neutral = decode(model, params, prompt, N, repetition_penalty=1.0)
+    np.testing.assert_array_equal(np.asarray(base),
+                                  np.asarray(neutral))
+
+    pen = decode(model, params, prompt, N, repetition_penalty=1e6)
+    assert not np.array_equal(np.asarray(pen), np.asarray(base))
+    # With an effectively infinite penalty and N + P << V, greedy
+    # should never emit the same token twice in a row.
+    gen = np.asarray(pen)[:, P:]
+    assert (gen[:, 1:] != gen[:, :-1]).all()
+
+    fast = decode(model, params, prompt, N, repetition_penalty=1e6,
+                  fast_prefill=True)
+    step = decode(model, params, prompt, N, repetition_penalty=1e6,
+                  fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(step))
+
+    with pytest.raises(ValueError, match="must be > 0"):
+        decode(model, params, prompt, N, repetition_penalty=0.0)

@@ -374,3 +374,14 @@ def test_model_status_endpoint(lm_server):
     assert meta["vocab_size"] == 64
     assert meta["max_batch"] == 4
     assert meta["prompt_buckets"] == sorted(meta["prompt_buckets"])
+
+
+def test_generate_repetition_penalty(lm_server):
+    out = post(lm_server, "/v1/models/lm:generate",
+               {"prompts": [[3, 9, 3]], "max_new_tokens": 6,
+                "repetition_penalty": 5.0})
+    assert len(out["sequences"][0]) == 9
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post(lm_server, "/v1/models/lm:generate",
+             {"prompts": [[1]], "repetition_penalty": 0})
+    assert err.value.code == 400

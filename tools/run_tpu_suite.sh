@@ -40,12 +40,17 @@ echo "[suite] attention sweep" >&2
 timeout 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json" \
   2>> "${OUT}/tpu_suite.log"
 
-echo "[suite] decode bench (bf16 + int8 cache)" >&2
+echo "[suite] decode bench (bf16 + int8 cache + GQA + window)" >&2
 {
   timeout 1800 python tools/bench_decode.py --batch 1 8 \
     --prompt-len 128 --new-tokens 128
   timeout 1800 python tools/bench_decode.py --batch 1 8 \
     --prompt-len 128 --new-tokens 128 --kv-cache-dtype int8
+  timeout 1800 python tools/bench_decode.py --batch 8 \
+    --prompt-len 128 --new-tokens 128 --kv-cache-dtype int8 \
+    --num-kv-heads 2 --pos-embedding rope
+  timeout 1800 python tools/bench_decode.py --batch 8 \
+    --prompt-len 128 --new-tokens 128 --attention-window 64
 } > "${OUT}/DECODE_BENCH.json" 2>> "${OUT}/tpu_suite.log"
 cat "${OUT}/DECODE_BENCH.json" >&2
 
