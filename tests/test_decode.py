@@ -469,3 +469,24 @@ def test_repetition_penalty(dense_lm):
 
     with pytest.raises(ValueError, match="must be > 0"):
         decode(model, params, prompt, N, repetition_penalty=0.0)
+
+
+def test_beam_search_composes_with_gqa_rope():
+    """Beam search shares the cache machinery; it must run unchanged
+    on a GQA + RoPE model and return valid, prompt-prefixed beams."""
+    from container_engine_accelerators_tpu.models.decode import (
+        beam_search,
+    )
+
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, num_kv_heads=2,
+                          pos_embedding="rope", max_seq_len=MAXLEN,
+                          dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    seqs, scores = beam_search(model, params, tokens, 6, num_beams=3)
+    assert seqs.shape == (B, 3, P + 6)
+    np.testing.assert_array_equal(
+        np.asarray(seqs[:, 0, :P]), np.asarray(tokens))
+    s = np.asarray(scores)
+    assert (s[:, :-1] >= s[:, 1:] - 1e-5).all()  # sorted best-first

@@ -73,6 +73,9 @@ def main(argv=None):
     p.add_argument("--causal", action=argparse.BooleanOptionalAction,
                    default=True)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--window", type=int, default=None,
+                   help="sliding-window width (causal only); windowed "
+                        "kernels skip out-of-window tiles")
     p.add_argument("--block", type=int, default=None,
                    help="flash kernel seq tile (multiple of 128); "
                         "None = CEA_FLASH_BLOCK or 128")
@@ -99,14 +102,21 @@ def main(argv=None):
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(key, (b, s, h, d), dtype)
                for key in ks)
-    # 4*b*h*s^2*d matmul FLOPs (QK^T + PV), halved by causality.
-    flops = 4 * b * h * s * s * d * (0.5 if args.causal else 1.0)
+    # 4*b*h*s^2*d matmul FLOPs (QK^T + PV), halved by causality;
+    # a sliding window caps each query's keys at the window width.
+    if args.causal and args.window:
+        w = min(args.window, s)
+        attended = w * s - w * (w - 1) // 2  # sum over query rows
+        flops = 4 * b * h * attended * d
+    else:
+        flops = 4 * b * h * s * s * d * (0.5 if args.causal else 1.0)
 
     schedules = {
         "dense": jax.jit(lambda q, k, v: dot_product_attention(
             q, k, v, causal=args.causal)),
         "flash": jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, causal=args.causal, block=args.block)),
+            q, k, v, causal=args.causal, block=args.block,
+            window=args.window)),
     }
     n = len(jax.devices())
     if n > 1:
@@ -143,11 +153,14 @@ def main(argv=None):
             "head_dim": d,
             "devices": n,
             "block": args.block,
+            "window": args.window,
             "platform": jax.devices()[0].platform,
             "ms_per_call": round(sec * 1000, 3),
             "tflops": round(flops / sec / 1e12, 2),
         }
-        if reference is not None and name != "dense":
+        # The dense reference is full-causal; windowed flash is a
+        # different function, so the error metric would be bogus.
+        if reference is not None and name != "dense" and not args.window:
             err = float(jnp.max(jnp.abs(
                 fn(q, k, v).astype(jnp.float32)
                 - reference.astype(jnp.float32))))
