@@ -83,6 +83,18 @@ def _apply_repetition_penalty(logits, seen, penalty):
     return jnp.where(seen, penalized, logits)
 
 
+def _mask_min_p(logits, min_p):
+    """min-p filter: keep tokens whose probability is at least
+    min_p * p_max (adaptive support: tight when the model is
+    confident, wide when it is not). min_p is a traced scalar or
+    per-row [B] vector; 0.0 is a no-op row."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    cutoff = (jnp.max(logp, axis=-1, keepdims=True)
+              + jnp.log(jnp.maximum(
+                  jnp.reshape(min_p, (-1, 1)), 1e-38)))
+    return jnp.where(logp < cutoff, -jnp.inf, logits)
+
+
 def _mask_top_p(logits, top_p):
     """Nucleus mask: keep the smallest prefix of the probability-
     sorted vocab whose mass reaches top_p. top_p is a traced scalar
@@ -100,11 +112,12 @@ def _mask_top_p(logits, top_p):
                    static_argnames=("model", "max_new_tokens",
                                     "sample", "fast_prefill",
                                     "top_k", "use_top_p", "use_eos",
-                                    "use_rp"))
+                                    "use_rp", "use_min_p"))
 def _decode_impl(model, params, prompt, max_new_tokens, temperature,
-                 rng, prompt_len, top_p, eos_id, rep_penalty, *,
-                 sample, fast_prefill=False, top_k=0, use_top_p=False,
-                 use_eos=False, use_rp=False):
+                 rng, prompt_len, top_p, eos_id, rep_penalty, min_p,
+                 *, sample, fast_prefill=False, top_k=0,
+                 use_top_p=False, use_eos=False, use_rp=False,
+                 use_min_p=False):
     b, p_pad = prompt.shape
     total = p_pad + max_new_tokens
     decode_model, cache = init_cache(model, b, total)
@@ -137,6 +150,8 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
                 logits = _mask_top_k(logits, top_k)
             if use_top_p:
                 logits = _mask_top_p(logits, top_p)
+            if use_min_p:
+                logits = _mask_min_p(logits, min_p)
             chosen = jax.random.categorical(sub, logits, axis=-1)
         else:
             chosen = jnp.argmax(logits, axis=-1)
@@ -209,7 +224,7 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
 def decode(model, params, prompt, max_new_tokens, *,
            temperature=0.0, rng=None, prompt_len=None,
            fast_prefill=None, top_k=0, top_p=1.0, eos_id=None,
-           repetition_penalty=1.0):
+           repetition_penalty=1.0, min_p=0.0):
     """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
 
     temperature == 0 is greedy argmax; > 0 samples from
@@ -224,8 +239,10 @@ def decode(model, params, prompt, max_new_tokens, *,
     Sampling filters: ``top_k`` (static — each value compiles its own
     program) keeps the k most likely tokens; ``top_p`` (traced scalar
     or per-row [B] vector, 1.0 = off) keeps the smallest nucleus of
-    probability mass >= top_p. Both apply after temperature, and
-    compose (top_k first).
+    probability mass >= top_p; ``min_p`` (traced scalar or [B]
+    vector, 0.0 = off) keeps tokens whose probability is at least
+    min_p * p_max. All apply after temperature and compose
+    (top_k, then top_p, then min_p).
 
     ``repetition_penalty`` (traced scalar or per-row [B] vector,
     1.0 = off): CTRL-style — logits of tokens already in the row
@@ -290,6 +307,10 @@ def decode(model, params, prompt, max_new_tokens, *,
     # top_p == 1.0 everywhere is the identity; skip the mask so the
     # common no-nucleus case costs nothing and compiles no variant.
     use_top_p = bool((p_host < 1.0).any())
+    mp_host = np.asarray(min_p, np.float32)
+    if (mp_host < 0.0).any() or (mp_host >= 1.0).any():
+        raise ValueError("min_p entries must be in [0, 1)")
+    use_min_p = bool((mp_host > 0.0).any())
     use_eos = eos_id is not None
     rp_host = np.asarray(repetition_penalty, np.float32)
     if (rp_host <= 0.0).any():
@@ -304,9 +325,11 @@ def decode(model, params, prompt, max_new_tokens, *,
                         jnp.asarray(eos_id if use_eos else -1,
                                     jnp.int32),
                         jnp.asarray(repetition_penalty, jnp.float32),
+                        jnp.asarray(min_p, jnp.float32),
                         sample=sample, fast_prefill=fast_prefill,
                         top_k=top_k, use_top_p=use_top_p,
-                        use_eos=use_eos, use_rp=use_rp)
+                        use_eos=use_eos, use_rp=use_rp,
+                        use_min_p=use_min_p)
 
 
 def greedy_decode(model, params, prompt, max_new_tokens):

@@ -364,9 +364,9 @@ class GenerationServer(_BaseServer):
                 # Both default programs per bucket: greedy and plain
                 # sampling (pad_temp selects the mode).
                 self._run([(np.zeros((b,), np.int32), 0.0, b, 1.0,
-                            -1, 1.0)], 0.0)
+                            -1, 1.0, 0.0)], 0.0)
                 self._run([(np.zeros((b,), np.int32), 1.0, b, 1.0,
-                            -1, 1.0)], 1.0)
+                            -1, 1.0, 0.0)], 1.0)
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"
@@ -391,14 +391,16 @@ class GenerationServer(_BaseServer):
         top_ps = np.ones((self._max_batch,), np.float32)
         eos_ids = np.full((self._max_batch,), -1, np.int32)
         rep_pens = np.ones((self._max_batch,), np.float32)
-        for row, (tokens, temp, p_len, top_p, eos_id,
-                  rep_pen) in enumerate(instances):
+        min_ps = np.zeros((self._max_batch,), np.float32)
+        for row, (tokens, temp, p_len, top_p, eos_id, rep_pen,
+                  min_p) in enumerate(instances):
             padded[row] = tokens
             temps[row] = temp
             plens[row] = p_len
             top_ps[row] = top_p
             eos_ids[row] = eos_id
             rep_pens[row] = rep_pen
+            min_ps[row] = min_p
         with self._stats_lock:
             self._seed += 1
             seed = self._seed
@@ -420,7 +422,8 @@ class GenerationServer(_BaseServer):
                            prompt_len=plens, fast_prefill=False,
                            top_k=top_k, top_p=top_ps,
                            eos_id=eos_ids,
-                           repetition_penalty=rep_pens)
+                           repetition_penalty=rep_pens,
+                           min_p=min_ps)
         return np.asarray(seq)[:n]
 
     def _batcher_for(self, bucket, sampling, top_k):
@@ -468,6 +471,7 @@ class GenerationServer(_BaseServer):
             top_p = float(payload.get("top_p", 1.0))
             eos_id = int(payload.get("eos_id", -1))
             rep_pen = float(payload.get("repetition_penalty", 1.0))
+            min_p = float(payload.get("min_p", 0.0))
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"error": f"bad request: {e}"}
         if not -1 <= eos_id < self._model.vocab_size:
@@ -481,8 +485,11 @@ class GenerationServer(_BaseServer):
         if not 0.0 < rep_pen <= 100.0:
             return 400, {"error": "repetition_penalty must be in "
                                   "(0, 100]"}
-        if (top_k or top_p < 1.0) and temperature <= 0.0:
-            return 400, {"error": "top_k/top_p require temperature > 0"}
+        if not 0.0 <= min_p < 1.0:
+            return 400, {"error": "min_p must be in [0, 1)"}
+        if (top_k or top_p < 1.0 or min_p > 0.0) and temperature <= 0.0:
+            return 400, {"error": "top_k/top_p/min_p require "
+                                  "temperature > 0"}
         if top_k:
             # Quantize to the next power of two (a superset of the
             # requested support) so untrusted clients cannot mint an
@@ -519,7 +526,8 @@ class GenerationServer(_BaseServer):
         if batcher is None:
             return 503, {"error": "server is shutting down"}
         pending = [batcher.submit_async((row, temperature, p_len,
-                                         top_p, eos_id, rep_pen))
+                                         top_p, eos_id, rep_pen,
+                                         min_p))
                    for row in padded]
         rows = []
         for done in pending:

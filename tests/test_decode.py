@@ -490,3 +490,22 @@ def test_beam_search_composes_with_gqa_rope():
         np.asarray(seqs[:, 0, :P]), np.asarray(tokens))
     s = np.asarray(scores)
     assert (s[:, :-1] >= s[:, 1:] - 1e-5).all()  # sorted best-first
+
+
+def test_min_p_filter(dense_lm):
+    """min_p close to 1 forces near-greedy sampling; min_p=0.0 is
+    exactly the unfiltered program; validation rejects bad values."""
+    model, params, prompt = dense_lm
+    greedy = decode(model, params, prompt, N)
+    near = decode(model, params, prompt, N, temperature=0.05,
+                  min_p=0.97, rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(near), np.asarray(greedy))
+
+    a = decode(model, params, prompt, N, temperature=1.0,
+               rng=jax.random.PRNGKey(5))
+    b = decode(model, params, prompt, N, temperature=1.0, min_p=0.0,
+               rng=jax.random.PRNGKey(5))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(ValueError, match="min_p"):
+        decode(model, params, prompt, N, temperature=1.0, min_p=1.0)
