@@ -211,18 +211,64 @@ class CausalSelfAttention(nn.Module):
                 f"use None or \"int8\"")
         cache_dtype = jnp.int8 if quantized else k.dtype
         is_init = not self.has_variable("cache", "cached_key")
+        # Sliding-window models keep a RING buffer of window slots
+        # instead of the full sequence: position p lives in slot
+        # p % window, so cache residency is O(window) however long
+        # generation runs — for a 32k-context model with a 4k window
+        # that is 8x less HBM than the full-length cache.
+        ring = bool(self.window)
+        # Sizing only applies at variable creation (the full-length
+        # init pass); later calls see k.shape[1] == 1 and must take
+        # the ring length from the existing buffer instead.
+        c_len = (min(k.shape[1], self.window) if ring
+                 else k.shape[1])
+        cache_shape = k.shape[:1] + (c_len,) + k.shape[2:]
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                 k.shape, cache_dtype)
+                                 cache_shape, cache_dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                 v.shape, cache_dtype)
+                                 cache_shape, cache_dtype)
+        c_len = cached_k.value.shape[1]
+        cache_shape = cached_k.value.shape
         if quantized:
-            scale_shape = k.shape[:-1] + (1,)
+            scale_shape = cache_shape[:-1] + (1,)
             k_scale = self.variable("cache", "key_scale", jnp.zeros,
                                     scale_shape, jnp.float32)
             v_scale = self.variable("cache", "value_scale", jnp.zeros,
                                     scale_shape, jnp.float32)
+        if ring:
+            # Global position held by each slot (-1 = never written);
+            # per-batch-row so beam search's cache gathers/fan-outs
+            # (which match leaves on the leading batch dim) stay
+            # semantically correct.
+            slot_pos = self.variable(
+                "cache", "slot_pos",
+                lambda: jnp.full((k.shape[0], c_len), -1, jnp.int32))
         index = self.variable("cache", "cache_index",
                               lambda: jnp.zeros((), jnp.int32))
+
+        def cache_write(buf, val):
+            """Write a [B, Q, ...] update at positions i..i+Q-1
+            (ring-aware; the prefill chunk's wrap split is static
+            because Q and the ring length are static and i == 0 by
+            the one-shot-prefill contract)."""
+            zeros = (0,) * (val.ndim - 2)
+            if not ring:
+                return jax.lax.dynamic_update_slice(
+                    buf, val, (0, i) + zeros)
+            p = val.shape[1]
+            if p == 1:
+                return jax.lax.dynamic_update_slice(
+                    buf, val, (0, i % c_len) + zeros)
+            n = min(p, c_len)  # only the last `window` entries matter
+            tail = val[:, p - n:]
+            start = (p - n) % c_len
+            first = min(n, c_len - start)
+            buf = jax.lax.dynamic_update_slice(
+                buf, tail[:, :first], (0, start) + zeros)
+            if n > first:
+                buf = jax.lax.dynamic_update_slice(
+                    buf, tail[:, first:], (0, 0) + zeros)
+            return buf
         if is_init:
             # Cache sizing pass (init_cache runs the model over the
             # full max_seq_len input): the output is discarded, but
@@ -247,19 +293,21 @@ class CausalSelfAttention(nn.Module):
         if quantized:
             kq, ks = _quantize_rows_int8(k)
             vq, vs = _quantize_rows_int8(v)
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, kq, (0, i, 0, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, vq, (0, i, 0, 0))
-            k_scale.value = jax.lax.dynamic_update_slice(
-                k_scale.value, ks, (0, i, 0, 0))
-            v_scale.value = jax.lax.dynamic_update_slice(
-                v_scale.value, vs, (0, i, 0, 0))
+            cached_k.value = cache_write(cached_k.value, kq)
+            cached_v.value = cache_write(cached_v.value, vq)
+            k_scale.value = cache_write(k_scale.value, ks)
+            v_scale.value = cache_write(v_scale.value, vs)
         else:
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cache_dtype), (0, i, 0, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cache_dtype), (0, i, 0, 0))
+            cached_k.value = cache_write(cached_k.value,
+                                         k.astype(cache_dtype))
+            cached_v.value = cache_write(cached_v.value,
+                                         v.astype(cache_dtype))
+        if ring:
+            q_len_now = q.shape[1]
+            pos_vals = jnp.broadcast_to(
+                (i + jnp.arange(q_len_now, dtype=jnp.int32))[None, :],
+                (q.shape[0], q_len_now))
+            slot_pos.value = cache_write(slot_pos.value, pos_vals)
         index.value = i + q.shape[1]
 
         if q.shape[1] > 1:
@@ -299,13 +347,20 @@ class CausalSelfAttention(nn.Module):
         # Queries in a multi-token chunk (one-shot prefill) sit at
         # positions i..i+Q-1; each attends causally to its own
         # prefix. Single-token decode (Q=1) reduces to k_pos <= i.
-        k_pos = jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, dimension=4)
         q_pos = i + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=3)
-        keep = k_pos <= q_pos
-        if self.window:
-            keep &= k_pos > q_pos - self.window
+        if ring:
+            # Ring cache: slot j holds global position slot_pos[b, j]
+            # (-1 = never written); the window band is what bounds
+            # staleness — a slot overwritten since (p - W, p] can
+            # never pass the mask.
+            k_pos = slot_pos.value[:, None, None, None, :]
+            keep = ((k_pos >= 0) & (k_pos <= q_pos)
+                    & (k_pos > q_pos - self.window))
+        else:
+            k_pos = jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, dimension=4)
+            keep = k_pos <= q_pos
         scores = jnp.where(keep, scores, -1e9)
         probs = jax.nn.softmax(scores, axis=-1)
         if quantized:

@@ -509,3 +509,62 @@ def test_min_p_filter(dense_lm):
 
     with pytest.raises(ValueError, match="min_p"):
         decode(model, params, prompt, N, temperature=1.0, min_p=1.0)
+
+
+def test_windowed_ring_cache_is_window_sized_and_wraps_exactly():
+    """Sliding-window decode keeps an O(window) ring cache (slot =
+    position % window), and stays argmax-consistent with the dense
+    windowed forward even after generation has wrapped the ring
+    several times — the eviction path, where a stale slot must never
+    pass the band mask."""
+    from container_engine_accelerators_tpu.models.decode import (
+        init_cache,
+    )
+
+    W, n_new = 6, 20  # wraps the 6-slot ring 3+ times
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, attention_window=W,
+                          max_seq_len=MAXLEN, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+
+    _, cache = init_cache(model, B, P + n_new)
+    attn = cache["block0"]["attn"]
+    assert attn["cached_key"].shape == (B, W, H, E // H)
+    assert attn["slot_pos"].shape == (B, W)
+
+    seq = greedy_decode(model, params, tokens, n_new)
+    _check_greedy_consistency(model, params, seq, P)
+
+
+def test_windowed_ring_cache_composes_gqa_rope_int8():
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, num_kv_heads=2,
+                          pos_embedding="rope", kv_cache_dtype="int8",
+                          attention_window=6, max_seq_len=MAXLEN,
+                          dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(10), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    seq = greedy_decode(model, params, tokens, 16)
+    assert seq.shape == (B, P + 16)
+    got = np.asarray(seq)
+    assert got.min() >= 0 and got.max() < V
+    fast = decode(model, params, tokens, 16, fast_prefill=True)
+    step = decode(model, params, tokens, 16, fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(step))
+
+
+def test_windowed_ring_prefill_longer_than_window():
+    """Prompt longer than the window: one-shot prefill keeps only
+    the last W entries (static wrap split), and decode remains
+    argmax-consistent with the dense windowed forward."""
+    W = 4  # < P=5, so the prefill write wraps
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, attention_window=W,
+                          max_seq_len=MAXLEN, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(12), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    fast = decode(model, params, tokens, N, fast_prefill=True)
+    step = decode(model, params, tokens, N, fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(step))
+    _check_greedy_consistency(model, params, fast, P)
