@@ -139,6 +139,7 @@ class MoEBlock(nn.Module):
     num_kv_heads: Any = None
     rope: bool = False
     window: int = 0
+    weights: str = "native"
 
     @nn.compact
     def __call__(self, x):
@@ -150,6 +151,7 @@ class MoEBlock(nn.Module):
                                 num_kv_heads=self.num_kv_heads,
                                 rope=self.rope,
                                 window=self.window,
+                                weights=self.weights,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -185,6 +187,9 @@ class MoETransformerLM(nn.Module):
     num_kv_heads: Any = None
     pos_embedding: str = "learned"
     attention_window: int = 0
+    # "int8": weight-only quantized attention/dense-MLP weights
+    # (expert kernels stay native; they are already expert-sharded).
+    weights: str = "native"
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -221,6 +226,7 @@ class MoETransformerLM(nn.Module):
                     num_kv_heads=self.num_kv_heads,
                     rope=self.pos_embedding == "rope",
                     window=self.attention_window,
+                    weights=self.weights,
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -232,6 +238,7 @@ class MoETransformerLM(nn.Module):
                           num_kv_heads=self.num_kv_heads,
                           rope=self.pos_embedding == "rope",
                           window=self.attention_window,
+                          weights=self.weights,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -1,0 +1,120 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Weight-only int8 linear layers for serving.
+
+Small-batch decode is weight-bandwidth-bound: every step streams the
+full parameter set out of HBM for a handful of rows. Storing kernels
+as int8 with one f32 scale per output channel halves that traffic
+(and residency) with no dequantized copy ever materializing — the
+scale is per-OUTPUT-channel, so it folds outside the contraction
+exactly:
+
+    x @ (q * s) == (x @ q) * s
+
+i.e. the matmul runs on the int8 kernel (converted to the compute
+dtype in-operand, like the int8 KV cache) and the [..., out] result
+is scaled afterwards. Quantization is symmetric round-to-nearest per
+channel, done once at weight-load time (`convert_params_int8`);
+training stays full precision.
+"""
+
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class Int8DenseGeneral(nn.Module):
+    """Drop-in DenseGeneral(axis=-1) over int8 weights.
+
+    Params: kernel_q int8 [in, *features], scale f32 [*features],
+    bias [*features] (matching nn.DenseGeneral's default use_bias).
+    Created zero-filled — real values come from converting a trained
+    checkpoint with ``convert_params_int8``.
+    """
+
+    features: Union[int, Sequence[int]]
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        feats = (self.features if isinstance(self.features, (tuple, list))
+                 else (self.features,))
+        feats = tuple(int(f) for f in feats)
+        in_dim = x.shape[-1]
+        kernel_q = self.param("kernel_q", nn.initializers.zeros,
+                              (in_dim,) + feats, jnp.int8)
+        scale = self.param("scale", nn.initializers.ones, feats,
+                           jnp.float32)
+        x = x.astype(self.dtype)
+        # Contract x's last axis with kernel's first; the int8 ->
+        # compute-dtype convert fuses into the dot's operand read.
+        y = jax.lax.dot_general(
+            x, kernel_q.astype(self.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())))
+        y = (y.astype(jnp.float32) * scale).astype(self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, feats,
+                              self.dtype)
+            y = y + bias
+        return y
+
+
+def quantize_kernel_int8(kernel):
+    """Symmetric per-output-channel int8 quantization of a dense
+    kernel [in, *out]: returns (q int8, scale f32 [*out])."""
+    w = jnp.asarray(kernel, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=0)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def convert_params_int8(template, params):
+    """Fill a weights="int8" model's param template from a trained
+    full-precision tree.
+
+    ``template``: params of the int8 model's init (same module names
+    as the native model — quantized modules hold kernel_q/scale
+    instead of kernel). ``params``: the native model's trained tree.
+    Non-quantized leaves copy through; shapes are checked so a
+    mismatched checkpoint fails loudly.
+    """
+    if not isinstance(template, dict):
+        if jnp.shape(template) != jnp.shape(params):
+            raise ValueError(
+                f"shape mismatch converting params: "
+                f"{jnp.shape(params)} -> {jnp.shape(template)}")
+        return params
+    if "kernel_q" in template:
+        out = {}
+        q, scale = quantize_kernel_int8(params["kernel"])
+        if q.shape != template["kernel_q"].shape:
+            raise ValueError(
+                f"kernel shape {q.shape} != template "
+                f"{template['kernel_q'].shape}")
+        out["kernel_q"], out["scale"] = q, scale
+        if "bias" in template:
+            out["bias"] = jnp.asarray(params["bias"],
+                                      template["bias"].dtype)
+        return out
+    if set(template) != set(params):
+        raise ValueError(
+            f"param tree mismatch: {sorted(template)} vs "
+            f"{sorted(params)}")
+    return {k: convert_params_int8(template[k], params[k])
+            for k in template}

@@ -33,6 +33,18 @@ import jax.numpy as jnp
 
 from ..ops.attention import flash_attention
 from .common import make_stateless_apply_fn, residual_constraint
+from .quantized import Int8DenseGeneral
+
+
+def _linear(quantized, features, dtype, name):
+    """DenseGeneral(axis=-1) or its weight-only-int8 twin. The int8
+    module uses the same flax name, so the param tree paths line up
+    leaf-for-leaf with the native model and checkpoints convert with
+    models.quantized.convert_params_int8."""
+    if quantized:
+        return Int8DenseGeneral(features=features, dtype=dtype,
+                                name=name)
+    return nn.DenseGeneral(features, dtype=dtype, name=name)
 
 
 def cached_positions(module, s, decode):
@@ -136,6 +148,9 @@ class CausalSelfAttention(nn.Module):
     # Sliding-window attention: query p sees keys in (p - W, p].
     # Only the flash kernel path supports it (0 = full causal).
     window: int = 0
+    # "int8": weight-only quantized projections (serving; convert a
+    # trained checkpoint with models.quantized.convert_params_int8).
+    weights: str = "native"
 
     def _kv_heads(self):
         kv = self.num_kv_heads or self.num_heads
@@ -152,15 +167,18 @@ class CausalSelfAttention(nn.Module):
         d = e // heads
         x = residual_constraint(x, self.mesh)
         h = nn.LayerNorm(dtype=self.dtype)(x)
+        quant = self.weights == "int8"
+        if self.weights not in ("native", "int8"):
+            raise ValueError(
+                f"weights must be 'native' or 'int8': {self.weights!r}")
         if kv_heads == heads:
-            qkv = nn.DenseGeneral((3, heads, d), dtype=self.dtype,
-                                  name="qkv")(h)
+            qkv = _linear(quant, (3, heads, d), self.dtype,
+                          "qkv")(h)
             q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, D]
         else:
-            q = nn.DenseGeneral((heads, d), dtype=self.dtype,
-                                name="q")(h)
-            kv = nn.DenseGeneral((2, kv_heads, d), dtype=self.dtype,
-                                 name="kv")(h)
+            q = _linear(quant, (heads, d), self.dtype, "q")(h)
+            kv = _linear(quant, (2, kv_heads, d), self.dtype,
+                         "kv")(h)
             k, v = kv[:, :, 0], kv[:, :, 1]  # [B, S, Hkv, D]
         if self.window and self.attention_fn is not flash_attention:
             raise ValueError(
@@ -182,8 +200,7 @@ class CausalSelfAttention(nn.Module):
                     q, _expand_kv(k, heads), _expand_kv(v, heads),
                     causal=True)
         attn = attn.reshape(x.shape)
-        out = x + nn.DenseGeneral(e, axis=(-1,), dtype=self.dtype,
-                                  name="proj")(attn)
+        out = x + _linear(quant, e, self.dtype, "proj")(attn)
         return residual_constraint(out, self.mesh)
 
     def _cached_attention(self, q, k, v):
@@ -384,6 +401,7 @@ class Block(nn.Module):
     num_kv_heads: Any = None
     rope: bool = False
     window: int = 0
+    weights: str = "native"
 
     @nn.compact
     def __call__(self, x):
@@ -396,12 +414,18 @@ class Block(nn.Module):
                                 num_kv_heads=self.num_kv_heads,
                                 rope=self.rope,
                                 window=self.window,
+                                weights=self.weights,
                                 name="attn")(x)
+        quant = self.weights == "int8"
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.mlp_ratio * e, dtype=self.dtype)(h)
+        # Explicit names match nn.Dense's auto-naming in the native
+        # tree so int8 checkpoints convert leaf-for-leaf.
+        h = _linear(quant, self.mlp_ratio * e, self.dtype,
+                    "Dense_0")(h)
         h = nn.gelu(h)
-        return residual_constraint(x + nn.Dense(e, dtype=self.dtype)(h),
-                                   self.mesh)
+        return residual_constraint(
+            x + _linear(quant, e, self.dtype, "Dense_1")(h),
+            self.mesh)
 
 
 class TransformerLM(nn.Module):
@@ -424,6 +448,9 @@ class TransformerLM(nn.Module):
     pos_embedding: str = "learned"
     # Sliding-window attention width (0 = full causal); flash path.
     attention_window: int = 0
+    # "int8": weight-only quantized projections/MLPs for serving
+    # (embeddings, norms, and the f32 lm_head stay full precision).
+    weights: str = "native"
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -457,6 +484,7 @@ class TransformerLM(nn.Module):
                       num_kv_heads=self.num_kv_heads,
                       rope=self.pos_embedding == "rope",
                       window=self.attention_window,
+                      weights=self.weights,
                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,

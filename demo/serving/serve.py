@@ -124,6 +124,13 @@ def main(argv=None):
                    default="bfloat16",
                    help="int8 halves KV-cache residency per replica "
                         "(~2x servable context/batch)")
+    p.add_argument("--quantize-weights", choices=["native", "int8"],
+                   default="native",
+                   help="int8: weight-only quantization of attention "
+                        "and MLP kernels at load time (halves weight "
+                        "residency and decode HBM traffic; "
+                        "embeddings/norms/lm_head stay full "
+                        "precision)")
     p.add_argument("--model-dir",
                    default=os.environ.get("MODEL_DIR", ""),
                    help="restore weights from the newest "
@@ -174,6 +181,15 @@ def main(argv=None):
         if args.model_dir:
             variables = load_checkpoint_variables(args.model_dir,
                                                   variables)
+        if args.quantize_weights == "int8":
+            from container_engine_accelerators_tpu.models.quantized                 import convert_params_int8
+            q_model = model.clone(weights="int8")
+            template = q_model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 8), jnp.int32))["params"]
+            variables = {"params": convert_params_int8(
+                template, variables["params"])}
+            model = q_model
         if args.tensor_parallel > 1:
             # Weights shard column-wise over the model axis
             # (parallel/sharding.py rules); decode stays an ordinary
