@@ -112,12 +112,13 @@ def _mask_top_p(logits, top_p):
                    static_argnames=("model", "max_new_tokens",
                                     "sample", "fast_prefill",
                                     "top_k", "use_top_p", "use_eos",
-                                    "use_rp", "use_min_p"))
+                                    "use_rp", "use_min_p",
+                                    "use_logprobs"))
 def _decode_impl(model, params, prompt, max_new_tokens, temperature,
                  rng, prompt_len, top_p, eos_id, rep_penalty, min_p,
                  *, sample, fast_prefill=False, top_k=0,
                  use_top_p=False, use_eos=False, use_rp=False,
-                 use_min_p=False):
+                 use_min_p=False, use_logprobs=False):
     b, p_pad = prompt.shape
     total = p_pad + max_new_tokens
     decode_model, cache = init_cache(model, b, total)
@@ -157,12 +158,20 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             chosen = jnp.argmax(logits, axis=-1)
         return chosen.astype(prompt.dtype), rng
 
+    def token_logprob(raw_logits, tok):
+        """Model log-probability of ``tok`` under the RAW logits
+        (pre-penalty/temperature/filters) — the scoring quantity."""
+        lp = jax.nn.log_softmax(raw_logits.astype(jnp.float32), -1)
+        return jnp.take_along_axis(
+            lp, tok[:, None].astype(jnp.int32), 1)[:, 0]
+
     def step(carry, t):
         cache, tok, rng, done, seen = carry
         outputs, updated = decode_model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             train=False, mutable=["cache"])
-        sampled, rng = pick(_logits_of(outputs)[:, 0], rng, seen)
+        raw = _logits_of(outputs)[:, 0]
+        sampled, rng = pick(raw, rng, seen)
         # While still inside the prompt, the model's prediction is
         # discarded and the actual prompt token is fed (prefill).
         # prompt_len is TRACED (scalar or [B] per-row vector), so one
@@ -180,8 +189,9 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             # trigger.
             nxt = jnp.where(done, eos_row.astype(prompt.dtype), nxt)
             done = done | (~in_prompt & (nxt == eos_row))
+        y = ((nxt, token_logprob(raw, nxt)) if use_logprobs else nxt)
         return (updated["cache"], nxt, rng, done,
-                mark_seen(seen, nxt)), nxt
+                mark_seen(seen, nxt)), y
 
     seen0 = jnp.zeros((b, model.vocab_size if use_rp else 0), bool)
 
@@ -201,13 +211,30 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
         outputs, updated = decode_model.apply(
             {"params": params, "cache": cache}, prompt,
             train=False, mutable=["cache"])
-        first, rng = pick(_logits_of(outputs)[:, -1], rng, seen0)
+        prefill_logits = _logits_of(outputs)
+        first, rng = pick(prefill_logits[:, -1], rng, seen0)
         done0 = ((first == eos_row) if use_eos
                  else jnp.zeros((b,), bool))
         (_, _, _, _, _), produced = jax.lax.scan(
             step, (updated["cache"], first, rng, done0,
                    mark_seen(seen0, first)),
             jnp.arange(p_pad, total - 1))
+        if use_logprobs:
+            toks, lps = produced
+            # Echo logprobs for the prompt come free from the prefill
+            # forward; position 0 has no conditioning prefix (0.0).
+            plp = jax.nn.log_softmax(
+                prefill_logits[:, :-1].astype(jnp.float32), -1)
+            plp = jnp.take_along_axis(
+                plp, prompt[:, 1:, None].astype(jnp.int32),
+                2)[..., 0]
+            first_lp = token_logprob(prefill_logits[:, -1], first)
+            seq = jnp.concatenate(
+                [prompt, first[:, None], toks.T], axis=1)
+            lp_full = jnp.concatenate(
+                [jnp.zeros((b, 1), jnp.float32), plp,
+                 first_lp[:, None], lps.T], axis=1)
+            return seq, lp_full
         return jnp.concatenate(
             [prompt, first[:, None], produced.T], axis=1)
 
@@ -218,13 +245,19 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
                mark_seen(seen0, prompt[:, 0])),
         jnp.arange(total - 1))
     # produced[t] is the token at position t+1.
+    if use_logprobs:
+        toks, lps = produced
+        return (jnp.concatenate([prompt[:, :1], toks.T], axis=1),
+                jnp.concatenate([jnp.zeros((b, 1), jnp.float32),
+                                 lps.T], axis=1))
     return jnp.concatenate([prompt[:, :1], produced.T], axis=1)
 
 
 def decode(model, params, prompt, max_new_tokens, *,
            temperature=0.0, rng=None, prompt_len=None,
            fast_prefill=None, top_k=0, top_p=1.0, eos_id=None,
-           repetition_penalty=1.0, min_p=0.0):
+           repetition_penalty=1.0, min_p=0.0,
+           return_logprobs=False):
     """Generate ``max_new_tokens`` after ``prompt`` ([B, P] int32).
 
     temperature == 0 is greedy argmax; > 0 samples from
@@ -243,6 +276,13 @@ def decode(model, params, prompt, max_new_tokens, *,
     vector, 0.0 = off) keeps tokens whose probability is at least
     min_p * p_max. All apply after temperature and compose
     (top_k, then top_p, then min_p).
+
+    ``return_logprobs=True`` additionally returns a [B, P + N] f32
+    array of per-token model log-probabilities under the RAW logits
+    (pre-penalty/temperature/filters): entry t is
+    log P(token_t | tokens_<t), entry 0 is 0.0 (no prefix). Prompt
+    positions score the prompt (echo logprobs — perplexity through
+    the same program); the return becomes (sequences, logprobs).
 
     ``repetition_penalty`` (traced scalar or per-row [B] vector,
     1.0 = off): CTRL-style — logits of tokens already in the row
@@ -329,7 +369,8 @@ def decode(model, params, prompt, max_new_tokens, *,
                         sample=sample, fast_prefill=fast_prefill,
                         top_k=top_k, use_top_p=use_top_p,
                         use_eos=use_eos, use_rp=use_rp,
-                        use_min_p=use_min_p)
+                        use_min_p=use_min_p,
+                        use_logprobs=bool(return_logprobs))
 
 
 def greedy_decode(model, params, prompt, max_new_tokens):

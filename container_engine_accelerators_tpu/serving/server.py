@@ -379,7 +379,7 @@ class GenerationServer(_BaseServer):
                 "max_new_tokens": self._max_new,
                 "max_batch": self._max_batch}
 
-    def _run(self, instances, pad_temp, top_k=0):
+    def _run(self, instances, pad_temp, top_k=0, want_lp=False):
         """Decode a micro-batch of (row, temperature, prompt_len,
         top_p, eos_id, rep_penalty) instances through the
         (max_batch, bucket) padded program."""
@@ -415,7 +415,7 @@ class GenerationServer(_BaseServer):
         # so batch composition can't flip program variants); any
         # top_p < 1.0 in the batch selects the nucleus variant (one
         # extra program per bucket, compiled on first use).
-        seq = self._decode(self._model, self._params,
+        out = self._decode(self._model, self._params,
                            jnp.asarray(padded), self._max_new,
                            temperature=temps if pad_temp else 0.0,
                            rng=jax.random.PRNGKey(seed),
@@ -423,11 +423,15 @@ class GenerationServer(_BaseServer):
                            top_k=top_k, top_p=top_ps,
                            eos_id=eos_ids,
                            repetition_penalty=rep_pens,
-                           min_p=min_ps)
-        return np.asarray(seq)[:n]
+                           min_p=min_ps,
+                           return_logprobs=want_lp)
+        if want_lp:
+            seq, lp = out
+            return list(zip(np.asarray(seq)[:n], np.asarray(lp)[:n]))
+        return np.asarray(out)[:n]
 
-    def _batcher_for(self, bucket, sampling, top_k):
-        key = (bucket, sampling, top_k)
+    def _batcher_for(self, bucket, sampling, top_k, want_lp=False):
+        key = (bucket, sampling, top_k, want_lp)
         with self._batchers_lock:
             if self._stopping:
                 return None
@@ -437,7 +441,7 @@ class GenerationServer(_BaseServer):
                     functools.partial(
                         self._run,
                         pad_temp=1.0 if sampling else 0.0,
-                        top_k=top_k),
+                        top_k=top_k, want_lp=want_lp),
                     self._max_batch, self._max_wait_ms)
                 self._batchers[key] = batcher
             return batcher
@@ -472,6 +476,7 @@ class GenerationServer(_BaseServer):
             eos_id = int(payload.get("eos_id", -1))
             rep_pen = float(payload.get("repetition_penalty", 1.0))
             min_p = float(payload.get("min_p", 0.0))
+            want_lp = bool(payload.get("logprobs", False))
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"error": f"bad request: {e}"}
         if not -1 <= eos_id < self._model.vocab_size:
@@ -522,7 +527,8 @@ class GenerationServer(_BaseServer):
                                   f"max {self._buckets[-1]}"}
         padded = np.zeros((arr.shape[0], bucket), np.int32)
         padded[:, :p_len] = arr
-        batcher = self._batcher_for(bucket, temperature > 0.0, top_k)
+        batcher = self._batcher_for(bucket, temperature > 0.0, top_k,
+                                    want_lp)
         if batcher is None:
             return 503, {"error": "server is shutting down"}
         pending = [batcher.submit_async((row, temperature, p_len,
@@ -538,5 +544,13 @@ class GenerationServer(_BaseServer):
             if status != "ok":
                 return 500, {"error": out}
             rows.append(out)
+        if want_lp:
+            seq = np.stack([r[0] for r in rows])
+            lps = np.stack([r[1] for r in rows])
+            return 200, {
+                "sequences": seq[:, :p_len + new].tolist(),
+                "logprobs": [[round(float(x), 6) for x in row]
+                             for row in lps[:, :p_len + new]],
+            }
         seq = np.stack(rows)
         return 200, {"sequences": seq[:, :p_len + new].tolist()}

@@ -568,3 +568,29 @@ def test_windowed_ring_prefill_longer_than_window():
     step = decode(model, params, tokens, N, fast_prefill=False)
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(step))
     _check_greedy_consistency(model, params, fast, P)
+
+
+def test_return_logprobs(dense_lm):
+    """Logprob entries must equal the dense forward's log-softmax at
+    the emitted tokens — prompt (echo) and generated alike — and the
+    fast-prefill path must match stepwise."""
+    model, params, prompt = dense_lm
+    seq, lp = decode(model, params, prompt, N, return_logprobs=True)
+    assert lp.shape == (B, P + N) and lp.dtype == jnp.float32
+
+    logits = model.apply({"params": params}, seq, train=False)
+    want = np.asarray(jax.nn.log_softmax(
+        logits.astype(jnp.float32), -1))
+    got_seq = np.asarray(seq)
+    got_lp = np.asarray(lp)
+    assert (got_lp[:, 0] == 0.0).all()
+    for t in range(1, P + N):
+        ref = want[np.arange(B), t - 1, got_seq[:, t]]
+        np.testing.assert_allclose(got_lp[:, t], ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    seq2, lp2 = decode(model, params, prompt, N, return_logprobs=True,
+                       fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(seq2), got_seq)
+    np.testing.assert_allclose(np.asarray(lp2), got_lp, rtol=1e-4,
+                               atol=1e-4)

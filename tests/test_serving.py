@@ -385,3 +385,14 @@ def test_generate_repetition_penalty(lm_server):
         post(lm_server, "/v1/models/lm:generate",
              {"prompts": [[1]], "repetition_penalty": 0})
     assert err.value.code == 400
+
+
+def test_generate_logprobs(lm_server):
+    out = post(lm_server, "/v1/models/lm:generate",
+               {"prompts": [[2, 4, 6]], "max_new_tokens": 5,
+                "logprobs": True})
+    assert len(out["sequences"][0]) == 8
+    lp = out["logprobs"][0]
+    assert len(lp) == 8
+    assert lp[0] == 0.0
+    assert all(x <= 0.0 for x in lp)
