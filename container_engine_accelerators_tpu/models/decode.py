@@ -223,11 +223,12 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             toks, lps = produced
             # Echo logprobs for the prompt come free from the prefill
             # forward; position 0 has no conditioning prefix (0.0).
-            plp = jax.nn.log_softmax(
-                prefill_logits[:, :-1].astype(jnp.float32), -1)
-            plp = jnp.take_along_axis(
-                plp, prompt[:, 1:, None].astype(jnp.int32),
-                2)[..., 0]
+            # Gather-then-logsumexp keeps the intermediate at [B, P]
+            # instead of a second full [B, P, V] log_softmax copy.
+            pl = prefill_logits[:, :-1].astype(jnp.float32)
+            chosen = jnp.take_along_axis(
+                pl, prompt[:, 1:, None].astype(jnp.int32), 2)[..., 0]
+            plp = chosen - jax.scipy.special.logsumexp(pl, axis=-1)
             first_lp = token_logprob(prefill_logits[:, -1], first)
             seq = jnp.concatenate(
                 [prompt, first[:, None], toks.T], axis=1)

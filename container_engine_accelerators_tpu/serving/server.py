@@ -315,8 +315,11 @@ class GenerationServer(_BaseServer):
     compile); sampling filters add bounded variants compiled on first
     use — top_p one nucleus variant per (bucket, top_k), top_k one
     program per power-of-two value (client values quantize up, so at
-    most log2(vocab) per bucket). Batcher threads follow the same
-    bound: one per (bucket, mode, effective top_k) actually seen.
+    most log2(vocab) per bucket); "logprobs": true doubles a key's
+    variants (its own compiled program + batcher, compiled on first
+    use — warm=True does not precompile them). Batcher threads
+    follow the same bound: one per (bucket, mode, effective top_k,
+    logprobs) actually seen.
     """
 
     def __init__(self, model_name, model, params, port=8500,

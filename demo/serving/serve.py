@@ -182,7 +182,9 @@ def main(argv=None):
             variables = load_checkpoint_variables(args.model_dir,
                                                   variables)
         if args.quantize_weights == "int8":
-            from container_engine_accelerators_tpu.models.quantized                 import convert_params_int8
+            from container_engine_accelerators_tpu.models.quantized import (
+                convert_params_int8,
+            )
             q_model = model.clone(weights="int8")
             template = q_model.init(
                 jax.random.PRNGKey(0),
