@@ -509,9 +509,12 @@ class GenerationServer(_BaseServer):
             return 400, {"error": f"need 1..{self._max_batch} prompts"}
         if len({len(p) for p in prompts}) != 1:
             return 400, {"error": "prompts must share one length"}
-        if new < 1 or new > self._max_new:
+        if new == 0 and not want_lp:
+            return 400, {"error": "max_new_tokens 0 (scoring mode) "
+                                  "requires logprobs: true"}
+        if new < 0 or new > self._max_new:
             return 400, {"error": f"max_new_tokens must be in "
-                                  f"1..{self._max_new}"}
+                                  f"0..{self._max_new}"}
         try:
             arr = np.asarray(prompts, dtype=np.int32)
         except (ValueError, TypeError) as e:

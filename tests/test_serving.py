@@ -396,3 +396,19 @@ def test_generate_logprobs(lm_server):
     assert len(lp) == 8
     assert lp[0] == 0.0
     assert all(x <= 0.0 for x in lp)
+
+
+def test_scoring_mode(lm_server):
+    """max_new_tokens 0 + logprobs = pure prompt scoring
+    (perplexity) through the same decode program."""
+    out = post(lm_server, "/v1/models/lm:generate",
+               {"prompts": [[2, 4, 6, 8]], "max_new_tokens": 0,
+                "logprobs": True})
+    assert out["sequences"][0] == [2, 4, 6, 8]
+    lp = out["logprobs"][0]
+    assert len(lp) == 4 and lp[0] == 0.0
+    assert all(x < 0.0 for x in lp[1:])
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post(lm_server, "/v1/models/lm:generate",
+             {"prompts": [[1, 2]], "max_new_tokens": 0})
+    assert err.value.code == 400
