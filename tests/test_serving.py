@@ -15,6 +15,7 @@
 """Inference-server tests over real HTTP (serving demo parity)."""
 
 import json
+import threading
 import urllib.error
 import urllib.request
 
@@ -412,3 +413,41 @@ def test_scoring_mode(lm_server):
         post(lm_server, "/v1/models/lm:generate",
              {"prompts": [[1, 2]], "max_new_tokens": 0})
     assert err.value.code == 400
+
+
+def test_generate_mixed_traffic_stress(lm_server):
+    """Concurrent requests spanning buckets, sampling modes,
+    filters, penalties, logprobs, and scoring must all succeed with
+    correctly-shaped responses — the expanded batcher-key space under
+    real thread interleaving."""
+    payloads = [
+        {"prompts": [[1, 2]], "max_new_tokens": 3},
+        {"prompts": [[3, 4, 5, 6, 7]], "max_new_tokens": 4,
+         "temperature": 1.0, "top_k": 4},
+        {"prompts": [[8]], "max_new_tokens": 2, "temperature": 0.7,
+         "top_p": 0.9, "repetition_penalty": 1.3},
+        {"prompts": [[9, 10, 11]], "max_new_tokens": 3,
+         "logprobs": True},
+        {"prompts": [[12, 13]], "max_new_tokens": 0,
+         "logprobs": True},
+        {"prompts": [[14, 15, 16]], "max_new_tokens": 5,
+         "temperature": 1.2, "min_p": 0.05, "eos_id": 7},
+    ]
+    results = [None] * (len(payloads) * 3)
+
+    def call(idx, payload):
+        out = post(lm_server, "/v1/models/lm:generate", payload)
+        p_len = len(payload["prompts"][0])
+        want = p_len + payload["max_new_tokens"]
+        ok = len(out["sequences"][0]) == want
+        if payload.get("logprobs"):
+            ok &= len(out["logprobs"][0]) == want
+        results[idx] = ok
+
+    threads = [threading.Thread(target=call, args=(i, payloads[i % len(payloads)]))
+               for i in range(len(results))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(results), results
