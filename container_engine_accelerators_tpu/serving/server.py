@@ -324,10 +324,18 @@ class GenerationServer(_BaseServer):
 
     def __init__(self, model_name, model, params, port=8500,
                  max_new_tokens=64, max_batch=8, buckets=None,
-                 warm=False, max_wait_ms=5):
+                 warm=False, max_wait_ms=5, tokenizer=None):
         super().__init__(model_name, port)
         from ..models.decode import decode
         self._decode = decode
+        # Optional text codec: requests may then carry "text"
+        # (list of strings) instead of "prompts"; responses gain
+        # "completions" with the decoded generated region.
+        self._tokenizer = tokenizer
+        if tokenizer is not None and                 tokenizer.vocab_size > model.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {tokenizer.vocab_size} exceeds "
+                f"model vocab {model.vocab_size}")
         self._model = model
         self._params = params
         self._max_new = max_new_tokens
@@ -471,6 +479,23 @@ class GenerationServer(_BaseServer):
 
     def _handle_post(self, payload):
         try:
+            texts = payload.get("text")
+            if texts is not None:
+                if self._tokenizer is None:
+                    return 400, {"error": "server has no tokenizer; "
+                                          "send token id prompts"}
+                if "prompts" in payload:
+                    return 400, {"error": "send text or prompts, "
+                                          "not both"}
+                if (not isinstance(texts, list)
+                        or not all(isinstance(s, str) for s in texts)):
+                    return 400, {"error": "text must be a list of "
+                                          "strings"}
+                prompts = [self._tokenizer.encode(s) for s in texts]
+                if any(not p for p in prompts):
+                    return 400, {"error": "text rows must encode to "
+                                          "at least one token"}
+                payload = dict(payload, prompts=prompts)
             prompts = payload["prompts"]
             new = int(payload.get("max_new_tokens", self._max_new))
             temperature = float(payload.get("temperature", 0.0))
@@ -553,10 +578,22 @@ class GenerationServer(_BaseServer):
         if want_lp:
             seq = np.stack([r[0] for r in rows])
             lps = np.stack([r[1] for r in rows])
-            return 200, {
+            resp = {
                 "sequences": seq[:, :p_len + new].tolist(),
                 "logprobs": [[round(float(x), 6) for x in row]
                              for row in lps[:, :p_len + new]],
             }
-        seq = np.stack(rows)
-        return 200, {"sequences": seq[:, :p_len + new].tolist()}
+        else:
+            seq = np.stack(rows)
+            resp = {"sequences": seq[:, :p_len + new].tolist()}
+        if texts is not None:
+            # Decoded generated region (eos_id tokens trimmed).
+            gen = seq[:, p_len:p_len + new]
+            comps = []
+            for row in gen:
+                ids = row.tolist()
+                if eos_id >= 0 and eos_id in ids:
+                    ids = ids[:ids.index(eos_id)]
+                comps.append(self._tokenizer.decode(ids))
+            resp["completions"] = comps
+        return 200, resp

@@ -1,0 +1,64 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Tokenizers for text-in/text-out serving.
+
+The serving core works on token ids (one compiled program per shape;
+ids are what the model sees). Tokenization is a host-side codec in
+front of it:
+
+- ``ByteTokenizer``: dependency-free byte-level codec (ByT5-style) —
+  id = utf-8 byte, works with any vocab_size >= 256, never needs
+  vocabulary files. The default for demos/load tests.
+- ``load_tokenizer(spec)``: "byte" or a LOCAL path to a pretrained
+  Hugging Face tokenizer directory (``transformers`` is only
+  imported in that case, and never downloads).
+"""
+
+
+class ByteTokenizer:
+    """id = utf-8 byte value (0..255). Lossless for any text."""
+
+    vocab_size = 256
+
+    def encode(self, text):
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids):
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class _HFTokenizer:
+    """Thin adapter over a local pretrained HF tokenizer."""
+
+    def __init__(self, path):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(
+            path, local_files_only=True)
+        self.vocab_size = int(self._tok.vocab_size)
+
+    def encode(self, text):
+        return list(self._tok.encode(text, add_special_tokens=False))
+
+    def decode(self, ids):
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(spec):
+    """"byte" -> ByteTokenizer; anything else is a local HF path."""
+    if spec == "byte":
+        return ByteTokenizer()
+    return _HFTokenizer(spec)

@@ -124,6 +124,11 @@ def main(argv=None):
                    default="bfloat16",
                    help="int8 halves KV-cache residency per replica "
                         "(~2x servable context/batch)")
+    p.add_argument("--tokenizer", default="",
+                   help="text-in/text-out serving: 'byte' "
+                        "(dependency-free byte-level codec) or a "
+                        "LOCAL Hugging Face tokenizer path; empty "
+                        "serves token ids only")
     p.add_argument("--quantize-weights", choices=["native", "int8"],
                    default="native",
                    help="int8: weight-only quantization of attention "
@@ -210,10 +215,14 @@ def main(argv=None):
             variables = {"params": jax.device_put(
                 variables["params"],
                 param_shardings(mesh, variables["params"]))}
+        tokenizer = None
+        if args.tokenizer:
+            from container_engine_accelerators_tpu.serving.tokenizer                 import load_tokenizer
+            tokenizer = load_tokenizer(args.tokenizer)
         server = GenerationServer(
             name, model, variables["params"], port=args.port,
             max_new_tokens=args.max_new_tokens,
-            max_batch=args.max_batch)
+            max_batch=args.max_batch, tokenizer=tokenizer)
     else:
         model = resnet(depth=args.depth)
         variables = model.init(

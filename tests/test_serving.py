@@ -451,3 +451,52 @@ def test_generate_mixed_traffic_stress(lm_server):
     for t in threads:
         t.join()
     assert all(results), results
+
+
+def test_text_serving_byte_tokenizer():
+    """Text in, text out through the byte tokenizer: encode ->
+    decode round trip plus server-level completions."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+    from container_engine_accelerators_tpu.serving.tokenizer import (
+        ByteTokenizer,
+        load_tokenizer,
+    )
+
+    tok = ByteTokenizer()
+    assert tok.decode(tok.encode("héllo wörld")) == "héllo wörld"
+    assert isinstance(load_tokenizer("byte"), ByteTokenizer)
+
+    model = TransformerLM(vocab_size=300, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm-text", model, params, port=0,
+                           max_new_tokens=8, max_batch=4,
+                           tokenizer=tok)
+    srv.start()
+    try:
+        out = post(srv, "/v1/models/lm-text:generate",
+                   {"text": ["hi"], "max_new_tokens": 4})
+        assert out["sequences"][0][:2] == [104, 105]  # 'h', 'i'
+        assert isinstance(out["completions"][0], str)
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(srv, "/v1/models/lm-text:generate",
+                 {"text": ["hi"], "prompts": [[1]]})
+        assert err.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(srv, "/v1/models/lm-text:generate", {"text": [""]})
+        assert err.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_text_serving_requires_tokenizer(lm_server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post(lm_server, "/v1/models/lm:generate",
+             {"text": ["hello"], "max_new_tokens": 2})
+    assert err.value.code == 400
