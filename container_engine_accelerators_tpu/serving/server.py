@@ -45,30 +45,47 @@ log = get_logger("serving")
 
 
 class _Batcher:
-    """Groups concurrent requests into fixed-size micro-batches."""
+    """Groups concurrent requests into fixed-size micro-batches.
 
-    def __init__(self, run_batch, max_batch, max_wait_ms):
+    ``max_queue`` bounds admitted-but-unserved rows: past it,
+    submit_async returns None and the caller sheds load (503) —
+    under sustained overload that keeps latency bounded and gives
+    the HPA a clean signal instead of a pile of client timeouts.
+    """
+
+    def __init__(self, run_batch, max_batch, max_wait_ms,
+                 max_queue=0):
         self._run = run_batch
         self._max_batch = max_batch
         self._max_wait_s = max_wait_ms / 1000.0
-        self._queue = queue.Queue()
+        self._queue = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="serving-batcher", daemon=True)
         self._thread.start()
 
     def submit(self, instance):
-        return self.submit_async(instance).get()
+        done = self.submit_async(instance)
+        if done is None:
+            return ("error", "server overloaded")
+        return done.get()
 
     def submit_async(self, instance):
-        """Enqueue without blocking; returns the result queue."""
+        """Enqueue without blocking; returns the result queue, or
+        None when the admission queue is full (shed the request)."""
         done = queue.Queue(maxsize=1)
-        self._queue.put((instance, done))
+        try:
+            self._queue.put_nowait((instance, done))
+        except queue.Full:
+            return None
         return done
 
     def stop(self):
         self._stop.set()
-        self._queue.put(None)
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass  # the loop re-checks _stop after every batch
         self._thread.join(timeout=5)
         # Rows enqueued behind the shutdown sentinel would otherwise
         # leave their handler threads blocked on done.get() forever.
@@ -232,10 +249,13 @@ class InferenceServer(_BaseServer):
     """HTTP server around one jitted model apply."""
 
     def __init__(self, model_name, apply_fn, variables, input_shape,
-                 port=8500, max_batch=8, max_wait_ms=5):
+                 port=8500, max_batch=8, max_wait_ms=5,
+                 max_queue=None):
         super().__init__(model_name, port)
         self._input_shape = tuple(input_shape)
         self._max_batch = max_batch
+        if max_queue is None:
+            max_queue = 8 * max_batch
 
         @jax.jit
         def predict(images):
@@ -254,7 +274,8 @@ class InferenceServer(_BaseServer):
             return [{"class": int(c), "score": float(s)}
                     for c, s in zip(classes, scores)]
 
-        self._batcher = _Batcher(run_batch, max_batch, max_wait_ms)
+        self._batcher = _Batcher(run_batch, max_batch, max_wait_ms,
+                                 max_queue=max_queue)
         # Warm the compile cache before accepting traffic.
         run_batch([np.zeros(self._input_shape, dtype=np.float32)])
 
@@ -282,6 +303,8 @@ class InferenceServer(_BaseServer):
         # Enqueue every instance before waiting on any result so one
         # request's instances share micro-batches.
         pending = [self._batcher.submit_async(a) for a in arrays]
+        if any(p is None for p in pending):
+            return 503, {"error": "server overloaded; retry"}
         predictions = []
         for done in pending:
             try:
@@ -324,7 +347,8 @@ class GenerationServer(_BaseServer):
 
     def __init__(self, model_name, model, params, port=8500,
                  max_new_tokens=64, max_batch=8, buckets=None,
-                 warm=False, max_wait_ms=5, tokenizer=None):
+                 warm=False, max_wait_ms=5, tokenizer=None,
+                 max_queue=None):
         super().__init__(model_name, port)
         from ..models.decode import decode
         self._decode = decode
@@ -341,6 +365,8 @@ class GenerationServer(_BaseServer):
         self._max_new = max_new_tokens
         self._max_batch = max_batch
         self._max_wait_ms = max_wait_ms
+        self._max_queue = (8 * max_batch if max_queue is None
+                           else max_queue)
         self._seed = 0
         self._decode_calls = 0
         self._decode_rows = 0
@@ -453,7 +479,8 @@ class GenerationServer(_BaseServer):
                         self._run,
                         pad_temp=1.0 if sampling else 0.0,
                         top_k=top_k, want_lp=want_lp),
-                    self._max_batch, self._max_wait_ms)
+                    self._max_batch, self._max_wait_ms,
+                    max_queue=self._max_queue)
                 self._batchers[key] = batcher
             return batcher
 
@@ -566,6 +593,8 @@ class GenerationServer(_BaseServer):
                                          top_p, eos_id, rep_pen,
                                          min_p))
                    for row in padded]
+        if any(p is None for p in pending):
+            return 503, {"error": "server overloaded; retry"}
         rows = []
         for done in pending:
             try:

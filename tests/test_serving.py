@@ -500,3 +500,34 @@ def test_text_serving_requires_tokenizer(lm_server):
         post(lm_server, "/v1/models/lm:generate",
              {"text": ["hello"], "max_new_tokens": 2})
     assert err.value.code == 400
+
+
+def test_backpressure_sheds_load():
+    """A full admission queue must yield immediate shed (None ->
+    503), not unbounded queueing; accepted work still completes."""
+    import time as _time
+
+    from container_engine_accelerators_tpu.serving.server import (
+        _Batcher,
+    )
+
+    release = threading.Event()
+
+    def slow_run(instances):
+        release.wait(timeout=30)
+        return [i * 2 for (i, ) in [(x,) for x in instances]]
+
+    b = _Batcher(slow_run, max_batch=1, max_wait_ms=1, max_queue=1)
+    try:
+        first = b.submit_async(1)   # picked up by the loop
+        _time.sleep(0.2)            # let the worker dequeue it
+        second = b.submit_async(2)  # fills the queue
+        assert first is not None and second is not None
+        shed = [b.submit_async(n) for n in range(3, 8)]
+        assert any(s is None for s in shed)
+        release.set()
+        assert first.get(timeout=10) == ("ok", 2)
+        assert second.get(timeout=10) == ("ok", 4)
+    finally:
+        release.set()
+        b.stop()
