@@ -131,6 +131,24 @@ def _bwd(residual, g):
 softmax_cross_entropy.defvjp(_fwd, _bwd)
 
 
-def mean_cross_entropy_loss(logits, labels):
-    """Trainer-compatible scalar loss built on the fused kernel."""
-    return jnp.mean(softmax_cross_entropy(logits, labels))
+def mean_cross_entropy_loss(logits, labels, label_smoothing=0.0):
+    """Trainer-compatible scalar loss built on the fused kernel.
+
+    ``label_smoothing`` (epsilon in [0, 1)) mixes the hard target
+    with the uniform distribution. The smooth term decomposes as
+    -mean_c log p_c = logsumexp(logits) - mean(logits), so it layers
+    OUTSIDE the Pallas kernel — the fused hard-target path is
+    untouched and the extra term is two cheap row reductions XLA
+    fuses.
+    """
+    ce = softmax_cross_entropy(logits, labels)
+    if label_smoothing:
+        eps = float(label_smoothing)
+        if not 0.0 <= eps < 1.0:
+            raise ValueError(
+                f"label_smoothing must be in [0, 1): {eps}")
+        lf = logits.astype(jnp.float32)
+        uniform_ce = (jax.scipy.special.logsumexp(lf, axis=-1)
+                      - jnp.mean(lf, axis=-1))
+        ce = (1.0 - eps) * ce + eps * uniform_ce
+    return jnp.mean(ce)

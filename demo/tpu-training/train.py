@@ -29,6 +29,7 @@ Examples:
 """
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -139,6 +140,9 @@ def parse_args(argv=None):
     p.add_argument("--lr-warmup-steps", type=int, default=0)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--label-smoothing", type=float, default=0.0,
+                   help="mix the hard target with the uniform "
+                        "distribution (epsilon in [0, 1))")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--warmup-steps", type=int, default=5,
                    help="steps excluded from throughput timing")
@@ -306,9 +310,10 @@ def build_lm(args, mesh):
     )
     from container_engine_accelerators_tpu.parallel.mesh import DATA_AXIS
 
-    base_loss = next_token_loss_fn(
+    base_loss = next_token_loss_fn(functools.partial(
         mean_cross_entropy_loss if args.pallas_loss
-        else _dense_lm_loss)
+        else _dense_lm_loss,
+        label_smoothing=args.label_smoothing))
     attention_fn = None
     if args.context_parallelism > 1:
         schedule = (ulysses_attention if args.attention == "ulysses"
@@ -333,11 +338,12 @@ def build_lm(args, mesh):
     return model, transformer_mod.make_apply_fn(model), base_loss
 
 
-def _dense_lm_loss(logits, labels):
+def _dense_lm_loss(logits, labels, label_smoothing=0.0):
     from container_engine_accelerators_tpu.parallel.train import (
         cross_entropy_loss,
     )
-    return cross_entropy_loss(logits, labels)
+    return cross_entropy_loss(logits, labels,
+                              label_smoothing=label_smoothing)
 
 
 def build_model(args):
@@ -447,12 +453,16 @@ def main(argv=None):
     else:
         model, apply_fn, image_shape, num_classes = build_model(args)
         if args.pallas_loss and args.model != "inception":
-            loss_fn = mean_cross_entropy_loss
+            loss_fn = functools.partial(
+                mean_cross_entropy_loss,
+                label_smoothing=args.label_smoothing)
         else:
             from container_engine_accelerators_tpu.parallel.train import (
                 cross_entropy_loss,
             )
-            loss_fn = cross_entropy_loss
+            loss_fn = functools.partial(
+                cross_entropy_loss,
+                label_smoothing=args.label_smoothing)
         init_batch = jnp.zeros((1, *image_shape), jnp.float32)
         if args.data_dir:
             # Deferred: skip_batches needs the restored step, and

@@ -443,3 +443,30 @@ def test_device_side_augmentation():
     state, loss0 = trainer.train_step(state, batch)
     state, loss1 = trainer.train_step(state, batch)
     assert np.isfinite(float(loss0)) and np.isfinite(float(loss1))
+
+
+def test_label_smoothing_paths_agree():
+    """Pallas-kernel smoothing (layered logsumexp-mean term) must
+    equal the lax one-hot formulation, and epsilon=0 must be exactly
+    the hard loss."""
+    from container_engine_accelerators_tpu.ops import (
+        mean_cross_entropy_loss,
+    )
+    from container_engine_accelerators_tpu.parallel.train import (
+        cross_entropy_loss,
+    )
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (16, 32))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 32)
+    for eps in (0.0, 0.1, 0.3):
+        a = float(mean_cross_entropy_loss(logits, labels,
+                                          label_smoothing=eps))
+        b = float(cross_entropy_loss(logits, labels,
+                                     label_smoothing=eps))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    hard = float(mean_cross_entropy_loss(logits, labels))
+    np.testing.assert_allclose(
+        hard, float(mean_cross_entropy_loss(logits, labels,
+                                            label_smoothing=0.0)))
+    with pytest.raises(ValueError, match="label_smoothing"):
+        mean_cross_entropy_loss(logits, labels, label_smoothing=1.5)
