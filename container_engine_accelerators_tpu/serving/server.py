@@ -133,6 +133,7 @@ class _BaseServer:
     def __init__(self, model_name, port):
         self._name = model_name
         self._requests = 0
+        self._shed = 0
         self._latencies = []
         self._stats_lock = threading.Lock()
         server = self
@@ -222,6 +223,7 @@ class _BaseServer:
             n = len(lat)
             out = {
                 "requests": self._requests,
+                "shed": self._shed,
                 "p50_ms": round(lat[n // 2] * 1000, 3) if n else None,
                 "p99_ms": round(lat[int(n * 0.99)] * 1000, 3)
                 if n else None,
@@ -304,6 +306,8 @@ class InferenceServer(_BaseServer):
         # request's instances share micro-batches.
         pending = [self._batcher.submit_async(a) for a in arrays]
         if any(p is None for p in pending):
+            with self._stats_lock:
+                self._shed += 1
             return 503, {"error": "server overloaded; retry"}
         predictions = []
         for done in pending:
@@ -594,6 +598,8 @@ class GenerationServer(_BaseServer):
                                          min_p))
                    for row in padded]
         if any(p is None for p in pending):
+            with self._stats_lock:
+                self._shed += 1
             return 503, {"error": "server overloaded; retry"}
         rows = []
         for done in pending:
