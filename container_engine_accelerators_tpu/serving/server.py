@@ -48,9 +48,11 @@ class _Batcher:
     """Groups concurrent requests into fixed-size micro-batches.
 
     ``max_queue`` bounds admitted-but-unserved rows: past it,
-    submit_async returns None and the caller sheds load (503) —
-    under sustained overload that keeps latency bounded and gives
-    the HPA a clean signal instead of a pile of client timeouts.
+    submissions shed (the caller returns 503) — under sustained
+    overload that keeps latency bounded and gives the HPA a clean
+    signal instead of a pile of client timeouts. Admission is
+    all-or-nothing per request (``submit_many``), so a shed request
+    never leaves orphaned rows burning device time.
     """
 
     def __init__(self, run_batch, max_batch, max_wait_ms,
@@ -58,7 +60,9 @@ class _Batcher:
         self._run = run_batch
         self._max_batch = max_batch
         self._max_wait_s = max_wait_ms / 1000.0
-        self._queue = queue.Queue(maxsize=max_queue)
+        self._queue = queue.Queue()
+        self._admit_lock = threading.Lock()
+        self._free = max_queue if max_queue else float("inf")
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="serving-batcher", daemon=True)
@@ -70,22 +74,31 @@ class _Batcher:
             return ("error", "server overloaded")
         return done.get()
 
+    def submit_many(self, instances):
+        """Admit all rows or none: returns the result queues, or
+        None when admitting them would exceed the bound."""
+        with self._admit_lock:
+            if len(instances) > self._free:
+                return None
+            self._free -= len(instances)
+        dones = []
+        for instance in instances:
+            done = queue.Queue(maxsize=1)
+            self._queue.put((instance, done))
+            dones.append(done)
+        return dones
+
     def submit_async(self, instance):
-        """Enqueue without blocking; returns the result queue, or
-        None when the admission queue is full (shed the request)."""
-        done = queue.Queue(maxsize=1)
-        try:
-            self._queue.put_nowait((instance, done))
-        except queue.Full:
-            return None
-        return done
+        out = self.submit_many([instance])
+        return out[0] if out else None
+
+    def _release(self, n):
+        with self._admit_lock:
+            self._free += n
 
     def stop(self):
         self._stop.set()
-        try:
-            self._queue.put_nowait(None)
-        except queue.Full:
-            pass  # the loop re-checks _stop after every batch
+        self._queue.put(None)
         self._thread.join(timeout=5)
         # Rows enqueued behind the shutdown sentinel would otherwise
         # leave their handler threads blocked on done.get() forever.
@@ -124,6 +137,8 @@ class _Batcher:
                 log.exception("batch inference failed")
                 for _, done in batch:
                     done.put(("error", str(e)))
+            finally:
+                self._release(len(batch))
 
 
 class _BaseServer:
@@ -304,8 +319,8 @@ class InferenceServer(_BaseServer):
             arrays.append(arr)
         # Enqueue every instance before waiting on any result so one
         # request's instances share micro-batches.
-        pending = [self._batcher.submit_async(a) for a in arrays]
-        if any(p is None for p in pending):
+        pending = self._batcher.submit_many(arrays)
+        if pending is None:
             with self._stats_lock:
                 self._shed += 1
             return 503, {"error": "server overloaded; retry"}
@@ -360,7 +375,8 @@ class GenerationServer(_BaseServer):
         # (list of strings) instead of "prompts"; responses gain
         # "completions" with the decoded generated region.
         self._tokenizer = tokenizer
-        if tokenizer is not None and                 tokenizer.vocab_size > model.vocab_size:
+        if (tokenizer is not None
+                and tokenizer.vocab_size > model.vocab_size):
             raise ValueError(
                 f"tokenizer vocab {tokenizer.vocab_size} exceeds "
                 f"model vocab {model.vocab_size}")
@@ -563,7 +579,7 @@ class GenerationServer(_BaseServer):
                         self._model.vocab_size)
         if not prompts or len(prompts) > self._max_batch:
             return 400, {"error": f"need 1..{self._max_batch} prompts"}
-        if len({len(p) for p in prompts}) != 1:
+        if texts is None and len({len(p) for p in prompts}) != 1:
             return 400, {"error": "prompts must share one length"}
         if new == 0 and not want_lp:
             return 400, {"error": "max_new_tokens 0 (scoring mode) "
@@ -572,7 +588,18 @@ class GenerationServer(_BaseServer):
             return 400, {"error": f"max_new_tokens must be in "
                                   f"0..{self._max_new}"}
         try:
-            arr = np.asarray(prompts, dtype=np.int32)
+            if texts is not None:
+                # Text rows may be ragged: right-pad to the widest
+                # row; per-row true lengths ride with each instance.
+                width = max(len(p) for p in prompts)
+                arr = np.zeros((len(prompts), width), np.int32)
+                p_lens = []
+                for r, p in enumerate(prompts):
+                    arr[r, :len(p)] = np.asarray(p, np.int32)
+                    p_lens.append(len(p))
+            else:
+                arr = np.asarray(prompts, dtype=np.int32)
+                p_lens = [arr.shape[1]] * arr.shape[0]
         except (ValueError, TypeError) as e:
             return 400, {"error": f"bad prompt tokens: {e}"}
         if arr.ndim != 2 or arr.shape[1] < 1:
@@ -593,11 +620,11 @@ class GenerationServer(_BaseServer):
                                     want_lp)
         if batcher is None:
             return 503, {"error": "server is shutting down"}
-        pending = [batcher.submit_async((row, temperature, p_len,
-                                         top_p, eos_id, rep_pen,
-                                         min_p))
-                   for row in padded]
-        if any(p is None for p in pending):
+        pending = batcher.submit_many(
+            [(row, temperature, int(pl), top_p, eos_id, rep_pen,
+              min_p)
+             for row, pl in zip(padded, p_lens)])
+        if pending is None:
             with self._stats_lock:
                 self._shed += 1
             return 503, {"error": "server overloaded; retry"}
@@ -614,19 +641,21 @@ class GenerationServer(_BaseServer):
             seq = np.stack([r[0] for r in rows])
             lps = np.stack([r[1] for r in rows])
             resp = {
-                "sequences": seq[:, :p_len + new].tolist(),
-                "logprobs": [[round(float(x), 6) for x in row]
-                             for row in lps[:, :p_len + new]],
+                "sequences": [s[:pl + new].tolist()
+                              for s, pl in zip(seq, p_lens)],
+                "logprobs": [[round(float(x), 6)
+                              for x in row[:pl + new]]
+                             for row, pl in zip(lps, p_lens)],
             }
         else:
             seq = np.stack(rows)
-            resp = {"sequences": seq[:, :p_len + new].tolist()}
+            resp = {"sequences": [s[:pl + new].tolist()
+                                  for s, pl in zip(seq, p_lens)]}
         if texts is not None:
             # Decoded generated region (eos_id tokens trimmed).
-            gen = seq[:, p_len:p_len + new]
             comps = []
-            for row in gen:
-                ids = row.tolist()
+            for row, pl in zip(seq, p_lens):
+                ids = row[pl:pl + new].tolist()
                 if eos_id >= 0 and eos_id in ids:
                     ids = ids[:ids.index(eos_id)]
                 comps.append(self._tokenizer.decode(ids))

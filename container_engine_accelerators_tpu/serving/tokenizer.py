@@ -36,8 +36,19 @@ class ByteTokenizer:
         return list(text.encode("utf-8"))
 
     def decode(self, ids):
-        data = bytes(i for i in ids if 0 <= i < 256)
-        return data.decode("utf-8", errors="replace")
+        # Ids outside the byte range (a model vocab may exceed 256)
+        # become U+FFFD rather than silently vanishing.
+        out = []
+        run = bytearray()
+        for i in ids:
+            if 0 <= i < 256:
+                run.append(i)
+            else:
+                out.append(run.decode("utf-8", errors="replace"))
+                run = bytearray()
+                out.append("\ufffd")
+        out.append(run.decode("utf-8", errors="replace"))
+        return "".join(out)
 
 
 class _HFTokenizer:
@@ -48,7 +59,10 @@ class _HFTokenizer:
 
         self._tok = AutoTokenizer.from_pretrained(
             path, local_files_only=True)
-        self.vocab_size = int(self._tok.vocab_size)
+        # len() includes added/special tokens; .vocab_size does not,
+        # and an added token would then sail past the server's
+        # model-vocab guard.
+        self.vocab_size = int(len(self._tok))
 
     def encode(self, text):
         return list(self._tok.encode(text, add_special_tokens=False))

@@ -217,7 +217,8 @@ def main(argv=None):
                 param_shardings(mesh, variables["params"]))}
         tokenizer = None
         if args.tokenizer:
-            from container_engine_accelerators_tpu.serving.tokenizer                 import load_tokenizer
+            from container_engine_accelerators_tpu.serving.tokenizer \
+                import load_tokenizer
             tokenizer = load_tokenizer(args.tokenizer)
         server = GenerationServer(
             name, model, variables["params"], port=args.port,

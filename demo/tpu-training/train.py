@@ -140,7 +140,14 @@ def parse_args(argv=None):
     p.add_argument("--lr-warmup-steps", type=int, default=0)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
-    p.add_argument("--label-smoothing", type=float, default=0.0,
+    def _smoothing(v):
+        v = float(v)
+        if not 0.0 <= v < 1.0:
+            raise argparse.ArgumentTypeError(
+                f"label smoothing must be in [0, 1): {v}")
+        return v
+
+    p.add_argument("--label-smoothing", type=_smoothing, default=0.0,
                    help="mix the hard target with the uniform "
                         "distribution (epsilon in [0, 1))")
     p.add_argument("--steps", type=int, default=100)

@@ -517,17 +517,70 @@ def test_backpressure_sheds_load():
         release.wait(timeout=30)
         return [i * 2 for (i, ) in [(x,) for x in instances]]
 
-    b = _Batcher(slow_run, max_batch=1, max_wait_ms=1, max_queue=1)
+    b = _Batcher(slow_run, max_batch=1, max_wait_ms=1, max_queue=2)
     try:
-        first = b.submit_async(1)   # picked up by the loop
-        _time.sleep(0.2)            # let the worker dequeue it
-        second = b.submit_async(2)  # fills the queue
+        first = b.submit_async(1)
+        second = b.submit_async(2)
         assert first is not None and second is not None
-        shed = [b.submit_async(n) for n in range(3, 8)]
-        assert any(s is None for s in shed)
+        # The bound covers in-flight + queued rows: nothing else fits
+        # until a row finishes, and admission is all-or-nothing (a
+        # 2-row request cannot half-land).
+        assert b.submit_async(3) is None
+        assert b.submit_many([4, 5]) is None
         release.set()
         assert first.get(timeout=10) == ("ok", 2)
         assert second.get(timeout=10) == ("ok", 4)
+        # Completion releases permits; admission works again.
+        for _ in range(50):
+            nxt = b.submit_async(6)
+            if nxt is not None:
+                break
+            _time.sleep(0.1)
+        assert nxt is not None
+        assert nxt.get(timeout=10) == ("ok", 12)
     finally:
         release.set()
         b.stop()
+
+
+def test_text_serving_ragged_batch():
+    """Text rows of different lengths pad per row and trim per row —
+    the raggedness every real text batch has."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+    from container_engine_accelerators_tpu.serving.tokenizer import (
+        ByteTokenizer,
+    )
+
+    model = TransformerLM(vocab_size=300, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm-rag", model, params, port=0,
+                           max_new_tokens=8, max_batch=4,
+                           tokenizer=ByteTokenizer())
+    srv.start()
+    try:
+        out = post(srv, "/v1/models/lm-rag:generate",
+                   {"text": ["hi", "hello"], "max_new_tokens": 3,
+                    "logprobs": True})
+        assert len(out["sequences"][0]) == 2 + 3
+        assert len(out["sequences"][1]) == 5 + 3
+        assert len(out["logprobs"][0]) == 5
+        assert len(out["logprobs"][1]) == 8
+        assert out["sequences"][0][:2] == [104, 105]
+        assert len(out["completions"]) == 2
+    finally:
+        srv.stop()
+
+
+def test_byte_tokenizer_out_of_range_marker():
+    from container_engine_accelerators_tpu.serving.tokenizer import (
+        ByteTokenizer,
+    )
+
+    tok = ByteTokenizer()
+    assert tok.decode([104, 105, 290, 33]) == "hi�!"
