@@ -66,6 +66,10 @@ def main(argv=None):
                    help="sliding-window attention width (0 = full)")
     p.add_argument("--kv-cache-dtype", choices=["bfloat16", "int8"],
                    default="bfloat16")
+    p.add_argument("--quantize-weights", choices=["native", "int8"],
+                   default="native",
+                   help="weight-only int8 projections/MLPs (the "
+                        "serving load-time conversion)")
     args = p.parse_args(argv)
 
     from container_engine_accelerators_tpu.models import TransformerLM
@@ -84,6 +88,15 @@ def main(argv=None):
         key, jnp.zeros((1, 8), jnp.int32), train=False)["params"],
     )(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
+    if args.quantize_weights == "int8":
+        from container_engine_accelerators_tpu.models.quantized import (
+            convert_params_int8,
+        )
+        model = model.clone(weights="int8")
+        template = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), jnp.int32), train=False)["params"]
+        params = convert_params_int8(template, params)
 
     for b in args.batch:
         prompt = jax.random.randint(
@@ -108,6 +121,7 @@ def main(argv=None):
             "embed_dim": args.embed_dim,
             "kv_cache_dtype": args.kv_cache_dtype,
             "num_kv_heads": args.num_kv_heads or args.num_heads,
+            "weights": args.quantize_weights,
             "pos_embedding": args.pos_embedding,
             "attention_window": args.attention_window,
             "platform": jax.devices()[0].platform,

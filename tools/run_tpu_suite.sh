@@ -51,6 +51,8 @@ echo "[suite] decode bench (bf16 + int8 cache + GQA + window)" >&2
     --num-kv-heads 2 --pos-embedding rope
   timeout 1800 python tools/bench_decode.py --batch 8 \
     --prompt-len 128 --new-tokens 128 --attention-window 64
+  timeout 1800 python tools/bench_decode.py --batch 1 8 \
+    --prompt-len 128 --new-tokens 128 --quantize-weights int8
 } > "${OUT}/DECODE_BENCH.json" 2>> "${OUT}/tpu_suite.log"
 cat "${OUT}/DECODE_BENCH.json" >&2
 
