@@ -48,6 +48,9 @@ class TrainState:
     params: Any
     opt_state: Any
     batch_stats: Any  # BatchNorm running stats; empty dict if unused
+    # Polyak/EMA shadow of params (Trainer(ema_decay=...)); None when
+    # EMA is off, so existing checkpoints and states are unaffected.
+    ema_params: Any = None
 
 
 class Trainer:
@@ -59,9 +62,12 @@ class Trainer:
 
     def __init__(self, apply_fn, loss_fn, optimizer, mesh=None,
                  donate_state=True, remat=False, grad_accum=1,
-                 augment_fn=None):
+                 augment_fn=None, ema_decay=0.0):
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1: {grad_accum}")
+        if not 0.0 <= ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in [0, 1): {ema_decay}")
         self._apply = apply_fn
         self._loss = loss_fn
         self._tx = optimizer
@@ -74,6 +80,9 @@ class Trainer:
         # folded from the step counter — reproducible, and resume
         # continues the exact augmentation stream.
         self._augment = augment_fn
+        # EMA shadow params updated inside the compiled step; use
+        # eval_params(state) to read the weights eval should see.
+        self._ema_decay = float(ema_decay)
         self._train_step = None
         self._state_shardings = None
 
@@ -91,10 +100,14 @@ class Trainer:
         params = init_variables["params"]
         batch_stats = init_variables.get("batch_stats", {})
 
+        ema = self._ema_decay
+
         def make_state(params, batch_stats):
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=self._tx.init(params),
-                              batch_stats=batch_stats)
+                              batch_stats=batch_stats,
+                              ema_params=(jax.tree_util.tree_map(
+                                  lambda p: p, params) if ema else None))
 
         abstract = jax.eval_shape(make_state, params, batch_stats)
         shardings = self.state_shardings(abstract)
@@ -121,6 +134,8 @@ class Trainer:
                 opt_state=jax.tree_util.tree_map(opt_shard, state.opt_state),
                 batch_stats=jax.tree_util.tree_map(
                     lambda _: rep, state.batch_stats),
+                ema_params=(p_shard if state.ema_params is not None
+                            else None),
             )
         return self._state_shardings
 
@@ -137,8 +152,8 @@ class Trainer:
         tx = self._tx
 
         accum = self._grad_accum
-
         augment = self._augment
+        ema_decay = self._ema_decay
 
         def step_fn(state, batch):
             images, labels = batch
@@ -212,8 +227,14 @@ class Trainer:
 
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+            new_ema = state.ema_params
+            if ema_decay and new_ema is not None:
+                new_ema = jax.tree_util.tree_map(
+                    lambda e, p: e * ema_decay + p * (1.0 - ema_decay),
+                    new_ema, new_params)
             new_state = TrainState(step=state.step + 1, params=new_params,
-                                   opt_state=new_opt, batch_stats=new_stats)
+                                   opt_state=new_opt, batch_stats=new_stats,
+                                   ema_params=new_ema)
             return new_state, loss
 
         shardings = self.state_shardings(state)
@@ -232,12 +253,28 @@ class Trainer:
             self._train_step = self._build_train_step(state)
         return self._train_step(state, batch)
 
+    def eval_params(self, state):
+        """Weights eval/serving should read: the EMA shadow when it
+        is being tracked, the live params otherwise."""
+        if self._ema_decay and state.ema_params is not None:
+            return state.ema_params
+        return state.params
+
+    def ensure_ema(self, state):
+        """Seed the EMA shadow from params if missing — used after
+        restoring a checkpoint written without EMA."""
+        if self._ema_decay and state.ema_params is None:
+            return dataclasses.replace(state,
+                                       ema_params=state.params)
+        return state
+
     @functools.cached_property
     def eval_step(self):
         apply = self._apply
+        eval_params = self.eval_params
 
         def step_fn(state, images):
-            variables = {"params": state.params}
+            variables = {"params": eval_params(state)}
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
             logits, _ = apply(variables, images, False)

@@ -147,6 +147,11 @@ def parse_args(argv=None):
                 f"label smoothing must be in [0, 1): {v}")
         return v
 
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="track an EMA (Polyak) shadow of the params "
+                        "inside the compiled step; eval and the "
+                        "final checkpoint's ema_params use it "
+                        "(0 = off)")
     p.add_argument("--label-smoothing", type=_smoothing, default=0.0,
                    help="mix the hard target with the uniform "
                         "distribution (epsilon in [0, 1))")
@@ -231,11 +236,12 @@ def save_checkpoint(model_dir, state):
     background (finalize_checkpoints() joins it)."""
     step = int(state.step)
     path = os.path.abspath(os.path.join(model_dir, f"checkpoint_{step}"))
-    _checkpointer().save(
-        path,
-        {"step": step, "params": state.params,
-         "opt_state": state.opt_state, "batch_stats": state.batch_stats},
-        force=True)
+    payload = {"step": step, "params": state.params,
+               "opt_state": state.opt_state,
+               "batch_stats": state.batch_stats}
+    if state.ema_params is not None:
+        payload["ema_params"] = state.ema_params
+    _checkpointer().save(path, payload, force=True)
     print(f"saving checkpoint {path} (async)", file=sys.stderr)
     return path
 
@@ -290,15 +296,29 @@ def restore_checkpoint(model_dir, state):
     if not entries:
         return state
     path = os.path.abspath(os.path.join(model_dir, entries[-1][1]))
-    restored = ocp.PyTreeCheckpointer().restore(path, item={
-        "step": 0, "params": state.params,
-        "opt_state": state.opt_state, "batch_stats": state.batch_stats})
+    item = {"step": 0, "params": state.params,
+            "opt_state": state.opt_state,
+            "batch_stats": state.batch_stats}
+    ema = None
+    if state.ema_params is not None:
+        # EMA-tracking run: prefer restoring the shadow too (written
+        # by EMA-enabled runs); checkpoints from before EMA lack the
+        # key, in which case the caller re-seeds via ensure_ema.
+        try:
+            restored = ocp.PyTreeCheckpointer().restore(
+                path, item=dict(item, ema_params=state.ema_params))
+            ema = restored["ema_params"]
+        except Exception:
+            restored = ocp.PyTreeCheckpointer().restore(path, item=item)
+    else:
+        restored = ocp.PyTreeCheckpointer().restore(path, item=item)
     print(f"restored checkpoint {path}", file=sys.stderr)
     import jax.numpy as _jnp
     return TrainState(step=_jnp.asarray(restored["step"], _jnp.int32),
                       params=restored["params"],
                       opt_state=restored["opt_state"],
-                      batch_stats=restored["batch_stats"])
+                      batch_stats=restored["batch_stats"],
+                      ema_params=ema)
 
 
 def build_lm(args, mesh):
@@ -521,7 +541,8 @@ def main(argv=None):
             augment_fn = make_augment_fn(
                 flip=True, crop_padding=args.crop_padding)
     trainer = Trainer(apply_fn, loss_fn, tx, mesh=mesh, remat=args.remat,
-                      grad_accum=args.grad_accum, augment_fn=augment_fn)
+                      grad_accum=args.grad_accum, augment_fn=augment_fn,
+                      ema_decay=args.ema_decay)
 
     variables = model.init(jax.random.PRNGKey(0), init_batch, train=False)
     state = trainer.init_state(variables)
@@ -533,6 +554,10 @@ def main(argv=None):
         else:
             state = jax.device_put(restore_checkpoint(args.model_dir, state),
                                    trainer.state_shardings(state))
+            # Checkpoints written without EMA restore with
+            # ema_params=None; re-seed the shadow from the restored
+            # params so tracking just continues.
+            state = trainer.ensure_ema(state)
     if loader is None:
         # Real-data loader, deferred above: resume fast-forwards the
         # shard stream past the batches the restored step already

@@ -470,3 +470,68 @@ def test_label_smoothing_paths_agree():
                                             label_smoothing=0.0)))
     with pytest.raises(ValueError, match="label_smoothing"):
         mean_cross_entropy_loss(logits, labels, label_smoothing=1.5)
+
+
+def test_ema_shadow_params():
+    """EMA tracking: shadow follows the decay recursion exactly,
+    eval reads the shadow, ensure_ema seeds a restored state, and
+    ema off leaves the state shape untouched."""
+    import dataclasses
+
+    import optax
+
+    from container_engine_accelerators_tpu.parallel.train import (
+        cross_entropy_loss,
+    )
+
+    model = MnistMLP(hidden=16, dtype=jnp.float32)
+    apply_fn = mlp_mod.make_apply_fn(model)
+    mesh = build_mesh()
+    decay = 0.9
+    trainer = Trainer(apply_fn, cross_entropy_loss, optax.sgd(0.1),
+                      mesh=mesh, ema_decay=decay, donate_state=False)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8, 8, 1)), train=False)
+    state = trainer.init_state(variables)
+    assert state.ema_params is not None
+
+    batch = (jax.random.normal(jax.random.PRNGKey(1), (8, 8, 8, 1)),
+             jnp.zeros((8,), jnp.int32))
+    expect = jax.tree_util.tree_map(lambda p: np.asarray(p),
+                                    state.params)
+    s = state
+    for _ in range(3):
+        prev = jax.tree_util.tree_map(np.asarray, s.params)
+        s, _ = trainer.train_step(s, batch)
+        expect = jax.tree_util.tree_map(
+            lambda e, p: e * decay + np.asarray(p) * (1 - decay),
+            expect, s.params)
+    for got, want in zip(jax.tree_util.tree_leaves(s.ema_params),
+                         jax.tree_util.tree_leaves(expect)):
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    # eval reads the shadow
+    images = batch[0]
+    logits = trainer.eval_step(s, images)
+    want_logits, _ = apply_fn({"params": s.ema_params}, images, False)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(want_logits), rtol=1e-5,
+                               atol=1e-5)
+
+    # ensure_ema seeds a shadow-less state (old checkpoint restore)
+    bare = dataclasses.replace(s, ema_params=None)
+    seeded = trainer.ensure_ema(bare)
+    for a, b in zip(jax.tree_util.tree_leaves(seeded.ema_params),
+                    jax.tree_util.tree_leaves(bare.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # off: no shadow anywhere
+    t2 = Trainer(apply_fn, cross_entropy_loss, optax.sgd(0.1),
+                 mesh=mesh)
+    s2 = t2.init_state(variables)
+    assert s2.ema_params is None
+    assert t2.eval_params(s2) is s2.params
+    with pytest.raises(ValueError, match="ema_decay"):
+        Trainer(apply_fn, cross_entropy_loss, optax.sgd(0.1),
+                mesh=mesh, ema_decay=1.0)
