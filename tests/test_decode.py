@@ -594,3 +594,44 @@ def test_return_logprobs(dense_lm):
     np.testing.assert_array_equal(np.asarray(seq2), got_seq)
     np.testing.assert_allclose(np.asarray(lp2), got_lp, rtol=1e-4,
                                atol=1e-4)
+
+
+def test_decode_option_fuzz():
+    """Random combinations of every sampling/penalty/filter option on
+    a GQA+RoPE model: outputs must always be valid vocab ids with the
+    prompt preserved, logprob arrays finite-or-zero and aligned —
+    the 'options compose' invariant no pairwise test covers."""
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, num_kv_heads=2,
+                          pos_embedding="rope", max_seq_len=MAXLEN,
+                          dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(20), (B, P), 0, V)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    rng = np.random.RandomState(0)
+    for trial in range(8):
+        sample = bool(rng.rand() < 0.7)
+        kwargs = dict(
+            temperature=float(rng.uniform(0.3, 1.5)) if sample else 0.0,
+            top_k=int(rng.choice([0, 4, 8])) if sample else 0,
+            top_p=float(rng.choice([1.0, 0.9])) if sample else 1.0,
+            min_p=float(rng.choice([0.0, 0.05])) if sample else 0.0,
+            repetition_penalty=float(rng.choice([1.0, 1.3])),
+            eos_id=int(rng.choice([-1, 3])),
+            return_logprobs=bool(rng.rand() < 0.5),
+            rng=jax.random.PRNGKey(trial),
+        )
+        if kwargs["eos_id"] < 0:
+            kwargs.pop("eos_id")
+        out = decode(model, params, tokens, 6, **kwargs)
+        if kwargs["return_logprobs"]:
+            seq, lp = out
+            got_lp = np.asarray(lp)
+            assert got_lp.shape == (B, P + 6)
+            assert np.isfinite(got_lp).all()
+            assert (got_lp[:, 0] == 0.0).all()
+        else:
+            seq = out
+        got = np.asarray(seq)
+        assert got.shape == (B, P + 6)
+        np.testing.assert_array_equal(got[:, :P], np.asarray(tokens))
+        assert got.min() >= 0 and got.max() < V, (trial, kwargs)
