@@ -72,13 +72,16 @@ TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", "100"))
 IMAGE_SIZE = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
 DEPTH = int(os.environ.get("BENCH_DEPTH", "50"))
 
-ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+# The tunneled backend has multi-hour outages; 6 attempts with linear
+# backoff (100s * attempt => 100..500s, ~25 min of spread) rides out
+# short outages instead of burning all attempts in the first minute.
+ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "6"))
 # Child phase budgets (child()): init 300 + probe 300 + build 600 +
 # compile 600 + measure 600 = 2400s; the attempt timeout must cover
 # their sum plus slack so a child that honors every per-phase alarm
 # is never killed mid-measure by its own supervisor.
 ATTEMPT_TIMEOUT_S = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "2600"))
-BACKOFF_S = float(os.environ.get("BENCH_BACKOFF_S", "20"))
+BACKOFF_S = float(os.environ.get("BENCH_BACKOFF_S", "100"))
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
 
 METRIC = "resnet50_train_throughput"
@@ -86,8 +89,20 @@ UNIT = "images/sec/chip"
 TARGET = REFERENCE_IMG_PER_SEC_PER_CHIP * TARGET_FRACTION
 
 
+_STEP_LOG_FH = None
+
+
 def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+    global _STEP_LOG_FH
+    if _STEP_LOG_FH is None and os.environ.get("BENCH_STEP_LOG"):
+        try:
+            _STEP_LOG_FH = open(os.environ["BENCH_STEP_LOG"], "a")
+        except OSError:
+            _STEP_LOG_FH = False
+    if _STEP_LOG_FH:
+        _STEP_LOG_FH.write(f"[bench] {msg}\n")
+        _STEP_LOG_FH.flush()
 
 
 # ---------------------------------------------------------------------------
@@ -130,9 +145,24 @@ def probe():
     return 0
 
 
+def _artifact_names():
+    """(artifact json, step-log path) for this config, or (None, None)
+    for smoke configs whose numbers must never overwrite the committed
+    on-chip record."""
+    if (os.environ.get("BENCH_PLATFORMS") == "cpu"
+            or IMAGE_SIZE != 224 or DEPTH != 50
+            or WARMUP_STEPS < 5 or TIMED_STEPS < 50):
+        return None, None
+    variant = "DEFAULT" if BATCH_PER_CHIP == 128 else f"B{BATCH_PER_CHIP}"
+    root = os.path.dirname(os.path.abspath(__file__))
+    return (os.path.join(root, f"TPU_BENCH_{variant}.json"),
+            os.path.join(root, "logs", f"TPU_BENCH_{variant}.steplog.txt"))
+
+
 def supervise():
     errors = []
     phase = "unknown"
+    artifact_path, step_log = _artifact_names()
     for attempt in range(1, ATTEMPTS + 1):
         if not _backend_probe():
             errors.append(f"attempt {attempt}: backend probe "
@@ -147,6 +177,15 @@ def supervise():
         fd, status_path = tempfile.mkstemp(prefix="bench_status_")
         os.close(fd)
         env = dict(os.environ, BENCH_STATUS_FILE=status_path)
+        if step_log:
+            # Write to a sidecar and promote only on success so a
+            # failed retry never destroys the log the committed
+            # artifact points at.
+            os.makedirs(os.path.dirname(step_log), exist_ok=True)
+            with open(step_log + ".tmp", "w") as f:
+                f.write(f"# bench attempt {attempt}, "
+                        f"argv={sys.argv}\n")
+            env["BENCH_STEP_LOG"] = step_log + ".tmp"
         _log(f"attempt {attempt}/{ATTEMPTS} "
              f"(timeout {ATTEMPT_TIMEOUT_S:.0f}s)")
         t0 = time.monotonic()
@@ -164,11 +203,15 @@ def supervise():
         os.unlink(status_path)
         if rc == 0:
             line = _last_json_line(out)
-            if line is not None:
+            if line is not None and not _cpu_fallback(line):
+                _refresh_artifact(line, artifact_path, step_log)
+                _cleanup_tmp(step_log)
                 print(json.dumps(line), flush=True)
                 return 0
-            rc = -2
-        errors.append(f"attempt {attempt}: rc={rc} phase={phase}")
+            rc = -3 if line is not None else -2
+        _cleanup_tmp(step_log)
+        errors.append(f"attempt {attempt}: rc={rc} phase={phase}" + (
+            " (CPU fallback, not a TPU measurement)" if rc == -3 else ""))
         _log(errors[-1])
         if attempt < ATTEMPTS:
             delay = BACKOFF_S * attempt
@@ -191,6 +234,58 @@ def supervise():
         pass
     print(json.dumps(diag), flush=True)
     return 1
+
+
+def _cpu_fallback(line):
+    """True when a "successful" child actually measured host CPU.
+
+    The axon sitecustomize pins jax_platforms="axon,cpu": when the
+    tunnel is down jax falls back to CPU and the run still exits 0. A
+    CPU number must neither be reported as the TPU measurement nor
+    overwrite the committed on-chip record. Explicit BENCH_PLATFORMS=
+    cpu (smoke tests) opts out — there CPU is the requested platform.
+    """
+    if os.environ.get("BENCH_PLATFORMS") == "cpu":
+        return False
+    devices = (line.get("provenance") or {}).get("devices") or []
+    return not devices or any("cpu" in d.lower() for d in devices)
+
+
+def _cleanup_tmp(step_log):
+    """Drop the attempt's un-promoted step-log sidecar (a successful
+    refresh os.replace()s it away; failures must not leave it next to
+    the committed audit trail)."""
+    if step_log:
+        try:
+            os.unlink(step_log + ".tmp")
+        except OSError:
+            pass
+
+
+def _refresh_artifact(line, artifact_path, step_log):
+    """Persist a successful on-chip measurement with its provenance so
+    the committed record always has a same-round, auditable capture
+    (VERDICT r2 #1: artifacts without UTC/device/sha/step-log are
+    unfalsifiable)."""
+    if not artifact_path or "provenance" not in line:
+        return
+    row = dict(line)
+    rel_log = os.path.relpath(step_log, os.path.dirname(artifact_path))
+    row["provenance"] = dict(row["provenance"], step_log=rel_log)
+    try:
+        # Stage the artifact fully before promoting either file, and
+        # promote the log first only once the artifact bytes exist —
+        # so a partial failure can never leave the committed artifact
+        # pointing at a mismatched step log.
+        with open(artifact_path + ".tmp", "w") as f:
+            json.dump(row, f, indent=1)
+            f.write("\n")
+        os.replace(step_log + ".tmp", step_log)
+        os.replace(artifact_path + ".tmp", artifact_path)
+        _log(f"refreshed {os.path.basename(artifact_path)} "
+             f"(step log: {rel_log})")
+    except OSError as e:
+        _log(f"artifact refresh failed: {e}")
 
 
 def _read_status(path):
@@ -377,11 +472,16 @@ def child():
 
     images_per_sec = global_batch * TIMED_STEPS / elapsed
     per_chip = images_per_sec / n
+    from container_engine_accelerators_tpu.utils.provenance import stamp
     print(json.dumps({
         "metric": METRIC,
         "value": round(per_chip, 2),
         "unit": UNIT,
         "vs_baseline": round(per_chip / TARGET, 4),
+        "batch_per_chip": BATCH_PER_CHIP,
+        "timed_steps": TIMED_STEPS,
+        "elapsed_s": round(elapsed, 3),
+        "provenance": stamp(devices),
     }), flush=True)
     return 0
 

@@ -26,15 +26,22 @@ set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-.}"
 
+# bench.py itself refreshes TPU_BENCH_{DEFAULT,B256}.json (with
+# provenance + step-log pointer) on a successful on-chip run, so the
+# suite must NOT redirect stdout onto those paths — that would race
+# bench.py's own atomic write of the same file.
+# Worst case for 2 attempts: 2x240s probe + 2x2600s attempt + 30s
+# backoff = 5710s; the outer timeout must exceed that or it kills the
+# supervisor mid-measure and no JSON line is emitted.
 echo "[suite] headline bench (default batch)" >&2
-BENCH_ATTEMPTS=2 timeout 5400 python bench.py \
-  > "${OUT}/TPU_BENCH_DEFAULT.json" 2>> "${OUT}/tpu_suite.log"
-cat "${OUT}/TPU_BENCH_DEFAULT.json" >&2
+BENCH_ATTEMPTS=2 BENCH_BACKOFF_S=30 timeout 6000 python bench.py \
+  > "${OUT}/tpu_bench_default.out" 2>> "${OUT}/tpu_suite.log"
+cat "${OUT}/tpu_bench_default.out" >&2
 
 echo "[suite] headline bench (batch 256/chip)" >&2
 BENCH_ATTEMPTS=1 BENCH_BATCH_PER_CHIP=256 timeout 3600 python bench.py \
-  > "${OUT}/TPU_BENCH_B256.json" 2>> "${OUT}/tpu_suite.log"
-cat "${OUT}/TPU_BENCH_B256.json" >&2
+  > "${OUT}/tpu_bench_b256.out" 2>> "${OUT}/tpu_suite.log"
+cat "${OUT}/tpu_bench_b256.out" >&2
 
 echo "[suite] attention sweep" >&2
 timeout 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json" \
