@@ -41,6 +41,9 @@ class PluginServiceV1Alpha(DevicePluginV1AlphaServicer):
 
     def ListAndWatch(self, request, context):
         log.info("device-plugin (v1alpha): ListAndWatch started")
+        # See beta_plugin.ListAndWatch: frees the stream thread at
+        # disconnect time, not at the next poll-quantum boundary.
+        context.add_callback(self._m.wake_streams)
         last = None
         while context.is_active() and not self._m.is_stopping():
             if last is None:

@@ -52,6 +52,11 @@ class PluginServiceV1Beta1(DevicePluginV1Beta1Servicer):
         re-send whenever health or population changes.
         """
         log.info("device-plugin: ListAndWatch started")
+        # On client disconnect, wake the manager's change condition so
+        # this thread re-checks is_active() now rather than after the
+        # poll quantum (frees the executor thread for re-serves under
+        # a flapping kubelet).
+        context.add_callback(self._m.wake_streams)
         last = None
         while context.is_active() and not self._m.is_stopping():
             if last is None:

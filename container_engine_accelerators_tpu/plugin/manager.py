@@ -116,13 +116,19 @@ class TpuManager:
         with self._changed:
             old = self._devices
             if partitioned:
-                fresh = self._slice_mgr.list_devices()
+                # The slice manager is the single health authority for
+                # subslices (set_device_health routes into it), so take
+                # its health verbatim — that carries both the
+                # all-unhealthy poisoned state after a failed
+                # re-partition and the reset after a successful one;
+                # preferring `old` here would resurrect stale health
+                # either way.
+                self._devices = self._slice_mgr.list_devices()
             else:
-                fresh = {f"accel{i}": HEALTHY for i in self._chip_indices()}
-            self._devices = {
-                dev_id: old.get(dev_id, health)
-                for dev_id, health in fresh.items()
-            }
+                self._devices = {
+                    f"accel{i}": old.get(f"accel{i}", HEALTHY)
+                    for i in self._chip_indices()
+                }
             self._changed.notify_all()
 
     def _chip_indices(self):
@@ -138,23 +144,32 @@ class TpuManager:
         Analog of hasAdditionalGPUsInstalled (manager.go:143-157).
         Returns True when the chip population changed.
         """
-        before = set(self.list_devices())
+        before = self.list_devices()
         self._backend.rescan()
         chips_now = set(self._chip_indices())
         chips_changed = chips_now != self._known_chips
         self._known_chips = chips_now
-        if self._config.tpu_partition_size:
-            if chips_changed:
-                # Only re-solve the tiling when the population actually
-                # changed: SliceManager.start() resets slice health.
-                try:
-                    self._slice_mgr.start(self._config.tpu_partition_size)
-                except Exception as e:  # non-uniform after hot-plug
-                    log.warning("re-partition after rescan failed: %s", e)
-            after_ids = set(self._slice_mgr.list_devices())
-        else:
-            after_ids = {f"accel{i}" for i in chips_now}
-        return chips_changed or after_ids != before
+        if not self._config.tpu_partition_size:
+            return chips_changed
+        if chips_changed or self._slice_mgr.poisoned is not None:
+            # Re-solve the tiling when the population changed — and
+            # keep retrying every rescan while poisoned, since the
+            # failure can clear without another population change
+            # (e.g. the node topology file settles after the /dev
+            # nodes appeared).
+            try:
+                self._slice_mgr.start(self._config.tpu_partition_size)
+            except Exception as e:  # non-uniform after hot-plug
+                # The old slice->chip table now references a chip
+                # population that no longer exists/tiles; serving it
+                # would hand containers stale /dev/accelN paths.
+                # Poison: every slice goes Unhealthy until a later
+                # rescan tiles cleanly (mig.go:190-201 hard-fails the
+                # same breach).
+                self._slice_mgr.poison(e)
+        # Health transitions (poison/recovery) matter as much as
+        # id-set changes: the caller re-serves + re-advertises on True.
+        return chips_changed or self._slice_mgr.list_devices() != before
 
     # -- device map ---------------------------------------------------
 
@@ -172,9 +187,15 @@ class TpuManager:
             if device_id not in self._devices:
                 log.warning("health update for unknown device %s", device_id)
                 return
-            self._devices[device_id] = health
             if is_slice_device_id(device_id):
-                self._slice_mgr.set_device_health(device_id, health)
+                # The slice manager is the health authority and may
+                # refuse (e.g. HEALTHY while the table is poisoned);
+                # the advertised map must not diverge from it.
+                if not self._slice_mgr.set_device_health(device_id, health):
+                    log.info("health update %s=%s refused by slice "
+                             "manager", device_id, health)
+                    return
+            self._devices[device_id] = health
             self._changed.notify_all()
 
     def wait_for_change(self, timeout):
@@ -183,6 +204,20 @@ class TpuManager:
         with self._changed:
             self._changed.wait(timeout)
             return dict(self._devices)
+
+    def wake_streams(self):
+        """Wake every ListAndWatch waiter without changing state.
+
+        Wired as the gRPC per-stream cancellation callback: when a
+        kubelet connection dies, its stream thread is usually parked
+        in wait_for_change(); waking it lets the loop observe
+        context.is_active() == False and release the executor thread
+        immediately instead of up to one poll quantum later (a
+        flapping kubelet could otherwise transiently pin all server
+        threads on dead streams).
+        """
+        with self._changed:
+            self._changed.notify_all()
 
     def is_stopping(self):
         """True once stop() was called; streams must terminate.

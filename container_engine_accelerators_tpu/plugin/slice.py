@@ -28,7 +28,7 @@ import re
 import threading
 
 from ..chip.backend import parse_shape
-from .api import HEALTHY
+from .api import HEALTHY, UNHEALTHY
 from ..utils import get_logger
 
 log = get_logger("slice")
@@ -71,11 +71,39 @@ class SliceManager:
         self._shape = ""
         self._slices = {}   # device id -> [chip indices]
         self._health = {}   # device id -> health string
+        self._poisoned = None   # reason string while tiling is stale
         self._lock = threading.Lock()
 
     @property
     def shape(self):
         return self._shape
+
+    @property
+    def poisoned(self):
+        """Reason string while the slice table is known-stale (a
+        re-partition failed after the chip population changed), else
+        None."""
+        with self._lock:
+            return self._poisoned
+
+    def poison(self, reason):
+        """Mark every subslice unhealthy until a re-tiling succeeds.
+
+        The chip population changed and no longer tiles into the
+        configured shape: the slice->chip table is stale, and handing
+        out its /dev/accelN paths could reference removed chips. The
+        reference hard-fails this uniformity breach (mig.go:190-201);
+        here the serve loop stays up but every slice is advertised
+        Unhealthy (the kubelet stops scheduling them and Allocate's
+        health gate refuses) until start() re-tiles cleanly.
+        """
+        with self._lock:
+            self._poisoned = str(reason)
+            for dev_id in self._health:
+                self._health[dev_id] = UNHEALTHY
+        log.error("slice table poisoned (%s): all %d subslices marked "
+                  "unhealthy until the topology tiles again",
+                  reason, len(self._health))
 
     def start(self, partition_size):
         """Discover subslices for the configured shape.
@@ -86,16 +114,21 @@ class SliceManager:
         counts don't match the expected table (mig.go:190-201).
         """
         parse_shape(partition_size)  # surface BadShapeError early
+        # Build the whole table before swapping it in: a mid-build
+        # failure (e.g. NoSuchChipError — the shape tiles the topology
+        # but a chip at some tile coordinate is gone) must leave the
+        # previous table intact so poison() can re-advertise its ids
+        # as unhealthy instead of a partially-populated table.
         count = self._backend.subslice_count(partition_size)
+        slices = {}
+        for i in range(count):
+            dev_id = slice_device_id(partition_size, i)
+            slices[dev_id] = self._backend.subslice_chips(partition_size, i)
         with self._lock:
             self._shape = partition_size
-            self._slices = {}
-            self._health = {}
-            for i in range(count):
-                dev_id = slice_device_id(partition_size, i)
-                self._slices[dev_id] = self._backend.subslice_chips(
-                    partition_size, i)
-                self._health[dev_id] = HEALTHY
+            self._slices = slices
+            self._health = {dev_id: HEALTHY for dev_id in slices}
+            self._poisoned = None
         log.info("discovered %d %s subslices", count, partition_size)
         return count
 
@@ -118,8 +151,19 @@ class SliceManager:
         return None
 
     def set_device_health(self, device_id, health):
+        """Record a health transition; returns False when refused.
+
+        While poisoned, HEALTHY is refused: the slice->chip table is
+        known-stale, and the health checker polling the *old* chip
+        list would otherwise "recover" slices right back (its chips
+        can all look fine — e.g. a hot-ADD that broke the tiling
+        leaves every old chip present). Only a successful start() may
+        restore health.
+        """
         with self._lock:
             if device_id not in self._health:
+                return False
+            if self._poisoned is not None and health == HEALTHY:
                 return False
             self._health[device_id] = health
             return True

@@ -117,6 +117,105 @@ def test_slice_health_routing(node8, fast_intervals):
     assert mgr._slice_mgr.list_devices()["tpu-2x2-0"] == api.UNHEALTHY
 
 
+def test_failed_repartition_poisons_all_slices(node8, fast_intervals):
+    """Hot-unplug that breaks the tiling must never serve stale chip
+    sets: every slice goes Unhealthy under the old ids (VERDICT r2 #5;
+    invariant source mig.go:190-201)."""
+    mgr = make_partitioned_manager(node8)
+    assert all(h == api.HEALTHY for h in mgr.list_devices().values())
+    node8.remove_chip(7)
+    assert mgr.has_new_devices()
+    mgr._refresh_devices()  # what the serve loop does on True
+    devices = mgr.list_devices()
+    # Ids stay stable (kubelet sees known devices go unhealthy, not
+    # vanish) but everything is refused.
+    assert sorted(devices) == ["tpu-2x2-0", "tpu-2x2-1"]
+    assert all(h == api.UNHEALTHY for h in devices.values())
+    assert mgr._slice_mgr.poisoned is not None
+    for dev_id in devices:
+        with pytest.raises(ValueError):
+            mgr.device_specs(dev_id)
+
+
+def test_repartition_recovers_when_topology_tiles_again(
+        node8, fast_intervals):
+    mgr = make_partitioned_manager(node8)
+    node8.remove_chip(7)
+    assert mgr.has_new_devices()
+    mgr._refresh_devices()
+    assert all(h == api.UNHEALTHY for h in mgr.list_devices().values())
+    node8.add_chip(7)
+    assert mgr.has_new_devices()
+    mgr._refresh_devices()
+    devices = mgr.list_devices()
+    assert sorted(devices) == ["tpu-2x2-0", "tpu-2x2-1"]
+    assert all(h == api.HEALTHY for h in devices.values())
+    assert mgr._slice_mgr.poisoned is None
+    assert len(mgr.device_specs("tpu-2x2-1")) == 4
+
+
+def test_poison_transition_reserves_without_id_change(
+        node8, fast_intervals):
+    """has_new_devices() must report True on pure health transitions
+    (poison/recovery) even though the id set is unchanged, so the
+    serve loop re-advertises."""
+    mgr = make_partitioned_manager(node8)
+    assert not mgr.has_new_devices()  # steady state: no change
+    node8.remove_chip(7)
+    assert mgr.has_new_devices()      # poison transition
+    mgr._refresh_devices()
+    assert not mgr.has_new_devices()  # poisoned steady state
+    node8.add_chip(7)
+    assert mgr.has_new_devices()      # recovery transition
+    mgr._refresh_devices()
+    assert not mgr.has_new_devices()
+
+
+def test_health_checker_cannot_unpoison(node8, fast_intervals):
+    """The health checker's recovery branch calls
+    set_device_health(dev, HEALTHY) when a slice's (stale) chips all
+    look fine; while poisoned that must be refused — only a clean
+    re-tiling restores schedulability."""
+    mgr = make_partitioned_manager(node8)
+    node8.remove_chip(7)
+    assert mgr.has_new_devices()
+    mgr._refresh_devices()
+    # Slice 0's chips (0,1,4,5) are all still present and healthy; a
+    # poll would try to "recover" it exactly like this:
+    mgr.set_device_health("tpu-2x2-0", api.HEALTHY)
+    assert mgr.list_devices()["tpu-2x2-0"] == api.UNHEALTHY
+    assert mgr._slice_mgr.list_devices()["tpu-2x2-0"] == api.UNHEALTHY
+    # Unhealthy transitions are still accepted while poisoned.
+    mgr.set_device_health("tpu-2x2-1", api.UNHEALTHY)
+    assert mgr.list_devices()["tpu-2x2-1"] == api.UNHEALTHY
+
+
+def test_poisoned_retiling_retries_without_population_change(fake_node):
+    """A poison can clear without another chip-set change (e.g. the
+    node topology file settles); the rescan loop must keep retrying
+    start() while poisoned."""
+    for i in range(8):
+        fake_node.add_chip(i)
+    fake_node.set_topology("2x4")
+    mgr = make_partitioned_manager(fake_node, size="2")
+    assert sorted(mgr.list_devices()) == [f"tpu-2-{i}" for i in range(4)]
+    # Drop to 6 chips: 2x4 topology now has holes -> poison.
+    fake_node.remove_chip(6)
+    fake_node.remove_chip(7)
+    assert mgr.has_new_devices()
+    mgr._refresh_devices()
+    assert all(h == api.UNHEALTHY for h in mgr.list_devices().values())
+    # Topology settles to 2x3 with the SAME chip population; the next
+    # rescan must re-attempt the tiling and recover.
+    fake_node.set_topology("2x3")
+    assert mgr.has_new_devices()
+    mgr._refresh_devices()
+    devices = mgr.list_devices()
+    assert sorted(devices) == [f"tpu-2-{i}" for i in range(3)]
+    assert all(h == api.HEALTHY for h in devices.values())
+    assert mgr._slice_mgr.poisoned is None
+
+
 def test_slice_id_helpers():
     assert slice_device_id("2x2", 1) == "tpu-2x2-1"
     assert is_slice_device_id("tpu-2x2-1")
