@@ -31,8 +31,9 @@ Sources, tried in order each tick:
      the supported in-process surface on current TPU VM images;
   2. the libtpu runtime gRPC metric service (default localhost:8431 —
      the endpoint the ``tpu-info`` diagnostic tool queries), decoded
-     with a tolerant protobuf wire walker so minor proto revisions
-     don't break the bridge;
+     deterministically via the vendored proto
+     (proto/tpu_runtime_metrics.proto) with a tolerant wire walker as
+     the fallback for unknown proto revisions;
   3. ``--fake`` synthetic values (tests / demo rigs without a TPU).
 
 Output: one JSON object per line, appended atomically (write to a
@@ -76,14 +77,27 @@ GRPC_METHOD = ("/tpu.monitoring.runtime.RuntimeMetricService"
 
 
 # ---------------------------------------------------------------------
-# Protobuf wire helpers (no generated code: the service proto is not
-# vendored, and a tolerant walker survives field-number drift better
-# than a frozen descriptor would).
+# Decoding. Primary path: the vendored runtime-metrics proto
+# (proto/tpu_runtime_metrics.proto, generated into plugin/api) —
+# deterministic field-number access, the way the reference consumes
+# generated NVML/podresources APIs (metrics/devices.go:33-96).
+# Fallback: a tolerant wire walker that survives field-number drift in
+# runtime revisions whose proto differs from the vendored copy.
 # ---------------------------------------------------------------------
+
+try:
+    from container_engine_accelerators_tpu.plugin.api import (  # noqa: E402
+        tpu_runtime_metrics_pb2 as rtm_pb2,
+    )
+except ImportError:  # pragma: no cover - generated file always present
+    rtm_pb2 = None
 
 
 def encode_metric_request(metric_name):
     """MetricRequest{ string metric_name = 1 } on the wire."""
+    if rtm_pb2 is not None:
+        return rtm_pb2.MetricRequest(
+            metric_name=metric_name).SerializeToString()
     data = metric_name.encode()
     return b"\x0a" + _varint(len(data)) + data
 
@@ -163,8 +177,41 @@ def _scalars_in(msg_bytes, depth=0):
     return found
 
 
-def decode_gauges(response_bytes):
-    """Per-device values from a GetRuntimeMetric response.
+def decode_gauges_typed(response_bytes):
+    """Per-device values via the vendored proto, or None.
+
+    Deterministic path: parse MetricResponse and read
+    metric.metrics[].attribute.value.int_attr (device id) +
+    .gauge.as_double/as_int (value) by field number. Returns None —
+    not {} — when the bytes don't parse as the vendored shape or
+    carry no usable gauge, so the caller can distinguish "decoded,
+    empty" from "unknown revision, try the walker".
+    """
+    if rtm_pb2 is None:
+        return None
+    try:
+        resp = rtm_pb2.MetricResponse.FromString(bytes(response_bytes))
+    except Exception:
+        return None
+    out = {}
+    for idx, metric in enumerate(resp.metric.metrics):
+        which = metric.gauge.WhichOneof("value")
+        if which == "as_double":
+            value = metric.gauge.as_double
+        elif which == "as_int":
+            value = float(metric.gauge.as_int)
+        else:
+            continue
+        if metric.attribute.value.WhichOneof("attr") == "int_attr":
+            device = metric.attribute.value.int_attr
+        else:
+            device = idx
+        out[int(device)] = float(value)
+    return out or None
+
+
+def decode_gauges_walker(response_bytes):
+    """Per-device values from a GetRuntimeMetric response (fallback).
 
     Expected shape (tpu-info's proto): response.metric.metrics[] each
     carrying a device-id attribute and a gauge scalar. The walker
@@ -207,6 +254,14 @@ def decode_gauges(response_bytes):
                 default=idx)
             per_device[int(device)] = float(value)
     return per_device
+
+
+def decode_gauges(response_bytes):
+    """Per-device gauge values: vendored proto first, walker fallback."""
+    typed = decode_gauges_typed(response_bytes)
+    if typed is not None:
+        return typed
+    return decode_gauges_walker(response_bytes)
 
 
 # ---------------------------------------------------------------------
@@ -290,10 +345,20 @@ class FakeSource:
 
 
 def pick_source(args):
-    if args.fake_chips:
-        return FakeSource(args.fake_chips)
-    try:
+    if args.source == "fake" or (args.source == "auto" and args.fake_chips):
+        return FakeSource(args.fake_chips or 1)
+    if args.source == "grpc":
+        return GrpcSource(args.metrics_addr)
+    if args.source == "sdk":
         return SdkSource()
+    try:
+        src = SdkSource()
+        # An importable SDK without telemetry (e.g. a libtpu wheel on
+        # a chip-less host) must not shadow the gRPC source: probe it
+        # once and fall through when it yields nothing.
+        if not src.poll():
+            raise RuntimeError("SDK present but reports no chips")
+        return src
     except Exception as e:
         log.info("libtpu SDK source unavailable (%s); trying gRPC", e)
     return GrpcSource(args.metrics_addr)
@@ -328,6 +393,10 @@ def main(argv=None):
                    help="libtpu runtime metric service address")
     p.add_argument("--fake-chips", type=int, default=0,
                    help="emit synthetic telemetry for N chips")
+    p.add_argument("--source", default="auto",
+                   choices=("auto", "sdk", "grpc", "fake"),
+                   help="pin a telemetry source instead of probing "
+                        "sdk -> grpc (auto)")
     p.add_argument("--once", action="store_true")
     args = p.parse_args(argv)
 

@@ -23,7 +23,10 @@ because this image ships grpcio but not grpc_tools.
 from . import deviceplugin_v1beta1_pb2 as v1beta1_pb2
 from . import deviceplugin_v1alpha_pb2 as v1alpha_pb2
 from . import podresources_v1alpha1_pb2 as podresources_pb2
+from . import tpu_runtime_metrics_pb2 as runtime_metrics_pb2
 from .grpc_bindings import (
+    RuntimeMetricServiceServicer,
+    add_runtime_metric_service,
     V1BETA1_VERSION,
     V1ALPHA_VERSION,
     HEALTHY,
@@ -47,6 +50,9 @@ __all__ = [
     "v1beta1_pb2",
     "v1alpha_pb2",
     "podresources_pb2",
+    "runtime_metrics_pb2",
+    "RuntimeMetricServiceServicer",
+    "add_runtime_metric_service",
     "V1BETA1_VERSION",
     "V1ALPHA_VERSION",
     "HEALTHY",

@@ -50,6 +50,7 @@ _V1BETA1_REG = "v1beta1.Registration"
 _V1ALPHA_DP = "deviceplugin.DevicePlugin"
 _V1ALPHA_REG = "deviceplugin.Registration"
 _PODRES = "v1alpha1.PodResourcesLister"
+_RUNTIME_METRICS = "tpu.monitoring.runtime.RuntimeMetricService"
 
 
 class DevicePluginV1Beta1Servicer:
@@ -173,6 +174,34 @@ class PodResourcesListerServicer:
 
     def List(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "List")
+
+
+class RuntimeMetricServiceServicer:
+    """Base class for the libtpu runtime metric service.
+
+    Served by libtpu on real TPU VMs (localhost:8431); implemented
+    here by test fixtures speaking the vendored
+    proto/tpu_runtime_metrics.proto so the metrics bridge's gRPC
+    source can be integration-tested against the genuine wire shape.
+    """
+
+    def GetRuntimeMetric(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetRuntimeMetric")
+
+
+def add_runtime_metric_service(servicer, server):
+    from . import tpu_runtime_metrics_pb2 as rtm
+
+    handlers = {
+        "GetRuntimeMetric": grpc.unary_unary_rpc_method_handler(
+            servicer.GetRuntimeMetric,
+            request_deserializer=rtm.MetricRequest.FromString,
+            response_serializer=rtm.MetricResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_RUNTIME_METRICS, handlers),)
+    )
 
 
 def add_pod_resources_lister(servicer, server):

@@ -144,13 +144,13 @@ class TpuManager:
         Analog of hasAdditionalGPUsInstalled (manager.go:143-157).
         Returns True when the chip population changed.
         """
-        before = self.list_devices()
         self._backend.rescan()
         chips_now = set(self._chip_indices())
         chips_changed = chips_now != self._known_chips
         self._known_chips = chips_now
         if not self._config.tpu_partition_size:
             return chips_changed
+        before = self.list_devices()
         if chips_changed or self._slice_mgr.poisoned is not None:
             # Re-solve the tiling when the population changed — and
             # keep retrying every rescan while poisoned, since the

@@ -98,12 +98,17 @@ class SliceManager:
         health gate refuses) until start() re-tiles cleanly.
         """
         with self._lock:
+            first = self._poisoned is None
             self._poisoned = str(reason)
             for dev_id in self._health:
                 self._health[dev_id] = UNHEALTHY
-        log.error("slice table poisoned (%s): all %d subslices marked "
-                  "unhealthy until the topology tiles again",
-                  reason, len(self._health))
+        if first:
+            log.error("slice table poisoned (%s): all %d subslices marked "
+                      "unhealthy until the topology tiles again",
+                      reason, len(self._health))
+        else:
+            # Retried every rescan (~10s); don't bury real errors.
+            log.debug("slice table still poisoned (%s)", reason)
 
     def start(self, partition_size):
         """Discover subslices for the configured shape.

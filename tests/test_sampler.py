@@ -242,6 +242,130 @@ def test_bridge_fake_source_feeds_sampler(tmp_path):
     assert hbm_used == 256 * 1024 ** 2
 
 
+# Raw protobuf wire encoders for synthesizing drifted/alien proto
+# revisions (shared by the codec tests below).
+def _wire_varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _wire_ld(field, payload):
+    return bytes([(field << 3) | 2]) + _wire_varint(len(payload)) + payload
+
+
+def _wire_vint(field, v):
+    return bytes([(field << 3) | 0]) + _wire_varint(v)
+
+
+def _wire_dbl(field, v):
+    import struct as s
+    return bytes([(field << 3) | 1]) + s.pack("<d", v)
+
+
+class _RuntimeMetrics:
+    """In-repo runtime metric service speaking the vendored proto —
+    the integration seam for the bridge's gRPC source (VERDICT r2 #3:
+    decode by field number against a real server, walker only for
+    unknown revisions)."""
+
+    def __init__(self, gauges):
+        # gauges: {metric_name: {device: value}}
+        self.gauges = gauges
+        self.requests = []
+
+    def GetRuntimeMetric(self, request, context):
+        from container_engine_accelerators_tpu.plugin import api
+
+        self.requests.append(request.metric_name)
+        resp = api.runtime_metrics_pb2.MetricResponse()
+        resp.metric.name = request.metric_name
+        for device, value in sorted(
+                self.gauges.get(request.metric_name, {}).items()):
+            m = resp.metric.metrics.add()
+            m.attribute.key = "device-id"
+            m.attribute.value.int_attr = device
+            if isinstance(value, float):
+                m.gauge.as_double = value
+            else:
+                m.gauge.as_int = value
+        return resp
+
+
+def _serve_runtime_metrics(servicer):
+    from concurrent import futures
+
+    import grpc
+
+    from container_engine_accelerators_tpu.plugin import api
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    api.add_runtime_metric_service(servicer, server)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    return server, f"localhost:{port}"
+
+
+def test_bridge_grpc_source_against_real_proto_server():
+    """GrpcSource end-to-end over a real gRPC hop: typed decode must
+    recover exact device ids and values (including device ids that the
+    old heuristic would have confused with small gauge values)."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "cmd"))
+    import tpu_metrics_bridge as bridge
+
+    servicer = _RuntimeMetrics({
+        # Integer duty gauge 2 on device 5 is the VERDICT r2 weak #2
+        # swap case: the walker heuristic decodes it as {2: 5.0}
+        # (value and device exchanged); the typed path cannot.
+        bridge.GRPC_DUTY_METRIC: {0: 37.5, 5: 2},
+        bridge.GRPC_HBM_USAGE_METRIC: {0: 123 * 2**20, 5: 456 * 2**20},
+        bridge.GRPC_HBM_TOTAL_METRIC: {0: 16 * 2**30, 5: 16 * 2**30},
+    })
+    server, addr = _serve_runtime_metrics(servicer)
+    try:
+        chips = bridge.GrpcSource(addr).poll()
+    finally:
+        server.stop(grace=0)
+    assert chips == [
+        {"chip": 0, "duty_pct": 37.5, "hbm_used": 123 * 2**20,
+         "hbm_total": 16 * 2**30},
+        {"chip": 5, "duty_pct": 2.0, "hbm_used": 456 * 2**20,
+         "hbm_total": 16 * 2**30},
+    ]
+    assert servicer.requests[0] == bridge.GRPC_DUTY_METRIC
+
+
+def test_bridge_typed_decode_none_on_unknown_revision():
+    """Bytes from a drifted proto revision must fall through to the
+    walker (typed decoder returns None, not a wrong answer)."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "cmd"))
+    import tpu_metrics_bridge as bridge
+
+    # A revision where the gauge lives at field 3 (not 2) and the
+    # device id is a bare varint inside the attribute (not AttrValue):
+    # the synthetic shape from test_bridge_wire_codec_roundtrip.
+    metrics = b"".join(
+        _wire_ld(2, _wire_ld(1, _wire_vint(2, dev))
+                 + _wire_ld(3, _wire_dbl(1, 25.0 * (dev + 1))))
+        for dev in range(2))
+    drifted = _wire_ld(1, _wire_ld(1, b"name") + metrics)
+    assert bridge.decode_gauges_typed(drifted) is None
+    assert bridge.decode_gauges(drifted) == {0: 25.0, 1: 50.0}
+
+    # And the vendored shape decodes typed, not via the walker.
+    from container_engine_accelerators_tpu.plugin import api
+    resp = api.runtime_metrics_pb2.MetricResponse()
+    m = resp.metric.metrics.add()
+    m.attribute.value.int_attr = 3
+    m.gauge.as_int = 77
+    assert bridge.decode_gauges_typed(
+        resp.SerializeToString()) == {3: 77.0}
+
+
 def test_bridge_wire_codec_roundtrip():
     """The tolerant decoder must extract per-device gauges from a
     response shaped like the runtime metric service's."""
@@ -258,28 +382,10 @@ def test_bridge_wire_codec_roundtrip():
     assert fields[0][2].decode().endswith("percent")
 
     # MetricResponse{ metric { metrics[] { attr{device=N} gauge{double} } } }
-    def varint(n):
-        out = b""
-        while True:
-            b7 = n & 0x7F
-            n >>= 7
-            out += bytes([b7 | (0x80 if n else 0)])
-            if not n:
-                return out
-
-    def ld(field, payload):
-        return bytes([(field << 3) | 2]) + varint(len(payload)) + payload
-
-    def vint(field, v):
-        return bytes([(field << 3) | 0]) + varint(v)
-
-    def dbl(field, v):
-        import struct as s
-        return bytes([(field << 3) | 1]) + s.pack("<d", v)
-
     metrics = b"".join(
-        ld(2, ld(1, vint(2, dev)) + ld(3, dbl(1, 25.0 * (dev + 1))))
+        _wire_ld(2, _wire_ld(1, _wire_vint(2, dev))
+                 + _wire_ld(3, _wire_dbl(1, 25.0 * (dev + 1))))
         for dev in range(2))
-    resp = ld(1, ld(1, b"name") + metrics)
+    resp = _wire_ld(1, _wire_ld(1, b"name") + metrics)
     gauges = decode_gauges(resp)
     assert gauges == {0: 25.0, 1: 50.0}
