@@ -17,10 +17,18 @@ native:
 
 test: native
 	$(MAKE) -C native/tpuinfo test
+	$(MAKE) test-native-asan
 	python3 -m pytest tests/ -q
 
 test-native:
 	$(MAKE) -C native/tpuinfo test
+
+# ASan+UBSan pass over the native layer: tpuinfo unit tests plus the
+# sampler feed-parser fuzz harness (the C++ analog of the reference's
+# `go test -race` on every run).
+test-native-asan:
+	$(MAKE) -C native/tpuinfo test-asan
+	$(MAKE) -C native/sampler test-asan
 
 presubmit:
 	./build/check_python.sh
@@ -50,5 +58,5 @@ clean:
 	$(MAKE) -C native/sampler clean
 	$(MAKE) -C demo/tpu-error clean
 
-.PHONY: all native test test-native presubmit bench container \
-	partition-tpu push clean
+.PHONY: all native test test-native test-native-asan presubmit bench \
+	container partition-tpu push clean

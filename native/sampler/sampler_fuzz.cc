@@ -1,0 +1,167 @@
+// Copyright 2026 The container-engine-accelerators-tpu Authors.
+//
+// Licensed under the Apache License, Version 2.0 (the "License");
+// you may not use this file except in compliance with the License.
+// You may obtain a copy of the License at
+//
+//     http://www.apache.org/licenses/LICENSE-2.0
+//
+// Unless required by applicable law or agreed to in writing, software
+// distributed under the License is distributed on an "AS IS" BASIS,
+// WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+// See the License for the specific language governing permissions and
+// limitations under the License.
+
+// Deterministic fuzz harness for the sampler's hand-rolled feed
+// parser (parse_feed_line / scan_number / read_feed). The parser runs
+// as root on every node and consumes a file a compromised or buggy
+// bridge could fill with anything, so it must never read out of
+// bounds, overflow, or loop forever on adversarial input. Built with
+// ASan+UBSan (`make test-asan`, wired into CI) — the analog of the
+// reference running `go test -race` on every run (Makefile:20).
+//
+// Strategy (no libFuzzer in the image): a seeded xorshift RNG drives
+//   1. every-byte truncations of valid lines,
+//   2. random byte mutations of valid lines,
+//   3. structured garbage (unbalanced braces, missing colons, huge
+//      exponents, NaN/Inf, NULs, deep nesting, oversized arrays),
+//   4. read_feed over corrupt/empty/binary temp files.
+// The invariant is simply "terminates without sanitizer findings";
+// semantic checks are the unit tests' job (tests/test_sampler.py).
+
+#define main tpu_state_sampler_main
+#include "tpu_state_sampler.cc"
+#undef main
+
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+namespace {
+
+uint64_t rng_state = 0x9E3779B97F4A7C15ull;
+
+uint64_t rng() {
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return rng_state;
+}
+
+const char* kSeeds[] = {
+    "{\"ts_us\": 1234567890, \"chips\": [{\"chip\": 0, \"duty_pct\": "
+    "37.5, \"hbm_total\": 17179869184, \"hbm_used\": 1048576, "
+    "\"health\": \"ok\"}]}",
+    "{\"ts_us\": 1, \"chips\": [{\"chip\": 0, \"duty_pct\": 1.0}, "
+    "{\"chip\": 1, \"duty_pct\": 2.0}, {\"chip\": 2, \"health\": "
+    "\"uncorrectable_ecc\"}]}",
+    "{\"chips\": []}",
+    "",
+};
+
+void exercise(const std::string& line) {
+  Feed feed = parse_feed_line(line);
+  // Touch the result so the work can't be optimized away.
+  volatile size_t n = feed.chips.size();
+  (void)n;
+  double out = 0;
+  scan_number(line, "\"chip\"", &out);
+  scan_number(line, "\"duty_pct\"", &out);
+  scan_number(line, "", &out);
+}
+
+std::string mutate(std::string s) {
+  if (s.empty()) return s;
+  int edits = 1 + (int)(rng() % 8);
+  for (int i = 0; i < edits && !s.empty(); i++) {
+    size_t pos = rng() % s.size();
+    switch (rng() % 4) {
+      case 0: s[pos] = (char)(rng() & 0xFF); break;           // flip
+      case 1: s.erase(pos, 1 + rng() % 4); break;             // cut
+      case 2: s.insert(pos, 1 + rng() % 4,
+                       (char)(rng() & 0xFF)); break;          // dup
+      case 3: s.insert(pos, "{\"chip\":"); break;             // nest
+    }
+  }
+  return s;
+}
+
+std::string structured_garbage(int kind) {
+  switch (kind % 10) {
+    case 0: return std::string(1 << 16, '{');
+    case 1: return "{\"chip\"" + std::string(1 << 12, ':');
+    case 2: return "{\"chip\": 1e99999999, \"duty_pct\": -1e-99999}";
+    case 3: return "{\"chip\": nan, \"duty_pct\": inf}";
+    case 4: {
+      std::string s = "{\"chips\": [";
+      for (int i = 0; i < 5000; i++) s += "{\"chip\": 9999999999},";
+      return s;  // unterminated on purpose
+    }
+    case 5: return std::string("{\"chip\"\x00: 1}", 13);  // embedded NUL
+    case 6: return "{\"health\": \"" + std::string(1 << 15, 'x');
+    case 7: return "{\"chip\": 0x7fffffffffffffff, \"hbm_total\": "
+                   "99999999999999999999999999999}";
+    case 8: return "\"chip\"\"chip\"\"chip\"{}{}{}::::";
+    case 9: return "{\"chip\": -9223372036854775808, \"duty_pct\": "
+                   "2.2250738585072011e-308}";
+  }
+  return "";
+}
+
+void fuzz_read_feed(const std::string& body) {
+  char tmpl[] = "/tmp/sampler_fuzz_XXXXXX";
+  int fd = mkstemp(tmpl);
+  assert(fd >= 0);
+  FILE* f = fdopen(fd, "w");
+  fwrite(body.data(), 1, body.size(), f);
+  fclose(f);
+  Options opt;
+  opt.feed_file = tmpl;
+  opt.feed_stale_ms = 1 << 30;
+  Feed feed = read_feed(opt);
+  volatile bool ok = feed.ok;
+  (void)ok;
+  unlink(tmpl);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int iters = argc > 1 ? atoi(argv[1]) : 20000;
+
+  // 1. Every-byte truncations of each seed.
+  for (const char* seed : kSeeds) {
+    std::string s(seed);
+    for (size_t cut = 0; cut <= s.size(); cut++) {
+      exercise(s.substr(0, cut));
+      exercise(s.substr(cut));
+    }
+  }
+
+  // 2. Random mutations.
+  for (int i = 0; i < iters; i++) {
+    exercise(mutate(kSeeds[rng() % 3]));
+  }
+
+  // 3. Structured garbage.
+  for (int i = 0; i < 64; i++) {
+    exercise(structured_garbage(i));
+  }
+
+  // 4. read_feed over corrupt files (incl. empty / only newlines /
+  // binary / no trailing newline).
+  fuzz_read_feed("");
+  fuzz_read_feed("\n\n\n");
+  fuzz_read_feed(std::string(kSeeds[0]) + "\n" + kSeeds[1]);
+  fuzz_read_feed(std::string(4096, '\xff'));
+  for (int i = 0; i < 200; i++) {
+    fuzz_read_feed(mutate(kSeeds[rng() % 3]) + "\n" +
+                   mutate(kSeeds[rng() % 3]));
+  }
+
+  printf("sampler_fuzz: OK (%d mutation iters + truncations + garbage "
+         "+ read_feed corpus)\n", iters);
+  return 0;
+}

@@ -59,6 +59,7 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <cmath>
 #include <map>
 #include <string>
 #include <vector>
@@ -167,10 +168,24 @@ bool scan_number(const std::string& s, const char* key, double* out) {
   return sscanf(s.c_str() + p + 1, " %lf", out) == 1;
 }
 
+// double -> integer with an explicit range gate: the feed is
+// attacker-influenceable (any root-adjacent writer), and casting a
+// NaN/out-of-range double is undefined behavior, not just a wrong
+// number. Returns false (entry skipped) instead of clamping so a
+// corrupt line can't smuggle a boundary value in as data.
+bool to_int64_checked(double v, int64_t lo, int64_t hi, int64_t* out) {
+  if (!std::isfinite(v) || v < (double)lo || v >= (double)hi) return false;
+  *out = (int64_t)v;
+  return true;
+}
+
 Feed parse_feed_line(const std::string& line) {
   Feed feed;
+  const int64_t kMaxCount = (int64_t)1 << 62;  // bytes/us upper gate
   double ts = 0;
-  if (scan_number(line, "\"ts_us\"", &ts)) feed.ts_us = (int64_t)ts;
+  if (scan_number(line, "\"ts_us\"", &ts)) {
+    to_int64_checked(ts, 0, kMaxCount, &feed.ts_us);
+  }
   // Split into per-chip objects: find each "chip" key and parse until
   // the enclosing object closes.
   size_t pos = 0;
@@ -185,17 +200,25 @@ Feed parse_feed_line(const std::string& line) {
       continue;
     }
     FeedChip fc;
-    int chip = (int)v;
-    if (scan_number(obj, "\"duty_pct\"", &v)) {
+    int64_t chip64 = 0;
+    if (!to_int64_checked(v, 0, 1 << 20, &chip64)) {
+      pos = end;
+      continue;  // absurd or non-finite chip index: drop the entry
+    }
+    int chip = (int)chip64;
+    if (scan_number(obj, "\"duty_pct\"", &v) && std::isfinite(v)) {
       fc.has_duty = true;
       fc.duty_pct = v;
     }
     double total = 0, used = 0;
+    int64_t total64 = 0, used64 = 0;
     if (scan_number(obj, "\"hbm_total\"", &total) &&
-        scan_number(obj, "\"hbm_used\"", &used)) {
+        scan_number(obj, "\"hbm_used\"", &used) &&
+        to_int64_checked(total, 0, kMaxCount, &total64) &&
+        to_int64_checked(used, 0, kMaxCount, &used64)) {
       fc.has_hbm = true;
-      fc.hbm_total = (int64_t)total;
-      fc.hbm_used = (int64_t)used;
+      fc.hbm_total = total64;
+      fc.hbm_used = used64;
     }
     size_t hp = obj.find("\"health\"");
     if (hp != std::string::npos) {
