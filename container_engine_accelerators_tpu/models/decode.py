@@ -89,9 +89,17 @@ def _mask_min_p(logits, min_p):
     confident, wide when it is not). min_p is a traced scalar or
     per-row [B] vector; 0.0 is a no-op row."""
     logp = jax.nn.log_softmax(logits, axis=-1)
-    cutoff = (jnp.max(logp, axis=-1, keepdims=True)
-              + jnp.log(jnp.maximum(
-                  jnp.reshape(min_p, (-1, 1)), 1e-38)))
+    mp = jnp.reshape(min_p, (-1, 1))
+    # min_p == 0 rows get a -inf cutoff (nothing masked): a clamp
+    # like log(max(mp, 1e-38)) would still mask tokens below
+    # 1e-38 * p_max, making a zero row in a mixed batch behave
+    # differently from the same row in an all-zero batch (where the
+    # filter is skipped entirely).
+    cutoff = jnp.where(
+        mp > 0,
+        jnp.max(logp, axis=-1, keepdims=True)
+        + jnp.log(jnp.maximum(mp, 1e-38)),
+        -jnp.inf)
     return jnp.where(logp < cutoff, -jnp.inf, logits)
 
 

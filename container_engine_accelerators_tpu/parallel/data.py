@@ -193,8 +193,15 @@ def _npz_rows(path, name="images"):
             version = npfmt.read_magic(f)
             if version == (1, 0):
                 shape, _, _ = npfmt.read_array_header_1_0(f)
-            else:
+            elif version == (2, 0):
                 shape, _, _ = npfmt.read_array_header_2_0(f)
+            elif hasattr(npfmt, "_read_array_header"):
+                # 3.0 (utf-8 header) and future versions numpy knows.
+                shape, _, _ = npfmt._read_array_header(f, version)
+            else:
+                raise ValueError(
+                    f"unsupported .npy format version {version} "
+                    f"in {path}:{name}")
     return shape[0]
 
 

@@ -25,6 +25,7 @@ of `:predict`.
 """
 
 import argparse
+import json
 import os
 import signal
 import sys
@@ -120,6 +121,17 @@ def main(argv=None):
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--warm", action="store_true",
+                   help="precompile per-bucket decode programs in "
+                        "the background; /healthz answers 503 until "
+                        "done (point the readinessProbe at it so a "
+                        "new replica joins the Service only once no "
+                        "request would pay a compile)")
+    p.add_argument("--warm-filters", default="",
+                   help="JSON list of sampling-option dicts (top_k, "
+                        "top_p, min_p, repetition_penalty, logprobs, "
+                        "temperature) to additionally precompile, "
+                        "e.g. '[{\"top_k\": 40, \"top_p\": 0.9}]'")
     p.add_argument("--kv-cache-dtype", choices=["bfloat16", "int8"],
                    default="bfloat16",
                    help="int8 halves KV-cache residency per replica "
@@ -220,10 +232,24 @@ def main(argv=None):
             from container_engine_accelerators_tpu.serving.tokenizer \
                 import load_tokenizer
             tokenizer = load_tokenizer(args.tokenizer)
+        warm_filters = None
+        if args.warm_filters:
+            warm_filters = json.loads(args.warm_filters)
+            # Validate the shape HERE: a malformed spec must fail
+            # startup loudly, not crash the background warm thread
+            # and leave the replica permanently unready.
+            if (not isinstance(warm_filters, list)
+                    or not all(isinstance(f, dict)
+                               for f in warm_filters)):
+                raise SystemExit(
+                    "--warm-filters must be a JSON list of dicts, "
+                    f"got: {args.warm_filters!r}")
         server = GenerationServer(
             name, model, variables["params"], port=args.port,
             max_new_tokens=args.max_new_tokens,
-            max_batch=args.max_batch, tokenizer=tokenizer)
+            max_batch=args.max_batch, tokenizer=tokenizer,
+            warm=args.warm, warm_filters=warm_filters,
+            warm_async=True)
     else:
         model = resnet(depth=args.depth)
         variables = model.init(

@@ -635,3 +635,23 @@ def test_decode_option_fuzz():
         assert got.shape == (B, P + 6)
         np.testing.assert_array_equal(got[:, :P], np.asarray(tokens))
         assert got.min() >= 0 and got.max() < V, (trial, kwargs)
+
+
+def test_mask_min_p_zero_row_exact_in_mixed_batch():
+    """A min_p=0.0 row in a mixed batch must be EXACTLY transparent:
+    the old 1e-38 clamp still masked tokens with probability below
+    1e-38 * p_max, so the same row behaved differently batched with a
+    min_p>0 row than in an all-zero batch (ADVICE r2)."""
+    from container_engine_accelerators_tpu.models.decode import (
+        _mask_min_p,
+    )
+
+    logits = jnp.array([[0.0, -200.0, -5.0],
+                        [0.0, -200.0, -5.0]], jnp.float32)
+    out = _mask_min_p(logits, jnp.array([0.5, 0.0], jnp.float32))
+    # Row 0 (min_p=0.5): both sub-threshold tokens masked.
+    assert np.isneginf(np.asarray(out)[0, 1])
+    assert np.isneginf(np.asarray(out)[0, 2])
+    # Row 1 (min_p=0.0): exact no-op, even for p ~ e^-200 < 1e-38.
+    np.testing.assert_array_equal(np.asarray(out)[1],
+                                  np.asarray(logits)[1])

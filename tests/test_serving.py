@@ -287,6 +287,82 @@ def test_generate_warm_compiles_both_modes():
         srv.stop()
 
 
+def test_generate_warm_filters_compile_variants():
+    """warm_filters must precompile the sampling-filter/penalty
+    variants a config uses (VERDICT r2 weak #5): one extra decode
+    per bucket per filter spec, and a matching live request then
+    reuses the program (decode_calls grows by exactly the request's
+    one batched call, not a compile-triggering variant miss)."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer(
+        "lm", model, params, port=0, max_new_tokens=8, max_batch=2,
+        buckets=[8], warm=True,
+        warm_filters=[{"top_k": 3, "top_p": 0.9},
+                      {"logprobs": True, "temperature": 0.0}])
+    # 1 bucket x (greedy + sampling + 2 filter specs).
+    assert srv._decode_calls == 4
+    assert srv._ready.is_set()
+    srv.start()
+    try:
+        out = post(srv, "/v1/models/lm:generate",
+                   {"prompts": [[1, 2, 3]], "max_new_tokens": 2,
+                    "temperature": 0.9, "top_k": 3, "top_p": 0.9})
+        assert len(out["sequences"][0]) == 5
+        # top_k 3 quantizes to 4 — same grid the warm spec used.
+        assert srv._decode_calls == 5
+    finally:
+        srv.stop()
+
+
+def test_generate_async_warm_gates_healthz():
+    """warm_async=True: /healthz answers 503 while programs compile
+    and 200 after — the readinessProbe contract that keeps an HPA
+    replica out of the Service until no request would pay a compile."""
+    import time
+    import urllib.error
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=2,
+                           buckets=[8, 16], warm=True, warm_async=True)
+    srv.start()
+    try:
+        url = f"http://localhost:{srv.port}/healthz"
+        # The HTTP server answers immediately; readiness may not.
+        if not srv._ready.is_set():
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(url, timeout=10)
+            assert err.value.code == 503
+            assert json.loads(err.value.read())["status"] == "warming"
+        deadline = time.monotonic() + 120
+        while not srv._ready.is_set():
+            assert time.monotonic() < deadline, "warm-up never finished"
+            time.sleep(0.1)
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert json.loads(resp.read())["status"] == "ok"
+        assert srv._decode_calls == 4  # 2 buckets x (greedy+sampling)
+    finally:
+        srv.stop()
+
+
 def test_generate_top_k_top_p(lm_server):
     out = post(lm_server, "/v1/models/lm:generate",
                {"prompts": [[5, 6, 7]], "max_new_tokens": 4,
@@ -584,3 +660,68 @@ def test_byte_tokenizer_out_of_range_marker():
 
     tok = ByteTokenizer()
     assert tok.decode([104, 105, 290, 33]) == "hi�!"
+
+
+def test_admission_budget_shared_across_variant_batchers():
+    """The overload bound caps AGGREGATE admitted rows across all
+    program-variant batchers of one server (ADVICE r2: a per-variant
+    bound would scale with the number of variants clients exercise)."""
+    import threading
+
+    from container_engine_accelerators_tpu.serving.server import (
+        SHED,
+        _Admission,
+        _Batcher,
+    )
+
+    release = threading.Event()
+
+    def slow_run(instances):
+        release.wait(timeout=30)
+        return [0 for _ in instances]
+
+    shared = _Admission(2)
+    b1 = _Batcher(slow_run, max_batch=1, max_wait_ms=1,
+                  admission=shared)
+    b2 = _Batcher(slow_run, max_batch=1, max_wait_ms=1,
+                  admission=shared)
+    try:
+        first = b1.submit_many([object()])
+        assert first is not None           # 1 of 2 admitted
+        assert b2.submit_many([object(), object()]) is None  # 1 free
+        second = b2.submit_many([object()])
+        assert second is not None          # 2 of 2 admitted
+        assert b1.submit_many([object()]) is None  # aggregate full
+        assert b1.submit(object()) == SHED  # shed sentinel, not error
+        release.set()
+        assert first[0].get(timeout=10)[0] == "ok"
+        assert second[0].get(timeout=10)[0] == "ok"
+    finally:
+        release.set()
+        b1.stop()
+        b2.stop()
+
+
+def test_generation_server_batchers_share_admission():
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=2, buckets=[8])
+    try:
+        b_greedy = srv._batcher_for(8, False, 0)
+        b_sample = srv._batcher_for(8, True, 0)
+        assert b_greedy._admission is srv._admission
+        assert b_sample._admission is srv._admission
+    finally:
+        # Never started: stop() must not deadlock in
+        # ThreadingHTTPServer.shutdown() (regression: it used to wait
+        # forever for a serve loop that was never running).
+        srv.stop()

@@ -63,15 +63,20 @@ echo "[suite] decode bench (bf16 + int8 cache + GQA + window)" >&2
 } > "${OUT}/DECODE_BENCH.json" 2>> "${OUT}/tpu_suite.log"
 cat "${OUT}/DECODE_BENCH.json" >&2
 
+# --warm + /healthz gating: "cold" below measures a replica that just
+# became Ready (the HPA join path), not a replica still compiling —
+# with the readiness gate no request ever pays a compile.
 echo "[suite] serving bench (LM generate, cold + warm)" >&2
 python demo/serving/serve.py --model transformer --port 8519 \
-  --max-seq-len 256 --max-new-tokens 32 \
+  --max-seq-len 256 --max-new-tokens 32 --warm \
   2>> "${OUT}/tpu_suite.log" &
 SERVE_PID=$!
 trap 'kill "${SERVE_PID}" 2>/dev/null' EXIT
 READY=0
-for i in $(seq 1 60); do
-  curl -s -m 2 localhost:8519/stats > /dev/null 2>&1 && { READY=1; break; }
+for i in $(seq 1 120); do
+  code="$(curl -s -m 2 -o /dev/null -w '%{http_code}' \
+    localhost:8519/healthz 2>/dev/null)"
+  [ "${code}" = "200" ] && { READY=1; break; }
   kill -0 "${SERVE_PID}" 2>/dev/null || break  # server died
   sleep 5
 done
