@@ -140,6 +140,29 @@ def host_grid_mesh(process_bounds, devices=None):
             f"{px}x{py}x{pz} host grid")
     local = len(devices) // n_proc
     grid = np.array(devices).reshape(px, py, pz, local)
+    # When the devices really span multiple processes, the reshape is
+    # only meaningful if the grid math lands every cell on the process
+    # it names — verify, don't trust, or shardings labeled
+    # host-adjacent silently ride the wrong links. (A single-process
+    # device set — tests / virtual CPU mesh — has no host boundaries
+    # to misplace.)
+    real_procs = {d.process_index for d in devices}
+    if len(real_procs) > 1:
+        if len(real_procs) != n_proc:
+            raise ValueError(
+                f"process bounds {px}x{py}x{pz} name {n_proc} hosts "
+                f"but devices span {len(real_procs)} processes")
+        for x in range(px):
+            for y in range(py):
+                for z in range(pz):
+                    want = (x * py + y) * pz + z
+                    got = {d.process_index for d in grid[x, y, z]}
+                    if got != {sorted(real_procs)[want]}:
+                        raise ValueError(
+                            f"host grid cell ({x},{y},{z}) maps to "
+                            f"processes {sorted(got)}, expected "
+                            f"process #{want}: device order does not "
+                            f"follow the {px}x{py}x{pz} grid")
     return Mesh(grid, HOST_AXES + ("chip",))
 
 

@@ -144,11 +144,16 @@ def main(argv=None):
         # length a kernel claims gets an error bound. Where dense
         # also compiled, the two references cross-validate on-chip.
         if not args.window:
+            # Largest divisor of s that fits the 512 budget keeps the
+            # oracle available at every length (768, 1280, ...) while
+            # never materializing more than a [B,H,512,512] tile.
+            chunk = max(c for c in range(1, min(512, s) + 1)
+                        if s % c == 0)
             try:
                 oracle = jax.jit(lambda q, k, v:
                                  chunked_reference_attention(
                                      q, k, v, causal=args.causal,
-                                     chunk=min(512, s)))(q, k, v)
+                                     chunk=chunk))(q, k, v)
                 jax.block_until_ready(oracle)
                 if reference is not None:
                     xerr = float(jnp.max(jnp.abs(

@@ -43,6 +43,12 @@ BENCH_ATTEMPTS=1 BENCH_BATCH_PER_CHIP=256 timeout 3600 python bench.py \
   > "${OUT}/tpu_bench_b256.out" 2>> "${OUT}/tpu_suite.log"
 cat "${OUT}/tpu_bench_b256.out" >&2
 
+echo "[suite] Allocate env contract on the real chip" >&2
+timeout 900 python tools/allocate_env_harness.py \
+  2>> "${OUT}/tpu_suite.log" || echo "[suite] allocate-env harness" \
+  "failed (see log)" >&2
+[ -f ALLOCATE_ENV_TPU.json ] && cat ALLOCATE_ENV_TPU.json >&2
+
 echo "[suite] attention sweep" >&2
 timeout 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json" \
   2>> "${OUT}/tpu_suite.log"
