@@ -27,7 +27,8 @@ from .resnet import ResNet, resnet
 from .inception import InceptionV3
 from .mlp import MnistMLP
 from .moe import MoETransformerLM
+from .speculative import speculative_decode
 from .transformer import TransformerLM
 
 __all__ = ["ResNet", "resnet", "InceptionV3", "MnistMLP",
-           "MoETransformerLM", "TransformerLM"]
+           "MoETransformerLM", "TransformerLM", "speculative_decode"]

@@ -1,0 +1,234 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Greedy speculative decoding: a small draft LM proposes, the target
+LM verifies k proposals in ONE cached forward.
+
+Single-token autoregressive decode is HBM-bandwidth-bound on TPU: each
+step streams the full weight set to produce one token. Speculation
+converts up to k of those streams into one chunked verify pass whose
+matmuls are [B, k+1, E]-shaped (MXU-friendly), so the target's
+bandwidth cost amortizes over the accepted tokens while the cheap
+draft runs the sequential part. With greedy acceptance the output is
+PROVABLY IDENTICAL to plain greedy decode of the target model — the
+only thing speculation changes is wall-clock.
+
+TPU-first design notes:
+  - one jitted program: the accept-loop is a lax.while_loop whose body
+    is {k draft steps (lax.scan) + 1 chunked verify apply}; all shapes
+    static, progress rides a scalar token counter;
+  - KV-cache "rewind" is free: cache writes are position-indexed and
+    the attention mask derives from cache_index, so rejecting
+    speculated entries = setting the index back (stale rows can never
+    pass the <= mask). No copies, no scatter-erase;
+  - the whole batch advances uniformly by the MINIMUM acceptance
+    across rows (per-row cache indices would need per-row gather
+    attention). B=1 is the latency play; larger batches still win
+    when rows agree (same-domain traffic).
+
+Verify-chunk attention reuses the decode cache path with
+``chunk_attends_cache=True`` (transformer.py): the general grouped
+einsum is already position-correct for multi-token chunks at any
+offset; the clone shares cache variables with the plain decode model,
+so prefill still uses the fast empty-cache path.
+
+Not supported (raise): sampling (temperature > 0 — rejection-sampling
+speculation is a different algorithm), sliding-window/ring caches
+(their prefill chunk write assumes offset 0), EOS early-exit, MoE
+draft or target. Reference repo has no counterpart (its serving demo
+is TF-Serving images, SURVEY.md section 2.3); this is framework-level
+capability the TPU stack adds.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .decode import _logits_of, init_cache
+
+
+def _rewind(cache, position):
+    """Set every per-layer step counter in a cache pytree to
+    ``position``. Stale K/V rows beyond it are masked by the
+    attention's ``k_pos <= q_pos`` test, so this alone un-speculates
+    the cache."""
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", None)
+        if name in ("cache_index", "pos_index"):
+            return jnp.asarray(position, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
+                              "k", "return_stats"))
+def _spec_impl(model, params, draft_model, draft_params, prompt,
+               max_new_tokens, k, return_stats):
+    b, p = prompt.shape
+    total = p + max_new_tokens + k  # slack for optimistic writes
+
+    target_dec, target_cache = init_cache(model, b, total)
+    verify_dec = target_dec.clone(chunk_attends_cache=True)
+    draft_dec, draft_cache = init_cache(draft_model, b, total)
+
+    # Prefill both caches with one full-width forward each; the
+    # target's last-position logits yield the first generated token
+    # (identical to decode()'s fast_prefill).
+    outs, upd = target_dec.apply(
+        {"params": params, "cache": target_cache}, prompt,
+        train=False, mutable=["cache"])
+    target_cache = upd["cache"]
+    first = jnp.argmax(_logits_of(outs)[:, -1], axis=-1).astype(
+        prompt.dtype)
+    _, dupd = draft_dec.apply(
+        {"params": draft_params, "cache": draft_cache}, prompt,
+        train=False, mutable=["cache"])
+    draft_cache = dupd["cache"]
+
+    out = jnp.zeros((b, total), prompt.dtype)
+    out = jax.lax.dynamic_update_slice(out, prompt, (0, 0))
+    out = jax.lax.dynamic_update_slice(out, first[:, None], (0, p))
+
+    def cond(carry):
+        n = carry[1]
+        return n < max_new_tokens
+
+    def body(carry):
+        out, n, last, target_cache, draft_cache, rounds, accepted = carry
+
+        # Draft: k sequential greedy steps from the last committed
+        # token. Its cache enters at index p+n-1 (the invariant: the
+        # index of the newest committed-but-unkeyed token).
+        def draft_step(c, _):
+            cache, tok = c
+            o, u = draft_dec.apply(
+                {"params": draft_params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"])
+            nxt = jnp.argmax(_logits_of(o)[:, 0], axis=-1).astype(
+                tok.dtype)
+            return (u["cache"], nxt), nxt
+
+        # k steps yield k-1 usable proposals: the k-th step's sampled
+        # token is discarded, but the step itself is what writes
+        # d_{k-1}'s key into the draft cache — without it a fully-
+        # accepted round would leave the draft missing the key of the
+        # newest accepted token. (This off-by-one is inherent: a
+        # draft never consumes, hence never keys, its own final
+        # proposal.)
+        (draft_cache, _), proposals = jax.lax.scan(
+            draft_step, (draft_cache, last), None, length=k)
+        d = proposals.T[:, :k - 1]  # [B, k-1]
+
+        # Target verifies the proposals (+ keys the last token) in
+        # ONE chunked forward of width k: logits[:, j] predicts the
+        # token after chunk position j. Every column is consumed
+        # (nxt = g[:, m] with m <= k-1), so the chunk is as narrow
+        # as the acceptance bound allows.
+        chunk = jnp.concatenate([last[:, None], d], axis=1)
+        o, u = verify_dec.apply(
+            {"params": params, "cache": target_cache}, chunk,
+            train=False, mutable=["cache"])
+        g = jnp.argmax(_logits_of(o), axis=-1).astype(last.dtype)
+
+        # Longest prefix where the draft matched the target's greedy
+        # choice, uniform across the batch (<= k-1 by construction).
+        match = (d == g[:, :k - 1]).astype(jnp.int32)
+        m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        m = jnp.min(m_row)
+        # The committed continuation: accepted proposals d[:, :m],
+        # then the target's own token at the first divergence (which
+        # equals the next draft proposal when everything matched).
+        nxt = jax.lax.dynamic_index_in_dim(g, m, axis=1,
+                                           keepdims=False)
+
+        start = p + n  # first uncommitted output position
+        if k > 1:
+            out = jax.lax.dynamic_update_slice(out, d, (0, start))
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None],
+                                           (0, start + m))
+
+        # Rewind both caches to the invariant index: the position of
+        # `nxt`, the newest committed-but-unkeyed token.
+        target_cache = _rewind(u["cache"], start + m)
+        draft_cache = _rewind(draft_cache, start + m)
+        return (out, n + m + 1, nxt, target_cache, draft_cache,
+                rounds + 1, accepted + m)
+
+    zero = jnp.zeros((), jnp.int32)
+    out, n, _, _, _, rounds, accepted = jax.lax.while_loop(
+        cond, body,
+        (out, jnp.ones((), jnp.int32), first, target_cache,
+         draft_cache, zero, zero))
+
+    tokens = out[:, :p + max_new_tokens]
+    if return_stats:
+        return tokens, {"rounds": rounds, "accepted_drafts": accepted,
+                        "generated": n}
+    return tokens
+
+
+def speculative_decode(model, params, draft_model, draft_params,
+                       prompt, max_new_tokens, *, k=4,
+                       return_stats=False):
+    """Greedy decode of ``model`` accelerated by ``draft_model``.
+
+    Returns [B, P + max_new_tokens] tokens identical to
+    ``decode(model, params, prompt, max_new_tokens)`` (greedy). With
+    ``return_stats=True`` also returns {"rounds", "accepted_drafts",
+    "generated"} for acceptance-rate telemetry (generated may
+    overshoot max_new_tokens internally; the output is sliced).
+
+    Per round: k draft steps propose k-1 tokens (the k-th step only
+    keys the draft cache), one width-k verify forward scores them,
+    and up to k tokens commit (k-1 accepted + the target's own).
+    k=1 degenerates to plain greedy with a redundant draft step.
+
+    Requirements: full-width prompts (every row's true length equals
+    the prompt width — the one-shot-prefill contract), greedy only,
+    no sliding window on either model, shared vocab, and
+    P + max_new_tokens + k within both models' max_seq_len.
+    """
+    if max_new_tokens < 1:
+        raise ValueError("speculative decode needs max_new_tokens >= 1")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if getattr(model, "attention_window", 0) or getattr(
+            draft_model, "attention_window", 0):
+        raise ValueError(
+            "speculative decode does not support sliding-window "
+            "models (ring cache writes assume one-shot prefill)")
+    for m, which in ((model, "target"), (draft_model, "draft")):
+        if not hasattr(m, "chunk_attends_cache"):
+            raise ValueError(
+                f"speculative decode does not support this {which} "
+                f"model ({type(m).__name__}): it has no "
+                f"chunk_attends_cache verify path (MoE models are "
+                f"not supported)")
+    if draft_model.vocab_size != model.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_model.vocab_size} != target vocab "
+            f"{model.vocab_size}")
+    b, p = prompt.shape
+    need = p + max_new_tokens + k
+    for m, which in ((model, "target"), (draft_model, "draft")):
+        if need > m.max_seq_len:
+            raise ValueError(
+                f"prompt {p} + max_new_tokens {max_new_tokens} + k "
+                f"{k} exceeds {which} max_seq_len {m.max_seq_len}")
+    return _spec_impl(model, params, draft_model, draft_params,
+                      jnp.asarray(prompt, jnp.int32), max_new_tokens,
+                      k, return_stats)

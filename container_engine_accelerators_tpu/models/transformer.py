@@ -151,6 +151,12 @@ class CausalSelfAttention(nn.Module):
     # "int8": weight-only quantized projections (serving; convert a
     # trained checkpoint with models.quantized.convert_params_int8).
     weights: str = "native"
+    # Multi-token chunks attend the cache (speculative-decode verify
+    # steps) instead of taking the one-shot-prefill fast path, which
+    # assumes an empty cache. Clone-time flag: it changes only the
+    # compute path, never the cache variables, so a chunked clone
+    # interoperates with the plain decode model's cache.
+    chunk_attends_cache: bool = False
 
     def _kv_heads(self):
         kv = self.num_kv_heads or self.num_heads
@@ -327,16 +333,19 @@ class CausalSelfAttention(nn.Module):
             slot_pos.value = cache_write(slot_pos.value, pos_vals)
         index.value = i + q.shape[1]
 
-        if q.shape[1] > 1:
-            # Multi-token chunks only occur at one-shot prefill, where
-            # the cache was empty (decode.py feeds single tokens after
-            # prefill; a multi-token chunk against a non-empty cache
-            # is outside the decode API's contract). Attention then
-            # reduces to causal attention among the incoming tokens —
-            # every padded cache position is masked — so run the
-            # Pallas kernel on the raw chunk: O(P*block) score memory
+        if q.shape[1] > 1 and not self.chunk_attends_cache:
+            # Multi-token chunks normally occur only at one-shot
+            # prefill, where the cache was empty (decode.py feeds
+            # single tokens after prefill). Attention then reduces to
+            # causal attention among the incoming tokens — every
+            # padded cache position is masked — so run the Pallas
+            # kernel on the raw chunk: O(P*block) score memory
             # instead of [B, H, P, S_max] against the cache, and no
             # int8 round-trip for the prefill tokens' own scores.
+            # Speculative verify steps clone the model with
+            # chunk_attends_cache=True and fall through to the
+            # general cached path below, whose position masks are
+            # already chunk-correct at any offset.
             heads = q.shape[2]
             return flash_attention(q, _expand_kv(k, heads),
                                    _expand_kv(v, heads), causal=True,
@@ -402,6 +411,7 @@ class Block(nn.Module):
     rope: bool = False
     window: int = 0
     weights: str = "native"
+    chunk_attends_cache: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -415,6 +425,8 @@ class Block(nn.Module):
                                 rope=self.rope,
                                 window=self.window,
                                 weights=self.weights,
+                                chunk_attends_cache=(
+                                    self.chunk_attends_cache),
                                 name="attn")(x)
         quant = self.weights == "int8"
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -451,6 +463,9 @@ class TransformerLM(nn.Module):
     # "int8": weight-only quantized projections/MLPs for serving
     # (embeddings, norms, and the f32 lm_head stay full precision).
     weights: str = "native"
+    # Speculative-decode verify clones: multi-token chunks attend the
+    # KV cache (see CausalSelfAttention.chunk_attends_cache).
+    chunk_attends_cache: bool = False
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -485,6 +500,7 @@ class TransformerLM(nn.Module):
                       rope=self.pos_embedding == "rope",
                       window=self.attention_window,
                       weights=self.weights,
+                      chunk_attends_cache=self.chunk_attends_cache,
                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,

@@ -1,0 +1,129 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Speculative decoding: greedy equality is the whole contract.
+
+Every test pins speculative_decode against plain greedy decode() —
+speculation may only change wall-clock, never a single token. The
+verify path (multi-token chunks attending a non-empty cache via
+chunk_attends_cache) is exercised by construction in every case.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import TransformerLM
+from container_engine_accelerators_tpu.models.decode import decode
+from container_engine_accelerators_tpu.models.speculative import (
+    speculative_decode,
+)
+
+
+def _make(vocab=64, embed=32, layers=2, heads=4, seq=96, seed=0,
+          **kwargs):
+    model = TransformerLM(vocab_size=vocab, embed_dim=embed,
+                          num_layers=layers, num_heads=heads,
+                          max_seq_len=seq, dtype=jnp.float32, **kwargs)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _prompt(b, p, vocab=64, seed=7):
+    return jax.random.randint(jax.random.PRNGKey(seed), (b, p), 0,
+                              vocab)
+
+
+@pytest.mark.parametrize("k", [1, 2, 4, 7])
+def test_spec_equals_greedy_disagreeing_draft(k):
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 8)
+    want = decode(target, tp, prompt, 16)
+    got = speculative_decode(target, tp, draft, dp, prompt, 16, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_equals_greedy_self_draft_full_acceptance():
+    """Draft == target: every proposal matches, each round commits k
+    tokens, and the output is still exactly greedy."""
+    target, tp = _make(seed=0)
+    prompt = _prompt(1, 8)
+    want = decode(target, tp, prompt, 20)
+    got, stats = speculative_decode(target, tp, target, tp, prompt,
+                                    20, k=4, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats["accepted_drafts"]) > 0
+    # Full acceptance commits k tokens per round (k-1 drafts + the
+    # target's own token, which equals the k-th draft).
+    assert int(stats["rounds"]) <= -(-20 // 4)  # ceil
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"pos_embedding": "rope"},
+    {"num_kv_heads": 2},
+    {"kv_cache_dtype": "int8"},
+    {"pos_embedding": "rope", "num_kv_heads": 2,
+     "kv_cache_dtype": "int8"},
+])
+def test_spec_equals_greedy_model_variants(kwargs):
+    target, tp = _make(seed=3, **kwargs)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=4, **{
+        key: val for key, val in kwargs.items()
+        if key != "num_kv_heads"})
+    prompt = _prompt(2, 8)
+    want = decode(target, tp, prompt, 12)
+    got = speculative_decode(target, tp, draft, dp, prompt, 12, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_batch_uniform_progress():
+    """Batched rows advance by the minimum acceptance; output still
+    matches row-for-row."""
+    target, tp = _make(seed=0)
+    prompt = _prompt(4, 8, seed=11)
+    want = decode(target, tp, prompt, 16)
+    got = speculative_decode(target, tp, target, tp, prompt, 16, k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_validation():
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=1)
+    prompt = _prompt(1, 8)
+    with pytest.raises(ValueError, match="max_new_tokens >= 1"):
+        speculative_decode(target, tp, draft, dp, prompt, 0)
+    with pytest.raises(ValueError, match="k must be"):
+        speculative_decode(target, tp, draft, dp, prompt, 4, k=0)
+    wdraft, wdp = _make(embed=16, layers=1, heads=2, seed=1,
+                        attention_window=8)
+    with pytest.raises(ValueError, match="sliding-window"):
+        speculative_decode(target, tp, wdraft, wdp, prompt, 4)
+    vdraft, vdp = _make(vocab=32, embed=16, layers=1, heads=2, seed=1)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_decode(target, tp, vdraft, vdp, prompt, 4)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        speculative_decode(target, tp, draft, dp, prompt, 96, k=4)
+    from container_engine_accelerators_tpu.models import (
+        MoETransformerLM,
+    )
+    moe = MoETransformerLM(vocab_size=64, embed_dim=32, num_layers=1,
+                           num_heads=2, num_experts=2, max_seq_len=96,
+                           dtype=jnp.float32)
+    with pytest.raises(ValueError, match="MoE"):
+        speculative_decode(moe, {}, draft, dp, prompt, 4)
+    with pytest.raises(ValueError, match="MoE"):
+        speculative_decode(target, tp, moe, {}, prompt, 4)

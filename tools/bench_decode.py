@@ -70,6 +70,18 @@ def main(argv=None):
                    default="native",
                    help="weight-only int8 projections/MLPs (the "
                         "serving load-time conversion)")
+    p.add_argument("--speculative-k", type=int, default=0,
+                   help="N>0: greedy speculative decoding with a "
+                        "draft model proposing N tokens per verify "
+                        "round (output identical to plain greedy)")
+    p.add_argument("--draft", default="self", choices=["self", "small"],
+                   help="'self': draft = the target itself (full "
+                        "acceptance — the mechanism's upper bound); "
+                        "'small': an untrained --draft-layers/"
+                        "--draft-embed-dim model (random weights "
+                        "never agree: the all-rejected floor)")
+    p.add_argument("--draft-layers", type=int, default=2)
+    p.add_argument("--draft-embed-dim", type=int, default=128)
     args = p.parse_args(argv)
 
     from container_engine_accelerators_tpu.models import TransformerLM
@@ -81,7 +93,9 @@ def main(argv=None):
         num_kv_heads=args.num_kv_heads or None,
         pos_embedding=args.pos_embedding,
         attention_window=args.attention_window,
-        max_seq_len=args.prompt_len + args.new_tokens,
+        # Speculative verify chunks need k slack cache positions.
+        max_seq_len=(args.prompt_len + args.new_tokens
+                     + args.speculative_k),
         kv_cache_dtype=(None if args.kv_cache_dtype == "bfloat16"
                         else args.kv_cache_dtype))
     params = jax.jit(lambda key: model.init(
@@ -98,6 +112,38 @@ def main(argv=None):
             jnp.zeros((1, 8), jnp.int32), train=False)["params"]
         params = convert_params_int8(template, params)
 
+    spec = {}
+    if args.speculative_k:
+        from container_engine_accelerators_tpu.models.speculative import (
+            speculative_decode,
+        )
+        if args.draft == "self":
+            draft_model, draft_params = model, params
+        else:
+            draft_model = TransformerLM(
+                vocab_size=args.vocab_size,
+                embed_dim=args.draft_embed_dim,
+                num_layers=args.draft_layers,
+                num_heads=args.num_heads,
+                pos_embedding=args.pos_embedding,
+                max_seq_len=model.max_seq_len)
+            draft_params = jax.jit(lambda key: draft_model.init(
+                key, jnp.zeros((1, 8), jnp.int32),
+                train=False)["params"])(jax.random.PRNGKey(2))
+        spec = {"speculative_k": args.speculative_k,
+                "draft": args.draft,
+                "draft_layers": (args.num_layers
+                                 if args.draft == "self"
+                                 else args.draft_layers)}
+
+        def run(prompt):
+            return speculative_decode(
+                model, params, draft_model, draft_params, prompt,
+                args.new_tokens, k=args.speculative_k)
+    else:
+        def run(prompt):
+            return decode(model, params, prompt, args.new_tokens)
+
     for b in args.batch:
         prompt = jax.random.randint(
             jax.random.PRNGKey(1), (b, args.prompt_len), 0,
@@ -105,11 +151,11 @@ def main(argv=None):
         # wall_sync, not block_until_ready: the tunneled axon backend
         # acks dispatch as "ready"; only a forced device->host
         # transfer times real execution (one round trip, amortized).
-        out = decode(model, params, prompt, args.new_tokens)
+        out = run(prompt)
         wall_sync(out)  # compile + warm
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            out = decode(model, params, prompt, args.new_tokens)
+            out = run(prompt)
         wall_sync(out)
         sec = (time.perf_counter() - t0) / args.iters
         tokens = b * args.new_tokens
@@ -128,6 +174,7 @@ def main(argv=None):
             "sec_per_call": round(sec, 4),
             "decode_tokens_per_sec": round(tokens / sec, 1),
             "ms_per_token": round(sec / args.new_tokens * 1000, 3),
+            **spec,
         }))
 
 

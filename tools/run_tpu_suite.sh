@@ -66,6 +66,12 @@ echo "[suite] decode bench (bf16 + int8 cache + GQA + window)" >&2
     --prompt-len 128 --new-tokens 128 --attention-window 64
   timeout 1800 python tools/bench_decode.py --batch 1 8 \
     --prompt-len 128 --new-tokens 128 --quantize-weights int8
+  # Speculative decoding: self-draft = full-acceptance upper bound,
+  # small-draft = all-rejected floor; real drafts land in between.
+  timeout 1800 python tools/bench_decode.py --batch 1 \
+    --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft self
+  timeout 1800 python tools/bench_decode.py --batch 1 \
+    --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft small
 } > "${OUT}/DECODE_BENCH.json" 2>> "${OUT}/tpu_suite.log"
 cat "${OUT}/DECODE_BENCH.json" >&2
 
