@@ -54,7 +54,7 @@ def batch_sharding(mesh):
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def _param_spec(path, value, model_parallel, expert_parallel):
+def _param_spec(path, value, model_parallel, expert_parallel, fsdp=0):
     shape = getattr(value, "shape", ())
     # Stacked per-expert kernels ([E, in, out]) shard their expert
     # dim over EXPERT_AXIS — the layout expert_parallel_moe expects.
@@ -67,42 +67,65 @@ def _param_spec(path, value, model_parallel, expert_parallel):
     # kernel fails loudly in review, not silently at scale.
     keys = [str(getattr(k, "key", k)) for k in path]
     in_expert_module = any(_is_expert_module(k) for k in keys[:-1])
+    spec = [None] * len(shape)
     if expert_parallel and in_expert_module and len(shape) >= 3:
         if (keys[-1] in _EXPERT_PARAM_NAMES
                 and shape[0] % expert_parallel == 0):
-            return P(*([EXPERT_AXIS] + [None] * (len(shape) - 1)))
-        log.warning(
-            "param %s (shape %s) sits in an expert module but does "
-            "not match the expert-kernel contract (names %s, leading "
-            "dim divisible by %d); leaving it replicated",
-            "/".join(keys), shape, sorted(_EXPERT_PARAM_NAMES),
-            expert_parallel)
-    if not model_parallel:
-        return P()
-    if len(shape) < 2:
-        return P()
+            spec[0] = EXPERT_AXIS
+        else:
+            log.warning(
+                "param %s (shape %s) sits in an expert module but "
+                "does not match the expert-kernel contract (names "
+                "%s, leading dim divisible by %d); leaving it "
+                "replicated",
+                "/".join(keys), shape, sorted(_EXPERT_PARAM_NAMES),
+                expert_parallel)
     # Shard the output-features dim (last axis for both conv HWIO and
     # dense IO kernels) when it is wide and divisible.
-    if shape[-1] >= _MIN_SHARD_DIM and shape[-1] % model_parallel == 0:
-        return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
-    return P()
+    if (model_parallel and len(shape) >= 2 and spec[-1] is None
+            and shape[-1] >= _MIN_SHARD_DIM
+            and shape[-1] % model_parallel == 0):
+        spec[-1] = MODEL_AXIS
+    # FSDP (ZeRO-3 via GSPMD): additionally shard each big kernel's
+    # largest still-free dim over the DATA axis. Per-device parameter
+    # and optimizer-moment residency then drops by ~the data-parallel
+    # degree; XLA inserts the all-gather at use and the
+    # reduce-scatter on the gradient — the scaling-book recipe, no
+    # hand-written collectives. Composes with tensor parallelism
+    # (out-features over "model", another dim over "data").
+    if fsdp and len(shape) >= 2:
+        # >= 2-D only: a 512-wide BatchNorm scale/bias is 2 KB —
+        # gathering it every step costs more collective latency than
+        # the bytes it saves (same rationale as _MIN_SHARD_DIM).
+        for i in sorted(range(len(shape)),
+                        key=lambda i: -int(shape[i])):
+            if (spec[i] is None and shape[i] >= _MIN_SHARD_DIM
+                    and shape[i] % fsdp == 0):
+                spec[i] = DATA_AXIS
+                break
+    return P(*spec) if any(s is not None for s in spec) else P()
 
 
-def param_shardings(mesh, params):
+def param_shardings(mesh, params, fsdp=False):
     """NamedSharding pytree for a parameter pytree.
 
     With a 1-wide model axis everything is replicated (pure DP); with
     model parallelism, wide kernels are sharded column-wise over
     MODEL_AXIS; on meshes with an expert axis, stacked MoE expert
-    kernels shard their leading expert dim over EXPERT_AXIS. XLA
+    kernels shard their leading expert dim over EXPERT_AXIS; with
+    ``fsdp=True`` big kernels additionally shard a free dim over the
+    DATA axis (ZeRO-3-style parameter/optimizer sharding). XLA
     inserts the matching all-gathers/reduce-scatters.
     """
     model_parallel = dict(mesh.shape).get(MODEL_AXIS, 1)
     mp = model_parallel if model_parallel > 1 else 0
     expert_parallel = dict(mesh.shape).get(EXPERT_AXIS, 1)
     ep = expert_parallel if expert_parallel > 1 else 0
+    data_parallel = dict(mesh.shape).get(DATA_AXIS, 1)
+    dp = data_parallel if (fsdp and data_parallel > 1) else 0
 
     def to_sharding(path, value):
-        return NamedSharding(mesh, _param_spec(path, value, mp, ep))
+        return NamedSharding(mesh, _param_spec(path, value, mp, ep,
+                                               dp))
 
     return jax.tree_util.tree_map_with_path(to_sharding, params)

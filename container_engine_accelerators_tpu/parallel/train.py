@@ -62,7 +62,7 @@ class Trainer:
 
     def __init__(self, apply_fn, loss_fn, optimizer, mesh=None,
                  donate_state=True, remat=False, grad_accum=1,
-                 augment_fn=None, ema_decay=0.0):
+                 augment_fn=None, ema_decay=0.0, fsdp=False):
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1: {grad_accum}")
         if not 0.0 <= ema_decay < 1.0:
@@ -71,6 +71,13 @@ class Trainer:
         self._apply = apply_fn
         self._loss = loss_fn
         self._tx = optimizer
+        # fsdp=True: ZeRO-3-style sharding — big kernels (and their
+        # optimizer moments, which mirror param layouts) shard a dim
+        # over the data axis; XLA gathers weights at use and
+        # reduce-scatters gradients. Changes memory layout only, not
+        # the math: the loss trajectory is bitwise-comparable to pure
+        # DP up to reduction order.
+        self._fsdp = bool(fsdp)
         self.mesh = mesh if mesh is not None else build_mesh()
         self._donate = donate_state
         self._remat = remat
@@ -116,7 +123,8 @@ class Trainer:
 
     def state_shardings(self, state):
         if self._state_shardings is None:
-            p_shard = param_shardings(self.mesh, state.params)
+            p_shard = param_shardings(self.mesh, state.params,
+                                      fsdp=self._fsdp)
             rep = replicated(self.mesh)
             # Optimizer moments mirror their parameter's layout (same
             # shape -> same sharding); scalars/counters replicate.

@@ -166,6 +166,13 @@ def parse_args(argv=None):
                         "many DCN granules (slices/hosts), keeping "
                         "model parallelism inside each granule")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3-style parameter/optimizer sharding "
+                        "over the data axis (big kernels shard a "
+                        "free dim; XLA gathers weights at use and "
+                        "reduce-scatters grads) — per-device "
+                        "parameter residency drops by ~the "
+                        "data-parallel degree")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="accumulate gradients over N equal microbatches "
                         "inside one compiled step (one optimizer update; "
@@ -542,7 +549,7 @@ def main(argv=None):
                 flip=True, crop_padding=args.crop_padding)
     trainer = Trainer(apply_fn, loss_fn, tx, mesh=mesh, remat=args.remat,
                       grad_accum=args.grad_accum, augment_fn=augment_fn,
-                      ema_decay=args.ema_decay)
+                      ema_decay=args.ema_decay, fsdp=args.fsdp)
 
     variables = model.init(jax.random.PRNGKey(0), init_batch, train=False)
     state = trainer.init_state(variables)
