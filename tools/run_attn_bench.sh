@@ -36,10 +36,13 @@ done
 
 # Long-context (streaming kernels; dense cannot compile here, which
 # the rows record). batch 1 keeps the dense comparison attempt cheap.
+# --check-numerics at 16k/32k: dense cannot compile there (its row
+# reports numerics_error) but the chunked f32 oracle can — these are
+# exactly the lengths whose TFLOP/s claims need an error bound.
 for SEQ in 16384 32768; do
   echo "[attn-bench] seq_len=${SEQ} (streaming)" >&2
-  timeout 900 python tools/bench_attention.py \
-    --seq-len "${SEQ}" --batch 1 >> "${TMP}" \
+  timeout 1500 python tools/bench_attention.py \
+    --seq-len "${SEQ}" --batch 1 --check-numerics >> "${TMP}" \
     || echo "{\"seq_len\": ${SEQ}, \"error\": \"run failed/timeout\"}" \
        >> "${TMP}"
 done
