@@ -55,6 +55,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .decode import _logits_of, init_cache
 
@@ -75,9 +76,9 @@ def _rewind(cache, position):
 
 @functools.partial(
     jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
-                              "k", "return_stats"))
+                              "k", "return_stats", "ragged"))
 def _spec_impl(model, params, draft_model, draft_params, prompt,
-               max_new_tokens, k, return_stats):
+               max_new_tokens, k, return_stats, ragged, prompt_len):
     b, p = prompt.shape
     total = p + max_new_tokens + k  # slack for optimistic writes
 
@@ -85,23 +86,64 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
     verify_dec = target_dec.clone(chunk_attends_cache=True)
     draft_dec, draft_cache = init_cache(draft_model, b, total)
 
-    # Prefill both caches with one full-width forward each; the
-    # target's last-position logits yield the first generated token
-    # (identical to decode()'s fast_prefill).
-    outs, upd = target_dec.apply(
-        {"params": params, "cache": target_cache}, prompt,
-        train=False, mutable=["cache"])
-    target_cache = upd["cache"]
-    first = jnp.argmax(_logits_of(outs)[:, -1], axis=-1).astype(
-        prompt.dtype)
-    _, dupd = draft_dec.apply(
-        {"params": draft_params, "cache": draft_cache}, prompt,
-        train=False, mutable=["cache"])
-    draft_cache = dupd["cache"]
+    if ragged:
+        # Per-row true lengths: rows diverge inside the padded prompt
+        # (short rows are already generating while long rows are
+        # still forced), so speculation cannot start yet. Walk the
+        # prompt region stepwise exactly as decode() does — forced
+        # token while in-prompt, greedy sample after — until every
+        # row reaches the uniform frontier at position p. This phase
+        # is identical work to the serving decode path's stepwise
+        # prefill; speculation accelerates the generation phase.
+        # One pad column: the scan's forced index reaches exactly p
+        # (selected only while t + 1 < plen <= p).
+        padded = jnp.pad(prompt, ((0, 0), (0, 1)))
+        plen = jnp.reshape(prompt_len, (-1,))
 
-    out = jnp.zeros((b, total), prompt.dtype)
-    out = jax.lax.dynamic_update_slice(out, prompt, (0, 0))
-    out = jax.lax.dynamic_update_slice(out, first[:, None], (0, p))
+        def prompt_step(carry, t):
+            cache, tok = carry
+            o, u = target_dec.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                train=False, mutable=["cache"])
+            sampled = jnp.argmax(_logits_of(o)[:, 0], axis=-1).astype(
+                tok.dtype)
+            forced = jax.lax.dynamic_index_in_dim(
+                padded, t + 1, 1, keepdims=False)
+            nxt = jnp.where(t + 1 < plen, forced, sampled)
+            return (u["cache"], nxt), nxt
+
+        (target_cache, first), walked = jax.lax.scan(
+            prompt_step, (target_cache, prompt[:, 0]),
+            jnp.arange(p, dtype=jnp.int32))
+        # Resolved prefix (prompt tokens + target generations inside
+        # the padding); the draft prefills it in ONE empty-cache
+        # forward. `first` is the token at position p.
+        prefix = jnp.concatenate(
+            [prompt[:, :1], walked.T[:, :p - 1]], axis=1)
+        _, dupd = draft_dec.apply(
+            {"params": draft_params, "cache": draft_cache}, prefix,
+            train=False, mutable=["cache"])
+        draft_cache = dupd["cache"]
+        out = jnp.zeros((b, total), prompt.dtype)
+        out = jax.lax.dynamic_update_slice(out, prefix, (0, 0))
+        out = jax.lax.dynamic_update_slice(out, first[:, None], (0, p))
+    else:
+        # Full-width prompts: prefill both caches with one forward
+        # each; the target's last-position logits yield the first
+        # generated token (identical to decode()'s fast_prefill).
+        outs, upd = target_dec.apply(
+            {"params": params, "cache": target_cache}, prompt,
+            train=False, mutable=["cache"])
+        target_cache = upd["cache"]
+        first = jnp.argmax(_logits_of(outs)[:, -1], axis=-1).astype(
+            prompt.dtype)
+        _, dupd = draft_dec.apply(
+            {"params": draft_params, "cache": draft_cache}, prompt,
+            train=False, mutable=["cache"])
+        draft_cache = dupd["cache"]
+        out = jnp.zeros((b, total), prompt.dtype)
+        out = jax.lax.dynamic_update_slice(out, prompt, (0, 0))
+        out = jax.lax.dynamic_update_slice(out, first[:, None], (0, p))
 
     def cond(carry):
         n = carry[1]
@@ -183,7 +225,7 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
 
 def speculative_decode(model, params, draft_model, draft_params,
                        prompt, max_new_tokens, *, k=4,
-                       return_stats=False):
+                       prompt_len=None, return_stats=False):
     """Greedy decode of ``model`` accelerated by ``draft_model``.
 
     Returns [B, P + max_new_tokens] tokens identical to
@@ -197,10 +239,17 @@ def speculative_decode(model, params, draft_model, draft_params,
     and up to k tokens commit (k-1 accepted + the target's own).
     k=1 degenerates to plain greedy with a redundant draft step.
 
-    Requirements: full-width prompts (every row's true length equals
-    the prompt width — the one-shot-prefill contract), greedy only,
-    no sliding window on either model, shared vocab, and
-    P + max_new_tokens + k within both models' max_seq_len.
+    ``prompt_len`` (scalar or per-row [B] vector of true lengths)
+    supports right-padded ragged prompts, matching
+    ``decode(..., prompt_len=...)``: the padded prompt region is
+    walked stepwise exactly as decode does (rows diverge there —
+    short rows generate while long rows are forced), and speculation
+    starts at the uniform frontier after the padding. None means
+    full-width prompts and one-shot prefill.
+
+    Requirements: greedy only, no sliding window on either model,
+    shared vocab, and P + max_new_tokens + k within both models'
+    max_seq_len.
     """
     if max_new_tokens < 1:
         raise ValueError("speculative decode needs max_new_tokens >= 1")
@@ -229,6 +278,24 @@ def speculative_decode(model, params, draft_model, draft_params,
             raise ValueError(
                 f"prompt {p} + max_new_tokens {max_new_tokens} + k "
                 f"{k} exceeds {which} max_seq_len {m.max_seq_len}")
+    ragged = prompt_len is not None
+    if ragged:
+        # Validate on host (no device round trip; prompt_len is a
+        # concrete value at dispatch time).
+        plen_host = np.asarray(prompt_len, np.int32).reshape(-1)
+        if plen_host.shape[0] not in (1, b):
+            raise ValueError(
+                f"prompt_len must be a scalar or one entry per row "
+                f"({b}): got shape {plen_host.shape}")
+        plen_host = np.broadcast_to(plen_host, (b,))
+        if (plen_host < 1).any() or (plen_host > p).any():
+            raise ValueError(
+                f"prompt_len entries must be in 1..{p}: {plen_host}")
+        plen_arr = jnp.asarray(plen_host)
+        if (plen_host == p).all():
+            ragged = False  # full-width: use one-shot prefill
+    else:
+        plen_arr = jnp.full((b,), p, jnp.int32)
     return _spec_impl(model, params, draft_model, draft_params,
                       jnp.asarray(prompt, jnp.int32), max_new_tokens,
-                      k, return_stats)
+                      k, return_stats, ragged, plen_arr)

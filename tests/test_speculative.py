@@ -127,3 +127,40 @@ def test_spec_validation():
         speculative_decode(moe, {}, draft, dp, prompt, 4)
     with pytest.raises(ValueError, match="MoE"):
         speculative_decode(target, tp, moe, {}, prompt, 4)
+
+
+def test_spec_equals_greedy_ragged_prompts():
+    """prompt_len support: rows with different true lengths match
+    decode(prompt_len=...) token-for-token (the serving layer's
+    padded-bucket shape)."""
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=9)
+    prompt = _prompt(3, 8, seed=13)
+    plen = jnp.array([3, 8, 5], jnp.int32)
+    want = decode(target, tp, prompt, 12, prompt_len=plen)
+    for dm, dpar in ((draft, dp), (target, tp)):
+        got = speculative_decode(target, tp, dm, dpar, prompt, 12,
+                                 k=4, prompt_len=plen)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+
+
+def test_spec_ragged_validation():
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=9)
+    prompt = _prompt(2, 8)
+    with pytest.raises(ValueError, match="prompt_len"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           prompt_len=jnp.array([0, 8]))
+    with pytest.raises(ValueError, match="prompt_len"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           prompt_len=9)
+
+
+def test_spec_ragged_wrong_length_vector():
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=9)
+    prompt = _prompt(3, 8)
+    with pytest.raises(ValueError, match="one entry per row"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           prompt_len=jnp.array([3, 5]))
