@@ -43,12 +43,15 @@ einsum is already position-correct for multi-token chunks at any
 offset; the clone shares cache variables with the plain decode model,
 so prefill still uses the fast empty-cache path.
 
-Not supported (raise): sampling (temperature > 0 — rejection-sampling
-speculation is a different algorithm), sliding-window/ring caches
-(their prefill chunk write assumes offset 0), EOS early-exit, MoE
-draft or target. Reference repo has no counterpart (its serving demo
-is TF-Serving images, SURVEY.md section 2.3); this is framework-level
-capability the TPU stack adds.
+Supported alongside speculation: ragged prompts (``prompt_len``) and
+EOS termination (``eos_id``, with an early exit plain decode cannot
+do — once every row finished, remaining positions fill with EOS and
+no further model evaluation runs). Not supported (raise): sampling
+(temperature > 0 — rejection-sampling speculation is a different
+algorithm), sliding-window/ring caches (their prefill chunk write
+assumes offset 0), MoE draft or target. Reference repo has no
+counterpart (its serving demo is TF-Serving images, SURVEY.md
+section 2.3); this is framework-level capability the TPU stack adds.
 """
 
 import functools
@@ -76,11 +79,16 @@ def _rewind(cache, position):
 
 @functools.partial(
     jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
-                              "k", "return_stats", "ragged"))
+                              "k", "return_stats", "ragged",
+                              "use_eos"))
 def _spec_impl(model, params, draft_model, draft_params, prompt,
-               max_new_tokens, k, return_stats, ragged, prompt_len):
+               max_new_tokens, k, return_stats, ragged, prompt_len,
+               use_eos, eos_id):
     b, p = prompt.shape
     total = p + max_new_tokens + k  # slack for optimistic writes
+    # Per-row EOS (-1 = never matches); decode's semantics: a row
+    # whose GENERATED text reached EOS keeps emitting it.
+    eos_row = jnp.reshape(eos_id, (-1,)).astype(prompt.dtype)
 
     target_dec, target_cache = init_cache(model, b, total)
     verify_dec = target_dec.clone(chunk_attends_cache=True)
@@ -101,7 +109,7 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         plen = jnp.reshape(prompt_len, (-1,))
 
         def prompt_step(carry, t):
-            cache, tok = carry
+            cache, tok, done = carry
             o, u = target_dec.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 train=False, mutable=["cache"])
@@ -109,11 +117,18 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
                 tok.dtype)
             forced = jax.lax.dynamic_index_in_dim(
                 padded, t + 1, 1, keepdims=False)
-            nxt = jnp.where(t + 1 < plen, forced, sampled)
-            return (u["cache"], nxt), nxt
+            in_prompt = t + 1 < plen
+            nxt = jnp.where(in_prompt, forced, sampled)
+            if use_eos:
+                # Same order as decode's step: done-mask after prompt
+                # forcing; prompt-resident EOS never triggers.
+                nxt = jnp.where(done, eos_row, nxt)
+                done = done | (~in_prompt & (nxt == eos_row))
+            return (u["cache"], nxt, done), nxt
 
-        (target_cache, first), walked = jax.lax.scan(
-            prompt_step, (target_cache, prompt[:, 0]),
+        (target_cache, first, done), walked = jax.lax.scan(
+            prompt_step,
+            (target_cache, prompt[:, 0], jnp.zeros((b,), bool)),
             jnp.arange(p, dtype=jnp.int32))
         # Resolved prefix (prompt tokens + target generations inside
         # the padding); the draft prefills it in ONE empty-cache
@@ -137,6 +152,8 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         target_cache = upd["cache"]
         first = jnp.argmax(_logits_of(outs)[:, -1], axis=-1).astype(
             prompt.dtype)
+        done = ((first == eos_row) if use_eos
+                else jnp.zeros((b,), bool))
         _, dupd = draft_dec.apply(
             {"params": draft_params, "cache": draft_cache}, prompt,
             train=False, mutable=["cache"])
@@ -146,23 +163,32 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         out = jax.lax.dynamic_update_slice(out, first[:, None], (0, p))
 
     def cond(carry):
-        n = carry[1]
-        return n < max_new_tokens
+        n, done = carry[1], carry[5]
+        alive = jnp.logical_not(jnp.all(done)) if use_eos else True
+        return (n < max_new_tokens) & alive
 
     def body(carry):
-        out, n, last, target_cache, draft_cache, rounds, accepted = carry
+        (out, n, last, target_cache, draft_cache, done, rounds,
+         accepted) = carry
 
         # Draft: k sequential greedy steps from the last committed
         # token. Its cache enters at index p+n-1 (the invariant: the
-        # index of the newest committed-but-unkeyed token).
+        # index of the newest committed-but-unkeyed token). Proposals
+        # carry decode's done-chain (a finished row proposes EOS
+        # forever) so the fed draft stream — and hence the verify
+        # chunk — matches the committed stream token-for-token on
+        # accepted prefixes.
         def draft_step(c, _):
-            cache, tok = c
+            cache, tok, done_d = c
             o, u = draft_dec.apply(
                 {"params": draft_params, "cache": cache}, tok[:, None],
                 train=False, mutable=["cache"])
             nxt = jnp.argmax(_logits_of(o)[:, 0], axis=-1).astype(
                 tok.dtype)
-            return (u["cache"], nxt), nxt
+            if use_eos:
+                nxt = jnp.where(done_d, eos_row, nxt)
+                done_d = done_d | (nxt == eos_row)
+            return (u["cache"], nxt, done_d), nxt
 
         # k steps yield k-1 usable proposals: the k-th step's sampled
         # token is discarded, but the step itself is what writes
@@ -171,14 +197,14 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         # newest accepted token. (This off-by-one is inherent: a
         # draft never consumes, hence never keys, its own final
         # proposal.)
-        (draft_cache, _), proposals = jax.lax.scan(
-            draft_step, (draft_cache, last), None, length=k)
+        (draft_cache, _, _), proposals = jax.lax.scan(
+            draft_step, (draft_cache, last, done), None, length=k)
         d = proposals.T[:, :k - 1]  # [B, k-1]
 
         # Target verifies the proposals (+ keys the last token) in
         # ONE chunked forward of width k: logits[:, j] predicts the
         # token after chunk position j. Every column is consumed
-        # (nxt = g[:, m] with m <= k-1), so the chunk is as narrow
+        # (nxt = c[:, m] with m <= k-1), so the chunk is as narrow
         # as the acceptance bound allows.
         chunk = jnp.concatenate([last[:, None], d], axis=1)
         o, u = verify_dec.apply(
@@ -186,16 +212,37 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
             train=False, mutable=["cache"])
         g = jnp.argmax(_logits_of(o), axis=-1).astype(last.dtype)
 
-        # Longest prefix where the draft matched the target's greedy
-        # choice, uniform across the batch (<= k-1 by construction).
-        match = (d == g[:, :k - 1]).astype(jnp.int32)
+        if use_eos:
+            # The committed stream applies decode's done-mask to the
+            # target's greedy choices column by column (a tiny scan
+            # over k columns — [B] work per step).
+            def commit_col(done_c, gj):
+                cj = jnp.where(done_c, eos_row, gj)
+                done_after = done_c | (cj == eos_row)
+                return done_after, (cj, done_after)
+
+            _, (c_cols, done_cols) = jax.lax.scan(
+                commit_col, done, g.T)
+            c = c_cols.T                 # [B, k] masked commits
+            done_track = done_cols.T     # [B, k] done AFTER column j
+        else:
+            c = g
+
+        # Longest prefix where the (done-masked) proposals match the
+        # committed stream, uniform across the batch (<= k-1 by
+        # construction). Finished rows auto-match: both sides emit
+        # EOS, so a done row never drags the batch's acceptance down.
+        match = (d == c[:, :k - 1]).astype(jnp.int32)
         m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
         m = jnp.min(m_row)
         # The committed continuation: accepted proposals d[:, :m],
-        # then the target's own token at the first divergence (which
+        # then the committed token at the first divergence (which
         # equals the next draft proposal when everything matched).
-        nxt = jax.lax.dynamic_index_in_dim(g, m, axis=1,
+        nxt = jax.lax.dynamic_index_in_dim(c, m, axis=1,
                                            keepdims=False)
+        if use_eos:
+            done = jax.lax.dynamic_index_in_dim(done_track, m, axis=1,
+                                                keepdims=False)
 
         start = p + n  # first uncommitted output position
         if k > 1:
@@ -207,14 +254,23 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         # `nxt`, the newest committed-but-unkeyed token.
         target_cache = _rewind(u["cache"], start + m)
         draft_cache = _rewind(draft_cache, start + m)
-        return (out, n + m + 1, nxt, target_cache, draft_cache,
+        return (out, n + m + 1, nxt, target_cache, draft_cache, done,
                 rounds + 1, accepted + m)
 
     zero = jnp.zeros((), jnp.int32)
-    out, n, _, _, _, rounds, accepted = jax.lax.while_loop(
+    out, n, _, _, _, done, rounds, accepted = jax.lax.while_loop(
         cond, body,
         (out, jnp.ones((), jnp.int32), first, target_cache,
-         draft_cache, zero, zero))
+         draft_cache, done, zero, zero))
+
+    if use_eos:
+        # Early exit (every row finished): positions the loop never
+        # reached are EOS by decode's keep-emitting contract. Only
+        # done rows fill — identical to what further rounds would
+        # have committed, minus the model evaluations.
+        pos = jnp.arange(total, dtype=jnp.int32)[None, :]
+        fill = (pos >= p + n) & done[:, None]
+        out = jnp.where(fill, eos_row[:, None], out)
 
     tokens = out[:, :p + max_new_tokens]
     if return_stats:
@@ -225,7 +281,8 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
 
 def speculative_decode(model, params, draft_model, draft_params,
                        prompt, max_new_tokens, *, k=4,
-                       prompt_len=None, return_stats=False):
+                       prompt_len=None, eos_id=None,
+                       return_stats=False):
     """Greedy decode of ``model`` accelerated by ``draft_model``.
 
     Returns [B, P + max_new_tokens] tokens identical to
@@ -246,6 +303,12 @@ def speculative_decode(model, params, draft_model, draft_params,
     short rows generate while long rows are forced), and speculation
     starts at the uniform frontier after the padding. None means
     full-width prompts and one-shot prefill.
+
+    ``eos_id`` (scalar or per-row [B] vector; -1 = off for that row)
+    matches decode's semantics — a finished row keeps emitting its
+    EOS — with one speculative bonus: once EVERY row has finished,
+    the loop exits early and the remaining positions fill with EOS
+    directly (plain decode must scan to max_new_tokens regardless).
 
     Requirements: greedy only, no sliding window on either model,
     shared vocab, and P + max_new_tokens + k within both models'
@@ -296,6 +359,24 @@ def speculative_decode(model, params, draft_model, draft_params,
             ragged = False  # full-width: use one-shot prefill
     else:
         plen_arr = jnp.full((b,), p, jnp.int32)
+    use_eos = eos_id is not None
+    if use_eos:
+        eos_host = np.asarray(eos_id, np.int32).reshape(-1)
+        if eos_host.shape[0] not in (1, b):
+            raise ValueError(
+                f"eos_id must be a scalar or one entry per row "
+                f"({b}): got shape {eos_host.shape}")
+        eos_host = np.broadcast_to(eos_host, (b,))
+        if ((eos_host < -1) | (eos_host >= model.vocab_size)).any():
+            raise ValueError(
+                f"eos_id entries must be -1 (off) or in "
+                f"0..{model.vocab_size - 1}: {eos_host}")
+        eos_arr = jnp.asarray(eos_host)
+        if (eos_host == -1).all():
+            use_eos = False  # all rows off: skip the done machinery
+    else:
+        eos_arr = jnp.full((b,), -1, jnp.int32)
     return _spec_impl(model, params, draft_model, draft_params,
                       jnp.asarray(prompt, jnp.int32), max_new_tokens,
-                      k, return_stats, ragged, plen_arr)
+                      k, return_stats, ragged, plen_arr, use_eos,
+                      eos_arr)

@@ -164,3 +164,64 @@ def test_spec_ragged_wrong_length_vector():
     with pytest.raises(ValueError, match="one entry per row"):
         speculative_decode(target, tp, draft, dp, prompt, 4,
                            prompt_len=jnp.array([3, 5]))
+
+
+def _eos_token(model, params, prompt, n=20):
+    """A token id that actually appears in the greedy generation, so
+    EOS tests exercise real terminations."""
+    import collections
+    gen = np.asarray(decode(model, params, prompt, n))[:,
+                                                       prompt.shape[1]:]
+    return collections.Counter(gen.flatten().tolist()).most_common(
+        1)[0][0]
+
+
+def test_spec_equals_greedy_with_eos():
+    """EOS semantics match decode (finished rows keep emitting EOS),
+    for scalar, per-row mixed (-1 = off), and ragged+eos together."""
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=9)
+    prompt = _prompt(3, 8, seed=13)
+    eos = _eos_token(target, tp, prompt)
+    for eos_arg in (eos, jnp.array([eos, -1, eos], jnp.int32)):
+        want = decode(target, tp, prompt, 20, eos_id=eos_arg)
+        for dm, dpar in ((draft, dp), (target, tp)):
+            got = speculative_decode(target, tp, dm, dpar, prompt,
+                                     20, k=4, eos_id=eos_arg)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+    plen = jnp.array([3, 8, 5], jnp.int32)
+    want = decode(target, tp, prompt, 20, eos_id=eos,
+                  prompt_len=plen)
+    got = speculative_decode(target, tp, draft, dp, prompt, 20, k=4,
+                             eos_id=eos, prompt_len=plen)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_eos_early_exit():
+    """Once every row finished, the loop exits and fills EOS without
+    further model evaluations — decode cannot do that. generated <
+    max_new_tokens proves the early exit fired."""
+    target, tp = _make(seed=0)
+    prompt = _prompt(2, 8, seed=13)
+    eos = _eos_token(target, tp, prompt)
+    want = decode(target, tp, prompt, 40, eos_id=eos)
+    got, stats = speculative_decode(target, tp, target, tp, prompt,
+                                    40, k=4, eos_id=eos,
+                                    return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # Both rows terminate well before 40 tokens in this fixture; the
+    # early exit must have stopped the loop short.
+    assert int(stats["generated"]) < 40, stats
+
+
+def test_spec_eos_validation():
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=9)
+    prompt = _prompt(2, 8)
+    with pytest.raises(ValueError, match="eos_id"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           eos_id=jnp.array([1, 2, 3]))
+    with pytest.raises(ValueError, match="eos_id"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           eos_id=64)
