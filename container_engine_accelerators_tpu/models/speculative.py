@@ -341,6 +341,14 @@ def speculative_decode(model, params, draft_model, draft_params,
             raise ValueError(
                 f"prompt {p} + max_new_tokens {max_new_tokens} + k "
                 f"{k} exceeds {which} max_seq_len {m.max_seq_len}")
+    # Program-variant selection is purely type-driven (None vs given),
+    # NEVER value-driven: a serving layer feeding batches of varying
+    # composition must land on one stable compiled program per shape
+    # bucket — a "helpful" downgrade when all rows happen to be
+    # full-width (or all EOS entries happen to be -1) would flip
+    # variants mid-traffic and stall requests on compiles. Callers
+    # wanting the one-shot-prefill / no-done-machinery fast paths
+    # pass None.
     ragged = prompt_len is not None
     if ragged:
         # Validate on host (no device round trip; prompt_len is a
@@ -355,8 +363,6 @@ def speculative_decode(model, params, draft_model, draft_params,
             raise ValueError(
                 f"prompt_len entries must be in 1..{p}: {plen_host}")
         plen_arr = jnp.asarray(plen_host)
-        if (plen_host == p).all():
-            ragged = False  # full-width: use one-shot prefill
     else:
         plen_arr = jnp.full((b,), p, jnp.int32)
     use_eos = eos_id is not None
@@ -372,8 +378,6 @@ def speculative_decode(model, params, draft_model, draft_params,
                 f"eos_id entries must be -1 (off) or in "
                 f"0..{model.vocab_size - 1}: {eos_host}")
         eos_arr = jnp.asarray(eos_host)
-        if (eos_host == -1).all():
-            use_eos = False  # all rows off: skip the done machinery
     else:
         eos_arr = jnp.full((b,), -1, jnp.int32)
     return _spec_impl(model, params, draft_model, draft_params,

@@ -414,10 +414,48 @@ class GenerationServer(_BaseServer):
                  max_new_tokens=64, max_batch=8, buckets=None,
                  warm=False, warm_filters=None, warm_async=False,
                  max_wait_ms=5, tokenizer=None,
-                 max_queue=None):
+                 max_queue=None, draft_model=None, draft_params=None,
+                 speculative_k=0):
         super().__init__(model_name, port)
         from ..models.decode import decode
         self._decode = decode
+        # Speculative decoding for the default greedy path: a draft
+        # model proposes, the target verifies — identical tokens,
+        # fewer weight streams. Only plain greedy requests (no
+        # top_k/top_p/min_p — already implied by greedy validation —
+        # no repetition penalty, no logprobs) ride it; everything
+        # else takes the ordinary decode program.
+        self._spec_k = int(speculative_k)
+        self._draft_model = draft_model
+        self._draft_params = draft_params
+        if self._spec_k:
+            from ..models.speculative import speculative_decode
+            self._speculative = speculative_decode
+            # Fail at CONSTRUCTION, not at request time (or, worse,
+            # inside an async warm-up thread that leaves the replica
+            # permanently unready): every precondition
+            # speculative_decode enforces per call is checked here.
+            if self._spec_k < 1:
+                raise ValueError(
+                    f"speculative_k must be >= 1: {speculative_k}")
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "speculative_k requires draft_model and "
+                    "draft_params")
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab_size} != "
+                    f"target vocab {model.vocab_size}")
+            for m, which in ((model, "target"), (draft_model, "draft")):
+                if getattr(m, "attention_window", 0):
+                    raise ValueError(
+                        f"speculative decoding does not support the "
+                        f"sliding-window {which} model")
+                if not hasattr(m, "chunk_attends_cache"):
+                    raise ValueError(
+                        f"speculative decoding does not support this "
+                        f"{which} model ({type(m).__name__}: no "
+                        f"chunked verify path)")
         # Optional text codec: requests may then carry "text"
         # (list of strings) instead of "prompts"; responses gain
         # "completions" with the decoded generated region.
@@ -441,6 +479,7 @@ class GenerationServer(_BaseServer):
         self._seed = 0
         self._decode_calls = 0
         self._decode_rows = 0
+        self._spec_calls = 0
         max_prompt = model.max_seq_len - max_new_tokens
         if max_prompt < 1:
             raise ValueError(
@@ -571,6 +610,25 @@ class GenerationServer(_BaseServer):
             seed = self._seed
             self._decode_calls += 1
             self._decode_rows += n
+        if (self._spec_k and pad_temp == 0.0 and not top_k
+                and not want_lp
+                and (rep_pens == 1.0).all() and (min_ps == 0.0).all()
+                and (top_ps == 1.0).all()
+                and bucket + self._max_new + self._spec_k
+                <= min(self._model.max_seq_len,
+                       self._draft_model.max_seq_len)):
+            # One stable spec program per bucket: prompt_len and
+            # eos_id ride as vectors regardless of batch composition
+            # (speculative_decode never downgrades variants on
+            # values). Output is identical to the decode() below.
+            out = self._speculative(
+                self._model, self._params, self._draft_model,
+                self._draft_params, jnp.asarray(padded),
+                self._max_new, k=self._spec_k, prompt_len=plens,
+                eos_id=eos_ids)
+            with self._stats_lock:
+                self._spec_calls += 1
+            return np.asarray(out)[:n]
         # fast_prefill=False keeps the per-bucket program set fixed
         # (warm=True precompiles exactly these programs; the
         # auto-selected one-shot-prefill variant would flip in and
@@ -619,6 +677,7 @@ class GenerationServer(_BaseServer):
         return {
             "decode_calls": calls,
             "decode_rows": self._decode_rows,
+            "speculative_calls": self._spec_calls,
             "avg_batch_occupancy": (
                 round(self._decode_rows / calls, 3) if calls else None),
         }

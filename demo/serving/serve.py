@@ -166,6 +166,23 @@ def main(argv=None):
                         "axis (all visible chips of the replica's "
                         "subslice); XLA inserts the collectives. "
                         "1 = single-chip replica")
+    p.add_argument("--speculative-k", type=int, default=0,
+                   help="N>0: plain-greedy requests decode "
+                        "speculatively — a draft model proposes N-1 "
+                        "tokens per verify round (identical output, "
+                        "fewer weight streams); needs headroom "
+                        "(bucket + max_new_tokens + N <= "
+                        "max_seq_len), transformer model only")
+    p.add_argument("--draft-layers", type=int, default=2)
+    p.add_argument("--draft-embed-dim", type=int, default=128)
+    p.add_argument("--draft-num-heads", type=int, default=0,
+                   help="0 = the target's --num-heads (must divide "
+                        "--draft-embed-dim; rope needs embed % "
+                        "(2*heads) == 0)")
+    p.add_argument("--draft-model-dir", default="",
+                   help="orbax checkpoint for the draft; empty uses "
+                        "a random draft init (load-testing only — "
+                        "random drafts never agree with the target)")
     args = p.parse_args(argv)
     if args.compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir",
@@ -244,12 +261,40 @@ def main(argv=None):
                 raise SystemExit(
                     "--warm-filters must be a JSON list of dicts, "
                     f"got: {args.warm_filters!r}")
+        draft_model = draft_params = None
+        if args.speculative_k:
+            if args.model != "transformer":
+                raise SystemExit(
+                    "--speculative-k supports --model transformer "
+                    "only")
+            draft_heads = args.draft_num_heads or args.num_heads
+            if args.draft_embed_dim % draft_heads:
+                raise SystemExit(
+                    f"--draft-embed-dim {args.draft_embed_dim} not "
+                    f"divisible by draft heads {draft_heads}; set "
+                    f"--draft-num-heads")
+            draft_model = TransformerLM(
+                vocab_size=args.vocab_size,
+                embed_dim=args.draft_embed_dim,
+                num_layers=args.draft_layers,
+                num_heads=draft_heads,
+                pos_embedding=args.pos_embedding,
+                max_seq_len=args.max_seq_len)
+            draft_vars = {"params": draft_model.init(
+                jax.random.PRNGKey(1),
+                jnp.zeros((1, 8), jnp.int32))["params"]}
+            if args.draft_model_dir:
+                draft_vars = load_checkpoint_variables(
+                    args.draft_model_dir, draft_vars)
+            draft_params = draft_vars["params"]
         server = GenerationServer(
             name, model, variables["params"], port=args.port,
             max_new_tokens=args.max_new_tokens,
             max_batch=args.max_batch, tokenizer=tokenizer,
             warm=args.warm, warm_filters=warm_filters,
-            warm_async=True)
+            warm_async=True, draft_model=draft_model,
+            draft_params=draft_params,
+            speculative_k=args.speculative_k)
     else:
         model = resnet(depth=args.depth)
         variables = model.init(

@@ -725,3 +725,99 @@ def test_generation_server_batchers_share_admission():
         # ThreadingHTTPServer.shutdown() (regression: it used to wait
         # forever for a serve loop that was never running).
         srv.stop()
+
+
+def test_generate_speculative_greedy_path():
+    """With a draft configured, plain-greedy requests route through
+    speculative decoding and return EXACTLY what the plain path
+    returns; non-default options (repetition penalty, sampling,
+    logprobs) fall back to the ordinary decode program."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    draft = TransformerLM(vocab_size=64, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=48,
+                          dtype=jnp.float32)
+    dparams = draft.init(jax.random.PRNGKey(2),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def make(**kw):
+        return GenerationServer("lm", model, params, port=0,
+                                max_new_tokens=8, max_batch=2,
+                                buckets=[8], **kw)
+
+    plain = make()
+    spec = make(draft_model=draft, draft_params=dparams,
+                speculative_k=4)
+    plain.start()
+    spec.start()
+    try:
+        for payload in (
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 6},
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 6,
+                 "eos_id": 7},
+                {"prompts": [[4, 5, 6, 7, 8]], "max_new_tokens": 8},
+        ):
+            a = post(plain, "/v1/models/lm:generate", payload)
+            b = post(spec, "/v1/models/lm:generate", payload)
+            assert a["sequences"] == b["sequences"], payload
+        import urllib.request as _u
+        with _u.urlopen(f"http://localhost:{spec.port}/stats",
+                        timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["speculative_calls"] >= 3, stats
+        # Penalized greedy and sampling fall back to plain decode.
+        for payload in (
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                 "repetition_penalty": 1.3},
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                 "temperature": 0.9},
+        ):
+            out = post(spec, "/v1/models/lm:generate", payload)
+            assert len(out["sequences"][0]) == 7
+        with _u.urlopen(f"http://localhost:{spec.port}/stats",
+                        timeout=10) as resp:
+            stats2 = json.loads(resp.read())
+        assert stats2["speculative_calls"] == stats["speculative_calls"]
+    finally:
+        plain.stop()
+        spec.stop()
+
+
+def test_generate_speculative_headroom_fallback():
+    """Buckets without max_seq_len headroom for the verify slack use
+    the plain decode path instead of failing."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    # max_seq_len 16 = bucket 8 + max_new 8: no room for k slack.
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=16,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=2, buckets=[8],
+                           draft_model=model, draft_params=params,
+                           speculative_k=4)
+    srv.start()
+    try:
+        out = post(srv, "/v1/models/lm:generate",
+                   {"prompts": [[1, 2, 3]], "max_new_tokens": 4})
+        assert len(out["sequences"][0]) == 7
+        import urllib.request as _u
+        with _u.urlopen(f"http://localhost:{srv.port}/stats",
+                        timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["speculative_calls"] == 0, stats
+    finally:
+        srv.stop()
