@@ -117,15 +117,20 @@ def _backend_probe():
     never fires, so a full child attempt would only die at the
     supervisor's attempt timeout (~43 min). Probing in a short-lived
     subprocess first turns a dead backend into a fast attempt failure.
+
+    Returns the probe's exit code (0 = chip answered, 2 = CPU
+    fallback refused) or None on a hang — callers should report the
+    distinction: "hung" and "up but fallen back to CPU" need opposite
+    operator responses.
     """
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--probe"],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             timeout=PROBE_TIMEOUT_S)
-        return proc.returncode == 0
+        return proc.returncode
     except subprocess.TimeoutExpired:
-        return False
+        return None
 
 
 def probe():
@@ -139,6 +144,13 @@ def probe():
     from container_engine_accelerators_tpu.utils.sync import wall_sync
 
     devices = jax.devices()
+    # Same CPU-fallback guard as the supervisor (_cpu_fallback): with
+    # jax_platforms="axon,cpu" a down tunnel falls back to host CPU and
+    # the matmul still succeeds — that must read as "backend down", or
+    # the watchdog would launch the multi-hour suite against nothing.
+    if plat != "cpu" and _is_cpu_devices([str(d) for d in devices]):
+        _log(f"probe refused: CPU fallback {[str(d) for d in devices]}")
+        return 2
     x = jnp.ones((256, 256), jnp.bfloat16)
     val = wall_sync(x @ x)
     _log(f"probe ok: {[str(d) for d in devices]} (got {val})")
@@ -164,9 +176,13 @@ def supervise():
     phase = "unknown"
     artifact_path, step_log = _artifact_names()
     for attempt in range(1, ATTEMPTS + 1):
-        if not _backend_probe():
-            errors.append(f"attempt {attempt}: backend probe "
-                          f"failed/hung (limit {PROBE_TIMEOUT_S:.0f}s)")
+        probe_rc = _backend_probe()
+        if probe_rc != 0:
+            detail = {
+                None: f"hung (limit {PROBE_TIMEOUT_S:.0f}s)",
+                2: "refused: tunnel down, jax fell back to host CPU",
+            }.get(probe_rc, f"failed (rc={probe_rc})")
+            errors.append(f"attempt {attempt}: backend probe {detail}")
             _log(errors[-1])
             phase = "backend-probe"
             if attempt < ATTEMPTS:
@@ -236,6 +252,12 @@ def supervise():
     return 1
 
 
+def _is_cpu_devices(device_strs):
+    """True when a device list means "host CPU, not the chip" — an
+    empty list is treated as fallback too (nothing measured)."""
+    return not device_strs or any("cpu" in d.lower() for d in device_strs)
+
+
 def _cpu_fallback(line):
     """True when a "successful" child actually measured host CPU.
 
@@ -248,7 +270,7 @@ def _cpu_fallback(line):
     if os.environ.get("BENCH_PLATFORMS") == "cpu":
         return False
     devices = (line.get("provenance") or {}).get("devices") or []
-    return not devices or any("cpu" in d.lower() for d in devices)
+    return _is_cpu_devices(devices)
 
 
 def _cleanup_tmp(step_log):

@@ -180,6 +180,11 @@ class _BaseServer:
         # only receives traffic once its programs are built.
         self._ready = threading.Event()
         self._ready.set()
+        # Captured once, outside the stats lock: jax caches the device
+        # list at backend init anyway, and calling jax.devices() under
+        # _stats_lock could block every request thread on a dead
+        # tunnel the first time /stats is hit.
+        self._platform = jax.devices()[0].platform
         self._requests = 0
         self._shed = 0
         self._latencies = []
@@ -277,6 +282,11 @@ class _BaseServer:
             out = {
                 "requests": self._requests,
                 "shed": self._shed,
+                # What this replica computes on (captured at init) —
+                # lets a load harness reject numbers measured on a
+                # host-CPU fallback (the axon tunnel's known failure
+                # mode) instead of trusting that jax kept the chip.
+                "platform": self._platform,
                 "p50_ms": round(lat[n // 2] * 1000, 3) if n else None,
                 "p99_ms": round(lat[int(n * 0.99)] * 1000, 3)
                 if n else None,

@@ -28,7 +28,7 @@ TMP="$(mktemp)"
 
 for SEQ in 2048 4096 8192; do
   echo "[attn-bench] seq_len=${SEQ}" >&2
-  timeout 900 python tools/bench_attention.py \
+  timeout -k 30 900 python tools/bench_attention.py \
     --seq-len "${SEQ}" --check-numerics >> "${TMP}" \
     || echo "{\"seq_len\": ${SEQ}, \"error\": \"run failed/timeout\"}" \
        >> "${TMP}"
@@ -41,7 +41,7 @@ done
 # exactly the lengths whose TFLOP/s claims need an error bound.
 for SEQ in 16384 32768; do
   echo "[attn-bench] seq_len=${SEQ} (streaming)" >&2
-  timeout 1500 python tools/bench_attention.py \
+  timeout -k 30 1500 python tools/bench_attention.py \
     --seq-len "${SEQ}" --batch 1 --check-numerics >> "${TMP}" \
     || echo "{\"seq_len\": ${SEQ}, \"error\": \"run failed/timeout\"}" \
        >> "${TMP}"
@@ -50,7 +50,7 @@ done
 # Tile-size tuning sweep at the middle sequence length.
 for BLK in 256 512; do
   echo "[attn-bench] seq_len=4096 block=${BLK}" >&2
-  timeout 900 python tools/bench_attention.py \
+  timeout -k 30 900 python tools/bench_attention.py \
     --seq-len 4096 --block "${BLK}" >> "${TMP}" \
     || echo "{\"seq_len\": 4096, \"block\": ${BLK}, \
 \"error\": \"run failed/timeout\"}" >> "${TMP}"

@@ -26,6 +26,26 @@ set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-.}"
 
+# Single-flight: the suite owns one chip, fixed ports (serve.py :8519)
+# and fixed artifact paths, so two concurrent runs (watchdog + manual,
+# or two watchdogs) corrupt each other. rc 99 = another run is active.
+exec 9> tools/suite.lock
+if ! flock -n 9; then
+  echo "[suite] another suite run holds tools/suite.lock; aborting" >&2
+  exit 99
+fi
+
+# Section-failure accounting: the script must exit non-zero when any
+# section fails so the watchdog (tools/tpu_watchdog.sh) retries at the
+# next window instead of waiting out its cooldown on a cut-short pass.
+FAILS=0
+sec_rc() {  # $1 = rc, $2 = section name
+  if [ "$1" -ne 0 ]; then
+    FAILS=$(( FAILS + 1 ))
+    echo "[suite] section FAILED (rc=$1): $2" >&2
+  fi
+}
+
 # bench.py itself refreshes TPU_BENCH_{DEFAULT,B256}.json (with
 # provenance + step-log pointer) on a successful on-chip run, so the
 # suite must NOT redirect stdout onto those paths — that would race
@@ -34,56 +54,127 @@ OUT="${1:-.}"
 # backoff = 5710s; the outer timeout must exceed that or it kills the
 # supervisor mid-measure and no JSON line is emitted.
 echo "[suite] headline bench (default batch)" >&2
-BENCH_ATTEMPTS=2 BENCH_BACKOFF_S=30 timeout 6000 python bench.py \
-  > "${OUT}/tpu_bench_default.out" 2>> "${OUT}/tpu_suite.log"
+BENCH_ATTEMPTS=2 BENCH_BACKOFF_S=30 timeout -k 30 6000 python bench.py \
+  > "${OUT}/tpu_bench_default.out" 2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "headline bench (default batch)"
 cat "${OUT}/tpu_bench_default.out" >&2
 
 echo "[suite] headline bench (batch 256/chip)" >&2
-BENCH_ATTEMPTS=1 BENCH_BATCH_PER_CHIP=256 timeout 3600 python bench.py \
-  > "${OUT}/tpu_bench_b256.out" 2>> "${OUT}/tpu_suite.log"
+BENCH_ATTEMPTS=1 BENCH_BATCH_PER_CHIP=256 timeout -k 30 3600 \
+  python bench.py \
+  > "${OUT}/tpu_bench_b256.out" 2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "headline bench (batch 256)"
 cat "${OUT}/tpu_bench_b256.out" >&2
 
 echo "[suite] Allocate env contract on the real chip" >&2
-timeout 900 python tools/allocate_env_harness.py \
-  2>> "${OUT}/tpu_suite.log" || echo "[suite] allocate-env harness" \
-  "failed (see log)" >&2
+timeout -k 30 900 python tools/allocate_env_harness.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "allocate-env harness"
 [ -f ALLOCATE_ENV_TPU.json ] && cat ALLOCATE_ENV_TPU.json >&2
 
 echo "[suite] attention sweep" >&2
-timeout 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json" \
-  2>> "${OUT}/tpu_suite.log"
+# Tracked artifact: write a sidecar and promote only on success, so a
+# timed-out sweep can't truncate the committed on-chip record (same
+# rule bench.py applies to TPU_BENCH_*.json).
+timeout -k 30 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json.tmp" \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+ATTN_RC=$?
+# run_attn_bench.sh records a failed/timed-out config as a clean
+# {"error": ...} row and still exits 0 — refuse to promote those over
+# the committed record (expected in-row fields like numerics_error on
+# dense-can't-compile lengths are fine; a bare "error" row means the
+# run died).
+if [ "${ATTN_RC}" = 0 ]; then
+  python - "${OUT}/ATTN_BENCH.json.tmp" <<'PYEOF' || ATTN_RC=1
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d.get("rows"), "no rows"
+# Per-schedule rows record expected failures in-row (e.g. dense OOMs
+# at long seq_len, with a "schedule" key); only the sweep's injected
+# whole-config placeholder (no "schedule") means the run itself died.
+bad = [r for r in d["rows"] if "error" in r and "schedule" not in r]
+assert not bad, bad
+# A mid-suite tunnel drop makes jax fall back to host CPU (the
+# sitecustomize pins jax_platforms="axon,cpu") and the sweep "works" —
+# those numbers must never replace the on-chip record.  Successful
+# rows always carry "platform"; require at least one and all-tpu.
+timed = [r for r in d["rows"] if "platform" in r]
+assert timed, "no successfully timed rows"
+bad = [r for r in timed if r["platform"] != "tpu"]
+assert not bad, bad
+PYEOF
+fi
+sec_rc "${ATTN_RC}" "attention sweep"
+[ "${ATTN_RC}" = 0 ] && \
+  mv "${OUT}/ATTN_BENCH.json.tmp" "${OUT}/ATTN_BENCH.json"
 
 echo "[suite] decode bench (bf16 + int8 cache + GQA + window)" >&2
+DECODE_RC=0
 {
-  timeout 1800 python tools/bench_decode.py --batch 1 8 \
-    --prompt-len 128 --new-tokens 128
-  timeout 1800 python tools/bench_decode.py --batch 1 8 \
-    --prompt-len 128 --new-tokens 128 --kv-cache-dtype int8
-  timeout 1800 python tools/bench_decode.py --batch 8 \
+  timeout -k 30 1800 python tools/bench_decode.py --batch 1 8 \
+    --prompt-len 128 --new-tokens 128 || DECODE_RC=1
+  timeout -k 30 1800 python tools/bench_decode.py --batch 1 8 \
+    --prompt-len 128 --new-tokens 128 --kv-cache-dtype int8 || DECODE_RC=1
+  timeout -k 30 1800 python tools/bench_decode.py --batch 8 \
     --prompt-len 128 --new-tokens 128 --kv-cache-dtype int8 \
-    --num-kv-heads 2 --pos-embedding rope
-  timeout 1800 python tools/bench_decode.py --batch 8 \
-    --prompt-len 128 --new-tokens 128 --attention-window 64
-  timeout 1800 python tools/bench_decode.py --batch 1 8 \
-    --prompt-len 128 --new-tokens 128 --quantize-weights int8
+    --num-kv-heads 2 --pos-embedding rope || DECODE_RC=1
+  timeout -k 30 1800 python tools/bench_decode.py --batch 8 \
+    --prompt-len 128 --new-tokens 128 --attention-window 64 || DECODE_RC=1
+  timeout -k 30 1800 python tools/bench_decode.py --batch 1 8 \
+    --prompt-len 128 --new-tokens 128 --quantize-weights int8 \
+    || DECODE_RC=1
   # Speculative decoding: self-draft = full-acceptance upper bound,
   # small-draft = all-rejected floor; real drafts land in between.
-  timeout 1800 python tools/bench_decode.py --batch 1 \
-    --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft self
-  timeout 1800 python tools/bench_decode.py --batch 1 \
-    --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft small
-} > "${OUT}/DECODE_BENCH.json" 2>> "${OUT}/tpu_suite.log"
-cat "${OUT}/DECODE_BENCH.json" >&2
+  timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
+    --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft self \
+    || DECODE_RC=1
+  timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
+    --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft small \
+    || DECODE_RC=1
+} > "${OUT}/DECODE_BENCH.json.tmp" 2>> "${OUT}/tpu_suite.log" 9>&-
+# Exit codes don't catch the CPU-fallback mode (a dropped tunnel lets
+# every run succeed on host CPU) — check the platform each row
+# actually measured on before promoting.
+if [ "${DECODE_RC}" = 0 ]; then
+  python - "${OUT}/DECODE_BENCH.json.tmp" <<'PYEOF' || DECODE_RC=1
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert rows, "no rows"
+bad = [r for r in rows if r.get("platform") != "tpu"]
+assert not bad, bad
+PYEOF
+fi
+sec_rc "${DECODE_RC}" "decode bench"
+# Promote over the tracked artifact only when every run succeeded — a
+# killed run leaves partial rows that must not replace the committed
+# record (the .tmp stays behind, gitignored, for inspection).
+if [ "${DECODE_RC}" = 0 ]; then
+  mv "${OUT}/DECODE_BENCH.json.tmp" "${OUT}/DECODE_BENCH.json"
+  cat "${OUT}/DECODE_BENCH.json" >&2
+else
+  cat "${OUT}/DECODE_BENCH.json.tmp" >&2
+fi
 
 # --warm + /healthz gating: "cold" below measures a replica that just
 # became Ready (the HPA join path), not a replica still compiling —
 # with the readiness gate no request ever pays a compile.
 echo "[suite] serving bench (LM generate, cold + warm)" >&2
+# 9>&-: the backgrounded server must not inherit the suite lock fd —
+# a hung serve.py outliving this run would otherwise hold the flock
+# and wedge every future suite at rc 99.
 python demo/serving/serve.py --model transformer --port 8519 \
   --max-seq-len 256 --max-new-tokens 32 --warm \
-  2>> "${OUT}/tpu_suite.log" &
+  2>> "${OUT}/tpu_suite.log" 9>&- &
 SERVE_PID=$!
-trap 'kill "${SERVE_PID}" 2>/dev/null' EXIT
+stop_server() {  # TERM, grace, then KILL — a server hung in tunnel
+  kill "${SERVE_PID}" 2>/dev/null  # I/O must not keep port 8519
+  for i in 1 2 3 4 5 6 7 8 9 10; do
+    kill -0 "${SERVE_PID}" 2>/dev/null || return 0
+    sleep 1
+  done
+  kill -9 "${SERVE_PID}" 2>/dev/null
+}
+trap stop_server EXIT
 READY=0
 for i in $(seq 1 120); do
   code="$(curl -s -m 2 -o /dev/null -w '%{http_code}' \
@@ -94,7 +185,7 @@ for i in $(seq 1 120); do
 done
 serving_run() {  # $1 = num requests; emits one JSON object, always
   local row
-  row="$(timeout 1200 python demo/serving/load_generator.py \
+  row="$(timeout -k 30 1200 python demo/serving/load_generator.py \
     --mode generate --port 8519 --model-name transformer \
     --max-prompt-len 48 --max-new-tokens 32 -n "$1" --parallelism 8 \
     2>/dev/null | tail -1)"
@@ -104,17 +195,53 @@ serving_run() {  # $1 = num requests; emits one JSON object, always
   esac
 }
 if [ "${READY}" = 1 ]; then
-  {
-    echo -n '{"cold": '; serving_run 300
-    echo -n ', "warm": '; serving_run 600
-    echo '}'
-  } > "${OUT}/SERVING_BENCH_RAW.json"
+  # Same CPU-fallback defense as every other section: the server
+  # reports what it computes on via /stats; refuse host-CPU numbers.
+  SRV_PLAT=""
+  for i in 1 2 3; do  # retried: one dropped request must not void a
+    SRV_PLAT="$(curl -s -m 5 localhost:8519/stats \
+      | python -c 'import json,sys; print((json.load(sys.stdin) or {}).get("platform"))' \
+      2>/dev/null)"   # healthy window
+    [ "${SRV_PLAT}" = "tpu" ] && break
+    sleep 2
+  done
+  if [ "${SRV_PLAT}" != "tpu" ]; then
+    # Don't spend ~40 min load-testing numbers already known rejected.
+    sec_rc 1 "serving bench (server platform='${SRV_PLAT}', want tpu)"
+    echo "{\"error\": \"server platform '${SRV_PLAT}', want tpu\"}" \
+      > "${OUT}/SERVING_BENCH_RAW.json"
+  else
+    {
+      echo -n '{"cold": '; serving_run 300
+      echo -n ', "warm": '; serving_run 600
+      echo '}'
+    } > "${OUT}/SERVING_BENCH_RAW.json"
+    # A summary with requests=0 or mostly-failed requests is still a
+    # '{'-prefixed row — validate the fields, don't grep for "error".
+    python - "${OUT}/SERVING_BENCH_RAW.json" <<'PYEOF' || \
+      sec_rc 1 "serving bench (bad summary rows)"
+import json, sys
+d = json.load(open(sys.argv[1]))
+for k in ("cold", "warm"):
+    r = d.get(k) or {}
+    assert not r.get("error"), (k, r)
+    n, e = r.get("requests", 0), r.get("errors", 0)
+    assert n > 0 and e * 2 < n, (k, r)
+PYEOF
+  fi
 else
   echo '{"error": "server never became ready"}' \
     > "${OUT}/SERVING_BENCH_RAW.json"
+  sec_rc 1 "serving bench (server never ready)"
 fi
-kill "${SERVE_PID}" 2>/dev/null
+stop_server
 trap - EXIT
 cat "${OUT}/SERVING_BENCH_RAW.json" >&2
 
-echo "[suite] done" >&2
+# Shared run record: any suite invocation (watchdog-launched or
+# manual) stamps its outcome here, so every watchdog instance sees
+# the true last run and applies its cooldown to it.
+echo "${FAILS} $(date +%s)" > tools/suite.last
+
+echo "[suite] done (${FAILS} section(s) failed)" >&2
+exit "${FAILS}"
