@@ -292,20 +292,41 @@ def _refresh_artifact(line, artifact_path, step_log):
     if not artifact_path or "provenance" not in line:
         return
     row = dict(line)
-    rel_log = os.path.relpath(step_log, os.path.dirname(artifact_path))
+    # Version the promoted log per attempt: whatever order the two
+    # os.replace()s run in, a failure between them could otherwise
+    # leave the surviving artifact pointing at the OTHER attempt's
+    # log (ADVICE r3). With a unique log name per attempt, the
+    # committed artifact always references exactly the log written
+    # with it; a dangling versioned log from a failed promotion is
+    # inert.
+    base, ext = os.path.splitext(step_log)
+    versioned = f"{base}.{int(time.time())}{ext}"
+    rel_log = os.path.relpath(versioned, os.path.dirname(artifact_path))
     row["provenance"] = dict(row["provenance"], step_log=rel_log)
     try:
-        # Stage the artifact fully before promoting either file, and
-        # promote the log first only once the artifact bytes exist —
-        # so a partial failure can never leave the committed artifact
-        # pointing at a mismatched step log.
+        old_log = None
+        try:
+            with open(artifact_path) as f:
+                old_log = (json.load(f).get("provenance") or {}
+                           ).get("step_log")
+        except (OSError, ValueError):
+            pass
         with open(artifact_path + ".tmp", "w") as f:
             json.dump(row, f, indent=1)
             f.write("\n")
-        os.replace(step_log + ".tmp", step_log)
+        os.replace(step_log + ".tmp", versioned)
         os.replace(artifact_path + ".tmp", artifact_path)
         _log(f"refreshed {os.path.basename(artifact_path)} "
              f"(step log: {rel_log})")
+        # Only after the new pair is fully promoted: drop the log the
+        # previous artifact referenced, so logs/ holds one log per
+        # committed artifact, not an unbounded history.
+        if old_log and old_log != rel_log:
+            try:
+                os.unlink(os.path.join(
+                    os.path.dirname(artifact_path), old_log))
+            except OSError:
+                pass
     except OSError as e:
         _log(f"artifact refresh failed: {e}")
 

@@ -194,7 +194,7 @@ def decode_gauges_typed(response_bytes):
     except Exception:
         return None
     out = {}
-    for idx, metric in enumerate(resp.metric.metrics):
+    for metric in resp.metric.metrics:
         which = metric.gauge.WhichOneof("value")
         if which == "as_double":
             value = metric.gauge.as_double
@@ -202,11 +202,14 @@ def decode_gauges_typed(response_bytes):
             value = float(metric.gauge.as_int)
         else:
             continue
-        if metric.attribute.value.WhichOneof("attr") == "int_attr":
-            device = metric.attribute.value.int_attr
-        else:
-            device = idx
-        out[int(device)] = float(value)
+        if metric.attribute.value.WhichOneof("attr") != "int_attr":
+            # A runtime revision keying devices by something other
+            # than int ids (e.g. string chip paths) is an UNKNOWN
+            # shape: synthesizing 0..N-1 ids here would silently
+            # mis-attribute gauges to the wrong chips (ADVICE r3).
+            # Fall through to the heuristic walker instead.
+            return None
+        out[int(metric.attribute.value.int_attr)] = float(value)
     return out or None
 
 

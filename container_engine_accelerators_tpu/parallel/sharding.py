@@ -71,7 +71,15 @@ def _param_spec(path, value, model_parallel, expert_parallel, fsdp=0):
     if expert_parallel and in_expert_module and len(shape) >= 3:
         if (keys[-1] in _EXPERT_PARAM_NAMES
                 and shape[0] % expert_parallel == 0):
+            # Early return: expert_parallel_moe's contract is
+            # P(expert, None, ...) — per-expert kernels replicated
+            # within an expert shard. Letting the model-parallel or
+            # FSDP branches below additionally shard the feature dims
+            # would hand that function a layout it was never tested
+            # with (ADVICE r3); revisit deliberately if an
+            # expert×model mesh is ever built.
             spec[0] = EXPERT_AXIS
+            return P(*spec)
         else:
             log.warning(
                 "param %s (shape %s) sits in an expert module but "

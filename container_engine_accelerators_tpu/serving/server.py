@@ -556,6 +556,19 @@ class GenerationServer(_BaseServer):
             zeros = np.zeros((b,), np.int32)
             # pad_temp selects greedy vs sampling mode.
             self._run([(zeros, 0.0, b, 1.0, -1, 1.0, 0.0)], 0.0)
+            if self._spec_k:
+                # The default-greedy call above rode the speculative
+                # program; greedy traffic with a repetition penalty
+                # still selects the PLAIN decode program (ADVICE r3:
+                # without this it paid a first-request compile after
+                # /healthz already reported ready). rep_pen 1.1, not
+                # 1.0: decode() specializes on use_rp = any(rp != 1)
+                # as a STATIC argument, and penalty traffic runs the
+                # use_rp=True program — warming with all-1.0 would
+                # build the wrong variant (and, on buckets without
+                # speculative headroom, just repeat the call above).
+                self._run([(zeros, 0.0, b, 1.0, -1, 1.1, 0.0)], 0.0,
+                          force_plain=True)
             self._run([(zeros, 1.0, b, 1.0, -1, 1.0, 0.0)], 1.0)
             for spec in self._warm_filters:
                 temp = float(spec.get("temperature", 1.0))
@@ -593,7 +606,8 @@ class GenerationServer(_BaseServer):
                 "max_new_tokens": self._max_new,
                 "max_batch": self._max_batch}
 
-    def _run(self, instances, pad_temp, top_k=0, want_lp=False):
+    def _run(self, instances, pad_temp, top_k=0, want_lp=False,
+             force_plain=False):
         """Decode a micro-batch of (row, temperature, prompt_len,
         top_p, eos_id, rep_penalty) instances through the
         (max_batch, bucket) padded program."""
@@ -620,8 +634,8 @@ class GenerationServer(_BaseServer):
             seed = self._seed
             self._decode_calls += 1
             self._decode_rows += n
-        if (self._spec_k and pad_temp == 0.0 and not top_k
-                and not want_lp
+        if (self._spec_k and not force_plain and pad_temp == 0.0
+                and not top_k and not want_lp
                 and (rep_pens == 1.0).all() and (min_ps == 0.0).all()
                 and (top_ps == 1.0).all()
                 and bucket + self._max_new + self._spec_k
@@ -663,8 +677,15 @@ class GenerationServer(_BaseServer):
             return list(zip(np.asarray(seq)[:n], np.asarray(lp)[:n]))
         return np.asarray(out)[:n]
 
-    def _batcher_for(self, bucket, sampling, top_k, want_lp=False):
-        key = (bucket, sampling, top_k, want_lp)
+    def _batcher_for(self, bucket, sampling, top_k, want_lp=False,
+                     plain=True):
+        # ``plain`` keys default-greedy rows apart from greedy rows
+        # carrying a repetition penalty (the only non-default knob
+        # validation allows at temperature 0), so a penalty row can
+        # never land in a default-greedy micro-batch and flip it off
+        # the speculative program — the program choice is decided by
+        # the batcher key, not by batch composition (ADVICE r3).
+        key = (bucket, sampling, top_k, want_lp, plain)
         with self._batchers_lock:
             if self._stopping:
                 return None
@@ -674,7 +695,8 @@ class GenerationServer(_BaseServer):
                     functools.partial(
                         self._run,
                         pad_temp=1.0 if sampling else 0.0,
-                        top_k=top_k, want_lp=want_lp),
+                        top_k=top_k, want_lp=want_lp,
+                        force_plain=not plain),
                     self._max_batch, self._max_wait_ms,
                     admission=self._admission)
                 self._batchers[key] = batcher
@@ -788,7 +810,9 @@ class GenerationServer(_BaseServer):
         padded = np.zeros((arr.shape[0], bucket), np.int32)
         padded[:, :p_len] = arr
         batcher = self._batcher_for(bucket, temperature > 0.0, top_k,
-                                    want_lp)
+                                    want_lp,
+                                    plain=(temperature <= 0.0
+                                           and rep_pen == 1.0))
         if batcher is None:
             return 503, {"error": "server is shutting down"}
         pending = batcher.submit_many(

@@ -100,6 +100,37 @@ def test_param_shardings_shard_wide_kernels():
     assert shardings["small"]["kernel"].spec == jax.sharding.PartitionSpec()
 
 
+def test_param_shardings_expert_kernels_pin_layout():
+    """On a hypothetical expert×model(×fsdp) mesh, matched expert
+    kernels keep exactly P(expert, None, None): the model-parallel
+    and FSDP branches must NOT add feature-dim axes, because
+    expert_parallel_moe was only ever validated against per-expert
+    kernels that are whole within an expert shard (ADVICE r3)."""
+    from container_engine_accelerators_tpu.parallel.expert import (
+        EXPERT_AXIS,
+    )
+
+    devices = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = jax.sharding.Mesh(
+        devices, (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS))
+    params = {
+        "moe": {"w_in": jnp.zeros((4, 64, 1024)),
+                "w_out": jnp.zeros((4, 1024, 64))},
+        "dense": {"kernel": jnp.zeros((256, 1024))},
+    }
+    for fsdp in (False, True):
+        shardings = param_shardings(mesh, params, fsdp=fsdp)
+        assert shardings["moe"]["w_in"].spec == \
+            jax.sharding.PartitionSpec(EXPERT_AXIS, None, None)
+        assert shardings["moe"]["w_out"].spec == \
+            jax.sharding.PartitionSpec(EXPERT_AXIS, None, None)
+    # Non-expert params on the same mesh still pick up model (and
+    # FSDP data) sharding as usual.
+    shardings = param_shardings(mesh, params, fsdp=True)
+    assert shardings["dense"]["kernel"].spec == \
+        jax.sharding.PartitionSpec(None, MODEL_AXIS)
+
+
 def _train_mlp(mesh, steps=30):
     model = MnistMLP(hidden=1024, dtype=jnp.float32)
     apply_fn = mlp_mod.make_apply_fn(model)

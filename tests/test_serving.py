@@ -791,6 +791,55 @@ def test_generate_speculative_greedy_path():
         spec.stop()
 
 
+def test_generate_speculative_warm_compiles_plain_greedy():
+    """ADVICE r3 (medium): with speculative_k set, warm-up must also
+    build the PLAIN greedy decode program per bucket — greedy traffic
+    with a repetition penalty (allowed by validation) selects it, and
+    without the extra warm call it paid a first-request compile after
+    /healthz already reported ready. Observable composition: per
+    bucket, warm-up now runs spec-greedy + plain-greedy + sampling =
+    3 decode calls, exactly one of them speculative."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    draft = TransformerLM(vocab_size=64, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=48,
+                          dtype=jnp.float32)
+    dparams = draft.init(jax.random.PRNGKey(2),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=2,
+                           buckets=[8, 16], warm=True,
+                           draft_model=draft, draft_params=dparams,
+                           speculative_k=4)
+    srv.start()
+    try:
+        import urllib.request as _u
+        with _u.urlopen(f"http://localhost:{srv.port}/stats",
+                        timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["decode_calls"] == 6, stats   # 3 per bucket
+        assert stats["speculative_calls"] == 2, stats  # 1 per bucket
+        # The plain program warm-up targeted: greedy + penalty.
+        out = post(srv, "/v1/models/lm:generate",
+                   {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                    "repetition_penalty": 1.3})
+        assert len(out["sequences"][0]) == 7
+        with _u.urlopen(f"http://localhost:{srv.port}/stats",
+                        timeout=10) as resp:
+            stats2 = json.loads(resp.read())
+        assert stats2["speculative_calls"] == 2, stats2
+    finally:
+        srv.stop()
+
+
 def test_generate_speculative_headroom_fallback():
     """Buckets without max_seq_len headroom for the verify slack use
     the plain decode path instead of failing."""

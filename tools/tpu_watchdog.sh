@@ -56,6 +56,9 @@ say() { echo "[watchdog $(date -u +%FT%TZ)] $*" >> "${LOG}"; }
 suites_done=0
 fail_streak=0
 [ -f "${LOG}.fail_streak" ] && fail_streak="$(cat "${LOG}.fail_streak")"
+# A truncated state file (crash mid-write) must degrade to defaults,
+# not wedge the arithmetic below with an empty/garbage operand.
+case "${fail_streak}" in (*[!0-9]*|"") fail_streak=0 ;; esac
 say "start: probe cap ${PROBE_TIMEOUT}s, interval ${INTERVAL}s," \
     "cooldown ${COOLDOWN}s"
 while :; do
@@ -70,6 +73,9 @@ while :; do
     last_epoch=0
     [ -f tools/suite.last ] && \
       read -r last_rc last_epoch < tools/suite.last
+    # Crash-truncated stamp -> defaults (treat as "failed long ago").
+    case "${last_rc}" in (*[!0-9]*|"") last_rc=1 ;; esac
+    case "${last_epoch}" in (*[!0-9]*|"") last_epoch=0 ;; esac
     now="$(date +%s)"
     # Re-run when the applicable cooldown has elapsed: a failed suite
     # retries sooner than a successful one refreshes, but never
