@@ -43,12 +43,23 @@ einsum is already position-correct for multi-token chunks at any
 offset; the clone shares cache variables with the plain decode model,
 so prefill still uses the fast empty-cache path.
 
-Supported alongside speculation: ragged prompts (``prompt_len``) and
-EOS termination (``eos_id``, with an early exit plain decode cannot
-do — once every row finished, remaining positions fill with EOS and
-no further model evaluation runs). Not supported (raise): sampling
-(temperature > 0 — rejection-sampling speculation is a different
-algorithm), sliding-window/ring caches (their prefill chunk write
+Supported alongside speculation: ragged prompts (``prompt_len``), EOS
+termination (``eos_id``, with an early exit plain decode cannot do —
+once every row finished, remaining positions fill with EOS and no
+further model evaluation runs), and **sampling** (``temperature > 0``)
+via rejection-sampling speculation: the draft PROPOSES from its own
+softmax q, the target ACCEPTS proposal x with probability
+min(1, p(x)/q(x)) and on rejection resamples from the residual
+normalize(max(0, p - q)); if every proposal in a round is accepted the
+target samples one bonus token from p directly. Each committed token
+is then distributed EXACTLY per the target's softmax(logits/T) — the
+classic speculative-sampling identity (p = q·min(1, p/q) +
+(1-sum q·min(1, p/q))·residual) — so speculation again changes only
+wall-clock, never the output distribution. Same chunked-verify /
+uniform-min-acceptance / cache-rewind machinery as greedy; the accept
+test just replaces exact token match. Not supported (raise):
+sampling filters (top-k/top-p/min-p) and repetition penalty under
+speculation, sliding-window/ring caches (their prefill chunk write
 assumes offset 0), MoE draft or target. Reference repo has no
 counterpart (its serving demo is TF-Serving images, SURVEY.md
 section 2.3); this is framework-level capability the TPU stack adds.
@@ -80,15 +91,25 @@ def _rewind(cache, position):
 @functools.partial(
     jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
                               "k", "return_stats", "ragged",
-                              "use_eos"))
+                              "use_eos", "sample"))
 def _spec_impl(model, params, draft_model, draft_params, prompt,
                max_new_tokens, k, return_stats, ragged, prompt_len,
-               use_eos, eos_id):
+               use_eos, eos_id, sample, temperature, rng):
     b, p = prompt.shape
     total = p + max_new_tokens + k  # slack for optimistic writes
     # Per-row EOS (-1 = never matches); decode's semantics: a row
     # whose GENERATED text reached EOS keeps emitting it.
     eos_row = jnp.reshape(eos_id, (-1,)).astype(prompt.dtype)
+    # [B, 1] so every probability computation is per-row (the serving
+    # layer batches rows with different client temperatures).
+    temp = jnp.reshape(jnp.asarray(temperature, jnp.float32), (-1, 1))
+
+    def dist(logits):
+        """Target/draft sampling distribution: softmax(logits/T) in
+        f32 — the EXACT quantity the accept ratio and residual are
+        defined over. [..., V] -> [..., V]."""
+        t = temp if logits.ndim == 2 else temp[:, :, None]
+        return jax.nn.softmax(logits.astype(jnp.float32) / t, axis=-1)
 
     target_dec, target_cache = init_cache(model, b, total)
     verify_dec = target_dec.clone(chunk_attends_cache=True)
@@ -108,13 +129,22 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         padded = jnp.pad(prompt, ((0, 0), (0, 1)))
         plen = jnp.reshape(prompt_len, (-1,))
 
+        # rng rides every carry unconditionally (same convention as
+        # decode.py's step) so greedy and sampling share one tuple
+        # layout; the greedy program just never consumes it.
         def prompt_step(carry, t):
-            cache, tok, done = carry
+            cache, tok, done, step_rng = carry
+            step_rng, sub = jax.random.split(step_rng)
             o, u = target_dec.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 train=False, mutable=["cache"])
-            sampled = jnp.argmax(_logits_of(o)[:, 0], axis=-1).astype(
-                tok.dtype)
+            logits = _logits_of(o)[:, 0]
+            if sample:
+                sampled = jax.random.categorical(
+                    sub, logits.astype(jnp.float32) / temp,
+                    axis=-1).astype(tok.dtype)
+            else:
+                sampled = jnp.argmax(logits, axis=-1).astype(tok.dtype)
             forced = jax.lax.dynamic_index_in_dim(
                 padded, t + 1, 1, keepdims=False)
             in_prompt = t + 1 < plen
@@ -124,11 +154,13 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
                 # forcing; prompt-resident EOS never triggers.
                 nxt = jnp.where(done, eos_row, nxt)
                 done = done | (~in_prompt & (nxt == eos_row))
-            return (u["cache"], nxt, done), nxt
+            return (u["cache"], nxt, done, step_rng), nxt
 
-        (target_cache, first, done), walked = jax.lax.scan(
+        rng, walk_rng = jax.random.split(rng)
+        (target_cache, first, done, _), walked = jax.lax.scan(
             prompt_step,
-            (target_cache, prompt[:, 0], jnp.zeros((b,), bool)),
+            (target_cache, prompt[:, 0], jnp.zeros((b,), bool),
+             walk_rng),
             jnp.arange(p, dtype=jnp.int32))
         # Resolved prefix (prompt tokens + target generations inside
         # the padding); the draft prefills it in ONE empty-cache
@@ -150,8 +182,15 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
             {"params": params, "cache": target_cache}, prompt,
             train=False, mutable=["cache"])
         target_cache = upd["cache"]
-        first = jnp.argmax(_logits_of(outs)[:, -1], axis=-1).astype(
-            prompt.dtype)
+        last_logits = _logits_of(outs)[:, -1]
+        if sample:
+            rng, sub = jax.random.split(rng)
+            first = jax.random.categorical(
+                sub, last_logits.astype(jnp.float32) / temp,
+                axis=-1).astype(prompt.dtype)
+        else:
+            first = jnp.argmax(last_logits, axis=-1).astype(
+                prompt.dtype)
         done = ((first == eos_row) if use_eos
                 else jnp.zeros((b,), bool))
         _, dupd = draft_dec.apply(
@@ -169,9 +208,12 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
 
     def body(carry):
         (out, n, last, target_cache, draft_cache, done, rounds,
-         accepted) = carry
+         accepted, loop_rng) = carry
+        (loop_rng, r_draft, r_accept, r_resid,
+         r_bonus) = jax.random.split(loop_rng, 5)
 
-        # Draft: k sequential greedy steps from the last committed
+        # Draft: k sequential steps (greedy argmax, or draws from the
+        # draft's own softmax q when sampling) from the last committed
         # token. Its cache enters at index p+n-1 (the invariant: the
         # index of the newest committed-but-unkeyed token). Proposals
         # carry decode's done-chain (a finished row proposes EOS
@@ -179,16 +221,26 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         # chunk — matches the committed stream token-for-token on
         # accepted prefixes.
         def draft_step(c, _):
-            cache, tok, done_d = c
+            cache, tok, done_d, rng_d = c
+            rng_d, sub = jax.random.split(rng_d)
             o, u = draft_dec.apply(
                 {"params": draft_params, "cache": cache}, tok[:, None],
                 train=False, mutable=["cache"])
-            nxt = jnp.argmax(_logits_of(o)[:, 0], axis=-1).astype(
-                tok.dtype)
+            logits = _logits_of(o)[:, 0]
+            if sample:
+                # Sample straight from the scaled logits (identical
+                # distribution, no exp+log round trip); q itself is
+                # still materialized for the accept test/residual.
+                nxt = jax.random.categorical(
+                    sub, logits.astype(jnp.float32) / temp,
+                    axis=-1).astype(tok.dtype)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
             if use_eos:
                 nxt = jnp.where(done_d, eos_row, nxt)
                 done_d = done_d | (nxt == eos_row)
-            return (u["cache"], nxt, done_d), nxt
+            y = (nxt, dist(logits)) if sample else nxt
+            return (u["cache"], nxt, done_d, rng_d), y
 
         # k steps yield k-1 usable proposals: the k-th step's sampled
         # token is discarded, but the step itself is what writes
@@ -197,8 +249,16 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         # newest accepted token. (This off-by-one is inherent: a
         # draft never consumes, hence never keys, its own final
         # proposal.)
-        (draft_cache, _, _), proposals = jax.lax.scan(
-            draft_step, (draft_cache, last, done), None, length=k)
+        if sample:
+            (draft_cache, _, _, _), (proposals, q_all) = jax.lax.scan(
+                draft_step, (draft_cache, last, done, r_draft), None,
+                length=k)
+            # q distributions of the k-1 usable proposals: [B, k-1, V]
+            qd = jnp.moveaxis(q_all[:k - 1], 0, 1)
+        else:
+            (draft_cache, _, _, _), proposals = jax.lax.scan(
+                draft_step, (draft_cache, last, done, r_draft), None,
+                length=k)
         d = proposals.T[:, :k - 1]  # [B, k-1]
 
         # Target verifies the proposals (+ keys the last token) in
@@ -210,29 +270,74 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         o, u = verify_dec.apply(
             {"params": params, "cache": target_cache}, chunk,
             train=False, mutable=["cache"])
-        g = jnp.argmax(_logits_of(o), axis=-1).astype(last.dtype)
+        if sample:
+            # Rejection-sampling acceptance (Leviathan/Chen): accept
+            # proposal d_j with prob min(1, p_j(d_j)/q_j(d_j)); on
+            # rejection resample from normalize(relu(p_j - q_j)); if
+            # all k-1 accepted, the bonus column samples from p
+            # directly. Each committed token is then exactly
+            # target-distributed: p = q·min(1,p/q) + P(reject)·resid.
+            pd = dist(_logits_of(o))          # [B, k, V] f32
+            p_of_d = jnp.take_along_axis(
+                pd[:, :k - 1], d[..., None].astype(jnp.int32),
+                2)[..., 0]
+            q_of_d = jnp.take_along_axis(
+                qd, d[..., None].astype(jnp.int32), 2)[..., 0]
+            ratio = p_of_d / jnp.maximum(q_of_d, 1e-20)
+            accept = jax.random.uniform(
+                r_accept, (b, k - 1)) < ratio    # [B, k-1]
+            resid = jnp.maximum(pd[:, :k - 1] - qd, 0.0)
+            # Self-draft (p == q): residual is all-zero but also never
+            # sampled (accept prob 1); fall back to p so categorical
+            # stays NaN-free on the untaken branch.
+            degenerate = (jnp.sum(resid, -1, keepdims=True) <= 0.0)
+            resid = jnp.where(degenerate, pd[:, :k - 1], resid)
+            replacement = jax.random.categorical(
+                r_resid, jnp.log(resid), axis=-1).astype(last.dtype)
+            bonus = jax.random.categorical(
+                r_bonus, jnp.log(pd[:, k - 1]), axis=-1
+            ).astype(last.dtype)
+            g = jnp.concatenate(
+                [jnp.where(accept, d, replacement), bonus[:, None]],
+                axis=1)                          # [B, k]
+        else:
+            g = jnp.argmax(_logits_of(o), axis=-1).astype(last.dtype)
 
         if use_eos:
             # The committed stream applies decode's done-mask to the
-            # target's greedy choices column by column (a tiny scan
-            # over k columns — [B] work per step).
-            def commit_col(done_c, gj):
-                cj = jnp.where(done_c, eos_row, gj)
-                done_after = done_c | (cj == eos_row)
-                return done_after, (cj, done_after)
+            # target's choices column by column (a tiny scan over k
+            # columns — [B] work per step). When sampling it also
+            # forces accept=True on finished rows (both streams emit
+            # EOS there, so a done row never drags the batch).
+            acc_in = (jnp.concatenate(
+                [accept, jnp.ones((b, 1), bool)], axis=1)
+                if sample else jnp.zeros((b, k), bool))
 
-            _, (c_cols, done_cols) = jax.lax.scan(
-                commit_col, done, g.T)
+            def commit_col(done_c, col):
+                gj, aj = col
+                cj = jnp.where(done_c, eos_row, gj)
+                aj = aj | done_c
+                done_after = done_c | (cj == eos_row)
+                return done_after, (cj, aj, done_after)
+
+            _, (c_cols, acc_cols, done_cols) = jax.lax.scan(
+                commit_col, done, (g.T, acc_in.T))
             c = c_cols.T                 # [B, k] masked commits
             done_track = done_cols.T     # [B, k] done AFTER column j
+            if sample:
+                accept = acc_cols.T[:, :k - 1]
         else:
             c = g
 
-        # Longest prefix where the (done-masked) proposals match the
-        # committed stream, uniform across the batch (<= k-1 by
-        # construction). Finished rows auto-match: both sides emit
-        # EOS, so a done row never drags the batch's acceptance down.
-        match = (d == c[:, :k - 1]).astype(jnp.int32)
+        # Longest accepted prefix, uniform across the batch (<= k-1
+        # by construction): greedy accepts where the proposal equals
+        # the committed stream; sampling uses the rejection test's
+        # accept flags (a rejected column already holds its residual
+        # resample in c). Finished rows auto-accept (see above).
+        if sample:
+            match = accept.astype(jnp.int32)
+        else:
+            match = (d == c[:, :k - 1]).astype(jnp.int32)
         m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
         m = jnp.min(m_row)
         # The committed continuation: accepted proposals d[:, :m],
@@ -254,14 +359,14 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         # `nxt`, the newest committed-but-unkeyed token.
         target_cache = _rewind(u["cache"], start + m)
         draft_cache = _rewind(draft_cache, start + m)
-        return (out, n + m + 1, nxt, target_cache, draft_cache, done,
-                rounds + 1, accepted + m)
+        return (out, n + m + 1, nxt, target_cache, draft_cache,
+                done, rounds + 1, accepted + m, loop_rng)
 
     zero = jnp.zeros((), jnp.int32)
-    out, n, _, _, _, done, rounds, accepted = jax.lax.while_loop(
+    (out, n, _, _, _, done, rounds, accepted, _) = jax.lax.while_loop(
         cond, body,
         (out, jnp.ones((), jnp.int32), first, target_cache,
-         draft_cache, done, zero, zero))
+         draft_cache, done, zero, zero, rng))
 
     if use_eos:
         # Early exit (every row finished): positions the loop never
@@ -281,15 +386,25 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
 
 def speculative_decode(model, params, draft_model, draft_params,
                        prompt, max_new_tokens, *, k=4,
+                       temperature=0.0, rng=None,
                        prompt_len=None, eos_id=None,
                        return_stats=False):
-    """Greedy decode of ``model`` accelerated by ``draft_model``.
+    """Decode of ``model`` accelerated by ``draft_model``.
 
-    Returns [B, P + max_new_tokens] tokens identical to
-    ``decode(model, params, prompt, max_new_tokens)`` (greedy). With
-    ``return_stats=True`` also returns {"rounds", "accepted_drafts",
-    "generated"} for acceptance-rate telemetry (generated may
-    overshoot max_new_tokens internally; the output is sliced).
+    With ``temperature == 0`` (default) the output is tokens
+    identical to ``decode(model, params, prompt, max_new_tokens)``
+    (greedy). With ``temperature > 0`` (scalar or per-row [B] vector,
+    all rows > 0) the draft PROPOSES from its softmax and the target
+    runs the rejection-sampling accept test, so each committed token
+    is distributed exactly per the target's softmax(logits/T) — same
+    output DISTRIBUTION as ``decode(..., temperature=T, rng=...)``,
+    not the same token path (the two consume randomness differently).
+    ``rng`` defaults to PRNGKey(0) like decode; fixed rng => fully
+    reproducible output. With ``return_stats=True`` also returns
+    {"rounds", "accepted_drafts", "generated"} for acceptance-rate
+    telemetry (generated may overshoot max_new_tokens internally; the
+    output is sliced) — under sampling, accepted/rounds is the
+    acceptance-rate signal that decides whether the draft pays off.
 
     Per round: k draft steps propose k-1 tokens (the k-th step only
     keys the draft cache), one width-k verify forward scores them,
@@ -310,9 +425,12 @@ def speculative_decode(model, params, draft_model, draft_params,
     the loop exits early and the remaining positions fill with EOS
     directly (plain decode must scan to max_new_tokens regardless).
 
-    Requirements: greedy only, no sliding window on either model,
-    shared vocab, and P + max_new_tokens + k within both models'
-    max_seq_len.
+    Requirements: no sampling filters (top-k/top-p/min-p) or
+    repetition penalty, no sliding window on either model, shared
+    vocab, and P + max_new_tokens + k within both models'
+    max_seq_len. Per-row temperatures must be all zero (greedy) or
+    all positive (sampling) — the two are different compiled
+    programs, same rule as ``decode``.
     """
     if max_new_tokens < 1:
         raise ValueError("speculative decode needs max_new_tokens >= 1")
@@ -365,6 +483,23 @@ def speculative_decode(model, params, draft_model, draft_params,
         plen_arr = jnp.asarray(plen_host)
     else:
         plen_arr = jnp.full((b,), p, jnp.int32)
+    # Same greedy/sampling mode rule as decode(): the MODE is compiled
+    # in (one program each), the temperature itself is traced.
+    t_host = np.asarray(temperature, np.float32).reshape(-1)
+    if t_host.shape[0] not in (1, b):
+        raise ValueError(
+            f"temperature must be a scalar or one entry per row "
+            f"({b}): got shape {t_host.shape}")
+    t_host = np.broadcast_to(t_host, (b,))
+    if (t_host < 0).any():
+        raise ValueError(f"temperatures must be >= 0: {t_host}")
+    sample = bool((t_host > 0).any())
+    if sample and not (t_host > 0).all():
+        raise ValueError(
+            "per-row temperatures must be all zero (greedy) or all "
+            f"positive (sampling): {t_host}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
     use_eos = eos_id is not None
     if use_eos:
         eos_host = np.asarray(eos_id, np.int32).reshape(-1)
@@ -383,4 +518,4 @@ def speculative_decode(model, params, draft_model, draft_params,
     return _spec_impl(model, params, draft_model, draft_params,
                       jnp.asarray(prompt, jnp.int32), max_new_tokens,
                       k, return_stats, ragged, plen_arr, use_eos,
-                      eos_arr)
+                      eos_arr, sample, jnp.asarray(t_host), rng)

@@ -12,11 +12,17 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Speculative decoding: greedy equality is the whole contract.
+"""Speculative decoding: speculation may only change wall-clock.
 
-Every test pins speculative_decode against plain greedy decode() —
-speculation may only change wall-clock, never a single token. The
-verify path (multi-token chunks attending a non-empty cache via
+Greedy (temperature 0): exact token equality is the contract — every
+greedy test pins speculative_decode against plain greedy decode().
+Sampling (temperature > 0, rejection-sampling speculation): the
+contract is DISTRIBUTIONAL — committed tokens must follow the
+target's softmax(logits/T) exactly, which the sampling tests check
+against enumerated exact marginals (plus structural invariants:
+reproducibility under a fixed rng, self-draft full acceptance, the
+T->0 greedy limit, EOS/ragged semantics). The verify path
+(multi-token chunks attending a non-empty cache via
 chunk_attends_cache) is exercised by construction in every case.
 """
 
@@ -225,3 +231,178 @@ def test_spec_eos_validation():
     with pytest.raises(ValueError, match="eos_id"):
         speculative_decode(target, tp, draft, dp, prompt, 4,
                            eos_id=64)
+
+
+# ---------------------------------------------------------------------
+# Rejection-sampling speculation (temperature > 0)
+# ---------------------------------------------------------------------
+
+
+def _small(vocab=16, seed=0, **kw):
+    return _make(vocab=vocab, embed=kw.pop("embed", 32),
+                 layers=kw.pop("layers", 2), heads=kw.pop("heads", 4),
+                 seq=32, seed=seed, **kw)
+
+
+def _marginals(model, params, prompt, temperature):
+    """Exact per-position marginals P(x_{p}), P(x_{p+1}), P(x_{p+2})
+    of ancestral sampling from softmax(logits/T), by enumerating all
+    vocab^j prefixes (teacher-forced full forwards, no cache)."""
+    V = model.vocab_size
+
+    def last_probs(seqs):
+        logits = model.apply({"params": params}, jnp.asarray(seqs),
+                             train=False)
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        return np.asarray(jax.nn.softmax(
+            logits[:, -1].astype(jnp.float32) / temperature, -1))
+
+    p1 = last_probs(prompt)[0]                              # [V]
+    toks = np.arange(V, dtype=np.int32)
+    pre2 = np.concatenate(
+        [np.repeat(prompt, V, 0), toks[:, None]], 1)
+    cond2 = last_probs(pre2)                                # [V, V]
+    p2 = p1 @ cond2
+    pre3 = np.concatenate(
+        [np.repeat(prompt, V * V, 0),
+         np.repeat(toks, V)[:, None],
+         np.tile(toks, V)[:, None]], 1)
+    cond3 = last_probs(pre3).reshape(V, V, V)               # [t1,t2,V]
+    p3 = np.einsum("a,ab,abv->v", p1, cond2, cond3)
+    return p1, p2, p3
+
+
+def _tv(a, b):
+    return 0.5 * float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+
+
+def test_spec_sampling_matches_target_distribution():
+    """THE correctness property of rejection-sampling speculation:
+    committed tokens are distributed exactly per the TARGET's
+    softmax(logits/T), not the draft's, even though most tokens are
+    physically produced by the draft. Checked against exact
+    enumerated marginals at the first three generated positions
+    (positions 2-3 ride the accept/residual machinery); the draft is
+    far from the target (TV ~ 0.4) so committing draft proposals
+    unconditionally would fail these bounds by an order of
+    magnitude."""
+    V = 16
+    target, tp = _small(vocab=V, seed=0)
+    draft, dp = _small(vocab=V, embed=16, layers=1, heads=2, seed=99)
+    prompt = np.array([[1, 2, 3, 4]], np.int32)
+    T = 1.0
+    p1, p2, p3 = _marginals(target, tp, prompt, T)
+    d1, d2, d3 = _marginals(draft, dp, prompt, T)
+    # Guard: the fixture must keep the two models distinguishable,
+    # or this test can't tell "target-distributed" from "draft-
+    # distributed".
+    assert _tv(p2, d2) > 0.25 and _tv(p3, d3) > 0.25
+
+    B, seeds, new = 128, 32, 3
+    batch = np.repeat(prompt, B, 0)
+    counts = np.zeros((3, V))
+    for s in range(seeds):
+        out = np.asarray(speculative_decode(
+            target, tp, draft, dp, batch, new, k=4, temperature=T,
+            rng=jax.random.PRNGKey(1000 + s)))
+        gen = out[:, prompt.shape[1]:]
+        for j in range(3):
+            counts[j] += np.bincount(gen[:, j], minlength=V)
+    emp = counts / counts.sum(axis=1, keepdims=True)
+    # ~4k samples over 16 bins: TV noise floor ~0.02-0.03.
+    for j, exact in enumerate((p1, p2, p3)):
+        assert _tv(emp[j], exact) < 0.08, (j, _tv(emp[j], exact))
+    # ...and provably NOT the draft's distribution.
+    assert _tv(emp[1], d2) > 0.25
+    assert _tv(emp[2], d3) > 0.25
+
+
+def test_spec_sampling_self_draft_accepts_everything():
+    """p == q makes the accept ratio exactly 1: every proposal
+    accepted, every round commits k tokens."""
+    target, tp = _small(seed=0)
+    prompt = _prompt(2, 6, vocab=16)
+    out, st = speculative_decode(
+        target, tp, target, tp, prompt, 12, k=4, temperature=0.7,
+        rng=jax.random.PRNGKey(3), return_stats=True)
+    assert int(st["accepted_drafts"]) == 3 * int(st["rounds"]), st
+    assert out.shape == (2, 6 + 12)
+
+
+def test_spec_sampling_reproducible_and_seed_sensitive():
+    target, tp = _small(seed=0)
+    draft, dp = _small(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 6, vocab=16)
+    r = jax.random.PRNGKey(5)
+    a = speculative_decode(target, tp, draft, dp, prompt, 10, k=4,
+                           temperature=1.0, rng=r)
+    b = speculative_decode(target, tp, draft, dp, prompt, 10, k=4,
+                           temperature=1.0, rng=r)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = speculative_decode(target, tp, draft, dp, prompt, 10, k=4,
+                           temperature=1.0,
+                           rng=jax.random.PRNGKey(6))
+    assert (np.asarray(a) != np.asarray(c)).any()
+
+
+def test_spec_sampling_tiny_temperature_is_greedy():
+    """T -> 0 collapses both p and q to argmax one-hots, so the
+    sampling program must reproduce the greedy token path."""
+    target, tp = _small(seed=0)
+    draft, dp = _small(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 6, vocab=16)
+    want = decode(target, tp, prompt, 10)
+    got = speculative_decode(target, tp, draft, dp, prompt, 10, k=4,
+                             temperature=1e-5,
+                             rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_sampling_eos_semantics():
+    """Sampling + EOS: decode's keep-emitting contract holds — after
+    the first generated EOS every later position is EOS."""
+    target, tp = _small(seed=0)
+    draft, dp = _small(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 6, vocab=16)
+    p = prompt.shape[1]
+    eos = 3
+    out = np.asarray(speculative_decode(
+        target, tp, draft, dp, prompt, 20, k=4, temperature=1.0,
+        rng=jax.random.PRNGKey(11), eos_id=eos))
+    gen = out[:, p:]
+    for row in gen:
+        hits = np.nonzero(row == eos)[0]
+        if hits.size:
+            assert (row[hits[0]:] == eos).all(), row
+
+
+def test_spec_sampling_ragged_prompts_keep_prompt_region():
+    """Sampling + ragged: forced prompt tokens survive verbatim; the
+    padding region is generated (whatever it is, the row's true
+    prompt must not be disturbed)."""
+    target, tp = _small(seed=0)
+    draft, dp = _small(embed=16, layers=1, heads=2, seed=99)
+    prompt = np.asarray(_prompt(2, 8, vocab=16))
+    plen = np.array([5, 8], np.int32)
+    out = np.asarray(speculative_decode(
+        target, tp, draft, dp, prompt, 8, k=4, temperature=1.0,
+        rng=jax.random.PRNGKey(12), prompt_len=plen))
+    for r, pl in enumerate(plen):
+        np.testing.assert_array_equal(out[r, :pl], prompt[r, :pl])
+    assert out.shape == (2, 16)
+
+
+def test_spec_sampling_validation():
+    target, tp = _small(seed=0)
+    draft, dp = _small(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 6, vocab=16)
+    with pytest.raises(ValueError, match="all zero .* or all"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           temperature=jnp.array([0.0, 1.0]))
+    with pytest.raises(ValueError, match=">= 0"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           temperature=-1.0)
+    with pytest.raises(ValueError, match="temperature must be"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           temperature=jnp.ones((3,)))
