@@ -429,12 +429,15 @@ class GenerationServer(_BaseServer):
         super().__init__(model_name, port)
         from ..models.decode import decode
         self._decode = decode
-        # Speculative decoding for the default greedy path: a draft
-        # model proposes, the target verifies — identical tokens,
-        # fewer weight streams. Only plain greedy requests (no
-        # top_k/top_p/min_p — already implied by greedy validation —
-        # no repetition penalty, no logprobs) ride it; everything
-        # else takes the ordinary decode program.
+        # Speculative decoding for default-knob traffic: a draft
+        # model proposes, the target verifies — identical tokens
+        # (greedy) or an identical output distribution (sampling,
+        # via the rejection-sampling accept test), fewer weight
+        # streams. Only requests without filters/penalties/logprobs
+        # (no top_k/top_p/min_p, repetition_penalty 1.0) ride it —
+        # greedy and sampling each get their own stable spec program
+        # per bucket; everything else takes the ordinary decode
+        # program.
         self._spec_k = int(speculative_k)
         self._draft_model = draft_model
         self._draft_params = draft_params
@@ -554,22 +557,25 @@ class GenerationServer(_BaseServer):
         """
         for b in self._buckets:
             zeros = np.zeros((b,), np.int32)
-            # pad_temp selects greedy vs sampling mode.
+            # pad_temp selects greedy vs sampling mode. With a draft
+            # configured the two default calls ride the greedy and
+            # sampling SPECULATIVE programs.
             self._run([(zeros, 0.0, b, 1.0, -1, 1.0, 0.0)], 0.0)
+            self._run([(zeros, 1.0, b, 1.0, -1, 1.0, 0.0)], 1.0)
             if self._spec_k:
-                # The default-greedy call above rode the speculative
-                # program; greedy traffic with a repetition penalty
-                # still selects the PLAIN decode program (ADVICE r3:
-                # without this it paid a first-request compile after
+                # Traffic with a repetition penalty still selects the
+                # PLAIN decode program in either mode (ADVICE r3:
+                # without these it paid a first-request compile after
                 # /healthz already reported ready). rep_pen 1.1, not
                 # 1.0: decode() specializes on use_rp = any(rp != 1)
                 # as a STATIC argument, and penalty traffic runs the
                 # use_rp=True program — warming with all-1.0 would
                 # build the wrong variant (and, on buckets without
-                # speculative headroom, just repeat the call above).
+                # speculative headroom, just repeat the calls above).
                 self._run([(zeros, 0.0, b, 1.0, -1, 1.1, 0.0)], 0.0,
                           force_plain=True)
-            self._run([(zeros, 1.0, b, 1.0, -1, 1.0, 0.0)], 1.0)
+                self._run([(zeros, 1.0, b, 1.0, -1, 1.1, 0.0)], 1.0,
+                          force_plain=True)
             for spec in self._warm_filters:
                 temp = float(spec.get("temperature", 1.0))
                 top_k = self._quantize_top_k(int(spec.get("top_k", 0)))
@@ -606,6 +612,20 @@ class GenerationServer(_BaseServer):
                 "max_new_tokens": self._max_new,
                 "max_batch": self._max_batch}
 
+    @staticmethod
+    def _default_knobs(top_k, want_lp, rep_pen, min_p, top_p):
+        """The speculative-eligible knob shape — no filters, no
+        penalty, no logprobs. ONE authority for both call sites:
+        request routing (scalars -> batcher ``plain`` key) and
+        _run's batch-level safety check (vectors). Keeping them in
+        sync matters: divergence either diverts default traffic onto
+        an unwarmed plain program (post-ready compile stall) or lets
+        a non-default row flip a spec batch."""
+        return (not top_k and not want_lp
+                and bool(np.all(np.asarray(rep_pen) == 1.0))
+                and bool(np.all(np.asarray(min_p) == 0.0))
+                and bool(np.all(np.asarray(top_p) == 1.0)))
+
     def _run(self, instances, pad_temp, top_k=0, want_lp=False,
              force_plain=False):
         """Decode a micro-batch of (row, temperature, prompt_len,
@@ -634,22 +654,25 @@ class GenerationServer(_BaseServer):
             seed = self._seed
             self._decode_calls += 1
             self._decode_rows += n
-        if (self._spec_k and not force_plain and pad_temp == 0.0
-                and not top_k and not want_lp
-                and (rep_pens == 1.0).all() and (min_ps == 0.0).all()
-                and (top_ps == 1.0).all()
+        if (self._spec_k and not force_plain
+                and self._default_knobs(top_k, want_lp, rep_pens,
+                                        min_ps, top_ps)
                 and bucket + self._max_new + self._spec_k
                 <= min(self._model.max_seq_len,
                        self._draft_model.max_seq_len)):
-            # One stable spec program per bucket: prompt_len and
-            # eos_id ride as vectors regardless of batch composition
-            # (speculative_decode never downgrades variants on
-            # values). Output is identical to the decode() below.
+            # One stable spec program per (bucket, mode): prompt_len,
+            # eos_id and temperature ride as vectors regardless of
+            # batch composition (speculative_decode picks greedy vs
+            # rejection-sampling from the MODE — temps here are
+            # all-zero or all-positive by batcher construction, never
+            # mixed). Output is identical to (greedy) or distributed
+            # identically to (sampling) the decode() below.
             out = self._speculative(
                 self._model, self._params, self._draft_model,
                 self._draft_params, jnp.asarray(padded),
                 self._max_new, k=self._spec_k, prompt_len=plens,
-                eos_id=eos_ids)
+                eos_id=eos_ids, temperature=temps,
+                rng=jax.random.PRNGKey(seed))
             with self._stats_lock:
                 self._spec_calls += 1
             return np.asarray(out)[:n]
@@ -679,12 +702,14 @@ class GenerationServer(_BaseServer):
 
     def _batcher_for(self, bucket, sampling, top_k, want_lp=False,
                      plain=True):
-        # ``plain`` keys default-greedy rows apart from greedy rows
-        # carrying a repetition penalty (the only non-default knob
-        # validation allows at temperature 0), so a penalty row can
-        # never land in a default-greedy micro-batch and flip it off
+        # ``plain`` keys default-knob rows (no filters, no penalty,
+        # no logprobs — the speculative-eligible shape) apart from
+        # rows carrying any non-default option, so a penalty/filter
+        # row can never land in a default micro-batch and flip it off
         # the speculative program — the program choice is decided by
         # the batcher key, not by batch composition (ADVICE r3).
+        # Greedy and sampling stay separate via ``sampling``, so each
+        # plain batcher feeds one stable spec program per bucket.
         key = (bucket, sampling, top_k, want_lp, plain)
         with self._batchers_lock:
             if self._stopping:
@@ -759,6 +784,12 @@ class GenerationServer(_BaseServer):
         if not 0 <= top_k <= self._model.vocab_size:
             return 400, {"error": f"top_k must be in "
                                   f"0..{self._model.vocab_size}"}
+        # Upper bound rejects inf/NaN too (NaN fails both compares).
+        # A negative temperature must not reach the batcher: it would
+        # poison speculative_decode's per-row temperature vector and
+        # 500 every co-batched request.
+        if not 0.0 <= temperature <= 1e6:
+            return 400, {"error": "temperature must be in [0, 1e6]"}
         if not 0.0 < top_p <= 1.0:
             return 400, {"error": "top_p must be in (0, 1]"}
         if not 0.0 < rep_pen <= 100.0:
@@ -809,10 +840,10 @@ class GenerationServer(_BaseServer):
                                   f"max {self._buckets[-1]}"}
         padded = np.zeros((arr.shape[0], bucket), np.int32)
         padded[:, :p_len] = arr
-        batcher = self._batcher_for(bucket, temperature > 0.0, top_k,
-                                    want_lp,
-                                    plain=(temperature <= 0.0
-                                           and rep_pen == 1.0))
+        batcher = self._batcher_for(
+            bucket, temperature > 0.0, top_k, want_lp,
+            plain=self._default_knobs(top_k, want_lp, rep_pen, min_p,
+                                      top_p))
         if batcher is None:
             return 503, {"error": "server is shutting down"}
         pending = batcher.submit_many(

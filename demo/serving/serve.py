@@ -167,12 +167,14 @@ def main(argv=None):
                         "subslice); XLA inserts the collectives. "
                         "1 = single-chip replica")
     p.add_argument("--speculative-k", type=int, default=0,
-                   help="N>0: plain-greedy requests decode "
-                        "speculatively — a draft model proposes N-1 "
-                        "tokens per verify round (identical output, "
-                        "fewer weight streams); needs headroom "
-                        "(bucket + max_new_tokens + N <= "
-                        "max_seq_len), transformer model only")
+                   help="N>0: default-knob requests (no filters/"
+                        "penalties/logprobs) decode speculatively — "
+                        "a draft model proposes N-1 tokens per "
+                        "verify round (greedy: identical output; "
+                        "sampling: identical output distribution via "
+                        "rejection-sampling, fewer weight streams); "
+                        "needs headroom (bucket + max_new_tokens + N "
+                        "<= max_seq_len), transformer model only")
     p.add_argument("--draft-layers", type=int, default=2)
     p.add_argument("--draft-embed-dim", type=int, default=128)
     p.add_argument("--draft-num-heads", type=int, default=0,

@@ -383,6 +383,10 @@ def test_generate_validation(lm_server):
             {"prompts": [[1]], "top_k": 5},  # filters need temp > 0
             {"prompts": [[1]], "eos_id": 64},  # >= vocab
             {"prompts": [[1]], "eos_id": -2},
+            # Negative temp must 400 here — reaching a spec-enabled
+            # batcher it would 500 every co-batched request.
+            {"prompts": [[1]], "temperature": -1.0},
+            {"prompts": [[1]], "temperature": float("nan")},
     ):
         with pytest.raises(urllib.error.HTTPError) as err:
             post(lm_server, "/v1/models/lm:generate", payload)
@@ -773,19 +777,31 @@ def test_generate_speculative_greedy_path():
                         timeout=10) as resp:
             stats = json.loads(resp.read())
         assert stats["speculative_calls"] >= 3, stats
-        # Penalized greedy and sampling fall back to plain decode.
+        # Default-knob SAMPLING also rides speculation (rejection-
+        # sampling program), while any non-default option — penalty,
+        # nucleus — falls back to plain decode in either mode.
+        out = post(spec, "/v1/models/lm:generate",
+                   {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                    "temperature": 0.9})
+        assert len(out["sequences"][0]) == 7
+        with _u.urlopen(f"http://localhost:{spec.port}/stats",
+                        timeout=10) as resp:
+            stats_s = json.loads(resp.read())
+        assert (stats_s["speculative_calls"]
+                == stats["speculative_calls"] + 1), stats_s
         for payload in (
                 {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
                  "repetition_penalty": 1.3},
                 {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
-                 "temperature": 0.9},
+                 "temperature": 0.9, "top_p": 0.8},
         ):
             out = post(spec, "/v1/models/lm:generate", payload)
             assert len(out["sequences"][0]) == 7
         with _u.urlopen(f"http://localhost:{spec.port}/stats",
                         timeout=10) as resp:
             stats2 = json.loads(resp.read())
-        assert stats2["speculative_calls"] == stats["speculative_calls"]
+        assert (stats2["speculative_calls"]
+                == stats_s["speculative_calls"]), stats2
     finally:
         plain.stop()
         spec.stop()
@@ -793,12 +809,13 @@ def test_generate_speculative_greedy_path():
 
 def test_generate_speculative_warm_compiles_plain_greedy():
     """ADVICE r3 (medium): with speculative_k set, warm-up must also
-    build the PLAIN greedy decode program per bucket — greedy traffic
-    with a repetition penalty (allowed by validation) selects it, and
-    without the extra warm call it paid a first-request compile after
-    /healthz already reported ready. Observable composition: per
-    bucket, warm-up now runs spec-greedy + plain-greedy + sampling =
-    3 decode calls, exactly one of them speculative."""
+    build the PLAIN decode programs per bucket — traffic with a
+    repetition penalty (allowed in both modes) selects them, and
+    without the extra warm calls it paid a first-request compile
+    after /healthz already reported ready. Observable composition:
+    per bucket, warm-up runs spec-greedy + spec-sampling +
+    plain-greedy(rp) + plain-sampling(rp) = 4 decode calls, two of
+    them speculative."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -825,8 +842,8 @@ def test_generate_speculative_warm_compiles_plain_greedy():
         with _u.urlopen(f"http://localhost:{srv.port}/stats",
                         timeout=10) as resp:
             stats = json.loads(resp.read())
-        assert stats["decode_calls"] == 6, stats   # 3 per bucket
-        assert stats["speculative_calls"] == 2, stats  # 1 per bucket
+        assert stats["decode_calls"] == 8, stats   # 4 per bucket
+        assert stats["speculative_calls"] == 4, stats  # 2 per bucket
         # The plain program warm-up targeted: greedy + penalty.
         out = post(srv, "/v1/models/lm:generate",
                    {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
@@ -835,7 +852,7 @@ def test_generate_speculative_warm_compiles_plain_greedy():
         with _u.urlopen(f"http://localhost:{srv.port}/stats",
                         timeout=10) as resp:
             stats2 = json.loads(resp.read())
-        assert stats2["speculative_calls"] == 2, stats2
+        assert stats2["speculative_calls"] == 4, stats2
     finally:
         srv.stop()
 

@@ -71,9 +71,14 @@ def main(argv=None):
                    help="weight-only int8 projections/MLPs (the "
                         "serving load-time conversion)")
     p.add_argument("--speculative-k", type=int, default=0,
-                   help="N>0: greedy speculative decoding with a "
-                        "draft model proposing N tokens per verify "
-                        "round (output identical to plain greedy)")
+                   help="N>0: speculative decoding with a draft "
+                        "model proposing N tokens per verify round "
+                        "(greedy: output identical to plain greedy; "
+                        "with --temperature > 0: rejection-sampling "
+                        "speculation, same output distribution)")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; > 0 samples from softmax(l/T) "
+                        "(works with and without --speculative-k)")
     p.add_argument("--draft", default="self", choices=["self", "small"],
                    help="'self': draft = the target itself (full "
                         "acceptance — the mechanism's upper bound); "
@@ -139,10 +144,14 @@ def main(argv=None):
         def run(prompt):
             return speculative_decode(
                 model, params, draft_model, draft_params, prompt,
-                args.new_tokens, k=args.speculative_k)
+                args.new_tokens, k=args.speculative_k,
+                temperature=args.temperature,
+                rng=jax.random.PRNGKey(3))
     else:
         def run(prompt):
-            return decode(model, params, prompt, args.new_tokens)
+            return decode(model, params, prompt, args.new_tokens,
+                          temperature=args.temperature,
+                          rng=jax.random.PRNGKey(3))
 
     for b in args.batch:
         prompt = jax.random.randint(
@@ -170,6 +179,7 @@ def main(argv=None):
             "weights": args.quantize_weights,
             "pos_embedding": args.pos_embedding,
             "attention_window": args.attention_window,
+            "temperature": args.temperature,
             "platform": jax.devices()[0].platform,
             "sec_per_call": round(sec, 4),
             "decode_tokens_per_sec": round(tokens / sec, 1),

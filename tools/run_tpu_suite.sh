@@ -131,6 +131,13 @@ DECODE_RC=0
   timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
     --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft small \
     || DECODE_RC=1
+  # Rejection-sampling speculation (self-draft = the full-acceptance
+  # bound for the sampling program; plain sampling is the baseline).
+  timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
+    --prompt-len 128 --new-tokens 128 --temperature 1.0 || DECODE_RC=1
+  timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
+    --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft self \
+    --temperature 1.0 || DECODE_RC=1
 } > "${OUT}/DECODE_BENCH.json.tmp" 2>> "${OUT}/tpu_suite.log" 9>&-
 # Exit codes don't catch the CPU-fallback mode (a dropped tunnel lets
 # every run succeed on host CPU) — check the platform each row
