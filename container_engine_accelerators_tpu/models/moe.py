@@ -140,6 +140,7 @@ class MoEBlock(nn.Module):
     rope: bool = False
     window: int = 0
     weights: str = "native"
+    chunk_attends_cache: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -152,6 +153,8 @@ class MoEBlock(nn.Module):
                                 rope=self.rope,
                                 window=self.window,
                                 weights=self.weights,
+                                chunk_attends_cache=(
+                                    self.chunk_attends_cache),
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -190,6 +193,9 @@ class MoETransformerLM(nn.Module):
     # "int8": weight-only quantized attention/dense-MLP weights
     # (expert kernels stay native; they are already expert-sharded).
     weights: str = "native"
+    # Speculative verify path: multi-token chunks attend a non-empty
+    # KV cache (see CausalSelfAttention.chunk_attends_cache).
+    chunk_attends_cache: bool = False
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -227,6 +233,7 @@ class MoETransformerLM(nn.Module):
                     rope=self.pos_embedding == "rope",
                     window=self.attention_window,
                     weights=self.weights,
+                    chunk_attends_cache=self.chunk_attends_cache,
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -239,6 +246,7 @@ class MoETransformerLM(nn.Module):
                           rope=self.pos_embedding == "rope",
                           window=self.attention_window,
                           weights=self.weights,
+                          chunk_attends_cache=self.chunk_attends_cache,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -57,10 +57,15 @@ classic speculative-sampling identity (p = q·min(1, p/q) +
 (1-sum q·min(1, p/q))·residual) — so speculation again changes only
 wall-clock, never the output distribution. Same chunked-verify /
 uniform-min-acceptance / cache-rewind machinery as greedy; the accept
-test just replaces exact token match. Not supported (raise):
-sampling filters (top-k/top-p/min-p) and repetition penalty under
-speculation, sliding-window/ring caches (their prefill chunk write
-assumes offset 0), MoE draft or target. Reference repo has no
+test just replaces exact token match. MoE drafts/targets are
+supported when their routing is DROP-FREE (capacity_factor >=
+num_experts / top_k): without drops a token's routing depends only
+on itself, so the width-k verify chunk scores tokens exactly as the
+single-token decode steps would — with drops, routing is
+token-group-shaped and the identity breaks, so droppy configs raise.
+Not supported (raise): sampling filters (top-k/top-p/min-p) and
+repetition penalty under speculation, sliding-window/ring caches
+(their prefill chunk write assumes offset 0). Reference repo has no
 counterpart (its serving demo is TF-Serving images, SURVEY.md
 section 2.3); this is framework-level capability the TPU stack adds.
 """
@@ -384,6 +389,46 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
     return tokens
 
 
+def check_spec_models(model, draft_model):
+    """Structural speculation preconditions, shared by
+    ``speculative_decode`` and the serving layer's
+    fail-at-construction check (a replica must refuse to build —
+    never 500 its first request or wedge an async warm-up — on a
+    config speculation cannot serve). ONE authority; keep call-time
+    and construction-time checks from drifting."""
+    if getattr(model, "attention_window", 0) or getattr(
+            draft_model, "attention_window", 0):
+        raise ValueError(
+            "speculative decode does not support sliding-window "
+            "models (ring cache writes assume one-shot prefill)")
+    for m, which in ((model, "target"), (draft_model, "draft")):
+        if not hasattr(m, "chunk_attends_cache"):
+            raise ValueError(
+                f"speculative decode does not support this {which} "
+                f"model ({type(m).__name__}): it has no "
+                f"chunk_attends_cache verify path")
+        # MoE is supported only with DROP-FREE routing
+        # (capacity >= every token-group size, i.e. capacity_factor
+        # >= num_experts / top_k): with drops, a token's routing
+        # depends on the other tokens in its group, so the width-k
+        # verify chunk and the single-token decode step would route —
+        # and hence score — the same token differently, breaking the
+        # exact-identity (greedy) / exact-distribution (sampling)
+        # contract speculation rests on.
+        ne = int(getattr(m, "num_experts", 0) or 0)
+        if ne and m.capacity_factor * m.top_k < ne:
+            raise ValueError(
+                f"speculative decode requires drop-free MoE routing "
+                f"on the {which} model: capacity_factor "
+                f"({m.capacity_factor}) * top_k ({m.top_k}) must be "
+                f">= num_experts ({ne}) so verify chunks route "
+                f"identically to single-token decode steps")
+    if draft_model.vocab_size != model.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_model.vocab_size} != target vocab "
+            f"{model.vocab_size}")
+
+
 def speculative_decode(model, params, draft_model, draft_params,
                        prompt, max_new_tokens, *, k=4,
                        temperature=0.0, rng=None,
@@ -436,22 +481,7 @@ def speculative_decode(model, params, draft_model, draft_params,
         raise ValueError("speculative decode needs max_new_tokens >= 1")
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
-    if getattr(model, "attention_window", 0) or getattr(
-            draft_model, "attention_window", 0):
-        raise ValueError(
-            "speculative decode does not support sliding-window "
-            "models (ring cache writes assume one-shot prefill)")
-    for m, which in ((model, "target"), (draft_model, "draft")):
-        if not hasattr(m, "chunk_attends_cache"):
-            raise ValueError(
-                f"speculative decode does not support this {which} "
-                f"model ({type(m).__name__}): it has no "
-                f"chunk_attends_cache verify path (MoE models are "
-                f"not supported)")
-    if draft_model.vocab_size != model.vocab_size:
-        raise ValueError(
-            f"draft vocab {draft_model.vocab_size} != target vocab "
-            f"{model.vocab_size}")
+    check_spec_models(model, draft_model)
     b, p = prompt.shape
     need = p + max_new_tokens + k
     for m, which in ((model, "target"), (draft_model, "draft")):

@@ -442,12 +442,17 @@ class GenerationServer(_BaseServer):
         self._draft_model = draft_model
         self._draft_params = draft_params
         if self._spec_k:
-            from ..models.speculative import speculative_decode
+            from ..models.speculative import (
+                check_spec_models,
+                speculative_decode,
+            )
             self._speculative = speculative_decode
             # Fail at CONSTRUCTION, not at request time (or, worse,
             # inside an async warm-up thread that leaves the replica
-            # permanently unready): every precondition
-            # speculative_decode enforces per call is checked here.
+            # permanently unready): every structural precondition
+            # speculative_decode enforces per call is checked here,
+            # through the same shared helper so the two sites cannot
+            # drift.
             if self._spec_k < 1:
                 raise ValueError(
                     f"speculative_k must be >= 1: {speculative_k}")
@@ -455,20 +460,7 @@ class GenerationServer(_BaseServer):
                 raise ValueError(
                     "speculative_k requires draft_model and "
                     "draft_params")
-            if draft_model.vocab_size != model.vocab_size:
-                raise ValueError(
-                    f"draft vocab {draft_model.vocab_size} != "
-                    f"target vocab {model.vocab_size}")
-            for m, which in ((model, "target"), (draft_model, "draft")):
-                if getattr(m, "attention_window", 0):
-                    raise ValueError(
-                        f"speculative decoding does not support the "
-                        f"sliding-window {which} model")
-                if not hasattr(m, "chunk_attends_cache"):
-                    raise ValueError(
-                        f"speculative decoding does not support this "
-                        f"{which} model ({type(m).__name__}: no "
-                        f"chunked verify path)")
+            check_spec_models(model, draft_model)
         # Optional text codec: requests may then carry "text"
         # (list of strings) instead of "prompts"; responses gain
         # "completions" with the decoded generated region.

@@ -126,12 +126,16 @@ def test_spec_validation():
     from container_engine_accelerators_tpu.models import (
         MoETransformerLM,
     )
+    # MoE with DROPPY routing (capacity_factor * top_k < num_experts)
+    # must raise — drop patterns are token-group-shaped, so verify
+    # chunks would score tokens differently than decode steps.
     moe = MoETransformerLM(vocab_size=64, embed_dim=32, num_layers=1,
-                           num_heads=2, num_experts=2, max_seq_len=96,
+                           num_heads=2, num_experts=8, top_k=2,
+                           capacity_factor=1.25, max_seq_len=96,
                            dtype=jnp.float32)
-    with pytest.raises(ValueError, match="MoE"):
+    with pytest.raises(ValueError, match="drop-free"):
         speculative_decode(moe, {}, draft, dp, prompt, 4)
-    with pytest.raises(ValueError, match="MoE"):
+    with pytest.raises(ValueError, match="drop-free"):
         speculative_decode(target, tp, moe, {}, prompt, 4)
 
 
@@ -406,3 +410,76 @@ def test_spec_sampling_validation():
     with pytest.raises(ValueError, match="temperature must be"):
         speculative_decode(target, tp, draft, dp, prompt, 4,
                            temperature=jnp.ones((3,)))
+
+
+# ---------------------------------------------------------------------
+# MoE targets/drafts (drop-free routing)
+# ---------------------------------------------------------------------
+
+
+def _moe(vocab=64, experts=4, seed=0, **kw):
+    from container_engine_accelerators_tpu.models import (
+        MoETransformerLM,
+    )
+
+    # capacity_factor * top_k >= num_experts => drop-free: routing is
+    # per-token, so chunked verify == stepwise decode exactly.
+    model = MoETransformerLM(
+        vocab_size=vocab, embed_dim=kw.pop("embed", 32),
+        num_layers=kw.pop("layers", 2), num_heads=kw.pop("heads", 2),
+        num_experts=experts, top_k=2, capacity_factor=experts / 2,
+        max_seq_len=kw.pop("seq", 96), dtype=jnp.float32, **kw)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def test_spec_equals_greedy_moe_target():
+    """Drop-free MoE target + dense draft: exact greedy identity."""
+    target, tp = _moe(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 8)
+    want = decode(target, tp, prompt, 12)
+    got = speculative_decode(target, tp, draft, dp, prompt, 12, k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_equals_greedy_moe_draft():
+    """Dense target + drop-free MoE draft: exact greedy identity."""
+    target, tp = _make(seed=0)
+    draft, dp = _moe(embed=16, layers=1, experts=2, seed=99)
+    prompt = _prompt(2, 8)
+    want = decode(target, tp, prompt, 12)
+    got = speculative_decode(target, tp, draft, dp, prompt, 12, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_moe_self_draft_full_acceptance():
+    """MoE self-draft: every proposal must be accepted — the chunked
+    verify scores EXACTLY like the draft's stepwise decode, which is
+    precisely what drop-free routing guarantees (a droppy config
+    would fail this test, not just the validation)."""
+    target, tp = _moe(seed=0)
+    prompt = _prompt(1, 8)
+    out, st = speculative_decode(target, tp, target, tp, prompt, 12,
+                                 k=4, return_stats=True)
+    want = decode(target, tp, prompt, 12)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+    assert int(st["accepted_drafts"]) == 3 * int(st["rounds"]), st
+
+
+def test_spec_moe_sampling_reproducible_and_greedy_limit():
+    target, tp = _moe(vocab=16, seed=0)
+    draft, dp = _make(vocab=16, embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 6, vocab=16)
+    r = jax.random.PRNGKey(5)
+    a = speculative_decode(target, tp, draft, dp, prompt, 8, k=3,
+                           temperature=1.0, rng=r)
+    b = speculative_decode(target, tp, draft, dp, prompt, 8, k=3,
+                           temperature=1.0, rng=r)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    want = decode(target, tp, prompt, 8)
+    got = speculative_decode(target, tp, draft, dp, prompt, 8, k=3,
+                             temperature=1e-5,
+                             rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
