@@ -72,6 +72,16 @@ timeout -k 30 900 python tools/allocate_env_harness.py \
 sec_rc $? "allocate-env harness"
 [ -f ALLOCATE_ENV_TPU.json ] && cat ALLOCATE_ENV_TPU.json >&2
 
+echo "[suite] telemetry source probe (sdk + runtime gRPC)" >&2
+# The record is the deliverable either way (a documented failure
+# enumerating what the host serves beats "never tried"); only a tool
+# crash fails the section.
+# The probe prints its own one-line summary on stdout (lands in this
+# script's output), so no re-parse of the artifact is needed here.
+timeout -k 30 120 python tools/telemetry_probe.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "telemetry source probe"
+
 echo "[suite] attention sweep" >&2
 # Tracked artifact: write a sidecar and promote only on success, so a
 # timed-out sweep can't truncate the committed on-chip record (same
