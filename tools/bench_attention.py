@@ -168,6 +168,18 @@ def main(argv=None):
                                   "seq_len": s,
                                   "numerics_error": str(e)[:200]}))
 
+    # Per-call harness overhead: the wall_sync round trip amortized
+    # over iters plus per-dispatch latency, measured with a trivial
+    # program timed exactly like the kernels. On the tunneled backend
+    # this constant (~5-7 ms/call at iters=10) dominates short
+    # sequences — the committed round-2 rows at 2k/4k measured the
+    # tunnel, not the kernel (see docs/benchmarks.md roofline
+    # section). Rows report it, and tflops_net subtracts it, so the
+    # artifact separates kernel quality from harness tax.
+    tiny = jnp.ones((8, 8), dtype)
+    overhead_s = _time(jax.jit(lambda x: x + 1), tiny,
+                       iters=args.iters)
+
     for name, fn in schedules.items():
         try:
             sec = _time(fn, q, k, v, iters=args.iters)
@@ -187,6 +199,13 @@ def main(argv=None):
             "platform": jax.devices()[0].platform,
             "ms_per_call": round(sec * 1000, 3),
             "tflops": round(flops / sec / 1e12, 2),
+            "overhead_ms_per_call": round(overhead_s * 1000, 3),
+            # Kernel-attributable rate: wall time minus the measured
+            # harness constant. null when the call is so short the
+            # constant swamps it (the number would be noise).
+            "tflops_net": (
+                round(flops / (sec - overhead_s) / 1e12, 2)
+                if sec > overhead_s * 1.25 else None),
         }
         # The references are full-causal; windowed flash is a
         # different function, so the error metric would be bogus.

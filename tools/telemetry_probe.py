@@ -40,11 +40,19 @@ import json
 import os
 import socket
 import sys
+import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# Imported before any probe work: a broken checkout must fail fast,
+# not after a minute of source legs whose results then get discarded.
+from container_engine_accelerators_tpu.utils.provenance import (  # noqa: E402
+    stamp,
+)
+
 _CANDIDATE_ADDRS = ("localhost:8431",)
+SDK_LEG_TIMEOUT_S = 30
 
 
 def _outcome(fn):
@@ -71,7 +79,29 @@ def _outcome(fn):
                 "error": str(e)[:500]}
 
 
-def host_observations():
+def _deadlined(fn, timeout_s):
+    """Run fn in a daemon thread with a hard deadline — the SDK's
+    get_metric has no deadline of its own, and a wedged libtpu call
+    must cost one leg, not the whole artifact."""
+    box = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:
+            box["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError(f"leg exceeded {timeout_s}s deadline")
+    if "exc" in box:
+        raise box["exc"]
+    return box["value"]
+
+
+def host_observations(addrs):
     """What the host actually exposes — context that makes a failed
     source probe diagnosable instead of a bare traceback."""
     obs = {}
@@ -92,7 +122,7 @@ def host_observations():
         obs["dev_accel"] = []
     obs["run_tpu_exists"] = os.path.isdir("/run/tpu")
     ports = {}
-    for addr in _CANDIDATE_ADDRS:
+    for addr in addrs:
         host, port = addr.rsplit(":", 1)
         s = socket.socket()
         s.settimeout(2)
@@ -125,16 +155,32 @@ def main(argv=None):
     bridge = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bridge)
 
-    record = {"metric": "telemetry_source_probe"}
-    record["host_observations"] = host_observations()
+    addrs = list(dict.fromkeys(list(_CANDIDATE_ADDRS) + args.addr))
+
+    def write(record):
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.out)
+
+    record = {"metric": "telemetry_source_probe",
+              "host_observations": host_observations(addrs),
+              "provenance": stamp()}
+    # Partial record FIRST: if a source leg wedges past every
+    # deadline and the process is killed, the host observations (the
+    # diagnosable context) survive instead of vanishing with it.
+    record["status"] = "in_progress"
+    write(record)
 
     def sdk():
         src = bridge.SdkSource()
         return {"source": src.name, "chips": src.poll()}
 
-    record["sdk"] = _outcome(sdk)
+    record["sdk"] = _outcome(
+        lambda: _deadlined(sdk, SDK_LEG_TIMEOUT_S))
     record["grpc"] = {}
-    for addr in list(_CANDIDATE_ADDRS) + args.addr:
+    for addr in addrs:
         def leg(addr=addr):
             src = bridge.GrpcSource(addr)
             return {"source": src.name, "chips": src.poll()}
@@ -144,15 +190,8 @@ def main(argv=None):
     any_ok = record["sdk"]["ok"] or any(
         r["ok"] for r in record["grpc"].values())
     record["any_real_source"] = any_ok
-
-    from container_engine_accelerators_tpu.utils.provenance import stamp
-    record["provenance"] = stamp()
-
-    tmp = args.out + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(record, f, indent=1)
-        f.write("\n")
-    os.replace(tmp, args.out)
+    record["status"] = "complete"
+    write(record)
     print(json.dumps({"wrote": args.out, "any_real_source": any_ok,
                       "sdk_ok": record["sdk"]["ok"],
                       "grpc": {a: r["ok"]
