@@ -96,10 +96,11 @@ def _rewind(cache, position):
 @functools.partial(
     jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
                               "k", "return_stats", "ragged",
-                              "use_eos", "sample"))
+                              "use_eos", "sample", "use_active"))
 def _spec_impl(model, params, draft_model, draft_params, prompt,
                max_new_tokens, k, return_stats, ragged, prompt_len,
-               use_eos, eos_id, sample, temperature, rng):
+               use_eos, eos_id, sample, temperature, rng, use_active,
+               active):
     b, p = prompt.shape
     total = p + max_new_tokens + k  # slack for optimistic writes
     # Per-row EOS (-1 = never matches); decode's semantics: a row
@@ -343,6 +344,15 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
             match = accept.astype(jnp.int32)
         else:
             match = (d == c[:, :k - 1]).astype(jnp.int32)
+        if use_active:
+            # Inactive (serving pad) rows auto-accept: their output
+            # is discarded by contract, so their draft/target
+            # disagreement must never cap the batch's uniform
+            # acceptance. One masking site covers both modes — match
+            # IS the acceptance in sampling, and an inactive row's
+            # committed value (which accept also selects there) is
+            # never observed.
+            match = jnp.where(active[:, None], match, 1)
         m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
         m = jnp.min(m_row)
         # The committed continuation: accepted proposals d[:, :m],
@@ -367,6 +377,10 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         return (out, n + m + 1, nxt, target_cache, draft_cache,
                 done, rounds + 1, accepted + m, loop_rng)
 
+    if use_eos and use_active:
+        # Inactive rows count as finished so the all-done early exit
+        # keys off the REAL rows only.
+        done = done | ~active
     zero = jnp.zeros((), jnp.int32)
     (out, n, _, _, _, done, rounds, accepted, _) = jax.lax.while_loop(
         cond, body,
@@ -433,7 +447,7 @@ def speculative_decode(model, params, draft_model, draft_params,
                        prompt, max_new_tokens, *, k=4,
                        temperature=0.0, rng=None,
                        prompt_len=None, eos_id=None,
-                       return_stats=False):
+                       active_rows=None, return_stats=False):
     """Decode of ``model`` accelerated by ``draft_model``.
 
     With ``temperature == 0`` (default) the output is tokens
@@ -469,6 +483,17 @@ def speculative_decode(model, params, draft_model, draft_params,
     EOS — with one speculative bonus: once EVERY row has finished,
     the loop exits early and the remaining positions fill with EOS
     directly (plain decode must scan to max_new_tokens regardless).
+
+    ``active_rows`` ([B] bools, None = all active) marks rows whose
+    output will be DISCARDED by the caller — a serving layer that
+    pads every micro-batch to max_batch. Inactive rows auto-accept,
+    so their draft/target disagreement never caps the batch's
+    uniform acceptance: without this, a single real request padded
+    with zero rows degrades toward plain decode plus draft overhead
+    (pad rows reject almost every round). Active-row outputs are
+    unchanged — a masked run behaves exactly like a run over the
+    active rows alone. At least one row must be active. Variant
+    selection is type-driven (None vs given), like prompt_len/eos_id.
 
     Requirements: no sampling filters (top-k/top-p/min-p) or
     repetition penalty, no sliding window on either model, shared
@@ -545,7 +570,20 @@ def speculative_decode(model, params, draft_model, draft_params,
         eos_arr = jnp.asarray(eos_host)
     else:
         eos_arr = jnp.full((b,), -1, jnp.int32)
+    use_active = active_rows is not None
+    if use_active:
+        act_host = np.asarray(active_rows, bool).reshape(-1)
+        if act_host.shape[0] != b:
+            raise ValueError(
+                f"active_rows must have one entry per row ({b}): "
+                f"got shape {act_host.shape}")
+        if not act_host.any():
+            raise ValueError("active_rows must mark at least one row")
+        act_arr = jnp.asarray(act_host)
+    else:
+        act_arr = jnp.ones((b,), bool)
     return _spec_impl(model, params, draft_model, draft_params,
                       jnp.asarray(prompt, jnp.int32), max_new_tokens,
                       k, return_stats, ragged, plen_arr, use_eos,
-                      eos_arr, sample, jnp.asarray(t_host), rng)
+                      eos_arr, sample, jnp.asarray(t_host), rng,
+                      use_active, act_arr)

@@ -659,12 +659,17 @@ class GenerationServer(_BaseServer):
             # all-zero or all-positive by batcher construction, never
             # mixed). Output is identical to (greedy) or distributed
             # identically to (sampling) the decode() below.
+            # active_rows: only the n real rows gate the batch's
+            # uniform acceptance — pad rows' draft/target
+            # disagreement must not collapse speculation toward
+            # plain decode (their output is sliced away below).
             out = self._speculative(
                 self._model, self._params, self._draft_model,
                 self._draft_params, jnp.asarray(padded),
                 self._max_new, k=self._spec_k, prompt_len=plens,
                 eos_id=eos_ids, temperature=temps,
-                rng=jax.random.PRNGKey(seed))
+                rng=jax.random.PRNGKey(seed),
+                active_rows=np.arange(self._max_batch) < n)
             with self._stats_lock:
                 self._spec_calls += 1
             return np.asarray(out)[:n]

@@ -483,3 +483,98 @@ def test_spec_moe_sampling_reproducible_and_greedy_limit():
                              temperature=1e-5,
                              rng=jax.random.PRNGKey(0))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------
+# active_rows (serving pad-row masking)
+# ---------------------------------------------------------------------
+
+
+def test_spec_active_rows_pad_cannot_gate_real_rows():
+    """A masked run must behave EXACTLY like a run over the active
+    rows alone: same committed tokens for the real row AND the same
+    rounds/acceptance stats — the pad rows' draft/target
+    disagreement must not cap the batch's uniform acceptance
+    (without masking, zero-prompt pad rows reject nearly every round
+    and degrade serving speculation toward plain decode)."""
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    real = np.asarray(_prompt(1, 8, seed=21))
+    padded = np.concatenate(
+        [real, np.zeros((3, 8), np.int32)], axis=0)
+
+    alone, st_alone = speculative_decode(
+        target, tp, draft, dp, real, 16, k=4, return_stats=True)
+    masked, st_masked = speculative_decode(
+        target, tp, draft, dp, padded, 16, k=4,
+        active_rows=[True, False, False, False], return_stats=True)
+    np.testing.assert_array_equal(np.asarray(masked)[0],
+                                  np.asarray(alone)[0])
+    assert int(st_masked["rounds"]) == int(st_alone["rounds"]), (
+        st_masked, st_alone)
+    assert int(st_masked["accepted_drafts"]) == int(
+        st_alone["accepted_drafts"])
+    # Unmasked, the garbage pad rows DO gate acceptance — shown
+    # under sampling, where acceptance is the p/q overlap and hence
+    # nonzero for the real row (greedy acceptance between two random
+    # models is ~0 for every row, so it can't demonstrate the gap).
+    # Deterministic given the fixed rng.
+    r = jax.random.PRNGKey(77)
+    _, st_m = speculative_decode(
+        target, tp, draft, dp, padded, 16, k=4, temperature=1.0,
+        rng=r, active_rows=[True, False, False, False],
+        return_stats=True)
+    _, st_u = speculative_decode(
+        target, tp, draft, dp, padded, 16, k=4, temperature=1.0,
+        rng=r, return_stats=True)
+    assert int(st_u["accepted_drafts"]) < int(
+        st_m["accepted_drafts"]), (st_u, st_m)
+    assert int(st_m["rounds"]) < int(st_u["rounds"]), (st_m, st_u)
+
+
+def test_spec_active_rows_output_identity_all_modes():
+    """Masked runs stay output-correct for real rows in every mode:
+    greedy equals decode; sampling is reproducible; EOS semantics
+    hold."""
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = np.asarray(_prompt(2, 8, seed=22))
+    padded = np.concatenate(
+        [prompt, np.zeros((2, 8), np.int32)], axis=0)
+    active = [True, True, False, False]
+
+    want = decode(target, tp, prompt, 12)
+    got = speculative_decode(target, tp, draft, dp, padded, 12, k=4,
+                             active_rows=active)
+    np.testing.assert_array_equal(np.asarray(got)[:2],
+                                  np.asarray(want))
+
+    r = jax.random.PRNGKey(6)
+    s1 = speculative_decode(target, tp, draft, dp, padded, 8, k=3,
+                            temperature=1.0, rng=r,
+                            active_rows=active)
+    s2 = speculative_decode(target, tp, draft, dp, padded, 8, k=3,
+                            temperature=1.0, rng=r,
+                            active_rows=active)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+
+    eos = int(np.asarray(decode(target, tp, prompt, 1))[0, -1])
+    out = np.asarray(speculative_decode(
+        target, tp, draft, dp, padded, 16, k=4, eos_id=eos,
+        active_rows=active))
+    for row in out[:2, 8:]:
+        hits = np.nonzero(row == eos)[0]
+        if hits.size:
+            assert (row[hits[0]:] == eos).all(), row
+
+
+def test_spec_active_rows_validation():
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 8)
+    with pytest.raises(ValueError, match="one entry per row"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           active_rows=[True])
+    with pytest.raises(ValueError, match="at least one row"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           active_rows=[False, False])
