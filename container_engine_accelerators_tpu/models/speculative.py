@@ -96,11 +96,12 @@ def _rewind(cache, position):
 @functools.partial(
     jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
                               "k", "return_stats", "ragged",
-                              "use_eos", "sample", "use_active"))
+                              "use_eos", "sample", "use_active",
+                              "use_logprobs"))
 def _spec_impl(model, params, draft_model, draft_params, prompt,
                max_new_tokens, k, return_stats, ragged, prompt_len,
                use_eos, eos_id, sample, temperature, rng, use_active,
-               active):
+               active, use_logprobs):
     b, p = prompt.shape
     total = p + max_new_tokens + k  # slack for optimistic writes
     # Per-row EOS (-1 = never matches); decode's semantics: a row
@@ -116,6 +117,14 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         defined over. [..., V] -> [..., V]."""
         t = temp if logits.ndim == 2 else temp[:, :, None]
         return jax.nn.softmax(logits.astype(jnp.float32) / t, axis=-1)
+
+    def token_lp(raw_logits, tok):
+        """log P(tok) under the RAW logits — decode's scoring
+        quantity (pre-temperature, token_logprob in decode.py).
+        raw_logits [..., V], tok [...] -> [...]."""
+        lsm = jax.nn.log_softmax(raw_logits.astype(jnp.float32), -1)
+        return jnp.take_along_axis(
+            lsm, tok[..., None].astype(jnp.int32), -1)[..., 0]
 
     target_dec, target_cache = init_cache(model, b, total)
     verify_dec = target_dec.clone(chunk_attends_cache=True)
@@ -160,7 +169,9 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
                 # forcing; prompt-resident EOS never triggers.
                 nxt = jnp.where(done, eos_row, nxt)
                 done = done | (~in_prompt & (nxt == eos_row))
-            return (u["cache"], nxt, done, step_rng), nxt
+            y = ((nxt, token_lp(logits, nxt)) if use_logprobs
+                 else nxt)
+            return (u["cache"], nxt, done, step_rng), y
 
         rng, walk_rng = jax.random.split(rng)
         (target_cache, first, done, _), walked = jax.lax.scan(
@@ -168,6 +179,8 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
             (target_cache, prompt[:, 0], jnp.zeros((b,), bool),
              walk_rng),
             jnp.arange(p, dtype=jnp.int32))
+        if use_logprobs:
+            walked, walked_lp = walked
         # Resolved prefix (prompt tokens + target generations inside
         # the padding); the draft prefills it in ONE empty-cache
         # forward. `first` is the token at position p.
@@ -180,6 +193,14 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         out = jnp.zeros((b, total), prompt.dtype)
         out = jax.lax.dynamic_update_slice(out, prefix, (0, 0))
         out = jax.lax.dynamic_update_slice(out, first[:, None], (0, p))
+        lp = jnp.zeros((b, total), jnp.float32)
+        if use_logprobs:
+            # Positions 1..p carry the walk's per-step scores
+            # (forced prompt tokens score as teacher-forced echo,
+            # exactly decode's stepwise path); position 0 has no
+            # conditioning prefix.
+            lp = jax.lax.dynamic_update_slice(
+                lp, walked_lp.T, (0, 1))
     else:
         # Full-width prompts: prefill both caches with one forward
         # each; the target's last-position logits yield the first
@@ -206,15 +227,31 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         out = jnp.zeros((b, total), prompt.dtype)
         out = jax.lax.dynamic_update_slice(out, prompt, (0, 0))
         out = jax.lax.dynamic_update_slice(out, first[:, None], (0, p))
+        lp = jnp.zeros((b, total), jnp.float32)
+        if use_logprobs:
+            # Echo logprobs for the prompt come free from the
+            # prefill forward (decode's fast_prefill pattern):
+            # gather-then-logsumexp keeps the intermediate at [B, P].
+            pl = _logits_of(outs)[:, :-1].astype(jnp.float32)
+            chosen = jnp.take_along_axis(
+                pl, prompt[:, 1:, None].astype(jnp.int32), 2)[..., 0]
+            plp = chosen - jax.scipy.special.logsumexp(pl, axis=-1)
+            lp = jax.lax.dynamic_update_slice(lp, plp, (0, 1))
+            lp = jax.lax.dynamic_update_slice(
+                lp, token_lp(last_logits, first)[:, None], (0, p))
 
     def cond(carry):
         n, done = carry[1], carry[5]
-        alive = jnp.logical_not(jnp.all(done)) if use_eos else True
+        # With logprobs every emitted position needs a real score, so
+        # the loop runs to max_new_tokens like plain decode does (the
+        # EOS early exit would leave filled positions unscored).
+        alive = (jnp.logical_not(jnp.all(done))
+                 if use_eos and not use_logprobs else True)
         return (n < max_new_tokens) & alive
 
     def body(carry):
         (out, n, last, target_cache, draft_cache, done, rounds,
-         accepted, loop_rng) = carry
+         accepted, loop_rng, lp) = carry
         (loop_rng, r_draft, r_accept, r_resid,
          r_bonus) = jax.random.split(loop_rng, 5)
 
@@ -369,38 +406,58 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
             out = jax.lax.dynamic_update_slice(out, d, (0, start))
         out = jax.lax.dynamic_update_slice(out, nxt[:, None],
                                            (0, start + m))
+        if use_logprobs:
+            # Scores of the committed stream come free from the same
+            # verify logits: column j scores the token at offset j.
+            # Same optimistic-write pattern as `out` — the accepted
+            # prefix's committed tokens equal the proposals, so
+            # their scores stand; columns beyond m are overwritten
+            # by later rounds exactly like the tokens are.
+            lpc = token_lp(_logits_of(o), c)     # [B, k]
+            if k > 1:
+                lp = jax.lax.dynamic_update_slice(
+                    lp, lpc[:, :k - 1], (0, start))
+            lp = jax.lax.dynamic_update_slice(
+                lp, jax.lax.dynamic_index_in_dim(
+                    lpc, m, axis=1, keepdims=True), (0, start + m))
 
         # Rewind both caches to the invariant index: the position of
         # `nxt`, the newest committed-but-unkeyed token.
         target_cache = _rewind(u["cache"], start + m)
         draft_cache = _rewind(draft_cache, start + m)
         return (out, n + m + 1, nxt, target_cache, draft_cache,
-                done, rounds + 1, accepted + m, loop_rng)
+                done, rounds + 1, accepted + m, loop_rng, lp)
 
     if use_eos and use_active:
         # Inactive rows count as finished so the all-done early exit
         # keys off the REAL rows only.
         done = done | ~active
     zero = jnp.zeros((), jnp.int32)
-    (out, n, _, _, _, done, rounds, accepted, _) = jax.lax.while_loop(
+    (out, n, _, _, _, done, rounds, accepted, _,
+     lp) = jax.lax.while_loop(
         cond, body,
         (out, jnp.ones((), jnp.int32), first, target_cache,
-         draft_cache, done, zero, zero, rng))
+         draft_cache, done, zero, zero, rng, lp))
 
-    if use_eos:
+    if use_eos and not use_logprobs:
         # Early exit (every row finished): positions the loop never
         # reached are EOS by decode's keep-emitting contract. Only
         # done rows fill — identical to what further rounds would
-        # have committed, minus the model evaluations.
+        # have committed, minus the model evaluations. (With
+        # logprobs the loop ran to max_new_tokens — see cond — so
+        # every position already carries a real token and score.)
         pos = jnp.arange(total, dtype=jnp.int32)[None, :]
         fill = (pos >= p + n) & done[:, None]
         out = jnp.where(fill, eos_row[:, None], out)
 
     tokens = out[:, :p + max_new_tokens]
+    result = ((tokens, lp[:, :p + max_new_tokens]) if use_logprobs
+              else tokens)
     if return_stats:
-        return tokens, {"rounds": rounds, "accepted_drafts": accepted,
+        return result, {"rounds": rounds,
+                        "accepted_drafts": accepted,
                         "generated": n}
-    return tokens
+    return result
 
 
 def check_spec_models(model, draft_model):
@@ -447,7 +504,8 @@ def speculative_decode(model, params, draft_model, draft_params,
                        prompt, max_new_tokens, *, k=4,
                        temperature=0.0, rng=None,
                        prompt_len=None, eos_id=None,
-                       active_rows=None, return_stats=False):
+                       active_rows=None, return_logprobs=False,
+                       return_stats=False):
     """Decode of ``model`` accelerated by ``draft_model``.
 
     With ``temperature == 0`` (default) the output is tokens
@@ -483,6 +541,17 @@ def speculative_decode(model, params, draft_model, draft_params,
     EOS — with one speculative bonus: once EVERY row has finished,
     the loop exits early and the remaining positions fill with EOS
     directly (plain decode must scan to max_new_tokens regardless).
+
+    ``return_logprobs=True`` additionally returns a [B, P +
+    max_new_tokens] float32 of per-token log-probabilities under the
+    target's RAW logits (pre-temperature — decode's scoring
+    quantity), matching ``decode(..., return_logprobs=True)``:
+    position 0 scores 0.0, prompt positions score as teacher-forced
+    echo, generated positions score the committed token. The scores
+    come free from the verify logits — no extra model evaluation.
+    One behavioral difference: the EOS all-rows-done early exit is
+    disabled (every emitted position needs a real score, so the loop
+    runs to max_new_tokens exactly as plain decode does).
 
     ``active_rows`` ([B] bools, None = all active) marks rows whose
     output will be DISCARDED by the caller — a serving layer that
@@ -586,4 +655,4 @@ def speculative_decode(model, params, draft_model, draft_params,
                       jnp.asarray(prompt, jnp.int32), max_new_tokens,
                       k, return_stats, ragged, plen_arr, use_eos,
                       eos_arr, sample, jnp.asarray(t_host), rng,
-                      use_active, act_arr)
+                      use_active, act_arr, bool(return_logprobs))

@@ -433,11 +433,12 @@ class GenerationServer(_BaseServer):
         # model proposes, the target verifies — identical tokens
         # (greedy) or an identical output distribution (sampling,
         # via the rejection-sampling accept test), fewer weight
-        # streams. Only requests without filters/penalties/logprobs
-        # (no top_k/top_p/min_p, repetition_penalty 1.0) ride it —
+        # streams. Only requests without filters/penalties (no
+        # top_k/top_p/min_p, repetition_penalty 1.0) ride it —
         # greedy and sampling each get their own stable spec program
-        # per bucket; everything else takes the ordinary decode
-        # program.
+        # per bucket, and logprobs requests ride their own spec
+        # variant (the verify logits score committed tokens for
+        # free); everything else takes the ordinary decode program.
         self._spec_k = int(speculative_k)
         self._draft_model = draft_model
         self._draft_params = draft_params
@@ -605,15 +606,16 @@ class GenerationServer(_BaseServer):
                 "max_batch": self._max_batch}
 
     @staticmethod
-    def _default_knobs(top_k, want_lp, rep_pen, min_p, top_p):
+    def _default_knobs(top_k, rep_pen, min_p, top_p):
         """The speculative-eligible knob shape — no filters, no
-        penalty, no logprobs. ONE authority for both call sites:
-        request routing (scalars -> batcher ``plain`` key) and
-        _run's batch-level safety check (vectors). Keeping them in
-        sync matters: divergence either diverts default traffic onto
-        an unwarmed plain program (post-ready compile stall) or lets
-        a non-default row flip a spec batch."""
-        return (not top_k and not want_lp
+        penalty (logprobs ARE spec-eligible; they ride their own
+        batcher key and program variant). ONE authority for both
+        call sites: request routing (scalars -> batcher ``plain``
+        key) and _run's batch-level safety check (vectors). Keeping
+        them in sync matters: divergence either diverts default
+        traffic onto an unwarmed plain program (post-ready compile
+        stall) or lets a non-default row flip a spec batch."""
+        return (not top_k
                 and bool(np.all(np.asarray(rep_pen) == 1.0))
                 and bool(np.all(np.asarray(min_p) == 0.0))
                 and bool(np.all(np.asarray(top_p) == 1.0)))
@@ -647,8 +649,8 @@ class GenerationServer(_BaseServer):
             self._decode_calls += 1
             self._decode_rows += n
         if (self._spec_k and not force_plain
-                and self._default_knobs(top_k, want_lp, rep_pens,
-                                        min_ps, top_ps)
+                and self._default_knobs(top_k, rep_pens, min_ps,
+                                        top_ps)
                 and bucket + self._max_new + self._spec_k
                 <= min(self._model.max_seq_len,
                        self._draft_model.max_seq_len)):
@@ -669,9 +671,14 @@ class GenerationServer(_BaseServer):
                 self._max_new, k=self._spec_k, prompt_len=plens,
                 eos_id=eos_ids, temperature=temps,
                 rng=jax.random.PRNGKey(seed),
-                active_rows=np.arange(self._max_batch) < n)
+                active_rows=np.arange(self._max_batch) < n,
+                return_logprobs=want_lp)
             with self._stats_lock:
                 self._spec_calls += 1
+            if want_lp:
+                seq, lps = out
+                return list(zip(np.asarray(seq)[:n],
+                                np.asarray(lps)[:n]))
             return np.asarray(out)[:n]
         # fast_prefill=False keeps the per-bucket program set fixed
         # (warm=True precompiles exactly these programs; the
@@ -699,8 +706,9 @@ class GenerationServer(_BaseServer):
 
     def _batcher_for(self, bucket, sampling, top_k, want_lp=False,
                      plain=True):
-        # ``plain`` keys default-knob rows (no filters, no penalty,
-        # no logprobs — the speculative-eligible shape) apart from
+        # ``plain`` keys default-knob rows (no filters, no penalty —
+        # the speculative-eligible shape; logprobs are eligible and
+        # separated by the ``want_lp`` key component) apart from
         # rows carrying any non-default option, so a penalty/filter
         # row can never land in a default micro-batch and flip it off
         # the speculative program — the program choice is decided by
@@ -839,8 +847,7 @@ class GenerationServer(_BaseServer):
         padded[:, :p_len] = arr
         batcher = self._batcher_for(
             bucket, temperature > 0.0, top_k, want_lp,
-            plain=self._default_knobs(top_k, want_lp, rep_pen, min_p,
-                                      top_p))
+            plain=self._default_knobs(top_k, rep_pen, min_p, top_p))
         if batcher is None:
             return 503, {"error": "server is shutting down"}
         pending = batcher.submit_many(

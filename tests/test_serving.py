@@ -887,3 +887,51 @@ def test_generate_speculative_headroom_fallback():
         assert stats["speculative_calls"] == 0, stats
     finally:
         srv.stop()
+
+
+def test_generate_speculative_serves_logprobs():
+    """Default-knob logprobs requests ride the speculative program
+    (the verify logits score committed tokens for free) and return
+    exactly what the plain server returns for greedy."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    draft = TransformerLM(vocab_size=64, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=48,
+                          dtype=jnp.float32)
+    dparams = draft.init(jax.random.PRNGKey(2),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def make(**kw):
+        return GenerationServer("lm", model, params, port=0,
+                                max_new_tokens=8, max_batch=2,
+                                buckets=[8], **kw)
+
+    plain = make()
+    spec = make(draft_model=draft, draft_params=dparams,
+                speculative_k=4)
+    plain.start()
+    spec.start()
+    try:
+        payload = {"prompts": [[1, 2, 3]], "max_new_tokens": 6,
+                   "logprobs": True}
+        a = post(plain, "/v1/models/lm:generate", payload)
+        b = post(spec, "/v1/models/lm:generate", payload)
+        assert a["sequences"] == b["sequences"]
+        np.testing.assert_allclose(a["logprobs"], b["logprobs"],
+                                   atol=1e-4)
+        import urllib.request as _u
+        with _u.urlopen(f"http://localhost:{spec.port}/stats",
+                        timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["speculative_calls"] >= 1, stats
+    finally:
+        plain.stop()
+        spec.stop()

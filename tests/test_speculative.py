@@ -578,3 +578,74 @@ def test_spec_active_rows_validation():
     with pytest.raises(ValueError, match="at least one row"):
         speculative_decode(target, tp, draft, dp, prompt, 4,
                            active_rows=[False, False])
+
+
+# ---------------------------------------------------------------------
+# Logprobs under speculation
+# ---------------------------------------------------------------------
+
+
+def test_spec_logprobs_match_decode_greedy():
+    """Greedy + return_logprobs: tokens exactly equal decode's and
+    scores match decode's raw-logit log-softmax (the verify chunk
+    re-derives what decode computes stepwise), full-width and
+    ragged."""
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 8)
+    ws, wl = decode(target, tp, prompt, 12, return_logprobs=True)
+    gs, gl = speculative_decode(target, tp, draft, dp, prompt, 12,
+                                k=4, return_logprobs=True)
+    np.testing.assert_array_equal(np.asarray(gs), np.asarray(ws))
+    np.testing.assert_allclose(np.asarray(gl), np.asarray(wl),
+                               atol=1e-5)
+    plen = jnp.array([3, 8], jnp.int32)
+    ws2, wl2 = decode(target, tp, prompt, 12, prompt_len=plen,
+                      return_logprobs=True)
+    gs2, gl2 = speculative_decode(target, tp, draft, dp, prompt, 12,
+                                  k=3, prompt_len=plen,
+                                  return_logprobs=True)
+    np.testing.assert_array_equal(np.asarray(gs2), np.asarray(ws2))
+    np.testing.assert_allclose(np.asarray(gl2), np.asarray(wl2),
+                               atol=1e-5)
+
+
+def test_spec_logprobs_sampling_self_consistent():
+    """Sampling + return_logprobs: reported scores must equal the
+    target's own teacher-forced log-softmax of the emitted sequence
+    (raw logits, pre-temperature) — checkable exactly without any
+    distributional argument."""
+    target, tp = _make(vocab=16, seed=0)
+    draft, dp = _make(vocab=16, embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 6, vocab=16)
+    (seq, lps), st = speculative_decode(
+        target, tp, draft, dp, prompt, 10, k=3, temperature=0.9,
+        rng=jax.random.PRNGKey(5), return_logprobs=True,
+        return_stats=True)
+    logits = target.apply({"params": tp}, seq, train=False)
+    if isinstance(logits, tuple):
+        logits = logits[0]
+    lsm = np.asarray(jax.nn.log_softmax(
+        np.asarray(logits, np.float32), -1))
+    want = np.take_along_axis(
+        lsm[:, :-1], np.asarray(seq)[:, 1:, None], 2)[..., 0]
+    np.testing.assert_allclose(np.asarray(lps)[:, 1:], want,
+                               atol=1e-4)
+    assert float(np.asarray(lps)[0, 0]) == 0.0
+
+
+def test_spec_logprobs_with_eos_runs_to_max_new():
+    """EOS + logprobs: the early exit is disabled (every position
+    needs a real score); forced-EOS emissions score like decode's."""
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 8)
+    eos = int(np.asarray(decode(target, tp, prompt, 1))[0, -1])
+    ws, wl = decode(target, tp, prompt, 16, eos_id=eos,
+                    return_logprobs=True)
+    gs, gl = speculative_decode(target, tp, draft, dp, prompt, 16,
+                                k=4, eos_id=eos,
+                                return_logprobs=True)
+    np.testing.assert_array_equal(np.asarray(gs), np.asarray(ws))
+    np.testing.assert_allclose(np.asarray(gl), np.asarray(wl),
+                               atol=1e-5)
