@@ -63,11 +63,15 @@ num_experts / top_k): without drops a token's routing depends only
 on itself, so the width-k verify chunk scores tokens exactly as the
 single-token decode steps would — with drops, routing is
 token-group-shaped and the identity breaks, so droppy configs raise.
-Not supported (raise): sampling filters (top-k/top-p/min-p) and
-repetition penalty under speculation, sliding-window/ring caches
-(their prefill chunk write assumes offset 0). Reference repo has no
-counterpart (its serving demo is TF-Serving images, SURVEY.md
-section 2.3); this is framework-level capability the TPU stack adds.
+Sampling filters (top-k / top-p / min-p) compose with speculation:
+they transform p and q identically (rejection sampling is
+distribution-agnostic), so committed tokens follow the target's
+FILTERED distribution exactly. Not supported (raise): the
+repetition penalty under speculation (stateful over the committed
+prefix), sliding-window/ring caches (their prefill chunk write
+assumes offset 0). Reference repo has no counterpart (its serving
+demo is TF-Serving images, SURVEY.md section 2.3); this is
+framework-level capability the TPU stack adds.
 """
 
 import functools
@@ -76,7 +80,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import _logits_of, init_cache
+from .decode import (
+    _logits_of,
+    _mask_min_p,
+    _mask_top_k,
+    _mask_top_p,
+    init_cache,
+)
 
 
 def _rewind(cache, position):
@@ -97,11 +107,13 @@ def _rewind(cache, position):
     jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
                               "k", "return_stats", "ragged",
                               "use_eos", "sample", "use_active",
-                              "use_logprobs"))
+                              "use_logprobs", "top_k", "use_top_p",
+                              "use_min_p"))
 def _spec_impl(model, params, draft_model, draft_params, prompt,
                max_new_tokens, k, return_stats, ragged, prompt_len,
                use_eos, eos_id, sample, temperature, rng, use_active,
-               active, use_logprobs):
+               active, use_logprobs, top_k, use_top_p, top_p,
+               use_min_p, min_p):
     b, p = prompt.shape
     total = p + max_new_tokens + k  # slack for optimistic writes
     # Per-row EOS (-1 = never matches); decode's semantics: a row
@@ -111,12 +123,32 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
     # layer batches rows with different client temperatures).
     temp = jnp.reshape(jnp.asarray(temperature, jnp.float32), (-1, 1))
 
-    def dist(logits):
-        """Target/draft sampling distribution: softmax(logits/T) in
-        f32 — the EXACT quantity the accept ratio and residual are
-        defined over. [..., V] -> [..., V]."""
-        t = temp if logits.ndim == 2 else temp[:, :, None]
-        return jax.nn.softmax(logits.astype(jnp.float32) / t, axis=-1)
+    def filt(scaled, reps=1):
+        """Apply the sampling filters (top-k -> top-p -> min-p, same
+        order as decode.pick) to temperature-scaled logits. The
+        helpers are row-wise [R, V]; ``reps`` repeats the per-row
+        filter params when R = B * reps (verify chunks)."""
+        if top_k:
+            scaled = _mask_top_k(scaled, top_k)
+        if use_top_p:
+            scaled = _mask_top_p(scaled, jnp.repeat(top_p, reps))
+        if use_min_p:
+            scaled = _mask_min_p(scaled, jnp.repeat(min_p, reps))
+        return scaled
+
+    def scaled_filtered(logits, reps=1):
+        """Temperature-scaled, filtered logits in f32 — the thing
+        both proposal sampling (categorical) and dist() build on."""
+        t = jnp.repeat(temp, reps, axis=0)
+        return filt(logits.astype(jnp.float32) / t, reps)
+
+    def dist(logits, reps=1):
+        """Target/draft EFFECTIVE sampling distribution:
+        softmax(filtered(logits/T)) in f32 — the exact quantity the
+        accept ratio and residual are defined over. Rejection
+        sampling is distribution-agnostic, so filters just transform
+        both p and q identically. [R, V] -> [R, V]."""
+        return jax.nn.softmax(scaled_filtered(logits, reps), axis=-1)
 
     def token_lp(raw_logits, tok):
         """log P(tok) under the RAW logits — decode's scoring
@@ -156,7 +188,7 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
             logits = _logits_of(o)[:, 0]
             if sample:
                 sampled = jax.random.categorical(
-                    sub, logits.astype(jnp.float32) / temp,
+                    sub, scaled_filtered(logits),
                     axis=-1).astype(tok.dtype)
             else:
                 sampled = jnp.argmax(logits, axis=-1).astype(tok.dtype)
@@ -213,7 +245,7 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         if sample:
             rng, sub = jax.random.split(rng)
             first = jax.random.categorical(
-                sub, last_logits.astype(jnp.float32) / temp,
+                sub, scaled_filtered(last_logits),
                 axis=-1).astype(prompt.dtype)
         else:
             first = jnp.argmax(last_logits, axis=-1).astype(
@@ -271,11 +303,12 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
                 train=False, mutable=["cache"])
             logits = _logits_of(o)[:, 0]
             if sample:
-                # Sample straight from the scaled logits (identical
-                # distribution, no exp+log round trip); q itself is
-                # still materialized for the accept test/residual.
+                # Sample straight from the scaled, filtered logits
+                # (identical distribution, no exp+log round trip); q
+                # itself is still materialized for the accept test
+                # and residual.
                 nxt = jax.random.categorical(
-                    sub, logits.astype(jnp.float32) / temp,
+                    sub, scaled_filtered(logits),
                     axis=-1).astype(tok.dtype)
             else:
                 nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
@@ -320,7 +353,9 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
             # all k-1 accepted, the bonus column samples from p
             # directly. Each committed token is then exactly
             # target-distributed: p = q·min(1,p/q) + P(reject)·resid.
-            pd = dist(_logits_of(o))          # [B, k, V] f32
+            vl = _logits_of(o)
+            pd = dist(vl.reshape(b * k, vl.shape[-1]),
+                      reps=k).reshape(b, k, -1)   # [B, k, V] f32
             p_of_d = jnp.take_along_axis(
                 pd[:, :k - 1], d[..., None].astype(jnp.int32),
                 2)[..., 0]
@@ -502,7 +537,8 @@ def check_spec_models(model, draft_model):
 
 def speculative_decode(model, params, draft_model, draft_params,
                        prompt, max_new_tokens, *, k=4,
-                       temperature=0.0, rng=None,
+                       temperature=0.0, rng=None, top_k=0,
+                       top_p=None, min_p=None,
                        prompt_len=None, eos_id=None,
                        active_rows=None, return_logprobs=False,
                        return_stats=False):
@@ -516,6 +552,17 @@ def speculative_decode(model, params, draft_model, draft_params,
     is distributed exactly per the target's softmax(logits/T) — same
     output DISTRIBUTION as ``decode(..., temperature=T, rng=...)``,
     not the same token path (the two consume randomness differently).
+
+    Sampling filters compose: ``top_k`` (static int, 0 = off),
+    ``top_p`` (nucleus; scalar or [B], None = off) and ``min_p``
+    (scalar or [B], None = off) transform BOTH the target p and the
+    draft q identically — rejection sampling is
+    distribution-agnostic, so committed tokens follow the target's
+    FILTERED distribution exactly (what ``decode`` samples with the
+    same knobs). At temperature 0 they are ignored, exactly as
+    decode ignores them in its greedy branch. The repetition penalty
+    remains unsupported under speculation (it is stateful over the
+    committed prefix).
     ``rng`` defaults to PRNGKey(0) like decode; fixed rng => fully
     reproducible output. With ``return_stats=True`` also returns
     {"rounds", "accepted_drafts", "generated"} for acceptance-rate
@@ -564,12 +611,11 @@ def speculative_decode(model, params, draft_model, draft_params,
     active rows alone. At least one row must be active. Variant
     selection is type-driven (None vs given), like prompt_len/eos_id.
 
-    Requirements: no sampling filters (top-k/top-p/min-p) or
-    repetition penalty, no sliding window on either model, shared
-    vocab, and P + max_new_tokens + k within both models'
-    max_seq_len. Per-row temperatures must be all zero (greedy) or
-    all positive (sampling) — the two are different compiled
-    programs, same rule as ``decode``.
+    Requirements: no repetition penalty, no sliding window on either
+    model, shared vocab, and P + max_new_tokens + k within both
+    models' max_seq_len. Per-row temperatures must be all zero
+    (greedy) or all positive (sampling) — the two are different
+    compiled programs, same rule as ``decode``.
     """
     if max_new_tokens < 1:
         raise ValueError("speculative decode needs max_new_tokens >= 1")
@@ -639,6 +685,47 @@ def speculative_decode(model, params, draft_model, draft_params,
         eos_arr = jnp.asarray(eos_host)
     else:
         eos_arr = jnp.full((b,), -1, jnp.int32)
+    # Sampling filters: validated like decode's, with the same
+    # per-row vector support; variant selection is type-driven
+    # (None/0 = off) so serving batches stay on stable programs.
+    top_k = int(top_k)
+    if not 0 <= top_k <= model.vocab_size:
+        raise ValueError(
+            f"top_k must be in 0..{model.vocab_size}: {top_k}")
+    use_top_p = top_p is not None
+    if use_top_p:
+        tp_host = np.asarray(top_p, np.float32).reshape(-1)
+        if tp_host.shape[0] not in (1, b):
+            raise ValueError(
+                f"top_p must be a scalar or one entry per row "
+                f"({b}): got shape {tp_host.shape}")
+        tp_host = np.broadcast_to(tp_host, (b,))
+        if ((tp_host <= 0) | (tp_host > 1)).any():
+            raise ValueError(f"top_p entries must be in (0, 1]: "
+                             f"{tp_host}")
+        tp_arr = jnp.asarray(tp_host)
+    else:
+        tp_arr = jnp.ones((b,), jnp.float32)
+    use_min_p = min_p is not None
+    if use_min_p:
+        mp_host = np.asarray(min_p, np.float32).reshape(-1)
+        if mp_host.shape[0] not in (1, b):
+            raise ValueError(
+                f"min_p must be a scalar or one entry per row "
+                f"({b}): got shape {mp_host.shape}")
+        mp_host = np.broadcast_to(mp_host, (b,))
+        if ((mp_host < 0) | (mp_host >= 1)).any():
+            raise ValueError(f"min_p entries must be in [0, 1): "
+                             f"{mp_host}")
+        mp_arr = jnp.asarray(mp_host)
+    else:
+        mp_arr = jnp.zeros((b,), jnp.float32)
+    if not sample:
+        # Greedy ignores the filters, exactly like decode does (its
+        # pick() never applies them in the argmax branch) — the
+        # drop-in parity the docstring promises. The serving layer
+        # rejects filters at temperature 0 at the HTTP boundary.
+        top_k, use_top_p, use_min_p = 0, False, False
     use_active = active_rows is not None
     if use_active:
         act_host = np.asarray(active_rows, bool).reshape(-1)
@@ -655,4 +742,5 @@ def speculative_decode(model, params, draft_model, draft_params,
                       jnp.asarray(prompt, jnp.int32), max_new_tokens,
                       k, return_stats, ragged, plen_arr, use_eos,
                       eos_arr, sample, jnp.asarray(t_host), rng,
-                      use_active, act_arr, bool(return_logprobs))
+                      use_active, act_arr, bool(return_logprobs),
+                      top_k, use_top_p, tp_arr, use_min_p, mp_arr)

@@ -572,12 +572,18 @@ class GenerationServer(_BaseServer):
             for spec in self._warm_filters:
                 temp = float(spec.get("temperature", 1.0))
                 top_k = self._quantize_top_k(int(spec.get("top_k", 0)))
-                inst = (zeros, temp, b,
-                        float(spec.get("top_p", 1.0)), -1,
-                        float(spec.get("repetition_penalty", 1.0)),
-                        float(spec.get("min_p", 0.0)))
+                tp_f = float(spec.get("top_p", 1.0))
+                mp_f = float(spec.get("min_p", 0.0))
+                rp_f = float(spec.get("repetition_penalty", 1.0))
+                inst = (zeros, temp, b, tp_f, -1, rp_f, mp_f)
+                # Mirror request routing exactly: penalty rows warm
+                # the plain program, filter rows the FILTERED spec
+                # program — a mismatch here would warm a variant
+                # traffic never selects.
                 self._run([inst], temp, top_k=top_k,
-                          want_lp=bool(spec.get("logprobs", False)))
+                          want_lp=bool(spec.get("logprobs", False)),
+                          force_plain=not self._default_knobs(rp_f),
+                          filtered=self._filtered_knobs(tp_f, mp_f))
         self._ready.set()
         log.info("warm-up complete: %d bucket(s) x (2 + %d) "
                  "programs", len(self._buckets),
@@ -606,22 +612,32 @@ class GenerationServer(_BaseServer):
                 "max_batch": self._max_batch}
 
     @staticmethod
-    def _default_knobs(top_k, rep_pen, min_p, top_p):
-        """The speculative-eligible knob shape — no filters, no
-        penalty (logprobs ARE spec-eligible; they ride their own
-        batcher key and program variant). ONE authority for both
-        call sites: request routing (scalars -> batcher ``plain``
-        key) and _run's batch-level safety check (vectors). Keeping
-        them in sync matters: divergence either diverts default
-        traffic onto an unwarmed plain program (post-ready compile
-        stall) or lets a non-default row flip a spec batch."""
-        return (not top_k
-                and bool(np.all(np.asarray(rep_pen) == 1.0))
-                and bool(np.all(np.asarray(min_p) == 0.0))
-                and bool(np.all(np.asarray(top_p) == 1.0)))
+    def _default_knobs(rep_pen):
+        """The speculative-eligible knob shape — no repetition
+        penalty. Everything else rides speculation: logprobs and
+        top_k on their own batcher-key components/program variants,
+        top_p/min_p as per-row vectors inside the one spec-sampling
+        program (1.0/0.0 rows are exact no-ops in the mask helpers,
+        so mixed batches stay on one program). ONE authority for
+        both call sites: request routing (scalar -> batcher
+        ``plain`` key) and _run's batch-level safety check (vector).
+        Keeping them in sync matters: divergence either diverts
+        default traffic onto an unwarmed plain program (post-ready
+        compile stall) or lets a penalty row flip a spec batch."""
+        return bool(np.all(np.asarray(rep_pen) == 1.0))
+
+    @staticmethod
+    def _filtered_knobs(top_p, min_p):
+        """Whether a row (or warm spec) carries a stateless sampling
+        filter — the ``filtered`` batcher-key component. ONE
+        authority for request routing and warm-up: divergence would
+        warm a spec program variant live traffic never selects (and
+        vice versa), reintroducing the post-ready compile stall."""
+        return bool(np.any(np.asarray(top_p) < 1.0)
+                    or np.any(np.asarray(min_p) > 0.0))
 
     def _run(self, instances, pad_temp, top_k=0, want_lp=False,
-             force_plain=False):
+             force_plain=False, filtered=False):
         """Decode a micro-batch of (row, temperature, prompt_len,
         top_p, eos_id, rep_penalty) instances through the
         (max_batch, bucket) padded program."""
@@ -649,8 +665,7 @@ class GenerationServer(_BaseServer):
             self._decode_calls += 1
             self._decode_rows += n
         if (self._spec_k and not force_plain
-                and self._default_knobs(top_k, rep_pens, min_ps,
-                                        top_ps)
+                and self._default_knobs(rep_pens)
                 and bucket + self._max_new + self._spec_k
                 <= min(self._model.max_seq_len,
                        self._draft_model.max_seq_len)):
@@ -665,6 +680,19 @@ class GenerationServer(_BaseServer):
             # uniform acceptance — pad rows' draft/target
             # disagreement must not collapse speculation toward
             # plain decode (their output is sliced away below).
+            # Filtered sampling batchers always carry BOTH filter
+            # vectors (pad/no-op rows are exact no-ops in the mask
+            # helpers), so their one spec program is stable across
+            # top_p-only / min_p-only compositions; default batchers
+            # carry none and keep the mask-free program (no vocab
+            # sort on the hot path). Greedy batches carry none —
+            # client filters are rejected at temperature 0.
+            fkw = {}
+            if pad_temp:
+                fkw["top_k"] = top_k
+                if filtered:
+                    fkw["top_p"] = top_ps
+                    fkw["min_p"] = min_ps
             out = self._speculative(
                 self._model, self._params, self._draft_model,
                 self._draft_params, jnp.asarray(padded),
@@ -672,7 +700,7 @@ class GenerationServer(_BaseServer):
                 eos_id=eos_ids, temperature=temps,
                 rng=jax.random.PRNGKey(seed),
                 active_rows=np.arange(self._max_batch) < n,
-                return_logprobs=want_lp)
+                return_logprobs=want_lp, **fkw)
             with self._stats_lock:
                 self._spec_calls += 1
             if want_lp:
@@ -705,17 +733,20 @@ class GenerationServer(_BaseServer):
         return np.asarray(out)[:n]
 
     def _batcher_for(self, bucket, sampling, top_k, want_lp=False,
-                     plain=True):
-        # ``plain`` keys default-knob rows (no filters, no penalty —
-        # the speculative-eligible shape; logprobs are eligible and
-        # separated by the ``want_lp`` key component) apart from
-        # rows carrying any non-default option, so a penalty/filter
-        # row can never land in a default micro-batch and flip it off
-        # the speculative program — the program choice is decided by
-        # the batcher key, not by batch composition (ADVICE r3).
-        # Greedy and sampling stay separate via ``sampling``, so each
-        # plain batcher feeds one stable spec program per bucket.
-        key = (bucket, sampling, top_k, want_lp, plain)
+                     plain=True, filtered=False):
+        # ``plain`` keys penalty-free rows (the speculative-eligible
+        # shape) apart from penalty rows, and ``filtered`` keys
+        # top_p/min_p rows apart from default rows — so neither a
+        # penalty row nor a filter row can ever land in a default
+        # micro-batch and flip its compiled program: program choice
+        # is decided by the batcher key, not by batch composition
+        # (ADVICE r3). Default rows keep the sort-free programs
+        # (plain decode's use_top_p/use_min_p variants AND the
+        # mask-free speculative program); filtered batchers always
+        # carry both filter vectors so their spec program is stable
+        # across top_p-only/min_p-only compositions. Greedy and
+        # sampling stay separate via ``sampling``.
+        key = (bucket, sampling, top_k, want_lp, plain, filtered)
         with self._batchers_lock:
             if self._stopping:
                 return None
@@ -726,7 +757,7 @@ class GenerationServer(_BaseServer):
                         self._run,
                         pad_temp=1.0 if sampling else 0.0,
                         top_k=top_k, want_lp=want_lp,
-                        force_plain=not plain),
+                        force_plain=not plain, filtered=filtered),
                     self._max_batch, self._max_wait_ms,
                     admission=self._admission)
                 self._batchers[key] = batcher
@@ -847,7 +878,8 @@ class GenerationServer(_BaseServer):
         padded[:, :p_len] = arr
         batcher = self._batcher_for(
             bucket, temperature > 0.0, top_k, want_lp,
-            plain=self._default_knobs(top_k, rep_pen, min_p, top_p))
+            plain=self._default_knobs(rep_pen),
+            filtered=self._filtered_knobs(top_p, min_p))
         if batcher is None:
             return 503, {"error": "server is shutting down"}
         pending = batcher.submit_many(

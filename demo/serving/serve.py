@@ -167,11 +167,12 @@ def main(argv=None):
                         "subslice); XLA inserts the collectives. "
                         "1 = single-chip replica")
     p.add_argument("--speculative-k", type=int, default=0,
-                   help="N>0: default-knob requests (no filters/"
-                        "penalties/logprobs) decode speculatively — "
-                        "a draft model proposes N-1 tokens per "
-                        "verify round (greedy: identical output; "
-                        "sampling: identical output distribution via "
+                   help="N>0: penalty-free requests decode "
+                        "speculatively (greedy, sampling, top-k/"
+                        "top-p/min-p filters, logprobs) — a draft "
+                        "model proposes N-1 tokens per verify round "
+                        "(greedy: identical output; sampling: "
+                        "identical output distribution via "
                         "rejection-sampling, fewer weight streams); "
                         "needs headroom (bucket + max_new_tokens + N "
                         "<= max_seq_len), transformer model only")

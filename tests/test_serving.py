@@ -777,26 +777,27 @@ def test_generate_speculative_greedy_path():
                         timeout=10) as resp:
             stats = json.loads(resp.read())
         assert stats["speculative_calls"] >= 3, stats
-        # Default-knob SAMPLING also rides speculation (rejection-
-        # sampling program), while any non-default option — penalty,
-        # nucleus — falls back to plain decode in either mode.
-        out = post(spec, "/v1/models/lm:generate",
-                   {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
-                    "temperature": 0.9})
-        assert len(out["sequences"][0]) == 7
-        with _u.urlopen(f"http://localhost:{spec.port}/stats",
-                        timeout=10) as resp:
-            stats_s = json.loads(resp.read())
-        assert (stats_s["speculative_calls"]
-                == stats["speculative_calls"] + 1), stats_s
+        # SAMPLING rides speculation too — default knobs AND the
+        # stateless filters (top_p here; they transform p and q
+        # identically inside the spec program). Only the stateful
+        # repetition penalty falls back to plain decode.
         for payload in (
                 {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
-                 "repetition_penalty": 1.3},
+                 "temperature": 0.9},
                 {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
                  "temperature": 0.9, "top_p": 0.8},
         ):
             out = post(spec, "/v1/models/lm:generate", payload)
             assert len(out["sequences"][0]) == 7
+        with _u.urlopen(f"http://localhost:{spec.port}/stats",
+                        timeout=10) as resp:
+            stats_s = json.loads(resp.read())
+        assert (stats_s["speculative_calls"]
+                == stats["speculative_calls"] + 2), stats_s
+        out = post(spec, "/v1/models/lm:generate",
+                   {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                    "repetition_penalty": 1.3})
+        assert len(out["sequences"][0]) == 7
         with _u.urlopen(f"http://localhost:{spec.port}/stats",
                         timeout=10) as resp:
             stats2 = json.loads(resp.read())
@@ -935,3 +936,44 @@ def test_generate_speculative_serves_logprobs():
     finally:
         plain.stop()
         spec.stop()
+
+
+def test_generate_speculative_filtered_topk1_is_greedy():
+    """Filtered sampling rides speculation: with top_k=1 the filtered
+    distribution is a point mass, so the spec-sampling program must
+    reproduce plain greedy output exactly — an end-to-end proof the
+    filters reached the speculative path rather than being ignored."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    draft = TransformerLM(vocab_size=64, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=48,
+                          dtype=jnp.float32)
+    dparams = draft.init(jax.random.PRNGKey(2),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=2,
+                           buckets=[8], draft_model=draft,
+                           draft_params=dparams, speculative_k=4)
+    srv.start()
+    try:
+        greedy = post(srv, "/v1/models/lm:generate",
+                      {"prompts": [[1, 2, 3]], "max_new_tokens": 6})
+        topk1 = post(srv, "/v1/models/lm:generate",
+                     {"prompts": [[1, 2, 3]], "max_new_tokens": 6,
+                      "temperature": 1.0, "top_k": 1})
+        assert greedy["sequences"] == topk1["sequences"]
+        import urllib.request as _u
+        with _u.urlopen(f"http://localhost:{srv.port}/stats",
+                        timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["speculative_calls"] >= 2, stats
+    finally:
+        srv.stop()

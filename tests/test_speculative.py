@@ -649,3 +649,116 @@ def test_spec_logprobs_with_eos_runs_to_max_new():
     np.testing.assert_array_equal(np.asarray(gs), np.asarray(ws))
     np.testing.assert_allclose(np.asarray(gl), np.asarray(wl),
                                atol=1e-5)
+
+
+# ---------------------------------------------------------------------
+# Filtered sampling under speculation (top-k / top-p / min-p)
+# ---------------------------------------------------------------------
+
+
+def _filtered_marginals(model, params, prompt, temperature, top_p):
+    """Exact marginals of ancestral sampling from the FILTERED
+    distribution softmax(mask_top_p(logits/T)) — decode's own mask
+    helper is the authority, applied exactly as decode.pick does."""
+    from container_engine_accelerators_tpu.models.decode import (
+        _mask_top_p,
+    )
+
+    V = model.vocab_size
+
+    def probs(seqs):
+        logits = model.apply({"params": params}, jnp.asarray(seqs),
+                             train=False)
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        scaled = logits[:, -1].astype(jnp.float32) / temperature
+        masked = _mask_top_p(scaled, jnp.full((scaled.shape[0],),
+                                              top_p, jnp.float32))
+        return np.asarray(jax.nn.softmax(masked, -1))
+
+    p1 = probs(prompt)[0]
+    toks = np.arange(V, dtype=np.int32)
+    pre2 = np.concatenate([np.repeat(prompt, V, 0), toks[:, None]], 1)
+    cond2 = probs(pre2)
+    p2 = p1 @ cond2
+    pre3 = np.concatenate(
+        [np.repeat(prompt, V * V, 0),
+         np.repeat(toks, V)[:, None], np.tile(toks, V)[:, None]], 1)
+    cond3 = probs(pre3).reshape(V, V, V)
+    p3 = np.einsum("a,ab,abv->v", p1, cond2, cond3)
+    return p1, p2, p3
+
+
+def test_spec_filtered_sampling_matches_filtered_target():
+    """top-p speculation must produce tokens distributed exactly per
+    the target's NUCLEUS-FILTERED softmax — checked against exact
+    enumerated filtered marginals at the first three generated
+    positions. The filter bites hard (TV vs the unfiltered target
+    > 0.2) and the result is far from the draft's filtered
+    distribution, so neither 'filters ignored' nor 'draft leaked
+    through' can pass."""
+    V = 16
+    target, tp = _small(vocab=V, seed=0)
+    draft, dp = _small(vocab=V, embed=16, layers=1, heads=2, seed=99)
+    prompt = np.array([[1, 2, 3, 4]], np.int32)
+    T, TOP_P = 1.0, 0.7
+    f1, f2, f3 = _filtered_marginals(target, tp, prompt, T, TOP_P)
+    u1, u2, u3 = _marginals(target, tp, prompt, T)     # unfiltered
+    d1, d2, d3 = _filtered_marginals(draft, dp, prompt, T, TOP_P)
+    assert _tv(f2, u2) > 0.15 and _tv(f3, u3) > 0.1, (
+        _tv(f2, u2), _tv(f3, u3))
+    assert _tv(f2, d2) > 0.25 and _tv(f3, d3) > 0.25
+
+    B, seeds = 128, 32
+    batch = np.repeat(prompt, B, 0)
+    counts = np.zeros((3, V))
+    for s in range(seeds):
+        out = np.asarray(speculative_decode(
+            target, tp, draft, dp, batch, 3, k=4, temperature=T,
+            top_p=TOP_P, rng=jax.random.PRNGKey(3000 + s)))
+        gen = out[:, prompt.shape[1]:]
+        for j in range(3):
+            counts[j] += np.bincount(gen[:, j], minlength=V)
+    emp = counts / counts.sum(axis=1, keepdims=True)
+    for j, exact in enumerate((f1, f2, f3)):
+        assert _tv(emp[j], exact) < 0.08, (j, _tv(emp[j], exact))
+    assert _tv(emp[1], u2) > 0.1      # filters were NOT ignored
+    assert _tv(emp[1], d2) > 0.2      # and it's not the draft
+
+
+def test_spec_filtered_sampling_structure():
+    """Structural invariants for every filter kind: reproducibility,
+    filtered self-draft full acceptance (p' == q'), top_k=1 ==
+    greedy, validation."""
+    target, tp = _small(seed=0)
+    draft, dp = _small(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 6, vocab=16)
+    r = jax.random.PRNGKey(7)
+    for kw in ({"top_k": 4}, {"top_p": 0.8}, {"min_p": 0.1},
+               {"top_k": 8, "top_p": 0.9, "min_p": 0.05}):
+        a = speculative_decode(target, tp, draft, dp, prompt, 6, k=3,
+                               temperature=1.0, rng=r, **kw)
+        bb = speculative_decode(target, tp, draft, dp, prompt, 6,
+                                k=3, temperature=1.0, rng=r, **kw)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
+    want = decode(target, tp, prompt, 8)
+    got = speculative_decode(target, tp, draft, dp, prompt, 8, k=3,
+                             temperature=1.0, rng=r, top_k=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    out, st = speculative_decode(target, tp, target, tp, prompt, 9,
+                                 k=4, temperature=0.8, rng=r,
+                                 top_p=0.9, return_stats=True)
+    assert int(st["accepted_drafts"]) == 3 * int(st["rounds"]), st
+    # Greedy ignores filters, exactly like decode's argmax branch —
+    # drop-in parity for callers that pass knobs unconditionally.
+    got = speculative_decode(target, tp, draft, dp, prompt, 6, k=3,
+                             top_k=3, top_p=0.9)
+    np.testing.assert_array_equal(
+        np.asarray(got),
+        np.asarray(decode(target, tp, prompt, 6)))
+    with pytest.raises(ValueError, match="top_p"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           temperature=1.0, top_p=0.0)
+    with pytest.raises(ValueError, match="min_p"):
+        speculative_decode(target, tp, draft, dp, prompt, 4,
+                           temperature=1.0, min_p=1.0)
