@@ -49,6 +49,8 @@ from .mesh import (
 )
 from .pipeline import (
     build_pipeline_mesh,
+    circular_pipeline_apply,
+    circular_stage_order,
     pipeline_apply,
     stack_stage_params,
     stage_sharding,
@@ -70,6 +72,8 @@ __all__ = [
     "host_grid_mesh",
     "build_pipeline_mesh",
     "chips_from_env",
+    "circular_pipeline_apply",
+    "circular_stage_order",
     "dense_moe",
     "chunked_reference_attention",
     "dot_product_attention",

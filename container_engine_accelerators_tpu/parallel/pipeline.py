@@ -12,7 +12,7 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Pipeline parallelism: GPipe microbatching over an ICI axis.
+"""Pipeline parallelism: GPipe + circular interleaving over ICI.
 
 Stages live on consecutive devices along the "pipe" mesh axis, and
 activations advance one stage per tick via ``ppermute`` — each tick
@@ -36,6 +36,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -134,6 +135,142 @@ def pipeline_apply(mesh, stage_fn, params, x, *, num_microbatches,
             tick, (state0, out0), jnp.arange(m + p_size - 1))
         # Only the last stage holds real outputs; broadcast them so
         # the result is pipe-replicated as out_specs promises.
+        outputs = jax.lax.psum(
+            jnp.where(is_last, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs.reshape(x.shape)
+
+    return _pipeline(params, x)
+
+
+def circular_stage_order(n_stages, pipe):
+    """Placement-order permutation for ``circular_pipeline_apply``:
+    row d*v + r of the placement-ordered stack holds natural stage
+    r*pipe + d, so a P()-sharded leading axis gives device d exactly
+    its round-robin stages. Apply once at parameter-build time
+    (``tree_map(lambda w: w[order], params)``) and pass
+    ``pre_permuted=True`` to keep the per-step all-to-all out of the
+    train loop; gradients/optimizer state then live in placement
+    order too, which is self-consistent."""
+    if n_stages % pipe != 0:
+        raise ValueError(
+            f"{n_stages} stages do not fold onto pipe={pipe}")
+    v = n_stages // pipe
+    return np.asarray(
+        [r * pipe + d for d in range(pipe) for r in range(v)])
+
+
+def circular_pipeline_apply(mesh, stage_fn, params, x, *,
+                            num_microbatches,
+                            axis_name=PIPELINE_AXIS,
+                            batch_axis=DATA_AXIS,
+                            pre_permuted=False):
+    """Circular (interleaved) pipeline: S = v * P stages on P devices.
+
+    Megatron-style interleaved scheduling, SPMD-native: device d holds
+    the v non-adjacent stages {r*P + d : r < v} (round-robin
+    placement), activations advance one device per tick over a full
+    ring ``ppermute`` (the P-1 -> 0 wrap returns each microbatch for
+    its next lap), and every microbatch makes v laps. The bubble is
+    P - 1 fine-stage ticks, v times smaller than folding the same S
+    stages into P coarse GPipe stages ((P - 1) * v fine-stage ticks)
+    — the reason interleaving exists.
+
+    Same contract as ``pipeline_apply`` otherwise: ``stage_fn`` is
+    shape-preserving, ``params`` is the stacked [S, ...] pytree in
+    NATURAL stage order (the round-robin placement gather happens
+    internally; its transpose restores gradient order), x is [B, ...]
+    sharded over ``batch_axis``. S must be a multiple of the pipe
+    axis size; v == 1 degenerates to the GPipe schedule (with a ring
+    wrap nothing consumes).
+
+    The internal gather is a cross-shard shuffle of ~(v-1)/v of the
+    parameter bytes per call (plus its scatter transpose per backward)
+    when params are pipe-sharded in natural order. Train loops should
+    pre-permute ONCE with ``circular_stage_order`` and pass
+    ``pre_permuted=True``, which skips the gather entirely — weights,
+    gradients, and optimizer state then all live in placement order.
+
+    Schedule (device d, tick t, u = t - d): j = u mod P,
+    q = u // P, lap r = q mod v, group g = q // v, microbatch
+    m = g*P + j. Lap 0 on device 0 ingests microbatch m; every other
+    (d, r) consumes the ring input; device P-1 on lap v-1 retires
+    microbatch m. Injection groups of P microbatches chain seamlessly
+    (group g's first ingest lands exactly one tick after group g-1's
+    last lap leaves device 0), so total ticks = M*v + P - 1 when P
+    divides M, with each device busy M*v ticks; a partial tail group
+    idles its masked slots, growing the scan to
+    P*v*ceil(M/P) + (M-1) mod P.
+    """
+    p_size = mesh.shape[axis_name]
+    m = num_microbatches
+    n_stages = jax.tree_util.tree_leaves(params)[0].shape[0]
+    if n_stages % p_size != 0:
+        raise ValueError(
+            f"{n_stages} stacked stages do not fold onto {axis_name} "
+            f"axis size {p_size} (need a multiple)")
+    v = n_stages // p_size
+    if not pre_permuted:
+        # Round-robin placement as a gather: shard d of the
+        # P()-sharded leading axis is rows [d*v, (d+1)*v), so row
+        # d*v + r must hold stage r*P + d.
+        perm = jnp.asarray(circular_stage_order(n_stages, p_size))
+        params = jax.tree_util.tree_map(lambda w: w[perm], params)
+    x_spec = P(batch_axis)
+    w_spec = P(axis_name)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(w_spec, x_spec),
+        out_specs=x_spec, check_vma=False)
+    def _pipeline(params, x):
+        d = jax.lax.axis_index(axis_name)
+        is_first = (d == 0)
+        is_last = (d == p_size - 1)
+        b_local = x.shape[0]
+        if b_local % m != 0:
+            raise ValueError(
+                f"local batch {b_local} not divisible into "
+                f"{m} microbatches")
+        x_mb = x.reshape((m, b_local // m) + x.shape[1:])
+        ring = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            u = t - d
+            j = jnp.mod(u, p_size)
+            q = jnp.floor_divide(u, p_size)
+            r = jnp.mod(q, v)
+            mb = jnp.floor_divide(q, v) * p_size + j
+            # Bubble ticks (u < 0 head, m overrun tail) still run the
+            # stage on garbage — masking the retire, not the compute,
+            # keeps one compiled body, same as the GPipe schedule.
+            valid = (u >= 0) & (mb < m)
+            mb_c = jnp.clip(mb, 0, m - 1)
+            fresh = jax.lax.dynamic_index_in_dim(
+                x_mb, mb_c, 0, keepdims=False)
+            inp = jnp.where(is_first & (r == 0), fresh, state)
+            local = jax.tree_util.tree_map(
+                lambda w: jax.lax.dynamic_index_in_dim(
+                    w, r, 0, keepdims=False), params)
+            out = stage_fn(local, inp)
+            retire = valid & is_last & (r == v - 1)
+            cur = jax.lax.dynamic_index_in_dim(outputs, mb_c, 0,
+                                               keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(retire, out, cur), mb_c, 0)
+            state = jax.lax.ppermute(out, axis_name, ring)
+            return (state, outputs), None
+
+        state0 = jnp.zeros_like(x_mb[0])
+        out0 = jnp.zeros_like(x_mb)
+        # Last microbatch M-1 starts its last lap at device 0 on tick
+        # ((M-1)//P)*P*v + (v-1)*P + (M-1)%P and retires P-1 ticks
+        # later; a partial tail group still occupies its full P-slot
+        # injection window, so this exceeds M*v + P - 1 (the exact
+        # count when P | M) by the masked slots.
+        ticks = p_size * v * ((m - 1) // p_size + 1) + (m - 1) % p_size
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, out0), jnp.arange(ticks))
         outputs = jax.lax.psum(
             jnp.where(is_last, outputs, jnp.zeros_like(outputs)),
             axis_name)

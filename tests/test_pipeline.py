@@ -27,6 +27,8 @@ import pytest
 
 from container_engine_accelerators_tpu.parallel import (
     build_pipeline_mesh,
+    circular_pipeline_apply,
+    circular_stage_order,
     pipeline_apply,
     stack_stage_params,
     stage_sharding,
@@ -133,3 +135,110 @@ def test_microbatch_divisibility_error():
     x = jnp.zeros((6, D))  # 3 per data shard, not divisible by 2
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_apply(mesh, stage_fn, params, x, num_microbatches=2)
+
+
+@pytest.mark.parametrize("stages,pipe,data,microbatches", [
+    (8, 4, 2, 4),    # v=2, M == P: one injection group
+    (8, 2, 4, 8),    # v=4, M = 4P: chained injection groups
+    (2, 2, 4, 4),    # v=1: degenerates to the GPipe schedule
+    (12, 4, 1, 5),   # v=3, M % P != 0: masked-tail injection group
+])
+def test_circular_pipeline_matches_sequential(stages, pipe, data,
+                                              microbatches):
+    mesh = build_pipeline_mesh(pipe, data=data)
+    params = make_params(stages, jax.random.PRNGKey(8))
+    x = jax.random.normal(jax.random.PRNGKey(9),
+                          (data * microbatches * 2, D))
+    want = sequential_apply(params, x)
+    got = circular_pipeline_apply(mesh, stage_fn, params, x,
+                                  num_microbatches=microbatches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_circular_pipeline_grads_match_sequential():
+    stages, pipe, microbatches = 8, 4, 4
+    mesh = build_pipeline_mesh(pipe, data=2)
+    params = make_params(stages, jax.random.PRNGKey(10))
+    x = jax.random.normal(jax.random.PRNGKey(11), (8, D))
+
+    def pipe_loss(params):
+        return jnp.mean(circular_pipeline_apply(
+            mesh, stage_fn, params, x,
+            num_microbatches=microbatches) ** 2)
+
+    def seq_loss(params):
+        return jnp.mean(sequential_apply(params, x) ** 2)
+
+    got = jax.grad(pipe_loss)(params)
+    want = jax.grad(seq_loss)(params)
+    jax.tree_util.tree_map(
+        lambda g, w: np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=1e-5, atol=1e-6),
+        got, want)
+
+
+def test_circular_placement_order_matches_natural():
+    """pre_permuted=True on a circular_stage_order-permuted stack is
+    exactly the natural-order apply — the train-loop layout that
+    keeps the per-step placement all-to-all out of the step."""
+    stages, pipe, microbatches = 8, 4, 4
+    mesh = build_pipeline_mesh(pipe, data=2)
+    params = make_params(stages, jax.random.PRNGKey(16))
+    x = jax.random.normal(jax.random.PRNGKey(17), (16, D))
+    order = circular_stage_order(stages, pipe)
+    placed = jax.tree_util.tree_map(lambda w: w[order], params)
+    want = circular_pipeline_apply(mesh, stage_fn, params, x,
+                                   num_microbatches=microbatches)
+    got = circular_pipeline_apply(mesh, stage_fn, placed, x,
+                                  num_microbatches=microbatches,
+                                  pre_permuted=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_circular_pipeline_jitted_train_step():
+    """Interleaved schedule inside a jitted SGD step with the stacked
+    stages sharded over the pipe axis in PLACEMENT order (the layout
+    that keeps the placement all-to-all out of the step; grads and
+    updates stay in placement order, which is self-consistent)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    stages, pipe, microbatches = 8, 4, 2
+    mesh = build_pipeline_mesh(pipe, data=2)
+    params = make_params(stages, jax.random.PRNGKey(12))
+    order = circular_stage_order(stages, pipe)
+    params = jax.tree_util.tree_map(lambda w: w[order], params)
+    params = jax.device_put(params, stage_sharding(mesh, params))
+    b_shard = NamedSharding(mesh, P("data"))
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(13), (8, D)), b_shard)
+    y = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(14), (8, D)), b_shard)
+
+    @jax.jit
+    def train_step(params, x, y):
+        def loss_fn(params):
+            out = circular_pipeline_apply(
+                mesh, stage_fn, params, x,
+                num_microbatches=microbatches, pre_permuted=True)
+            return jnp.mean((out - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 0.1 * g, params, grads)
+        return params, loss
+
+    params, loss0 = train_step(params, x, y)
+    for _ in range(5):
+        params, loss = train_step(params, x, y)
+    assert float(loss) < float(loss0)
+
+
+def test_circular_stage_count_error():
+    mesh = build_pipeline_mesh(4, data=2)
+    params = make_params(6, jax.random.PRNGKey(15))  # 6 % 4 != 0
+    x = jnp.zeros((8, D))
+    with pytest.raises(ValueError, match="multiple"):
+        circular_pipeline_apply(mesh, stage_fn, params, x,
+                                num_microbatches=2)
