@@ -36,24 +36,61 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def init_cache(model, batch, length):
-    """Size the KV cache: a decode-mode init at full length creates
-    per-layer [B, length, H, D] cache buffers plus step counters.
-
-    Any training mesh on the model is dropped: a mesh-bound MoE
-    model would route its [B*1] decode token group through the
-    expert shard_map and hit a divisibility error, and the residual
-    sharding pins are pointless for single-chip decode. The params
-    are mesh-agnostic, so the dense dispatch path is always valid.
-    """
+def _decode_clone(model):
+    """The decode-mode module for ``model``, with any training mesh
+    dropped: a mesh-bound MoE model would route its [B*1] decode
+    token group through the expert shard_map and hit a divisibility
+    error, and the residual sharding pins are pointless for
+    single-chip decode. The params are mesh-agnostic, so the dense
+    dispatch path is always valid."""
     clone_kwargs = {"decode": True}
     if getattr(model, "mesh", None) is not None:
         clone_kwargs["mesh"] = None
-    decode_model = model.clone(**clone_kwargs)
+    return model.clone(**clone_kwargs)
+
+
+def init_cache(model, batch, length):
+    """Size the KV cache: a decode-mode init at full length creates
+    per-layer [B, length, H, D] cache buffers plus step counters."""
+    decode_model = _decode_clone(model)
     variables = decode_model.init(
         jax.random.PRNGKey(0), jnp.zeros((batch, length), jnp.int32),
         train=False)
     return decode_model, variables["cache"]
+
+
+def _sampling_flags(temperature, top_k, top_p, min_p):
+    """Host-side validation shared by every sampling entry point.
+    Returns (sample, top_k, use_top_p, use_min_p)."""
+    t_host = np.asarray(temperature, np.float32)
+    if (t_host < 0.0).any():
+        # Scalar and vector alike: silently greedy-ing a negative
+        # scalar would mask a caller's sign bug.
+        raise ValueError(f"temperature must be >= 0: {temperature}")
+    if t_host.ndim == 0:
+        sample = bool(t_host > 0.0)
+    elif (t_host > 0.0).all():
+        sample = True
+    elif (t_host == 0.0).all():
+        sample = False
+    else:
+        raise ValueError(
+            "per-row temperatures must be all zero (greedy) or all "
+            "positive (sampling); greedy and sampling rows compile "
+            "to different programs")
+    top_k = int(top_k)
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0: {top_k}")
+    p_host = np.asarray(top_p, np.float32)
+    if (p_host <= 0.0).any() or (p_host > 1.0).any():
+        raise ValueError("top_p entries must be in (0, 1]")
+    mp_host = np.asarray(min_p, np.float32)
+    if (mp_host < 0.0).any() or (mp_host >= 1.0).any():
+        raise ValueError("min_p entries must be in [0, 1)")
+    # The == 1.0 / == 0.0 everywhere cases are identities; skipping
+    # them costs nothing and compiles no variant.
+    return (sample, top_k, bool((p_host < 1.0).any()),
+            bool((mp_host > 0.0).any()))
 
 
 def _logits_of(outputs):
@@ -103,6 +140,53 @@ def _mask_min_p(logits, min_p):
     return jnp.where(logp < cutoff, -jnp.inf, logits)
 
 
+def _pick_token(logits, rng, temperature, top_p, min_p, *, sample,
+                top_k, use_top_p, use_min_p, out_dtype):
+    """The one sampling chain every decode path shares: temperature
+    scale, then top_k -> top_p -> min_p masks, then categorical (or
+    argmax when greedy). Returns (token, advanced rng)."""
+    if sample:
+        rng, sub = jax.random.split(rng)
+        # temperature is a traced scalar or a [B] vector (one entry
+        # per row — cross-request batching in the serving layer
+        # shares one compiled program across client temps).
+        temp = jnp.reshape(jnp.asarray(temperature, jnp.float32),
+                           (-1, 1))
+        logits = logits / temp
+        if top_k:
+            logits = _mask_top_k(logits, top_k)
+        if use_top_p:
+            logits = _mask_top_p(logits, top_p)
+        if use_min_p:
+            logits = _mask_min_p(logits, min_p)
+        chosen = jax.random.categorical(sub, logits, axis=-1)
+    else:
+        chosen = jnp.argmax(logits, axis=-1)
+    return chosen.astype(out_dtype), rng
+
+
+def _advance_token(sampled, padded, t, total, prompt_len, done,
+                   eos_row, out_dtype):
+    """Prompt takeover + EOS freeze, shared by every decode scan.
+
+    While still inside the prompt the model's prediction is discarded
+    and the actual prompt token is fed (prefill); prompt_len is
+    TRACED (scalar or [B] per-row vector), so one compiled program
+    serves every true prompt length padded into a shape bucket. A row
+    whose GENERATED text reached its EOS keeps emitting it (rows stay
+    static-shaped; the caller trims at the first EOS) — prompt-
+    resident EOS ids don't trigger. Returns (next_token, done).
+    """
+    forced = jax.lax.dynamic_index_in_dim(
+        padded, jnp.minimum(t + 1, total - 1), 1, keepdims=False)
+    in_prompt = t + 1 < jnp.reshape(prompt_len, (-1,))
+    nxt = jnp.where(in_prompt, forced, sampled)
+    if eos_row is not None:
+        nxt = jnp.where(done, eos_row.astype(out_dtype), nxt)
+        done = done | (~in_prompt & (nxt == eos_row))
+    return nxt, done
+
+
 def _mask_top_p(logits, top_p):
     """Nucleus mask: keep the smallest prefix of the probability-
     sorted vocab whose mass reaches top_p. top_p is a traced scalar
@@ -147,24 +231,10 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             # On raw logits, before temperature/filters (CTRL).
             logits = _apply_repetition_penalty(logits, seen,
                                                rep_penalty)
-        if sample:
-            rng, sub = jax.random.split(rng)
-            # temperature is a traced scalar or a [B] vector (one
-            # entry per row — cross-request batching in the serving
-            # layer shares one compiled program across client temps).
-            temp = jnp.reshape(jnp.asarray(temperature, jnp.float32),
-                               (-1, 1))
-            logits = logits / temp
-            if top_k:
-                logits = _mask_top_k(logits, top_k)
-            if use_top_p:
-                logits = _mask_top_p(logits, top_p)
-            if use_min_p:
-                logits = _mask_min_p(logits, min_p)
-            chosen = jax.random.categorical(sub, logits, axis=-1)
-        else:
-            chosen = jnp.argmax(logits, axis=-1)
-        return chosen.astype(prompt.dtype), rng
+        return _pick_token(logits, rng, temperature, top_p, min_p,
+                           sample=sample, top_k=top_k,
+                           use_top_p=use_top_p, use_min_p=use_min_p,
+                           out_dtype=prompt.dtype)
 
     def token_logprob(raw_logits, tok):
         """Model log-probability of ``tok`` under the RAW logits
@@ -180,23 +250,9 @@ def _decode_impl(model, params, prompt, max_new_tokens, temperature,
             train=False, mutable=["cache"])
         raw = _logits_of(outputs)[:, 0]
         sampled, rng = pick(raw, rng, seen)
-        # While still inside the prompt, the model's prediction is
-        # discarded and the actual prompt token is fed (prefill).
-        # prompt_len is TRACED (scalar or [B] per-row vector), so one
-        # compiled program serves every true prompt length padded
-        # into this shape bucket — and a cross-request batch may mix
-        # rows of different true lengths.
-        forced = jax.lax.dynamic_index_in_dim(
-            padded, jnp.minimum(t + 1, total - 1), 1, keepdims=False)
-        in_prompt = t + 1 < jnp.reshape(prompt_len, (-1,))
-        nxt = jnp.where(in_prompt, forced, sampled)
-        if use_eos:
-            # A row whose GENERATED text reached its EOS keeps
-            # emitting it (rows stay static-shaped; the caller trims
-            # at the first EOS). Prompt-resident EOS ids don't
-            # trigger.
-            nxt = jnp.where(done, eos_row.astype(prompt.dtype), nxt)
-            done = done | (~in_prompt & (nxt == eos_row))
+        nxt, done = _advance_token(
+            sampled, padded, t, total, prompt_len, done,
+            eos_row if use_eos else None, prompt.dtype)
         y = ((nxt, token_logprob(raw, nxt)) if use_logprobs else nxt)
         return (updated["cache"], nxt, rng, done,
                 mark_seen(seen, nxt)), y
@@ -335,31 +391,8 @@ def decode(model, params, prompt, max_new_tokens, *,
         raise ValueError(
             "fast_prefill=True requires every row's prompt_len to "
             "equal the prompt width (no right-padding)")
-    t_host = np.asarray(temperature, np.float32)
-    if t_host.ndim == 0:
-        sample = bool(t_host > 0.0)
-    elif (t_host > 0.0).all():
-        sample = True
-    elif (t_host == 0.0).all():
-        sample = False
-    else:
-        raise ValueError(
-            "per-row temperatures must be all zero (greedy) or all "
-            "positive (sampling); greedy and sampling rows compile "
-            "to different programs")
-    top_k = int(top_k)
-    if top_k < 0:
-        raise ValueError(f"top_k must be >= 0: {top_k}")
-    p_host = np.asarray(top_p, np.float32)
-    if (p_host <= 0.0).any() or (p_host > 1.0).any():
-        raise ValueError("top_p entries must be in (0, 1]")
-    # top_p == 1.0 everywhere is the identity; skip the mask so the
-    # common no-nucleus case costs nothing and compiles no variant.
-    use_top_p = bool((p_host < 1.0).any())
-    mp_host = np.asarray(min_p, np.float32)
-    if (mp_host < 0.0).any() or (mp_host >= 1.0).any():
-        raise ValueError("min_p entries must be in [0, 1)")
-    use_min_p = bool((mp_host > 0.0).any())
+    sample, top_k, use_top_p, use_min_p = _sampling_flags(
+        temperature, top_k, top_p, min_p)
     use_eos = eos_id is not None
     rp_host = np.asarray(repetition_penalty, np.float32)
     if (rp_host <= 0.0).any():
@@ -385,6 +418,164 @@ def decode(model, params, prompt, max_new_tokens, *,
 def greedy_decode(model, params, prompt, max_new_tokens):
     """Greedy generation (temperature 0)."""
     return decode(model, params, prompt, max_new_tokens)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("model", "max_total_len"))
+def _prefill_prefix_impl(model, params, prefix, max_total_len):
+    b, _ = prefix.shape
+    decode_model, cache = init_cache(model, b, max_total_len)
+    _, updated = decode_model.apply(
+        {"params": params, "cache": cache}, prefix,
+        train=False, mutable=["cache"])
+    return updated["cache"]
+
+
+def prefill_prefix(model, params, prefix, *, max_total_len):
+    """Prefill a shared prefix ONCE; fan the result out to many
+    continuations with ``decode_with_prefix``.
+
+    Serving systems front most traffic with a common system prompt;
+    re-running its prefill per request wastes exactly the FLOPs and
+    HBM traffic that dominate time-to-first-token. This runs the
+    prefix through the model as ONE forward pass into a KV cache
+    sized for ``max_total_len`` (prefix + the longest
+    suffix + max_new_tokens it will serve) and returns an opaque
+    state that ``decode_with_prefix`` broadcasts across request
+    batches. The
+    one-shot prefill rides the same chunked flash path as
+    fast_prefill, so long prefixes stay O(P * block) in score memory.
+
+    ``prefix``: [Bp, P] int32, full-width (no padding — a shared
+    prefix has one true length).
+    """
+    if prefix.shape[1] >= max_total_len:
+        raise ValueError(
+            f"max_total_len {max_total_len} leaves no room after the "
+            f"{prefix.shape[1]}-token prefix")
+    cache = _prefill_prefix_impl(model, params,
+                                 jnp.asarray(prefix, jnp.int32),
+                                 int(max_total_len))
+    # max_total_len travels in the state because the cache length dim
+    # cannot stand in for it: a sliding-window model's ring cache is
+    # only min(max_total_len, window) long yet serves longer totals.
+    return cache, prefix.shape[1], int(max_total_len)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("model", "max_new_tokens",
+                                    "fan_out", "sample", "top_k",
+                                    "use_top_p", "use_min_p",
+                                    "use_eos"))
+def _decode_with_prefix_impl(model, params, cache, prompt,
+                             max_new_tokens, temperature, rng,
+                             prompt_len, top_p, min_p, eos_id, *,
+                             fan_out, sample, top_k, use_top_p,
+                             use_min_p, use_eos):
+    b, p_pad = prompt.shape
+    total_s = p_pad + max_new_tokens
+    # The cache already counted the prefix; the clone only rebuilds
+    # the module (init_cache's sizing init is skipped — its cache is
+    # replaced by the prefilled one).
+    decode_model = _decode_clone(model)
+    if fan_out > 1:
+        # [Bp, ...] cache rows -> [Bp*fan_out, ...]: request row
+        # bp*fan_out + j continues prefix row bp. Scalar counters
+        # (pos_index/cache_index) are shared.
+        cache = jax.tree_util.tree_map(
+            lambda a: (jnp.repeat(a, fan_out, axis=0)
+                       if a.ndim and a.shape[0] * fan_out == b else a),
+            cache)
+    padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+    eos_row = jnp.reshape(eos_id, (-1,)) if use_eos else None
+
+    def pick(logits, rng):
+        return _pick_token(logits, rng, temperature, top_p, min_p,
+                           sample=sample, top_k=top_k,
+                           use_top_p=use_top_p, use_min_p=use_min_p,
+                           out_dtype=prompt.dtype)
+
+    def step(carry, t):
+        cache, tok, rng, done = carry
+        outputs, updated = decode_model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            train=False, mutable=["cache"])
+        sampled, rng = pick(_logits_of(outputs)[:, 0], rng)
+        nxt, done = _advance_token(
+            sampled, padded, t, total_s, prompt_len, done,
+            eos_row if use_eos else None, prompt.dtype)
+        return (updated["cache"], nxt, rng, done), nxt
+
+    (_, _, _, _), produced = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng, jnp.zeros((b,), bool)),
+        jnp.arange(total_s - 1))
+    return jnp.concatenate([prompt[:, :1], produced.T], axis=1)
+
+
+def decode_with_prefix(model, params, prefix_state, prompt,
+                       max_new_tokens, *, temperature=0.0, rng=None,
+                       prompt_len=None, top_k=0, top_p=1.0,
+                       min_p=0.0, eos_id=None):
+    """Continue generation from a ``prefill_prefix`` state.
+
+    ``prompt`` ([B, P] int32) holds each request's own tokens (the
+    part AFTER the shared prefix); B must be a multiple of the
+    prefix batch, and request row i continues prefix row
+    i // (B / Bp). Returns the [B, P + max_new_tokens] suffix
+    sequences (prefix tokens not re-emitted). Greedy output is
+    token-for-token identical to running ``decode`` on the
+    concatenated (prefix + prompt) rows — pinned by tests — while
+    paying the prefix prefill once per prefix instead of once per
+    request. Knobs match ``decode`` (temperature/top_k/top_p/min_p/
+    eos_id, per-row or scalar); repetition_penalty and logprobs are
+    not supported on this path (they need prefix-token visibility —
+    use ``decode``).
+
+    The caller owns lifetime: the state is an ordinary pytree (donate
+    or drop it to free HBM). One compiled program per
+    (fan-out, shape) pair.
+
+    The suffix itself prefills STEPWISE (one scan step per token):
+    right for the short per-request prompts behind a long shared
+    prefix this path exists for. A suffix long enough to dominate
+    should ride ``decode(fast_prefill=True)`` instead (one chunked
+    forward), trading away the prefix reuse.
+    """
+    cache, prefix_len, max_total_len = prefix_state
+    # Cache leaves mix KV buffers ([B, L, H, D]) with scalar step
+    # counters; the batch comes from a buffer leaf. (Capacity comes
+    # from the state, NOT the buffer length: a sliding-window ring
+    # cache is shorter than the total it serves.)
+    kv = next(leaf for leaf in jax.tree_util.tree_leaves(cache)
+              if leaf.ndim >= 2)
+    prefix_b = kv.shape[0]
+    b = prompt.shape[0]
+    if b % prefix_b != 0:
+        raise ValueError(
+            f"request batch {b} is not a multiple of the prefix "
+            f"batch {prefix_b}")
+    need = prefix_len + prompt.shape[1] + max_new_tokens
+    if need > max_total_len:
+        raise ValueError(
+            f"prefix state sized for {max_total_len} total tokens; "
+            f"prefix {prefix_len} + prompt {prompt.shape[1]} + "
+            f"max_new_tokens {max_new_tokens} = {need} overflows it")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if prompt_len is None:
+        prompt_len = prompt.shape[1]
+    sample, top_k, use_top_p, use_min_p = _sampling_flags(
+        temperature, top_k, top_p, min_p)
+    use_eos = eos_id is not None
+    return _decode_with_prefix_impl(
+        model, params, cache, jnp.asarray(prompt, jnp.int32),
+        max_new_tokens, jnp.asarray(temperature, jnp.float32), rng,
+        jnp.asarray(prompt_len, jnp.int32),
+        jnp.asarray(top_p, jnp.float32),
+        jnp.asarray(min_p, jnp.float32),
+        jnp.asarray(eos_id if use_eos else -1, jnp.int32),
+        fan_out=b // prefix_b, sample=sample, top_k=top_k,
+        use_top_p=use_top_p, use_min_p=use_min_p, use_eos=use_eos)
 
 
 @functools.partial(jax.jit,

@@ -655,3 +655,162 @@ def test_mask_min_p_zero_row_exact_in_mixed_batch():
     # Row 1 (min_p=0.0): exact no-op, even for p ~ e^-200 < 1e-38.
     np.testing.assert_array_equal(np.asarray(out)[1],
                                   np.asarray(logits)[1])
+
+
+def test_prefix_cache_greedy_equals_full_decode(dense_lm):
+    """decode_with_prefix on a shared prefix is token-for-token the
+    full decode of (prefix + suffix) — the prefill-once fan-out path
+    changes where FLOPs are spent, never what is generated."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model, params, _ = dense_lm
+    prefix = jax.random.randint(jax.random.PRNGKey(20), (1, 6), 0, V)
+    suffixes = jax.random.randint(jax.random.PRNGKey(21), (3, 4), 0, V)
+    state = prefill_prefix(model, params, prefix,
+                           max_total_len=6 + 4 + N)
+    got = decode_with_prefix(model, params, state, suffixes, N)
+    assert got.shape == (3, 4 + N)
+    full = decode(
+        model, params,
+        jnp.concatenate([jnp.broadcast_to(prefix, (3, 6)), suffixes],
+                        axis=1), N)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(full)[:, 6:])
+
+
+def test_prefix_cache_multi_row_prefix_fan_out(dense_lm):
+    """A [2]-row prefix fans out to 4 request rows: row i continues
+    prefix row i // 2."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model, params, _ = dense_lm
+    prefix = jax.random.randint(jax.random.PRNGKey(22), (2, 5), 0, V)
+    suffixes = jax.random.randint(jax.random.PRNGKey(23), (4, 3), 0, V)
+    state = prefill_prefix(model, params, prefix,
+                           max_total_len=5 + 3 + N)
+    got = decode_with_prefix(model, params, state, suffixes, N)
+    expanded = jnp.repeat(prefix, 2, axis=0)
+    full = decode(model, params,
+                  jnp.concatenate([expanded, suffixes], axis=1), N)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(full)[:, 5:])
+
+
+def test_prefix_cache_eos_and_ragged_suffix(dense_lm):
+    """EOS freezing and per-row ragged suffix lengths compose with
+    the prefix path exactly as with full decode."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model, params, _ = dense_lm
+    prefix = jax.random.randint(jax.random.PRNGKey(24), (1, 4), 0, V)
+    suffixes = jax.random.randint(jax.random.PRNGKey(25), (2, 4), 0, V)
+    p_len = jnp.array([3, 4], jnp.int32)
+    eos = 7
+    state = prefill_prefix(model, params, prefix,
+                           max_total_len=4 + 4 + N)
+    got = decode_with_prefix(model, params, state, suffixes, N,
+                             prompt_len=p_len, eos_id=eos)
+    full = decode(
+        model, params,
+        jnp.concatenate([jnp.broadcast_to(prefix, (2, 4)), suffixes],
+                        axis=1), N, prompt_len=4 + p_len, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(full)[:, 4:])
+
+
+def test_prefix_cache_sampling_stays_in_vocab_and_t0_limit(dense_lm):
+    """Sampling through the prefix path: tokens stay in-vocab, and
+    top_k=1 (support of one) reproduces greedy regardless of rng."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model, params, _ = dense_lm
+    prefix = jax.random.randint(jax.random.PRNGKey(26), (1, 5), 0, V)
+    suffixes = jax.random.randint(jax.random.PRNGKey(27), (2, 3), 0, V)
+    state = prefill_prefix(model, params, prefix,
+                           max_total_len=5 + 3 + N)
+    sampled = decode_with_prefix(model, params, state, suffixes, N,
+                                 temperature=0.9,
+                                 rng=jax.random.PRNGKey(28))
+    assert ((np.asarray(sampled) >= 0)
+            & (np.asarray(sampled) < V)).all()
+    k1 = decode_with_prefix(model, params, state, suffixes, N,
+                            temperature=0.7, top_k=1,
+                            rng=jax.random.PRNGKey(29))
+    greedy = decode_with_prefix(model, params, state, suffixes, N)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+
+def test_prefix_cache_validation(dense_lm):
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model, params, _ = dense_lm
+    prefix = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="no room"):
+        prefill_prefix(model, params, prefix, max_total_len=4)
+    state = prefill_prefix(model, params, prefix, max_total_len=12)
+    with pytest.raises(ValueError, match="multiple"):
+        decode_with_prefix(model, params, state,
+                           jnp.zeros((3, 2), jnp.int32), 2)
+    with pytest.raises(ValueError, match="overflows"):
+        decode_with_prefix(model, params, state,
+                           jnp.zeros((2, 4), jnp.int32), 8)
+
+
+def test_prefix_cache_sliding_window_model():
+    """The prefix path composes with a sliding-window ring cache:
+    capacity comes from the state's max_total_len, not the W-sized
+    buffer, and outputs still match full decode token-for-token."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    w = 8
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, max_seq_len=MAXLEN,
+                          attention_window=w, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(30), (1, 6), 0, V)
+    params = model.init(jax.random.PRNGKey(31), tokens)["params"]
+    suffixes = jax.random.randint(jax.random.PRNGKey(32), (2, 4), 0, V)
+    # prefix 6 + suffix 4 + N 10 = 20 total > window 8: the ring
+    # cache wraps during generation.
+    state = prefill_prefix(model, params, tokens,
+                           max_total_len=6 + 4 + N)
+    got = decode_with_prefix(model, params, state, suffixes, N)
+    full = decode(
+        model, params,
+        jnp.concatenate([jnp.broadcast_to(tokens, (2, 6)), suffixes],
+                        axis=1), N)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(full)[:, 6:])
+
+
+def test_prefix_cache_negative_top_k_rejected(dense_lm):
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model, params, _ = dense_lm
+    state = prefill_prefix(model, params,
+                           jnp.zeros((1, 4), jnp.int32),
+                           max_total_len=20)
+    with pytest.raises(ValueError, match="top_k"):
+        decode_with_prefix(model, params, state,
+                           jnp.zeros((1, 2), jnp.int32), 2,
+                           temperature=0.9, top_k=-1)

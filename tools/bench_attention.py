@@ -31,6 +31,7 @@ for schedule-correctness timing.
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -46,6 +47,17 @@ if os.environ.get("JAX_PLATFORMS"):
 import jax.numpy as jnp
 
 from container_engine_accelerators_tpu.utils.sync import wall_sync
+
+_ANSI = re.compile(r"\x1b\[[0-9;]*[A-Za-z]")
+
+
+def _clean_err(e):
+    """One clean line: exception type + whitespace-collapsed message,
+    ANSI stripped. Committed artifacts are audit records — a raw
+    backend traceback (escape codes, multi-line WARN spans) embedded
+    as a row value is noise the reader must reverse-engineer."""
+    s = " ".join(_ANSI.sub("", str(e)).split())
+    return f"{type(e).__name__}: {s[:160]}"
 
 
 def _time(fn, *args, iters):
@@ -138,7 +150,7 @@ def main(argv=None):
             jax.block_until_ready(reference)
         except Exception as e:
             print(json.dumps({"schedule": "dense", "seq_len": s,
-                              "numerics_error": str(e)[:200]}))
+                              "numerics_error": _clean_err(e)}))
         # Chunked f32 oracle ([B,H,chunk,chunk] peak score memory):
         # compiles at the 8k-32k lengths where dense cannot, so every
         # length a kernel claims gets an error bound. Where dense
@@ -166,7 +178,7 @@ def main(argv=None):
             except Exception as e:
                 print(json.dumps({"schedule": "chunked_oracle",
                                   "seq_len": s,
-                                  "numerics_error": str(e)[:200]}))
+                                  "numerics_error": _clean_err(e)}))
 
     # Per-call harness overhead: the wall_sync round trip amortized
     # over iters plus per-dispatch latency, measured with a trivial
@@ -185,7 +197,7 @@ def main(argv=None):
             sec = _time(fn, q, k, v, iters=args.iters)
         except Exception as e:  # dense at long S can OOM; keep going
             print(json.dumps({"schedule": name, "seq_len": s,
-                              "error": str(e)[:200]}))
+                              "error": _clean_err(e)}))
             continue
         row = {
             "schedule": name,

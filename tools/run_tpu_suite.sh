@@ -120,34 +120,74 @@ sec_rc "${ATTN_RC}" "attention sweep"
 
 echo "[suite] decode bench (bf16 + int8 cache + GQA + window)" >&2
 DECODE_RC=0
+dec2() {  # one retry after a pause: a transient tunnel drop mid-
+  # window (the dominant section killer — round 4's first window
+  # lost 1 of 12 invocations to a refused remote_compile) must not
+  # void an otherwise-complete capture. Each attempt's stdout is
+  # buffered and only the succeeding attempt's rows are emitted — a
+  # failed attempt may already have printed some batches, and
+  # replaying them would duplicate rows in the artifact.
+  local buf rc
+  buf="$(mktemp)"
+  for attempt in 1 2; do
+    timeout -k 30 1800 python tools/bench_decode.py "$@" > "${buf}"
+    rc=$?
+    if [ "${rc}" = 0 ]; then
+      cat "${buf}"; rm -f "${buf}"; return 0
+    fi
+    # Retry ONLY the fast-transient shape this exists for (a refused
+    # remote_compile connection exits rc 1 in seconds). rc 124/137 =
+    # killed by the 1800s timeout: the backend already burned the
+    # full cap hanging, and a retry doubles a multi-hour worst case
+    # while holding suite.lock. rc 2 = argparse usage error and
+    # rc 143 = external SIGTERM (window teardown): deterministic or
+    # dead — an identical rerun cannot help.
+    case "${rc}" in (2|124|137|143)
+      echo "[suite] decode invocation rc=${rc} (not transient);" \
+           "not retrying: $*" >&2
+      break
+    ;; esac
+    [ "${attempt}" = 1 ] && {
+      echo "[suite] decode invocation failed (rc=${rc});" \
+           "retrying once: $*" >&2
+      sleep 60
+    }
+  done
+  rm -f "${buf}"
+  return 1
+}
 {
-  timeout -k 30 1800 python tools/bench_decode.py --batch 1 8 \
+  dec2 --batch 1 8 \
     --prompt-len 128 --new-tokens 128 || DECODE_RC=1
-  timeout -k 30 1800 python tools/bench_decode.py --batch 1 8 \
+  dec2 --batch 1 8 \
     --prompt-len 128 --new-tokens 128 --kv-cache-dtype int8 || DECODE_RC=1
-  timeout -k 30 1800 python tools/bench_decode.py --batch 8 \
+  dec2 --batch 8 \
     --prompt-len 128 --new-tokens 128 --kv-cache-dtype int8 \
     --num-kv-heads 2 --pos-embedding rope || DECODE_RC=1
-  timeout -k 30 1800 python tools/bench_decode.py --batch 8 \
+  dec2 --batch 8 \
     --prompt-len 128 --new-tokens 128 --attention-window 64 || DECODE_RC=1
-  timeout -k 30 1800 python tools/bench_decode.py --batch 1 8 \
+  dec2 --batch 1 8 \
     --prompt-len 128 --new-tokens 128 --quantize-weights int8 \
     || DECODE_RC=1
   # Speculative decoding: self-draft = full-acceptance upper bound,
   # small-draft = all-rejected floor; real drafts land in between.
-  timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
+  dec2 --batch 1 \
     --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft self \
     || DECODE_RC=1
-  timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
+  dec2 --batch 1 \
     --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft small \
     || DECODE_RC=1
   # Rejection-sampling speculation (self-draft = the full-acceptance
   # bound for the sampling program; plain sampling is the baseline).
-  timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
+  dec2 --batch 1 \
     --prompt-len 128 --new-tokens 128 --temperature 1.0 || DECODE_RC=1
-  timeout -k 30 1800 python tools/bench_decode.py --batch 1 \
+  dec2 --batch 1 \
     --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft self \
     --temperature 1.0 || DECODE_RC=1
+  # Prefix caching: shared system-prompt prefilled once, per-request
+  # continuation timed alone (models/decode.py prefill_prefix).
+  dec2 --batch 1 8 \
+    --prompt-len 32 --new-tokens 128 --prefix-len 96 || DECODE_RC=1
 } > "${OUT}/DECODE_BENCH.json.tmp" 2>> "${OUT}/tpu_suite.log" 9>&-
 # Exit codes don't catch the CPU-fallback mode (a dropped tunnel lets
 # every run succeed on host CPU) — check the platform each row
