@@ -418,6 +418,16 @@ class GenerationServer(_BaseServer):
     use — warm=True does not precompile them). Batcher threads
     follow the same bound: one per (bucket, mode, effective top_k,
     logprobs) actually seen.
+
+    ``prefix_tokens`` turns on system-prompt serving: the shared
+    prefix prefills ONE KV cache at construction
+    (models.decode.prefill_prefix) and every request's prompt is the
+    part AFTER it — per-request cost drops to suffix prefill +
+    generation, and responses carry suffix-relative sequences (the
+    prefix is never re-emitted). Requests needing prefix-token
+    visibility (repetition_penalty, logprobs) are rejected with 400,
+    and the mode does not compose with speculative_k (construction
+    error) — use a plain server for that traffic.
     """
 
     def __init__(self, model_name, model, params, port=8500,
@@ -425,7 +435,7 @@ class GenerationServer(_BaseServer):
                  warm=False, warm_filters=None, warm_async=False,
                  max_wait_ms=5, tokenizer=None,
                  max_queue=None, draft_model=None, draft_params=None,
-                 speculative_k=0):
+                 speculative_k=0, prefix_tokens=None):
         super().__init__(model_name, port)
         from ..models.decode import decode
         self._decode = decode
@@ -486,11 +496,42 @@ class GenerationServer(_BaseServer):
         self._decode_calls = 0
         self._decode_rows = 0
         self._spec_calls = 0
-        max_prompt = model.max_seq_len - max_new_tokens
+        self._prefix_state = None
+        self._prefix_len = 0
+        if prefix_tokens is not None:
+            if self._spec_k:
+                raise ValueError(
+                    "prefix_tokens does not compose with "
+                    "speculative_k: the spec verify path has no "
+                    "prefix-cache reuse")
+            prefix_arr = np.asarray(prefix_tokens, np.int32)
+            if prefix_arr.ndim != 1 or prefix_arr.size < 1:
+                raise ValueError(
+                    "prefix_tokens must be a non-empty 1-D id list")
+            if (prefix_arr.min() < 0
+                    or prefix_arr.max() >= model.vocab_size):
+                raise ValueError(
+                    f"prefix token ids must be in "
+                    f"0..{model.vocab_size - 1}")
+            for spec in (warm_filters or []):
+                if (float(spec.get("repetition_penalty", 1.0)) != 1.0
+                        or spec.get("logprobs", False)):
+                    # The same shapes _handle_post rejects at request
+                    # time; warming them would build programs no
+                    # request can select.
+                    raise ValueError(
+                        "prefix-serving warm_filters cannot carry "
+                        "repetition_penalty or logprobs")
+            self._prefix_len = int(prefix_arr.size)
+        max_prompt = (model.max_seq_len - max_new_tokens
+                      - self._prefix_len)
         if max_prompt < 1:
             raise ValueError(
-                f"max_new_tokens {max_new_tokens} leaves no room for "
-                f"a prompt within max_seq_len {model.max_seq_len}")
+                f"max_new_tokens {max_new_tokens}"
+                + (f" + prefix {self._prefix_len}"
+                   if self._prefix_len else "")
+                + f" leaves no room for a prompt within max_seq_len "
+                  f"{model.max_seq_len}")
         if buckets is None:
             buckets, b = [], 16
             while b < max_prompt:
@@ -501,6 +542,20 @@ class GenerationServer(_BaseServer):
             {b for b in buckets if 1 <= b <= max_prompt})
         if not self._buckets:
             raise ValueError("no valid prompt-length buckets")
+        if self._prefix_len:
+            from ..models.decode import (
+                decode_with_prefix,
+                prefill_prefix,
+            )
+            self._decode_with_prefix = decode_with_prefix
+            # One state serves every bucket (smaller buckets need
+            # less than the sizing total); one compiled decode
+            # program per (bucket, mode) as usual — fan_out is the
+            # constant max_batch because _run always pads to it.
+            self._prefix_state = prefill_prefix(
+                model, params, prefix_arr[None, :],
+                max_total_len=(self._prefix_len + self._buckets[-1]
+                               + max_new_tokens))
         # Cross-request batching: one _Batcher per (bucket, sampling
         # mode, effective top_k) — rows from concurrent requests with
         # the same key share one decode call. Rows carry per-row
@@ -604,12 +659,17 @@ class GenerationServer(_BaseServer):
         return f"/v1/models/{self._name}:generate"
 
     def _model_metadata(self):
-        return {"kind": "generate",
+        meta = {"kind": "generate",
                 "vocab_size": self._model.vocab_size,
                 "max_prompt_len": self._buckets[-1],
                 "prompt_buckets": self._buckets,
                 "max_new_tokens": self._max_new,
                 "max_batch": self._max_batch}
+        if self._prefix_len:
+            # Clients send only the suffix; sequences come back
+            # suffix-relative (the shared prefix is never re-emitted).
+            meta["prefix_len"] = self._prefix_len
+        return meta
 
     @staticmethod
     def _default_knobs(rep_pen):
@@ -664,6 +724,19 @@ class GenerationServer(_BaseServer):
             seed = self._seed
             self._decode_calls += 1
             self._decode_rows += n
+        if self._prefix_state is not None:
+            # System-prompt mode: every request row continues the one
+            # prefilled prefix (fan_out = max_batch). Penalty and
+            # logprobs rows cannot reach here (_handle_post 400s
+            # them; construction rejects such warm_filters).
+            out = self._decode_with_prefix(
+                self._model, self._params, self._prefix_state,
+                jnp.asarray(padded), self._max_new,
+                temperature=temps if pad_temp else 0.0,
+                rng=jax.random.PRNGKey(seed), prompt_len=plens,
+                top_k=top_k, top_p=top_ps, min_p=min_ps,
+                eos_id=eos_ids)
+            return np.asarray(out)[:n]
         if (self._spec_k and not force_plain
                 and self._default_knobs(rep_pens)
                 and bucket + self._max_new + self._spec_k
@@ -836,6 +909,14 @@ class GenerationServer(_BaseServer):
         if (top_k or top_p < 1.0 or min_p > 0.0) and temperature <= 0.0:
             return 400, {"error": "top_k/top_p/min_p require "
                                   "temperature > 0"}
+        if self._prefix_len and rep_pen != 1.0:
+            return 400, {"error": "repetition_penalty is not "
+                                  "supported on a prefix-serving "
+                                  "server (the penalty needs "
+                                  "prefix-token visibility)"}
+        if self._prefix_len and want_lp:
+            return 400, {"error": "logprobs is not supported on a "
+                                  "prefix-serving server"}
         top_k = self._quantize_top_k(top_k)
         if not prompts or len(prompts) > self._max_batch:
             return 400, {"error": f"need 1..{self._max_batch} prompts"}

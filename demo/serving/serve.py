@@ -186,6 +186,19 @@ def main(argv=None):
                    help="orbax checkpoint for the draft; empty uses "
                         "a random draft init (load-testing only — "
                         "random drafts never agree with the target)")
+    p.add_argument("--system-prefix", default="",
+                   help="shared system-prompt TEXT, prefilled ONCE "
+                        "at startup (models.decode.prefill_prefix); "
+                        "clients then send only their suffix. "
+                        "Requires --tokenizer (ids go in "
+                        "--system-prefix-ids: text that happens to "
+                        "look like ids must never silently change "
+                        "meaning). Not combinable with "
+                        "--speculative-k")
+    p.add_argument("--system-prefix-ids", default="",
+                   help="shared system prompt as comma-separated "
+                        "token ids (mutually exclusive with "
+                        "--system-prefix)")
     args = p.parse_args(argv)
     if args.compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir",
@@ -290,6 +303,23 @@ def main(argv=None):
                 draft_vars = load_checkpoint_variables(
                     args.draft_model_dir, draft_vars)
             draft_params = draft_vars["params"]
+        prefix_tokens = None
+        if args.system_prefix and args.system_prefix_ids:
+            p.error("pass --system-prefix or --system-prefix-ids, "
+                    "not both")
+        if args.system_prefix_ids:
+            try:
+                prefix_tokens = [int(t) for t in
+                                 args.system_prefix_ids.split(",")]
+            except ValueError:
+                p.error("--system-prefix-ids must be comma-separated "
+                        "integers")
+        elif args.system_prefix:
+            if tokenizer is None:
+                p.error("--system-prefix is text and requires "
+                        "--tokenizer; pass ids via "
+                        "--system-prefix-ids")
+            prefix_tokens = tokenizer.encode(args.system_prefix)
         server = GenerationServer(
             name, model, variables["params"], port=args.port,
             max_new_tokens=args.max_new_tokens,
@@ -297,7 +327,8 @@ def main(argv=None):
             warm=args.warm, warm_filters=warm_filters,
             warm_async=True, draft_model=draft_model,
             draft_params=draft_params,
-            speculative_k=args.speculative_k)
+            speculative_k=args.speculative_k,
+            prefix_tokens=prefix_tokens)
     else:
         model = resnet(depth=args.depth)
         variables = model.init(
