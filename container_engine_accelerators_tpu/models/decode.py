@@ -466,12 +466,12 @@ def prefill_prefix(model, params, prefix, *, max_total_len):
                    static_argnames=("model", "max_new_tokens",
                                     "fan_out", "sample", "top_k",
                                     "use_top_p", "use_min_p",
-                                    "use_eos"))
+                                    "use_eos", "fast_prefill"))
 def _decode_with_prefix_impl(model, params, cache, prompt,
                              max_new_tokens, temperature, rng,
                              prompt_len, top_p, min_p, eos_id, *,
                              fan_out, sample, top_k, use_top_p,
-                             use_min_p, use_eos):
+                             use_min_p, use_eos, fast_prefill=False):
     b, p_pad = prompt.shape
     total_s = p_pad + max_new_tokens
     # The cache already counted the prefix; the clone only rebuilds
@@ -506,6 +506,27 @@ def _decode_with_prefix_impl(model, params, cache, prompt,
             eos_row if use_eos else None, prompt.dtype)
         return (updated["cache"], nxt, rng, done), nxt
 
+    if fast_prefill and max_new_tokens > 0:
+        # The whole suffix runs as ONE mid-cache chunk apply, valid
+        # when every row's true length equals the suffix width. The
+        # chunk_attends_cache clone is ESSENTIAL (and what the
+        # speculative verify path uses): the default multi-token
+        # chunk path assumes an empty cache and runs causal
+        # attention over the chunk alone — it would never see the
+        # resident prefix.
+        chunk_model = decode_model.clone(chunk_attends_cache=True)
+        outputs, updated = chunk_model.apply(
+            {"params": params, "cache": cache}, prompt,
+            train=False, mutable=["cache"])
+        first, rng = pick(_logits_of(outputs)[:, -1], rng)
+        done0 = ((first == eos_row) if use_eos
+                 else jnp.zeros((b,), bool))
+        (_, _, _, _), produced = jax.lax.scan(
+            step, (updated["cache"], first, rng, done0),
+            jnp.arange(p_pad, total_s - 1))
+        return jnp.concatenate(
+            [prompt, first[:, None], produced.T], axis=1)
+
     (_, _, _, _), produced = jax.lax.scan(
         step, (cache, prompt[:, 0], rng, jnp.zeros((b,), bool)),
         jnp.arange(total_s - 1))
@@ -515,7 +536,7 @@ def _decode_with_prefix_impl(model, params, cache, prompt,
 def decode_with_prefix(model, params, prefix_state, prompt,
                        max_new_tokens, *, temperature=0.0, rng=None,
                        prompt_len=None, top_k=0, top_p=1.0,
-                       min_p=0.0, eos_id=None):
+                       min_p=0.0, eos_id=None, fast_prefill=None):
     """Continue generation from a ``prefill_prefix`` state.
 
     ``prompt`` ([B, P] int32) holds each request's own tokens (the
@@ -535,11 +556,14 @@ def decode_with_prefix(model, params, prefix_state, prompt,
     or drop it to free HBM). One compiled program per
     (fan-out, shape) pair.
 
-    The suffix itself prefills STEPWISE (one scan step per token):
-    right for the short per-request prompts behind a long shared
-    prefix this path exists for. A suffix long enough to dominate
-    should ride ``decode(fast_prefill=True)`` instead (one chunked
-    forward), trading away the prefix reuse.
+    ``fast_prefill`` mirrors ``decode``: when every row's true length
+    equals the suffix width (auto-detected; None), the whole suffix
+    runs as ONE mid-cache chunk forward — the same chunked write +
+    intra-chunk causal masking the speculative verify path uses —
+    instead of one scan step per token. Right-padded (ragged)
+    suffixes prefill stepwise; callers that must keep a fixed
+    program set per shape (the serving layer) pass
+    ``fast_prefill=False``.
     """
     cache, prefix_len, max_total_len = prefix_state
     # Cache leaves mix KV buffers ([B, L, H, D]) with scalar step
@@ -564,6 +588,25 @@ def decode_with_prefix(model, params, prefix_state, prompt,
         rng = jax.random.PRNGKey(0)
     if prompt_len is None:
         prompt_len = prompt.shape[1]
+    full_width = bool(
+        (np.asarray(prompt_len) == prompt.shape[1]).all())
+    # The chunk apply needs the model's mid-cache chunk attention
+    # (chunk_attends_cache); models without it prefill stepwise.
+    # Sliding-window models are excluded for the same reason
+    # speculative_decode rejects them: the ring cache's multi-token
+    # write path assumes the chunk starts at position 0, which a
+    # mid-cache chunk violates.
+    can_chunk = (hasattr(model, "chunk_attends_cache")
+                 and not getattr(model, "attention_window", 0))
+    if fast_prefill is None:
+        fast_prefill = full_width and max_new_tokens > 0 and can_chunk
+    elif fast_prefill and not (full_width and max_new_tokens > 0
+                               and can_chunk):
+        raise ValueError(
+            "fast_prefill=True requires every row's prompt_len to "
+            "equal the suffix width (no right-padding), "
+            "max_new_tokens > 0, and a model with the "
+            "chunk_attends_cache mid-cache chunk path")
     sample, top_k, use_top_p, use_min_p = _sampling_flags(
         temperature, top_k, top_p, min_p)
     use_eos = eos_id is not None
@@ -575,7 +618,8 @@ def decode_with_prefix(model, params, prefix_state, prompt,
         jnp.asarray(min_p, jnp.float32),
         jnp.asarray(eos_id if use_eos else -1, jnp.int32),
         fan_out=b // prefix_b, sample=sample, top_k=top_k,
-        use_top_p=use_top_p, use_min_p=use_min_p, use_eos=use_eos)
+        use_top_p=use_top_p, use_min_p=use_min_p, use_eos=use_eos,
+        fast_prefill=bool(fast_prefill))
 
 
 @functools.partial(jax.jit,

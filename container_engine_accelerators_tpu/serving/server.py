@@ -729,13 +729,17 @@ class GenerationServer(_BaseServer):
             # prefilled prefix (fan_out = max_batch). Penalty and
             # logprobs rows cannot reach here (_handle_post 400s
             # them; construction rejects such warm_filters).
+            # fast_prefill=False for the same reason as the plain
+            # path below: the auto-selected one-chunk-suffix variant
+            # would flip with batch composition (all-full-width vs
+            # ragged) and stall requests on compiles.
             out = self._decode_with_prefix(
                 self._model, self._params, self._prefix_state,
                 jnp.asarray(padded), self._max_new,
                 temperature=temps if pad_temp else 0.0,
                 rng=jax.random.PRNGKey(seed), prompt_len=plens,
                 top_k=top_k, top_p=top_ps, min_p=min_ps,
-                eos_id=eos_ids)
+                eos_id=eos_ids, fast_prefill=False)
             return np.asarray(out)[:n]
         if (self._spec_k and not force_plain
                 and self._default_knobs(rep_pens)

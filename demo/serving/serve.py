@@ -200,6 +200,31 @@ def main(argv=None):
                         "token ids (mutually exclusive with "
                         "--system-prefix)")
     args = p.parse_args(argv)
+    # Prefix flags validate at PARSE time: a conflict or missing
+    # tokenizer must not cost a full model build + checkpoint load
+    # before erroring, and the flags must never be silently ignored
+    # on a non-LM model.
+    if args.system_prefix and args.system_prefix_ids:
+        p.error("pass --system-prefix or --system-prefix-ids, "
+                "not both")
+    prefix_ids = None
+    if args.system_prefix_ids:
+        try:
+            prefix_ids = [int(t) for t in
+                          args.system_prefix_ids.split(",")]
+        except ValueError:
+            p.error("--system-prefix-ids must be comma-separated "
+                    "integers")
+    if args.system_prefix and not args.tokenizer:
+        p.error("--system-prefix is text and requires --tokenizer; "
+                "pass ids via --system-prefix-ids")
+    if args.system_prefix or prefix_ids:
+        if args.model not in ("transformer", "moe"):
+            p.error("--system-prefix/--system-prefix-ids apply only "
+                    "to LM models (--model transformer|moe)")
+        if args.speculative_k:
+            p.error("--system-prefix does not compose with "
+                    "--speculative-k")
     if args.compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir",
                           args.compilation_cache_dir)
@@ -303,22 +328,8 @@ def main(argv=None):
                 draft_vars = load_checkpoint_variables(
                     args.draft_model_dir, draft_vars)
             draft_params = draft_vars["params"]
-        prefix_tokens = None
-        if args.system_prefix and args.system_prefix_ids:
-            p.error("pass --system-prefix or --system-prefix-ids, "
-                    "not both")
-        if args.system_prefix_ids:
-            try:
-                prefix_tokens = [int(t) for t in
-                                 args.system_prefix_ids.split(",")]
-            except ValueError:
-                p.error("--system-prefix-ids must be comma-separated "
-                        "integers")
-        elif args.system_prefix:
-            if tokenizer is None:
-                p.error("--system-prefix is text and requires "
-                        "--tokenizer; pass ids via "
-                        "--system-prefix-ids")
+        prefix_tokens = prefix_ids
+        if args.system_prefix:
             prefix_tokens = tokenizer.encode(args.system_prefix)
         server = GenerationServer(
             name, model, variables["params"], port=args.port,

@@ -814,3 +814,34 @@ def test_prefix_cache_negative_top_k_rejected(dense_lm):
         decode_with_prefix(model, params, state,
                            jnp.zeros((1, 2), jnp.int32), 2,
                            temperature=0.9, top_k=-1)
+
+
+def test_prefix_cache_fast_suffix_prefill_matches_stepwise(dense_lm):
+    """The one-chunk suffix prefill (mid-cache chunk apply) equals
+    the stepwise scan token-for-token, greedy and top_k=1 sampling
+    alike — and both equal full decode."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model, params, _ = dense_lm
+    prefix = jax.random.randint(jax.random.PRNGKey(33), (1, 6), 0, V)
+    suffixes = jax.random.randint(jax.random.PRNGKey(34), (3, 5), 0, V)
+    state = prefill_prefix(model, params, prefix,
+                           max_total_len=6 + 5 + N)
+    fast = decode_with_prefix(model, params, state, suffixes, N,
+                              fast_prefill=True)
+    slow = decode_with_prefix(model, params, state, suffixes, N,
+                              fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+    full = decode(
+        model, params,
+        jnp.concatenate([jnp.broadcast_to(prefix, (3, 6)), suffixes],
+                        axis=1), N)
+    np.testing.assert_array_equal(np.asarray(fast),
+                                  np.asarray(full)[:, 6:])
+    with pytest.raises(ValueError, match="fast_prefill"):
+        decode_with_prefix(model, params, state, suffixes, N,
+                           prompt_len=jnp.array([4, 5, 5]),
+                           fast_prefill=True)
