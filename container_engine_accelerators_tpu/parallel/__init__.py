@@ -55,6 +55,7 @@ from .pipeline import (
     stack_stage_params,
     stage_sharding,
 )
+from .pipeline_lm import PipelinedLM
 from .sharding import batch_sharding, param_shardings, replicated
 from .train import TrainState, Trainer
 
@@ -86,6 +87,7 @@ __all__ = [
     "batch_sharding",
     "param_shardings",
     "replicated",
+    "PipelinedLM",
     "TrainState",
     "Trainer",
 ]

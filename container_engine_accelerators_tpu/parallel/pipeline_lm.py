@@ -1,0 +1,191 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Pipeline-parallel TransformerLM training.
+
+Makes pipeline parallelism usable on a REAL model, not just the
+toy stage functions of the schedule tests: transformer blocks are
+the stages (Block is [B, S, E] shape-preserving, exactly the
+pipeline contract), while the embedding, final norm, and LM head
+run data-parallel outside the pipe — the standard layout (first/
+last-stage asymmetry would break the SPMD one-program schedule).
+
+Layout on a ("data", "pipe") mesh:
+  - token/position embeddings, final LayerNorm, lm_head: replicated
+    over the pipe axis, batch sharded over "data";
+  - the num_layers Block parameter trees: STACKED on a leading
+    stage axis and sharded over "pipe", stored in placement order
+    (circular_stage_order) so the jitted step carries no per-step
+    placement all-to-all;
+  - activations advance stage-per-tick via the circular
+    (interleaved) schedule — num_layers = v * pipe runs v stages
+    per device with the v-times-smaller bubble.
+
+The reference's demo layer has no pipeline-parallel trainer at all
+(its TF images scale by device count only —
+/root/reference/demo/gpu-training/generate_job.sh); this is
+TPU-native scope beyond it, built on the same Block the serving
+stack decodes.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..models.transformer import Block
+from .mesh import DATA_AXIS
+from .pipeline import (
+    PIPELINE_AXIS,
+    circular_pipeline_apply,
+    circular_stage_order,
+    stack_stage_params,
+    stage_sharding,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinedLM:
+    """A causal LM whose blocks run as pipeline stages.
+
+    Not a flax module: parameters are an explicit pytree
+    ({"tok_embed", "pos_embed", "blocks", "ln", "lm_head"}) so the
+    stacked block axis can be sharded over the pipe axis directly.
+    ``pipe`` is part of the MODEL, not the call: the block stack is
+    stored in placement order for exactly that pipe size, and
+    ``apply`` refuses a mesh whose pipe axis differs — a different
+    size that still divides num_layers would otherwise silently run
+    the blocks in the wrong order. ``num_layers`` must be a multiple
+    of ``pipe``; the quotient is the interleave depth v.
+    """
+
+    vocab_size: int
+    embed_dim: int
+    num_layers: int
+    num_heads: int
+    max_seq_len: int
+    pipe: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.pipe < 1 or self.num_layers % self.pipe != 0:
+            raise ValueError(
+                f"{self.num_layers} layers do not fold onto "
+                f"pipe={self.pipe}")
+
+    def _block(self):
+        return Block(num_heads=self.num_heads,
+                     mlp_ratio=self.mlp_ratio, dtype=self.dtype)
+
+    def _embed(self, which):
+        n = (self.vocab_size if which == "tok_embed"
+             else self.max_seq_len)
+        return nn.Embed(n, self.embed_dim, dtype=self.dtype,
+                        name=which)
+
+    def _ln(self):
+        return nn.LayerNorm(dtype=self.dtype)
+
+    def _head(self):
+        # f32 logits for xent numerics, same as TransformerLM.
+        return nn.Dense(self.vocab_size, dtype=jnp.float32)
+
+    def init(self, key):
+        """Parameter pytree with the block stack in PLACEMENT order
+        for this model's pipe size."""
+        keys = jax.random.split(key, self.num_layers + 4)
+        dummy_tok = jnp.zeros((1, 8), jnp.int32)
+        dummy_h = jnp.zeros((1, 8, self.embed_dim), self.dtype)
+        blocks = stack_stage_params([
+            self._block().init(keys[i], dummy_h)["params"]
+            for i in range(self.num_layers)])
+        order = circular_stage_order(self.num_layers, self.pipe)
+        blocks = jax.tree_util.tree_map(lambda w: w[order], blocks)
+        return {
+            "tok_embed": self._embed("tok_embed").init(
+                keys[-4], dummy_tok)["params"],
+            "pos_embed": self._embed("pos_embed").init(
+                keys[-3], dummy_tok)["params"],
+            "blocks": blocks,
+            "ln": self._ln().init(keys[-2], dummy_h)["params"],
+            "lm_head": self._head().init(
+                keys[-1], dummy_h.astype(jnp.float32))["params"],
+        }
+
+    def shardings(self, mesh, params):
+        """NamedSharding pytree: blocks over the pipe axis,
+        everything else replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(mesh, P())
+        out = jax.tree_util.tree_map(lambda _: rep, params)
+        out["blocks"] = stage_sharding(mesh, params["blocks"])
+        return out
+
+    def apply(self, params, tokens, *, mesh, num_microbatches):
+        """tokens [B, S] int32 -> logits [B, S, V] f32. ``tokens``
+        must be sharded over DATA_AXIS (B divisible into
+        num_microbatches per data shard)."""
+        s = tokens.shape[1]
+        if s > self.max_seq_len:
+            raise ValueError(
+                f"sequence length {s} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        if mesh.shape[PIPELINE_AXIS] != self.pipe:
+            raise ValueError(
+                f"mesh pipe axis {mesh.shape[PIPELINE_AXIS]} != "
+                f"model pipe {self.pipe}: the block stack is in "
+                f"placement order for {self.pipe} devices")
+        x = self._embed("tok_embed").apply(
+            {"params": params["tok_embed"]}, tokens)
+        pos = self._embed("pos_embed").apply(
+            {"params": params["pos_embed"]},
+            jnp.arange(s, dtype=jnp.int32))
+        x = x + pos[None]
+
+        block = self._block()
+
+        def stage_fn(block_params, h):
+            return block.apply({"params": block_params}, h)
+
+        x = circular_pipeline_apply(
+            mesh, stage_fn, params["blocks"], x,
+            num_microbatches=num_microbatches, pre_permuted=True)
+        x = self._ln().apply({"params": params["ln"]}, x)
+        return self._head().apply({"params": params["lm_head"]},
+                                  x.astype(jnp.float32))
+
+    def reference_apply(self, params, tokens):
+        """The same computation with the blocks folded sequentially
+        on one device (placement order inverted back to natural) —
+        the equality oracle for the pipelined apply."""
+        s = tokens.shape[1]
+        x = self._embed("tok_embed").apply(
+            {"params": params["tok_embed"]}, tokens)
+        pos = self._embed("pos_embed").apply(
+            {"params": params["pos_embed"]},
+            jnp.arange(s, dtype=jnp.int32))
+        x = x + pos[None]
+        block = self._block()
+        order = list(circular_stage_order(self.num_layers, self.pipe))
+        for stage in range(self.num_layers):
+            slot = order.index(stage)  # placement row holding it
+            bp = jax.tree_util.tree_map(lambda w: w[slot],
+                                        params["blocks"])
+            x = block.apply({"params": bp}, x)
+        x = self._ln().apply({"params": params["ln"]}, x)
+        return self._head().apply({"params": params["lm_head"]},
+                                  x.astype(jnp.float32))

@@ -835,6 +835,14 @@ def test_prefix_cache_fast_suffix_prefill_matches_stepwise(dense_lm):
     slow = decode_with_prefix(model, params, state, suffixes, N,
                               fast_prefill=False)
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+    # top_k=1 sampling (support of one -> deterministic regardless
+    # of each path's different rng stream) exercises the sampling
+    # branch of the fast chunk pick.
+    fast_s = decode_with_prefix(model, params, state, suffixes, N,
+                                temperature=0.7, top_k=1,
+                                fast_prefill=True)
+    np.testing.assert_array_equal(np.asarray(fast_s),
+                                  np.asarray(fast))
     full = decode(
         model, params,
         jnp.concatenate([jnp.broadcast_to(prefix, (3, 6)), suffixes],
