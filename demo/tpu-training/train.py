@@ -161,6 +161,15 @@ def parse_args(argv=None):
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--model-parallelism", type=int, default=1)
+    p.add_argument("--pipeline-parallelism", type=int, default=1,
+                   help="K>1: train the transformer with its blocks "
+                        "as interleaved pipeline stages over a "
+                        "(data, pipe=K) mesh (PipelinedLM); "
+                        "num_layers must be a multiple of K")
+    p.add_argument("--num-microbatches", type=int, default=4,
+                   help="pipeline microbatches per step (the "
+                        "per-data-shard batch must divide into "
+                        "them)")
     p.add_argument("--dcn-granules", type=int, default=0,
                    help="multislice: spread the data axis over this "
                         "many DCN granules (slices/hosts), keeping "
@@ -372,6 +381,207 @@ def build_lm(args, mesh):
     return model, transformer_mod.make_apply_fn(model), base_loss
 
 
+def build_tx(args):
+    """The optimizer every training path shares (--lr-schedule +
+    kernel-masked weight decay + SGD/momentum)."""
+    if args.lr_schedule == "constant":
+        lr = args.lr
+    elif args.lr_schedule == "cosine":
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.lr,
+            warmup_steps=args.lr_warmup_steps,
+            decay_steps=max(args.steps, args.lr_warmup_steps + 1))
+    else:  # linear
+        lr = optax.join_schedules(
+            [optax.linear_schedule(0.0, args.lr, args.lr_warmup_steps),
+             optax.linear_schedule(
+                 args.lr, 0.0,
+                 max(args.steps - args.lr_warmup_steps, 1))],
+            [args.lr_warmup_steps])
+    return optax.chain(
+        # Decay kernels only: biases and norm scales (ndim < 2) pull
+        # toward zero under decay with no regularization benefit —
+        # the standard mask.
+        optax.add_decayed_weights(
+            args.weight_decay,
+            mask=lambda params: jax.tree_util.tree_map(
+                lambda p: getattr(p, "ndim", 0) >= 2, params)),
+        optax.sgd(lr, momentum=args.momentum),
+    )
+
+
+def run_pipeline_lm(args, devices):
+    """--pipeline-parallelism K: train the PipelinedLM (transformer
+    blocks as interleaved pipeline stages over a ("data", "pipe")
+    mesh — parallel/pipeline_lm.py) with its own jitted step.
+
+    Deliberately narrow: the pipelined parameter layout (stacked
+    placement-ordered block axis) is its own world, so flags that
+    assume the Trainer state shape are rejected loudly instead of
+    silently half-working. Checkpointing saves/restores the pipeline
+    payload ({step, params, opt_state}) through the same async orbax
+    path as the main driver.
+    """
+    from container_engine_accelerators_tpu.parallel import PipelinedLM
+    from container_engine_accelerators_tpu.parallel.pipeline import (
+        build_pipeline_mesh,
+    )
+
+    pp = args.pipeline_parallelism
+    if args.model != "transformer":
+        raise SystemExit(
+            "--pipeline-parallelism applies to --model transformer")
+    unsupported = {
+        "--model-parallelism": args.model_parallelism > 1,
+        "--context-parallelism": args.context_parallelism > 1,
+        "--expert-parallelism": args.expert_parallelism > 1,
+        "--dcn-granules": args.dcn_granules > 1,
+        "--fsdp": args.fsdp,
+        "--grad-accum": args.grad_accum > 1,
+        "--ema-decay": args.ema_decay > 0,
+        "--remat": args.remat,
+        "--eval-batches": args.eval_batches > 0,
+        "--data-dir": bool(args.data_dir),
+        "--num-kv-heads": args.num_kv_heads > 0,
+        "--attention-window": args.attention_window > 0,
+        "--pos-embedding rope": args.pos_embedding == "rope",
+        "--attention ring/ulysses": args.attention != "flash",
+    }
+    on = [flag for flag, bad in unsupported.items() if bad]
+    if on:
+        raise SystemExit(
+            f"--pipeline-parallelism does not support "
+            f"{', '.join(on)}")
+    if len(devices) % pp != 0:
+        raise SystemExit(
+            f"{len(devices)} devices do not fold onto pipe={pp}")
+    data = len(devices) // pp
+    mesh = build_pipeline_mesh(pp, data=data, devices=devices)
+    lm = PipelinedLM(vocab_size=args.vocab_size,
+                     embed_dim=args.embed_dim,
+                     num_layers=args.num_layers,
+                     num_heads=args.num_heads,
+                     max_seq_len=args.seq_len, pipe=pp,
+                     dtype=jnp.bfloat16)
+    params = lm.init(jax.random.PRNGKey(0))
+    params = jax.device_put(params, lm.shardings(mesh, params))
+    tx = build_tx(args)
+    opt_state = tx.init(params)
+    if args.model_dir.startswith("gs://"):
+        print("WARNING: gs:// model dirs need a GCS-enabled image; "
+              "skipping checkpointing", file=sys.stderr)
+        args.model_dir = ""
+    step0 = 0
+    if args.model_dir:
+        restored = restore_pipeline_checkpoint(
+            args.model_dir, {"step": 0, "params": params,
+                             "opt_state": opt_state})
+        if restored is not None:
+            step0 = int(restored["step"])
+            params = jax.device_put(restored["params"],
+                                    lm.shardings(mesh, params))
+            opt_state = restored["opt_state"]
+    m = args.num_microbatches
+    loader = SyntheticTokenLoader(
+        args.batch_size, args.seq_len, args.vocab_size,
+        sharding=batch_sharding(mesh), pool=2)
+
+    # Same objective knobs as every other LM path: --pallas-loss and
+    # --label-smoothing ride the shared loss builders.
+    lm_loss = next_token_loss_fn(functools.partial(
+        mean_cross_entropy_loss if args.pallas_loss
+        else _dense_lm_loss,
+        label_smoothing=args.label_smoothing))
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(params):
+            logits = lm.apply(params, tokens, mesh=mesh,
+                              num_microbatches=m)
+            return lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    warmup = max(args.warmup_steps, 0)
+    t_start = time.perf_counter() if warmup == 0 else None
+    for step, (tokens, _) in zip(range(args.steps), loader):
+        params, opt_state, loss = train_step(params, opt_state,
+                                             tokens)
+        if t_start is None and step == warmup - 1:
+            wall_sync(loss)
+            t_start = time.perf_counter()
+        if step % 20 == 0 or step == args.steps - 1:
+            loss_val = float(loss)
+            losses.append(loss_val)
+            print(f"step {step} loss {loss_val:.4f}", file=sys.stderr)
+        if (args.model_dir and args.checkpoint_every
+                and (step + 1) % args.checkpoint_every == 0):
+            save_pipeline_checkpoint(
+                args.model_dir,
+                {"step": step0 + step + 1, "params": params,
+                 "opt_state": opt_state})
+            if args.keep_checkpoints:
+                prune_checkpoints(args.model_dir,
+                                  args.keep_checkpoints)
+    wall_sync(params)
+    t_end = time.perf_counter()
+    if hasattr(loader, "close"):
+        loader.close()
+    timed_steps = max(args.steps - warmup, 0)
+    elapsed = (t_end - t_start) if t_start is not None else 0.0
+    seqs_per_sec = (args.batch_size * timed_steps / elapsed
+                    if elapsed > 0 and timed_steps else 0.0)
+    if args.model_dir:
+        save_pipeline_checkpoint(
+            args.model_dir,
+            {"step": step0 + args.steps, "params": params,
+             "opt_state": opt_state})
+        finalize_checkpoints()
+        if args.keep_checkpoints:
+            prune_checkpoints(args.model_dir, args.keep_checkpoints)
+    result = {
+        "model": "transformer",
+        "pipeline_parallelism": pp,
+        "num_microbatches": m,
+        "devices": len(devices),
+        "global_batch": args.batch_size,
+        "steps": args.steps,
+        "images_per_sec": round(seqs_per_sec, 2),
+        "images_per_sec_per_chip": round(
+            seqs_per_sec / len(devices), 2),
+        "tokens_per_sec": round(seqs_per_sec * args.seq_len, 2),
+        "final_loss": losses[-1] if losses else None,
+    }
+    print(json.dumps(result))
+    return result
+
+
+def save_pipeline_checkpoint(model_dir, payload):
+    """Async-checkpoint the pipeline payload ({step, params,
+    opt_state}) under the same checkpoint_N naming as the main
+    driver."""
+    step = int(payload["step"])
+    path = os.path.abspath(
+        os.path.join(model_dir, f"checkpoint_{step}"))
+    _checkpointer().save(path, payload, force=True)
+    print(f"saving checkpoint {path} (async)", file=sys.stderr)
+    return path
+
+
+def restore_pipeline_checkpoint(model_dir, template):
+    """Newest finished checkpoint restored against ``template``'s
+    tree, or None when the dir holds none."""
+    entries = _list_checkpoints(model_dir)
+    if not entries:
+        return None
+    _, name = entries[-1]
+    path = os.path.abspath(os.path.join(model_dir, name))
+    return _checkpointer().restore(path, item=template)
+
+
 def _dense_lm_loss(logits, labels, label_smoothing=0.0):
     from container_engine_accelerators_tpu.parallel.train import (
         cross_entropy_loss,
@@ -435,6 +645,8 @@ def main(argv=None):
     )
     initialize_from_plugin_env()
     devices = jax.devices()
+    if args.pipeline_parallelism > 1:
+        return run_pipeline_lm(args, devices)
     if args.context_parallelism > 1 and args.model not in LM_MODELS:
         raise SystemExit(
             "--context-parallelism only applies to the LM models")
@@ -512,30 +724,7 @@ def main(argv=None):
                                      num_classes,
                                      sharding=batch_sharding(mesh), pool=2)
 
-    if args.lr_schedule == "constant":
-        lr = args.lr
-    elif args.lr_schedule == "cosine":
-        lr = optax.warmup_cosine_decay_schedule(
-            init_value=0.0, peak_value=args.lr,
-            warmup_steps=args.lr_warmup_steps,
-            decay_steps=max(args.steps, args.lr_warmup_steps + 1))
-    else:  # linear
-        lr = optax.join_schedules(
-            [optax.linear_schedule(0.0, args.lr, args.lr_warmup_steps),
-             optax.linear_schedule(
-                 args.lr, 0.0,
-                 max(args.steps - args.lr_warmup_steps, 1))],
-            [args.lr_warmup_steps])
-    tx = optax.chain(
-        # Decay kernels only: biases and norm scales (ndim < 2) pull
-        # toward zero under decay with no regularization benefit —
-        # the standard mask.
-        optax.add_decayed_weights(
-            args.weight_decay,
-            mask=lambda params: jax.tree_util.tree_map(
-                lambda p: getattr(p, "ndim", 0) >= 2, params)),
-        optax.sgd(lr, momentum=args.momentum),
-    )
+    tx = build_tx(args)
     augment_fn = None
     if args.augment:
         if args.model in ("transformer", "moe"):
