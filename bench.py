@@ -516,6 +516,26 @@ def child():
     images_per_sec = global_batch * TIMED_STEPS / elapsed
     per_chip = images_per_sec / n
     from container_engine_accelerators_tpu.utils.provenance import stamp
+    # Self-auditing MFU: the record carries its own derivation (see
+    # docs/benchmarks.md "Headline MFU"). Analytic convention:
+    # ~4.1 GFLOP/image ResNet-50 fwd at 224^2, x3 fwd+bwd; v5e peak
+    # ~197 bf16 TFLOP/s/chip. A reader can check value -> TFLOP/s ->
+    # %peak without opening the docs. Only for the CANONICAL config:
+    # the same predicate that gates the committed artifact — a smoke
+    # run (BENCH_DEPTH/IMAGE_SIZE/PLATFORMS overrides) would report
+    # an MFU off by the full depth/resolution FLOP ratio.
+    mfu_fields = {}
+    if _artifact_names()[0] is not None:
+        analytic_flops_per_image = 12.3e9
+        v5e_peak_tflops = 197.0
+        mfu = (per_chip * analytic_flops_per_image / 1e12
+               / v5e_peak_tflops)
+        mfu_fields = {
+            "mfu_analytic": round(mfu, 4),
+            "mfu_note": ("12.3 GFLOP/image (fwd x3) vs 197 bf16 "
+                         "TFLOP/s v5e peak; step is HBM-bound — see "
+                         "docs/benchmarks.md"),
+        }
     print(json.dumps({
         "metric": METRIC,
         "value": round(per_chip, 2),
@@ -524,6 +544,7 @@ def child():
         "batch_per_chip": BATCH_PER_CHIP,
         "timed_steps": TIMED_STEPS,
         "elapsed_s": round(elapsed, 3),
+        **mfu_fields,
         "provenance": stamp(devices),
     }), flush=True)
     return 0
