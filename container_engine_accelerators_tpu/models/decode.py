@@ -466,12 +466,14 @@ def prefill_prefix(model, params, prefix, *, max_total_len):
                    static_argnames=("model", "max_new_tokens",
                                     "fan_out", "sample", "top_k",
                                     "use_top_p", "use_min_p",
-                                    "use_eos", "fast_prefill"))
+                                    "use_eos", "fast_prefill",
+                                    "return_cache"))
 def _decode_with_prefix_impl(model, params, cache, prompt,
                              max_new_tokens, temperature, rng,
                              prompt_len, top_p, min_p, eos_id, *,
                              fan_out, sample, top_k, use_top_p,
-                             use_min_p, use_eos, fast_prefill=False):
+                             use_min_p, use_eos, fast_prefill=False,
+                             return_cache=False):
     b, p_pad = prompt.shape
     total_s = p_pad + max_new_tokens
     # The cache already counted the prefix; the clone only rebuilds
@@ -521,22 +523,25 @@ def _decode_with_prefix_impl(model, params, cache, prompt,
         first, rng = pick(_logits_of(outputs)[:, -1], rng)
         done0 = ((first == eos_row) if use_eos
                  else jnp.zeros((b,), bool))
-        (_, _, _, _), produced = jax.lax.scan(
+        (cache, _, _, _), produced = jax.lax.scan(
             step, (updated["cache"], first, rng, done0),
             jnp.arange(p_pad, total_s - 1))
-        return jnp.concatenate(
+        seq = jnp.concatenate(
             [prompt, first[:, None], produced.T], axis=1)
+        return (seq, cache) if return_cache else seq
 
-    (_, _, _, _), produced = jax.lax.scan(
+    (cache, _, _, _), produced = jax.lax.scan(
         step, (cache, prompt[:, 0], rng, jnp.zeros((b,), bool)),
         jnp.arange(total_s - 1))
-    return jnp.concatenate([prompt[:, :1], produced.T], axis=1)
+    seq = jnp.concatenate([prompt[:, :1], produced.T], axis=1)
+    return (seq, cache) if return_cache else seq
 
 
 def decode_with_prefix(model, params, prefix_state, prompt,
                        max_new_tokens, *, temperature=0.0, rng=None,
                        prompt_len=None, top_k=0, top_p=1.0,
-                       min_p=0.0, eos_id=None, fast_prefill=None):
+                       min_p=0.0, eos_id=None, fast_prefill=None,
+                       return_state=False):
     """Continue generation from a ``prefill_prefix`` state.
 
     ``prompt`` ([B, P] int32) holds each request's own tokens (the
@@ -564,6 +569,12 @@ def decode_with_prefix(model, params, prefix_state, prompt,
     suffixes prefill stepwise; callers that must keep a fixed
     program set per shape (the serving layer) pass
     ``fast_prefill=False``.
+
+    ``return_state=True`` additionally returns the advanced state:
+    generation continues by passing the returned sequence's LAST
+    token as the next call's 1-token prompt (it was sampled but not
+    yet fed through the model, so the cache does not yet contain
+    it). ``stream_decode`` packages this into a chunked generator.
     """
     cache, prefix_len, max_total_len = prefix_state
     # Cache leaves mix KV buffers ([B, L, H, D]) with scalar step
@@ -610,7 +621,7 @@ def decode_with_prefix(model, params, prefix_state, prompt,
     sample, top_k, use_top_p, use_min_p = _sampling_flags(
         temperature, top_k, top_p, min_p)
     use_eos = eos_id is not None
-    return _decode_with_prefix_impl(
+    out = _decode_with_prefix_impl(
         model, params, cache, jnp.asarray(prompt, jnp.int32),
         max_new_tokens, jnp.asarray(temperature, jnp.float32), rng,
         jnp.asarray(prompt_len, jnp.int32),
@@ -619,7 +630,78 @@ def decode_with_prefix(model, params, prefix_state, prompt,
         jnp.asarray(eos_id if use_eos else -1, jnp.int32),
         fan_out=b // prefix_b, sample=sample, top_k=top_k,
         use_top_p=use_top_p, use_min_p=use_min_p, use_eos=use_eos,
-        fast_prefill=bool(fast_prefill))
+        fast_prefill=bool(fast_prefill),
+        return_cache=bool(return_state))
+    if not return_state:
+        return out
+    seq, new_cache = out
+    # Tokens RESIDENT in the cache: everything applied through the
+    # model — the final sampled token is not yet among them (the
+    # next call applies it as its 1-token prompt).
+    resident = prefix_len + prompt.shape[1] + max_new_tokens - 1
+    return seq, (new_cache, resident, max_total_len)
+
+
+def stream_decode(model, params, prompt, max_new_tokens, *,
+                  chunk=16, temperature=0.0, rng=None, top_k=0,
+                  top_p=1.0, min_p=0.0, eos_id=None):
+    """Incremental generation: yields [B, <=chunk] token blocks as
+    they are produced — the API behind serving's streaming
+    responses, built on the prefix-cache continuation
+    (``decode_with_prefix(return_state=True)``).
+
+    The prompt (full-width [B, P] int32, no padding) prefills once;
+    each chunk is one compiled decode program (at most two distinct
+    programs: the steady chunk size and the remainder), and the
+    cache carries across chunks so total work matches one-shot
+    decode. Greedy chunked output is token-for-token the one-shot
+    ``decode`` result; sampling draws a fresh rng split per chunk
+    (same per-token distribution, different stream than one-shot).
+    ``eos_id`` freezes finished rows across chunk boundaries
+    (host-side: the in-program freeze only sees its own chunk) and
+    stops early once every row finished.
+    """
+    b, p = jnp.asarray(prompt).shape
+    if max_new_tokens < 1:
+        raise ValueError("stream_decode needs max_new_tokens >= 1")
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1: {chunk}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    total = p + max_new_tokens
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if p >= 2:
+        # Keep the last prompt token OUT of the prefix: each
+        # decode_with_prefix call needs >= 1 token to feed, and its
+        # logits produce the first generated token.
+        state = prefill_prefix(model, params, prompt[:, :-1],
+                               max_total_len=total)
+        feed = prompt[:, -1:]
+    else:
+        # 1-token prompt: no prefix to prefill; an untouched cache
+        # with a zero-length "prefix" is a valid state by
+        # construction (the stepwise scan applies the fed token).
+        _, cache = init_cache(model, b, total)
+        state = (cache, 0, total)
+        feed = prompt
+    done = np.zeros((b,), bool)
+    remaining = max_new_tokens
+    while remaining > 0:
+        n = min(chunk, remaining)
+        rng, sub = jax.random.split(rng)
+        seq, state = decode_with_prefix(
+            model, params, state, feed, n, temperature=temperature,
+            rng=sub, top_k=top_k, top_p=top_p, min_p=min_p,
+            eos_id=eos_id, return_state=True)
+        block = np.asarray(seq[:, 1:]).copy()
+        feed = seq[:, -1:]
+        remaining -= n
+        if eos_id is not None:
+            block[done] = int(eos_id)
+            done |= (block == int(eos_id)).any(axis=1)
+        yield block
+        if eos_id is not None and bool(done.all()):
+            return
 
 
 @functools.partial(jax.jit,

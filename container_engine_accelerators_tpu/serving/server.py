@@ -169,6 +169,37 @@ class _Batcher:
                 self._release(len(batch))
 
 
+class _StreamBody:
+    """Iterator wrapper owning a streaming response's admission slot.
+
+    Generator finalization is NOT a reliable release point: a
+    generator that was never iterated (client gone before the first
+    body write) runs none of its code on close()/GC, so a finally
+    inside it would leak the slot. close() here releases exactly
+    once regardless of how far iteration got, and the HTTP handler
+    calls it in its own finally.
+    """
+
+    def __init__(self, gen, release):
+        self._gen = gen
+        self._release = release
+        self._released = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self):
+        try:
+            self._gen.close()
+        finally:
+            if not self._released:
+                self._released = True
+                self._release()
+
+
 class _BaseServer:
     """HTTP scaffolding shared by the predict and generate servers:
     /healthz, /stats, latency bookkeeping, and one POST route."""
@@ -247,6 +278,38 @@ class _BaseServer:
                 except Exception as e:  # model/runtime failure
                     log.exception("POST handler failed")
                     code, resp = 500, {"error": str(e)}
+                if code == 200 and hasattr(resp, "__next__"):
+                    # Streaming response: one JSON line per block
+                    # (ndjson). All validation happened before the
+                    # body was returned; a decode failure mid-stream
+                    # surfaces as a final {"error"} line (the 200 is
+                    # already on the wire). HTTP/1.0 + connection
+                    # close frames the body. Headers are INSIDE the
+                    # try: a client that disconnected before
+                    # end_headers() must still reach the finally —
+                    # resp.close() releases the admission slot even
+                    # for a never-iterated body (_StreamBody.close
+                    # does not rely on generator finalization).
+                    try:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.end_headers()
+                        for item in resp:
+                            self.wfile.write(
+                                (json.dumps(item) + "\n").encode())
+                            self.wfile.flush()
+                    except Exception as e:
+                        log.exception("stream failed")
+                        try:
+                            self.wfile.write((json.dumps(
+                                {"error": str(e)}) + "\n").encode())
+                        except OSError:
+                            pass  # client went away
+                    finally:
+                        resp.close()
+                    server._record(time.perf_counter() - t0)
+                    return
                 if code == 200:
                     server._record(time.perf_counter() - t0)
                 self._reply(code, resp)
@@ -809,6 +872,93 @@ class GenerationServer(_BaseServer):
             return list(zip(np.asarray(seq)[:n], np.asarray(lp)[:n]))
         return np.asarray(out)[:n]
 
+    STREAM_CHUNK = 16
+
+    def _stream_response(self, row, p_len, new, temperature, top_k,
+                         top_p, min_p, eos_id, decode_text):
+        """Generator behind ``"stream": true``: one request row
+        decodes in STREAM_CHUNK-token program calls against a cache
+        carried across calls (decode_with_prefix(return_state=True)),
+        yielding {"tokens": [...]} ndjson lines as blocks land.
+
+        Program-set discipline: the per-call horizon follows SERVER
+        constants — n = STREAM_CHUNK for every call except a final
+        max_new % STREAM_CHUNK remainder — so per bucket at most
+        three extra programs ((1, bucket) feed + the two (1, 1)
+        horizons) and ONE cache shape, sized prefix + bucket +
+        max_new: exactly the budget server construction already
+        guarantees fits max_seq_len (and the shared prefix state),
+        however large the bucket. A right-padded row's generation
+        overwrites its padding (standard decode semantics), so the
+        generated region is contiguous from p_len and the host
+        cursor just slices it; the schedule may stop early once
+        ``new`` tokens (<= max_new) are out. Streaming rows do not
+        cross-request batch; they hold one admission slot for the
+        stream's lifetime (released by _StreamBody.close, not here —
+        a never-iterated generator runs no finally). The stream ends
+        at the first EOS (emitted), or after ``new`` tokens.
+        """
+        from ..models.decode import decode_with_prefix, init_cache
+
+        chunk = self.STREAM_CHUNK
+        bucket = int(row.shape[0])
+        total = self._prefix_len + bucket + self._max_new
+        eos = None if eos_id < 0 else int(eos_id)
+        if self._prefix_state is not None:
+            state = self._prefix_state
+        else:
+            _, cache = init_cache(self._model, 1, total)
+            state = (cache, 0, total)
+        feed = jnp.asarray(row[None, :])
+        feed_plen = int(p_len)
+        emitted = 0
+        pending = []
+        call_budget = self._max_new
+        with self._stats_lock:
+            self._seed += 1
+            seed = self._seed
+        rng = jax.random.PRNGKey(seed)
+        while emitted < new:
+            # Each call yields >= n fresh tokens and call_budget
+            # only depletes by n, so emitted reaches new (<= max_new)
+            # no later than call_budget reaches 0. The guard is
+            # belt-and-braces against that invariant ever breaking —
+            # a 0-token decode call would loop forever.
+            n = min(chunk, call_budget)
+            if n <= 0:
+                break
+            call_budget -= n
+            rng, sub = jax.random.split(rng)
+            with self._stats_lock:
+                self._decode_calls += 1
+                self._decode_rows += 1
+            seq, state = decode_with_prefix(
+                self._model, self._params, state, feed, n,
+                temperature=temperature, rng=sub, top_k=top_k,
+                top_p=top_p, min_p=min_p, eos_id=eos,
+                prompt_len=feed_plen, fast_prefill=False,
+                return_state=True)
+            gen = np.asarray(seq[0, feed_plen:])
+            feed = seq[:, -1:]
+            feed_plen = 1
+            pending.extend(int(t) for t in gen)
+            take = min(len(pending), new - emitted)
+            block, pending = pending[:take], pending[take:]
+            if eos is not None and eos in block:
+                block = block[:block.index(eos) + 1]
+                emitted = new  # ends the loop after this yield
+            else:
+                emitted += len(block)
+            line = {"tokens": block}
+            if decode_text is not None:
+                ids = (block[:-1] if eos is not None
+                       and block and block[-1] == eos else block)
+                line["completion_delta"] = decode_text(ids)
+            yield line
+            if eos is not None and line["tokens"][-1:] == [eos]:
+                break
+        yield {"done": True}
+
     def _batcher_for(self, bucket, sampling, top_k, want_lp=False,
                      plain=True, filtered=False):
         # ``plain`` keys penalty-free rows (the speculative-eligible
@@ -889,8 +1039,12 @@ class GenerationServer(_BaseServer):
             rep_pen = float(payload.get("repetition_penalty", 1.0))
             min_p = float(payload.get("min_p", 0.0))
             want_lp = bool(payload.get("logprobs", False))
+            stream = bool(payload.get("stream", False))
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"error": f"bad request: {e}"}
+        if stream and (want_lp or rep_pen != 1.0):
+            return 400, {"error": "stream does not support logprobs "
+                                  "or repetition_penalty"}
         if not -1 <= eos_id < self._model.vocab_size:
             return 400, {"error": f"eos_id must be -1 (off) or in "
                                   f"0..{self._model.vocab_size - 1}"}
@@ -961,6 +1115,24 @@ class GenerationServer(_BaseServer):
                                   f"max {self._buckets[-1]}"}
         padded = np.zeros((arr.shape[0], bucket), np.int32)
         padded[:, :p_len] = arr
+        if stream:
+            if arr.shape[0] != 1:
+                return 400, {"error": "stream requires exactly one "
+                                      "prompt"}
+            if new < 1:
+                return 400, {"error": "stream requires "
+                                      "max_new_tokens >= 1"}
+            if not self._admission.try_acquire(1):
+                with self._stats_lock:
+                    self._shed += 1
+                return 503, {"error": "server overloaded; retry"}
+            decode_text = (self._tokenizer.decode
+                           if texts is not None else None)
+            return 200, _StreamBody(
+                self._stream_response(
+                    padded[0], p_lens[0], new, temperature, top_k,
+                    top_p, min_p, eos_id, decode_text),
+                functools.partial(self._admission.release, 1))
         batcher = self._batcher_for(
             bucket, temperature > 0.0, top_k, want_lp,
             plain=self._default_knobs(rep_pen),

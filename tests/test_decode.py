@@ -853,3 +853,79 @@ def test_prefix_cache_fast_suffix_prefill_matches_stepwise(dense_lm):
         decode_with_prefix(model, params, state, suffixes, N,
                            prompt_len=jnp.array([4, 5, 5]),
                            fast_prefill=True)
+
+
+def test_stream_decode_greedy_equals_one_shot(dense_lm):
+    """Chunked streaming generation is token-for-token the one-shot
+    greedy decode — chunk boundaries change when tokens arrive,
+    never what they are."""
+    from container_engine_accelerators_tpu.models.decode import (
+        stream_decode,
+    )
+
+    model, params, prompt = dense_lm
+    want = np.asarray(greedy_decode(model, params, prompt, N))
+    for chunk in (1, 3, N):
+        blocks = list(stream_decode(model, params, prompt, N,
+                                    chunk=chunk))
+        got = np.concatenate(blocks, axis=1)
+        assert got.shape == (B, N)
+        np.testing.assert_array_equal(got, want[:, P:])
+
+
+def test_stream_decode_single_token_prompt(dense_lm):
+    from container_engine_accelerators_tpu.models.decode import (
+        stream_decode,
+    )
+
+    model, params, _ = dense_lm
+    prompt = jnp.array([[7], [9]], jnp.int32)
+    want = np.asarray(greedy_decode(model, params, prompt, 6))
+    got = np.concatenate(
+        list(stream_decode(model, params, prompt, 6, chunk=2)),
+        axis=1)
+    np.testing.assert_array_equal(got, want[:, 1:])
+
+
+def test_stream_decode_eos_freezes_and_stops(dense_lm):
+    """A row that emits EOS stays frozen in every later block, and
+    the stream ends early once all rows finish."""
+    from container_engine_accelerators_tpu.models.decode import (
+        stream_decode,
+    )
+
+    model, params, prompt = dense_lm
+    full = np.asarray(greedy_decode(model, params, prompt, N))
+    # Use the token the model actually generates first as row 0's
+    # EOS, so the freeze provably triggers mid-stream.
+    eos = int(full[0, P])
+    blocks = list(stream_decode(model, params, prompt, N, chunk=2,
+                                eos_id=eos))
+    got = np.concatenate(blocks, axis=1)
+    row0 = got[0]
+    first = int(np.argmax(row0 == eos))
+    assert (row0[first:] == eos).all()  # frozen after first EOS
+    # Single-row stream whose first generated token IS the EOS: the
+    # early-stop must end the stream after the first block instead
+    # of emitting all N tokens.
+    one = prompt[:1]
+    blocks1 = list(stream_decode(model, params, one, N, chunk=2,
+                                 eos_id=eos))
+    total1 = sum(b.shape[1] for b in blocks1)
+    assert total1 < N  # genuinely stopped early
+    assert int(blocks1[0][0, 0]) == eos
+
+
+def test_stream_decode_sampling_in_vocab(dense_lm):
+    from container_engine_accelerators_tpu.models.decode import (
+        stream_decode,
+    )
+
+    model, params, prompt = dense_lm
+    got = np.concatenate(
+        list(stream_decode(model, params, prompt, 8, chunk=3,
+                           temperature=0.9, top_k=8,
+                           rng=jax.random.PRNGKey(5))),
+        axis=1)
+    assert got.shape == (B, 8)
+    assert ((got >= 0) & (got < V)).all()

@@ -1084,3 +1084,115 @@ def test_prefix_server_construction_errors():
         GenerationServer("x", model, params, port=0,
                          max_new_tokens=8,
                          prefix_tokens=list(range(32)))
+
+
+def _post_stream(server, path, payload):
+    """POST and read the ndjson stream; returns the parsed lines."""
+    req = urllib.request.Request(
+        f"http://localhost:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    lines = []
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers["Content-Type"] == "application/x-ndjson"
+        for raw in resp:
+            raw = raw.strip()
+            if raw:
+                lines.append(json.loads(raw))
+    return lines
+
+
+def test_stream_generate_matches_non_stream(lm_server):
+    """"stream": true yields the same greedy tokens as the one-shot
+    response, in >= 1 ndjson blocks, ending with {"done": true}."""
+    one = post(lm_server, "/v1/models/lm:generate",
+               {"prompts": [[1, 2, 3, 4]], "max_new_tokens": 8})
+    lines = _post_stream(lm_server, "/v1/models/lm:generate",
+                         {"prompts": [[1, 2, 3, 4]],
+                          "max_new_tokens": 8, "stream": True})
+    assert lines[-1] == {"done": True}
+    got = [t for line in lines[:-1] for t in line["tokens"]]
+    assert got == one["sequences"][0][4:]
+
+
+def test_stream_generate_eos_ends_stream(lm_server):
+    one = post(lm_server, "/v1/models/lm:generate",
+               {"prompts": [[5, 6, 7]], "max_new_tokens": 8})
+    eos = one["sequences"][0][3]  # first generated token
+    lines = _post_stream(lm_server, "/v1/models/lm:generate",
+                         {"prompts": [[5, 6, 7]],
+                          "max_new_tokens": 8, "stream": True,
+                          "eos_id": eos})
+    toks = [t for line in lines[:-1] for t in line.get("tokens", [])]
+    assert toks[-1] == eos and len(toks) <= 8
+    assert lines[-1] == {"done": True}
+
+
+def test_stream_validation(lm_server):
+    for bad in ({"logprobs": True}, {"repetition_penalty": 1.2},
+                {"prompts": [[1], [2]]}):
+        body = {"prompts": [[1, 2]], "max_new_tokens": 4,
+                "stream": True}
+        body.update(bad)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(lm_server, "/v1/models/lm:generate", body)
+        assert err.value.code == 400
+
+
+def test_stream_on_prefix_server_matches_plain(prefix_server):
+    """Streaming on a system-prompt server continues the shared
+    prefix state: tokens equal the non-streamed suffix response."""
+    srv, _, _, _ = prefix_server
+    one = post(srv, "/v1/models/lm-sys:generate",
+               {"prompts": [[2, 4, 6]], "max_new_tokens": 6})
+    lines = _post_stream(srv, "/v1/models/lm-sys:generate",
+                         {"prompts": [[2, 4, 6]],
+                          "max_new_tokens": 6, "stream": True})
+    got = [t for line in lines[:-1] for t in line["tokens"]]
+    assert got == one["sequences"][0][3:]
+
+
+def test_stream_admission_released_without_iteration():
+    """A streaming body that is closed without ever being iterated
+    (client gone before the first write) must still release its
+    admission slot — generator finalization alone would leak it."""
+    from container_engine_accelerators_tpu.serving.server import (
+        _StreamBody,
+    )
+
+    released = []
+
+    def gen():
+        try:
+            yield {"tokens": [1]}
+        finally:
+            released.append("gen-finally")
+
+    body = _StreamBody(gen(), lambda: released.append("slot"))
+    body.close()  # never iterated
+    assert released == ["slot"]  # slot freed; gen finally never ran
+    # Iterated bodies release exactly once too.
+    released.clear()
+    body2 = _StreamBody(gen(), lambda: released.append("slot"))
+    next(body2)
+    body2.close()
+    assert released == ["gen-finally", "slot"]
+    body2.close()
+    assert released == ["gen-finally", "slot"]  # idempotent
+
+
+def test_stream_largest_bucket_fits_budget(prefix_server):
+    """Streaming a prompt in the LARGEST bucket must fit the prefix
+    state's capacity (regression: chunk-quantized cache sizing used
+    to overflow max_total_len for big buckets and error mid-stream)."""
+    srv, _, _, _ = prefix_server
+    prompt = list(range(1, 21))  # 20 tokens -> top bucket
+    one = post(srv, "/v1/models/lm-sys:generate",
+               {"prompts": [prompt], "max_new_tokens": 8})
+    lines = _post_stream(srv, "/v1/models/lm-sys:generate",
+                         {"prompts": [prompt], "max_new_tokens": 8,
+                          "stream": True})
+    assert lines[-1] == {"done": True}
+    assert not any("error" in l for l in lines)
+    got = [t for line in lines[:-1] for t in line["tokens"]]
+    assert got == one["sequences"][0][20:]
