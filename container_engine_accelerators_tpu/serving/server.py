@@ -688,6 +688,22 @@ class GenerationServer(_BaseServer):
                 self._run([(zeros, 1.0, b, 1.0, -1, 1.1, 0.0)], 1.0,
                           force_plain=True)
             for spec in self._warm_filters:
+                if spec.get("stream"):
+                    # Mirror request routing exactly (same rule as
+                    # the non-stream specs below): the spec's
+                    # mode/filter knobs select the compiled stream
+                    # variants, temperature defaulting to 1.0 like
+                    # every other warm spec — deployments with
+                    # greedy streams add {"stream": true,
+                    # "temperature": 0}.
+                    self._warm_stream(
+                        zeros, b,
+                        float(spec.get("temperature", 1.0)),
+                        self._quantize_top_k(
+                            int(spec.get("top_k", 0))),
+                        float(spec.get("top_p", 1.0)),
+                        float(spec.get("min_p", 0.0)))
+                    continue
                 temp = float(spec.get("temperature", 1.0))
                 top_k = self._quantize_top_k(int(spec.get("top_k", 0)))
                 tp_f = float(spec.get("top_p", 1.0))
@@ -874,6 +890,66 @@ class GenerationServer(_BaseServer):
 
     STREAM_CHUNK = 16
 
+    def _stream_call(self, state, feed, feed_plen, n, temperature,
+                     top_k, top_p, min_p, eos, rng):
+        """The ONE decode invocation shape behind streaming —
+        shared by the request path and warm-up so the warmed
+        programs are exactly what live streams select."""
+        from ..models.decode import decode_with_prefix
+
+        with self._stats_lock:
+            self._decode_calls += 1
+            self._decode_rows += 1
+        return decode_with_prefix(
+            self._model, self._params, state, feed, n,
+            temperature=temperature, rng=rng, top_k=top_k,
+            top_p=top_p, min_p=min_p, eos_id=eos,
+            prompt_len=feed_plen, fast_prefill=False,
+            return_state=True)
+
+    def _stream_fresh_state(self, bucket):
+        """Initial stream state for one request row: the shared
+        prefix state, or an untouched cache with the ONE stream
+        cache shape (prefix + bucket + max_new — the budget server
+        construction already guarantees fits max_seq_len)."""
+        from ..models.decode import init_cache
+
+        total = self._prefix_len + bucket + self._max_new
+        if self._prefix_state is not None:
+            return self._prefix_state
+        _, cache = init_cache(self._model, 1, total)
+        return (cache, 0, total)
+
+    def _warm_stream(self, row, bucket, temperature, top_k, top_p,
+                     min_p):
+        """Compile one bucket's COMPLETE stream program set in at
+        most three calls instead of draining max_new tokens.
+
+        The request schedule's horizons are n = min(STREAM_CHUNK,
+        remaining budget), so the distinct programs are: the
+        (1, bucket) first call at n1 = min(chunk, max_new); the
+        (1, 1) remainder horizon (max_new % n1, when nonzero); and
+        the (1, 1) full-chunk horizon (only reachable when
+        max_new >= 2*chunk). Run in that order they fit the one
+        cache shape exactly: n1 + rem + chunk <= max_new whenever
+        the third program exists.
+        """
+        chunk = min(self.STREAM_CHUNK, self._max_new)
+        rem = self._max_new % chunk
+        rng = jax.random.PRNGKey(0)
+        state = self._stream_fresh_state(bucket)
+        seq, state = self._stream_call(
+            state, jnp.asarray(row[None, :]), bucket, chunk,
+            temperature, top_k, top_p, min_p, None, rng)
+        if rem:
+            seq, state = self._stream_call(
+                state, seq[:, -1:], 1, rem, temperature, top_k,
+                top_p, min_p, None, rng)
+        if self._max_new >= 2 * chunk:
+            self._stream_call(
+                state, seq[:, -1:], 1, chunk, temperature, top_k,
+                top_p, min_p, None, rng)
+
     def _stream_response(self, row, p_len, new, temperature, top_k,
                          top_p, min_p, eos_id, decode_text):
         """Generator behind ``"stream": true``: one request row
@@ -898,17 +974,10 @@ class GenerationServer(_BaseServer):
         a never-iterated generator runs no finally). The stream ends
         at the first EOS (emitted), or after ``new`` tokens.
         """
-        from ..models.decode import decode_with_prefix, init_cache
-
-        chunk = self.STREAM_CHUNK
+        chunk = min(self.STREAM_CHUNK, self._max_new)
         bucket = int(row.shape[0])
-        total = self._prefix_len + bucket + self._max_new
         eos = None if eos_id < 0 else int(eos_id)
-        if self._prefix_state is not None:
-            state = self._prefix_state
-        else:
-            _, cache = init_cache(self._model, 1, total)
-            state = (cache, 0, total)
+        state = self._stream_fresh_state(bucket)
         feed = jnp.asarray(row[None, :])
         feed_plen = int(p_len)
         emitted = 0
@@ -929,15 +998,9 @@ class GenerationServer(_BaseServer):
                 break
             call_budget -= n
             rng, sub = jax.random.split(rng)
-            with self._stats_lock:
-                self._decode_calls += 1
-                self._decode_rows += 1
-            seq, state = decode_with_prefix(
-                self._model, self._params, state, feed, n,
-                temperature=temperature, rng=sub, top_k=top_k,
-                top_p=top_p, min_p=min_p, eos_id=eos,
-                prompt_len=feed_plen, fast_prefill=False,
-                return_state=True)
+            seq, state = self._stream_call(
+                state, feed, feed_plen, n, temperature, top_k,
+                top_p, min_p, eos, sub)
             gen = np.asarray(seq[0, feed_plen:])
             feed = seq[:, -1:]
             feed_plen = 1

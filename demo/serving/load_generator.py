@@ -52,14 +52,17 @@ def _generate_payloads(args, rng):
                               size=(p_len,)).tolist()
         temperature = (0.0 if rng.random() < 0.5
                        else round(float(rng.uniform(0.5, 1.5)), 2))
-        yield json.dumps({
+        body = {
             "prompts": [prompt],
             "max_new_tokens": args.max_new_tokens,
             "temperature": temperature,
-        }).encode()
+        }
+        if getattr(args, "stream", False):
+            body["stream"] = True
+        yield json.dumps(body).encode()
 
 
-def worker(url, payloads, n, results, errors):
+def worker(url, payloads, n, results, errors, ttfb=None):
     for payload, _ in zip(payloads, range(n)):
         t0 = time.perf_counter()
         try:
@@ -67,6 +70,13 @@ def worker(url, payloads, n, results, errors):
                 url, data=payload,
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=60) as resp:
+                if ttfb is not None:
+                    # Streaming: the latency that matters is
+                    # time-to-first-block, recorded separately from
+                    # whole-stream completion.
+                    first = resp.readline()
+                    if first:
+                        ttfb.append(time.perf_counter() - t0)
                 resp.read()
             results.append(time.perf_counter() - t0)
         except Exception as e:
@@ -91,7 +101,13 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-n", "--num-requests", type=int, default=1000)
     p.add_argument("--parallelism", type=int, default=30)
+    p.add_argument("--stream", action="store_true",
+                   help="generate mode: request \"stream\": true "
+                        "and additionally report time-to-first-block "
+                        "percentiles")
     args = p.parse_args(argv)
+    if args.stream and args.mode != "generate":
+        p.error("--stream applies to --mode generate")
 
     url = (f"http://{args.host}:{args.port}/v1/models/"
            f"{args.model_name}:{args.mode}")
@@ -99,11 +115,12 @@ def main(argv=None):
                      else _generate_payloads)
     per_worker = max(args.num_requests // args.parallelism, 1)
     results, errors = [], []
+    ttfb = [] if args.stream else None
     threads = [threading.Thread(
         target=worker,
         args=(url, make_payloads(args,
                                  np.random.default_rng(args.seed + i)),
-              per_worker, results, errors))
+              per_worker, results, errors, ttfb))
         for i in range(args.parallelism)]
     t0 = time.perf_counter()
     for t in threads:
@@ -120,6 +137,12 @@ def main(argv=None):
         "p50_ms": round(statistics.median(lat) * 1000, 2) if lat else None,
         "p99_ms": round(lat[int(len(lat) * 0.99)] * 1000, 2) if lat else None,
     }
+    if ttfb:
+        tt = sorted(ttfb)
+        summary["ttfb_p50_ms"] = round(
+            statistics.median(tt) * 1000, 2)
+        summary["ttfb_p99_ms"] = round(
+            tt[int(len(tt) * 0.99)] * 1000, 2)
     if errors:
         by_kind = {}
         for kind in errors:

@@ -130,8 +130,9 @@ def main(argv=None):
     p.add_argument("--warm-filters", default="",
                    help="JSON list of sampling-option dicts (top_k, "
                         "top_p, min_p, repetition_penalty, logprobs, "
-                        "temperature) to additionally precompile, "
-                        "e.g. '[{\"top_k\": 40, \"top_p\": 0.9}]'")
+                        "temperature, stream) to additionally "
+                        "precompile, e.g. "
+                        "'[{\"top_k\": 40}, {\"stream\": true}]'")
     p.add_argument("--kv-cache-dtype", choices=["bfloat16", "int8"],
                    default="bfloat16",
                    help="int8 halves KV-cache residency per replica "

@@ -1196,3 +1196,41 @@ def test_stream_largest_bucket_fits_budget(prefix_server):
     assert not any("error" in l for l in lines)
     got = [t for line in lines[:-1] for t in line["tokens"]]
     assert got == one["sequences"][0][20:]
+
+
+def test_stream_warm_filter_precompiles():
+    """Stream warm specs compile each bucket's stream program set in
+    at most three calls, honoring the spec's mode knobs — the warm
+    composition is pinned exactly, so deleting the stream branch (or
+    draining full streams again) fails this test."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    # max_new 24, STREAM_CHUNK 16 -> chunk 16, rem 8, max_new < 2*16:
+    # per bucket the stream set is first(16) + remainder(8) = 2
+    # calls. Buckets for max_prompt 40: [16, 32, 40] -> 3 buckets.
+    # Default warm = 2 calls/bucket; two stream specs (greedy +
+    # sampling) add 2*2 calls/bucket: total 3 * (2 + 4) = 18.
+    srv = GenerationServer(
+        "lm-ws", model, params, port=0, max_new_tokens=24,
+        max_batch=2, warm=True,
+        warm_filters=[{"stream": True, "temperature": 0},
+                      {"stream": True}])
+    srv.start()
+    try:
+        assert srv.stats()["decode_calls"] == 18
+        lines = _post_stream(srv, "/v1/models/lm-ws:generate",
+                             {"prompts": [[1, 2, 3]],
+                              "max_new_tokens": 6, "stream": True})
+        assert lines[-1] == {"done": True}
+        got = [t for line in lines[:-1] for t in line["tokens"]]
+        assert len(got) == 6
+    finally:
+        srv.stop()
