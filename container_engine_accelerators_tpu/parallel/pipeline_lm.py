@@ -80,6 +80,12 @@ class PipelinedLM:
     pipe: int
     mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
+    # Rematerialize each stage in the backward pass: per tick, only
+    # the stage INPUT is saved (the jax.checkpoint residual) instead
+    # of every block-internal activation (the 4x-wide MLP hidden,
+    # attention intermediates), traded for one extra stage forward —
+    # the standard pipeline + remat composition for deep models.
+    remat: bool = False
 
     def __post_init__(self):
         if self.pipe < 1 or self.num_layers % self.pipe != 0:
@@ -160,6 +166,9 @@ class PipelinedLM:
 
         def stage_fn(block_params, h):
             return block.apply({"params": block_params}, h)
+
+        if self.remat:
+            stage_fn = jax.checkpoint(stage_fn)
 
         x = circular_pipeline_apply(
             mesh, stage_fn, params["blocks"], x,

@@ -439,7 +439,6 @@ def run_pipeline_lm(args, devices):
         "--fsdp": args.fsdp,
         "--grad-accum": args.grad_accum > 1,
         "--ema-decay": args.ema_decay > 0,
-        "--remat": args.remat,
         "--eval-batches": args.eval_batches > 0,
         "--data-dir": bool(args.data_dir),
         "--num-kv-heads": args.num_kv_heads > 0,
@@ -462,7 +461,7 @@ def run_pipeline_lm(args, devices):
                      num_layers=args.num_layers,
                      num_heads=args.num_heads,
                      max_seq_len=args.seq_len, pipe=pp,
-                     dtype=jnp.bfloat16)
+                     dtype=jnp.bfloat16, remat=args.remat)
     params = lm.init(jax.random.PRNGKey(0))
     params = jax.device_put(params, lm.shardings(mesh, params))
     tx = build_tx(args)

@@ -328,3 +328,34 @@ def test_pipelined_lm_layer_divisibility_error():
     with pytest.raises(ValueError, match="placement order"):
         lm.apply(params, jnp.zeros((8, 8), jnp.int32), mesh=mesh2,
                  num_microbatches=2)
+
+
+def test_pipelined_lm_remat_grads_match():
+    """remat=True changes memory/recompute, never the math: loss and
+    grads equal the non-remat model exactly."""
+    from container_engine_accelerators_tpu.parallel.pipeline_lm import (
+        PipelinedLM,
+    )
+
+    kw = dict(vocab_size=31, embed_dim=16, num_layers=8, num_heads=4,
+              max_seq_len=16, pipe=4, dtype=jnp.float32)
+    lm = PipelinedLM(**kw)
+    lm_r = PipelinedLM(**kw, remat=True)
+    mesh = build_pipeline_mesh(4, data=2)
+    params = lm.init(jax.random.PRNGKey(20))
+    tokens = jax.random.randint(jax.random.PRNGKey(21), (8, 12), 0, 31)
+
+    def loss(model, params):
+        logits = model.apply(params, tokens, mesh=mesh,
+                             num_microbatches=2)
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        return -jnp.mean(jnp.take_along_axis(
+            logp, tokens[:, 1:, None], axis=-1))
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(lm, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(lm_r, p))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        g0, g1)
