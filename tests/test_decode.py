@@ -929,3 +929,30 @@ def test_stream_decode_sampling_in_vocab(dense_lm):
         axis=1)
     assert got.shape == (B, 8)
     assert ((got >= 0) & (got < V)).all()
+
+
+def test_prefix_cache_composes_int8_gqa_rope():
+    """The prefix path on a GQA + rope + int8-cache model (the
+    serving-economy composition): greedy equality with full decode,
+    incl. the int8 scale leaves riding the cache fan-out."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=4, num_kv_heads=2,
+                          pos_embedding="rope", kv_cache_dtype="int8",
+                          max_seq_len=MAXLEN, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(40), (1, 6), 0, V)
+    params = model.init(jax.random.PRNGKey(41), tokens)["params"]
+    suffixes = jax.random.randint(jax.random.PRNGKey(42), (2, 4), 0, V)
+    state = prefill_prefix(model, params, tokens,
+                           max_total_len=6 + 4 + N)
+    got = decode_with_prefix(model, params, state, suffixes, N)
+    full = decode(
+        model, params,
+        jnp.concatenate([jnp.broadcast_to(tokens, (2, 6)), suffixes],
+                        axis=1), N)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(full)[:, 6:])
