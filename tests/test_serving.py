@@ -1234,3 +1234,40 @@ def test_stream_warm_filter_precompiles():
         assert len(got) == 6
     finally:
         srv.stop()
+
+
+def test_stream_on_spec_server_matches_plain_greedy():
+    """"stream": true on a speculative-enabled server rides the
+    plain stream chain (no spec for streams) and still returns
+    exactly the plain greedy tokens."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    draft = TransformerLM(vocab_size=64, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=48,
+                          dtype=jnp.float32)
+    dparams = draft.init(jax.random.PRNGKey(2),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm-ss", model, params, port=0,
+                           max_new_tokens=8, max_batch=2,
+                           buckets=[8], draft_model=draft,
+                           draft_params=dparams, speculative_k=4)
+    srv.start()
+    try:
+        one = post(srv, "/v1/models/lm-ss:generate",
+                   {"prompts": [[1, 2, 3]], "max_new_tokens": 6})
+        lines = _post_stream(srv, "/v1/models/lm-ss:generate",
+                             {"prompts": [[1, 2, 3]],
+                              "max_new_tokens": 6, "stream": True})
+        got = [t for line in lines[:-1] for t in line["tokens"]]
+        assert got == one["sequences"][0][3:]
+        assert lines[-1] == {"done": True}
+    finally:
+        srv.stop()
