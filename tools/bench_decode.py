@@ -94,9 +94,18 @@ def main(argv=None):
                         "the system-prompt fan-out path; the row "
                         "reports the one-time prefill cost "
                         "separately")
+    p.add_argument("--stream-chunk", type=int, default=0,
+                   help="N>0: generate through stream_decode in "
+                        "N-token blocks (the serving streaming "
+                        "path) instead of one compiled scan — the "
+                        "row quantifies the chunked-decode tax vs "
+                        "one-shot")
     args = p.parse_args(argv)
     if args.prefix_len and args.speculative_k:
         p.error("--prefix-len does not compose with --speculative-k")
+    if args.stream_chunk and (args.speculative_k or args.prefix_len):
+        p.error("--stream-chunk does not compose with "
+                "--speculative-k/--prefix-len")
 
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.models.decode import decode
@@ -195,6 +204,24 @@ def main(argv=None):
                 temperature=args.temperature,
                 rng=jax.random.PRNGKey(3))
 
+    stream_extra = {}
+    if args.stream_chunk:
+        from container_engine_accelerators_tpu.models.decode import (
+            stream_decode,
+        )
+
+        stream_extra = {"stream_chunk": args.stream_chunk}
+
+        def run(prompt):
+            last = None
+            for block in stream_decode(
+                    model, params, prompt, args.new_tokens,
+                    chunk=args.stream_chunk,
+                    temperature=args.temperature,
+                    rng=jax.random.PRNGKey(3)):
+                last = block
+            return last
+
     for b in args.batch:
         prompt = jax.random.randint(
             jax.random.PRNGKey(1), (b, args.prompt_len), 0,
@@ -228,6 +255,7 @@ def main(argv=None):
             "ms_per_token": round(sec / args.new_tokens * 1000, 3),
             **spec,
             **prefix_extra,
+            **stream_extra,
         }))
 
 

@@ -188,6 +188,11 @@ dec2() {  # one retry after a pause: a transient tunnel drop mid-
   # continuation timed alone (models/decode.py prefill_prefix).
   dec2 --batch 1 8 \
     --prompt-len 32 --new-tokens 128 --prefix-len 96 || DECODE_RC=1
+  # Streaming: chunked decode (the serving stream path) vs the
+  # one-shot scan above — the row quantifies the per-block host
+  # sync + dispatch tax.
+  dec2 --batch 1 \
+    --prompt-len 128 --new-tokens 128 --stream-chunk 16 || DECODE_RC=1
 } > "${OUT}/DECODE_BENCH.json.tmp" 2>> "${OUT}/tpu_suite.log" 9>&-
 # Exit codes don't catch the CPU-fallback mode (a dropped tunnel lets
 # every run succeed on host CPU) — check the platform each row
