@@ -140,6 +140,11 @@ def parse_args(argv=None):
     p.add_argument("--lr-warmup-steps", type=int, default=0)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--grad-clip", type=float, default=0.0,
+                   help="clip gradients to this global L2 norm "
+                        "before the update (0 = off)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="parameter-init PRNG seed")
     def _smoothing(v):
         v = float(v)
         if not 0.0 <= v < 1.0:
@@ -398,7 +403,12 @@ def build_tx(args):
                  args.lr, 0.0,
                  max(args.steps - args.lr_warmup_steps, 1))],
             [args.lr_warmup_steps])
-    return optax.chain(
+    steps = []
+    if args.grad_clip > 0:
+        # Before decay/momentum: the clip bounds the raw gradient's
+        # global norm, the convention every major trainer follows.
+        steps.append(optax.clip_by_global_norm(args.grad_clip))
+    steps += [
         # Decay kernels only: biases and norm scales (ndim < 2) pull
         # toward zero under decay with no regularization benefit —
         # the standard mask.
@@ -407,7 +417,8 @@ def build_tx(args):
             mask=lambda params: jax.tree_util.tree_map(
                 lambda p: getattr(p, "ndim", 0) >= 2, params)),
         optax.sgd(lr, momentum=args.momentum),
-    )
+    ]
+    return optax.chain(*steps)
 
 
 def run_pipeline_lm(args, devices):
@@ -462,7 +473,7 @@ def run_pipeline_lm(args, devices):
                      num_heads=args.num_heads,
                      max_seq_len=args.seq_len, pipe=pp,
                      dtype=jnp.bfloat16, remat=args.remat)
-    params = lm.init(jax.random.PRNGKey(0))
+    params = lm.init(jax.random.PRNGKey(args.seed))
     params = jax.device_put(params, lm.shardings(mesh, params))
     tx = build_tx(args)
     opt_state = tx.init(params)
@@ -739,7 +750,8 @@ def main(argv=None):
                       grad_accum=args.grad_accum, augment_fn=augment_fn,
                       ema_decay=args.ema_decay, fsdp=args.fsdp)
 
-    variables = model.init(jax.random.PRNGKey(0), init_batch, train=False)
+    variables = model.init(jax.random.PRNGKey(args.seed), init_batch,
+                           train=False)
     state = trainer.init_state(variables)
     if args.model_dir:
         if args.model_dir.startswith("gs://"):

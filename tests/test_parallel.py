@@ -667,3 +667,23 @@ def test_train_driver_pipeline_parallelism(tmp_path):
     import pytest as _pytest
     with _pytest.raises(SystemExit, match="fsdp"):
         mod.main(args + ["--fsdp"])
+
+
+def test_train_driver_grad_clip_and_seed():
+    """--grad-clip bounds the raw gradient's global norm inside the
+    shared optimizer chain, and --seed changes the init stream
+    (different final loss for a fixed data stream)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "demo_train_clip", "demo/tpu-training/train.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    base = ["--model", "mnist", "--steps", "2", "--warmup-steps", "0",
+            "--batch-size", "16"]
+    r_clip = mod.main(base + ["--grad-clip", "1e-8"])
+    r_free = mod.main(base)
+    # A vanishing clip norm freezes learning: the unclipped run must
+    # end at a strictly lower loss than the frozen one.
+    assert r_free["final_loss"] < r_clip["final_loss"]
+    r_seed = mod.main(base + ["--seed", "7"])
+    assert r_seed["final_loss"] != r_free["final_loss"]
