@@ -403,11 +403,13 @@ def build_tx(args):
                  args.lr, 0.0,
                  max(args.steps - args.lr_warmup_steps, 1))],
             [args.lr_warmup_steps])
-    steps = []
-    if args.grad_clip > 0:
-        # Before decay/momentum: the clip bounds the raw gradient's
-        # global norm, the convention every major trainer follows.
-        steps.append(optax.clip_by_global_norm(args.grad_clip))
+    # Before decay/momentum: the clip bounds the raw gradient's
+    # global norm, the convention every major trainer follows. The
+    # slot ALWAYS exists (identity when off, same EmptyState) so the
+    # opt_state pytree structure — and therefore checkpoint resume —
+    # is stable across a --grad-clip toggle.
+    steps = [optax.clip_by_global_norm(args.grad_clip)
+             if args.grad_clip > 0 else optax.identity()]
     steps += [
         # Decay kernels only: biases and norm scales (ndim < 2) pull
         # toward zero under decay with no regularization benefit —
