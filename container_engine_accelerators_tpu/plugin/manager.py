@@ -27,13 +27,14 @@ TPU-specific departures:
 """
 
 import os
+import re
 import threading
 import time
 from concurrent import futures
 
 import grpc
 
-from ..chip import get_backend
+from ..chip import ChipBackendError, get_backend
 from ..utils import accel_index, get_logger, is_accel_name
 from . import config as cfg
 from .api import (
@@ -273,7 +274,19 @@ class TpuManager:
         reference leaving NCCL to the workload, SURVEY.md s2.4).
         """
         chips = sorted({c for d in device_ids for c in self.device_chips(d)})
-        coords = [self._backend.chip_coords(c) for c in chips]
+        try:
+            coords = [self._backend.chip_coords(c) for c in chips]
+        except ChipBackendError as e:
+            # Hot-unplug race: the device passed the health gate but
+            # its chip left the backend before the coord read. The
+            # Allocate error contract is KeyError/ValueError (mapped
+            # to INVALID_ARGUMENT); a raw backend error would surface
+            # as gRPC UNKNOWN — the internal-exception shape the
+            # stress suite treats as a bug. The kubelet re-gates via
+            # the ListAndWatch update the same rescan publishes.
+            raise KeyError(
+                f"invalid allocation request: chip vanished during "
+                f"allocation ({e})") from e
         return topology_envs(chips, coords, worker_id=self._worker_id,
                              worker_hostnames=self._worker_hostnames,
                              process_bounds=self._process_bounds)
@@ -283,6 +296,24 @@ class TpuManager:
             v1beta1_pb2.Mount(container_path=c, host_path=h, read_only=True)
             for c, h in self._mount_paths
         ]
+
+    @staticmethod
+    def _first_n(available, must_include, size):
+        """must_include + first available fillers (NATURAL id order:
+        accel2 before accel10 — a lexicographic sort would scatter
+        the fallback across the torus on 10+-chip hosts), the
+        advisory fallback when topology can't be consulted."""
+        def natural(d):
+            return [int(t) if t.isdigit() else t
+                    for t in re.split(r"(\d+)", d)]
+
+        chosen = list(must_include)
+        for d in sorted(available, key=natural):
+            if len(chosen) >= size:
+                break
+            if d not in chosen:
+                chosen.append(d)
+        return chosen[:size]
 
     def preferred_allocation(self, available, must_include, size):
         """Topology-compact preferred set.
@@ -299,12 +330,28 @@ class TpuManager:
         """
         if size <= 0 or size > len(available):
             return list(available)[:max(size, 0)]
-        if self._config.tpu_partition_size:
-            return self._preferred_slices(available, must_include, size)
-        avail_chips = {self.device_chips(d)[0]: d for d in available}
-        must_chips = {self.device_chips(d)[0] for d in must_include}
-        dims = self._backend.topology()
-        chip_at = {self._backend.chip_coords(c): c for c in avail_chips}
+        try:
+            if self._config.tpu_partition_size:
+                return self._preferred_slices(available, must_include,
+                                              size)
+            avail_chips = {self.device_chips(d)[0]: d
+                           for d in available}
+            must_chips = {self.device_chips(d)[0]
+                          for d in must_include}
+            dims = self._backend.topology()
+            chip_at = {self._backend.chip_coords(c): c
+                       for c in avail_chips}
+        except ChipBackendError as e:
+            # Hot-unplug race mid-query: a chip in the kubelet's
+            # availability snapshot left the backend. Preference is
+            # advisory — fall back to first-N (the reference's stub
+            # behavior) rather than failing the RPC; the kubelet's
+            # next ListAndWatch update re-gates the vanished device.
+            # Logged: a PERSISTENT backend failure degrading every
+            # preference to first-N must be visible to operators.
+            log.warning("preferred_allocation: backend unavailable "
+                        "(%s); falling back to first-N", e)
+            return self._first_n(available, must_include, size)
         best = None
         for bx, by, bz in _box_shapes(size, dims):
             # Prefer the most cube-like box; skip shapes that cannot
@@ -317,14 +364,12 @@ class TpuManager:
                 best = (score, box)
         if best is not None:
             return sorted(avail_chips[c] for c in best[1])
-        chosen = [avail_chips[c] for c in sorted(must_chips)]
-        for c in sorted(avail_chips):
-            d = avail_chips[c]
-            if len(chosen) >= size:
-                break
-            if d not in chosen:
-                chosen.append(d)
-        return chosen[:size]
+        # No box fits the availability: same advisory fallback as the
+        # backend-unavailable path (one implementation, natural chip
+        # order).
+        return self._first_n(
+            available, [avail_chips[c] for c in sorted(must_chips)],
+            size)
 
     def _preferred_slices(self, available, must_include, size):
         """Preferred set of subslice devices: greedy, ICI-adjacent.

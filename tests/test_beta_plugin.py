@@ -220,3 +220,40 @@ def test_kubelet_restart_triggers_reserve(node4, fast_intervals):
             stub = api.DevicePluginV1Beta1Stub(ch)
             opts = stub.GetDevicePluginOptions(api.v1beta1_pb2.Empty())
             assert opts.get_preferred_allocation_available
+
+
+def test_allocate_chip_vanished_is_invalid_argument(node4,
+                                                    fast_intervals):
+    """Hot-unplug race: a device passes the health gate but its chip
+    leaves the backend before the coord read (stress-suite find).
+    The Allocate error contract must hold — KeyError mapped to
+    INVALID_ARGUMENT, never a raw backend error surfacing as
+    UNKNOWN."""
+    from container_engine_accelerators_tpu.chip import (
+        ChipBackendError,
+    )
+
+    manager = make_manager(node4)
+    try:
+        # Simulate the interleaving deterministically: the health map
+        # still lists accel3 but the backend no longer knows chip 3.
+        del manager._backend._coords[3]
+        with pytest.raises(KeyError, match="vanished"):
+            manager.allocate_envs(["accel3"])
+        # Preference is advisory: same race falls back to first-N
+        # instead of raising.
+        got = manager.preferred_allocation(
+            ["accel0", "accel1", "accel3"], ["accel1"], 2)
+        assert got == ["accel1", "accel0"]
+        # The raw backend error shape never escapes either call.
+        for fn in (lambda: manager.allocate_envs(["accel3"]),
+                   lambda: manager.preferred_allocation(
+                       ["accel3"], [], 1)):
+            try:
+                fn()
+            except ChipBackendError:
+                pytest.fail("raw ChipBackendError escaped")
+            except KeyError:
+                pass
+    finally:
+        manager.stop()
