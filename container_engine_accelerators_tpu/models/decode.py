@@ -706,8 +706,9 @@ def stream_decode(model, params, prompt, max_new_tokens, *,
 
 @functools.partial(jax.jit,
                    static_argnames=("model", "max_new_tokens",
-                                    "num_beams"))
-def _beam_impl(model, params, prompt, max_new_tokens, *, num_beams):
+                                    "num_beams", "use_eos"))
+def _beam_impl(model, params, prompt, max_new_tokens, eos_id, *,
+               num_beams, use_eos=False):
     b, p = prompt.shape
     k = num_beams
     total = p + max_new_tokens
@@ -739,12 +740,24 @@ def _beam_impl(model, params, prompt, max_new_tokens, *, num_beams):
     scores0 = jnp.where(jnp.arange(k) == 0, 0.0, -jnp.inf)
     scores0 = jnp.broadcast_to(scores0, (b, k))
     seqs0 = jnp.zeros((b, k, max_new_tokens), prompt.dtype)
+    finished0 = jnp.zeros((b, k), bool)
 
-    def select(seqs, scores, logprobs, t):
+    def freeze_finished(logprobs, finished):
+        # A finished beam's only continuation is EOS at logprob 0:
+        # its score freezes while it keeps competing in the top-k —
+        # the static-shape equivalent of a finished-hypothesis set.
+        if not use_eos:
+            return logprobs
+        frozen = jnp.full((v,), -jnp.inf).at[eos_id].set(0.0)
+        return jnp.where(finished.reshape(b * k, 1), frozen[None],
+                         logprobs)
+
+    def select(seqs, scores, finished, logprobs, t):
         # Combine beam scores with next-token logprobs; pick the K
         # best (beam, token) pairs per batch element. Beams whose
         # score is -inf (k exceeds the number of distinct
         # continuations so far) get token 0 as defined padding.
+        logprobs = freeze_finished(logprobs, finished)
         totals = (scores[:, :, None]
                   + logprobs.reshape(b, k, v)).reshape(b, k * v)
         new_scores, idx = jax.lax.top_k(totals, k)      # [B, K]
@@ -755,7 +768,10 @@ def _beam_impl(model, params, prompt, max_new_tokens, *, num_beams):
         seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
         seqs = jax.lax.dynamic_update_index_in_dim(
             seqs, token, t, axis=2)
-        return seqs, new_scores, token, flat_parent
+        if use_eos:
+            finished = (jnp.take_along_axis(finished, parent, axis=1)
+                        | (token == eos_id))
+        return seqs, new_scores, finished, token, flat_parent
 
     def reorder(tree, flat_parent):
         # Gather beam-major leaves; scalars (pos_index) are shared.
@@ -764,32 +780,34 @@ def _beam_impl(model, params, prompt, max_new_tokens, *, num_beams):
             a.shape[0] == b * k else a, tree)
 
     def expand(carry, t):
-        cache, seqs, scores, logprobs = carry
-        seqs, scores, token, flat_parent = select(
-            seqs, scores, logprobs, t)
+        cache, seqs, scores, finished, logprobs = carry
+        seqs, scores, finished, token, flat_parent = select(
+            seqs, scores, finished, logprobs, t)
         cache = reorder(cache, flat_parent)
         outputs, updated = decode_model.apply(
             {"params": params, "cache": cache},
             token.reshape(b * k, 1), train=False, mutable=["cache"])
         logprobs = jax.nn.log_softmax(
             _logits_of(outputs)[:, 0].astype(jnp.float32), axis=-1)
-        return (updated["cache"], seqs, scores, logprobs), None
+        return (updated["cache"], seqs, scores, finished,
+                logprobs), None
 
     # The final expansion needs no model apply (its logprobs would be
     # discarded), so the scan runs max_new_tokens - 1 applies and the
     # last selection happens outside.
     if max_new_tokens > 1:
-        (cache, seqs0, scores0, logprobs), _ = jax.lax.scan(
-            expand, (cache, seqs0, scores0, logprobs),
+        (cache, seqs0, scores0, finished0, logprobs), _ = jax.lax.scan(
+            expand, (cache, seqs0, scores0, finished0, logprobs),
             jnp.arange(max_new_tokens - 1))
-    seqs, scores, _, _ = select(seqs0, scores0, logprobs,
-                                max_new_tokens - 1)
+    seqs, scores, _, _, _ = select(seqs0, scores0, finished0,
+                                   logprobs, max_new_tokens - 1)
     full = jnp.concatenate(
         [jnp.broadcast_to(prompt[:, None], (b, k, p)), seqs], axis=2)
     return full, scores
 
 
-def beam_search(model, params, prompt, max_new_tokens, *, num_beams=4):
+def beam_search(model, params, prompt, max_new_tokens, *,
+                num_beams=4, eos_id=None):
     """Beam-search generation: the num_beams highest sum-logprob
     continuations per batch element.
 
@@ -802,13 +820,34 @@ def beam_search(model, params, prompt, max_new_tokens, *, num_beams=4):
     (sequences [B, K, P + max_new_tokens], scores [B, K]), beams
     sorted best-first; num_beams=1 is exactly greedy. When num_beams
     exceeds the number of distinct continuations (k > V^n), the
-    surplus beams come back with score -inf and token-0 padding. No
-    EOS handling — the demo models have no end-of-sequence
-    semantics; callers that need it can post-trim.
+    surplus beams come back with score -inf and token-0 padding.
+
+    ``eos_id`` (None = off): a beam that emits EOS is FINISHED — its
+    score freezes (the only continuation is EOS at logprob 0, the
+    static-shape equivalent of a finished-hypothesis set) while it
+    keeps competing with live beams for the top-K; finished rows
+    pad with EOS, so callers trim at the first EOS. A sequence's
+    score is then the sum of logprobs through its first EOS —
+    pinned against exhaustive enumeration under the same semantics.
     """
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1: {num_beams}")
     if max_new_tokens < 1:
         raise ValueError("beam_search needs max_new_tokens >= 1")
+    use_eos = eos_id is not None
+    if use_eos:
+        # Scalar only (unlike decode's per-row vector): the frozen
+        # continuation row is one [V] one-hot shared by every beam.
+        eos_host = np.asarray(eos_id)
+        if eos_host.ndim != 0:
+            raise ValueError(
+                "beam_search eos_id must be a scalar (per-row EOS "
+                "vectors are a decode()/stream_decode() feature)")
+        if not 0 <= int(eos_host) < model.vocab_size:
+            raise ValueError(
+                f"eos_id must be in 0..{model.vocab_size - 1}: "
+                f"{eos_id}")
     return _beam_impl(model, params, prompt, max_new_tokens,
-                      num_beams=int(num_beams))
+                      jnp.asarray(eos_id if use_eos else -1,
+                                  jnp.int32),
+                      num_beams=int(num_beams), use_eos=use_eos)

@@ -956,3 +956,80 @@ def test_prefix_cache_composes_int8_gqa_rope():
                         axis=1), N)
     np.testing.assert_array_equal(np.asarray(got),
                                   np.asarray(full)[:, 6:])
+
+
+def test_beam_eos_equals_exhaustive_truncated_scoring():
+    """With eos_id set and num_beams >= V^N, the best beam equals
+    the exhaustive argmax where a path's score is the sum of
+    logprobs through its FIRST eos (finished-hypothesis semantics),
+    and the winning row pads with eos after finishing."""
+    import itertools
+
+    v, n, eos = 5, 3, 2
+    model = TransformerLM(vocab_size=v, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=8,
+                          dtype=jnp.float32)
+    prompt = jnp.asarray([[1, 3]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(6), prompt)["params"]
+    seqs, scores = beam_search(model, params, prompt, n,
+                               num_beams=v ** n, eos_id=eos)
+
+    def truncated_score(path):
+        # Model logprobs along the path, stopping at the first eos;
+        # positions after it contribute nothing (the in-beam freeze).
+        seq = jnp.asarray([[1, 3, *path]], jnp.int32)
+        logits = model.apply({"params": params}, seq, train=False)
+        lp = jax.nn.log_softmax(
+            np.asarray(logits)[0].astype(np.float32), axis=-1)
+        score = 0.0
+        for t in range(1, n + 1):
+            score += lp[t, seq[0, t + 1]]
+            if int(seq[0, t + 1]) == eos:
+                break
+        return score
+
+    best_score, best_path = -np.inf, None
+    seen = set()
+    for path in itertools.product(range(v), repeat=n):
+        # Canonicalize: tokens after the first eos are frozen to eos
+        # in the beam representation, so distinct raw paths that
+        # share a truncated form are ONE hypothesis.
+        canon = []
+        done = False
+        for tok in path:
+            canon.append(eos if done else tok)
+            done = done or tok == eos
+        canon = tuple(canon)
+        if canon in seen:
+            continue
+        seen.add(canon)
+        score = truncated_score(canon)
+        if score > best_score:
+            best_score, best_path = score, canon
+    np.testing.assert_array_equal(np.asarray(seqs[0, 0, 2:]),
+                                  np.asarray(best_path))
+    np.testing.assert_allclose(float(scores[0, 0]), best_score,
+                               rtol=1e-4, atol=1e-4)
+    # A finished winner stays frozen: everything after its first eos
+    # is eos.
+    row = np.asarray(seqs[0, 0, 2:])
+    if eos in row:
+        first = int(np.argmax(row == eos))
+        assert (row[first:] == eos).all()
+
+
+def test_beam_eos_off_unchanged(dense_lm):
+    """eos_id=None reproduces the exact pre-EOS beam behavior."""
+    model, params, prompt = dense_lm
+    a, sa = beam_search(model, params, prompt, 6, num_beams=3)
+    b_, sb = beam_search(model, params, prompt, 6, num_beams=3,
+                         eos_id=None)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+
+
+def test_beam_eos_vector_rejected(dense_lm):
+    model, params, prompt = dense_lm
+    with pytest.raises(ValueError, match="scalar"):
+        beam_search(model, params, prompt, 4, num_beams=2,
+                    eos_id=jnp.array([2, 2]))
