@@ -706,12 +706,19 @@ def stream_decode(model, params, prompt, max_new_tokens, *,
 
 @functools.partial(jax.jit,
                    static_argnames=("model", "max_new_tokens",
-                                    "num_beams", "use_eos"))
-def _beam_impl(model, params, prompt, max_new_tokens, eos_id, *,
-               num_beams, use_eos=False):
+                                    "num_beams", "use_eos",
+                                    "use_lp"))
+def _beam_impl(model, params, prompt, max_new_tokens, eos_id, alpha,
+               *, num_beams, use_eos=False, use_lp=False):
     b, p = prompt.shape
     k = num_beams
     total = p + max_new_tokens
+
+    def lp(n):
+        # GNMT length penalty ((5 + n) / 6)^alpha: dividing a
+        # (negative) sum-logprob by lp > 1 lifts longer finished
+        # hypotheses toward zero.
+        return ((5.0 + n.astype(jnp.float32)) / 6.0) ** alpha
 
     # Prefill ONCE on [B] rows, then fan the cache out to [B*K]
     # beam rows — beams are identical until the first expansion, so
@@ -752,26 +759,52 @@ def _beam_impl(model, params, prompt, max_new_tokens, eos_id, *,
         return jnp.where(finished.reshape(b * k, 1), frozen[None],
                          logprobs)
 
-    def select(seqs, scores, finished, logprobs, t):
+    def select(seqs, scores, finished, gen_len, logprobs, t):
         # Combine beam scores with next-token logprobs; pick the K
         # best (beam, token) pairs per batch element. Beams whose
         # score is -inf (k exceeds the number of distinct
         # continuations so far) get token 0 as defined padding.
         logprobs = freeze_finished(logprobs, finished)
         totals = (scores[:, :, None]
-                  + logprobs.reshape(b, k, v)).reshape(b, k * v)
-        new_scores, idx = jax.lax.top_k(totals, k)      # [B, K]
+                  + logprobs.reshape(b, k, v))           # [B, K, V]
+        if use_lp:
+            # Any candidate ENDING in EOS is a finished hypothesis
+            # and competes penalized AT ITS TRUE LENGTH: a live
+            # beam's eos column finishes it at gen_len + 1, a
+            # finished beam's (its only finite entry) stays frozen
+            # at gen_len. Everything not ending in EOS competes raw
+            # (finished beams' non-eos columns are -inf anyway).
+            # Penalizing only at the step AFTER emission would let
+            # last-step finishers rank raw. Raw scores stay the
+            # carried quantity — -inf stays -inf under the division,
+            # so pad beams are unaffected.
+            fin_len = jnp.where(finished, gen_len, gen_len + 1)
+            eos_col = jnp.take_along_axis(
+                totals, jnp.full((b, k, 1), eos_id), axis=2)[..., 0]
+            eff = jnp.where(
+                (jnp.arange(v)[None, None, :] == eos_id),
+                (eos_col / lp(fin_len))[:, :, None], totals)
+        else:
+            eff = totals
+        totals = totals.reshape(b, k * v)
+        eff_scores, idx = jax.lax.top_k(eff.reshape(b, k * v), k)
+        new_scores = jnp.take_along_axis(totals, idx, axis=1)
         parent = idx // v
         token = (idx % v).astype(prompt.dtype)
-        token = jnp.where(jnp.isfinite(new_scores), token, 0)
+        token = jnp.where(jnp.isfinite(eff_scores), token, 0)
         flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
         seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
         seqs = jax.lax.dynamic_update_index_in_dim(
             seqs, token, t, axis=2)
         if use_eos:
-            finished = (jnp.take_along_axis(finished, parent, axis=1)
-                        | (token == eos_id))
-        return seqs, new_scores, finished, token, flat_parent
+            parent_fin = jnp.take_along_axis(finished, parent, axis=1)
+            # Generated length counts tokens through the first EOS:
+            # already-finished parents stop counting.
+            gen_len = (jnp.take_along_axis(gen_len, parent, axis=1)
+                       + (~parent_fin).astype(jnp.int32))
+            finished = parent_fin | (token == eos_id)
+        return (seqs, new_scores, finished, gen_len, token,
+                flat_parent, eff_scores)
 
     def reorder(tree, flat_parent):
         # Gather beam-major leaves; scalars (pos_index) are shared.
@@ -779,35 +812,45 @@ def _beam_impl(model, params, prompt, max_new_tokens, eos_id, *,
             lambda a: a[flat_parent] if a.ndim and
             a.shape[0] == b * k else a, tree)
 
+    gen_len0 = jnp.zeros((b, k), jnp.int32)
+
     def expand(carry, t):
-        cache, seqs, scores, finished, logprobs = carry
-        seqs, scores, finished, token, flat_parent = select(
-            seqs, scores, finished, logprobs, t)
+        cache, seqs, scores, finished, gen_len, logprobs = carry
+        (seqs, scores, finished, gen_len, token,
+         flat_parent, _) = select(
+            seqs, scores, finished, gen_len, logprobs, t)
         cache = reorder(cache, flat_parent)
         outputs, updated = decode_model.apply(
             {"params": params, "cache": cache},
             token.reshape(b * k, 1), train=False, mutable=["cache"])
         logprobs = jax.nn.log_softmax(
             _logits_of(outputs)[:, 0].astype(jnp.float32), axis=-1)
-        return (updated["cache"], seqs, scores, finished,
+        return (updated["cache"], seqs, scores, finished, gen_len,
                 logprobs), None
 
     # The final expansion needs no model apply (its logprobs would be
     # discarded), so the scan runs max_new_tokens - 1 applies and the
     # last selection happens outside.
     if max_new_tokens > 1:
-        (cache, seqs0, scores0, finished0, logprobs), _ = jax.lax.scan(
-            expand, (cache, seqs0, scores0, finished0, logprobs),
+        (cache, seqs0, scores0, finished0, gen_len0,
+         logprobs), _ = jax.lax.scan(
+            expand,
+            (cache, seqs0, scores0, finished0, gen_len0, logprobs),
             jnp.arange(max_new_tokens - 1))
-    seqs, scores, _, _, _ = select(seqs0, scores0, finished0,
-                                   logprobs, max_new_tokens - 1)
+    seqs, scores, _, _, _, _, eff = select(
+        seqs0, scores0, finished0, gen_len0, logprobs,
+        max_new_tokens - 1)
     full = jnp.concatenate(
         [jnp.broadcast_to(prompt[:, None], (b, k, p)), seqs], axis=2)
-    return full, scores
+    # With a length penalty the ranking quantity is the effective
+    # (penalized-if-finished) score, already sorted best-first by the
+    # final top_k; without one the raw sum-logprob is returned as
+    # before.
+    return full, (eff if use_lp else scores)
 
 
 def beam_search(model, params, prompt, max_new_tokens, *,
-                num_beams=4, eos_id=None):
+                num_beams=4, eos_id=None, length_penalty=0.0):
     """Beam-search generation: the num_beams highest sum-logprob
     continuations per batch element.
 
@@ -829,6 +872,13 @@ def beam_search(model, params, prompt, max_new_tokens, *,
     pad with EOS, so callers trim at the first EOS. A sequence's
     score is then the sum of logprobs through its first EOS —
     pinned against exhaustive enumeration under the same semantics.
+
+    ``length_penalty`` (GNMT alpha; 0.0 = off, requires eos_id):
+    finished beams compete with score / ((5 + len)/6)^alpha — len
+    counting generated tokens through the first EOS — lifting longer
+    finished hypotheses; live beams compete raw (the t5x/brevity
+    convention). Returned scores are then the penalized ranking
+    quantity. Pinned against exhaustive enumeration.
     """
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1: {num_beams}")
@@ -847,7 +897,14 @@ def beam_search(model, params, prompt, max_new_tokens, *,
             raise ValueError(
                 f"eos_id must be in 0..{model.vocab_size - 1}: "
                 f"{eos_id}")
+    use_lp = float(length_penalty) != 0.0
+    if use_lp and not use_eos:
+        raise ValueError(
+            "length_penalty applies to finished beams and therefore "
+            "requires eos_id")
     return _beam_impl(model, params, prompt, max_new_tokens,
                       jnp.asarray(eos_id if use_eos else -1,
                                   jnp.int32),
-                      num_beams=int(num_beams), use_eos=use_eos)
+                      jnp.asarray(length_penalty, jnp.float32),
+                      num_beams=int(num_beams), use_eos=use_eos,
+                      use_lp=use_lp)

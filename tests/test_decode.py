@@ -1033,3 +1033,74 @@ def test_beam_eos_vector_rejected(dense_lm):
     with pytest.raises(ValueError, match="scalar"):
         beam_search(model, params, prompt, 4, num_beams=2,
                     eos_id=jnp.array([2, 2]))
+
+
+@pytest.mark.parametrize("seed,n", [
+    (6, 3),
+    # seed 9 / n=2: the best penalized path emits EOS exactly at the
+    # final generated token — the case where a one-step-late penalty
+    # would rank it raw (review find).
+    (9, 2),
+])
+def test_beam_length_penalty_equals_exhaustive(seed, n):
+    """With length_penalty alpha and full-width beams, the best beam
+    equals the exhaustive argmax where every hypothesis ending in
+    eos ranks by score / ((5+len)/6)^alpha (len through first eos)
+    and live ones rank raw — the GNMT/t5x convention."""
+    import itertools
+
+    v, eos, alpha = 5, 2, 1.4
+    model = TransformerLM(vocab_size=v, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=8,
+                          dtype=jnp.float32)
+    prompt = jnp.asarray([[1, 3]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), prompt)["params"]
+    seqs, scores = beam_search(model, params, prompt, n,
+                               num_beams=v ** n, eos_id=eos,
+                               length_penalty=alpha)
+
+    def path_eff(canon):
+        seq = jnp.asarray([[1, 3, *canon]], jnp.int32)
+        logits = model.apply({"params": params}, seq, train=False)
+        lp_ = jax.nn.log_softmax(
+            np.asarray(logits)[0].astype(np.float32), axis=-1)
+        raw, length, finished = 0.0, 0, False
+        for t in range(1, n + 1):
+            raw += lp_[t, seq[0, t + 1]]
+            length += 1
+            if int(seq[0, t + 1]) == eos:
+                finished = True
+                break
+        if finished:
+            return raw / (((5.0 + length) / 6.0) ** alpha)
+        return raw
+
+    best_eff, best_path = -np.inf, None
+    seen = set()
+    for path in itertools.product(range(v), repeat=n):
+        canon, done = [], False
+        for tok in path:
+            canon.append(eos if done else tok)
+            done = done or tok == eos
+        canon = tuple(canon)
+        if canon in seen:
+            continue
+        seen.add(canon)
+        eff = path_eff(canon)
+        if eff > best_eff:
+            best_eff, best_path = eff, canon
+    np.testing.assert_array_equal(np.asarray(seqs[0, 0, 2:]),
+                                  np.asarray(best_path))
+    np.testing.assert_allclose(float(scores[0, 0]), best_eff,
+                               rtol=1e-4, atol=1e-4)
+    # alpha=0 via the use_lp gate is byte-identical to the plain EOS
+    # path.
+    a0, s0 = beam_search(model, params, prompt, n, num_beams=4,
+                         eos_id=eos)
+    a1, s1 = beam_search(model, params, prompt, n, num_beams=4,
+                         eos_id=eos, length_penalty=0.0)
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+    np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
+    with pytest.raises(ValueError, match="requires eos_id"):
+        beam_search(model, params, prompt, n, num_beams=2,
+                    length_penalty=0.5)
