@@ -1,0 +1,120 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""bench.py supervisor contract: a parseable JSON line ALWAYS lands.
+
+Four consecutive rounds of driver perf records were rc=124 with
+``parsed: null`` because the supervisor printed its one diagnostic
+line only after every retry + backoff completed — slower than the
+driver's kill timer (VERDICT r4, "What's weak" #1).  The fixed
+contract under test:
+
+  * a cumulative diagnostic line is printed at supervisor start and
+    after EVERY failed attempt (last-line-wins), so an external
+    SIGKILL at any moment leaves a parseable record on stdout;
+  * BENCH_TOTAL_BUDGET_S caps the whole run — probes, attempts and
+    backoffs are clamped to the remaining budget and the final line
+    prints before the budget expires.
+
+The probe subprocesses these tests spawn target the axon tunnel
+(down or absent in CI), so every attempt fails fast at its clamped
+probe cap — exactly the failure mode the driver sees.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from tests.conftest import REPO_ROOT
+
+BENCH = os.path.join(REPO_ROOT, "bench.py")
+
+
+def _env(**extra):
+    env = dict(os.environ)
+    # Force the supervisor down its failure path deterministically:
+    # probes run with the inherited axon,cpu pin (sitecustomize), the
+    # tunnel is absent in CI, so each probe hangs or falls back to CPU
+    # and is refused. BENCH_PLATFORMS must NOT be set — that would
+    # make CPU a legal measurement platform.
+    env.pop("BENCH_PLATFORMS", None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _json_lines(out):
+    rows = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            rows.append(json.loads(line))
+    return rows
+
+
+def test_total_budget_caps_run_and_final_line_lands():
+    # Budget must exceed MIN_USEFUL_S or no attempt starts at all;
+    # the override keeps the test fast while the production default
+    # (420s) refuses guaranteed-futile budget-tail attempts.
+    budget = 150
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, BENCH], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=_env(BENCH_ATTEMPTS=6, BENCH_BACKOFF_S=2,
+                 BENCH_TOTAL_BUDGET_S=budget,
+                 BENCH_MIN_USEFUL_S=90,
+                 BENCH_PROBE_TIMEOUT_S=20),
+        timeout=budget + 60)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 1
+    # The run must respect the budget (plus modest slack for python
+    # startup), not the 6-attempt worst case of probes + backoffs.
+    assert elapsed < budget + 45, elapsed
+    rows = _json_lines(proc.stdout.decode())
+    # At least: the at-start emission, one per-failure emission, and
+    # the final one.
+    assert len(rows) >= 3, rows
+    final = rows[-1]
+    assert final["value"] == 0.0
+    assert final["metric"] == "resnet50_train_throughput"
+    assert final["final"] is True
+    assert "error" in final and final["error"], final
+    # Every emission is the same cumulative shape — any of them is a
+    # valid driver record.
+    for row in rows:
+        assert row["value"] == 0.0
+        assert "vs_baseline" in row and "phase" in row
+
+
+def test_sigkill_mid_run_leaves_parseable_line():
+    """Kill the supervisor the moment its first line is out — the
+    stdout captured so far must already parse (the driver-kill case)."""
+    proc = subprocess.Popen(
+        [sys.executable, BENCH], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=_env(BENCH_ATTEMPTS=6, BENCH_BACKOFF_S=300,
+                 BENCH_TOTAL_BUDGET_S=3600,
+                 BENCH_PROBE_TIMEOUT_S=240))
+    try:
+        first = proc.stdout.readline().decode()
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    row = json.loads(first)
+    assert row["value"] == 0.0
+    assert row["unit"] == "images/sec/chip"
+    assert row["final"] is False
