@@ -141,6 +141,7 @@ class MoEBlock(nn.Module):
     window: int = 0
     weights: str = "native"
     chunk_attends_cache: bool = False
+    ring_slack: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -155,6 +156,7 @@ class MoEBlock(nn.Module):
                                 weights=self.weights,
                                 chunk_attends_cache=(
                                     self.chunk_attends_cache),
+                                ring_slack=self.ring_slack,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -196,6 +198,9 @@ class MoETransformerLM(nn.Module):
     # Speculative verify path: multi-token chunks attend a non-empty
     # KV cache (see CausalSelfAttention.chunk_attends_cache).
     chunk_attends_cache: bool = False
+    # Extra ring slots for speculation on sliding-window models (see
+    # CausalSelfAttention.ring_slack; changes the cache shape).
+    ring_slack: int = 0
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -234,6 +239,7 @@ class MoETransformerLM(nn.Module):
                     window=self.attention_window,
                     weights=self.weights,
                     chunk_attends_cache=self.chunk_attends_cache,
+                    ring_slack=self.ring_slack,
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -247,6 +253,7 @@ class MoETransformerLM(nn.Module):
                           window=self.attention_window,
                           weights=self.weights,
                           chunk_attends_cache=self.chunk_attends_cache,
+                          ring_slack=self.ring_slack,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -66,12 +66,19 @@ token-group-shaped and the identity breaks, so droppy configs raise.
 Sampling filters (top-k / top-p / min-p) compose with speculation:
 they transform p and q identically (rejection sampling is
 distribution-agnostic), so committed tokens follow the target's
-FILTERED distribution exactly. Not supported (raise): the
-repetition penalty under speculation (stateful over the committed
-prefix), sliding-window/ring caches (their prefill chunk write
-assumes offset 0). Reference repo has no counterpart (its serving
-demo is TF-Serving images, SURVEY.md section 2.3); this is
-framework-level capability the TPU stack adds.
+FILTERED distribution exactly. Sliding-window (ring-cache) models
+are supported on both sides: the verify chunk writes its K/V by
+scatter on the ring slots (the wrap split at a traced offset is
+data-dependent — transformer.py cache_write's chunk_attends_cache
+branch), and both caches are over-allocated by k slots
+(``ring_slack``) so optimistic writes can never evict a key still
+inside a post-rewind query's window band (eviction proof at the
+init_cache call site below). Output remains EXACTLY plain windowed
+decode's. Not supported (raise): the repetition penalty under
+speculation (stateful over the committed prefix). Reference repo
+has no counterpart (its serving demo is TF-Serving images,
+SURVEY.md section 2.3); this is framework-level capability the TPU
+stack adds.
 """
 
 import functools
@@ -158,6 +165,21 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         return jnp.take_along_axis(
             lsm, tok[..., None].astype(jnp.int32), -1)[..., 0]
 
+    # Sliding-window models: over-allocate the ring by k slots
+    # (ring_slack) so optimistic verify/draft writes — which run up
+    # to k positions past the committed index before a rewind — can
+    # never evict a key still inside a post-rewind query's window
+    # band. Eviction proof: a key at position pos leaves the ring
+    # when a write at pos + W + k lands; writes never run more than
+    # k positions ahead of the oldest query still to attend, so any
+    # evicted pos satisfies pos <= q - W - 1 — already outside q's
+    # (q - W, q] band. Stale (rejected) entries are masked by the
+    # k_pos <= q_pos test until the recommit pass rewrites their
+    # slot, which happens before any query reaches their position.
+    if getattr(model, "attention_window", 0):
+        model = model.clone(ring_slack=k)
+    if getattr(draft_model, "attention_window", 0):
+        draft_model = draft_model.clone(ring_slack=k)
     target_dec, target_cache = init_cache(model, b, total)
     verify_dec = target_dec.clone(chunk_attends_cache=True)
     draft_dec, draft_cache = init_cache(draft_model, b, total)
@@ -502,11 +524,6 @@ def check_spec_models(model, draft_model):
     never 500 its first request or wedge an async warm-up — on a
     config speculation cannot serve). ONE authority; keep call-time
     and construction-time checks from drifting."""
-    if getattr(model, "attention_window", 0) or getattr(
-            draft_model, "attention_window", 0):
-        raise ValueError(
-            "speculative decode does not support sliding-window "
-            "models (ring cache writes assume one-shot prefill)")
     for m, which in ((model, "target"), (draft_model, "draft")):
         if not hasattr(m, "chunk_attends_cache"):
             raise ValueError(
@@ -611,11 +628,16 @@ def speculative_decode(model, params, draft_model, draft_params,
     active rows alone. At least one row must be active. Variant
     selection is type-driven (None vs given), like prompt_len/eos_id.
 
-    Requirements: no repetition penalty, no sliding window on either
-    model, shared vocab, and P + max_new_tokens + k within both
-    models' max_seq_len. Per-row temperatures must be all zero
-    (greedy) or all positive (sampling) — the two are different
-    compiled programs, same rule as ``decode``.
+    Sliding-window models (target and/or draft) are supported; their
+    ring caches are over-allocated by ``k`` slots internally
+    (``ring_slack``) and the output still matches plain windowed
+    decode token-for-token (greedy) / in distribution (sampling).
+
+    Requirements: no repetition penalty, shared vocab, and
+    P + max_new_tokens + k within both models' max_seq_len. Per-row
+    temperatures must be all zero (greedy) or all positive
+    (sampling) — the two are different compiled programs, same rule
+    as ``decode``.
     """
     if max_new_tokens < 1:
         raise ValueError("speculative decode needs max_new_tokens >= 1")

@@ -157,6 +157,17 @@ class CausalSelfAttention(nn.Module):
     # compute path, never the cache variables, so a chunked clone
     # interoperates with the plain decode model's cache.
     chunk_attends_cache: bool = False
+    # Extra ring slots beyond `window` (sliding-window models only).
+    # Speculative decode sets this to its chunk width k: optimistic
+    # verify writes run up to k positions past the committed index,
+    # and with exactly `window` slots such a write could evict a key
+    # still inside a post-rewind query's attention band. With
+    # window + k slots, a write at position p + window + k can only
+    # land while every query is > p + window - k... (see
+    # models/speculative.py "windowed" notes for the full eviction
+    # proof). Affects the CACHE SHAPE: a slacked clone's cache is not
+    # interchangeable with a ring_slack=0 cache.
+    ring_slack: int = 0
 
     def _kv_heads(self):
         kv = self.num_kv_heads or self.num_heads
@@ -243,8 +254,8 @@ class CausalSelfAttention(nn.Module):
         # Sizing only applies at variable creation (the full-length
         # init pass); later calls see k.shape[1] == 1 and must take
         # the ring length from the existing buffer instead.
-        c_len = (min(k.shape[1], self.window) if ring
-                 else k.shape[1])
+        c_len = (min(k.shape[1], self.window + self.ring_slack)
+                 if ring else k.shape[1])
         cache_shape = k.shape[:1] + (c_len,) + k.shape[2:]
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
                                  cache_shape, cache_dtype)
@@ -282,8 +293,19 @@ class CausalSelfAttention(nn.Module):
             if p == 1:
                 return jax.lax.dynamic_update_slice(
                     buf, val, (0, i % c_len) + zeros)
-            n = min(p, c_len)  # only the last `window` entries matter
+            n = min(p, c_len)  # only the last `c_len` entries matter
             tail = val[:, p - n:]
+            if self.chunk_attends_cache:
+                # Mid-cache chunk (speculative verify) at a TRACED
+                # offset i: the ring wrap split is data-dependent, so
+                # write by scatter on the slot indices instead of a
+                # static two-piece split. Slots are n consecutive
+                # values mod c_len with n <= c_len — never duplicated,
+                # so the scatter order is immaterial. Chunk widths are
+                # k (small); the scatter is O(B * k) rows.
+                slots = (i + (p - n)
+                         + jnp.arange(n, dtype=jnp.int32)) % c_len
+                return buf.at[:, slots].set(tail)
             start = (p - n) % c_len
             first = min(n, c_len - start)
             buf = jax.lax.dynamic_update_slice(
@@ -412,6 +434,7 @@ class Block(nn.Module):
     window: int = 0
     weights: str = "native"
     chunk_attends_cache: bool = False
+    ring_slack: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -427,6 +450,7 @@ class Block(nn.Module):
                                 weights=self.weights,
                                 chunk_attends_cache=(
                                     self.chunk_attends_cache),
+                                ring_slack=self.ring_slack,
                                 name="attn")(x)
         quant = self.weights == "int8"
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -466,6 +490,9 @@ class TransformerLM(nn.Module):
     # Speculative-decode verify clones: multi-token chunks attend the
     # KV cache (see CausalSelfAttention.chunk_attends_cache).
     chunk_attends_cache: bool = False
+    # Extra ring slots for speculation on sliding-window models (see
+    # CausalSelfAttention.ring_slack; changes the cache shape).
+    ring_slack: int = 0
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -501,6 +528,7 @@ class TransformerLM(nn.Module):
                       window=self.attention_window,
                       weights=self.weights,
                       chunk_attends_cache=self.chunk_attends_cache,
+                      ring_slack=self.ring_slack,
                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,

@@ -114,10 +114,6 @@ def test_spec_validation():
         speculative_decode(target, tp, draft, dp, prompt, 0)
     with pytest.raises(ValueError, match="k must be"):
         speculative_decode(target, tp, draft, dp, prompt, 4, k=0)
-    wdraft, wdp = _make(embed=16, layers=1, heads=2, seed=1,
-                        attention_window=8)
-    with pytest.raises(ValueError, match="sliding-window"):
-        speculative_decode(target, tp, wdraft, wdp, prompt, 4)
     vdraft, vdp = _make(vocab=32, embed=16, layers=1, heads=2, seed=1)
     with pytest.raises(ValueError, match="vocab"):
         speculative_decode(target, tp, vdraft, vdp, prompt, 4)
@@ -762,3 +758,111 @@ def test_spec_filtered_sampling_structure():
     with pytest.raises(ValueError, match="min_p"):
         speculative_decode(target, tp, draft, dp, prompt, 4,
                            temperature=1.0, min_p=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window (ring cache) speculation: output must equal plain
+# WINDOWED decode exactly. Every config here wraps the ring
+# (prompt + max_new well past the window), so the scatter chunk
+# write, the ring_slack eviction margin, and the stale-slot masking
+# are all load-bearing, not idle paths.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_spec_windowed_target_equals_windowed_greedy(k):
+    target, tp = _make(seed=0, attention_window=8)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 8)
+    want = decode(target, tp, prompt, 24)
+    got = speculative_decode(target, tp, draft, dp, prompt, 24, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_windowed_draft_dense_target():
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99,
+                      attention_window=8)
+    prompt = _prompt(2, 8)
+    want = decode(target, tp, prompt, 24)
+    got = speculative_decode(target, tp, draft, dp, prompt, 24, k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_windowed_self_draft_full_acceptance():
+    """Windowed target == windowed draft: proposals all match, so
+    every round commits k tokens and the ring rewind machinery runs
+    at maximum optimistic depth."""
+    target, tp = _make(seed=0, attention_window=8)
+    prompt = _prompt(1, 8)
+    want = decode(target, tp, prompt, 24)
+    got, stats = speculative_decode(target, tp, target, tp, prompt,
+                                    24, k=4, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats["accepted_drafts"]) > 0
+    assert int(stats["rounds"]) <= -(-24 // 4)
+
+
+def test_spec_windowed_ragged_and_eos():
+    """Windowed speculation composes with ragged prompts and EOS,
+    matching plain windowed decode's exact semantics."""
+    target, tp = _make(seed=0, attention_window=8)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(3, 8, seed=5)
+    plen = jnp.asarray([8, 3, 6], jnp.int32)
+    want = decode(target, tp, prompt, 20, prompt_len=plen)
+    got = speculative_decode(target, tp, draft, dp, prompt, 20, k=4,
+                             prompt_len=plen)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # EOS: pick a token the greedy run actually emits so the done
+    # machinery engages mid-sequence.
+    eos = int(np.asarray(want)[0, 10])
+    want_e = decode(target, tp, prompt, 20, eos_id=eos)
+    got_e = speculative_decode(target, tp, draft, dp, prompt, 20,
+                               k=4, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got_e),
+                                  np.asarray(want_e))
+
+
+def test_spec_windowed_composes_gqa_rope_int8():
+    """Ring speculation on the serving stack's full composition:
+    GQA + rope + int8 KV cache + sliding window."""
+    kwargs = dict(num_kv_heads=2, pos_embedding="rope",
+                  kv_cache_dtype="int8", attention_window=8)
+    target, tp = _make(seed=0, **kwargs)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99, **kwargs)
+    prompt = _prompt(2, 8)
+    want = decode(target, tp, prompt, 24)
+    got = speculative_decode(target, tp, draft, dp, prompt, 24, k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_windowed_logprobs_match_decode():
+    target, tp = _make(seed=0, attention_window=8)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 8)
+    want, want_lp = decode(target, tp, prompt, 20,
+                           return_logprobs=True)
+    got, got_lp = speculative_decode(target, tp, draft, dp, prompt,
+                                     20, k=4, return_logprobs=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_allclose(np.asarray(got_lp),
+                               np.asarray(want_lp), atol=2e-4)
+
+
+def test_spec_windowed_sampling_reproducible_and_greedy_limit():
+    target, tp = _make(seed=0, attention_window=8)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(1, 8)
+    rng = jax.random.PRNGKey(3)
+    a = speculative_decode(target, tp, draft, dp, prompt, 16, k=4,
+                           temperature=1.0, rng=rng)
+    b = speculative_decode(target, tp, draft, dp, prompt, 16, k=4,
+                           temperature=1.0, rng=rng)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(np.asarray(a).max()) < target.vocab_size
+    # T -> 0 limit reproduces greedy windowed decode exactly.
+    tiny = speculative_decode(target, tp, draft, dp, prompt, 16, k=4,
+                              temperature=1e-6, rng=rng)
+    want = decode(target, tp, prompt, 16)
+    np.testing.assert_array_equal(np.asarray(tiny), np.asarray(want))
