@@ -49,6 +49,24 @@ def _decode_clone(model):
     return model.clone(**clone_kwargs)
 
 
+def _map_batch_leaves(fn, cache):
+    """Apply ``fn`` to every batch-major cache leaf, pass scalars
+    through.
+
+    The cache tree's structural contract (transformer.py cache
+    variables): every leaf with ndim >= 2 is batch-major
+    (cached_key/value [B, S, H, D], key/value_scale [B, S, H, 1],
+    slot_pos [B, c_len]); the only other leaves are the shared
+    scalar step counters (cache_index/pos_index, ndim 0). Keying the
+    batch transforms (beam gather/fan-out, prefix fan-out) on ndim
+    instead of a leading-dim size comparison means a non-batch leaf
+    whose leading dim coincidentally equals the batch can never be
+    transformed by accident, and a batch-major leaf can never be
+    silently skipped (ADVICE r4)."""
+    return jax.tree_util.tree_map(
+        lambda a: fn(a) if a.ndim >= 2 else a, cache)
+
+
 def init_cache(model, batch, length):
     """Size the KV cache: a decode-mode init at full length creates
     per-layer [B, length, H, D] cache buffers plus step counters."""
@@ -484,10 +502,8 @@ def _decode_with_prefix_impl(model, params, cache, prompt,
         # [Bp, ...] cache rows -> [Bp*fan_out, ...]: request row
         # bp*fan_out + j continues prefix row bp. Scalar counters
         # (pos_index/cache_index) are shared.
-        cache = jax.tree_util.tree_map(
-            lambda a: (jnp.repeat(a, fan_out, axis=0)
-                       if a.ndim and a.shape[0] * fan_out == b else a),
-            cache)
+        cache = _map_batch_leaves(
+            lambda a: jnp.repeat(a, fan_out, axis=0), cache)
     padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
     eos_row = jnp.reshape(eos_id, (-1,)) if use_eos else None
 
@@ -603,10 +619,15 @@ def decode_with_prefix(model, params, prefix_state, prompt,
         (np.asarray(prompt_len) == prompt.shape[1]).all())
     # The chunk apply needs the model's mid-cache chunk attention
     # (chunk_attends_cache); models without it prefill stepwise.
-    # Sliding-window models are excluded for the same reason
-    # speculative_decode rejects them: the ring cache's multi-token
-    # write path assumes the chunk starts at position 0, which a
-    # mid-cache chunk violates.
+    # Sliding-window models are excluded for a CAPACITY reason (the
+    # traced-offset ring write itself is now supported — the scatter
+    # path speculative verify chunks use): chunk attention reads all
+    # of the chunk's K/V back from the ring, so a W-slot ring needs
+    # W + chunk_width slots to hold the chunk AND each early query's
+    # pre-chunk window (speculative_decode allocates exactly that
+    # slack for its width-k chunks via ring_slack). The prefix state
+    # here was allocated by prefill_prefix without suffix-width
+    # slack, so windowed models take the stepwise path.
     can_chunk = (hasattr(model, "chunk_attends_cache")
                  and not getattr(model, "attention_window", 0))
     if fast_prefill is None:
@@ -732,14 +753,13 @@ def _beam_impl(model, params, prompt, max_new_tokens, eos_id, alpha,
     v = logprobs.shape[-1]
 
     def fan_out(a):
-        if a.ndim and a.shape[0] == b:
-            return jnp.repeat(a, k, axis=0)
-        return a  # scalars (pos_index/cache_index) are shared
+        return jnp.repeat(a, k, axis=0)
 
     # Beam rows of one batch element are adjacent (row b*k + j); the
     # [B, total] cache init means the per-row buffers already have
-    # full length, so fan-out is a pure gather.
-    cache = jax.tree_util.tree_map(fan_out, updated["cache"])
+    # full length, so fan-out is a pure gather. Scalar counters
+    # (pos_index/cache_index) are shared.
+    cache = _map_batch_leaves(fan_out, updated["cache"])
     logprobs = fan_out(logprobs)  # [B*K, V]
 
     # All beams start identical: only beam 0 is live, so the first
@@ -808,9 +828,7 @@ def _beam_impl(model, params, prompt, max_new_tokens, eos_id, alpha,
 
     def reorder(tree, flat_parent):
         # Gather beam-major leaves; scalars (pos_index) are shared.
-        return jax.tree_util.tree_map(
-            lambda a: a[flat_parent] if a.ndim and
-            a.shape[0] == b * k else a, tree)
+        return _map_batch_leaves(lambda a: a[flat_parent], tree)
 
     gen_len0 = jnp.zeros((b, k), jnp.int32)
 

@@ -216,6 +216,7 @@ class _BaseServer:
         # _stats_lock could block every request thread on a dead
         # tunnel the first time /stats is hit.
         self._platform = jax.devices()[0].platform
+        self._devices = [str(d) for d in jax.devices()]
         self._requests = 0
         self._shed = 0
         self._latencies = []
@@ -350,6 +351,7 @@ class _BaseServer:
                 # host-CPU fallback (the axon tunnel's known failure
                 # mode) instead of trusting that jax kept the chip.
                 "platform": self._platform,
+                "devices": self._devices,
                 "p50_ms": round(lat[n // 2] * 1000, 3) if n else None,
                 "p99_ms": round(lat[int(n * 0.99)] * 1000, 3)
                 if n else None,
@@ -923,7 +925,8 @@ class GenerationServer(_BaseServer):
     def _warm_stream(self, row, bucket, temperature, top_k, top_p,
                      min_p):
         """Compile one bucket's COMPLETE stream program set in at
-        most three calls instead of draining max_new tokens.
+        most six calls (three horizons x use_eos on/off) instead of
+        draining max_new tokens.
 
         The request schedule's horizons are n = min(STREAM_CHUNK,
         remaining budget), so the distinct programs are: the
@@ -937,18 +940,26 @@ class GenerationServer(_BaseServer):
         chunk = min(self.STREAM_CHUNK, self._max_new)
         rem = self._max_new % chunk
         rng = jax.random.PRNGKey(0)
-        state = self._stream_fresh_state(bucket)
-        seq, state = self._stream_call(
-            state, jnp.asarray(row[None, :]), bucket, chunk,
-            temperature, top_k, top_p, min_p, None, rng)
-        if rem:
+        # use_eos is a STATIC jit arg of the decode program: a stream
+        # that carries eos_id selects a different program than one
+        # that doesn't, so both variants of every horizon must warm
+        # or the first eos-bearing request stalls on a compile behind
+        # the readiness gate (ADVICE r4). The warm eos value is
+        # arbitrary — the program is specialized on use_eos, not the
+        # id; early EOS only pads the output, shapes are static.
+        for eos in (None, 0):
+            state = self._stream_fresh_state(bucket)
             seq, state = self._stream_call(
-                state, seq[:, -1:], 1, rem, temperature, top_k,
-                top_p, min_p, None, rng)
-        if self._max_new >= 2 * chunk:
-            self._stream_call(
-                state, seq[:, -1:], 1, chunk, temperature, top_k,
-                top_p, min_p, None, rng)
+                state, jnp.asarray(row[None, :]), bucket, chunk,
+                temperature, top_k, top_p, min_p, eos, rng)
+            if rem:
+                seq, state = self._stream_call(
+                    state, seq[:, -1:], 1, rem, temperature, top_k,
+                    top_p, min_p, eos, rng)
+            if self._max_new >= 2 * chunk:
+                self._stream_call(
+                    state, seq[:, -1:], 1, chunk, temperature, top_k,
+                    top_p, min_p, eos, rng)
 
     def _stream_response(self, row, p_len, new, temperature, top_k,
                          top_p, min_p, eos_id, decode_text):
@@ -1189,13 +1200,22 @@ class GenerationServer(_BaseServer):
                 with self._stats_lock:
                     self._shed += 1
                 return 503, {"error": "server overloaded; retry"}
-            decode_text = (self._tokenizer.decode
-                           if texts is not None else None)
-            return 200, _StreamBody(
-                self._stream_response(
-                    padded[0], p_lens[0], new, temperature, top_k,
-                    top_p, min_p, eos_id, decode_text),
-                functools.partial(self._admission.release, 1))
+            # Anything raising between acquire and the body reaching
+            # the caller (tokenizer access; generator construction)
+            # would be swallowed by the generic 500 handler with the
+            # slot still held — release before re-raising (ADVICE r4).
+            try:
+                decode_text = (self._tokenizer.decode
+                               if texts is not None else None)
+                body = _StreamBody(
+                    self._stream_response(
+                        padded[0], p_lens[0], new, temperature,
+                        top_k, top_p, min_p, eos_id, decode_text),
+                    functools.partial(self._admission.release, 1))
+            except BaseException:
+                self._admission.release(1)
+                raise
+            return 200, body
         batcher = self._batcher_for(
             bucket, temperature > 0.0, top_k, want_lp,
             plain=self._default_knobs(rep_pen),

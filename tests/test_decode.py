@@ -1104,3 +1104,27 @@ def test_beam_length_penalty_equals_exhaustive(seed, n):
     with pytest.raises(ValueError, match="requires eos_id"):
         beam_search(model, params, prompt, n, num_beams=2,
                     length_penalty=0.5)
+
+
+def test_map_batch_leaves_structure_keyed():
+    """Cache batch transforms key on the tree's structural contract
+    (ndim >= 2 == batch-major), not leading-dim size coincidences: a
+    non-batch leaf whose length happens to equal the batch must pass
+    through untouched, and scalars are always shared (ADVICE r4)."""
+    from container_engine_accelerators_tpu.models.decode import (
+        _map_batch_leaves,
+    )
+
+    tree = {
+        "cached_key": jnp.zeros((2, 4, 3, 5)),
+        "slot_pos": jnp.zeros((2, 7), jnp.int32),
+        "cache_index": jnp.zeros((), jnp.int32),
+        # 1-D, length == batch: the old shape-coincidence rule would
+        # have repeated this.
+        "not_a_batch_leaf": jnp.zeros((2,)),
+    }
+    out = _map_batch_leaves(lambda a: jnp.repeat(a, 3, axis=0), tree)
+    assert out["cached_key"].shape == (6, 4, 3, 5)
+    assert out["slot_pos"].shape == (6, 7)
+    assert out["cache_index"].shape == ()
+    assert out["not_a_batch_leaf"].shape == (2,)

@@ -1199,8 +1199,10 @@ def test_stream_largest_bucket_fits_budget(prefix_server):
 
 
 def test_stream_warm_filter_precompiles():
-    """Stream warm specs compile each bucket's stream program set in
-    at most three calls, honoring the spec's mode knobs — the warm
+    """Stream warm specs compile each bucket's COMPLETE stream
+    program set — every horizon x use_eos on/off (ADVICE r4: eos is
+    a static jit arg, so an unwarmed eos variant would stall the
+    first eos-bearing stream on a compile) — and the warm
     composition is pinned exactly, so deleting the stream branch (or
     draining full streams again) fails this test."""
     from container_engine_accelerators_tpu.models import TransformerLM
@@ -1214,10 +1216,12 @@ def test_stream_warm_filter_precompiles():
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     # max_new 24, STREAM_CHUNK 16 -> chunk 16, rem 8, max_new < 2*16:
-    # per bucket the stream set is first(16) + remainder(8) = 2
-    # calls. Buckets for max_prompt 40: [16, 32, 40] -> 3 buckets.
-    # Default warm = 2 calls/bucket; two stream specs (greedy +
-    # sampling) add 2*2 calls/bucket: total 3 * (2 + 4) = 18.
+    # per bucket one stream pass is first(16) + remainder(8) = 2
+    # calls, and each spec warms the pass twice (eos=None + eos set)
+    # = 4 calls. Buckets for max_prompt 40: [16, 32, 40] -> 3
+    # buckets. Default warm = 2 calls/bucket; two stream specs
+    # (greedy + sampling) add 2*4 calls/bucket:
+    # total 3 * (2 + 8) = 30.
     srv = GenerationServer(
         "lm-ws", model, params, port=0, max_new_tokens=24,
         max_batch=2, warm=True,
@@ -1225,7 +1229,7 @@ def test_stream_warm_filter_precompiles():
                       {"stream": True}])
     srv.start()
     try:
-        assert srv.stats()["decode_calls"] == 18
+        assert srv.stats()["decode_calls"] == 30
         lines = _post_stream(srv, "/v1/models/lm-ws:generate",
                              {"prompts": [[1, 2, 3]],
                               "max_new_tokens": 6, "stream": True})
@@ -1271,3 +1275,55 @@ def test_stream_on_spec_server_matches_plain_greedy():
         assert lines[-1] == {"done": True}
     finally:
         srv.stop()
+
+
+def test_generate_speculative_windowed_model_routes_spec():
+    """Sliding-window target + draft: the server constructs (the old
+    check_spec_models window refusal is gone), default-knob traffic
+    rides the SPECULATIVE program, and output equals the plain
+    windowed server's exactly (VERDICT r4 item 5)."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          attention_window=8, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    draft = TransformerLM(vocab_size=64, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=48,
+                          attention_window=8, dtype=jnp.float32)
+    dparams = draft.init(jax.random.PRNGKey(2),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def make(**kw):
+        return GenerationServer("lm", model, params, port=0,
+                                max_new_tokens=8, max_batch=2,
+                                buckets=[8], **kw)
+
+    plain = make()
+    spec = make(draft_model=draft, draft_params=dparams,
+                speculative_k=4)
+    plain.start()
+    spec.start()
+    try:
+        for payload in (
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 8},
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 8,
+                 "eos_id": 7},
+                {"prompts": [[4, 5, 6, 7, 8, 9, 10, 11]],
+                 "max_new_tokens": 8},
+        ):
+            a = post(plain, "/v1/models/lm:generate", payload)
+            b = post(spec, "/v1/models/lm:generate", payload)
+            assert a["sequences"] == b["sequences"], payload
+        import urllib.request as _u
+        with _u.urlopen(f"http://localhost:{spec.port}/stats",
+                        timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["speculative_calls"] >= 3, stats
+    finally:
+        plain.stop()
+        spec.stop()
