@@ -247,13 +247,11 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref,
         num_scr[...] = jnp.zeros(num_scr.shape, num_scr.dtype)
         den_scr[...] = jnp.zeros(den_scr.shape, den_scr.dtype)
 
-    run = ik * block < s_orig  # fully-padded K tiles contribute nothing
-    if causal:
-        run = jnp.logical_and(run, iq >= ik)
-        if window:
-            # K tiles entirely below the Q tile's window: skip.
-            run = jnp.logical_and(
-                run, (ik + 1) * block - 1 >= iq * block - window + 1)
+    # Same formula as the streamed operands' DMA clamp — a computing
+    # step must see the identity index map (see _stream_useful_range).
+    lo, hi = _stream_useful_range(block, causal, s_orig, window,
+                                  "k", iq)
+    run = jnp.logical_and(ik >= lo, ik <= hi)
 
     @pl.when(run)
     def _step():
@@ -285,12 +283,9 @@ def _dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[...] = jnp.zeros(dq_scr.shape, dq_scr.dtype)
 
-    run = ik * block < s_orig
-    if causal:
-        run = jnp.logical_and(run, iq >= ik)
-        if window:
-            run = jnp.logical_and(
-                run, (ik + 1) * block - 1 >= iq * block - window + 1)
+    lo, hi = _stream_useful_range(block, causal, s_orig, window,
+                                  "k", iq)
+    run = jnp.logical_and(ik >= lo, ik <= hi)
 
     @pl.when(run)
     def _step():
@@ -321,14 +316,12 @@ def _dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros(dk_scr.shape, dk_scr.dtype)
         dv_scr[...] = jnp.zeros(dv_scr.shape, dv_scr.dtype)
 
-    # Padded-Q tiles have do == 0, so their contribution is zero; skip.
-    run = iqb * block < s_orig
-    if causal:
-        run = jnp.logical_and(run, iqb >= ikb)
-        if window:
-            # Q tiles entirely past this K tile's window: skip.
-            run = jnp.logical_and(
-                run, iqb * block <= (ikb + 1) * block - 2 + window)
+    # Padded-Q tiles have do == 0, so their contribution is zero; the
+    # range also skips them (same formula as the DMA clamp — see
+    # _stream_useful_range).
+    lo, hi = _stream_useful_range(block, causal, s_orig, window,
+                                  "q", ikb)
+    run = jnp.logical_and(iqb >= lo, iqb <= hi)
 
     @pl.when(run)
     def _step():
@@ -385,6 +378,74 @@ def _stream_specs(d, block):
     return outer, inner, vec_outer, vec_inner
 
 
+def _stream_useful_range(block, causal, s_orig, window, mode, acc):
+    """Inclusive [lo, hi] of the streamed-tile indices that the
+    accumulated tile ``acc`` actually uses — THE single source of
+    truth shared by the streaming kernels' ``pl.when(run)``
+    predicates and the streamed operands' DMA-clamp index maps.
+    They must agree exactly: a step that computes must see the
+    identity map, so both derive from this one formula (a drifting
+    copy would silently corrupt attention, not raise).
+
+    mode "k": acc = Q tile i, streamed = K tiles (fwd, dq). Tile j
+    is useful iff it holds a real key (j <= last non-padded tile),
+    is not in the causal future (j <= i), and is not entirely below
+    the window of tile i's first row.
+    mode "q": acc = K tile ik, streamed = Q tiles (dkv). Tile iq is
+    useful iff it has real queries, is not before ik (causal), and
+    its first row is not past ik's window reach.
+    """
+    n_real = max(0, -(-s_orig // block) - 1)  # last non-padded tile
+    if mode == "k":
+        hi = jnp.minimum(acc, n_real) if causal else n_real
+        lo = (jnp.maximum(0, (acc * block - window + 1) // block)
+              if causal and window else 0)
+    else:
+        lo = acc if causal else 0
+        hi = n_real
+        if causal and window:
+            hi = jnp.minimum(
+                hi, ((acc + 1) * block - 2 + window) // block)
+    return lo, hi
+
+
+def _stream_inner_map(block, causal, s_orig, window, mode):
+    """Index map for the STREAMED (axis-2) operands, clamped into the
+    step's useful range.
+
+    The streaming kernels' rectangular (bh, n, n) grid visits every
+    (accumulated, streamed) tile pair; masked pairs (causal triangle,
+    window band, fully-padded tail) compute nothing (pl.when) but
+    with the identity map they would still pay the streamed tile's
+    HBM->VMEM DMA — for causal attention that is ~2x the useful
+    traffic, and it is why the round-4 capture read 104 net TFLOP/s
+    at 8k (resident kernel, fori_loop skips masked blocks outright)
+    but only ~64 at 16k/32k (streaming). The Pallas TPU pipeline
+    skips an input copy whenever the block index repeats between
+    consecutive grid steps, so clamping a masked step's index onto
+    the adjacent useful step's index makes the dead DMA disappear
+    while the (cheap, compute-skipped) grid step itself remains.
+    """
+    def index_map(bh, acc, streamed):
+        lo, hi = _stream_useful_range(block, causal, s_orig, window,
+                                      mode, acc)
+        # hi < lo happens only on steps where nothing computes (e.g.
+        # a fully-padded accumulated tile); any in-bounds index is
+        # fine there, so collapse the range instead of inverting it.
+        return (bh, jnp.clip(streamed, lo, jnp.maximum(hi, lo)), 0)
+    return index_map
+
+
+def _clamped_stream_specs(d, block, causal, s_orig, window, mode):
+    """(inner, vec_inner) with the masked-step DMA clamp applied."""
+    index_map = _stream_inner_map(block, causal, s_orig, window, mode)
+    inner = pl.BlockSpec((1, block, d), index_map,
+                         memory_space=pltpu.VMEM)
+    vec_inner = pl.BlockSpec((1, block, 1), index_map,
+                             memory_space=pltpu.VMEM)
+    return inner, vec_inner
+
+
 # Resident mode holds K/V (or Q/dO) for the whole padded sequence in
 # VMEM, double-buffered across batch*head programs: ~4*Sp*D*itemsize
 # bytes. Measured limit on v5e: seq 8192 bf16 D=128 (8.4 MB) compiles,
@@ -406,7 +467,9 @@ def _flash_fwd(q3, k3, v3, causal, s_orig, block, streaming=None,
     out_shape = [jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
                  jax.ShapeDtypeStruct((bh, sp, 1), jnp.float32)]
     if _use_streaming(sp, d, q3.dtype.itemsize, streaming):
-        outer, inner, vec_outer, _ = _stream_specs(d, block)
+        outer, _, vec_outer, _ = _stream_specs(d, block)
+        inner, _ = _clamped_stream_specs(d, block, causal, s_orig,
+                                         window, "k")
         return pl.pallas_call(
             functools.partial(_fwd_kernel_stream, causal=causal,
                               s_orig=s_orig, scale=scale, block=block,
@@ -445,14 +508,19 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
         # (see _dq_step). glse3: [BH, Sp, 1] f32.
         delta = delta - glse3
     if _use_streaming(sp, d, q3.dtype.itemsize, streaming):
-        outer, inner, vec_outer, vec_inner = _stream_specs(d, block)
+        outer, _, vec_outer, _ = _stream_specs(d, block)
+        k_inner, _ = _clamped_stream_specs(d, block, causal, s_orig,
+                                           window, "k")
+        q_inner, q_vec_inner = _clamped_stream_specs(
+            d, block, causal, s_orig, window, "q")
         n = sp // block
         dq = pl.pallas_call(
             functools.partial(_dq_kernel_stream, causal=causal,
                               s_orig=s_orig, scale=scale, block=block,
                               window=window),
             grid=(bh, n, n),
-            in_specs=[outer, inner, inner, outer, vec_outer, vec_outer],
+            in_specs=[outer, k_inner, k_inner, outer, vec_outer,
+                      vec_outer],
             out_specs=outer,
             out_shape=jax.ShapeDtypeStruct((bh, sp, d), q3.dtype),
             scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
@@ -465,7 +533,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, causal, s_orig, block,
                               s_orig=s_orig, scale=scale, block=block,
                               window=window),
             grid=(bh, n, n),
-            in_specs=[inner, outer, outer, inner, vec_inner, vec_inner],
+            in_specs=[q_inner, outer, outer, q_inner, q_vec_inner,
+                      q_vec_inner],
             out_specs=[outer, outer],
             out_shape=[jax.ShapeDtypeStruct((bh, sp, d), k3.dtype),
                        jax.ShapeDtypeStruct((bh, sp, d), v3.dtype)],
