@@ -206,6 +206,7 @@ def main(argv=None):
             "heads": h,
             "head_dim": d,
             "devices": n,
+            "device_strs": [str(x) for x in jax.devices()],
             "block": args.block,
             "window": args.window,
             "platform": jax.devices()[0].platform,

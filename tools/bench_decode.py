@@ -250,6 +250,7 @@ def main(argv=None):
             "attention_window": args.attention_window,
             "temperature": args.temperature,
             "platform": jax.devices()[0].platform,
+            "devices": [str(d) for d in jax.devices()],
             "sec_per_call": round(sec, 4),
             "decode_tokens_per_sec": round(tokens / sec, 1),
             "ms_per_token": round(sec / args.new_tokens * 1000, 3),

@@ -15,12 +15,21 @@
 # limitations under the License.
 
 # The full on-TPU measurement suite, for when the (flaky) tunneled
-# chip is up: headline bench at two batch sizes, the attention
-# schedule/tile sweep, and decode throughput (bf16 + int8 cache).
-# Each section is individually time-capped; artifacts land in the
-# repo root / stdout.
+# chip is up. Sections run STALEST-ARTIFACT-FIRST (VERDICT r4 item 2:
+# the round-4 window died exactly when it reached the never-captured
+# serving/decode sections, which ran last): serving and decode come
+# before re-measuring the already-captured headline/attention numbers,
+# and a section whose committed artifact carries a full provenance
+# block younger than SUITE_SKIP_FRESH_DAYS days (default 1) is skipped
+# outright.
 #
 # Usage: tools/run_tpu_suite.sh [outdir]
+#
+# [outdir] holds SCRATCH outputs only (logs, raw sidecars, .tmp
+# buffers). The TRACKED artifacts (SERVING_BENCH.json,
+# DECODE_BENCH.json, ATTN_BENCH.json, TPU_BENCH_*.json via bench.py)
+# always live at the repo root — the freshness gates read the same
+# committed paths the promotions write, whatever outdir is.
 
 set -u
 cd "$(dirname "$0")/.."
@@ -46,78 +55,175 @@ sec_rc() {  # $1 = rc, $2 = section name
   fi
 }
 
-# bench.py itself refreshes TPU_BENCH_{DEFAULT,B256}.json (with
-# provenance + step-log pointer) on a successful on-chip run, so the
-# suite must NOT redirect stdout onto those paths — that would race
-# bench.py's own atomic write of the same file.
-# Worst case for 2 attempts: 2x240s probe + 2x2600s attempt + 30s
-# backoff = 5710s; the outer timeout must exceed that or it kills the
-# supervisor mid-measure and no JSON line is emitted.
-echo "[suite] headline bench (default batch)" >&2
-BENCH_ATTEMPTS=2 BENCH_BACKOFF_S=30 timeout -k 30 6000 python bench.py \
-  > "${OUT}/tpu_bench_default.out" 2>> "${OUT}/tpu_suite.log" 9>&-
-sec_rc $? "headline bench (default batch)"
-cat "${OUT}/tpu_bench_default.out" >&2
+# Freshness gate: skip re-measuring an artifact that already carries a
+# full provenance block (generated_utc + git_sha + devices) younger
+# than SUITE_SKIP_FRESH_DAYS days, so scarce window time goes to what
+# has never been captured. An artifact without auditable provenance is
+# always stale — that forces the round-2-vintage DECODE_BENCH.json and
+# the provenance-less ATTN_BENCH.json to refresh.
+SKIP_FRESH_DAYS="${SUITE_SKIP_FRESH_DAYS:-1}"
+is_fresh() {  # $1 = artifact path; rc 0 = fresh enough to skip
+  python - "$1" "${SKIP_FRESH_DAYS}" <<'PYEOF' 2>/dev/null
+import datetime
+import json
+import sys
+import time
 
-echo "[suite] headline bench (batch 256/chip)" >&2
-BENCH_ATTEMPTS=1 BENCH_BATCH_PER_CHIP=256 timeout -k 30 3600 \
-  python bench.py \
-  > "${OUT}/tpu_bench_b256.out" 2>> "${OUT}/tpu_suite.log" 9>&-
-sec_rc $? "headline bench (batch 256)"
-cat "${OUT}/tpu_bench_b256.out" >&2
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+prov = d.get("provenance") or {}
+if not (prov.get("generated_utc") and prov.get("git_sha")
+        and prov.get("devices")):
+    sys.exit(1)
+if prov.get("retro_stamped"):
+    sys.exit(1)  # stamped after the fact — still wants a clean rerun
+try:
+    ts = datetime.datetime.fromisoformat(
+        prov["generated_utc"]).timestamp()
+except ValueError:
+    sys.exit(1)
+age_days = (time.time() - ts) / 86400.0
+sys.exit(0 if 0 <= age_days < float(sys.argv[2]) else 1)
+PYEOF
+}
 
-echo "[suite] Allocate env contract on the real chip" >&2
-timeout -k 30 900 python tools/allocate_env_harness.py \
-  2>> "${OUT}/tpu_suite.log" 9>&-
-sec_rc $? "allocate-env harness"
-[ -f ALLOCATE_ENV_TPU.json ] && cat ALLOCATE_ENV_TPU.json >&2
-
-echo "[suite] telemetry source probe (sdk + runtime gRPC)" >&2
-# The record is the deliverable either way (a documented failure
-# enumerating what the host serves beats "never tried"); only a tool
-# crash fails the section.
-# The probe prints its own one-line summary on stdout (lands in this
-# script's output), so no re-parse of the artifact is needed here.
-timeout -k 30 120 python tools/telemetry_probe.py \
-  2>> "${OUT}/tpu_suite.log" 9>&-
-sec_rc $? "telemetry source probe"
-
-echo "[suite] attention sweep" >&2
-# Tracked artifact: write a sidecar and promote only on success, so a
-# timed-out sweep can't truncate the committed on-chip record (same
-# rule bench.py applies to TPU_BENCH_*.json).
-timeout -k 30 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json.tmp" \
-  2>> "${OUT}/tpu_suite.log" 9>&-
-ATTN_RC=$?
-# run_attn_bench.sh records a failed/timed-out config as a clean
-# {"error": ...} row and still exits 0 — refuse to promote those over
-# the committed record (expected in-row fields like numerics_error on
-# dense-can't-compile lengths are fine; a bare "error" row means the
-# run died).
-if [ "${ATTN_RC}" = 0 ]; then
-  python - "${OUT}/ATTN_BENCH.json.tmp" <<'PYEOF' || ATTN_RC=1
+# ---------------------------------------------------------------------
+# 1. Serving bench — the stalest artifact: no warmed capture has ever
+#    landed (the committed SERVING_BENCH.json predates round 3's
+#    readiness gating and shows the obsolete pre-warm-up cold path).
+# ---------------------------------------------------------------------
+# --warm + /healthz gating: "cold" below measures a replica that just
+# became Ready (the HPA join path), not a replica still compiling —
+# with the readiness gate no request ever pays a compile.
+if is_fresh SERVING_BENCH.json; then
+  echo "[suite] serving bench: SERVING_BENCH.json fresh, skipping" >&2
+else
+  echo "[suite] serving bench (LM generate, cold + warm)" >&2
+  # 9>&-: the backgrounded server must not inherit the suite lock fd —
+  # a hung serve.py outliving this run would otherwise hold the flock
+  # and wedge every future suite at rc 99.
+  python demo/serving/serve.py --model transformer --port 8519 \
+    --max-seq-len 256 --max-new-tokens 32 --warm \
+    2>> "${OUT}/tpu_suite.log" 9>&- &
+  SERVE_PID=$!
+  stop_server() {  # TERM, grace, then KILL — a server hung in tunnel
+    kill "${SERVE_PID}" 2>/dev/null  # I/O must not keep port 8519
+    for i in 1 2 3 4 5 6 7 8 9 10; do
+      kill -0 "${SERVE_PID}" 2>/dev/null || return 0
+      sleep 1
+    done
+    kill -9 "${SERVE_PID}" 2>/dev/null
+  }
+  trap stop_server EXIT
+  READY=0
+  for i in $(seq 1 120); do
+    code="$(curl -s -m 2 -o /dev/null -w '%{http_code}' \
+      localhost:8519/healthz 2>/dev/null)"
+    [ "${code}" = "200" ] && { READY=1; break; }
+    kill -0 "${SERVE_PID}" 2>/dev/null || break  # server died
+    sleep 5
+  done
+  serving_run() {  # $1 = num requests; emits one JSON object, always
+    local row
+    row="$(timeout -k 30 1200 python demo/serving/load_generator.py \
+      --mode generate --port 8519 --model-name transformer \
+      --max-prompt-len 48 --max-new-tokens 32 -n "$1" --parallelism 8 \
+      2>/dev/null | tail -1)"
+    case "${row}" in
+      {*) echo -n "${row}" ;;
+      *)  echo -n '{"error": "load generator produced no result"}' ;;
+    esac
+  }
+  SERVING_RC=0
+  if [ "${READY}" = 1 ]; then
+    # Same CPU-fallback defense as every other section: the server
+    # reports what it computes on via /stats; refuse host-CPU numbers.
+    SRV_PLAT=""
+    for i in 1 2 3; do  # retried: one dropped request must not void a
+      curl -s -m 5 localhost:8519/stats > "${OUT}/.srv_stats.json" \
+        2>/dev/null  # healthy window
+      SRV_PLAT="$(python -c 'import json,sys; print((json.load(open(sys.argv[1])) or {}).get("platform"))' \
+        "${OUT}/.srv_stats.json" 2>/dev/null)"
+      [ "${SRV_PLAT}" = "tpu" ] && break
+      sleep 2
+    done
+    if [ "${SRV_PLAT}" != "tpu" ]; then
+      # Don't spend ~40 min load-testing numbers already known rejected.
+      SERVING_RC=1
+      sec_rc 1 "serving bench (server platform='${SRV_PLAT}', want tpu)"
+      echo "{\"error\": \"server platform '${SRV_PLAT}', want tpu\"}" \
+        > "${OUT}/SERVING_BENCH_RAW.json"
+    else
+      {
+        echo -n '{"cold": '; serving_run 300
+        echo -n ', "warm": '; serving_run 600
+        echo '}'
+      } > "${OUT}/SERVING_BENCH_RAW.json"
+      # A summary with requests=0 or mostly-failed requests is still a
+      # '{'-prefixed row — validate the fields, don't grep for "error".
+      python - "${OUT}/SERVING_BENCH_RAW.json" <<'PYEOF' || SERVING_RC=1
 import json, sys
 d = json.load(open(sys.argv[1]))
-assert d.get("rows"), "no rows"
-# Per-schedule rows record expected failures in-row (e.g. dense OOMs
-# at long seq_len, with a "schedule" key); only the sweep's injected
-# whole-config placeholder (no "schedule") means the run itself died.
-bad = [r for r in d["rows"] if "error" in r and "schedule" not in r]
-assert not bad, bad
-# A mid-suite tunnel drop makes jax fall back to host CPU (the
-# sitecustomize pins jax_platforms="axon,cpu") and the sweep "works" —
-# those numbers must never replace the on-chip record.  Successful
-# rows always carry "platform"; require at least one and all-tpu.
-timed = [r for r in d["rows"] if "platform" in r]
-assert timed, "no successfully timed rows"
-bad = [r for r in timed if r["platform"] != "tpu"]
-assert not bad, bad
+for k in ("cold", "warm"):
+    r = d.get(k) or {}
+    assert not r.get("error"), (k, r)
+    n, e = r.get("requests", 0), r.get("errors", 0)
+    assert n > 0 and e * 2 < n, (k, r)
 PYEOF
+      if [ "${SERVING_RC}" != 0 ]; then
+        sec_rc 1 "serving bench (bad summary rows)"
+      else
+        # Promote a provenance-stamped SERVING_BENCH.json: the warmed
+        # capture replacing the pre-readiness-gate record whose 17x
+        # cold-start p99 undermined the HPA story (VERDICT r4 item 2).
+        python - "${OUT}/SERVING_BENCH_RAW.json" \
+          "${OUT}/.srv_stats.json" SERVING_BENCH.json \
+          <<'PYEOF' || sec_rc 1 "serving bench (promotion failed)"
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from container_engine_accelerators_tpu.utils.provenance import stamp
+raw = json.load(open(sys.argv[1]))
+stats = json.load(open(sys.argv[2]))
+out = {
+    "config": {
+        "model": "transformer", "max_new_tokens": 32,
+        "max_prompt_len": 48, "parallelism": 8, "mode": "generate",
+        "warm": True, "readiness_gated": True,
+    },
+    "cold_start": raw["cold"],
+    "steady_state": raw["warm"],
+    "server_platform": stats.get("platform"),
+    "provenance": stamp(stats.get("devices") or []),
+}
+tmp = sys.argv[3] + ".tmp"
+with open(tmp, "w") as f:
+    json.dump(out, f, indent=1)
+    f.write("\n")
+os.replace(tmp, sys.argv[3])
+PYEOF
+      fi
+    fi
+  else
+    SERVING_RC=1
+    echo '{"error": "server never became ready"}' \
+      > "${OUT}/SERVING_BENCH_RAW.json"
+    sec_rc 1 "serving bench (server never ready)"
+  fi
+  stop_server
+  trap - EXIT
+  cat "${OUT}/SERVING_BENCH_RAW.json" >&2
 fi
-sec_rc "${ATTN_RC}" "attention sweep"
-[ "${ATTN_RC}" = 0 ] && \
-  mv "${OUT}/ATTN_BENCH.json.tmp" "${OUT}/ATTN_BENCH.json"
 
+# ---------------------------------------------------------------------
+# 2. Decode bench — the committed DECODE_BENCH.json is round-2 vintage
+#    (bare rows, no provenance); the round-4 window's richer capture
+#    only made it to DECODE_BENCH_PARTIAL.json.
+# ---------------------------------------------------------------------
+if is_fresh DECODE_BENCH.json; then
+  echo "[suite] decode bench: DECODE_BENCH.json fresh, skipping" >&2
+else
 echo "[suite] decode bench (bf16 + int8 cache + GQA + window)" >&2
 DECODE_RC=0
 dec2() {  # one retry after a pause: a transient tunnel drop mid-
@@ -166,6 +272,11 @@ dec2() {  # one retry after a pause: a transient tunnel drop mid-
     --num-kv-heads 2 --pos-embedding rope || DECODE_RC=1
   dec2 --batch 8 \
     --prompt-len 128 --new-tokens 128 --attention-window 64 || DECODE_RC=1
+  # Windowed (ring-cache) speculation — new this round: scatter chunk
+  # writes + ring_slack eviction margin (models/speculative.py).
+  dec2 --batch 1 \
+    --prompt-len 128 --new-tokens 128 --attention-window 64 \
+    --speculative-k 4 --draft self || DECODE_RC=1
   dec2 --batch 1 8 \
     --prompt-len 128 --new-tokens 128 --quantize-weights int8 \
     || DECODE_RC=1
@@ -177,6 +288,20 @@ dec2() {  # one retry after a pause: a transient tunnel drop mid-
   dec2 --batch 1 \
     --prompt-len 128 --new-tokens 128 --speculative-k 4 --draft small \
     || DECODE_RC=1
+  # Speculation's claimed win regime is weight-bandwidth-bound decode
+  # (models/speculative.py design note): a deep/wide target where the
+  # verify pass amortizes the weight stream over k+1 tokens. The
+  # 8-layer/512 rows above measured a SLOWDOWN (VERDICT r4 item 3) —
+  # these rows test the regime the analysis says should flip.
+  dec2 --batch 1 \
+    --prompt-len 128 --new-tokens 64 --num-layers 24 --embed-dim 2048 \
+    || DECODE_RC=1
+  dec2 --batch 1 \
+    --prompt-len 128 --new-tokens 64 --num-layers 24 --embed-dim 2048 \
+    --speculative-k 4 --draft self || DECODE_RC=1
+  dec2 --batch 1 \
+    --prompt-len 128 --new-tokens 64 --num-layers 24 --embed-dim 2048 \
+    --speculative-k 4 --draft small || DECODE_RC=1
   # Rejection-sampling speculation (self-draft = the full-acceptance
   # bound for the sampling program; plain sampling is the baseline).
   dec2 --batch 1 \
@@ -209,96 +334,140 @@ fi
 sec_rc "${DECODE_RC}" "decode bench"
 # Promote over the tracked artifact only when every run succeeded — a
 # killed run leaves partial rows that must not replace the committed
-# record (the .tmp stays behind, gitignored, for inspection).
+# record (the .tmp stays behind, gitignored, for inspection). The
+# promoted artifact wraps the JSONL rows in one object with a full
+# provenance block (VERDICT r4 item 6: auditable artifacts only).
 if [ "${DECODE_RC}" = 0 ]; then
-  mv "${OUT}/DECODE_BENCH.json.tmp" "${OUT}/DECODE_BENCH.json"
-  cat "${OUT}/DECODE_BENCH.json" >&2
+  python - "${OUT}/DECODE_BENCH.json.tmp" DECODE_BENCH.json \
+    <<'PYEOF' && rm -f "${OUT}/DECODE_BENCH.json.tmp" \
+               DECODE_BENCH_PARTIAL.json \
+    || sec_rc 1 "decode bench (promotion failed)"
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from container_engine_accelerators_tpu.utils.provenance import stamp
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+devices = rows[0].get("devices") or []
+out = {"provenance": stamp(devices), "rows": rows}
+tmp = sys.argv[2] + ".tmp2"
+with open(tmp, "w") as f:
+    json.dump(out, f, indent=1)
+    f.write("\n")
+os.replace(tmp, sys.argv[2])
+PYEOF
+  cat DECODE_BENCH.json >&2
 else
   cat "${OUT}/DECODE_BENCH.json.tmp" >&2
 fi
+fi
 
-# --warm + /healthz gating: "cold" below measures a replica that just
-# became Ready (the HPA join path), not a replica still compiling —
-# with the readiness gate no request ever pays a compile.
-echo "[suite] serving bench (LM generate, cold + warm)" >&2
-# 9>&-: the backgrounded server must not inherit the suite lock fd —
-# a hung serve.py outliving this run would otherwise hold the flock
-# and wedge every future suite at rc 99.
-python demo/serving/serve.py --model transformer --port 8519 \
-  --max-seq-len 256 --max-new-tokens 32 --warm \
-  2>> "${OUT}/tpu_suite.log" 9>&- &
-SERVE_PID=$!
-stop_server() {  # TERM, grace, then KILL — a server hung in tunnel
-  kill "${SERVE_PID}" 2>/dev/null  # I/O must not keep port 8519
-  for i in 1 2 3 4 5 6 7 8 9 10; do
-    kill -0 "${SERVE_PID}" 2>/dev/null || return 0
-    sleep 1
-  done
-  kill -9 "${SERVE_PID}" 2>/dev/null
-}
-trap stop_server EXIT
-READY=0
-for i in $(seq 1 120); do
-  code="$(curl -s -m 2 -o /dev/null -w '%{http_code}' \
-    localhost:8519/healthz 2>/dev/null)"
-  [ "${code}" = "200" ] && { READY=1; break; }
-  kill -0 "${SERVE_PID}" 2>/dev/null || break  # server died
-  sleep 5
-done
-serving_run() {  # $1 = num requests; emits one JSON object, always
-  local row
-  row="$(timeout -k 30 1200 python demo/serving/load_generator.py \
-    --mode generate --port 8519 --model-name transformer \
-    --max-prompt-len 48 --max-new-tokens 32 -n "$1" --parallelism 8 \
-    2>/dev/null | tail -1)"
-  case "${row}" in
-    {*) echo -n "${row}" ;;
-    *)  echo -n '{"error": "load generator produced no result"}' ;;
-  esac
-}
-if [ "${READY}" = 1 ]; then
-  # Same CPU-fallback defense as every other section: the server
-  # reports what it computes on via /stats; refuse host-CPU numbers.
-  SRV_PLAT=""
-  for i in 1 2 3; do  # retried: one dropped request must not void a
-    SRV_PLAT="$(curl -s -m 5 localhost:8519/stats \
-      | python -c 'import json,sys; print((json.load(sys.stdin) or {}).get("platform"))' \
-      2>/dev/null)"   # healthy window
-    [ "${SRV_PLAT}" = "tpu" ] && break
-    sleep 2
-  done
-  if [ "${SRV_PLAT}" != "tpu" ]; then
-    # Don't spend ~40 min load-testing numbers already known rejected.
-    sec_rc 1 "serving bench (server platform='${SRV_PLAT}', want tpu)"
-    echo "{\"error\": \"server platform '${SRV_PLAT}', want tpu\"}" \
-      > "${OUT}/SERVING_BENCH_RAW.json"
-  else
-    {
-      echo -n '{"cold": '; serving_run 300
-      echo -n ', "warm": '; serving_run 600
-      echo '}'
-    } > "${OUT}/SERVING_BENCH_RAW.json"
-    # A summary with requests=0 or mostly-failed requests is still a
-    # '{'-prefixed row — validate the fields, don't grep for "error".
-    python - "${OUT}/SERVING_BENCH_RAW.json" <<'PYEOF' || \
-      sec_rc 1 "serving bench (bad summary rows)"
+# ---------------------------------------------------------------------
+# 3. Telemetry source probe — cheap (120s) and re-armed every window:
+#    the committed TELEMETRY_PROBE.json documents whether this rig
+#    exposes any real telemetry endpoint yet.
+# ---------------------------------------------------------------------
+echo "[suite] telemetry source probe (sdk + runtime gRPC)" >&2
+# The record is the deliverable either way (a documented failure
+# enumerating what the host serves beats "never tried"); only a tool
+# crash fails the section.
+# The probe prints its own one-line summary on stdout (lands in this
+# script's output), so no re-parse of the artifact is needed here.
+timeout -k 30 120 python tools/telemetry_probe.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "telemetry source probe"
+
+# ---------------------------------------------------------------------
+# 4. Headline bench — captured with full provenance at round 4; skipped
+#    while fresh so the window budget goes to the sections above.
+# ---------------------------------------------------------------------
+# bench.py itself refreshes TPU_BENCH_{DEFAULT,B256}.json (with
+# provenance + step-log pointer) on a successful on-chip run, so the
+# suite must NOT redirect stdout onto those paths — that would race
+# bench.py's own atomic write of the same file.
+# BENCH_TOTAL_BUDGET_S is set just under the outer timeout so bench.py
+# itself finalizes (and prints its cumulative diagnostic) before
+# `timeout` kills it.
+if is_fresh TPU_BENCH_DEFAULT.json; then
+  echo "[suite] headline bench: TPU_BENCH_DEFAULT.json fresh, skipping" >&2
+else
+  echo "[suite] headline bench (default batch)" >&2
+  BENCH_ATTEMPTS=2 BENCH_BACKOFF_S=30 BENCH_TOTAL_BUDGET_S=5700 \
+    timeout -k 30 6000 python bench.py \
+    > "${OUT}/tpu_bench_default.out" 2>> "${OUT}/tpu_suite.log" 9>&-
+  sec_rc $? "headline bench (default batch)"
+  cat "${OUT}/tpu_bench_default.out" >&2
+fi
+
+if is_fresh TPU_BENCH_B256.json; then
+  echo "[suite] headline bench: TPU_BENCH_B256.json fresh, skipping" >&2
+else
+  echo "[suite] headline bench (batch 256/chip)" >&2
+  BENCH_ATTEMPTS=1 BENCH_BATCH_PER_CHIP=256 BENCH_TOTAL_BUDGET_S=3300 \
+    timeout -k 30 3600 python bench.py \
+    > "${OUT}/tpu_bench_b256.out" 2>> "${OUT}/tpu_suite.log" 9>&-
+  sec_rc $? "headline bench (batch 256)"
+  cat "${OUT}/tpu_bench_b256.out" >&2
+fi
+
+# ---------------------------------------------------------------------
+# 5. Allocate env contract on the real chip — captured at round 4.
+# ---------------------------------------------------------------------
+if is_fresh ALLOCATE_ENV_TPU.json; then
+  echo "[suite] allocate-env harness: ALLOCATE_ENV_TPU.json fresh," \
+       "skipping" >&2
+else
+  echo "[suite] Allocate env contract on the real chip" >&2
+  timeout -k 30 900 python tools/allocate_env_harness.py \
+    2>> "${OUT}/tpu_suite.log" 9>&-
+  sec_rc $? "allocate-env harness"
+  [ -f ALLOCATE_ENV_TPU.json ] && cat ALLOCATE_ENV_TPU.json >&2
+fi
+
+# ---------------------------------------------------------------------
+# 6. Attention sweep — last: its committed artifact is one round old
+#    and the sweep is the longest single section (~90 min cap). The
+#    freshness gate requires a full top-level provenance block, which
+#    the current ATTN_BENCH.json lacks — so it reruns until a clean
+#    capture (ANSI-free rows, tflops_net everywhere) lands.
+# ---------------------------------------------------------------------
+if is_fresh ATTN_BENCH.json; then
+  echo "[suite] attention sweep: ATTN_BENCH.json fresh, skipping" >&2
+else
+echo "[suite] attention sweep" >&2
+# Tracked artifact: write a sidecar and promote only on success, so a
+# timed-out sweep can't truncate the committed on-chip record (same
+# rule bench.py applies to TPU_BENCH_*.json).
+timeout -k 30 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json.tmp" \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+ATTN_RC=$?
+# run_attn_bench.sh records a failed/timed-out config as a clean
+# {"error": ...} row and still exits 0 — refuse to promote those over
+# the committed record (expected in-row fields like numerics_error on
+# dense-can't-compile lengths are fine; a bare "error" row means the
+# run died).
+if [ "${ATTN_RC}" = 0 ]; then
+  python - "${OUT}/ATTN_BENCH.json.tmp" <<'PYEOF' || ATTN_RC=1
 import json, sys
 d = json.load(open(sys.argv[1]))
-for k in ("cold", "warm"):
-    r = d.get(k) or {}
-    assert not r.get("error"), (k, r)
-    n, e = r.get("requests", 0), r.get("errors", 0)
-    assert n > 0 and e * 2 < n, (k, r)
+assert d.get("rows"), "no rows"
+# Per-schedule rows record expected failures in-row (e.g. dense OOMs
+# at long seq_len, with a "schedule" key); only the sweep's injected
+# whole-config placeholder (no "schedule") means the run itself died.
+bad = [r for r in d["rows"] if "error" in r and "schedule" not in r]
+assert not bad, bad
+# A mid-suite tunnel drop makes jax fall back to host CPU (the
+# sitecustomize pins jax_platforms="axon,cpu") and the sweep "works" —
+# those numbers must never replace the on-chip record.  Successful
+# rows always carry "platform"; require at least one and all-tpu.
+timed = [r for r in d["rows"] if "platform" in r]
+assert timed, "no successfully timed rows"
+bad = [r for r in timed if r["platform"] != "tpu"]
+assert not bad, bad
 PYEOF
-  fi
-else
-  echo '{"error": "server never became ready"}' \
-    > "${OUT}/SERVING_BENCH_RAW.json"
-  sec_rc 1 "serving bench (server never ready)"
 fi
-stop_server
-trap - EXIT
-cat "${OUT}/SERVING_BENCH_RAW.json" >&2
+sec_rc "${ATTN_RC}" "attention sweep"
+[ "${ATTN_RC}" = 0 ] && \
+  mv "${OUT}/ATTN_BENCH.json.tmp" ATTN_BENCH.json
+fi
 
 # Shared run record: any suite invocation (watchdog-launched or
 # manual) stamps its outcome here, so every watchdog instance sees

@@ -166,7 +166,14 @@ def main(argv=None):
 
     record = {"metric": "telemetry_source_probe",
               "host_observations": host_observations(addrs),
-              "provenance": stamp()}
+              # The probe interrogates HOST-side telemetry sources
+              # (SDK construct + runtime gRPC port + /dev/accel*);
+              # no accelerator is in the probed path, and the stamp
+              # says so (tests/test_artifacts.py requires a devices
+              # field on every committed artifact).
+              "provenance": stamp(
+                  devices=["host (telemetry-source probe; no "
+                           "accelerator in the probed path)"])}
     # Partial record FIRST: if a source leg wedges past every
     # deadline and the process is killed, the host observations (the
     # diagnosable context) survive instead of vanishing with it.
