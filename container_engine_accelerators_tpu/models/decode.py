@@ -449,7 +449,8 @@ def _prefill_prefix_impl(model, params, prefix, max_total_len):
     return updated["cache"]
 
 
-def prefill_prefix(model, params, prefix, *, max_total_len):
+def prefill_prefix(model, params, prefix, *, max_total_len,
+                   chunk_slack=0):
     """Prefill a shared prefix ONCE; fan the result out to many
     continuations with ``decode_with_prefix``.
 
@@ -466,11 +467,37 @@ def prefill_prefix(model, params, prefix, *, max_total_len):
 
     ``prefix``: [Bp, P] int32, full-width (no padding — a shared
     prefix has one true length).
+
+    ``chunk_slack`` (sliding-window models only): allocate this many
+    ring slots beyond the window. Chunked suffix prefill
+    (``decode_with_prefix(fast_prefill=True)``) reads the whole
+    suffix chunk back from the ring, so the ring must hold
+    window + suffix_width entries — the same capacity invariant
+    speculation's ``ring_slack`` provides for its width-k verify
+    chunks. Set it to the widest suffix this state will serve;
+    decode_with_prefix enables chunked prefill automatically when
+    the capacity is there (it also is when the ring never wraps:
+    ``max_total_len <= window``). Costs chunk_slack extra KV rows of
+    HBM per layer; decode semantics are unchanged either way (the
+    ring length is read from the buffer at apply time, and the
+    window band mask is independent of it).
     """
     if prefix.shape[1] >= max_total_len:
         raise ValueError(
             f"max_total_len {max_total_len} leaves no room after the "
             f"{prefix.shape[1]}-token prefix")
+    if chunk_slack:
+        if int(chunk_slack) < 0:
+            # A negative value would SHRINK the ring below the
+            # window and silently corrupt decode (keys evicted while
+            # still inside the band).
+            raise ValueError(
+                f"chunk_slack must be >= 0: {chunk_slack}")
+        if not getattr(model, "attention_window", 0):
+            raise ValueError(
+                "chunk_slack only applies to sliding-window models "
+                "(dense caches already hold every position)")
+        model = model.clone(ring_slack=int(chunk_slack))
     cache = _prefill_prefix_impl(model, params,
                                  jnp.asarray(prefix, jnp.int32),
                                  int(max_total_len))
@@ -478,6 +505,16 @@ def prefill_prefix(model, params, prefix, *, max_total_len):
     # cannot stand in for it: a sliding-window model's ring cache is
     # only min(max_total_len, window) long yet serves longer totals.
     return cache, prefix.shape[1], int(max_total_len)
+
+
+def _ring_capacity(cache):
+    """Ring length (slot count) of the first cached_key leaf, or
+    None when the tree has none (empty model)."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(cache)
+    for path, leaf in leaves:
+        if getattr(path[-1], "key", None) == "cached_key":
+            return leaf.shape[1]
+    return None
 
 
 @functools.partial(jax.jit,
@@ -619,17 +656,23 @@ def decode_with_prefix(model, params, prefix_state, prompt,
         (np.asarray(prompt_len) == prompt.shape[1]).all())
     # The chunk apply needs the model's mid-cache chunk attention
     # (chunk_attends_cache); models without it prefill stepwise.
-    # Sliding-window models are excluded for a CAPACITY reason (the
-    # traced-offset ring write itself is now supported — the scatter
+    # Sliding-window models additionally need ring CAPACITY (the
+    # traced-offset ring write itself is supported — the scatter
     # path speculative verify chunks use): chunk attention reads all
     # of the chunk's K/V back from the ring, so a W-slot ring needs
     # W + chunk_width slots to hold the chunk AND each early query's
-    # pre-chunk window (speculative_decode allocates exactly that
-    # slack for its width-k chunks via ring_slack). The prefix state
-    # here was allocated by prefill_prefix without suffix-width
-    # slack, so windowed models take the stepwise path.
-    can_chunk = (hasattr(model, "chunk_attends_cache")
-                 and not getattr(model, "attention_window", 0))
+    # pre-chunk window (the invariant speculation's ring_slack
+    # provides for its width-k chunks). A prefix state allocated
+    # with prefill_prefix(chunk_slack=<max suffix width>) has it; so
+    # does a ring that never wraps (capacity >= max_total_len).
+    # Undersized windowed states take the stepwise path.
+    window = getattr(model, "attention_window", 0)
+    can_chunk = hasattr(model, "chunk_attends_cache")
+    if can_chunk and window:
+        capacity = _ring_capacity(cache)
+        can_chunk = capacity is not None and (
+            capacity >= window + prompt.shape[1]
+            or capacity >= max_total_len)
     if fast_prefill is None:
         fast_prefill = full_width and max_new_tokens > 0 and can_chunk
     elif fast_prefill and not (full_width and max_new_tokens > 0
@@ -638,7 +681,10 @@ def decode_with_prefix(model, params, prefix_state, prompt,
             "fast_prefill=True requires every row's prompt_len to "
             "equal the suffix width (no right-padding), "
             "max_new_tokens > 0, and a model with the "
-            "chunk_attends_cache mid-cache chunk path")
+            "chunk_attends_cache mid-cache chunk path (for "
+            "sliding-window models the prefix state's ring must "
+            "also hold window + suffix width slots — allocate it "
+            "with prefill_prefix(chunk_slack=...))")
     sample, top_k, use_top_p, use_min_p = _sampling_flags(
         temperature, top_k, top_p, min_p)
     use_eos = eos_id is not None

@@ -1128,3 +1128,120 @@ def test_map_batch_leaves_structure_keyed():
     assert out["slot_pos"].shape == (6, 7)
     assert out["cache_index"].shape == ()
     assert out["not_a_batch_leaf"].shape == (2,)
+
+
+def test_prefix_cache_windowed_fast_prefill_with_chunk_slack():
+    """Chunked suffix prefill on a sliding-window model: a prefix
+    state allocated with chunk_slack >= suffix width runs the suffix
+    as ONE mid-cache ring chunk (scatter write) and matches the
+    stepwise path and full decode token-for-token; an undersized
+    state refuses fast_prefill=True."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    w = 8
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, max_seq_len=MAXLEN,
+                          attention_window=w, dtype=jnp.float32)
+    prefix = jax.random.randint(jax.random.PRNGKey(40), (1, 6), 0, V)
+    params = model.init(jax.random.PRNGKey(41), prefix)["params"]
+    suffixes = jax.random.randint(jax.random.PRNGKey(42), (2, 5), 0, V)
+    # 6 + 5 + 10 = 21 total > window 8: the ring wraps during both
+    # the suffix chunk and generation.
+    state = prefill_prefix(model, params, prefix,
+                           max_total_len=6 + 5 + N, chunk_slack=5)
+    fast = decode_with_prefix(model, params, state, suffixes, N,
+                              fast_prefill=True)
+    slow = decode_with_prefix(model, params, state, suffixes, N,
+                              fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+    full = decode(
+        model, params,
+        jnp.concatenate([jnp.broadcast_to(prefix, (2, 6)), suffixes],
+                        axis=1), N)
+    np.testing.assert_array_equal(np.asarray(fast),
+                                  np.asarray(full)[:, 6:])
+    # Without slack the ring cannot hold window + suffix: explicit
+    # fast_prefill must refuse (the default silently goes stepwise).
+    bare = prefill_prefix(model, params, prefix,
+                          max_total_len=6 + 5 + N)
+    with pytest.raises(ValueError, match="ring"):
+        decode_with_prefix(model, params, bare, suffixes, N,
+                           fast_prefill=True)
+    got = decode_with_prefix(model, params, bare, suffixes, N)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(fast))
+
+
+def test_prefix_cache_windowed_fast_prefill_no_wrap_needs_no_slack():
+    """A ring that never wraps (max_total_len <= window) has full
+    capacity by construction, so chunked suffix prefill works on a
+    slack-free state."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, max_seq_len=MAXLEN,
+                          attention_window=24, dtype=jnp.float32)
+    prefix = jax.random.randint(jax.random.PRNGKey(43), (1, 4), 0, V)
+    params = model.init(jax.random.PRNGKey(44), prefix)["params"]
+    suffixes = jax.random.randint(jax.random.PRNGKey(45), (2, 4), 0, V)
+    state = prefill_prefix(model, params, prefix,
+                           max_total_len=4 + 4 + 8)  # 16 <= 24
+    fast = decode_with_prefix(model, params, state, suffixes, 8,
+                              fast_prefill=True)
+    slow = decode_with_prefix(model, params, state, suffixes, 8,
+                              fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+def test_prefix_cache_windowed_chunk_slack_composes_int8_gqa_rope():
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+        prefill_prefix,
+    )
+
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=L,
+                          num_heads=H, max_seq_len=MAXLEN,
+                          attention_window=8, num_kv_heads=2,
+                          pos_embedding="rope", kv_cache_dtype="int8",
+                          dtype=jnp.float32)
+    prefix = jax.random.randint(jax.random.PRNGKey(46), (1, 6), 0, V)
+    params = model.init(jax.random.PRNGKey(47), prefix)["params"]
+    suffixes = jax.random.randint(jax.random.PRNGKey(48), (2, 4), 0, V)
+    state = prefill_prefix(model, params, prefix,
+                           max_total_len=6 + 4 + N, chunk_slack=4)
+    fast = decode_with_prefix(model, params, state, suffixes, N,
+                              fast_prefill=True)
+    slow = decode_with_prefix(model, params, state, suffixes, N,
+                              fast_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+def test_prefill_prefix_chunk_slack_rejected_on_dense_models(dense_lm):
+    from container_engine_accelerators_tpu.models.decode import (
+        prefill_prefix,
+    )
+
+    model, params, _ = dense_lm
+    with pytest.raises(ValueError, match="chunk_slack"):
+        prefill_prefix(model, params, jnp.zeros((1, 4), jnp.int32),
+                       max_total_len=20, chunk_slack=4)
+
+
+def test_prefill_prefix_negative_chunk_slack_rejected():
+    from container_engine_accelerators_tpu.models.decode import (
+        prefill_prefix,
+    )
+
+    model = TransformerLM(vocab_size=V, embed_dim=E, num_layers=1,
+                          num_heads=H, max_seq_len=MAXLEN,
+                          attention_window=8, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="chunk_slack must be"):
+        prefill_prefix(model, params, jnp.zeros((1, 4), jnp.int32),
+                       max_total_len=20, chunk_slack=-2)
