@@ -33,8 +33,15 @@ import glob
 import json
 import os
 import re
+import sys
 
 from tests.conftest import REPO_ROOT
+
+_TOOLS = os.path.join(REPO_ROOT, "tools")
+if _TOOLS not in sys.path:
+    sys.path.append(_TOOLS)  # append, not insert: tools/ modules
+    # must never shadow the package/test import namespace.
+from artifact_freshness import is_fresh  # noqa: E402
 
 # Every measurement/probe artifact the repo commits. Missing entries
 # fail the test (the record must not silently disappear); extras on
@@ -105,3 +112,66 @@ def test_every_artifact_carries_full_provenance():
         if "git_dirty" not in prov:
             problems.append(f"{name}: git_dirty missing")
     assert not problems, "\n".join(problems)
+
+
+def test_freshness_gate_decisions(tmp_path):
+    """The suite's skip-if-fresh gate (tools/artifact_freshness.py):
+    fresh = auditable + not retro-stamped + younger than the cap."""
+    now = 1_700_000_000.0
+    utc_new = datetime.datetime.fromtimestamp(
+        now - 3600, datetime.timezone.utc).isoformat()
+    utc_old = datetime.datetime.fromtimestamp(
+        now - 3 * 86400, datetime.timezone.utc).isoformat()
+    prov = {"generated_utc": utc_new, "git_sha": "a" * 40,
+            "devices": ["TPU v5 lite0"]}
+
+    def write(name, payload):
+        p = tmp_path / name
+        p.write_text(json.dumps(payload))
+        return str(p)
+
+    assert is_fresh(write("fresh.json", {"provenance": prov}), 1,
+                    now=now)
+    assert not is_fresh(
+        write("old.json",
+              {"provenance": dict(prov, generated_utc=utc_old)}),
+        1, now=now)
+    assert is_fresh(
+        write("old_wide.json",
+              {"provenance": dict(prov, generated_utc=utc_old)}),
+        7, now=now)
+    assert not is_fresh(
+        write("retro.json",
+              {"provenance": dict(prov, retro_stamped="note")}),
+        1, now=now)
+    for missing in ("generated_utc", "git_sha", "devices"):
+        broken = dict(prov)
+        del broken[missing]
+        assert not is_fresh(
+            write(f"no_{missing}.json", {"provenance": broken}), 1,
+            now=now), missing
+    assert not is_fresh(write("bare.json", {"rows": []}), 1, now=now)
+    assert not is_fresh(str(tmp_path / "absent.json"), 1, now=now)
+    jl = tmp_path / "rows.jsonl"
+    jl.write_text('{"a": 1}\n{"a": 2}\n')
+    assert not is_fresh(str(jl), 1, now=now)
+    # Clock skew: a capture "from the future" is suspect, not fresh.
+    future = datetime.datetime.fromtimestamp(
+        now + 7200, datetime.timezone.utc).isoformat()
+    assert not is_fresh(
+        write("future.json",
+              {"provenance": dict(prov, generated_utc=future)}),
+        1, now=now)
+
+
+def test_committed_artifact_freshness_matches_expectations():
+    """Pin the gate's decisions on the actual committed artifacts:
+    retro-stamped records must read STALE (they want a clean rerun)
+    whatever their age."""
+    for name in ("ATTN_BENCH.json", "DECODE_BENCH.json",
+                 "SERVING_BENCH.json"):
+        path = os.path.join(REPO_ROOT, name)
+        with open(path) as f:
+            prov = json.load(f)["provenance"]
+        if prov.get("retro_stamped"):
+            assert not is_fresh(path, 10_000), name

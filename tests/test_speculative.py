@@ -866,3 +866,15 @@ def test_spec_windowed_sampling_reproducible_and_greedy_limit():
                               temperature=1e-6, rng=rng)
     want = decode(target, tp, prompt, 16)
     np.testing.assert_array_equal(np.asarray(tiny), np.asarray(want))
+
+
+def test_spec_windowed_moe_target_equals_windowed_greedy():
+    """Drop-free MoE target WITH a sliding window (ring_slack threads
+    through the MoE block stack too): exact greedy identity against
+    plain windowed MoE decode, ring wrapped several times."""
+    target, tp = _moe(seed=0, attention_window=8)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prompt = _prompt(2, 8)
+    want = decode(target, tp, prompt, 24)
+    got = speculative_decode(target, tp, draft, dp, prompt, 24, k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

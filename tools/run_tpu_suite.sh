@@ -63,30 +63,8 @@ sec_rc() {  # $1 = rc, $2 = section name
 # the provenance-less ATTN_BENCH.json to refresh.
 SKIP_FRESH_DAYS="${SUITE_SKIP_FRESH_DAYS:-1}"
 is_fresh() {  # $1 = artifact path; rc 0 = fresh enough to skip
-  python - "$1" "${SKIP_FRESH_DAYS}" <<'PYEOF' 2>/dev/null
-import datetime
-import json
-import sys
-import time
-
-try:
-    d = json.load(open(sys.argv[1]))
-except Exception:
-    sys.exit(1)
-prov = d.get("provenance") or {}
-if not (prov.get("generated_utc") and prov.get("git_sha")
-        and prov.get("devices")):
-    sys.exit(1)
-if prov.get("retro_stamped"):
-    sys.exit(1)  # stamped after the fact — still wants a clean rerun
-try:
-    ts = datetime.datetime.fromisoformat(
-        prov["generated_utc"]).timestamp()
-except ValueError:
-    sys.exit(1)
-age_days = (time.time() - ts) / 86400.0
-sys.exit(0 if 0 <= age_days < float(sys.argv[2]) else 1)
-PYEOF
+  python tools/artifact_freshness.py "$1" "${SKIP_FRESH_DAYS}" \
+    2>/dev/null
 }
 
 # ---------------------------------------------------------------------
