@@ -175,3 +175,89 @@ def test_committed_artifact_freshness_matches_expectations():
             prov = json.load(f)["provenance"]
         if prov.get("retro_stamped"):
             assert not is_fresh(path, 10_000), name
+
+
+def _promote(*args):
+    import subprocess
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(_TOOLS, "promote_artifact.py"), *args],
+        capture_output=True, text=True, timeout=120)
+
+
+def test_promote_decode_refusals_and_success(tmp_path):
+    """The decode promotion (tools/promote_artifact.py) must refuse
+    exactly what the round-4 window taught: empty captures, CPU
+    fallback rows, and must never touch the committed artifact on
+    refusal."""
+    out = tmp_path / "DECODE_BENCH.json"
+    out.write_text('{"sentinel": true}')
+    rows = tmp_path / "rows.jsonl"
+
+    rows.write_text("")
+    p = _promote("decode", str(rows), str(out))
+    assert p.returncode == 1 and "no rows" in p.stderr
+    assert json.loads(out.read_text()) == {"sentinel": True}
+
+    good = {"platform": "tpu", "devices": ["TPU v5 lite0"],
+            "decode_tokens_per_sec": 1.0}
+    rows.write_text(json.dumps(good) + "\n"
+                    + json.dumps(dict(good, platform="cpu")) + "\n")
+    p = _promote("decode", str(rows), str(out))
+    assert p.returncode == 1 and "not measured on TPU" in p.stderr
+    assert json.loads(out.read_text()) == {"sentinel": True}
+
+    # Stricter than the old inline heredoc (which stamped an empty
+    # devices list): rows without a devices field are refused, since
+    # the stamp would be unauditable.
+    rows.write_text(json.dumps(
+        {"platform": "tpu", "decode_tokens_per_sec": 1.0}) + "\n")
+    p = _promote("decode", str(rows), str(out))
+    assert p.returncode == 1 and "no devices" in p.stderr
+    assert json.loads(out.read_text()) == {"sentinel": True}
+
+    rows.write_text(json.dumps(good) + "\n" + json.dumps(good) + "\n")
+    p = _promote("decode", str(rows), str(out))
+    assert p.returncode == 0, p.stderr
+    promoted = json.loads(out.read_text())
+    assert len(promoted["rows"]) == 2
+    assert promoted["provenance"]["devices"] == ["TPU v5 lite0"]
+    assert not promoted["provenance"].get("retro_stamped")
+
+
+def test_promote_serving_refusals_and_success(tmp_path):
+    out = tmp_path / "SERVING_BENCH.json"
+    out.write_text('{"sentinel": true}')
+    raw = tmp_path / "raw.json"
+    stats = tmp_path / "stats.json"
+    ok_run = {"requests": 300, "errors": 0, "qps": 50.0,
+              "p50_ms": 90.0, "p99_ms": 200.0}
+    stats.write_text(json.dumps(
+        {"platform": "tpu", "devices": ["TPU v5 lite0"]}))
+
+    raw.write_text(json.dumps(
+        {"cold": {"error": "load generator produced no result"},
+         "warm": ok_run}))
+    p = _promote("serving", str(raw), str(stats), str(out))
+    assert p.returncode == 1 and "cold run errored" in p.stderr
+
+    raw.write_text(json.dumps(
+        {"cold": ok_run,
+         "warm": {"requests": 10, "errors": 6}}))
+    p = _promote("serving", str(raw), str(stats), str(out))
+    assert p.returncode == 1 and "warm summary unusable" in p.stderr
+
+    raw.write_text(json.dumps({"cold": ok_run, "warm": ok_run}))
+    stats.write_text(json.dumps({"platform": "cpu", "devices": []}))
+    p = _promote("serving", str(raw), str(stats), str(out))
+    assert p.returncode == 1 and "want tpu" in p.stderr
+    assert json.loads(out.read_text()) == {"sentinel": True}
+
+    stats.write_text(json.dumps(
+        {"platform": "tpu", "devices": ["TPU v5 lite0"]}))
+    p = _promote("serving", str(raw), str(stats), str(out))
+    assert p.returncode == 0, p.stderr
+    promoted = json.loads(out.read_text())
+    assert promoted["cold_start"]["requests"] == 300
+    assert promoted["config"]["readiness_gated"] is True
+    assert promoted["provenance"]["devices"] == ["TPU v5 lite0"]

@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Validate-and-promote captured measurements into committed artifacts.
+
+The TPU suite (tools/run_tpu_suite.sh) buffers each section's raw
+capture in a scratch file and only replaces the committed,
+provenance-stamped artifact when the capture is COMPLETE and
+actually measured on the chip — a partial or CPU-fallback capture
+must never overwrite the on-chip record (that rule saved the
+round-4 committed artifacts when the tunnel dropped mid-window).
+This module is that promotion logic, extracted from inline shell
+heredocs so unit tests can pin every refusal path.
+
+Subcommands:
+  decode  <rows.jsonl> <out.json>   wrap JSONL decode rows into one
+                                    {provenance, rows} object;
+                                    refuse empty/non-TPU rows.
+  serving <raw.json> <stats.json> <out.json>
+                                    build the stamped serving
+                                    artifact from the cold+warm
+                                    load-generator summaries and the
+                                    server's /stats; refuse error or
+                                    mostly-failed summaries and
+                                    non-TPU platforms.
+
+Exit 0 = promoted (out written atomically); 1 = refused (out
+untouched; reason on stderr).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.utils.provenance import (  # noqa: E402
+    stamp,
+)
+
+
+class Refused(Exception):
+    pass
+
+
+def _write_atomic(out_path, obj):
+    tmp = out_path + ".promote.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, out_path)
+
+
+def promote_decode(rows_path, out_path):
+    """JSONL rows -> {provenance, rows}; all rows must be on-chip."""
+    with open(rows_path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    if not rows:
+        raise Refused("no rows captured")
+    bad = [r for r in rows if r.get("platform") != "tpu"]
+    if bad:
+        raise Refused(
+            f"{len(bad)} row(s) not measured on TPU (CPU fallback?): "
+            f"first bad platform={bad[0].get('platform')!r}")
+    devices = rows[0].get("devices") or []
+    if not devices:
+        raise Refused("rows carry no devices list for the stamp")
+    _write_atomic(out_path, {"provenance": stamp(devices),
+                             "rows": rows})
+
+
+def promote_serving(raw_path, stats_path, out_path):
+    """cold+warm load summaries + /stats -> stamped artifact."""
+    with open(raw_path) as f:
+        raw = json.load(f)
+    with open(stats_path) as f:
+        stats = json.load(f)
+    for key in ("cold", "warm"):
+        summary = raw.get(key) or {}
+        if summary.get("error"):
+            raise Refused(f"{key} run errored: {summary['error']}")
+        n, errors = summary.get("requests", 0), summary.get("errors", 0)
+        if not (n > 0 and errors * 2 < n):
+            raise Refused(
+                f"{key} summary unusable: requests={n} errors={errors}")
+    if stats.get("platform") != "tpu":
+        raise Refused(
+            f"server platform {stats.get('platform')!r}, want tpu")
+    _write_atomic(out_path, {
+        "config": {
+            "model": "transformer", "max_new_tokens": 32,
+            "max_prompt_len": 48, "parallelism": 8,
+            "mode": "generate", "warm": True, "readiness_gated": True,
+        },
+        "cold_start": raw["cold"],
+        "steady_state": raw["warm"],
+        "server_platform": stats.get("platform"),
+        "provenance": stamp(stats.get("devices") or []),
+    })
+
+
+def main(argv):
+    try:
+        if len(argv) >= 2 and argv[1] == "decode" and len(argv) == 4:
+            promote_decode(argv[2], argv[3])
+        elif (len(argv) >= 2 and argv[1] == "serving"
+              and len(argv) == 5):
+            promote_serving(argv[2], argv[3], argv[4])
+        else:
+            print(__doc__, file=sys.stderr)
+            return 2
+    except Refused as e:
+        print(f"[promote] refused: {e}", file=sys.stderr)
+        return 1
+    except (OSError, ValueError) as e:
+        print(f"[promote] failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
