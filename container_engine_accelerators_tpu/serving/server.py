@@ -561,6 +561,8 @@ class GenerationServer(_BaseServer):
         self._decode_calls = 0
         self._decode_rows = 0
         self._spec_calls = 0
+        self._spec_rounds = 0
+        self._spec_accepted = 0
         self._prefix_state = None
         self._prefix_len = 0
         if prefix_tokens is not None:
@@ -720,6 +722,14 @@ class GenerationServer(_BaseServer):
                           want_lp=bool(spec.get("logprobs", False)),
                           force_plain=not self._default_knobs(rp_f),
                           filtered=self._filtered_knobs(tp_f, mp_f))
+        # Warm-up's synthetic all-zeros prompts ride the same spec
+        # call site and would dominate the acceptance telemetry early
+        # in a replica's life — reset so /stats reports TRAFFIC's
+        # alpha only (speculative_calls keeps counting warm calls;
+        # it is a program-compilation signal, not a traffic one).
+        with self._stats_lock:
+            self._spec_rounds = 0
+            self._spec_accepted = 0
         self._ready.set()
         log.info("warm-up complete: %d bucket(s) x (2 + %d) "
                  "programs", len(self._buckets),
@@ -858,9 +868,21 @@ class GenerationServer(_BaseServer):
                 eos_id=eos_ids, temperature=temps,
                 rng=jax.random.PRNGKey(seed),
                 active_rows=np.arange(self._max_batch) < n,
-                return_logprobs=want_lp, **fkw)
+                return_logprobs=want_lp, return_stats=True, **fkw)
+            # Acceptance telemetry: the alpha that decides whether
+            # the configured draft pays off in production traffic
+            # (docs/benchmarks.md "Speculation break-even"). The
+            # int() syncs BLOCK until the decode finishes, so they
+            # must run before taking _stats_lock (the file's rule:
+            # nothing blockable under that lock — /stats and every
+            # request thread's latency record wait on it).
+            out, spec_stats = out
+            spec_rounds = int(spec_stats["rounds"])
+            spec_accepted = int(spec_stats["accepted_drafts"])
             with self._stats_lock:
                 self._spec_calls += 1
+                self._spec_rounds += spec_rounds
+                self._spec_accepted += spec_accepted
             if want_lp:
                 seq, lps = out
                 return list(zip(np.asarray(seq)[:n],
@@ -1068,10 +1090,20 @@ class GenerationServer(_BaseServer):
         """Decode-batch occupancy: rows served per compiled call —
         the batching-efficiency signal for load tests."""
         calls = self._decode_calls
+        # k=1 proposes zero drafts per round — no acceptance to
+        # rate, so None (0.0 would read as "every proposal
+        # rejected").
+        proposed = self._spec_rounds * (self._spec_k - 1)
         return {
             "decode_calls": calls,
             "decode_rows": self._decode_rows,
             "speculative_calls": self._spec_calls,
+            # Fraction of draft proposals the target accepted — the
+            # alpha in the break-even model; near 0 means the
+            # configured draft is wasted work on this traffic.
+            "speculative_acceptance_rate": (
+                round(self._spec_accepted / proposed, 4)
+                if proposed else None),
             "avg_batch_occupancy": (
                 round(self._decode_rows / calls, 3) if calls else None),
         }

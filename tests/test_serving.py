@@ -1327,3 +1327,34 @@ def test_generate_speculative_windowed_model_routes_spec():
     finally:
         plain.stop()
         spec.stop()
+
+
+def test_generate_speculative_acceptance_telemetry():
+    """/stats exposes the draft acceptance rate — the break-even
+    model's alpha — accumulated across speculative calls."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer(
+        "lm", model, params, port=0, max_new_tokens=8, max_batch=2,
+        buckets=[8], draft_model=model, draft_params=params,
+        speculative_k=4)
+    srv.start()
+    try:
+        post(srv, "/v1/models/lm:generate",
+             {"prompts": [[1, 2, 3]], "max_new_tokens": 8})
+        stats = srv.stats()
+        assert stats["speculative_calls"] >= 1
+        rate = stats["speculative_acceptance_rate"]
+        # Self-draft: every proposal matches, so the accumulated
+        # acceptance must be 1.0 exactly.
+        assert rate == 1.0, stats
+    finally:
+        srv.stop()

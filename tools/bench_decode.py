@@ -160,11 +160,16 @@ def main(argv=None):
                                  else args.draft_layers)}
 
         def run(prompt):
+            # return_stats rides IN the timed program (it is a
+            # static jit arg — a separate stats call would compile
+            # and execute a whole second decode); the timed loop
+            # syncs only the tokens, the final iteration's stats are
+            # read after timing.
             return speculative_decode(
                 model, params, draft_model, draft_params, prompt,
                 args.new_tokens, k=args.speculative_k,
                 temperature=args.temperature,
-                rng=jax.random.PRNGKey(3))
+                rng=jax.random.PRNGKey(3), return_stats=True)
     else:
         def run(prompt):
             return decode(model, params, prompt, args.new_tokens,
@@ -229,14 +234,32 @@ def main(argv=None):
         # wall_sync, not block_until_ready: the tunneled axon backend
         # acks dispatch as "ready"; only a forced device->host
         # transfer times real execution (one round trip, amortized).
+        def seq_of(result):
+            return result[0] if args.speculative_k else result
+
         out = run(prompt)
-        wall_sync(out)  # compile + warm
+        wall_sync(seq_of(out))  # compile + warm
         t0 = time.perf_counter()
         for _ in range(args.iters):
             out = run(prompt)
-        wall_sync(out)
+        wall_sync(seq_of(out))
         sec = (time.perf_counter() - t0) / args.iters
         tokens = b * args.new_tokens
+        if args.speculative_k:
+            # Acceptance rate from the final timed iteration (fixed
+            # rng + prompt: every iteration's stats are identical) —
+            # the alpha the break-even model needs to interpret the
+            # throughput (docs/benchmarks.md "Speculation
+            # break-even"); a spec row without it says whether
+            # speculation won but not why.
+            st = out[1]
+            rounds = int(st["rounds"])
+            accepted = int(st["accepted_drafts"])
+            spec["spec_rounds"] = rounds
+            spec["spec_accepted_drafts"] = accepted
+            if rounds and args.speculative_k > 1:
+                spec["spec_acceptance_rate"] = round(
+                    accepted / (rounds * (args.speculative_k - 1)), 4)
         print(json.dumps({
             "batch": b,
             "prompt_len": args.prompt_len,
