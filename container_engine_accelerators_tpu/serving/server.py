@@ -675,8 +675,10 @@ class GenerationServer(_BaseServer):
             # pad_temp selects greedy vs sampling mode. With a draft
             # configured the two default calls ride the greedy and
             # sampling SPECULATIVE programs.
-            self._run([(zeros, 0.0, b, 1.0, -1, 1.0, 0.0)], 0.0)
-            self._run([(zeros, 1.0, b, 1.0, -1, 1.0, 0.0)], 1.0)
+            self._run([(zeros, 0.0, b, 1.0, -1, 1.0, 0.0)], 0.0,
+                      account_spec=False)
+            self._run([(zeros, 1.0, b, 1.0, -1, 1.0, 0.0)], 1.0,
+                      account_spec=False)
             if self._spec_k:
                 # Traffic with a repetition penalty still selects the
                 # PLAIN decode program in either mode (ADVICE r3:
@@ -688,9 +690,9 @@ class GenerationServer(_BaseServer):
                 # build the wrong variant (and, on buckets without
                 # speculative headroom, just repeat the calls above).
                 self._run([(zeros, 0.0, b, 1.0, -1, 1.1, 0.0)], 0.0,
-                          force_plain=True)
+                          force_plain=True, account_spec=False)
                 self._run([(zeros, 1.0, b, 1.0, -1, 1.1, 0.0)], 1.0,
-                          force_plain=True)
+                          force_plain=True, account_spec=False)
             for spec in self._warm_filters:
                 if spec.get("stream"):
                     # Mirror request routing exactly (same rule as
@@ -721,15 +723,8 @@ class GenerationServer(_BaseServer):
                 self._run([inst], temp, top_k=top_k,
                           want_lp=bool(spec.get("logprobs", False)),
                           force_plain=not self._default_knobs(rp_f),
-                          filtered=self._filtered_knobs(tp_f, mp_f))
-        # Warm-up's synthetic all-zeros prompts ride the same spec
-        # call site and would dominate the acceptance telemetry early
-        # in a replica's life — reset so /stats reports TRAFFIC's
-        # alpha only (speculative_calls keeps counting warm calls;
-        # it is a program-compilation signal, not a traffic one).
-        with self._stats_lock:
-            self._spec_rounds = 0
-            self._spec_accepted = 0
+                          filtered=self._filtered_knobs(tp_f, mp_f),
+                          account_spec=False)
         self._ready.set()
         log.info("warm-up complete: %d bucket(s) x (2 + %d) "
                  "programs", len(self._buckets),
@@ -788,7 +783,7 @@ class GenerationServer(_BaseServer):
                     or np.any(np.asarray(min_p) > 0.0))
 
     def _run(self, instances, pad_temp, top_k=0, want_lp=False,
-             force_plain=False, filtered=False):
+             force_plain=False, filtered=False, account_spec=True):
         """Decode a micro-batch of (row, temperature, prompt_len,
         top_p, eos_id, rep_penalty) instances through the
         (max_batch, bucket) padded program."""
@@ -881,8 +876,15 @@ class GenerationServer(_BaseServer):
             spec_accepted = int(spec_stats["accepted_drafts"])
             with self._stats_lock:
                 self._spec_calls += 1
-                self._spec_rounds += spec_rounds
-                self._spec_accepted += spec_accepted
+                # Warm-up's synthetic all-zeros prompts ride this
+                # same site with account_spec=False: their
+                # degenerate acceptance must not pollute the
+                # traffic alpha /stats reports (and real traffic
+                # served concurrently with an async warm-up keeps
+                # its own accounting — no reset races).
+                if account_spec:
+                    self._spec_rounds += spec_rounds
+                    self._spec_accepted += spec_accepted
             if want_lp:
                 seq, lps = out
                 return list(zip(np.asarray(seq)[:n],

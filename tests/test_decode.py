@@ -1245,3 +1245,54 @@ def test_prefill_prefix_negative_chunk_slack_rejected():
     with pytest.raises(ValueError, match="chunk_slack must be"):
         prefill_prefix(model, params, jnp.zeros((1, 4), jnp.int32),
                        max_total_len=20, chunk_slack=-2)
+
+
+def test_beam_windowed_equals_exhaustive_truncated_scoring():
+    """Beam search on a sliding-window model: the ring cache (which
+    the beam gather/fan-out reorders every step) must score paths
+    exactly as the dense windowed forward does — pinned against the
+    exhaustive argmax with first-EOS truncated scoring, with the
+    window short enough that the ring wraps inside the scored
+    region."""
+    import itertools
+
+    v, n, eos, w = 5, 3, 2, 3
+    model = TransformerLM(vocab_size=v, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=8,
+                          attention_window=w, dtype=jnp.float32)
+    prompt = jnp.asarray([[1, 3]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(8), prompt)["params"]
+    seqs, scores = beam_search(model, params, prompt, n,
+                               num_beams=v ** n, eos_id=eos)
+
+    def truncated_score(path):
+        seq = jnp.asarray([[1, 3, *path]], jnp.int32)
+        logits = model.apply({"params": params}, seq, train=False)
+        lp = jax.nn.log_softmax(
+            np.asarray(logits)[0].astype(np.float32), axis=-1)
+        score = 0.0
+        for t in range(1, n + 1):
+            score += lp[t, seq[0, t + 1]]
+            if int(seq[0, t + 1]) == eos:
+                break
+        return score
+
+    best_score, best_path = -np.inf, None
+    seen = set()
+    for path in itertools.product(range(v), repeat=n):
+        canon = []
+        done = False
+        for tok in path:
+            canon.append(eos if done else tok)
+            done = done or tok == eos
+        canon = tuple(canon)
+        if canon in seen:
+            continue
+        seen.add(canon)
+        score = truncated_score(canon)
+        if score > best_score:
+            best_score, best_path = score, canon
+    np.testing.assert_array_equal(np.asarray(seqs[0, 0, 2:]),
+                                  np.asarray(best_path))
+    np.testing.assert_allclose(float(scores[0, 0]), best_score,
+                               rtol=1e-4, atol=1e-4)

@@ -1345,16 +1345,20 @@ def test_generate_speculative_acceptance_telemetry():
     srv = GenerationServer(
         "lm", model, params, port=0, max_new_tokens=8, max_batch=2,
         buckets=[8], draft_model=model, draft_params=params,
-        speculative_k=4)
+        speculative_k=4, warm=True)
     srv.start()
     try:
+        # Warm-up's synthetic spec calls count as calls (program-
+        # compilation signal) but must NOT seed the acceptance rate:
+        # it reports TRAFFIC's alpha only.
+        stats0 = srv.stats()
+        assert stats0["speculative_calls"] >= 1, stats0
+        assert stats0["speculative_acceptance_rate"] is None, stats0
         post(srv, "/v1/models/lm:generate",
              {"prompts": [[1, 2, 3]], "max_new_tokens": 8})
         stats = srv.stats()
-        assert stats["speculative_calls"] >= 1
-        rate = stats["speculative_acceptance_rate"]
         # Self-draft: every proposal matches, so the accumulated
         # acceptance must be 1.0 exactly.
-        assert rate == 1.0, stats
+        assert stats["speculative_acceptance_rate"] == 1.0, stats
     finally:
         srv.stop()
