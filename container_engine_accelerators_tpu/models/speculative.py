@@ -88,7 +88,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .decode import (
+    _decode_clone,
     _logits_of,
+    _map_batch_leaves,
     _mask_min_p,
     _mask_top_k,
     _mask_top_p,
@@ -115,14 +117,23 @@ def _rewind(cache, position):
                               "k", "return_stats", "ragged",
                               "use_eos", "sample", "use_active",
                               "use_logprobs", "top_k", "use_top_p",
-                              "use_min_p"))
+                              "use_min_p", "use_prefix", "p0",
+                              "cache_fan"))
 def _spec_impl(model, params, draft_model, draft_params, prompt,
                max_new_tokens, k, return_stats, ragged, prompt_len,
                use_eos, eos_id, sample, temperature, rng, use_active,
                active, use_logprobs, top_k, use_top_p, top_p,
-               use_min_p, min_p):
+               use_min_p, min_p, use_prefix=False, p0=0, cache_fan=1,
+               t_prefix_cache=None, d_prefix_cache=None):
     b, p = prompt.shape
     total = p + max_new_tokens + k  # slack for optimistic writes
+    # use_prefix: caches arrive PREFILLED with a shared p0-token
+    # prefix (prefill_prefix states for both target and draft);
+    # prompt is then the per-request SUFFIX, all `out` positions are
+    # suffix-relative, and the only absolute-position seam is the
+    # cache rewind (p0 + suffix position). cache_fan broadcasts the
+    # prefix batch across the request batch exactly as
+    # decode_with_prefix does.
     # Per-row EOS (-1 = never matches); decode's semantics: a row
     # whose GENERATED text reached EOS keeps emitting it.
     eos_row = jnp.reshape(eos_id, (-1,)).astype(prompt.dtype)
@@ -176,13 +187,37 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
     # (q - W, q] band. Stale (rejected) entries are masked by the
     # k_pos <= q_pos test until the recommit pass rewrites their
     # slot, which happens before any query reaches their position.
-    if getattr(model, "attention_window", 0):
-        model = model.clone(ring_slack=k)
-    if getattr(draft_model, "attention_window", 0):
-        draft_model = draft_model.clone(ring_slack=k)
-    target_dec, target_cache = init_cache(model, b, total)
-    verify_dec = target_dec.clone(chunk_attends_cache=True)
-    draft_dec, draft_cache = init_cache(draft_model, b, total)
+    if use_prefix:
+        # Prefix path: caches are given (prefilled, counters at p0),
+        # not initialized here; both suffix prefills are MID-CACHE
+        # chunks, so the draft needs a chunk_attends_cache clone of
+        # its own (windowed models are rejected by the wrapper — the
+        # given ring would additionally need suffix-width capacity).
+        target_dec = _decode_clone(model)
+        verify_dec = target_dec.clone(chunk_attends_cache=True)
+        draft_dec = _decode_clone(draft_model)
+        draft_chunk = draft_dec.clone(chunk_attends_cache=True)
+
+        def _fan(cache):
+            if cache_fan == 1:
+                return cache
+            return _map_batch_leaves(
+                lambda a: jnp.repeat(a, cache_fan, axis=0), cache)
+
+        target_cache = _fan(t_prefix_cache)
+        draft_cache = _fan(d_prefix_cache)
+    else:
+        if getattr(model, "attention_window", 0):
+            model = model.clone(ring_slack=k)
+        if getattr(draft_model, "attention_window", 0):
+            draft_model = draft_model.clone(ring_slack=k)
+        target_dec, target_cache = init_cache(model, b, total)
+        verify_dec = target_dec.clone(chunk_attends_cache=True)
+        draft_dec, draft_cache = init_cache(draft_model, b, total)
+    # Suffix/prompt prefill modules: mid-cache chunks on the prefix
+    # path, ordinary empty-cache prefill otherwise.
+    prefill_target = verify_dec if use_prefix else target_dec
+    prefill_draft = draft_chunk if use_prefix else draft_dec
 
     if ragged:
         # Per-row true lengths: rows diverge inside the padded prompt
@@ -240,7 +275,7 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         # forward. `first` is the token at position p.
         prefix = jnp.concatenate(
             [prompt[:, :1], walked.T[:, :p - 1]], axis=1)
-        _, dupd = draft_dec.apply(
+        _, dupd = prefill_draft.apply(
             {"params": draft_params, "cache": draft_cache}, prefix,
             train=False, mutable=["cache"])
         draft_cache = dupd["cache"]
@@ -259,7 +294,7 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
         # Full-width prompts: prefill both caches with one forward
         # each; the target's last-position logits yield the first
         # generated token (identical to decode()'s fast_prefill).
-        outs, upd = target_dec.apply(
+        outs, upd = prefill_target.apply(
             {"params": params, "cache": target_cache}, prompt,
             train=False, mutable=["cache"])
         target_cache = upd["cache"]
@@ -274,7 +309,7 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
                 prompt.dtype)
         done = ((first == eos_row) if use_eos
                 else jnp.zeros((b,), bool))
-        _, dupd = draft_dec.apply(
+        _, dupd = prefill_draft.apply(
             {"params": draft_params, "cache": draft_cache}, prompt,
             train=False, mutable=["cache"])
         draft_cache = dupd["cache"]
@@ -479,9 +514,10 @@ def _spec_impl(model, params, draft_model, draft_params, prompt,
                     lpc, m, axis=1, keepdims=True), (0, start + m))
 
         # Rewind both caches to the invariant index: the position of
-        # `nxt`, the newest committed-but-unkeyed token.
-        target_cache = _rewind(u["cache"], start + m)
-        draft_cache = _rewind(draft_cache, start + m)
+        # `nxt`, the newest committed-but-unkeyed token. Cache
+        # positions are absolute (prefix path: p0 + suffix index).
+        target_cache = _rewind(u["cache"], p0 + start + m)
+        draft_cache = _rewind(draft_cache, p0 + start + m)
         return (out, n + m + 1, nxt, target_cache, draft_cache,
                 done, rounds + 1, accepted + m, loop_rng, lp)
 
@@ -651,6 +687,25 @@ def speculative_decode(model, params, draft_model, draft_params,
             raise ValueError(
                 f"prompt {p} + max_new_tokens {max_new_tokens} + k "
                 f"{k} exceeds {which} max_seq_len {m.max_seq_len}")
+    return _prepare_and_run_spec(
+        model, params, draft_model, draft_params, prompt,
+        max_new_tokens, k=k, temperature=temperature, rng=rng,
+        top_k=top_k, top_p=top_p, min_p=min_p, prompt_len=prompt_len,
+        eos_id=eos_id, active_rows=active_rows,
+        return_logprobs=return_logprobs, return_stats=return_stats)
+
+
+def _prepare_and_run_spec(model, params, draft_model, draft_params,
+                          prompt, max_new_tokens, *, k, temperature,
+                          rng, top_k, top_p, min_p, prompt_len,
+                          eos_id, active_rows, return_logprobs,
+                          return_stats, use_prefix=False, p0=0,
+                          cache_fan=1, t_prefix_cache=None,
+                          d_prefix_cache=None):
+    """Shared knob normalization + dispatch for plain and
+    prefix-state speculation: ONE authority for the per-row
+    vector/scalar rules, mode selection, and validation messages."""
+    b, p = prompt.shape
     # Program-variant selection is purely type-driven (None vs given),
     # NEVER value-driven: a serving layer feeding batches of varying
     # composition must land on one stable compiled program per shape
@@ -765,4 +820,105 @@ def speculative_decode(model, params, draft_model, draft_params,
                       k, return_stats, ragged, plen_arr, use_eos,
                       eos_arr, sample, jnp.asarray(t_host), rng,
                       use_active, act_arr, bool(return_logprobs),
-                      top_k, use_top_p, tp_arr, use_min_p, mp_arr)
+                      top_k, use_top_p, tp_arr, use_min_p, mp_arr,
+                      use_prefix=use_prefix, p0=p0,
+                      cache_fan=cache_fan,
+                      t_prefix_cache=t_prefix_cache,
+                      d_prefix_cache=d_prefix_cache)
+
+
+def speculative_decode_with_prefix(model, params, draft_model,
+                                   draft_params, target_prefix_state,
+                                   draft_prefix_state, prompt,
+                                   max_new_tokens, *, k=4,
+                                   temperature=0.0, rng=None, top_k=0,
+                                   top_p=None, min_p=None,
+                                   prompt_len=None, eos_id=None,
+                                   active_rows=None,
+                                   return_stats=False):
+    """Speculative decoding over a SHARED-PREFIX cache: the prefix
+    (system prompt) is prefilled once per model — ``prefill_prefix``
+    states for the target AND the draft, both over the same prefix
+    tokens — and each request pays only its suffix prefill plus the
+    drafted/verified generation. Combines ``decode_with_prefix``'s
+    time-to-first-token amortization with speculation's
+    weight-stream amortization; the serving layer's two biggest
+    levers no longer exclude each other.
+
+    Output contract matches ``decode_with_prefix`` exactly: with
+    ``temperature == 0`` the returned [B, P_suffix + max_new_tokens]
+    tokens (suffix-relative; the prefix is never re-emitted) are
+    token-for-token what ``decode_with_prefix(model, params,
+    target_prefix_state, prompt, max_new_tokens)`` returns; with
+    ``temperature > 0`` the committed tokens follow the target's
+    softmax(logits/T) exactly (rejection-sampling speculation, same
+    machinery and knobs as ``speculative_decode`` — top_k/top_p/
+    min_p compose, per-row vectors ride as usual). ``prompt_len``
+    supports ragged suffixes; ``eos_id``/``active_rows``/
+    ``return_stats`` behave as in ``speculative_decode``.
+
+    The prefix batch fans out across the request batch like
+    ``decode_with_prefix`` (request row bp*fan + j continues prefix
+    row bp). Both states must be allocated with room for
+    prefix + suffix + max_new_tokens + k tokens.
+
+    Not supported: sliding-window models (the given ring would
+    additionally need suffix-width + k slack; allocate-time support
+    is future work), ``return_logprobs`` (the first suffix
+    position's score lives in the prefix state's discarded last
+    logits), repetition penalty (stateful over the committed
+    prefix), and MoE restrictions as in ``speculative_decode``.
+    """
+    if max_new_tokens < 1:
+        raise ValueError("speculative decode needs max_new_tokens >= 1")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    check_spec_models(model, draft_model)
+    for m, which in ((model, "target"), (draft_model, "draft")):
+        if getattr(m, "attention_window", 0):
+            raise ValueError(
+                f"speculative_decode_with_prefix does not support "
+                f"sliding-window models ({which}): the prefix ring "
+                f"would need suffix-width + k extra slots")
+    t_cache, t_plen, t_total = target_prefix_state
+    d_cache, d_plen, d_total = draft_prefix_state
+    if t_plen != d_plen:
+        raise ValueError(
+            f"target and draft prefix states disagree on prefix "
+            f"length: {t_plen} vs {d_plen} — both must be "
+            f"prefill_prefix states over the SAME prefix tokens")
+    b, p = prompt.shape
+    t_kv = next(leaf for leaf in jax.tree_util.tree_leaves(t_cache)
+                if getattr(leaf, "ndim", 0) >= 2)
+    prefix_b = t_kv.shape[0]
+    d_kv = next(leaf for leaf in jax.tree_util.tree_leaves(d_cache)
+                if getattr(leaf, "ndim", 0) >= 2)
+    if d_kv.shape[0] != prefix_b:
+        raise ValueError(
+            f"target and draft prefix states disagree on prefix "
+            f"batch: {prefix_b} vs {d_kv.shape[0]}")
+    if b % prefix_b:
+        raise ValueError(
+            f"request batch {b} must be a multiple of the prefix "
+            f"batch {prefix_b}")
+    need = t_plen + p + max_new_tokens + k
+    for cap, which in ((t_total, "target"), (d_total, "draft")):
+        if need > cap:
+            raise ValueError(
+                f"prefix {t_plen} + suffix {p} + max_new_tokens "
+                f"{max_new_tokens} + k {k} = {need} overflows the "
+                f"{which} prefix state's max_total_len {cap}")
+    for m, which in ((model, "target"), (draft_model, "draft")):
+        if need > m.max_seq_len:
+            raise ValueError(
+                f"prefix {t_plen} + suffix {p} + max_new_tokens "
+                f"{max_new_tokens} + k {k} exceeds {which} "
+                f"max_seq_len {m.max_seq_len}")
+    return _prepare_and_run_spec(
+        model, params, draft_model, draft_params, prompt,
+        max_new_tokens, k=k, temperature=temperature, rng=rng,
+        top_k=top_k, top_p=top_p, min_p=min_p, prompt_len=prompt_len,
+        eos_id=eos_id, active_rows=active_rows,
+        return_logprobs=False, return_stats=return_stats,
+        use_prefix=True, p0=int(t_plen), cache_fan=b // prefix_b,
+        t_prefix_cache=t_cache, d_prefix_cache=d_cache)

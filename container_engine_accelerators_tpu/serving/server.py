@@ -490,9 +490,11 @@ class GenerationServer(_BaseServer):
     part AFTER it — per-request cost drops to suffix prefill +
     generation, and responses carry suffix-relative sequences (the
     prefix is never re-emitted). Requests needing prefix-token
-    visibility (repetition_penalty, logprobs) are rejected with 400,
-    and the mode does not compose with speculative_k (construction
-    error) — use a plain server for that traffic.
+    visibility (repetition_penalty, logprobs) are rejected with 400.
+    The mode COMPOSES with speculative_k: the draft prefills the
+    same prefix into its own state at construction and default-knob
+    traffic rides speculative_decode_with_prefix (sliding-window
+    models refuse the combination at construction).
     """
 
     def __init__(self, model_name, model, params, port=8500,
@@ -567,10 +569,19 @@ class GenerationServer(_BaseServer):
         self._prefix_len = 0
         if prefix_tokens is not None:
             if self._spec_k:
-                raise ValueError(
-                    "prefix_tokens does not compose with "
-                    "speculative_k: the spec verify path has no "
-                    "prefix-cache reuse")
+                # Prefix + speculation compose via
+                # speculative_decode_with_prefix (the draft gets its
+                # own prefilled state below) — except on
+                # sliding-window models, whose prefix ring would
+                # need suffix + k extra slots. Fail at CONSTRUCTION,
+                # as every other unservable config does.
+                for m, which in ((model, "target"),
+                                 (draft_model, "draft")):
+                    if getattr(m, "attention_window", 0):
+                        raise ValueError(
+                            f"prefix_tokens + speculative_k does "
+                            f"not support sliding-window models "
+                            f"({which})")
             prefix_arr = np.asarray(prefix_tokens, np.int32)
             if prefix_arr.ndim != 1 or prefix_arr.size < 1:
                 raise ValueError(
@@ -609,6 +620,7 @@ class GenerationServer(_BaseServer):
             {b for b in buckets if 1 <= b <= max_prompt})
         if not self._buckets:
             raise ValueError("no valid prompt-length buckets")
+        self._draft_prefix_state = None
         if self._prefix_len:
             from ..models.decode import (
                 decode_with_prefix,
@@ -619,10 +631,27 @@ class GenerationServer(_BaseServer):
             # less than the sizing total); one compiled decode
             # program per (bucket, mode) as usual — fan_out is the
             # constant max_batch because _run always pads to it.
+            # With a draft configured, the states carry spec_k extra
+            # positions (speculation's optimistic-write slack) and
+            # the draft prefills the SAME prefix into its own state.
+            # Each state clamps to its model's max_seq_len; buckets
+            # whose spec headroom doesn't fit fall back to the plain
+            # prefix program at routing time (the state capacities
+            # ARE the routing check), mirroring the non-prefix path.
+            want = (self._prefix_len + self._buckets[-1]
+                    + max_new_tokens + self._spec_k)
             self._prefix_state = prefill_prefix(
                 model, params, prefix_arr[None, :],
-                max_total_len=(self._prefix_len + self._buckets[-1]
-                               + max_new_tokens))
+                max_total_len=min(want, model.max_seq_len))
+            if self._spec_k:
+                from ..models.speculative import (
+                    speculative_decode_with_prefix,
+                )
+                self._speculative_with_prefix = (
+                    speculative_decode_with_prefix)
+                self._draft_prefix_state = prefill_prefix(
+                    draft_model, draft_params, prefix_arr[None, :],
+                    max_total_len=min(want, draft_model.max_seq_len))
         # Cross-request batching: one _Batcher per (bucket, sampling
         # mode, effective top_k) — rows from concurrent requests with
         # the same key share one decode call. Rows carry per-row
@@ -782,6 +811,41 @@ class GenerationServer(_BaseServer):
         return bool(np.any(np.asarray(top_p) < 1.0)
                     or np.any(np.asarray(min_p) > 0.0))
 
+    @staticmethod
+    def _spec_filter_kwargs(pad_temp, top_k, filtered, top_ps,
+                            min_ps):
+        """Sampling-filter kwargs for a speculative call — ONE
+        assembly for the prefix and non-prefix routes. Filtered
+        sampling batchers always carry BOTH filter vectors (pad
+        rows are exact no-ops in the mask helpers) so their one
+        spec program stays stable; greedy batches carry none."""
+        fkw = {}
+        if pad_temp:
+            fkw["top_k"] = top_k
+            if filtered:
+                fkw["top_p"] = top_ps
+                fkw["min_p"] = min_ps
+        return fkw
+
+    def _record_spec(self, spec_stats, account_spec):
+        """Acceptance telemetry — the alpha that decides whether the
+        configured draft pays off on this traffic (docs/benchmarks.md
+        "Speculation break-even"). The int() syncs BLOCK until the
+        decode finishes, so they run before _stats_lock (nothing
+        blockable may hold it — /stats and every request thread's
+        latency record wait on it). Warm-up's synthetic prompts pass
+        account_spec=False: their degenerate acceptance must not
+        pollute the traffic alpha, and traffic served concurrently
+        with an async warm-up keeps its own accounting (no resets to
+        race)."""
+        spec_rounds = int(spec_stats["rounds"])
+        spec_accepted = int(spec_stats["accepted_drafts"])
+        with self._stats_lock:
+            self._spec_calls += 1
+            if account_spec:
+                self._spec_rounds += spec_rounds
+                self._spec_accepted += spec_accepted
+
     def _run(self, instances, pad_temp, top_k=0, want_lp=False,
              force_plain=False, filtered=False, account_spec=True):
         """Decode a micro-batch of (row, temperature, prompt_len,
@@ -815,6 +879,29 @@ class GenerationServer(_BaseServer):
             # prefilled prefix (fan_out = max_batch). Penalty and
             # logprobs rows cannot reach here (_handle_post 400s
             # them; construction rejects such warm_filters).
+            if (self._spec_k and not force_plain
+                    and self._default_knobs(rep_pens)
+                    and self._prefix_len + bucket + self._max_new
+                    + self._spec_k
+                    <= min(self._prefix_state[2],
+                           self._draft_prefix_state[2])):
+                # Prefix + speculation: the two serving levers
+                # composed — same stable-program and active-rows
+                # discipline as the non-prefix spec route below.
+                out, spec_stats = self._speculative_with_prefix(
+                    self._model, self._params, self._draft_model,
+                    self._draft_params, self._prefix_state,
+                    self._draft_prefix_state, jnp.asarray(padded),
+                    self._max_new, k=self._spec_k, prompt_len=plens,
+                    eos_id=eos_ids, temperature=temps,
+                    rng=jax.random.PRNGKey(seed),
+                    active_rows=np.arange(self._max_batch) < n,
+                    return_stats=True,
+                    **self._spec_filter_kwargs(pad_temp, top_k,
+                                               filtered, top_ps,
+                                               min_ps))
+                self._record_spec(spec_stats, account_spec)
+                return np.asarray(out)[:n]
             # fast_prefill=False for the same reason as the plain
             # path below: the auto-selected one-chunk-suffix variant
             # would flip with batch composition (all-full-width vs
@@ -850,41 +937,17 @@ class GenerationServer(_BaseServer):
             # carry none and keep the mask-free program (no vocab
             # sort on the hot path). Greedy batches carry none —
             # client filters are rejected at temperature 0.
-            fkw = {}
-            if pad_temp:
-                fkw["top_k"] = top_k
-                if filtered:
-                    fkw["top_p"] = top_ps
-                    fkw["min_p"] = min_ps
-            out = self._speculative(
+            out, spec_stats = self._speculative(
                 self._model, self._params, self._draft_model,
                 self._draft_params, jnp.asarray(padded),
                 self._max_new, k=self._spec_k, prompt_len=plens,
                 eos_id=eos_ids, temperature=temps,
                 rng=jax.random.PRNGKey(seed),
                 active_rows=np.arange(self._max_batch) < n,
-                return_logprobs=want_lp, return_stats=True, **fkw)
-            # Acceptance telemetry: the alpha that decides whether
-            # the configured draft pays off in production traffic
-            # (docs/benchmarks.md "Speculation break-even"). The
-            # int() syncs BLOCK until the decode finishes, so they
-            # must run before taking _stats_lock (the file's rule:
-            # nothing blockable under that lock — /stats and every
-            # request thread's latency record wait on it).
-            out, spec_stats = out
-            spec_rounds = int(spec_stats["rounds"])
-            spec_accepted = int(spec_stats["accepted_drafts"])
-            with self._stats_lock:
-                self._spec_calls += 1
-                # Warm-up's synthetic all-zeros prompts ride this
-                # same site with account_spec=False: their
-                # degenerate acceptance must not pollute the
-                # traffic alpha /stats reports (and real traffic
-                # served concurrently with an async warm-up keeps
-                # its own accounting — no reset races).
-                if account_spec:
-                    self._spec_rounds += spec_rounds
-                    self._spec_accepted += spec_accepted
+                return_logprobs=want_lp, return_stats=True,
+                **self._spec_filter_kwargs(pad_temp, top_k, filtered,
+                                           top_ps, min_ps))
+            self._record_spec(spec_stats, account_spec)
             if want_lp:
                 seq, lps = out
                 return list(zip(np.asarray(seq)[:n],

@@ -194,8 +194,10 @@ def main(argv=None):
                         "Requires --tokenizer (ids go in "
                         "--system-prefix-ids: text that happens to "
                         "look like ids must never silently change "
-                        "meaning). Not combinable with "
-                        "--speculative-k")
+                        "meaning). Combines with --speculative-k: "
+                        "the draft prefills the same prefix and "
+                        "default-knob traffic rides prefix "
+                        "speculation")
     p.add_argument("--system-prefix-ids", default="",
                    help="shared system prompt as comma-separated "
                         "token ids (mutually exclusive with "
@@ -223,9 +225,9 @@ def main(argv=None):
         if args.model not in ("transformer", "moe"):
             p.error("--system-prefix/--system-prefix-ids apply only "
                     "to LM models (--model transformer|moe)")
-        if args.speculative_k:
-            p.error("--system-prefix does not compose with "
-                    "--speculative-k")
+        # --speculative-k composes: GenerationServer prefills the
+        # draft's prefix state at construction and routes
+        # default-knob traffic through prefix speculation.
     if args.compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir",
                           args.compilation_cache_dir)

@@ -1067,10 +1067,17 @@ def test_prefix_server_construction_errors():
                           dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
-    with pytest.raises(ValueError, match="speculative_k"):
-        GenerationServer("x", model, params, port=0,
+    # Prefix + speculation compose now — except on sliding-window
+    # models, which refuse at construction.
+    wmodel = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                           num_heads=4, max_seq_len=40,
+                           attention_window=8, dtype=jnp.float32)
+    wparams = wmodel.init(jax.random.PRNGKey(1),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="sliding-window"):
+        GenerationServer("x", wmodel, wparams, port=0,
                          prefix_tokens=[1, 2], speculative_k=2,
-                         draft_model=model, draft_params=params)
+                         draft_model=wmodel, draft_params=wparams)
     with pytest.raises(ValueError, match="0..63"):
         GenerationServer("x", model, params, port=0,
                          prefix_tokens=[1, 99])
@@ -1362,3 +1369,59 @@ def test_generate_speculative_acceptance_telemetry():
         assert stats["speculative_acceptance_rate"] == 1.0, stats
     finally:
         srv.stop()
+
+
+def test_prefix_server_with_speculation_matches_plain_prefix():
+    """prefix_tokens + speculative_k: default-knob traffic rides
+    prefix speculation and returns EXACTLY what the prefix-only
+    server returns; penalty traffic falls back to the plain prefix
+    program; acceptance telemetry accumulates."""
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    prefix = [7, 11, 13, 17]
+
+    def make(**kw):
+        return GenerationServer("lm", model, params, port=0,
+                                max_new_tokens=8, max_batch=2,
+                                buckets=[8], prefix_tokens=prefix,
+                                **kw)
+
+    plain = make()
+    spec = make(draft_model=model, draft_params=params,
+                speculative_k=4)
+    plain.start()
+    spec.start()
+    try:
+        for payload in (
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 8},
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 8,
+                 "eos_id": 9},
+                {"prompts": [[4, 5, 6, 7, 8]], "max_new_tokens": 8,
+                 "temperature": 0.0},
+        ):
+            a = post(plain, "/v1/models/lm:generate", payload)
+            b = post(spec, "/v1/models/lm:generate", payload)
+            assert a["sequences"] == b["sequences"], payload
+        stats = spec.stats()
+        assert stats["speculative_calls"] >= 3, stats
+        # Self-draft over the same prefix states: full acceptance.
+        assert stats["speculative_acceptance_rate"] == 1.0, stats
+        # Penalty requests still get the prefix-mode 400 (they need
+        # prefix-token visibility) — the composition does not widen
+        # the accepted request surface.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post(spec, "/v1/models/lm:generate",
+                 {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                  "repetition_penalty": 1.3})
+        assert err.value.code == 400
+    finally:
+        plain.stop()
+        spec.stop()

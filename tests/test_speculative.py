@@ -878,3 +878,188 @@ def test_spec_windowed_moe_target_equals_windowed_greedy():
     want = decode(target, tp, prompt, 24)
     got = speculative_decode(target, tp, draft, dp, prompt, 24, k=4)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# Prefix-state speculation: a shared prefix prefilled ONCE per model
+# (target + draft), requests pay suffix + drafted generation. Output
+# must equal decode_with_prefix exactly (greedy) — the two serving
+# levers (prefix caching, speculation) composed.
+# ---------------------------------------------------------------------------
+
+
+def _prefix_states(target, tp, draft, dp, prefix, max_total):
+    from container_engine_accelerators_tpu.models.decode import (
+        prefill_prefix,
+    )
+
+    return (prefill_prefix(target, tp, prefix, max_total_len=max_total),
+            prefill_prefix(draft, dp, prefix, max_total_len=max_total))
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_spec_prefix_equals_decode_with_prefix(k):
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        speculative_decode_with_prefix,
+    )
+
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prefix = _prompt(1, 6, seed=21)
+    suffixes = _prompt(2, 5, seed=22)
+    t_state, d_state = _prefix_states(target, tp, draft, dp, prefix,
+                                      6 + 5 + 16 + k)
+    want = decode_with_prefix(target, tp, t_state, suffixes, 16)
+    got = speculative_decode_with_prefix(
+        target, tp, draft, dp, t_state, d_state, suffixes, 16, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_prefix_self_draft_full_acceptance_and_fan_out():
+    """Self-draft over a fanned-out prefix (prefix batch 1 ->
+    request batch 3): full acceptance, exact equality, and the
+    round bound holds."""
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        speculative_decode_with_prefix,
+    )
+
+    target, tp = _make(seed=0)
+    prefix = _prompt(1, 6, seed=23)
+    suffixes = _prompt(3, 4, seed=24)
+    t_state, d_state = _prefix_states(target, tp, target, tp, prefix,
+                                      6 + 4 + 20 + 4)
+    want = decode_with_prefix(target, tp, t_state, suffixes, 20)
+    got, stats = speculative_decode_with_prefix(
+        target, tp, target, tp, t_state, d_state, suffixes, 20, k=4,
+        return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats["accepted_drafts"]) > 0
+    assert int(stats["rounds"]) <= -(-20 // 4)
+
+
+def test_spec_prefix_ragged_and_eos():
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        speculative_decode_with_prefix,
+    )
+
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prefix = _prompt(1, 6, seed=25)
+    suffixes = _prompt(3, 5, seed=26)
+    plen = jnp.asarray([5, 2, 4], jnp.int32)
+    t_state, d_state = _prefix_states(target, tp, draft, dp, prefix,
+                                      6 + 5 + 14 + 4)
+    want = decode_with_prefix(target, tp, t_state, suffixes, 14,
+                              prompt_len=plen)
+    got = speculative_decode_with_prefix(
+        target, tp, draft, dp, t_state, d_state, suffixes, 14, k=4,
+        prompt_len=plen)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    eos = int(np.asarray(want)[0, 7])
+    want_e = decode_with_prefix(target, tp, t_state, suffixes, 14,
+                                eos_id=eos)
+    got_e = speculative_decode_with_prefix(
+        target, tp, draft, dp, t_state, d_state, suffixes, 14, k=4,
+        eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got_e),
+                                  np.asarray(want_e))
+
+
+def test_spec_prefix_composes_int8_gqa_rope():
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        speculative_decode_with_prefix,
+    )
+
+    kwargs = dict(num_kv_heads=2, pos_embedding="rope",
+                  kv_cache_dtype="int8")
+    target, tp = _make(seed=3, **kwargs)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=4, **kwargs)
+    prefix = _prompt(1, 6, seed=27)
+    suffixes = _prompt(2, 4, seed=28)
+    t_state, d_state = _prefix_states(target, tp, draft, dp, prefix,
+                                      6 + 4 + 12 + 3)
+    want = decode_with_prefix(target, tp, t_state, suffixes, 12)
+    got = speculative_decode_with_prefix(
+        target, tp, draft, dp, t_state, d_state, suffixes, 12, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_spec_prefix_sampling_reproducible_and_greedy_limit():
+    from container_engine_accelerators_tpu.models.decode import (
+        decode_with_prefix,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        speculative_decode_with_prefix,
+    )
+
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prefix = _prompt(1, 6, seed=29)
+    suffixes = _prompt(1, 4, seed=30)
+    t_state, d_state = _prefix_states(target, tp, draft, dp, prefix,
+                                      6 + 4 + 12 + 4)
+    rng = jax.random.PRNGKey(5)
+    a = speculative_decode_with_prefix(
+        target, tp, draft, dp, t_state, d_state, suffixes, 12, k=4,
+        temperature=1.0, rng=rng)
+    b = speculative_decode_with_prefix(
+        target, tp, draft, dp, t_state, d_state, suffixes, 12, k=4,
+        temperature=1.0, rng=rng)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(np.asarray(a).max()) < target.vocab_size
+    tiny = speculative_decode_with_prefix(
+        target, tp, draft, dp, t_state, d_state, suffixes, 12, k=4,
+        temperature=1e-6, rng=rng)
+    want = decode_with_prefix(target, tp, t_state, suffixes, 12)
+    np.testing.assert_array_equal(np.asarray(tiny), np.asarray(want))
+
+
+def test_spec_prefix_validation():
+    from container_engine_accelerators_tpu.models.decode import (
+        prefill_prefix,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        speculative_decode_with_prefix,
+    )
+
+    target, tp = _make(seed=0)
+    draft, dp = _make(embed=16, layers=1, heads=2, seed=99)
+    prefix = _prompt(1, 6, seed=31)
+    suffixes = _prompt(2, 4, seed=32)
+    t_state = prefill_prefix(target, tp, prefix, max_total_len=40)
+    d_state = prefill_prefix(draft, dp, prefix, max_total_len=40)
+    # Mismatched prefix lengths.
+    d_short = prefill_prefix(draft, dp, prefix[:, :4],
+                             max_total_len=40)
+    with pytest.raises(ValueError, match="prefix length"):
+        speculative_decode_with_prefix(
+            target, tp, draft, dp, t_state, d_short, suffixes, 8)
+    # Overflow of the state capacity.
+    with pytest.raises(ValueError, match="overflows"):
+        speculative_decode_with_prefix(
+            target, tp, draft, dp, t_state, d_state, suffixes, 40)
+    # Windowed models refuse.
+    wtarget, wtp = _make(seed=0, attention_window=8)
+    wt_state = prefill_prefix(wtarget, wtp, prefix, max_total_len=40)
+    with pytest.raises(ValueError, match="sliding-window"):
+        speculative_decode_with_prefix(
+            wtarget, wtp, draft, dp, wt_state, d_state, suffixes, 8)
+    # Request batch must be a multiple of the prefix batch.
+    prefix2 = _prompt(2, 6, seed=34)
+    t2 = prefill_prefix(target, tp, prefix2, max_total_len=40)
+    d2 = prefill_prefix(draft, dp, prefix2, max_total_len=40)
+    with pytest.raises(ValueError, match="multiple"):
+        speculative_decode_with_prefix(
+            target, tp, draft, dp, t2, d2, _prompt(3, 4, seed=33), 8)
