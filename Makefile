@@ -35,6 +35,12 @@ presubmit:
 	./build/check_logging.sh
 	./build/check_boilerplate.sh
 
+# Tracer leak/regression guard: fake-chip plugin up, one Allocate
+# through the real gRPC surface, fail on empty /debug/trace or any
+# span left open. Pure CPU, ~2s.
+trace-check:
+	python3 tools/trace_check.py
+
 bench:
 	python3 bench.py
 
@@ -59,4 +65,4 @@ clean:
 	$(MAKE) -C demo/tpu-error clean
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
-	container partition-tpu push clean
+	trace-check container partition-tpu push clean

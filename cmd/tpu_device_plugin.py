@@ -45,7 +45,10 @@ from container_engine_accelerators_tpu.plugin.metrics import (
     DEFAULT_PORT,
     MetricServer,
 )
-from container_engine_accelerators_tpu.utils import get_logger
+from container_engine_accelerators_tpu.utils import (
+    get_logger,
+    set_verbosity,
+)
 
 log = get_logger("main")
 
@@ -89,14 +92,24 @@ def parse_args(argv=None):
                    help="host grid of the slice as x,y,z (e.g. 2,2,1 "
                         "for a 4-host v5e-16); empty selects the "
                         "linear 1,1,N default")
+    p.add_argument("-v", "--verbosity", type=int,
+                   default=int(os.environ.get("TPU_PLUGIN_VERBOSITY",
+                                              "0")),
+                   help="glog-style verbosity (>= 3 enables DEBUG); "
+                        "applied via utils.log.set_verbosity so the "
+                        "flag wins over a stale first-import latch")
     return p.parse_args(argv)
 
 
 def main(argv=None):
     args = parse_args(argv)
+    set_verbosity(args.verbosity)
     tpu_config = cfg.parse_tpu_config(args.config_file)
     log.info("TPU device plugin starting; partition=%r",
              tpu_config.tpu_partition_size)
+    if os.environ.get("CEA_TPU_TRACE_FILE"):
+        log.info("trace journal will be written to %s at exit",
+                 os.environ["CEA_TPU_TRACE_FILE"])
 
     backend = get_backend()
     mounts = [(args.container_path, args.host_path)] \

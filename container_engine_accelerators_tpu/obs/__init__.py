@@ -1,0 +1,76 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Unified observability layer: spans, histograms, event journal.
+
+Import surface for every other layer (plugin, serving, training,
+tools):
+
+    from container_engine_accelerators_tpu import obs
+    with obs.span("serving.prefill", bucket=64):
+        ...
+    obs.event("health.transition", device="accel1", to="Unhealthy")
+    obs.histogram("serving_request_latency_seconds").observe(dt)
+
+Everything records into ONE process-wide journal (obs.trace.TRACER)
+with bounded memory; /debug/trace and /debug/varz (obs.http) plus the
+Prometheus merge (obs.export) are the read side. Keep this module
+dependency-free: the plugin path must import it without jax, and the
+serving path without grpc (the grpc interceptor stays in its own
+module for that reason).
+"""
+
+from .export import dump_json, perfetto_trace, prometheus_text, varz
+from .http import TRACE_PATH, VARZ_PATH, debug_response
+from .trace import (
+    DEFAULT_BUCKETS,
+    NULL_SPAN,
+    Histogram,
+    Span,
+    Tracer,
+    get_tracer,
+)
+
+TRACER = get_tracer()
+
+
+def span(name, parent=None, **attrs):
+    """Open a span on the process-wide tracer."""
+    return TRACER.span(name, parent=parent, **attrs)
+
+
+def event(name, **fields):
+    """Record a journal event on the process-wide tracer."""
+    TRACER.event(name, **fields)
+
+
+def histogram(name, help_text="", labels=None, buckets=None):
+    """Get-or-create a histogram on the process-wide tracer."""
+    return TRACER.histogram(name, help_text, labels, buckets)
+
+
+def counter(name, inc=1, **labels):
+    TRACER.counter(name, inc, **labels)
+
+
+def enabled():
+    return TRACER.enabled
+
+
+__all__ = [
+    "DEFAULT_BUCKETS", "NULL_SPAN", "Histogram", "Span", "Tracer",
+    "TRACER", "TRACE_PATH", "VARZ_PATH", "counter", "debug_response",
+    "dump_json", "enabled", "event", "get_tracer", "histogram",
+    "perfetto_trace", "prometheus_text", "span", "varz",
+]

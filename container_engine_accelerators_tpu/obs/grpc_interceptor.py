@@ -1,0 +1,118 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""gRPC server interceptor tracing every device-plugin RPC.
+
+One interceptor on the manager's grpc.server covers all three served
+services (v1beta1, v1alpha, and the slice devices they advertise)
+without per-servicer instrumentation:
+
+  - unary RPCs (Allocate, GetPreferredAllocation, options...) get a
+    span + a per-method latency histogram
+    (``tpu_plugin_rpc_latency_seconds{method=...}``);
+  - server-streaming RPCs (ListAndWatch) get a histogram observation
+    of connect->first response (the latency that matters: how fast a
+    kubelet learns the device set) plus journal EVENTS
+    (rpc.stream_first_response / stream_update / stream_end), not
+    spans — a stream-lifetime span would sit "open" for hours and
+    read as a leak to the trace-check guard.
+"""
+
+import time
+
+import grpc
+
+from .trace import get_tracer
+
+RPC_HISTOGRAM = "tpu_plugin_rpc_latency_seconds"
+
+
+def _short_method(full_method):
+    # "/v1beta1.DevicePlugin/Allocate" -> "v1beta1.DevicePlugin/
+    # Allocate": the package prefix stays because alpha and beta both
+    # serve Allocate/ListAndWatch and their latencies must not merge.
+    return full_method.lstrip("/")
+
+
+class TracingServerInterceptor(grpc.ServerInterceptor):
+    def __init__(self, tracer=None):
+        self._tracer = tracer or get_tracer()
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        method = _short_method(handler_call_details.method)
+        if handler.request_streaming:
+            # No client-streaming RPCs in the device-plugin API;
+            # leave any untraced rather than guessing semantics.
+            return handler
+        if handler.response_streaming:
+            return grpc.unary_stream_rpc_method_handler(
+                self._wrap_stream(handler.unary_stream, method),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        return grpc.unary_unary_rpc_method_handler(
+            self._wrap_unary(handler.unary_unary, method),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer)
+
+    def _wrap_unary(self, behavior, method):
+        tracer = self._tracer
+        hist = tracer.histogram(
+            RPC_HISTOGRAM,
+            "Device-plugin RPC latency by method",
+            labels={"method": method})
+
+        def traced(request, context):
+            t0 = time.perf_counter()
+            try:
+                # context.abort raises: an aborted Allocate closes
+                # the span with status=error and still lands in the
+                # histogram — failed RPCs are exactly the latencies
+                # an operator needs visible.
+                with tracer.span("rpc." + method):
+                    return behavior(request, context)
+            finally:
+                hist.observe(time.perf_counter() - t0)
+
+        return traced
+
+    def _wrap_stream(self, behavior, method):
+        tracer = self._tracer
+        hist = tracer.histogram(
+            RPC_HISTOGRAM,
+            "Device-plugin RPC latency by method "
+            "(streaming: connect to first response)",
+            labels={"method": method})
+
+        def traced(request, context):
+            t0 = time.perf_counter()
+            updates = 0
+            for resp in behavior(request, context):
+                if updates == 0:
+                    dt = time.perf_counter() - t0
+                    hist.observe(dt)
+                    tracer.event("rpc.stream_first_response",
+                                 method=method,
+                                 latency_ms=round(dt * 1000, 3))
+                else:
+                    tracer.event("rpc.stream_update", method=method,
+                                 update=updates)
+                updates += 1
+                yield resp
+            tracer.event("rpc.stream_end", method=method,
+                         updates=updates)
+
+        return traced

@@ -1,0 +1,44 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Shared /debug/* HTTP surface for every server in the stack.
+
+Both HTTP servers we run (the plugin's wsgiref MetricServer and the
+serving stack's BaseHTTPRequestHandler) answer the same two debug
+paths through this one module, so the payload shapes cannot drift:
+
+  /debug/trace   journal snapshot (completed + open spans, events)
+                 as JSON; ?perfetto=1 returns Chrome/Perfetto
+                 trace_event JSON directly
+  /debug/varz    counters + histogram summaries + journal occupancy
+"""
+
+from .export import dump_json, perfetto_trace, varz
+
+TRACE_PATH = "/debug/trace"
+VARZ_PATH = "/debug/varz"
+
+
+def debug_response(tracer, path, query=""):
+    """(content_type, body_bytes) for a debug path, or None when the
+    path is not a debug endpoint."""
+    if path == TRACE_PATH:
+        snap = tracer.snapshot()
+        if "perfetto" in query:
+            return ("application/json",
+                    dump_json(perfetto_trace(snap)))
+        return ("application/json", dump_json(snap))
+    if path == VARZ_PATH:
+        return ("application/json", dump_json(varz(tracer)))
+    return None

@@ -37,6 +37,8 @@ import zipfile
 import jax
 import numpy as np
 
+from .. import obs
+
 
 def synthetic_batch(batch_size, image_shape, num_classes, seed=0,
                     dtype=np.float32):
@@ -169,7 +171,15 @@ class PrefetchLoader:
             raise self._exc
         if self._done or self._closed.is_set():
             raise StopIteration
-        item = self._q.get()
+        if obs.TRACER.enabled:
+            # The consumer-visible data-load cost: how long the train
+            # loop actually WAITED for a staged batch. Near-zero
+            # spans mean prefetch is keeping up; wide ones mean the
+            # input pipeline is the bottleneck, not the step.
+            with obs.span("train.data_wait"):
+                item = self._q.get()
+        else:
+            item = self._q.get()
         if item is self._DONE:
             self._done = True
             raise StopIteration

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from .. import obs
 from .mesh import DATA_AXIS, build_mesh
 from .sharding import batch_sharding, param_shardings, replicated
 
@@ -256,10 +257,23 @@ class Trainer:
         )
 
     def train_step(self, state, batch):
-        """Run one step; compiles on first call."""
+        """Run one step; compiles on first call.
+
+        Span discipline: ``train.step_compile`` wraps the one-time
+        build+trace, ``train.step_run`` each dispatch. The run span
+        measures host-side dispatch (jax returns before the device
+        finishes) — the wall gap between successive run spans is the
+        device-bound time, which is exactly what a Perfetto timeline
+        shows. Disabled tracing takes the bare path: no span objects,
+        no kwargs dicts on the per-step hot path.
+        """
         if self._train_step is None:
-            self._train_step = self._build_train_step(state)
-        return self._train_step(state, batch)
+            with obs.span("train.step_compile"):
+                self._train_step = self._build_train_step(state)
+        if not obs.TRACER.enabled:
+            return self._train_step(state, batch)
+        with obs.span("train.step_run"):
+            return self._train_step(state, batch)
 
     def eval_params(self, state):
         """Weights eval/serving should read: the EMA shadow when it

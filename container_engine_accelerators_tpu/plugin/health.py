@@ -32,13 +32,17 @@ only ever degrades until re-serve).
 """
 
 import threading
+import time
 
+from .. import obs
 from ..chip.backend import ChipBackendError, Health
 from ..utils import get_logger
 from .api import HEALTHY, UNHEALTHY
 from .slice import is_slice_device_id
 
 log = get_logger("health")
+
+_SWEEP_HISTOGRAM = "tpu_plugin_health_sweep_seconds"
 
 DEFAULT_POLL_INTERVAL_S = 5.0
 
@@ -75,6 +79,17 @@ class TpuHealthChecker:
 
     def poll_once(self):
         """One health sweep; exposed for tests and the fault demo."""
+        t0 = time.perf_counter()
+        try:
+            with obs.span("health.poll"):
+                self._poll_pass()
+        finally:
+            obs.histogram(
+                _SWEEP_HISTOGRAM,
+                "Health poll sweep duration").observe(
+                    time.perf_counter() - t0)
+
+    def _poll_pass(self):
         devices = self._m.list_devices()
         try:
             verdicts = {}
@@ -101,6 +116,10 @@ class TpuHealthChecker:
             log.error("chip backend failure during health poll: %s; "
                       "marking ALL devices unhealthy", e)
             for dev_id in devices:
+                if devices[dev_id] != UNHEALTHY:
+                    obs.event("health.transition", device=dev_id,
+                              to=UNHEALTHY,
+                              reason=f"backend failure: {e}")
                 self._m.set_device_health(dev_id, UNHEALTHY)
             return
 
@@ -111,9 +130,14 @@ class TpuHealthChecker:
                 kind = "subslice" if is_slice_device_id(dev_id) else "chip"
                 log.warning("marking %s %s unhealthy: chip %d reports %s",
                             kind, dev_id, chip, state.name)
+                obs.event("health.transition", device=dev_id,
+                          to=UNHEALTHY,
+                          reason=f"chip {chip} reports {state.name}")
                 self._m.set_device_health(dev_id, UNHEALTHY)
             elif bad is None and current != HEALTHY:
                 log.info("device %s recovered; marking healthy", dev_id)
+                obs.event("health.transition", device=dev_id,
+                          to=HEALTHY, reason="chip health recovered")
                 self._m.set_device_health(dev_id, HEALTHY)
 
     def _run(self):

@@ -34,7 +34,9 @@ from concurrent import futures
 
 import grpc
 
+from .. import obs
 from ..chip import ChipBackendError, get_backend
+from ..obs.grpc_interceptor import TracingServerInterceptor
 from ..utils import accel_index, get_logger, is_accel_name
 from . import config as cfg
 from .api import (
@@ -274,6 +276,12 @@ class TpuManager:
         reference leaving NCCL to the workload, SURVEY.md s2.4).
         """
         chips = sorted({c for d in device_ids for c in self.device_chips(d)})
+        # The allocation decision as a journal event: which devices
+        # resolved to which chips — the record placement work (ICI
+        # subslice allocator, ROADMAP) will mine for decisions made
+        # under each topology state.
+        obs.event("allocate.decision", devices=sorted(device_ids),
+                  chips=chips)
         try:
             coords = [self._backend.chip_coords(c) for c in chips]
         except ChipBackendError as e:
@@ -415,9 +423,15 @@ class TpuManager:
             socket_path = os.path.join(plugin_dir, endpoint)
             kubelet_socket = os.path.join(plugin_dir, kubelet_socket_name)
 
+            # One tracing interceptor covers every served service
+            # (v1beta1 + v1alpha + the subslice devices they front):
+            # spans + per-method latency histograms for Allocate /
+            # GetPreferredAllocation, connect->first-update latency
+            # for ListAndWatch streams.
             server = grpc.server(
                 futures.ThreadPoolExecutor(max_workers=8),
-                options=[("grpc.so_reuseport", 0)])
+                options=[("grpc.so_reuseport", 0)],
+                interceptors=(TracingServerInterceptor(),))
             add_device_plugin_v1beta1(PluginServiceV1Beta1(self), server)
             add_device_plugin_v1alpha(PluginServiceV1Alpha(self), server)
             server.add_insecure_port(f"unix://{socket_path}")

@@ -27,6 +27,7 @@ on the duty_cycle metric exactly as the reference's TF-Serving HPA
 does (demo/serving/tensorflow-serving.yaml:62-80).
 """
 
+import os
 import threading
 import wsgiref.simple_server
 
@@ -34,11 +35,24 @@ import grpc
 import prometheus_client
 from prometheus_client.core import CollectorRegistry
 
+from .. import obs
 from ..utils import get_logger
 from . import config as cfg
 from .devices import get_devices_for_all_containers
 
 log = get_logger("metrics")
+
+
+def _read_version():
+    """Best-effort VERSION file read for the build-info gauge."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "VERSION")
+    try:
+        with open(path) as f:
+            return f.read().strip() or "unknown"
+    except OSError:
+        return "unknown"
 
 DEFAULT_PORT = 2112
 DEFAULT_INTERVAL_MS = 30000
@@ -87,6 +101,20 @@ class MetricServer:
         self._health = prometheus_client.Gauge(
             "device_healthy", "1 when the device passes the health "
             "gate, else 0", ["tpu_device"], registry=self._registry)
+        # Info-gauge: constant 1 with the build version as a label —
+        # joins against any other series on a dashboard to answer
+        # "which plugin build produced these numbers".
+        self._build_info = prometheus_client.Gauge(
+            "tpu_plugin_build_info", "Plugin build information",
+            ["version"], registry=self._registry)
+        self._build_info.labels(_read_version()).set(1)
+        # A collection pass that dies used to vanish into a log line;
+        # a monotonically rising counter makes silent failure
+        # scrapeable/alertable.
+        self._collect_errors = prometheus_client.Counter(
+            "tpu_plugin_metrics_collect_errors",
+            "Metric collection passes that failed",
+            registry=self._registry)
         self._httpd = None
         self._thread = None
         self._stop = threading.Event()
@@ -94,15 +122,42 @@ class MetricServer:
     # -- HTTP ---------------------------------------------------------
 
     def start(self):
-        app = prometheus_client.make_wsgi_app(self._registry)
         path = self._path
 
         def routed(environ, start_response):
-            if environ.get("PATH_INFO") != path:
-                start_response("404 Not Found",
-                               [("Content-Type", "text/plain")])
-                return [b"not found; metrics at " + path.encode()]
-            return app(environ, start_response)
+            req_path = environ.get("PATH_INFO")
+            if req_path == path:
+                # One scrape surface: the gauge registry first, then
+                # the tracer's histograms/counters (RPC latency,
+                # health-sweep timing...) appended — exposition
+                # format concatenates cleanly across disjoint names.
+                # generate_latest, not the wsgi app: the app gzips
+                # for Accept-Encoding: gzip scrapers, which would
+                # corrupt the appended plain-text block.
+                body = prometheus_client.generate_latest(
+                    self._registry)
+                extra = obs.prometheus_text(obs.get_tracer())
+                body += extra.encode()
+                start_response(
+                    "200 OK",
+                    [("Content-Type",
+                      "text/plain; version=0.0.4; charset=utf-8"),
+                     ("Content-Length", str(len(body)))])
+                return [body]
+            debug = obs.debug_response(obs.get_tracer(), req_path,
+                                       environ.get("QUERY_STRING",
+                                                   ""))
+            if debug is not None:
+                ctype, body = debug
+                start_response("200 OK",
+                               [("Content-Type", ctype),
+                                ("Content-Length", str(len(body)))])
+                return [body]
+            start_response("404 Not Found",
+                           [("Content-Type", "text/plain")])
+            return [b"not found; metrics at " + path.encode()
+                    + b", traces at /debug/trace, vars at "
+                      b"/debug/varz"]
 
         self._httpd = wsgiref.simple_server.make_server(
             "", self._port, routed,
@@ -113,7 +168,8 @@ class MetricServer:
         self._thread = threading.Thread(
             target=self._run, name="tpu-metrics-collect", daemon=True)
         self._thread.start()
-        log.info("metrics server on :%d%s every %.0fs",
+        log.info("metrics server on :%d%s every %.0fs "
+                 "(debug: /debug/trace /debug/varz)",
                  self._port, self._path, self._interval_s)
 
     def stop(self):
@@ -133,6 +189,10 @@ class MetricServer:
 
     def collect_once(self):
         """One collection pass (metrics.go:126-156); test seam."""
+        with obs.span("metrics.collect"):
+            self._collect_pass()
+
+    def _collect_pass(self):
         from .api import HEALTHY
 
         for dev_id, health in sorted(self._m.list_devices().items()):
@@ -143,6 +203,7 @@ class MetricServer:
                 self._pod_resources_socket)
         except grpc.RpcError as e:
             log.warning("pod-resources query failed: %s", e.code())
+            self._collect_errors.inc()
             return
         for cd in containers:
             self._request.labels(cd.namespace, cd.pod, cd.container).set(
@@ -183,7 +244,14 @@ class MetricServer:
             if since_reset >= RESET_INTERVAL_MS / 1000.0:
                 self._reset()
                 since_reset = 0.0
-            self.collect_once()
+            try:
+                self.collect_once()
+            except Exception:
+                # A single bad pass (backend hiccup mid-sample) must
+                # not kill the collection thread for the rest of the
+                # process — and must not fail silently either.
+                self._collect_errors.inc()
+                log.exception("metric collection pass failed")
 
 
 class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):

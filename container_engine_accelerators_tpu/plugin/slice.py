@@ -27,6 +27,7 @@ single schedulable devices exactly as MIG partitions are.
 import re
 import threading
 
+from .. import obs
 from ..chip.backend import parse_shape
 from .api import HEALTHY, UNHEALTHY
 from ..utils import get_logger
@@ -106,6 +107,8 @@ class SliceManager:
             log.error("slice table poisoned (%s): all %d subslices marked "
                       "unhealthy until the topology tiles again",
                       reason, len(self._health))
+            obs.event("slice.poisoned", reason=str(reason),
+                      subslices=len(self._health))
         else:
             # Retried every rescan (~10s); don't bury real errors.
             log.debug("slice table still poisoned (%s)", reason)
@@ -130,11 +133,14 @@ class SliceManager:
             dev_id = slice_device_id(partition_size, i)
             slices[dev_id] = self._backend.subslice_chips(partition_size, i)
         with self._lock:
+            was_poisoned = self._poisoned is not None
             self._shape = partition_size
             self._slices = slices
             self._health = {dev_id: HEALTHY for dev_id in slices}
             self._poisoned = None
         log.info("discovered %d %s subslices", count, partition_size)
+        obs.event("slice.tiled", shape=partition_size,
+                  subslices=count, recovered=was_poisoned)
         return count
 
     def list_devices(self):

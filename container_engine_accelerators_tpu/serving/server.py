@@ -28,6 +28,7 @@ Endpoints:
   GET  /stats                     request count + latency summary
 """
 
+import contextlib
 import functools
 import json
 import queue
@@ -39,9 +40,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..utils import get_logger
 
 log = get_logger("serving")
+
+REQUEST_HISTOGRAM = "serving_request_latency_seconds"
+DECODE_HISTOGRAM = "serving_decode_latency_seconds"
 
 
 class _Admission:
@@ -110,10 +115,16 @@ class _Batcher:
         None when admitting them would exceed the bound."""
         if not self._admission.try_acquire(len(instances)):
             return None
+        # The submitting request's span context rides with each row:
+        # the batcher thread parents its batch span to the FIRST
+        # co-batched request's trace so the device work nests under a
+        # real request tree (other requests in the batch are linked
+        # by count — a span has one parent).
+        ctx = obs.TRACER.current_context()
         dones = []
         for instance in instances:
             done = queue.Queue(maxsize=1)
-            self._queue.put((instance, done))
+            self._queue.put((instance, done, ctx))
             dones.append(done)
         return dones
 
@@ -157,13 +168,17 @@ class _Batcher:
                     break
                 batch.append(nxt)
             instances = [b[0] for b in batch]
+            parent = next((b[2] for b in batch if b[2] is not None),
+                          None)
             try:
-                outputs = self._run(instances)
-                for (_, done), out in zip(batch, outputs):
+                with obs.span("serving.batch", parent=parent,
+                              batch_size=len(batch)):
+                    outputs = self._run(instances)
+                for (_, done, _ctx), out in zip(batch, outputs):
                     done.put(("ok", out))
             except Exception as e:  # surface per-request, keep serving
                 log.exception("batch inference failed")
-                for _, done in batch:
+                for _, done, _ctx in batch:
                     done.put(("error", str(e)))
             finally:
                 self._release(len(batch))
@@ -219,7 +234,13 @@ class _BaseServer:
         self._devices = [str(d) for d in jax.devices()]
         self._requests = 0
         self._shed = 0
-        self._latencies = []
+        # Request latency lives in a fixed-bucket histogram (bounded
+        # memory, mergeable across scrapes) instead of the old
+        # unbounded-ish sample list; /stats p50/p99 become
+        # bucket-interpolated estimates with the same JSON shape.
+        self._latency_hist = obs.histogram(
+            REQUEST_HISTOGRAM, "End-to-end serving request latency",
+            labels={"model": model_name})
         self._stats_lock = threading.Lock()
         server = self
 
@@ -236,7 +257,18 @@ class _BaseServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                path, _, query = self.path.partition("?")
+                debug = obs.debug_response(obs.get_tracer(), path,
+                                           query)
+                if debug is not None:
+                    ctype, body = debug
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/healthz":
                     if server._ready.is_set():
                         self._reply(200, {"status": "ok",
                                           "model": server._name})
@@ -264,6 +296,14 @@ class _BaseServer:
                 if self.path != server._post_path():
                     self._reply(404, {"error": "unknown model"})
                     return
+                # The request's root span: every phase below —
+                # admission, the batcher's device work (parented
+                # across threads), stream chunks — nests under it.
+                with obs.span("serving.request",
+                              path=self.path) as req_span:
+                    self._serve_post(req_span)
+
+            def _serve_post(self, req_span):
                 t0 = time.perf_counter()
                 try:
                     length = int(self.headers.get("Content-Length",
@@ -279,6 +319,7 @@ class _BaseServer:
                 except Exception as e:  # model/runtime failure
                     log.exception("POST handler failed")
                     code, resp = 500, {"error": str(e)}
+                req_span.set(status=code)
                 if code == 200 and hasattr(resp, "__next__"):
                     # Streaming response: one JSON line per block
                     # (ndjson). All validation happened before the
@@ -333,16 +374,16 @@ class _BaseServer:
         return self._httpd.server_address[1]
 
     def _record(self, latency_s):
+        self._latency_hist.observe(latency_s)
         with self._stats_lock:
             self._requests += 1
-            self._latencies.append(latency_s)
-            if len(self._latencies) > 10000:
-                self._latencies = self._latencies[-5000:]
 
     def stats(self):
+        # Histogram reads take the histogram's own lock, not
+        # _stats_lock (nothing blockable may hold _stats_lock).
+        p50 = self._latency_hist.quantile(0.5)
+        p99 = self._latency_hist.quantile(0.99)
         with self._stats_lock:
-            lat = sorted(self._latencies)
-            n = len(lat)
             out = {
                 "requests": self._requests,
                 "shed": self._shed,
@@ -352,9 +393,13 @@ class _BaseServer:
                 # mode) instead of trusting that jax kept the chip.
                 "platform": self._platform,
                 "devices": self._devices,
-                "p50_ms": round(lat[n // 2] * 1000, 3) if n else None,
-                "p99_ms": round(lat[int(n * 0.99)] * 1000, 3)
-                if n else None,
+                # Same keys as always; since the histogram refactor
+                # these are bucket-interpolated estimates, not exact
+                # order statistics.
+                "p50_ms": (round(p50 * 1000, 3)
+                           if p50 is not None else None),
+                "p99_ms": (round(p99 * 1000, 3)
+                           if p99 is not None else None),
             }
             out.update(self._extra_stats())
             return out
@@ -827,6 +872,26 @@ class GenerationServer(_BaseServer):
                 fkw["min_p"] = min_ps
         return fkw
 
+    @contextlib.contextmanager
+    def _decode_span(self, kind, bucket, rows, sampling, **attrs):
+        """Span + per-kind latency histogram around one decode call
+        — ONE shape for every _run variant (decode / speculative /
+        prefix_decode / prefix_speculative) so a Perfetto timeline
+        and the Prometheus scrape agree on naming."""
+        t0 = time.perf_counter()
+        try:
+            with obs.span("serving." + kind, bucket=bucket,
+                          rows=rows,
+                          mode=("sampling" if sampling
+                                else "greedy"), **attrs) as sp:
+                yield sp
+        finally:
+            obs.histogram(
+                DECODE_HISTOGRAM,
+                "Device decode-call latency by program kind",
+                labels={"kind": kind}).observe(
+                    time.perf_counter() - t0)
+
     def _record_spec(self, spec_stats, account_spec):
         """Acceptance telemetry — the alpha that decides whether the
         configured draft pays off on this traffic (docs/benchmarks.md
@@ -840,6 +905,13 @@ class GenerationServer(_BaseServer):
         race)."""
         spec_rounds = int(spec_stats["rounds"])
         spec_accepted = int(spec_stats["accepted_drafts"])
+        if account_spec:
+            # Per-call acceptance in the journal: the time-resolved
+            # signal behind /stats' cumulative alpha (a draft that
+            # pays off on average can still crater on one traffic
+            # shape; the journal shows WHEN).
+            obs.event("serving.speculation", rounds=spec_rounds,
+                      accepted_drafts=spec_accepted, k=self._spec_k)
         with self._stats_lock:
             self._spec_calls += 1
             if account_spec:
@@ -888,32 +960,39 @@ class GenerationServer(_BaseServer):
                 # Prefix + speculation: the two serving levers
                 # composed — same stable-program and active-rows
                 # discipline as the non-prefix spec route below.
-                out, spec_stats = self._speculative_with_prefix(
-                    self._model, self._params, self._draft_model,
-                    self._draft_params, self._prefix_state,
-                    self._draft_prefix_state, jnp.asarray(padded),
-                    self._max_new, k=self._spec_k, prompt_len=plens,
-                    eos_id=eos_ids, temperature=temps,
-                    rng=jax.random.PRNGKey(seed),
-                    active_rows=np.arange(self._max_batch) < n,
-                    return_stats=True,
-                    **self._spec_filter_kwargs(pad_temp, top_k,
-                                               filtered, top_ps,
-                                               min_ps))
+                with self._decode_span("prefix_speculative", bucket,
+                                       n, pad_temp):
+                    out, spec_stats = self._speculative_with_prefix(
+                        self._model, self._params, self._draft_model,
+                        self._draft_params, self._prefix_state,
+                        self._draft_prefix_state, jnp.asarray(padded),
+                        self._max_new, k=self._spec_k,
+                        prompt_len=plens,
+                        eos_id=eos_ids, temperature=temps,
+                        rng=jax.random.PRNGKey(seed),
+                        active_rows=np.arange(self._max_batch) < n,
+                        return_stats=True,
+                        **self._spec_filter_kwargs(pad_temp, top_k,
+                                                   filtered, top_ps,
+                                                   min_ps))
+                    out = np.asarray(out)[:n]
                 self._record_spec(spec_stats, account_spec)
-                return np.asarray(out)[:n]
+                return out
             # fast_prefill=False for the same reason as the plain
             # path below: the auto-selected one-chunk-suffix variant
             # would flip with batch composition (all-full-width vs
             # ragged) and stall requests on compiles.
-            out = self._decode_with_prefix(
-                self._model, self._params, self._prefix_state,
-                jnp.asarray(padded), self._max_new,
-                temperature=temps if pad_temp else 0.0,
-                rng=jax.random.PRNGKey(seed), prompt_len=plens,
-                top_k=top_k, top_p=top_ps, min_p=min_ps,
-                eos_id=eos_ids, fast_prefill=False)
-            return np.asarray(out)[:n]
+            with self._decode_span("prefix_decode", bucket, n,
+                                   pad_temp,
+                                   phase="suffix_prefill+decode"):
+                out = self._decode_with_prefix(
+                    self._model, self._params, self._prefix_state,
+                    jnp.asarray(padded), self._max_new,
+                    temperature=temps if pad_temp else 0.0,
+                    rng=jax.random.PRNGKey(seed), prompt_len=plens,
+                    top_k=top_k, top_p=top_ps, min_p=min_ps,
+                    eos_id=eos_ids, fast_prefill=False)
+                return np.asarray(out)[:n]
         if (self._spec_k and not force_plain
                 and self._default_knobs(rep_pens)
                 and bucket + self._max_new + self._spec_k
@@ -937,22 +1016,27 @@ class GenerationServer(_BaseServer):
             # carry none and keep the mask-free program (no vocab
             # sort on the hot path). Greedy batches carry none —
             # client filters are rejected at temperature 0.
-            out, spec_stats = self._speculative(
-                self._model, self._params, self._draft_model,
-                self._draft_params, jnp.asarray(padded),
-                self._max_new, k=self._spec_k, prompt_len=plens,
-                eos_id=eos_ids, temperature=temps,
-                rng=jax.random.PRNGKey(seed),
-                active_rows=np.arange(self._max_batch) < n,
-                return_logprobs=want_lp, return_stats=True,
-                **self._spec_filter_kwargs(pad_temp, top_k, filtered,
-                                           top_ps, min_ps))
+            with self._decode_span("speculative", bucket, n,
+                                   pad_temp, k=self._spec_k):
+                out, spec_stats = self._speculative(
+                    self._model, self._params, self._draft_model,
+                    self._draft_params, jnp.asarray(padded),
+                    self._max_new, k=self._spec_k, prompt_len=plens,
+                    eos_id=eos_ids, temperature=temps,
+                    rng=jax.random.PRNGKey(seed),
+                    active_rows=np.arange(self._max_batch) < n,
+                    return_logprobs=want_lp, return_stats=True,
+                    **self._spec_filter_kwargs(pad_temp, top_k,
+                                               filtered, top_ps,
+                                               min_ps))
+                if want_lp:
+                    seq, lps = out
+                    out = list(zip(np.asarray(seq)[:n],
+                                   np.asarray(lps)[:n]))
+                else:
+                    out = np.asarray(out)[:n]
             self._record_spec(spec_stats, account_spec)
-            if want_lp:
-                seq, lps = out
-                return list(zip(np.asarray(seq)[:n],
-                                np.asarray(lps)[:n]))
-            return np.asarray(out)[:n]
+            return out
         # fast_prefill=False keeps the per-bucket program set fixed
         # (warm=True precompiles exactly these programs; the
         # auto-selected one-shot-prefill variant would flip in and
@@ -962,20 +1046,23 @@ class GenerationServer(_BaseServer):
         # so batch composition can't flip program variants); any
         # top_p < 1.0 in the batch selects the nucleus variant (one
         # extra program per bucket, compiled on first use).
-        out = self._decode(self._model, self._params,
-                           jnp.asarray(padded), self._max_new,
-                           temperature=temps if pad_temp else 0.0,
-                           rng=jax.random.PRNGKey(seed),
-                           prompt_len=plens, fast_prefill=False,
-                           top_k=top_k, top_p=top_ps,
-                           eos_id=eos_ids,
-                           repetition_penalty=rep_pens,
-                           min_p=min_ps,
-                           return_logprobs=want_lp)
-        if want_lp:
-            seq, lp = out
-            return list(zip(np.asarray(seq)[:n], np.asarray(lp)[:n]))
-        return np.asarray(out)[:n]
+        with self._decode_span("decode", bucket, n, pad_temp,
+                               phase="prefill+decode"):
+            out = self._decode(self._model, self._params,
+                               jnp.asarray(padded), self._max_new,
+                               temperature=temps if pad_temp else 0.0,
+                               rng=jax.random.PRNGKey(seed),
+                               prompt_len=plens, fast_prefill=False,
+                               top_k=top_k, top_p=top_ps,
+                               eos_id=eos_ids,
+                               repetition_penalty=rep_pens,
+                               min_p=min_ps,
+                               return_logprobs=want_lp)
+            if want_lp:
+                seq, lp = out
+                return list(zip(np.asarray(seq)[:n],
+                                np.asarray(lp)[:n]))
+            return np.asarray(out)[:n]
 
     STREAM_CHUNK = 16
 
@@ -1096,9 +1183,16 @@ class GenerationServer(_BaseServer):
                 break
             call_budget -= n
             rng, sub = jax.random.split(rng)
-            seq, state = self._stream_call(
-                state, feed, feed_plen, n, temperature, top_k,
-                top_p, min_p, eos, sub)
+            # The first call feeds the whole prompt row (the prompt
+            # prefill + first block); later calls are pure decode
+            # chunks — named apart so the span tree reads
+            # request -> prefill -> decode chunks.
+            phase = ("serving.prefill" if feed.shape[1] > 1
+                     else "serving.decode_chunk")
+            with obs.span(phase, bucket=bucket, horizon=n):
+                seq, state = self._stream_call(
+                    state, feed, feed_plen, n, temperature, top_k,
+                    top_p, min_p, eos, sub)
             gen = np.asarray(seq[0, feed_plen:])
             feed = seq[:, -1:]
             feed_plen = 1
@@ -1313,29 +1407,33 @@ class GenerationServer(_BaseServer):
                 self._admission.release(1)
                 raise
             return 200, body
-        batcher = self._batcher_for(
-            bucket, temperature > 0.0, top_k, want_lp,
-            plain=self._default_knobs(rep_pen),
-            filtered=self._filtered_knobs(top_p, min_p))
-        if batcher is None:
-            return 503, {"error": "server is shutting down"}
-        pending = batcher.submit_many(
-            [(row, temperature, int(pl), top_p, eos_id, rep_pen,
-              min_p)
-             for row, pl in zip(padded, p_lens)])
-        if pending is None:
-            with self._stats_lock:
-                self._shed += 1
-            return 503, {"error": "server overloaded; retry"}
+        with obs.span("serving.admission", bucket=bucket,
+                      rows=len(padded)) as adm:
+            batcher = self._batcher_for(
+                bucket, temperature > 0.0, top_k, want_lp,
+                plain=self._default_knobs(rep_pen),
+                filtered=self._filtered_knobs(top_p, min_p))
+            if batcher is None:
+                return 503, {"error": "server is shutting down"}
+            pending = batcher.submit_many(
+                [(row, temperature, int(pl), top_p, eos_id, rep_pen,
+                  min_p)
+                 for row, pl in zip(padded, p_lens)])
+            if pending is None:
+                adm.set(shed=True)
+                with self._stats_lock:
+                    self._shed += 1
+                return 503, {"error": "server overloaded; retry"}
         rows = []
-        for done in pending:
-            try:
-                status, out = done.get(timeout=120)
-            except queue.Empty:
-                return 500, {"error": "decode timed out"}
-            if status != "ok":
-                return 500, {"error": out}
-            rows.append(out)
+        with obs.span("serving.wait", rows=len(pending)):
+            for done in pending:
+                try:
+                    status, out = done.get(timeout=120)
+                except queue.Empty:
+                    return 500, {"error": "decode timed out"}
+                if status != "ok":
+                    return 500, {"error": out}
+                rows.append(out)
         if want_lp:
             seq = np.stack([r[0] for r in rows])
             lps = np.stack([r[1] for r in rows])

@@ -15,7 +15,7 @@
 """Shared helpers: structured logging, path utilities."""
 
 from .paths import accel_index, device_name_from_path, is_accel_name
-from .log import get_logger
+from .log import get_logger, set_verbosity
 
 __all__ = ["accel_index", "device_name_from_path", "is_accel_name",
-           "get_logger"]
+           "get_logger", "set_verbosity"]

@@ -17,8 +17,18 @@
 The reference uses glog verbosity levels (SURVEY.md section 5,
 "Tracing / profiling"); here standard logging with a glog-like format
 plays that role. Verbosity maps: -v >= 3 -> DEBUG, else INFO.
+
+Two runtime controls beyond the glog parity:
+  - set_verbosity(v) re-levels the already-configured logger — the
+    old latch-at-first-import behavior meant an operator editing
+    TPU_PLUGIN_VERBOSITY on a live pod changed nothing until restart;
+  - TPU_PLUGIN_LOG_FORMAT=json emits one JSON object per line with
+    the same unix-seconds timestamp field the obs journal records
+    ("unix"), so log lines and trace events correlate by timestamp
+    and shared field names instead of by eyeballing two formats.
 """
 
+import json
 import logging
 import os
 import sys
@@ -29,19 +39,71 @@ _DATEFMT = "%m%d %H:%M:%S"
 _configured = False
 
 
+class _LiveStderrHandler(logging.StreamHandler):
+    """StreamHandler resolving sys.stderr at EMIT time, not at
+    configure time — a process that re-points stderr (test capture,
+    daemonization) keeps getting plugin logs."""
+
+    def __init__(self):
+        super().__init__(sys.stderr)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):
+        pass  # always live sys.stderr
+
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line, journal-compatible field names."""
+
+    def format(self, record):
+        out = {
+            "unix": record.created,
+            "level": record.levelname,
+            "name": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def _make_formatter():
+    if os.environ.get("TPU_PLUGIN_LOG_FORMAT", "").lower() == "json":
+        return _JsonFormatter()
+    return logging.Formatter(_FORMAT, _DATEFMT)
+
+
+def _level_for(verbosity):
+    return logging.DEBUG if int(verbosity) >= 3 else logging.INFO
+
+
 def _configure():
     global _configured
     if _configured:
         return
     verbosity = int(os.environ.get("TPU_PLUGIN_VERBOSITY", "0"))
-    level = logging.DEBUG if verbosity >= 3 else logging.INFO
-    handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+    handler = _LiveStderrHandler()
+    handler.setFormatter(_make_formatter())
     root = logging.getLogger("cea_tpu")
-    root.setLevel(level)
+    root.setLevel(_level_for(verbosity))
     root.addHandler(handler)
     root.propagate = False
     _configured = True
+
+
+def set_verbosity(verbosity):
+    """Re-level the plugin logger at runtime (glog -v semantics:
+    >= 3 -> DEBUG, else INFO). Also re-reads TPU_PLUGIN_LOG_FORMAT,
+    so a flag/env flip mid-process takes effect without restart."""
+    _configure()
+    root = logging.getLogger("cea_tpu")
+    root.setLevel(_level_for(verbosity))
+    for handler in root.handlers:
+        handler.setFormatter(_make_formatter())
 
 
 def get_logger(name):

@@ -21,12 +21,25 @@ unit-testable here: state file -> poller -> manager -> ListAndWatch.
 
 import pytest
 
+from container_engine_accelerators_tpu import obs
 from container_engine_accelerators_tpu.chip import PyChipBackend
 from container_engine_accelerators_tpu.chip.backend import ChipBackendError
 from container_engine_accelerators_tpu.plugin import api
 from container_engine_accelerators_tpu.plugin.config import TpuConfig
 from container_engine_accelerators_tpu.plugin.health import TpuHealthChecker
 from container_engine_accelerators_tpu.plugin.manager import TpuManager
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    obs.TRACER.reset()
+    yield
+    obs.TRACER.reset()
+
+
+def health_events():
+    return [e for e in obs.TRACER.snapshot()["events"]
+            if e["name"] == "health.transition"]
 
 
 @pytest.fixture
@@ -96,6 +109,92 @@ def test_bad_chip_marks_owning_subslice(node4):
     bad = [d for d, h in devices.items() if h == api.UNHEALTHY]
     assert len(bad) == 1
     assert 3 in mgr.device_chips(bad[0])
+
+
+def test_flip_emits_exactly_one_journal_event_each_way(node4):
+    """Each healthy->unhealthy->healthy flip journals EXACTLY one
+    event per transition, carrying device id and a human-readable
+    reason — repeat sweeps in the same state must not re-emit."""
+    mgr, _, hc = make(node4)
+    node4.set_state(1, "health", "uncorrectable_ecc")
+    hc.poll_once()
+    hc.poll_once()  # same state again: no second event
+    events = health_events()
+    assert len(events) == 1, events
+    assert events[0]["fields"]["device"] == "accel1"
+    assert events[0]["fields"]["to"] == api.UNHEALTHY
+    assert "UNCORRECTABLE_ECC" in events[0]["fields"]["reason"]
+
+    node4.set_state(1, "health", "ok")
+    hc.poll_once()
+    hc.poll_once()
+    events = health_events()
+    assert len(events) == 2, events
+    assert events[1]["fields"]["device"] == "accel1"
+    assert events[1]["fields"]["to"] == api.HEALTHY
+    assert events[1]["fields"]["reason"] == "chip health recovered"
+
+
+def test_backend_failure_journals_each_device_once(node4):
+    mgr, backend, hc = make(node4)
+
+    def boom(chip):
+        raise ChipBackendError("backend gone")
+
+    backend.chip_health = boom
+    hc.poll_once()
+    hc.poll_once()  # already unhealthy: no re-emission
+    events = health_events()
+    assert len(events) == 4, events
+    assert ({e["fields"]["device"] for e in events}
+            == {"accel0", "accel1", "accel2", "accel3"})
+    assert all("backend failure" in e["fields"]["reason"]
+               for e in events)
+
+
+def test_poll_records_sweep_span_and_histogram(node4):
+    mgr, _, hc = make(node4)
+    hc.poll_once()
+    spans = [s for s in obs.TRACER.snapshot()["spans"]
+             if s["name"] == "health.poll"]
+    assert len(spans) == 1
+    hist = obs.histogram("tpu_plugin_health_sweep_seconds")
+    assert hist.count == 1
+
+
+def test_listandwatch_latency_lands_in_histogram(node4):
+    """The interceptor's connect->first-response latency for a REAL
+    ListAndWatch stream lands in the per-method RPC histogram, and a
+    health flip journals its transition while streaming."""
+    from tests.plugin_helpers import ServingManager, short_tmpdir
+
+    mgr, _, hc = make(node4)
+    with ServingManager(mgr, short_tmpdir()) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            stream = stub.ListAndWatch(api.v1beta1_pb2.Empty(),
+                                       timeout=10)
+            first = next(iter(stream))
+            assert {d.ID for d in first.devices} == {
+                "accel0", "accel1", "accel2", "accel3"}
+            node4.set_state(2, "health", "overheat")
+            hc.poll_once()
+            second = next(iter(stream))
+            assert {d.ID: d.health for d in second.devices}[
+                "accel2"] == api.UNHEALTHY
+            stream.cancel()
+    # Both API versions serve a ListAndWatch; this test drove the
+    # v1beta1 stream, so at least that method's histogram must have
+    # the observation.
+    hists = [h for h in obs.TRACER.histograms()
+             if h.name == "tpu_plugin_rpc_latency_seconds"
+             and h.labels.get("method", "").endswith("ListAndWatch")]
+    assert hists and any(h.count >= 1 for h in hists), [
+        (h.labels, h.count) for h in hists]
+    beta = [h for h in hists if "v1beta1" in h.labels["method"]]
+    assert beta and beta[0].count >= 1
+    events = health_events()
+    assert len(events) == 1 and events[0]["fields"]["device"] == "accel2"
 
 
 def test_start_stop_thread(node4):

@@ -218,5 +218,15 @@ def test_telemetry_probe_writes_auditable_record(tmp_path):
             assert leg.get("error") or leg.get("error_type")
     assert "candidate_ports" in d["host_observations"]
     assert d["provenance"]["git_sha"]
+    # The varz legs snapshot /debug/varz from live obs-instrumented
+    # processes; with none running the outcome is a structured
+    # failure, never a crash.
+    assert d["varz"]
+    for leg in d["varz"].values():
+        assert "ok" in leg
+        if leg["ok"]:
+            assert "journal" in leg
+        else:
+            assert leg.get("error") or leg.get("error_type")
     last = json.loads(proc.stdout.strip().splitlines()[-1])
     assert last["any_real_source"] == d["any_real_source"]

@@ -1,0 +1,463 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Unified tracing layer tests: tracer core, exporters, HTTP surface,
+and the cross-layer threading (plugin scrape merge, serving span
+tree, trace_dump tool)."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from container_engine_accelerators_tpu import obs
+from container_engine_accelerators_tpu.obs.trace import Tracer
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    """Tests share the process-wide tracer; isolate journal state."""
+    obs.TRACER.reset()
+    yield
+    obs.TRACER.reset()
+
+
+# -- tracer core ------------------------------------------------------
+
+def test_span_nesting_and_journal():
+    with obs.span("outer", kind="test") as outer:
+        with obs.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == outer.trace_id
+    snap = obs.TRACER.snapshot()
+    names = [s["name"] for s in snap["spans"]]
+    # Children close (and record) before parents.
+    assert names == ["inner", "outer"]
+    assert snap["spans"][1]["parent_id"] is None
+    assert snap["spans"][0]["duration_s"] >= 0
+    assert not snap["open_spans"]
+
+
+def test_explicit_parent_crosses_threads():
+    import threading
+
+    ctxs = {}
+    with obs.span("request") as req:
+        ctxs["parent"] = req.context()
+
+        def worker():
+            with obs.span("batch", parent=ctxs["parent"]):
+                with obs.span("decode"):
+                    pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    spans = {s["name"]: s for s in obs.TRACER.snapshot()["spans"]}
+    assert spans["batch"]["parent_id"] == spans["request"]["span_id"]
+    assert spans["decode"]["parent_id"] == spans["batch"]["span_id"]
+    assert (spans["decode"]["trace_id"]
+            == spans["request"]["trace_id"])
+
+
+def test_error_status_and_attrs():
+    with pytest.raises(ValueError):
+        with obs.span("boom", a=1) as sp:
+            sp.set(b=2)
+            raise ValueError("nope")
+    rec = obs.TRACER.snapshot()["spans"][0]
+    assert rec["status"] == "error"
+    assert rec["attrs"]["a"] == 1
+    assert rec["attrs"]["b"] == 2
+    assert "nope" in rec["attrs"]["error"]
+
+
+def test_ring_buffer_bounds_memory():
+    tracer = Tracer(capacity=10, enabled=True)
+    for i in range(50):
+        with tracer.span(f"s{i}"):
+            pass
+        tracer.event(f"e{i}")
+    snap = tracer.snapshot()
+    assert len(snap["spans"]) == 10
+    assert len(snap["events"]) == 10
+    assert snap["dropped_spans"] == 40
+    assert snap["dropped_events"] == 40
+    # The ring keeps the NEWEST entries.
+    assert snap["spans"][-1]["name"] == "s49"
+
+
+def test_disabled_tracer_allocates_nothing():
+    tracer = Tracer(enabled=False)
+    sp = tracer.span("hot")
+    assert sp is obs.NULL_SPAN  # the singleton, not a new object
+    with sp:
+        sp.set(x=1)
+    tracer.event("nope", x=1)
+    snap = tracer.snapshot()
+    assert not snap["spans"] and not snap["events"]
+    # Histograms still record: they are the /metrics surface.
+    tracer.histogram("h").observe(0.5)
+    assert tracer.histogram("h").count == 1
+
+
+def test_events_carry_fields_and_context():
+    with obs.span("op") as sp:
+        obs.event("decision", device="accel0", reason="test")
+    ev = obs.TRACER.snapshot()["events"][0]
+    assert ev["name"] == "decision"
+    assert ev["fields"] == {"device": "accel0", "reason": "test"}
+    assert ev["trace_id"] == sp.trace_id
+
+
+# -- histograms -------------------------------------------------------
+
+def test_histogram_buckets_and_quantiles():
+    h = obs.Histogram("lat", buckets=(0.1, 1.0, 10.0))
+    assert h.quantile(0.5) is None
+    for v in (0.05, 0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    counts, total, n = h.snapshot()
+    assert counts == [2, 2, 1, 0]
+    assert n == 5
+    assert total == pytest.approx(6.1)
+    assert 0 < h.quantile(0.5) <= 1.0
+    assert 1.0 < h.quantile(0.99) <= 10.0
+    h.observe(99.0)  # lands in +Inf; quantile stays finite
+    assert h.quantile(1.0) == 10.0
+
+
+def test_prometheus_text_format():
+    tracer = Tracer(enabled=True)
+    h = tracer.histogram("x_seconds", "help text",
+                         labels={"method": "Allocate"},
+                         buckets=(0.5, 1.0))
+    h.observe(0.2)
+    h.observe(2.0)
+    tracer.counter("y_total", 3, kind="a")
+    text = obs.prometheus_text(tracer)
+    assert "# TYPE x_seconds histogram" in text
+    assert 'x_seconds_bucket{le="0.5",method="Allocate"} 1' in text
+    assert 'x_seconds_bucket{le="+Inf",method="Allocate"} 2' in text
+    assert 'x_seconds_count{method="Allocate"} 2' in text
+    assert 'y_total{kind="a"} 3' in text
+
+
+# -- perfetto export --------------------------------------------------
+
+def test_perfetto_trace_event_shape():
+    with obs.span("parent", layer="serving"):
+        with obs.span("child"):
+            pass
+        obs.event("marker", n=1)
+    doc = obs.perfetto_trace(obs.TRACER.snapshot())
+    assert "traceEvents" in doc
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["name"] for e in complete} == {"parent", "child"}
+    for e in complete:
+        assert e["ts"] > 0 and e["dur"] >= 0
+        assert isinstance(e["pid"], int)
+        assert "span_id" in e["args"]
+    assert instants[0]["name"] == "marker"
+    assert metas and metas[0]["name"] == "thread_name"
+    json.dumps(doc)  # must be JSON-serializable end to end
+
+
+# -- plugin HTTP surface ----------------------------------------------
+
+def test_metric_server_debug_endpoints_and_scrape_merge(fake_node):
+    from container_engine_accelerators_tpu.chip import PyChipBackend
+    from container_engine_accelerators_tpu.plugin.manager import (
+        TpuManager,
+    )
+    from container_engine_accelerators_tpu.plugin.metrics import (
+        MetricServer,
+    )
+
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("1x2")
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=fake_node.dev_dir,
+                     state_dir=fake_node.state_dir, backend=backend)
+    mgr.start()
+    server = MetricServer(mgr, backend, port=0,
+                          pod_resources_socket="/nonexistent")
+    server.start()
+    try:
+        with obs.span("synthetic.op"):
+            pass
+        obs.histogram("synthetic_seconds", "x").observe(0.01)
+        base = f"http://localhost:{server.port}"
+        trace = json.load(urllib.request.urlopen(
+            base + "/debug/trace"))
+        assert any(s["name"] == "synthetic.op"
+                   for s in trace["spans"])
+        varz = json.load(urllib.request.urlopen(
+            base + "/debug/varz"))
+        assert varz["tracing_enabled"] is True
+        assert "synthetic_seconds" in varz["histograms"]
+        perfetto = json.load(urllib.request.urlopen(
+            base + "/debug/trace?perfetto=1"))
+        assert any(e["name"] == "synthetic.op"
+                   for e in perfetto["traceEvents"])
+        scrape = urllib.request.urlopen(
+            base + "/metrics").read().decode()
+        # prometheus_client gauges and the tracer's histograms merge
+        # into ONE scrape body.
+        assert "tpu_plugin_build_info" in scrape
+        assert "synthetic_seconds_bucket" in scrape
+        assert "tpu_plugin_metrics_collect_errors_total" in scrape
+    finally:
+        server.stop()
+
+
+def test_collect_error_counter_rises(fake_node):
+    from container_engine_accelerators_tpu.chip import PyChipBackend
+    from container_engine_accelerators_tpu.plugin.manager import (
+        TpuManager,
+    )
+    from container_engine_accelerators_tpu.plugin.metrics import (
+        MetricServer,
+    )
+
+    fake_node.add_chip(0)
+    fake_node.set_topology("1x1")
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=fake_node.dev_dir,
+                     state_dir=fake_node.state_dir, backend=backend)
+    mgr.start()
+    server = MetricServer(mgr, backend, port=0,
+                          pod_resources_socket="/nonexistent")
+    server.start()
+    try:
+        server.collect_once()  # pod-resources socket is unreachable
+        scrape = urllib.request.urlopen(
+            f"http://localhost:{server.port}/metrics").read().decode()
+        assert ("tpu_plugin_metrics_collect_errors_total 1.0"
+                in scrape)
+    finally:
+        server.stop()
+
+
+# -- gRPC interceptor -------------------------------------------------
+
+def test_allocate_rpc_traced_end_to_end(fake_node):
+    from container_engine_accelerators_tpu.chip import PyChipBackend
+    from container_engine_accelerators_tpu.plugin import api
+    from container_engine_accelerators_tpu.plugin.manager import (
+        TpuManager,
+    )
+    from tests.plugin_helpers import ServingManager, short_tmpdir
+
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("1x2")
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=fake_node.dev_dir,
+                     state_dir=fake_node.state_dir, backend=backend)
+    mgr.start()
+    with ServingManager(mgr, short_tmpdir()) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel0"])]), timeout=5)
+    spans = obs.TRACER.snapshot()["spans"]
+    rpc = [s for s in spans if s["name"].endswith("Allocate")]
+    assert rpc and rpc[0]["status"] == "ok"
+    hists = {(h.name, h.labels.get("method", ""))
+             for h in obs.TRACER.histograms()}
+    assert any(n == "tpu_plugin_rpc_latency_seconds"
+               and m.endswith("Allocate") for n, m in hists)
+    events = obs.TRACER.snapshot()["events"]
+    alloc = [e for e in events if e["name"] == "allocate.decision"]
+    assert alloc and alloc[0]["fields"]["devices"] == ["accel0"]
+
+
+# -- serving span tree ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def predict_server():
+    import numpy as np
+
+    from container_engine_accelerators_tpu.serving import (
+        InferenceServer,
+    )
+
+    def apply_fn(variables, images, train):
+        # A linear "model" with no params: logits = sums per class.
+        import jax.numpy as jnp
+        logits = jnp.stack([images.sum(axis=(1, 2)),
+                            -images.sum(axis=(1, 2))], axis=-1)
+        return logits, {}
+
+    srv = InferenceServer("m", apply_fn, {"params": {}},
+                          input_shape=(2, 2), port=0, max_batch=4,
+                          max_wait_ms=1)
+    srv.start()
+    yield srv, np
+    srv.stop()
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://localhost:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=30))
+
+
+def test_serving_request_span_tree_and_stats(predict_server):
+    srv, np = predict_server
+    obs.TRACER.reset()
+    out = _post(srv.port, "/v1/models/m:predict",
+                {"instances": [[[1, 2], [3, 4]]]})
+    assert out["predictions"][0]["class"] == 0
+    snap = obs.TRACER.snapshot()
+    spans = {s["name"]: s for s in snap["spans"]}
+    assert "serving.request" in spans
+    assert "serving.batch" in spans
+    # Cross-thread parenting: the batcher's span joins the request's
+    # trace even though it ran on the batcher thread.
+    assert (spans["serving.batch"]["trace_id"]
+            == spans["serving.request"]["trace_id"])
+    assert (spans["serving.batch"]["parent_id"]
+            == spans["serving.request"]["span_id"])
+    assert not snap["open_spans"]
+    # /stats keeps its shape, now histogram-backed.
+    stats = json.load(urllib.request.urlopen(
+        f"http://localhost:{srv.port}/stats"))
+    for key in ("requests", "shed", "platform", "devices",
+                "p50_ms", "p99_ms"):
+        assert key in stats
+    assert stats["requests"] >= 1
+    assert stats["p50_ms"] is not None
+    # The request latency is scrapeable as a Prometheus histogram.
+    text = obs.prometheus_text(obs.TRACER)
+    assert 'serving_request_latency_seconds_bucket' in text
+    assert 'model="m"' in text
+
+
+def test_serving_debug_trace_endpoint(predict_server):
+    srv, np = predict_server
+    obs.TRACER.reset()
+    _post(srv.port, "/v1/models/m:predict",
+          {"instances": [[[1, 1], [1, 1]]]})
+    trace = json.load(urllib.request.urlopen(
+        f"http://localhost:{srv.port}/debug/trace"))
+    assert any(s["name"] == "serving.request"
+               for s in trace["spans"])
+    varz = json.load(urllib.request.urlopen(
+        f"http://localhost:{srv.port}/debug/varz"))
+    assert any("serving_request_latency_seconds" in k
+               for k in varz["histograms"])
+
+
+# -- trace_dump tool --------------------------------------------------
+
+def test_trace_dump_from_live_server_and_file(predict_server,
+                                              tmp_path):
+    import importlib.util
+    import sys
+
+    from tests.conftest import REPO_ROOT
+
+    srv, np = predict_server
+    obs.TRACER.reset()
+    _post(srv.port, "/v1/models/m:predict",
+          {"instances": [[[1, 1], [1, 1]]]})
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_dump", os.path.join(REPO_ROOT, "tools",
+                                   "trace_dump.py"))
+    trace_dump = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_dump)
+
+    out = tmp_path / "trace.json"
+    rc = trace_dump.main(["--url", f"http://localhost:{srv.port}",
+                          "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]
+    assert any(e["name"] == "serving.request"
+               for e in doc["traceEvents"])
+
+    # File mode: the CEA_TPU_TRACE_FILE journal shape round-trips.
+    journal = tmp_path / "journal.json"
+    journal.write_text(json.dumps(obs.TRACER.snapshot()))
+    out2 = tmp_path / "trace2.json"
+    rc = trace_dump.main(["--file", str(journal), "--out",
+                          str(out2)])
+    assert rc == 0
+    assert json.loads(out2.read_text())["traceEvents"]
+
+    missing = trace_dump.main(["--file", "/nonexistent",
+                               "--out", str(out2)])
+    assert missing == 1
+
+
+def test_trace_file_written_at_exit(tmp_path):
+    import subprocess
+    import sys
+
+    from tests.conftest import REPO_ROOT
+
+    path = tmp_path / "exit_journal.json"
+    code = (
+        "from container_engine_accelerators_tpu import obs\n"
+        "with obs.span('proc.main'):\n"
+        "    obs.event('proc.mark', ok=True)\n")
+    env = dict(os.environ, CEA_TPU_TRACE_FILE=str(path),
+               PYTHONPATH=REPO_ROOT)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=60,
+                          cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(path.read_text())
+    assert [s["name"] for s in doc["spans"]] == ["proc.main"]
+    assert doc["events"][0]["name"] == "proc.mark"
+
+
+# -- log format satellite ---------------------------------------------
+
+def test_set_verbosity_and_json_log_format(capfd):
+    import logging
+
+    from container_engine_accelerators_tpu.utils import (
+        log as log_mod,
+        set_verbosity,
+    )
+
+    logger = log_mod.get_logger("obs-test")
+    set_verbosity(3)
+    assert logging.getLogger("cea_tpu").level == logging.DEBUG
+    set_verbosity(0)
+    assert logging.getLogger("cea_tpu").level == logging.INFO
+    os.environ["TPU_PLUGIN_LOG_FORMAT"] = "json"
+    try:
+        set_verbosity(0)
+        logger.info("hello %s", "world")
+        err = capfd.readouterr().err
+        rec = json.loads(err.strip().splitlines()[-1])
+        assert rec["message"] == "hello world"
+        assert rec["level"] == "INFO"
+        assert isinstance(rec["unix"], float)
+    finally:
+        del os.environ["TPU_PLUGIN_LOG_FORMAT"]
+        set_verbosity(0)

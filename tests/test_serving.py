@@ -148,6 +148,38 @@ def test_generate_sampling_and_batch(lm_server):
     assert all(len(s) == 6 for s in out["sequences"])
 
 
+def test_generate_span_tree_on_debug_trace(lm_server):
+    """One generate request produces a nested span tree — request ->
+    admission/wait on the handler thread, batch -> decode parented
+    across threads into the same trace — retrievable from the
+    serving port's own /debug/trace, with the request latency in
+    the serving_request_latency_seconds histogram."""
+    from container_engine_accelerators_tpu import obs
+
+    obs.TRACER.reset()
+    post(lm_server, "/v1/models/lm:generate",
+         {"prompts": [[1, 2, 3]], "max_new_tokens": 4})
+    with urllib.request.urlopen(
+            f"http://localhost:{lm_server.port}/debug/trace",
+            timeout=10) as resp:
+        trace = json.loads(resp.read())
+    spans = {}
+    for s in trace["spans"]:
+        spans.setdefault(s["name"], s)
+    for name in ("serving.request", "serving.admission",
+                 "serving.wait", "serving.batch", "serving.decode"):
+        assert name in spans, sorted(spans)
+    req = spans["serving.request"]
+    assert spans["serving.batch"]["trace_id"] == req["trace_id"]
+    assert spans["serving.decode"]["trace_id"] == req["trace_id"]
+    assert (spans["serving.decode"]["parent_id"]
+            == spans["serving.batch"]["span_id"])
+    assert spans["serving.decode"]["attrs"]["mode"] == "greedy"
+    assert not trace["open_spans"]
+    text = obs.prometheus_text(obs.TRACER)
+    assert "serving_request_latency_seconds_bucket" in text
+
+
 def test_generate_cross_request_batching():
     """Concurrent same-bucket generate requests share one decode
     call — even with different temperatures AND different true

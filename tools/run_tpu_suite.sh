@@ -68,6 +68,18 @@ is_fresh() {  # $1 = artifact path; rc 0 = fresh enough to skip
 }
 
 # ---------------------------------------------------------------------
+# 0. Tracer preflight — `make trace-check` (~2s, pure CPU): fake-chip
+#    plugin + one Allocate; fails on an empty /debug/trace or a
+#    leaked (still-open) span. A broken tracer would silently strip
+#    the observability layer out of every artifact this suite
+#    captures, so it gates nothing downstream but must be VISIBLE.
+# ---------------------------------------------------------------------
+echo "[suite] trace-check preflight" >&2
+timeout -k 10 120 python tools/trace_check.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "trace-check preflight"
+
+# ---------------------------------------------------------------------
 # 1. Serving bench — the stalest artifact: no warmed capture has ever
 #    landed (the committed SERVING_BENCH.json predates round 3's
 #    readiness gating and shows the obsolete pre-warm-up cold path).

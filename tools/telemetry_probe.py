@@ -52,7 +52,11 @@ from container_engine_accelerators_tpu.utils.provenance import (  # noqa: E402
 )
 
 _CANDIDATE_ADDRS = ("localhost:8431",)
+# Debug/varz candidates: the plugin MetricServer's default port (it
+# serves /debug/varz next to /metrics since the obs layer landed).
+_CANDIDATE_VARZ = ("localhost:2112",)
 SDK_LEG_TIMEOUT_S = 30
+VARZ_LEG_TIMEOUT_S = 5
 
 
 def _outcome(fn):
@@ -101,6 +105,31 @@ def _deadlined(fn, timeout_s):
     return box["value"]
 
 
+def probe_varz(addr):
+    """Snapshot a live process's /debug/varz (the obs layer's
+    quick-look counters/histograms). Same record-don't-raise
+    discipline as the source legs: a refused connection is a
+    structured outcome, not a crash."""
+    import urllib.request
+
+    url = f"http://{addr}/debug/varz"
+    try:
+        with urllib.request.urlopen(
+                url, timeout=VARZ_LEG_TIMEOUT_S) as resp:
+            payload = json.load(resp)
+        return {"ok": True, "url": url,
+                "tracing_enabled": payload.get("tracing_enabled"),
+                "histograms": sorted(payload.get("histograms", {})),
+                "journal": payload.get("journal"),
+                "payload": payload}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:
+        return {"ok": False, "url": url,
+                "error_type": type(e).__name__,
+                "error": str(e)[:500]}
+
+
 def host_observations(addrs):
     """What the host actually exposes — context that makes a failed
     source probe diagnosable instead of a bare traceback."""
@@ -145,6 +174,10 @@ def main(argv=None):
     p.add_argument("--addr", action="append", default=[],
                    help="extra runtime gRPC addresses to try "
                         "(default: localhost:8431)")
+    p.add_argument("--varz-addr", action="append", default=[],
+                   help="extra host:port addresses whose "
+                        "/debug/varz to snapshot (default: "
+                        "localhost:2112, the plugin MetricServer)")
     args = p.parse_args(argv)
 
     # cmd/ is a script dir, not a package: import the bridge by path.
@@ -193,6 +226,13 @@ def main(argv=None):
             return {"source": src.name, "chips": src.poll()}
 
         record["grpc"][addr] = _outcome(leg)
+    # /debug/varz snapshots from any live obs-instrumented process
+    # (plugin MetricServer by default): records what the tracer sees
+    # — histograms live, journal occupancy — with the same
+    # bench-artifact provenance conventions as the rest of the file.
+    varz_addrs = list(dict.fromkeys(
+        list(_CANDIDATE_VARZ) + args.varz_addr))
+    record["varz"] = {addr: probe_varz(addr) for addr in varz_addrs}
 
     any_ok = record["sdk"]["ok"] or any(
         r["ok"] for r in record["grpc"].values())
@@ -202,7 +242,9 @@ def main(argv=None):
     print(json.dumps({"wrote": args.out, "any_real_source": any_ok,
                       "sdk_ok": record["sdk"]["ok"],
                       "grpc": {a: r["ok"]
-                               for a, r in record["grpc"].items()}}))
+                               for a, r in record["grpc"].items()},
+                      "varz": {a: r["ok"]
+                               for a, r in record["varz"].items()}}))
     return 0
 
 

@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Tracer leak/regression guard (the `make trace-check` preflight).
+
+Boots the fake-chip plugin end to end — PyChipBackend over a synthetic
+/dev + state dir, manager.serve() on a real unix socket, MetricServer
+on an ephemeral port — performs one ListAndWatch read and one
+Allocate through the REAL gRPC surface (so the tracing interceptor is
+on the path), then fails if:
+
+  - /debug/trace returns no completed spans (tracer dead or
+    interceptor unwired),
+  - the Allocate RPC's latency histogram is missing from /debug/varz
+    or the /metrics scrape,
+  - any span is still open after the traffic settles (a span leak:
+    some path opened a span and never closed it — exactly the
+    regression class a context-manager API invites when someone
+    "optimizes" it away).
+
+Pure CPU, no jax, ~2s: cheap enough to run before every suite.
+Exit 0 = clean, 1 = check failed, 2 = harness error.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# The guard checks that spans ARE recorded, so it must not inherit an
+# operator's CEA_TPU_TRACE=0 (a legitimate runtime setting that would
+# read as "tracer dead" here). Pin before the obs import latches it.
+os.environ["CEA_TPU_TRACE"] = "1"
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.chip import (  # noqa: E402
+    PyChipBackend,
+)
+from container_engine_accelerators_tpu.plugin import api  # noqa: E402
+from container_engine_accelerators_tpu.plugin.manager import (  # noqa: E402
+    TpuManager,
+)
+from container_engine_accelerators_tpu.plugin.metrics import (  # noqa: E402
+    MetricServer,
+)
+
+import grpc  # noqa: E402
+
+
+def fake_node(root):
+    dev = os.path.join(root, "dev")
+    state = os.path.join(root, "state")
+    os.makedirs(dev)
+    os.makedirs(state)
+    for i in range(4):
+        open(os.path.join(dev, f"accel{i}"), "w").close()
+        os.makedirs(os.path.join(state, f"accel{i}"))
+    with open(os.path.join(state, "topology"), "w") as f:
+        f.write("2x2")
+    return dev, state
+
+
+def main():
+    failures = []
+    trace = {}
+    root = tempfile.mkdtemp(prefix="tpu-trace-check")
+    plugin_dir = tempfile.mkdtemp(prefix="tpu")  # short: unix socket
+    dev, state = fake_node(root)
+    backend = PyChipBackend()
+    manager = TpuManager(dev_dir=dev, state_dir=state, backend=backend)
+    manager.start()
+    serve_thread = threading.Thread(
+        target=manager.serve, args=(plugin_dir, "kubelet.sock", "tpu"),
+        daemon=True)
+    serve_thread.start()
+    if not manager.wait_until_serving(10):
+        print("trace-check: plugin never started serving",
+              file=sys.stderr)
+        return 2
+    metrics = MetricServer(manager, backend, port=0)
+    metrics.start()
+    try:
+        socks = [f for f in os.listdir(plugin_dir)
+                 if f.startswith("tpu-") and f.endswith(".sock")]
+        with grpc.insecure_channel(
+                f"unix://{os.path.join(plugin_dir, socks[0])}") as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            stream = stub.ListAndWatch(
+                api.v1beta1_pb2.Empty(), timeout=10)
+            first = next(iter(stream))
+            device_ids = [d.ID for d in first.devices]
+            stream.cancel()
+            stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=device_ids[:1])]), timeout=10)
+
+        base = f"http://localhost:{metrics.port}"
+        with urllib.request.urlopen(base + obs.TRACE_PATH,
+                                    timeout=10) as resp:
+            trace = json.load(resp)
+        with urllib.request.urlopen(base + obs.VARZ_PATH,
+                                    timeout=10) as resp:
+            varz = json.load(resp)
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=10) as resp:
+            scrape = resp.read().decode()
+
+        if not trace.get("spans"):
+            failures.append("/debug/trace has no completed spans")
+        open_spans = trace.get("open_spans", [])
+        if open_spans:
+            failures.append(
+                "span leak: %d span(s) left open: %s" % (
+                    len(open_spans),
+                    sorted({s["name"] for s in open_spans})))
+        rpc_spans = [s for s in trace.get("spans", [])
+                     if s["name"].startswith("rpc.")
+                     and s["name"].endswith("Allocate")]
+        if not rpc_spans:
+            failures.append("no rpc.*Allocate span recorded "
+                            "(interceptor unwired?)")
+        if "tpu_plugin_rpc_latency_seconds" not in str(
+                varz.get("histograms", {})):
+            failures.append("RPC latency histogram missing from "
+                            "/debug/varz")
+        if "tpu_plugin_rpc_latency_seconds_bucket" not in scrape:
+            failures.append("RPC latency histogram missing from the "
+                            "/metrics scrape")
+        if "tpu_plugin_build_info" not in scrape:
+            failures.append("tpu_plugin_build_info missing from the "
+                            "/metrics scrape")
+    finally:
+        metrics.stop()
+        manager.stop()
+        serve_thread.join(timeout=10)
+
+    print(json.dumps({"spans": len(trace.get("spans", [])),
+                      "open_spans": len(trace.get("open_spans", [])),
+                      "failures": failures}))
+    if failures:
+        for f in failures:
+            print(f"trace-check FAILED: {f}", file=sys.stderr)
+        return 1
+    print("trace-check: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Pull a trace journal and emit Perfetto-loadable JSON.
+
+Sources (first match wins):
+  --url http://host:port       GETs <url>/debug/trace from a live
+                               process (plugin MetricServer or a
+                               serving server — both serve the path)
+  --file PATH                  reads a journal file written at exit
+                               via CEA_TPU_TRACE_FILE (or a saved
+                               /debug/trace body)
+
+Output is Chrome/Perfetto ``trace_event`` JSON on --out (default
+trace.perfetto.json): open it at https://ui.perfetto.dev or
+chrome://tracing. Pass --raw to emit the journal snapshot unconverted
+(spans/events with ids intact) for programmatic consumers.
+
+Usage:
+  python tools/trace_dump.py --url http://localhost:2112
+  python tools/trace_dump.py --file /tmp/plugin_trace.json --raw
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    TRACE_PATH,
+    perfetto_trace,
+)
+
+
+def load_snapshot(url=None, path=None, timeout=10):
+    if url:
+        full = url.rstrip("/") + TRACE_PATH
+        with urllib.request.urlopen(full, timeout=timeout) as resp:
+            return json.load(resp), full
+    with open(path) as f:
+        return json.load(f), path
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0])
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url",
+                     help="base URL of a live process exposing "
+                          "/debug/trace (e.g. http://localhost:2112)")
+    src.add_argument("--file",
+                     help="journal file written via "
+                          "CEA_TPU_TRACE_FILE")
+    p.add_argument("--out", default="trace.perfetto.json")
+    p.add_argument("--raw", action="store_true",
+                   help="emit the journal snapshot as-is instead of "
+                        "trace_event JSON")
+    p.add_argument("--timeout", type=float, default=10)
+    args = p.parse_args(argv)
+
+    try:
+        snapshot, source = load_snapshot(args.url, args.file,
+                                         args.timeout)
+    except (OSError, ValueError) as e:
+        print(f"error: could not load trace from "
+              f"{args.url or args.file}: {e}", file=sys.stderr)
+        return 1
+
+    spans = len(snapshot.get("spans", []))
+    events = len(snapshot.get("events", []))
+    if args.raw:
+        payload = snapshot
+    else:
+        payload = perfetto_trace(snapshot)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, args.out)
+    print(json.dumps({
+        "wrote": args.out,
+        "source": source,
+        "spans": spans,
+        "open_spans": len(snapshot.get("open_spans", [])),
+        "events": events,
+        "format": "journal" if args.raw else "trace_event",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
