@@ -41,6 +41,13 @@ presubmit:
 trace-check:
 	python3 tools/trace_check.py
 
+# Flight-recorder guard: fake-chip plugin + a second process's
+# journal, swept by tools/tpu_diagnose.py; fails unless the bundle
+# has a non-empty MERGED trace (both processes), a varz snapshot
+# with the RPC histogram, and the node's device state. Pure CPU.
+diagnose-check:
+	python3 tools/diagnose_check.py
+
 bench:
 	python3 bench.py
 
@@ -65,4 +72,4 @@ clean:
 	$(MAKE) -C demo/tpu-error clean
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
-	trace-check container partition-tpu push clean
+	trace-check diagnose-check container partition-tpu push clean

@@ -31,7 +31,9 @@ import time
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from container_engine_accelerators_tpu import obs
 from container_engine_accelerators_tpu.chip import get_backend
+from container_engine_accelerators_tpu.obs import postmortem
 from container_engine_accelerators_tpu.plugin import config as cfg
 from container_engine_accelerators_tpu.plugin.health import (
     TpuHealthChecker,
@@ -104,6 +106,7 @@ def parse_args(argv=None):
 def main(argv=None):
     args = parse_args(argv)
     set_verbosity(args.verbosity)
+    obs.set_role("plugin")
     tpu_config = cfg.parse_tpu_config(args.config_file)
     log.info("TPU device plugin starting; partition=%r",
              tpu_config.tpu_partition_size)
@@ -159,6 +162,14 @@ def main(argv=None):
 
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
+    # Postmortem capture in FRONT of the graceful handlers: a SIGTERM
+    # (k8s eviction) flushes the journal — open spans, last device
+    # health — to CEA_TPU_TRACE_FILE at signal time, then chains into
+    # shutdown above. An in-flight Allocate's span is captured open,
+    # which is exactly what a post-incident timeline needs.
+    postmortem.register_state_provider("device_health",
+                                       manager.list_devices)
+    postmortem.install(signals=(signal.SIGTERM, signal.SIGINT))
 
     try:
         manager.serve(args.plugin_directory, cfg.KUBELET_SOCKET, "tpu")

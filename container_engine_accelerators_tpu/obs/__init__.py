@@ -25,14 +25,34 @@ tools):
 
 Everything records into ONE process-wide journal (obs.trace.TRACER)
 with bounded memory; /debug/trace and /debug/varz (obs.http) plus the
-Prometheus merge (obs.export) are the read side. Keep this module
-dependency-free: the plugin path must import it without jax, and the
-serving path without grpc (the grpc interceptor stays in its own
-module for that reason).
+Prometheus merge (obs.export) are the read side. The journal is
+distributed and crash-proof: every snapshot carries a (host, pid,
+role) identity stamp (obs.identity), ids are unique across
+processes, span context propagates over gRPC metadata
+(obs.propagate + obs.grpc_client inject / obs.grpc_interceptor
+extract), merge_perfetto joins many processes' journals into one
+timeline, and obs.postmortem flushes the journal at signal/fault
+time. obs.straggler watches per-host step-time skew. Keep this
+module dependency-free: the plugin path must import it without jax,
+and the serving path without grpc (the grpc client/server
+interceptors stay in their own modules for that reason).
 """
 
-from .export import dump_json, perfetto_trace, prometheus_text, varz
+from .export import (
+    dump_json,
+    merge_perfetto,
+    perfetto_trace,
+    prometheus_text,
+    varz,
+)
 from .http import TRACE_PATH, VARZ_PATH, debug_response
+from .identity import identity, process_label, set_role
+from .propagate import (
+    TRACEPARENT_KEY,
+    context_from_metadata,
+    format_traceparent,
+    parse_traceparent,
+)
 from .trace import (
     DEFAULT_BUCKETS,
     NULL_SPAN,
@@ -40,6 +60,7 @@ from .trace import (
     Span,
     Tracer,
     get_tracer,
+    write_journal,
 )
 
 TRACER = get_tracer()
@@ -64,13 +85,21 @@ def counter(name, inc=1, **labels):
     TRACER.counter(name, inc, **labels)
 
 
+def gauge(name, value, **labels):
+    """Set an instantaneous gauge on the process-wide tracer."""
+    TRACER.gauge(name, value, **labels)
+
+
 def enabled():
     return TRACER.enabled
 
 
 __all__ = [
-    "DEFAULT_BUCKETS", "NULL_SPAN", "Histogram", "Span", "Tracer",
-    "TRACER", "TRACE_PATH", "VARZ_PATH", "counter", "debug_response",
-    "dump_json", "enabled", "event", "get_tracer", "histogram",
-    "perfetto_trace", "prometheus_text", "span", "varz",
+    "DEFAULT_BUCKETS", "NULL_SPAN", "Histogram", "Span", "TRACEPARENT_KEY",
+    "TRACER", "TRACE_PATH", "Tracer", "VARZ_PATH",
+    "context_from_metadata", "counter", "debug_response", "dump_json",
+    "enabled", "event", "format_traceparent", "gauge", "get_tracer",
+    "histogram", "identity", "merge_perfetto", "parse_traceparent",
+    "perfetto_trace", "process_label", "prometheus_text", "set_role",
+    "span", "varz", "write_journal",
 ]

@@ -27,6 +27,8 @@ Three consumers, one journal:
 import json
 import os
 
+from .identity import identity, process_label
+
 
 def _escape_label(value):
     return (str(value).replace("\\", r"\\").replace("\n", r"\n")
@@ -93,10 +95,16 @@ def prometheus_text(tracer):
             counter_names.add(name)
             lines.append(f"# TYPE {name} counter")
         lines.append(f"{name}{_label_str(dict(labels))} {value}")
+    gauge_names = set()
+    for (name, labels), value in sorted(tracer.gauges().items()):
+        if name not in gauge_names:
+            gauge_names.add(name)
+            lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{_label_str(dict(labels))} {value}")
     return ("\n".join(lines) + "\n") if lines else ""
 
 
-def perfetto_trace(snapshot):
+def perfetto_trace(snapshot, pid=None):
     """Chrome/Perfetto trace_event JSON from a journal snapshot.
 
     Spans become "X" (complete) events with microsecond wall-clock
@@ -104,9 +112,29 @@ def perfetto_trace(snapshot):
     names ride as tid strings — Perfetto renders one track per
     (pid, tid) pair, which puts e.g. the serving batcher and the
     health poller on separate labeled tracks.
+
+    The pid is the JOURNAL's pid (its identity stamp), not the
+    converting process's — a file-sourced journal keeps its origin —
+    and a process_name metadata event labels the track
+    ``role@host[pid]``, so journals from several processes merged
+    into one file (merge_perfetto) land on distinct named process
+    tracks.
     """
-    pid = os.getpid()
+    ident = snapshot.get("identity") or {}
+    if pid is None:
+        pid = ident.get("pid") or os.getpid()
     tids = {}
+
+    def safe_id(v):
+        # Our own ids are minted below 2^53 (trace.py) and stay exact
+        # ints through JSON.parse; ids PROPAGATED from foreign
+        # spec-compliant clients (full 128-bit traceparent) would
+        # silently lose low bits in JS consumers, so those export as
+        # hex strings — still equal across every journal that carries
+        # the same id, which is all the correlation needs.
+        if isinstance(v, int) and abs(v) >= 2 ** 53:
+            return format(v, "x")
+        return v
 
     def tid_of(thread_name):
         # Stable small ints per thread name; metadata events below
@@ -118,10 +146,10 @@ def perfetto_trace(snapshot):
             "open_spans", []):
         dur = span.get("duration_s")
         args = dict(span.get("attrs") or {})
-        args["trace_id"] = span.get("trace_id")
-        args["span_id"] = span.get("span_id")
+        args["trace_id"] = safe_id(span.get("trace_id"))
+        args["span_id"] = safe_id(span.get("span_id"))
         if span.get("parent_id") is not None:
-            args["parent_id"] = span["parent_id"]
+            args["parent_id"] = safe_id(span["parent_id"])
         if span.get("status") and span["status"] != "ok":
             args["status"] = span["status"]
         events.append({
@@ -148,6 +176,35 @@ def perfetto_trace(snapshot):
     for name, tid in tids.items():
         events.append({"name": "thread_name", "ph": "M", "pid": pid,
                        "tid": tid, "args": {"name": name}})
+    if ident:
+        # Label with the pid actually used for the track — a merge
+        # remap must not leave the label naming the old pid.
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": process_label(
+                           dict(ident, pid=pid))}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def merge_perfetto(snapshots):
+    """One Perfetto document from several journal snapshots — the
+    cross-process timeline (serving replica + device plugin + per-host
+    trainers side by side, correlated by the propagated trace ids in
+    span args).
+
+    Each journal keeps its own process track. Identity pids normally
+    differ already; when two journals collide on pid (same pid on two
+    hosts, or a recycled pid), the later one is remapped to keep the
+    tracks distinct.
+    """
+    events = []
+    used_pids = set()
+    for snap in snapshots:
+        ident = snap.get("identity") or {}
+        pid = ident.get("pid") or os.getpid()
+        while pid in used_pids:
+            pid += 1  # deterministic, collision-free remap
+        used_pids.add(pid)
+        events.extend(perfetto_trace(snap, pid=pid)["traceEvents"])
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -166,6 +223,9 @@ def varz(tracer):
     counters = {name + _label_str(dict(labels)): value
                 for (name, labels), value in
                 sorted(tracer.counters().items())}
+    gauges = {name + _label_str(dict(labels)): value
+              for (name, labels), value in
+              sorted(tracer.gauges().items())}
     with tracer._lock:
         spans = len(tracer._spans)
         events = len(tracer._events)
@@ -174,6 +234,7 @@ def varz(tracer):
         started = tracer._started_unix
     return {
         "tracing_enabled": tracer.enabled,
+        "identity": identity(),
         "journal": {
             "capacity": tracer.capacity,
             "spans": spans,
@@ -185,6 +246,7 @@ def varz(tracer):
         "started_unix": started,
         "histograms": snap_hists,
         "counters": counters,
+        "gauges": gauges,
     }
 
 

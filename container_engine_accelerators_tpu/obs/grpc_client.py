@@ -1,0 +1,112 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""gRPC client interceptor: trace-context injection + client spans.
+
+The other half of obs.grpc_interceptor's server side: every outgoing
+RPC through a ``traced_channel`` carries the caller's current span
+context as a W3C-style ``traceparent`` metadata entry, so the
+server-side span (plugin Allocate, pod-resources List...) parents
+under the CALLER's request tree — one trace spanning both processes,
+joinable after the fact with ``trace_dump.py --merge``.
+
+Unary RPCs additionally get a client-side ``rpc.client.<method>``
+span measuring invoke->completion (the latency the caller actually
+experienced, RTT and serialization included — the server span only
+covers handler time) plus a
+``tpu_client_rpc_latency_seconds{method=...}`` histogram. Streaming
+calls inject context only: a stream-lifetime client span would read
+as a leak, the same reason the server side uses events for streams.
+"""
+
+import collections
+import time
+
+import grpc
+
+from .propagate import TRACEPARENT_KEY, format_traceparent
+from .trace import get_tracer
+
+CLIENT_RPC_HISTOGRAM = "tpu_client_rpc_latency_seconds"
+
+
+class _CallDetails(
+        collections.namedtuple(
+            "_CallDetails",
+            ("method", "timeout", "metadata", "credentials",
+             "wait_for_ready", "compression")),
+        grpc.ClientCallDetails):
+    pass
+
+
+def _with_traceparent(details, context):
+    metadata = list(details.metadata or ())
+    metadata.append((TRACEPARENT_KEY, format_traceparent(context)))
+    return _CallDetails(
+        details.method, details.timeout, metadata,
+        getattr(details, "credentials", None),
+        getattr(details, "wait_for_ready", None),
+        getattr(details, "compression", None))
+
+
+class TracingClientInterceptor(grpc.UnaryUnaryClientInterceptor,
+                               grpc.UnaryStreamClientInterceptor):
+    def __init__(self, tracer=None):
+        self._tracer = tracer or get_tracer()
+
+    def intercept_unary_unary(self, continuation, client_call_details,
+                              request):
+        tracer = self._tracer
+        method = client_call_details.method.lstrip("/")
+        if not tracer.enabled:
+            return continuation(client_call_details, request)
+        hist = tracer.histogram(
+            CLIENT_RPC_HISTOGRAM,
+            "Client-observed RPC latency by method",
+            labels={"method": method})
+        t0 = time.perf_counter()
+        with tracer.span("rpc.client." + method) as sp:
+            details = _with_traceparent(client_call_details,
+                                        sp.context())
+            call = continuation(details, request)
+            # Block here so the client span covers the full RTT. The
+            # call object stays a Future: a raised RpcError is caught
+            # (closing the span as status=error) and re-raised to the
+            # caller by ITS result() — interceptors must return the
+            # call, not raise past it.
+            try:
+                call.result()
+            except grpc.RpcError:
+                sp.status = "error"
+                sp.set(error=str(call.code()))
+            hist.observe(time.perf_counter() - t0)
+        return call
+
+    def intercept_unary_stream(self, continuation, client_call_details,
+                               request):
+        tracer = self._tracer
+        if not tracer.enabled:
+            return continuation(client_call_details, request)
+        context = tracer.current_context()
+        if context is not None:
+            client_call_details = _with_traceparent(
+                client_call_details, context)
+        return continuation(client_call_details, request)
+
+
+def traced_channel(channel, tracer=None):
+    """Wrap a grpc channel so every call through it injects the
+    current trace context (and records client spans/latency)."""
+    return grpc.intercept_channel(
+        channel, TracingClientInterceptor(tracer))

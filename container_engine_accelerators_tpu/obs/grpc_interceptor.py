@@ -33,6 +33,7 @@ import time
 
 import grpc
 
+from .propagate import context_from_metadata
 from .trace import get_tracer
 
 RPC_HISTOGRAM = "tpu_plugin_rpc_latency_seconds"
@@ -54,6 +55,15 @@ class TracingServerInterceptor(grpc.ServerInterceptor):
         if handler is None:
             return None
         method = _short_method(handler_call_details.method)
+        # Cross-process propagation (obs/propagate.py): a caller that
+        # dialed through obs.traced_channel rides its current span's
+        # context in as a traceparent metadata entry; the RPC span
+        # below then parents under the CALLER's trace, so a serving
+        # request and the plugin-side Allocate it triggered join into
+        # one trace across the process boundary. Malformed/absent
+        # headers start a fresh trace (never fail the RPC).
+        parent = context_from_metadata(
+            handler_call_details.invocation_metadata)
         if handler.request_streaming:
             # No client-streaming RPCs in the device-plugin API;
             # leave any untraced rather than guessing semantics.
@@ -64,11 +74,11 @@ class TracingServerInterceptor(grpc.ServerInterceptor):
                 request_deserializer=handler.request_deserializer,
                 response_serializer=handler.response_serializer)
         return grpc.unary_unary_rpc_method_handler(
-            self._wrap_unary(handler.unary_unary, method),
+            self._wrap_unary(handler.unary_unary, method, parent),
             request_deserializer=handler.request_deserializer,
             response_serializer=handler.response_serializer)
 
-    def _wrap_unary(self, behavior, method):
+    def _wrap_unary(self, behavior, method, parent=None):
         tracer = self._tracer
         hist = tracer.histogram(
             RPC_HISTOGRAM,
@@ -82,7 +92,7 @@ class TracingServerInterceptor(grpc.ServerInterceptor):
                 # the span with status=error and still lands in the
                 # histogram — failed RPCs are exactly the latencies
                 # an operator needs visible.
-                with tracer.span("rpc." + method):
+                with tracer.span("rpc." + method, parent=parent):
                     return behavior(request, context)
             finally:
                 hist.observe(time.perf_counter() - t0)

@@ -1,0 +1,72 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""W3C-``traceparent``-style context propagation over gRPC metadata.
+
+The wire format is the traceparent header shape
+(``00-<trace-id-hex32>-<span-id-hex16>-01``) carried in gRPC
+invocation metadata under the lowercase key ``traceparent``; ids map
+onto the tracer's integer trace/span ids (which are seeded with a
+per-process random base, so ids from different processes never
+collide in a merged timeline — see Tracer._new_id).
+
+This module is wire-format only (stdlib, no grpc import): the client
+interceptor lives in ``grpc_client`` and the server extract path in
+``grpc_interceptor`` so the plugin can import the server side without
+pulling client machinery and vice versa.
+"""
+
+import re
+
+TRACEPARENT_KEY = "traceparent"
+
+# version 00, 16-byte trace id, 8-byte parent id, flags byte.
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def format_traceparent(context):
+    """(trace_id, span_id) -> a traceparent header value.
+
+    flags are always 01 (sampled): a context is only injected when
+    the caller actually recorded a span.
+    """
+    trace_id, span_id = context
+    return "00-%032x-%016x-01" % (trace_id & (1 << 128) - 1,
+                                  span_id & (1 << 64) - 1)
+
+
+def parse_traceparent(value):
+    """Header value -> (trace_id, span_id), or None when malformed.
+
+    Malformed values are DROPPED, never raised: a bad header from an
+    old client must not fail the RPC it rides on (the W3C spec's
+    restart-the-trace behavior).
+    """
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if not m:
+        return None
+    trace_id, span_id = int(m.group(1), 16), int(m.group(2), 16)
+    if not trace_id or not span_id:  # all-zero ids are invalid per spec
+        return None
+    return (trace_id, span_id)
+
+
+def context_from_metadata(metadata):
+    """Extract a parent context from gRPC invocation metadata
+    (an iterable of (key, value) pairs), or None."""
+    for key, value in metadata or ():
+        if key == TRACEPARENT_KEY:
+            return parse_traceparent(value)
+    return None

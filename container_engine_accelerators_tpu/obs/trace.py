@@ -41,8 +41,11 @@ Environment:
 import atexit
 import json
 import os
+import random
 import threading
 import time
+
+from .identity import identity
 
 DEFAULT_CAP = 4096
 
@@ -223,7 +226,18 @@ class Tracer:
         self._dropped_events = 0
         self._histograms = {}
         self._counters = {}
-        self._next_id = 1
+        self._gauges = {}
+        # Ids are sequential above a per-tracer random base: sequential
+        # keeps in-process ordering readable, the base makes ids from
+        # different PROCESSES collision-free, so journals merged by
+        # trace_dump --merge (and trace ids propagated over gRPC, see
+        # obs/propagate.py) never alias. Bit 51 is forced on so every
+        # id stays nonzero (zero ids are invalid on the wire); the
+        # base stays under 2^52 so ids survive JSON round trips
+        # through JS consumers (Perfetto's UI parses args with
+        # JSON.parse — anything past 2^53 silently loses low bits,
+        # which would alias distinct spans).
+        self._next_id = (random.getrandbits(52) | (1 << 51))
         self._open = {}          # span_id -> Span (leak guard surface)
         self._local = threading.local()
         self._started_unix = time.time()
@@ -333,6 +347,14 @@ class Tracer:
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + inc
 
+    def gauge(self, name, value, **labels):
+        """Set a gauge to an instantaneous value (straggler skew,
+        queue depths...). Unlike counters these go up AND down; like
+        counters they live until reset() and export on every scrape."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = float(value)
+
     # -- export seams -------------------------------------------------
 
     def snapshot(self):
@@ -343,6 +365,7 @@ class Tracer:
             return {
                 "enabled": self.enabled,
                 "capacity": self.capacity,
+                "identity": identity(),
                 "started_unix": self._started_unix,
                 "spans": list(self._spans),
                 "open_spans": [s.to_dict() for s in
@@ -359,6 +382,10 @@ class Tracer:
     def counters(self):
         with self._lock:
             return dict(self._counters)
+
+    def gauges(self):
+        with self._lock:
+            return dict(self._gauges)
 
     def open_span_count(self):
         with self._lock:
@@ -381,6 +408,7 @@ class Tracer:
                     h.sum = 0.0
                     h.count = 0
             self._counters.clear()
+            self._gauges.clear()
             self._dropped_spans = self._dropped_events = 0
         stack = getattr(self._local, "stack", None)
         if stack:
@@ -397,18 +425,58 @@ def get_tracer():
     return TRACER
 
 
-def _write_trace_file():
-    path = os.environ.get("CEA_TPU_TRACE_FILE")
+# Set once a postmortem capture has written the journal: the atexit
+# writer then stands down, so a clean-looking teardown AFTER a fault
+# capture cannot overwrite the at-fault view of the open spans.
+_final_written = False
+
+
+def write_journal(path=None, reason=None, state=None, final=False):
+    """Flush the process-wide journal to a file; the CEA_TPU_TRACE_FILE
+    body, shared by normal exit (atexit below) and abnormal exit
+    (obs.postmortem's signal/fault handlers).
+
+    ``reason`` marks WHY the journal was written ("atexit",
+    "signal:SIGTERM", ...); ``state`` carries postmortem extras (last
+    health states, open-span context) under ``postmortem_state``;
+    ``final=True`` (postmortem captures) suppresses the later atexit
+    rewrite. Best-effort by contract: returns the path written, or
+    None — it must never raise on an exit path.
+    """
+    global _final_written
+    env_path = os.environ.get("CEA_TPU_TRACE_FILE")
+    path = path or env_path
     if not path:
-        return
+        return None
     try:
-        tmp = path + ".tmp"
+        body = TRACER.snapshot()
+        if reason is not None:
+            body["exit_reason"] = reason
+        if state is not None:
+            body["postmortem_state"] = state
+        tmp = "%s.tmp.%d" % (path, os.getpid())
         with open(tmp, "w") as f:
-            json.dump(TRACER.snapshot(), f, indent=1)
+            json.dump(body, f, indent=1, default=repr)
             f.write("\n")
         os.replace(tmp, path)
-    except OSError:
-        pass  # exit-time best effort; never mask the real exit
+        # Stand the atexit writer down only once a final capture has
+        # actually LANDED on the atexit writer's own target: a manual
+        # capture to some other path, or a capture that failed,
+        # must not cost the end-of-run CEA_TPU_TRACE_FILE journal.
+        if final and path == env_path:
+            _final_written = True
+        return path
+    except Exception:
+        # Exit-time best effort; never mask the real exit — this runs
+        # inside signal handlers and atexit, where an escaping error
+        # (OSError, or json failing on e.g. a circular provider
+        # payload) would preempt the chained graceful shutdown.
+        return None
+
+
+def _write_trace_file():
+    if not _final_written:
+        write_journal(reason="atexit")
 
 
 atexit.register(_write_trace_file)

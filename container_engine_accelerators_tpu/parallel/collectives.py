@@ -28,9 +28,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .compat import shard_map
 from .mesh import DATA_AXIS
 
 

@@ -1,0 +1,41 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""jax version compatibility shims for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to
+``jax.shard_map`` (and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma``) across the jax versions this stack
+must run on. Every parallel module imports the symbol from here so
+the version split lives in exactly one place.
+"""
+
+try:
+    from jax import shard_map as _shard_map
+    _LEGACY_KWARGS = False
+except ImportError:  # jax < 0.5: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _LEGACY_KWARGS = True
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` on new jax, the experimental one on old jax.
+
+    Call sites use the new-jax kwarg spelling (``check_vma``); on a
+    legacy jax it is translated to ``check_rep`` (same meaning: the
+    VMA/replication check on out_specs).
+    """
+    if _LEGACY_KWARGS and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)

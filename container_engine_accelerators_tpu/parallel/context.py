@@ -45,10 +45,10 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import flash_attention, flash_attention_lse
+from .compat import shard_map
 from .mesh import grid_mesh
 
 CONTEXT_AXIS = "context"

@@ -32,6 +32,7 @@ budgets for the ResNet target.
 import os
 import queue
 import threading
+import time
 import zipfile
 
 import jax
@@ -108,9 +109,15 @@ class PrefetchLoader:
 
     _DONE = object()
 
-    def __init__(self, source, sharding=None, prefetch=2):
+    def __init__(self, source, sharding=None, prefetch=2,
+                 wait_cb=None):
         if prefetch < 1:
             raise ValueError(f"prefetch must be >= 1: {prefetch}")
+        # Called with each __next__'s wait time in seconds — wire to
+        # Trainer.record_data_wait so per-host step summaries (and
+        # the straggler detector) see data-starvation next to step
+        # time, not just as anonymous train.data_wait spans.
+        self._wait_cb = wait_cb
         self._sharding = sharding
         self._q = queue.Queue(maxsize=prefetch)
         self._closed = threading.Event()
@@ -171,13 +178,16 @@ class PrefetchLoader:
             raise self._exc
         if self._done or self._closed.is_set():
             raise StopIteration
-        if obs.TRACER.enabled:
+        if obs.TRACER.enabled or self._wait_cb is not None:
             # The consumer-visible data-load cost: how long the train
             # loop actually WAITED for a staged batch. Near-zero
             # spans mean prefetch is keeping up; wide ones mean the
             # input pipeline is the bottleneck, not the step.
+            t0 = time.perf_counter()
             with obs.span("train.data_wait"):
                 item = self._q.get()
+            if self._wait_cb is not None:
+                self._wait_cb(time.perf_counter() - t0)
         else:
             item = self._q.get()
         if item is self._DONE:

@@ -43,9 +43,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .compat import shard_map
 from .mesh import grid_mesh
 
 EXPERT_AXIS = "expert"

@@ -37,9 +37,9 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .compat import shard_map
 from .mesh import DATA_AXIS, grid_mesh
 
 PIPELINE_AXIS = "pipe"

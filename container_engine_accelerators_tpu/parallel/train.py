@@ -28,6 +28,7 @@ TPU-first design notes:
 import dataclasses
 import functools
 import inspect
+import time
 from typing import Any
 
 import jax
@@ -63,7 +64,9 @@ class Trainer:
 
     def __init__(self, apply_fn, loss_fn, optimizer, mesh=None,
                  donate_state=True, remat=False, grad_accum=1,
-                 augment_fn=None, ema_decay=0.0, fsdp=False):
+                 augment_fn=None, ema_decay=0.0, fsdp=False,
+                 host_id=None, straggler=None,
+                 summary_every=32):
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1: {grad_accum}")
         if not 0.0 <= ema_decay < 1.0:
@@ -93,6 +96,24 @@ class Trainer:
         self._ema_decay = float(ema_decay)
         self._train_step = None
         self._state_shardings = None
+        # Per-host step telemetry: host_id defaults to this process's
+        # jax.process_index() (resolved lazily — the backend may not
+        # be up at construction). ``straggler`` is an
+        # obs.straggler.StragglerDetector fed every step's wall time
+        # + data wait — skew needs >= 2 hosts observing into ONE
+        # detector, so this live wiring detects in multihost-sim or
+        # aggregator processes; on a real slice each host only times
+        # itself, and the fleet view comes from the
+        # ``train.step_summary`` journal event published every
+        # ``summary_every`` steps (replayed over merged journals by
+        # obs.straggler.scan_events / tpu_diagnose).
+        self._host_id = host_id
+        self._straggler = straggler
+        self._summary_every = max(1, int(summary_every))
+        self._steps_seen = 0
+        self._step_window = []
+        self._wait_window = []
+        self._pending_data_wait = 0.0
 
     # -- state --------------------------------------------------------
 
@@ -270,10 +291,55 @@ class Trainer:
         if self._train_step is None:
             with obs.span("train.step_compile"):
                 self._train_step = self._build_train_step(state)
-        if not obs.TRACER.enabled:
+        if not obs.TRACER.enabled and self._straggler is None:
             return self._train_step(state, batch)
+        t0 = time.perf_counter()
         with obs.span("train.step_run"):
-            return self._train_step(state, batch)
+            out = self._train_step(state, batch)
+        self._record_step(time.perf_counter() - t0)
+        return out
+
+    def host_id(self):
+        """This trainer's host identity for step telemetry."""
+        if self._host_id is None:
+            self._host_id = f"host{jax.process_index()}"
+        return self._host_id
+
+    def record_data_wait(self, seconds):
+        """Attribute input-pipeline wait time to the NEXT step's
+        telemetry; wire as PrefetchLoader(wait_cb=...). Thread-safe
+        enough for its single-consumer use (the train loop thread
+        both waits on data and steps)."""
+        self._pending_data_wait += float(seconds)
+
+    def _record_step(self, dt):
+        """Per-host step telemetry behind every traced train_step:
+        feed the straggler detector live, and publish a
+        ``train.step_summary`` journal event (host, p50/max step
+        time, data wait) every summary_every steps — the per-host
+        numbers a merged multi-journal timeline compares across the
+        fleet."""
+        host = self.host_id()
+        wait, self._pending_data_wait = self._pending_data_wait, 0.0
+        if self._straggler is not None:
+            self._straggler.observe(host, dt, wait)
+        if not obs.TRACER.enabled:
+            return
+        self._step_window.append(dt)
+        self._wait_window.append(wait)
+        self._steps_seen += 1
+        if self._steps_seen % self._summary_every:
+            return
+        times = sorted(self._step_window)
+        waits = sorted(self._wait_window)
+        obs.event(
+            "train.step_summary", host=host, step=self._steps_seen,
+            steps=len(times),
+            step_time_p50_ms=round(times[len(times) // 2] * 1e3, 3),
+            step_time_max_ms=round(times[-1] * 1e3, 3),
+            data_wait_p50_ms=round(waits[len(waits) // 2] * 1e3, 3),
+            data_wait_total_ms=round(sum(waits) * 1e3, 3))
+        del self._step_window[:], self._wait_window[:]
 
     def eval_params(self, state):
         """Weights eval/serving should read: the EMA shadow when it

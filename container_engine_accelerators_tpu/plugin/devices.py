@@ -22,6 +22,7 @@ to it, for metrics labeling.
 
 import grpc
 
+from ..obs.grpc_client import traced_channel
 from ..utils import get_logger
 from . import config as cfg
 from .api import PodResourcesListerStub, podresources_pb2
@@ -47,8 +48,12 @@ def get_devices_for_all_containers(
     Returns a list of ContainerDevices; raises grpc.RpcError when the
     kubelet socket is unreachable.
     """
+    # Traced channel: the List call lands as an rpc.client span under
+    # the metrics.collect sweep (and its latency in
+    # tpu_client_rpc_latency_seconds) — a slow kubelet pod-resources
+    # endpoint is a real production failure mode worth seeing.
     with grpc.insecure_channel(f"unix://{socket_path}") as channel:
-        stub = PodResourcesListerStub(channel)
+        stub = PodResourcesListerStub(traced_channel(channel))
         resp = stub.List(
             podresources_pb2.ListPodResourcesRequest(), timeout=_TIMEOUT_S)
     out = []

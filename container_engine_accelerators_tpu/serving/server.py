@@ -31,6 +31,7 @@ Endpoints:
 import contextlib
 import functools
 import json
+import os
 import queue
 import threading
 import time
@@ -217,9 +218,21 @@ class _StreamBody:
 
 class _BaseServer:
     """HTTP scaffolding shared by the predict and generate servers:
-    /healthz, /stats, latency bookkeeping, and one POST route."""
+    /healthz, /stats, latency bookkeeping, and one POST route.
 
-    def __init__(self, model_name, port):
+    ``plugin_socket`` (or CEA_TPU_PLUGIN_SOCKET) names the local
+    device plugin's unix socket; when set, /stats additionally
+    reports the plugin's advertised device-health map, queried over a
+    TRACED channel — the serving-side span context rides the RPC as
+    gRPC metadata (obs.grpc_client), so the plugin's journal shows
+    the query parented under this replica's trace.
+    """
+
+    def __init__(self, model_name, port, plugin_socket=None):
+        self._plugin_socket = (plugin_socket
+                               or os.environ.get(
+                                   "CEA_TPU_PLUGIN_SOCKET"))
+        self._plugin_status_cache = None  # (monotonic, result)
         self._name = model_name
         # Readiness: /healthz answers 503 until set. Servers that
         # precompile asynchronously clear it so a new HPA replica
@@ -378,9 +391,66 @@ class _BaseServer:
         with self._stats_lock:
             self._requests += 1
 
+    # Plugin-health answers change on health-poll timescales; caching
+    # keeps a hung (not cleanly dead) plugin socket from taxing every
+    # monitoring poll of /stats with fresh RPC deadlines.
+    _PLUGIN_STATUS_TTL_S = 5.0
+
+    def _plugin_status(self):
+        """Device-health map from the local device plugin, queried
+        over a traced channel (context-injecting: the plugin journal
+        shows this query under the serving trace) and cached for
+        _PLUGIN_STATUS_TTL_S. None when no plugin socket is
+        configured; a structured error dict when the query fails —
+        /stats must answer even with the plugin down."""
+        if not self._plugin_socket:
+            return None
+        cached = self._plugin_status_cache
+        if (cached is not None
+                and time.monotonic() - cached[0]
+                < self._PLUGIN_STATUS_TTL_S):
+            return cached[1]
+        result = self._query_plugin()
+        self._plugin_status_cache = (time.monotonic(), result)
+        return result
+
+    def _query_plugin(self):
+        import grpc
+
+        from ..obs.grpc_client import traced_channel
+        from ..plugin import api
+
+        with obs.span("serving.plugin_query",
+                      socket=self._plugin_socket) as sp:
+            try:
+                with grpc.insecure_channel(
+                        f"unix://{self._plugin_socket}") as ch:
+                    stub = api.DevicePluginV1Beta1Stub(
+                        traced_channel(ch))
+                    # Unary probe first: rides the full client-span +
+                    # inject + server-extract path.
+                    stub.GetDevicePluginOptions(
+                        api.v1beta1_pb2.Empty(), timeout=1)
+                    stream = stub.ListAndWatch(
+                        api.v1beta1_pb2.Empty(), timeout=2)
+                    first = next(iter(stream))
+                    stream.cancel()
+                    return {d.ID: d.health for d in first.devices}
+            except Exception as e:
+                # The error is a return value for /stats, but the
+                # SPAN must still read as failed — an operator
+                # tracing a dead plugin socket looks for exactly
+                # these error-status spans.
+                if sp:
+                    sp.status = "error"
+                    sp.set(error=str(e)[:200])
+                return {"error": str(e)[:200]}
+
     def stats(self):
         # Histogram reads take the histogram's own lock, not
-        # _stats_lock (nothing blockable may hold _stats_lock).
+        # _stats_lock (nothing blockable may hold _stats_lock —
+        # same reason the plugin query runs before acquiring it).
+        plugin_devices = self._plugin_status()
         p50 = self._latency_hist.quantile(0.5)
         p99 = self._latency_hist.quantile(0.99)
         with self._stats_lock:
@@ -401,6 +471,8 @@ class _BaseServer:
                 "p99_ms": (round(p99 * 1000, 3)
                            if p99 is not None else None),
             }
+            if plugin_devices is not None:
+                out["plugin_devices"] = plugin_devices
             out.update(self._extra_stats())
             return out
 
@@ -433,8 +505,9 @@ class InferenceServer(_BaseServer):
 
     def __init__(self, model_name, apply_fn, variables, input_shape,
                  port=8500, max_batch=8, max_wait_ms=5,
-                 max_queue=None):
-        super().__init__(model_name, port)
+                 max_queue=None, plugin_socket=None):
+        super().__init__(model_name, port,
+                         plugin_socket=plugin_socket)
         self._input_shape = tuple(input_shape)
         self._max_batch = max_batch
         if max_queue is None:
@@ -547,8 +620,10 @@ class GenerationServer(_BaseServer):
                  warm=False, warm_filters=None, warm_async=False,
                  max_wait_ms=5, tokenizer=None,
                  max_queue=None, draft_model=None, draft_params=None,
-                 speculative_k=0, prefix_tokens=None):
-        super().__init__(model_name, port)
+                 speculative_k=0, prefix_tokens=None,
+                 plugin_socket=None):
+        super().__init__(model_name, port,
+                         plugin_socket=plugin_socket)
         from ..models.decode import decode
         self._decode = decode
         # Speculative decoding for default-knob traffic: a draft

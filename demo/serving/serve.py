@@ -203,6 +203,11 @@ def main(argv=None):
                         "token ids (mutually exclusive with "
                         "--system-prefix)")
     args = p.parse_args(argv)
+    # Identity stamp for this process's journal (merged cross-process
+    # timelines label the track serving@host[pid]); entry points own
+    # the role, not library classes.
+    from container_engine_accelerators_tpu import obs
+    obs.set_role("serving")
     # Prefix flags validate at PARSE time: a conflict or missing
     # tokenizer must not cost a full model build + checkpoint load
     # before erroring, and the flags must never be silently ignored

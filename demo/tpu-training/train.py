@@ -644,6 +644,11 @@ def evaluate(trainer, state, loader, args):
 
 def main(argv=None):
     args = parse_args(argv)
+    # Identity stamp for this process's journal (merged cross-process
+    # timelines label the track train@host[pid]); entry points own
+    # the role, not library classes.
+    from container_engine_accelerators_tpu import obs
+    obs.set_role("train")
     if args.compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir",
                           args.compilation_cache_dir)

@@ -49,6 +49,26 @@ def test_prefetch_preserves_order_and_values():
         np.testing.assert_array_equal(np.asarray(labels), source[i][1])
 
 
+def test_prefetch_wait_cb_reports_consumer_waits():
+    """wait_cb (the Trainer.record_data_wait seam) sees one wait
+    duration per consumed batch — the per-host data-starvation
+    signal behind train.step_summary events."""
+    import time
+
+    def slow_source():
+        for i in range(3):
+            time.sleep(0.02)
+            yield (np.full((1,), i, np.float32),
+                   np.full((1,), i, np.int32))
+
+    waits = []
+    out = list(PrefetchLoader(slow_source(), wait_cb=waits.append))
+    assert len(out) == 3
+    assert len(waits) >= 3  # one per batch (+ the DONE sentinel read)
+    assert all(w >= 0 for w in waits)
+    assert sum(waits) > 0  # the staged source made the consumer wait
+
+
 def test_prefetch_device_puts_to_sharding():
     import jax
 

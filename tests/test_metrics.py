@@ -52,6 +52,10 @@ class PodResourcesStub(PodResourcesListerServicer):
     def List(self, request, context):
         return self._payload
 
+    def set_payload(self, payload):
+        """Swap the advertised pod set (container churn simulation)."""
+        self._payload = payload
+
     def start(self):
         self._server.start()
 
@@ -169,6 +173,68 @@ def test_reset_drops_stale_labels(node2):
         body = urllib.request.urlopen(
             f"http://localhost:{server.port}/metrics").read().decode()
         assert 'pod="train-0"' not in body
+    finally:
+        server.stop()
+        stub.stop()
+
+
+def test_reset_cycle_drops_departed_container_labels(node2,
+                                                     monkeypatch):
+    """The stale-label RESET cycle end to end, through the real
+    collection thread (metrics.go:63,158-167 behavior): label sets
+    for a container that DEPARTED keep being served only until the
+    next reset tick, after which the scrape carries the live pod set
+    only. test_reset_drops_stale_labels covers the _reset() seam;
+    this covers the ticker actually firing it."""
+    import time
+
+    from container_engine_accelerators_tpu.plugin import (
+        metrics as metrics_mod,
+    )
+
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=node2.dev_dir, state_dir=node2.state_dir,
+                     backend=backend)
+    mgr.start()
+    sock = os.path.join(short_tmpdir(), "podres.sock")
+    stub = PodResourcesStub(sock, payload_two_pods())
+    stub.start()
+    # Fast cycles: collect every 30ms, reset every ~90ms.
+    monkeypatch.setattr(metrics_mod, "RESET_INTERVAL_MS", 90)
+    server = MetricServer(mgr, backend, collection_interval_ms=30,
+                          port=0, pod_resources_socket=sock)
+    server.start()
+
+    def scrape():
+        return urllib.request.urlopen(
+            f"http://localhost:{server.port}/metrics").read().decode()
+
+    def wait_for(predicate, deadline_s=15):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            body = scrape()
+            if predicate(body):
+                return body
+            time.sleep(0.05)
+        return scrape()
+
+    try:
+        body = wait_for(lambda b: 'pod="train-0"' in b)
+        assert 'pod="train-0"' in body
+        # The pod departs: the kubelet stops listing it.
+        stub.set_payload(
+            api.podresources_pb2.ListPodResourcesResponse(
+                pod_resources=[api.podresources_pb2.PodResources(
+                    name="late-1", namespace="default", containers=[
+                        api.podresources_pb2.ContainerResources(
+                            name="jax", devices=[
+                                api.podresources_pb2.ContainerDevices(
+                                    resource_name="google.com/tpu",
+                                    device_ids=["accel1"])])])]))
+        body = wait_for(lambda b: ('pod="train-0"' not in b
+                                   and 'pod="late-1"' in b))
+        assert 'pod="train-0"' not in body  # departed: dropped
+        assert 'pod="late-1"' in body       # live: re-collected
     finally:
         server.stop()
         stub.stop()

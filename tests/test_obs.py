@@ -155,6 +155,32 @@ def test_prometheus_text_format():
     assert 'y_total{kind="a"} 3' in text
 
 
+def test_gauges_export_and_reset():
+    obs.gauge("tpu_train_step_skew_ratio", 1.75, host="host3")
+    obs.gauge("tpu_train_step_skew_ratio", 0.98, host="host0")
+    text = obs.prometheus_text(obs.TRACER)
+    assert "# TYPE tpu_train_step_skew_ratio gauge" in text
+    assert ('tpu_train_step_skew_ratio{host="host3"} 1.75'
+            in text)
+    varz = obs.varz(obs.TRACER)
+    assert varz["gauges"]['tpu_train_step_skew_ratio{host="host0"}'] \
+        == 0.98
+    # Gauges go DOWN too (unlike counters) and clear on reset.
+    obs.gauge("tpu_train_step_skew_ratio", 1.0, host="host3")
+    assert obs.TRACER.gauges()[
+        ("tpu_train_step_skew_ratio", (("host", "host3"),))] == 1.0
+    obs.TRACER.reset()
+    assert not obs.TRACER.gauges()
+
+
+def test_snapshot_carries_identity_stamp():
+    snap = obs.TRACER.snapshot()
+    ident = snap["identity"]
+    assert ident["pid"] == os.getpid()
+    assert ident["host"] and isinstance(ident["role"], str)
+    assert obs.process_label(ident).endswith(f"[{os.getpid()}]")
+
+
 # -- perfetto export --------------------------------------------------
 
 def test_perfetto_trace_event_shape():
@@ -410,6 +436,90 @@ def test_trace_dump_from_live_server_and_file(predict_server,
     missing = trace_dump.main(["--file", "/nonexistent",
                                "--out", str(out2)])
     assert missing == 1
+
+
+def _load_trace_dump():
+    import importlib.util
+
+    from tests.conftest import REPO_ROOT
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_dump", os.path.join(REPO_ROOT, "tools",
+                                   "trace_dump.py"))
+    trace_dump = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_dump)
+    return trace_dump
+
+
+def test_trace_dump_journal_round_trip(tmp_path):
+    """Journal file -> Perfetto conversion preserves the journal's
+    content: every span/event converts with µs timestamps, ids in
+    args, the journal's OWN pid on the track, and --raw returns the
+    byte-identical snapshot."""
+    with obs.span("layer.op", device="accel0"):
+        obs.event("layer.mark", n=7)
+    snapshot = obs.TRACER.snapshot()
+    journal = tmp_path / "journal.json"
+    journal.write_text(json.dumps(snapshot))
+    trace_dump = _load_trace_dump()
+
+    out = tmp_path / "round.json"
+    assert trace_dump.main(["--file", str(journal), "--out",
+                            str(out)]) == 0
+    doc = json.loads(out.read_text())
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    span = snapshot["spans"][0]
+    assert len(complete) == 1
+    assert complete[0]["ts"] == pytest.approx(
+        span["start_unix"] * 1e6)
+    assert complete[0]["dur"] == pytest.approx(
+        span["duration_s"] * 1e6)
+    assert complete[0]["args"]["span_id"] == span["span_id"]
+    assert complete[0]["pid"] == snapshot["identity"]["pid"]
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert instants[0]["args"] == {"n": 7}
+    # --raw: the snapshot comes back unconverted.
+    raw_out = tmp_path / "raw.json"
+    assert trace_dump.main(["--file", str(journal), "--raw",
+                            "--out", str(raw_out)]) == 0
+    assert json.loads(raw_out.read_text()) == snapshot
+
+
+def test_trace_dump_merge_mode(tmp_path):
+    """--merge folds several journals into one timeline (distinct
+    pids, all spans present); --raw --merge wraps the originals."""
+    with obs.span("proc_a.op"):
+        pass
+    snap_a = obs.TRACER.snapshot()
+    obs.TRACER.reset()
+    with obs.span("proc_b.op"):
+        pass
+    snap_b = dict(obs.TRACER.snapshot())
+    # Fake a second process: different pid in the identity stamp.
+    snap_b["identity"] = dict(snap_b["identity"],
+                              pid=snap_b["identity"]["pid"] + 1,
+                              role="other")
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(snap_a))
+    b.write_text(json.dumps(snap_b))
+    trace_dump = _load_trace_dump()
+
+    out = tmp_path / "merged.json"
+    assert trace_dump.main(["--merge", str(a), str(b), "--out",
+                            str(out)]) == 0
+    doc = json.loads(out.read_text())
+    spans = {e["name"]: e for e in doc["traceEvents"]
+             if e["ph"] == "X"}
+    assert set(spans) == {"proc_a.op", "proc_b.op"}
+    assert spans["proc_a.op"]["pid"] != spans["proc_b.op"]["pid"]
+    raw_out = tmp_path / "merged_raw.json"
+    assert trace_dump.main(["--merge", str(a), str(b), "--raw",
+                            "--out", str(raw_out)]) == 0
+    assert json.loads(raw_out.read_text()) == {
+        "journals": [snap_a, snap_b]}
+    # A missing merge operand is a clean error, not a traceback.
+    assert trace_dump.main(["--merge", str(a), "/nonexistent",
+                            "--out", str(out)]) == 1
 
 
 def test_trace_file_written_at_exit(tmp_path):

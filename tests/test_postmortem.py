@@ -1,0 +1,217 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Postmortem capture: signal/fault-time journal flush, state
+providers, and the SIGTERM-mid-Allocate acceptance path."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from container_engine_accelerators_tpu import obs
+from container_engine_accelerators_tpu.obs import postmortem
+from tests.conftest import REPO_ROOT
+from tests.plugin_helpers import short_tmpdir
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    obs.TRACER.reset()
+    yield
+    obs.TRACER.reset()
+
+
+def test_capture_writes_open_spans_and_state(tmp_path, monkeypatch):
+    path = tmp_path / "pm.json"
+    postmortem.register_state_provider(
+        "device_health", lambda: {"accel0": "Healthy"})
+    postmortem.register_state_provider(
+        "broken", lambda: 1 / 0)
+    try:
+        with obs.span("rpc.inflight", device="accel0"):
+            out = postmortem.capture("manual", path=str(path))
+    finally:
+        postmortem.unregister_state_provider("device_health")
+        postmortem.unregister_state_provider("broken")
+    assert out == str(path)
+    doc = json.loads(path.read_text())
+    assert doc["exit_reason"] == "manual"
+    assert [s["name"] for s in doc["open_spans"]] == ["rpc.inflight"]
+    state = doc["postmortem_state"]
+    assert state["device_health"] == {"accel0": "Healthy"}
+    # A dead provider records in place, never raises.
+    assert "ZeroDivisionError" in state["broken"]["provider_error"]
+    assert doc["identity"]["pid"] == os.getpid()
+
+
+def test_first_death_capture_wins(tmp_path, monkeypatch):
+    """Death-path captures (default CEA_TPU_TRACE_FILE target): the
+    first write wins; explicit-path operator captures bypass the
+    guard; force=True overrides; uninstall() re-arms."""
+    death = tmp_path / "death.json"
+    monkeypatch.setenv("CEA_TPU_TRACE_FILE", str(death))
+    try:
+        assert postmortem.capture("signal:TERM") == str(death)
+        # A second death-path capture (chained fault, atexit) must
+        # not overwrite the at-fault snapshot.
+        assert postmortem.capture("unhandled:Boom") is None
+        assert json.loads(
+            death.read_text())["exit_reason"] == "signal:TERM"
+        # Deliberate operator capture to its own path still writes.
+        side = tmp_path / "side.json"
+        assert postmortem.capture("manual",
+                                  path=str(side)) == str(side)
+        assert json.loads(
+            side.read_text())["exit_reason"] == "manual"
+        assert postmortem.capture("forced", force=True) == str(death)
+        assert json.loads(
+            death.read_text())["exit_reason"] == "forced"
+    finally:
+        postmortem.uninstall()  # re-arm the guard for other tests
+
+
+def test_install_chains_previous_handler_and_uninstalls():
+    seen = []
+    prev = signal.getsignal(signal.SIGUSR1)
+    signal.signal(signal.SIGUSR1, lambda s, f: seen.append(s))
+    try:
+        postmortem.install(signals=(signal.SIGUSR1,),
+                           fatal_errors=False)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.05)
+        # Chained: the graceful handler still ran after capture.
+        assert seen == [signal.SIGUSR1]
+    finally:
+        postmortem.uninstall()
+        signal.signal(signal.SIGUSR1, prev)
+
+
+# The acceptance path: a REAL fake-chip plugin process, SIGTERM'd
+# while an Allocate is blocked inside the handler, must still leave a
+# valid CEA_TPU_TRACE_FILE journal containing the open Allocate span
+# and the last device-health states.
+_PLUGIN_PROC = textwrap.dedent("""
+    import os, signal, sys, threading, time
+    sys.path.insert(0, {repo!r})
+    from container_engine_accelerators_tpu import obs
+    from container_engine_accelerators_tpu.obs import postmortem
+    obs.set_role("plugin")
+    from container_engine_accelerators_tpu.chip import PyChipBackend
+    from container_engine_accelerators_tpu.plugin.manager import (
+        TpuManager,
+    )
+
+    STOP = threading.Event()
+
+    class SlowBackend(PyChipBackend):
+        # Stall Allocate inside the traced handler so the span is
+        # open when SIGTERM lands; release on shutdown so the
+        # executor thread doesn't pin interpreter exit.
+        def chip_coords(self, chip):
+            print("STALLED", flush=True)
+            STOP.wait(60)
+            raise RuntimeError("server stopping")
+
+    mgr = TpuManager(dev_dir={dev!r}, state_dir={state!r},
+                     backend=SlowBackend())
+    mgr.start()
+
+    def shutdown(signum, frame):
+        STOP.set()
+        mgr.stop()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    postmortem.register_state_provider("device_health",
+                                       mgr.list_devices)
+    postmortem.install()
+
+    t = threading.Thread(
+        target=mgr.serve, args=({plugin_dir!r}, "kubelet.sock", "tpu"),
+        daemon=True)
+    t.start()
+    assert mgr.wait_until_serving(10)
+    print("READY", flush=True)
+    while True:  # SIGTERM (via postmortem chain -> shutdown) ends us
+        time.sleep(0.2)
+        if mgr.is_stopping():
+            break
+""")
+
+_CLIENT_CODE = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import grpc
+    from container_engine_accelerators_tpu.plugin import api
+    with grpc.insecure_channel("unix://" + {sock!r}) as ch:
+        stub = api.DevicePluginV1Beta1Stub(ch)
+        try:
+            stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel0"])]), timeout=30)
+        except grpc.RpcError:
+            pass  # the server dies mid-call; expected
+""")
+
+
+def test_sigterm_mid_allocate_writes_postmortem_journal(fake_node,
+                                                        tmp_path):
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("1x2")
+    plugin_dir = short_tmpdir()
+    journal = tmp_path / "postmortem_journal.json"
+
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT,
+               CEA_TPU_TRACE_FILE=str(journal))
+    plugin = subprocess.Popen(
+        [sys.executable, "-c", _PLUGIN_PROC.format(
+            repo=REPO_ROOT, dev=fake_node.dev_dir,
+            state=fake_node.state_dir, plugin_dir=plugin_dir)],
+        env=env, stdout=subprocess.PIPE, text=True, cwd=REPO_ROOT)
+    client = None
+    try:
+        assert plugin.stdout.readline().strip() == "READY"
+        socks = [f for f in os.listdir(plugin_dir)
+                 if f.startswith("tpu-") and f.endswith(".sock")]
+        sock = os.path.join(plugin_dir, socks[0])
+        client = subprocess.Popen(
+            [sys.executable, "-c", _CLIENT_CODE.format(
+                repo=REPO_ROOT, sock=sock)],
+            env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+            cwd=REPO_ROOT)
+        # Wait until the Allocate is provably inside the handler.
+        assert plugin.stdout.readline().strip() == "STALLED"
+        plugin.send_signal(signal.SIGTERM)
+        plugin.wait(timeout=30)
+    finally:
+        if plugin.poll() is None:
+            plugin.kill()
+        if client is not None:
+            client.kill()
+            client.wait(timeout=10)
+
+    doc = json.loads(journal.read_text())
+    assert doc["exit_reason"] == "signal:SIGTERM"
+    open_names = [s["name"] for s in doc["open_spans"]]
+    assert "rpc.v1beta1.DevicePlugin/Allocate" in open_names
+    assert (doc["postmortem_state"]["device_health"]
+            == {"accel0": "Healthy", "accel1": "Healthy"})
+    assert doc["identity"]["role"] == "plugin"

@@ -1,0 +1,192 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Straggler detection: sliding-window skew, event hysteresis, the
+skew gauge on the Prometheus surface, journal replay, and the
+multihost-sim train-loop integration."""
+
+import pytest
+
+from container_engine_accelerators_tpu import obs
+from container_engine_accelerators_tpu.obs.straggler import (
+    SKEW_GAUGE,
+    StragglerDetector,
+    scan_events,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    obs.TRACER.reset()
+    yield
+    obs.TRACER.reset()
+
+
+def _drive(det, steps, times_by_host):
+    for _ in range(steps):
+        for host, t in times_by_host.items():
+            det.observe(host, t)
+
+
+def test_detects_one_slow_host_exactly_once():
+    tracer = obs.Tracer(enabled=True)
+    det = StragglerDetector(window=16, factor=1.5, min_samples=4,
+                            tracer=tracer)
+    # host3 runs 2.5x the fleet median, persistently, many windows.
+    _drive(det, 50, {"host0": 0.10, "host1": 0.10, "host2": 0.10,
+                     "host3": 0.25})
+    events = [e for e in tracer.snapshot()["events"]
+              if e["name"] == "straggler.detected"]
+    assert len(events) == 1  # hysteresis: one event per episode
+    f = events[0]["fields"]
+    assert f["host"] == "host3"
+    assert f["skew_ratio"] == pytest.approx(2.5, rel=0.05)
+    assert det.flagged() == ["host3"]
+    # The gauge is live and nonzero for every host, >1.5 for host3.
+    gauges = {labels: v for (name, labels), v
+              in tracer.gauges().items() if name == SKEW_GAUGE}
+    assert gauges[(("host", "host3"),)] > 1.5
+    assert gauges[(("host", "host0"),)] == pytest.approx(1.0,
+                                                         rel=0.05)
+
+
+def test_recovery_emits_event_and_rearms():
+    tracer = obs.Tracer(enabled=True)
+    det = StragglerDetector(window=8, factor=1.5, min_samples=4,
+                            tracer=tracer)
+    _drive(det, 20, {"h0": 0.1, "h1": 0.1, "h2": 0.3})
+    assert det.event_count() == 1
+    _drive(det, 20, {"h0": 0.1, "h1": 0.1, "h2": 0.1})  # recovers
+    assert det.flagged() == []
+    names = [e["name"] for e in tracer.snapshot()["events"]]
+    assert names.count("straggler.recovered") == 1
+    _drive(det, 20, {"h0": 0.1, "h1": 0.1, "h2": 0.3})  # relapse
+    assert det.event_count() == 2
+
+
+def test_no_detection_below_min_samples_or_single_host():
+    tracer = obs.Tracer(enabled=True)
+    det = StragglerDetector(window=16, factor=1.5, min_samples=8,
+                            tracer=tracer)
+    _drive(det, 3, {"h0": 0.1, "h1": 0.9})  # too few samples
+    assert det.skews() == {}
+    solo = StragglerDetector(window=16, factor=1.5, min_samples=2,
+                             tracer=tracer)
+    _drive(solo, 20, {"only": 0.5})  # skew against yourself: no-op
+    assert solo.skews() == {}
+    assert solo.event_count() == 0
+
+
+def test_scan_events_replays_merged_journals():
+    """The offline path (tpu_diagnose bundles): per-host
+    train.step_summary events from merged journals reproduce the
+    live detector's verdict."""
+    events = []
+    for step in range(1, 13):
+        for host, p50 in (("host0", 100.0), ("host1", 102.0),
+                          ("host2", 240.0)):
+            events.append({"name": "train.step_summary",
+                           "unix": 1000.0 + step,
+                           "fields": {"host": host, "step": step,
+                                      "step_time_p50_ms": p50,
+                                      "data_wait_p50_ms": 1.0}})
+    events.append({"name": "health.transition", "unix": 999.0,
+                   "fields": {"device": "accel0"}})  # ignored
+    det = scan_events(events, window=8, factor=1.5, min_samples=4,
+                      tracer=obs.Tracer(enabled=False))
+    assert det.flagged() == ["host2"]
+    assert det.skews()["host2"] == pytest.approx(240 / 102, rel=0.05)
+
+
+# -- multihost-sim train loop -----------------------------------------
+
+def test_synthetic_slow_host_in_multihost_sim_train_loop():
+    """Acceptance: a synthetic slow host in a multihost-sim train
+    loop triggers exactly one straggler.detected event and a nonzero
+    tpu_train_step_skew_ratio gauge. Each simulated host runs a REAL
+    Trainer over a slice of the virtual CPU mesh (one train step
+    program per host, same model), with the slow host's step padded
+    by a sleep — the per-host Trainer telemetry feeds one shared
+    detector the way one aggregator would consume the fleet's
+    journals."""
+    import time
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from container_engine_accelerators_tpu.parallel.train import (
+        Trainer,
+        cross_entropy_loss,
+    )
+
+    detector = StragglerDetector(window=8, factor=1.5, min_samples=4)
+
+    def apply_fn(variables, images, train):
+        logits = images.reshape(images.shape[0], -1) @ \
+            variables["params"]["w"]
+        return logits, {}
+
+    devices = np.array(jax.devices()[:4]).reshape(4, 1)
+    hosts = []
+    for idx in range(4):
+        mesh = Mesh(devices[idx:idx + 1], ("data", "model"))
+        trainer = Trainer(apply_fn, cross_entropy_loss,
+                          optax.sgd(0.1), mesh=mesh,
+                          donate_state=False,
+                          host_id=f"host{idx}", summary_every=4)
+        state = trainer.init_state(
+            {"params": {"w": np.zeros((4, 2), np.float32)}})
+        hosts.append((trainer, state))
+
+    batch = (np.ones((2, 2, 2), np.float32),
+             np.zeros((2,), np.int32))
+    # Warm every host's compiled step BEFORE attaching the detector
+    # (the first dispatch pays the lazy XLA compile — a real fleet's
+    # steady-state windows never contain it), then give every host a
+    # uniform synthetic device-step cost with host3 3x slower — the
+    # slowness lands inside the measured step, as a slow chip's
+    # would. The baseline matters: bare dispatch is microseconds and
+    # its scheduling noise would swamp any ratio.
+    def with_device_cost(step_fn, seconds):
+        def stalled(state, batch):
+            time.sleep(seconds)
+            return step_fn(state, batch)
+        return stalled
+
+    for idx, (trainer, state) in enumerate(hosts):
+        new_state, _ = trainer.train_step(state, batch)
+        hosts[idx] = (trainer, new_state)
+        trainer._straggler = detector
+        trainer._train_step = with_device_cost(
+            trainer._train_step, 0.03 if idx == 3 else 0.01)
+
+    for step in range(16):
+        for idx, (trainer, state) in enumerate(hosts):
+            new_state, _ = trainer.train_step(state, batch)
+            hosts[idx] = (trainer, new_state)
+
+    events = [e for e in obs.TRACER.snapshot()["events"]
+              if e["name"] == "straggler.detected"]
+    assert len(events) == 1, events
+    assert events[0]["fields"]["host"] == "host3"
+    gauges = {labels: v for (name, labels), v
+              in obs.TRACER.gauges().items() if name == SKEW_GAUGE}
+    assert gauges[(("host", "host3"),)] > 1.5
+    # Per-host summaries landed in the journal for offline replay.
+    summaries = [e for e in obs.TRACER.snapshot()["events"]
+                 if e["name"] == "train.step_summary"]
+    assert {e["fields"]["host"] for e in summaries} == {
+        f"host{i}" for i in range(4)}

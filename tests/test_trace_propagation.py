@@ -1,0 +1,318 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Cross-process trace propagation: traceparent wire format, client
+interceptor -> server interceptor over a real gRPC socket, identity
+stamps, and the merged multi-process Perfetto timeline."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu import obs
+from container_engine_accelerators_tpu.obs.grpc_client import (
+    CLIENT_RPC_HISTOGRAM,
+    traced_channel,
+)
+from container_engine_accelerators_tpu.plugin import api
+from tests.conftest import REPO_ROOT
+from tests.plugin_helpers import ServingManager, short_tmpdir
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    obs.TRACER.reset()
+    yield
+    obs.TRACER.reset()
+
+
+# -- wire format ------------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = (0x1234abcd5678, 0x9f)
+    value = obs.format_traceparent(ctx)
+    assert value == ("00-000000000000000000001234abcd5678-"
+                     "000000000000009f-01")
+    assert obs.parse_traceparent(value) == ctx
+
+
+def test_traceparent_rejects_malformed():
+    for bad in ("", "junk", "00-zz-ff-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace
+                "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # zero span
+                "01-" + "1" * 32 + "-" + "2" * 16):         # truncated
+        assert obs.parse_traceparent(bad) is None, bad
+    # Metadata without the key -> no context, never an error.
+    assert obs.context_from_metadata([("other", "x")]) is None
+    assert obs.context_from_metadata(None) is None
+
+
+def test_process_ids_are_collision_resistant():
+    # Two tracers (stand-ins for two processes) must not mint
+    # overlapping span ids — merged timelines rely on it.
+    a, b = obs.Tracer(enabled=True), obs.Tracer(enabled=True)
+    with a.span("x") as sa, b.span("y") as sb:
+        assert sa.span_id != sb.span_id
+        assert sa.trace_id != sb.trace_id
+
+
+def _make_manager(fake_node):
+    from container_engine_accelerators_tpu.chip import PyChipBackend
+    from container_engine_accelerators_tpu.plugin.manager import (
+        TpuManager,
+    )
+
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("1x2")
+    mgr = TpuManager(dev_dir=fake_node.dev_dir,
+                     state_dir=fake_node.state_dir,
+                     backend=PyChipBackend())
+    mgr.start()
+    return mgr
+
+
+# -- end-to-end over a real socket ------------------------------------
+
+def test_allocate_parents_under_caller_span(fake_node):
+    """The acceptance path: a span opened on the 'serving' side rides
+    gRPC metadata into the plugin server, whose rpc.*Allocate span
+    joins the caller's trace id and parents under the caller's span.
+    (Same-process here — the subprocess version below proves the
+    cross-process file story.)"""
+    mgr = _make_manager(fake_node)
+    with ServingManager(mgr, short_tmpdir()) as sm:
+        with sm.channel() as raw:
+            stub = api.DevicePluginV1Beta1Stub(traced_channel(raw))
+            with obs.span("serving.request", test=True) as req:
+                stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                    container_requests=[
+                        api.v1beta1_pb2.ContainerAllocateRequest(
+                            devicesIDs=["accel0"])]), timeout=5)
+                req_ctx = req.context()
+    spans = {s["name"]: s for s in obs.TRACER.snapshot()["spans"]}
+    client = spans["rpc.client.v1beta1.DevicePlugin/Allocate"]
+    server = spans["rpc.v1beta1.DevicePlugin/Allocate"]
+    # Client span parents under the request; server span parents
+    # under the CLIENT span (the injected context) — all one trace.
+    assert client["trace_id"] == req_ctx[0]
+    assert client["parent_id"] == req_ctx[1]
+    assert server["trace_id"] == req_ctx[0]
+    assert server["parent_id"] == client["span_id"]
+    # Client-observed latency histogram exists for the method.
+    hists = {(h.name, h.labels.get("method", ""))
+             for h in obs.TRACER.histograms()}
+    assert any(n == CLIENT_RPC_HISTOGRAM and m.endswith("Allocate")
+               for n, m in hists)
+
+
+def test_untraced_client_still_served(fake_node):
+    """No metadata -> fresh trace; old clients keep working."""
+    mgr = _make_manager(fake_node)
+    with ServingManager(mgr, short_tmpdir()) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel0"])]), timeout=5)
+    spans = [s for s in obs.TRACER.snapshot()["spans"]
+             if s["name"] == "rpc.v1beta1.DevicePlugin/Allocate"]
+    assert spans and spans[0]["parent_id"] is None
+
+
+def test_failed_rpc_closes_client_span_as_error(fake_node):
+    mgr = _make_manager(fake_node)
+    with ServingManager(mgr, short_tmpdir()) as sm:
+        with sm.channel() as raw:
+            stub = api.DevicePluginV1Beta1Stub(traced_channel(raw))
+            with pytest.raises(grpc.RpcError):
+                stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                    container_requests=[
+                        api.v1beta1_pb2.ContainerAllocateRequest(
+                            devicesIDs=["nope"])]), timeout=5)
+    spans = {s["name"]: s for s in obs.TRACER.snapshot()["spans"]}
+    client = spans["rpc.client.v1beta1.DevicePlugin/Allocate"]
+    assert client["status"] == "error"
+    assert not obs.TRACER.snapshot()["open_spans"]
+
+
+def test_serving_stats_plugin_query_propagates(fake_node):
+    """The production inject path: a serving server configured with
+    the plugin socket reports the plugin's device health in /stats,
+    and the plugin-side spans join the serving process's traces."""
+    import urllib.request
+
+    from container_engine_accelerators_tpu.serving import (
+        InferenceServer,
+    )
+
+    mgr = _make_manager(fake_node)
+    with ServingManager(mgr, short_tmpdir()) as sm:
+        srv = InferenceServer(
+            "m", lambda v, x, t: (x.sum(axis=(1, 2))[:, None], {}),
+            {"params": {}}, input_shape=(2, 2), port=0, max_batch=2,
+            max_wait_ms=1, plugin_socket=sm.socket_path())
+        srv.start()
+        try:
+            stats = json.load(urllib.request.urlopen(
+                f"http://localhost:{srv.port}/stats", timeout=30))
+            assert stats["plugin_devices"] == {"accel0": "Healthy",
+                                               "accel1": "Healthy"}
+        finally:
+            srv.stop()
+    spans = {s["name"]: s for s in obs.TRACER.snapshot()["spans"]}
+    query = spans["serving.plugin_query"]
+    opts = spans["rpc.v1beta1.DevicePlugin/GetDevicePluginOptions"]
+    assert opts["trace_id"] == query["trace_id"]
+
+
+# -- two real processes + merge ---------------------------------------
+
+_PLUGIN_PROC = textwrap.dedent("""
+    import json, os, sys, threading
+    sys.path.insert(0, {repo!r})
+    from container_engine_accelerators_tpu import obs
+    obs.set_role("plugin")
+    from container_engine_accelerators_tpu.chip import PyChipBackend
+    from container_engine_accelerators_tpu.plugin.manager import (
+        TpuManager,
+    )
+    mgr = TpuManager(dev_dir={dev!r}, state_dir={state!r},
+                     backend=PyChipBackend())
+    mgr.start()
+    t = threading.Thread(
+        target=mgr.serve, args=({plugin_dir!r}, "kubelet.sock", "tpu"),
+        daemon=True)
+    t.start()
+    assert mgr.wait_until_serving(10)
+    print("READY", flush=True)
+    sys.stdin.readline()  # parent closes stdin -> shut down
+    mgr.stop()
+    t.join(timeout=10)
+""")
+
+_CLIENT_PROC = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import grpc
+    from container_engine_accelerators_tpu import obs
+    obs.set_role("serving")
+    from container_engine_accelerators_tpu.obs.grpc_client import (
+        traced_channel,
+    )
+    from container_engine_accelerators_tpu.plugin import api
+    with grpc.insecure_channel("unix://" + {sock!r}) as raw:
+        stub = api.DevicePluginV1Beta1Stub(traced_channel(raw))
+        with obs.span("serving.request", origin="client-proc") as sp:
+            stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel0"])]), timeout=10)
+            print(obs.format_traceparent(sp.context()), flush=True)
+""")
+
+
+def test_cross_process_journals_merge(fake_node, tmp_path):
+    """The full acceptance criterion, with two REAL processes: the
+    client process's span context propagates into the plugin
+    process's journal, and trace_dump --merge of the two journal
+    files yields one Perfetto file with both processes on distinct
+    named tracks."""
+    for i in range(2):
+        fake_node.add_chip(i)
+    fake_node.set_topology("1x2")
+    plugin_dir = short_tmpdir()
+    plugin_journal = tmp_path / "plugin_journal.json"
+    client_journal = tmp_path / "client_journal.json"
+
+    env_base = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    env_base.pop("CEA_TPU_TRACE_FILE", None)
+    plugin = subprocess.Popen(
+        [sys.executable, "-c", _PLUGIN_PROC.format(
+            repo=REPO_ROOT, dev=fake_node.dev_dir,
+            state=fake_node.state_dir, plugin_dir=plugin_dir)],
+        env=dict(env_base, CEA_TPU_TRACE_FILE=str(plugin_journal)),
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        cwd=REPO_ROOT)
+    try:
+        assert plugin.stdout.readline().strip() == "READY"
+        socks = [f for f in os.listdir(plugin_dir)
+                 if f.startswith("tpu-") and f.endswith(".sock")]
+        assert len(socks) == 1
+        sock = os.path.join(plugin_dir, socks[0])
+
+        client = subprocess.run(
+            [sys.executable, "-c", _CLIENT_PROC.format(
+                repo=REPO_ROOT, sock=sock)],
+            env=dict(env_base, CEA_TPU_TRACE_FILE=str(client_journal)),
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO_ROOT)
+        assert client.returncode == 0, client.stderr[-2000:]
+        caller_trace, caller_span = obs.parse_traceparent(
+            client.stdout.strip().splitlines()[-1])
+    finally:
+        try:
+            plugin.stdin.close()
+            plugin.wait(timeout=15)
+        except Exception:
+            plugin.kill()
+            raise
+    assert plugin.returncode == 0
+
+    # The plugin journal's Allocate span is parented under the
+    # CALLER's trace/span ids — ids minted in a different process.
+    plug = json.loads(plugin_journal.read_text())
+    assert plug["identity"]["role"] == "plugin"
+    rpc = [s for s in plug["spans"]
+           if s["name"] == "rpc.v1beta1.DevicePlugin/Allocate"]
+    assert rpc, [s["name"] for s in plug["spans"]]
+    assert rpc[0]["trace_id"] == caller_trace
+    cli = json.loads(client_journal.read_text())
+    assert cli["identity"]["role"] == "serving"
+    client_rpc_span = [
+        s for s in cli["spans"]
+        if s["name"] == "rpc.client.v1beta1.DevicePlugin/Allocate"]
+    assert rpc[0]["parent_id"] == client_rpc_span[0]["span_id"]
+    assert rpc[0]["parent_id"] != caller_span  # via the client span
+
+    # trace_dump --merge: one Perfetto file, two named process tracks.
+    spec = importlib.util.spec_from_file_location(
+        "trace_dump", os.path.join(REPO_ROOT, "tools",
+                                   "trace_dump.py"))
+    trace_dump = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_dump)
+    out = tmp_path / "merged.perfetto.json"
+    rc = trace_dump.main(["--merge", str(client_journal),
+                          str(plugin_journal), "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    by_name = {}
+    for ev in doc["traceEvents"]:
+        by_name.setdefault(ev["name"], []).append(ev)
+    req = by_name["serving.request"][0]
+    alloc = by_name["rpc.v1beta1.DevicePlugin/Allocate"][0]
+    assert req["pid"] != alloc["pid"]  # distinct process tracks
+    assert (req["args"]["trace_id"] == alloc["args"]["trace_id"]
+            == caller_trace)
+    labels = {ev["args"]["name"]
+              for ev in by_name.get("process_name", [])}
+    assert any(lbl.startswith("serving@") for lbl in labels), labels
+    assert any(lbl.startswith("plugin@") for lbl in labels), labels

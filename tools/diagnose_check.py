@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Flight-recorder guard (the `make diagnose-check` preflight).
+
+Boots the fake-chip plugin end to end (PyChipBackend + manager.serve
++ MetricServer, same scaffold as trace_check.py), drives one Allocate
+through the real gRPC surface, writes a second process's journal (a
+child python with CEA_TPU_TRACE_FILE, playing the serving replica),
+then runs tools/tpu_diagnose.py against the live metrics port + that
+journal and fails unless the bundle carries:
+
+  - a NON-EMPTY merged Perfetto trace with BOTH processes present
+    (distinct pids — the flight recorder's whole point is the
+    cross-process timeline),
+  - an ok /debug/varz snapshot with the RPC latency histogram,
+  - the fake node's device state (chips + topology).
+
+Pure CPU, no jax, a few seconds: cheap enough to run before every
+suite next to trace-check. Exit 0 = clean, 1 = check failed,
+2 = harness error.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ["CEA_TPU_TRACE"] = "1"  # the guard asserts spans exist
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+
+obs.set_role("plugin")
+
+from container_engine_accelerators_tpu.chip import (  # noqa: E402
+    PyChipBackend,
+)
+from container_engine_accelerators_tpu.plugin import api  # noqa: E402
+from container_engine_accelerators_tpu.plugin.manager import (  # noqa: E402
+    TpuManager,
+)
+from container_engine_accelerators_tpu.plugin.metrics import (  # noqa: E402
+    MetricServer,
+)
+
+import grpc  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD_JOURNAL_CODE = (
+    "from container_engine_accelerators_tpu import obs\n"
+    "obs.set_role('serving')\n"
+    "with obs.span('serving.request', synthetic=True):\n"
+    "    obs.event('serving.mark', ok=True)\n")
+
+
+def fake_node(root):
+    dev = os.path.join(root, "dev")
+    state = os.path.join(root, "state")
+    os.makedirs(dev)
+    os.makedirs(state)
+    for i in range(4):
+        open(os.path.join(dev, f"accel{i}"), "w").close()
+        os.makedirs(os.path.join(state, f"accel{i}"))
+    with open(os.path.join(state, "topology"), "w") as f:
+        f.write("2x2")
+    return dev, state
+
+
+def main():
+    failures = []
+    root = tempfile.mkdtemp(prefix="tpu-diagnose-check")
+    plugin_dir = tempfile.mkdtemp(prefix="tpu")  # short: unix socket
+    dev, state = fake_node(root)
+    backend = PyChipBackend()
+    manager = TpuManager(dev_dir=dev, state_dir=state, backend=backend)
+    manager.start()
+    serve_thread = threading.Thread(
+        target=manager.serve, args=(plugin_dir, "kubelet.sock", "tpu"),
+        daemon=True)
+    serve_thread.start()
+    if not manager.wait_until_serving(10):
+        print("diagnose-check: plugin never started serving",
+              file=sys.stderr)
+        return 2
+    metrics = MetricServer(manager, backend, port=0)
+    metrics.start()
+    try:
+        socks = [f for f in os.listdir(plugin_dir)
+                 if f.startswith("tpu-") and f.endswith(".sock")]
+        with grpc.insecure_channel(
+                f"unix://{os.path.join(plugin_dir, socks[0])}") as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            stub.Allocate(api.v1beta1_pb2.AllocateRequest(
+                container_requests=[
+                    api.v1beta1_pb2.ContainerAllocateRequest(
+                        devicesIDs=["accel0"])]), timeout=10)
+
+        # A second process's journal: the serving-replica stand-in.
+        journal = os.path.join(root, "serving_journal.json")
+        env = dict(os.environ, CEA_TPU_TRACE_FILE=journal,
+                   PYTHONPATH=REPO_ROOT)
+        child = subprocess.run(
+            [sys.executable, "-c", _CHILD_JOURNAL_CODE], env=env,
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO_ROOT)
+        if child.returncode != 0 or not os.path.exists(journal):
+            print("diagnose-check: child journal write failed:\n"
+                  + child.stderr[-2000:], file=sys.stderr)
+            return 2
+
+        bundle_path = os.path.join(root, "bundle.json")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "tpu_diagnose.py"),
+             "--no-default-urls",
+             "--url", f"http://localhost:{metrics.port}",
+             "--journal", journal,
+             "--dev-dir", dev, "--state-dir", state,
+             "--out", bundle_path],
+            capture_output=True, text=True, timeout=120,
+            cwd=REPO_ROOT)
+        if proc.returncode != 0:
+            print("diagnose-check: tpu_diagnose crashed:\n"
+                  + proc.stderr[-2000:], file=sys.stderr)
+            return 2
+        with open(bundle_path) as f:
+            bundle = json.load(f)
+
+        merged = bundle.get("merged_trace") or {}
+        events = merged.get("traceEvents") or []
+        if not events:
+            failures.append("merged trace is empty")
+        pids = {e.get("pid") for e in events}
+        if len(pids) < 2:
+            failures.append(
+                f"merged trace has {len(pids)} process track(s); "
+                f"want >= 2 (plugin + journal)")
+        if not any(e.get("name", "").endswith("Allocate")
+                   for e in events):
+            failures.append("no Allocate span in the merged trace")
+        if not any(e.get("name") == "serving.request"
+                   for e in events):
+            failures.append("journal's serving.request span missing "
+                            "from the merged trace")
+        (base, legs), = bundle.get("endpoints", {}).items()
+        if not legs["varz"]["ok"]:
+            failures.append(f"varz leg failed for {base}")
+        else:
+            hists = legs["varz"]["payload"].get("histograms", {})
+            if not any("tpu_plugin_rpc_latency_seconds" in k
+                       for k in hists):
+                failures.append("RPC latency histogram missing from "
+                                "the varz snapshot")
+        chips = bundle.get("device_state", {}).get("chips", {})
+        if len(chips) != 4:
+            failures.append(f"device state has {len(chips)} chips; "
+                            f"want 4")
+        if bundle.get("device_state", {}).get("topology") != "2x2":
+            failures.append("device state topology missing")
+    finally:
+        metrics.stop()
+        manager.stop()
+        serve_thread.join(timeout=10)
+
+    print(json.dumps({"failures": failures}))
+    if failures:
+        for f in failures:
+            print(f"diagnose-check FAILED: {f}", file=sys.stderr)
+        return 1
+    print("diagnose-check: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,15 @@ timeout -k 10 120 python tools/trace_check.py \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "trace-check preflight"
 
+# Flight-recorder preflight, same contract: fake-chip plugin + a
+# second journal swept by tpu_diagnose.py; fails on an empty merged
+# trace or missing varz/device state. A broken bundle collector
+# means postmortems of THIS suite's failures collect nothing.
+echo "[suite] diagnose-check preflight" >&2
+timeout -k 10 120 python tools/diagnose_check.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "diagnose-check preflight"
+
 # ---------------------------------------------------------------------
 # 1. Serving bench — the stalest artifact: no warmed capture has ever
 #    landed (the committed SERVING_BENCH.json predates round 3's

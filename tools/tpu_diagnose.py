@@ -1,0 +1,233 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Flight-recorder sweep: one diagnostics bundle from a live node.
+
+Collects, into a single JSON file an operator can attach to an
+incident:
+
+  - every reachable local /debug/trace, /debug/varz and /metrics
+    surface (the plugin MetricServer and any serving replicas —
+    pass extra --url for non-default ports);
+  - any CEA_TPU_TRACE_FILE journals already on disk (--journal),
+    including postmortem captures from processes that died;
+  - ONE merged Perfetto timeline over all of the above — every
+    process on its own named track, cross-process spans joined by
+    the propagated trace ids;
+  - device/slice state: accel nodes in --dev-dir, topology and
+    per-chip leaf files from --state-dir;
+  - a fleet straggler scan over all collected ``train.step_summary``
+    events (obs.straggler.scan_events).
+
+Endpoint failures are recorded in place (a structured error per
+surface), never raised: on a half-dead node the partial bundle IS the
+deliverable. Exit 0 whenever the bundle was written; non-zero only on
+tool crash. ``make diagnose-check`` (tools/diagnose_check.py) guards
+the non-empty-merged-trace + varz contract against a fake-chip
+plugin.
+
+Usage:
+  python tools/tpu_diagnose.py                       # default :2112
+  python tools/tpu_diagnose.py --url http://localhost:8500 \\
+      --journal /tmp/train_trace.json --out bundle.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.obs.straggler import (  # noqa: E402
+    scan_events,
+)
+from container_engine_accelerators_tpu.utils.provenance import (  # noqa: E402
+    stamp,
+)
+
+DEFAULT_URLS = ("http://localhost:2112",)
+FETCH_TIMEOUT_S = 5
+
+
+def _fetch(url, json_body=True):
+    """One endpoint leg; structured outcome, never a raise."""
+    try:
+        with urllib.request.urlopen(url,
+                                    timeout=FETCH_TIMEOUT_S) as resp:
+            body = resp.read()
+        return {"ok": True,
+                "payload": (json.loads(body) if json_body
+                            else body.decode(errors="replace"))}
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:
+        return {"ok": False, "error_type": type(e).__name__,
+                "error": str(e)[:300]}
+
+
+def sweep_endpoints(urls):
+    """{base_url: {trace, varz, metrics}} over every candidate."""
+    out = {}
+    for base in urls:
+        base = base.rstrip("/")
+        out[base] = {
+            "trace": _fetch(base + obs.TRACE_PATH),
+            "varz": _fetch(base + obs.VARZ_PATH),
+            "metrics": _fetch(base + "/metrics", json_body=False),
+        }
+    return out
+
+
+def load_journals(paths):
+    """{path: journal-or-error} for on-disk trace files (atexit or
+    postmortem captures)."""
+    out = {}
+    for path in paths:
+        try:
+            with open(path) as f:
+                out[path] = {"ok": True, "payload": json.load(f)}
+        except (OSError, ValueError) as e:
+            out[path] = {"ok": False,
+                         "error_type": type(e).__name__,
+                         "error": str(e)[:300]}
+    return out
+
+
+def device_state(dev_dir, state_dir):
+    """Local device/slice view: accel nodes + the chip state files
+    the PyChipBackend/libtpuinfo contract reads."""
+    state = {"dev_dir": dev_dir, "state_dir": state_dir}
+    try:
+        state["accel_nodes"] = sorted(
+            n for n in os.listdir(dev_dir) if n.startswith("accel"))
+    except OSError as e:
+        state["accel_nodes"] = []
+        state["dev_error"] = str(e)[:200]
+    chips = {}
+    try:
+        topo = os.path.join(state_dir, "topology")
+        if os.path.exists(topo):
+            with open(topo) as f:
+                state["topology"] = f.read().strip()
+        for entry in sorted(os.listdir(state_dir)):
+            leaf_dir = os.path.join(state_dir, entry)
+            if not (entry.startswith("accel")
+                    and os.path.isdir(leaf_dir)):
+                continue
+            leaves = {}
+            for leaf in sorted(os.listdir(leaf_dir)):
+                try:
+                    with open(os.path.join(leaf_dir, leaf)) as f:
+                        leaves[leaf] = f.read().strip()[:500]
+                except OSError as e:
+                    leaves[leaf] = f"<unreadable: {e}>"
+            chips[entry] = leaves
+    except OSError as e:
+        state["state_error"] = str(e)[:200]
+    state["chips"] = chips
+    return state
+
+
+def collect(urls, journal_paths, dev_dir, state_dir):
+    endpoints = sweep_endpoints(urls)
+    journals = load_journals(journal_paths)
+
+    snapshots = []
+    for base, legs in endpoints.items():
+        if legs["trace"]["ok"]:
+            snapshots.append(legs["trace"]["payload"])
+    for path, leg in journals.items():
+        if leg["ok"]:
+            snapshots.append(leg["payload"])
+
+    merged = obs.merge_perfetto(snapshots) if snapshots else None
+
+    all_events = [e for snap in snapshots
+                  for e in snap.get("events", [])]
+    det = scan_events(all_events, tracer=obs.Tracer(enabled=False))
+    straggler = {
+        "step_summary_events": sum(
+            1 for e in all_events
+            if e.get("name") == "train.step_summary"),
+        "skews": {h: round(r, 4) for h, r in det.skews().items()},
+        "flagged": det.flagged(),
+    }
+
+    return {
+        "metric": "tpu_diagnose_bundle",
+        "collected_unix": time.time(),
+        "collector_identity": obs.identity(),
+        "endpoints": endpoints,
+        "journals": journals,
+        "merged_trace": merged,
+        "merged_processes": len(snapshots),
+        "device_state": device_state(dev_dir, state_dir),
+        "straggler_scan": straggler,
+        "provenance": stamp(
+            devices=["host (diagnostics sweep; reads debug "
+                     "endpoints and state files only)"]),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--url", action="append", default=[],
+                   help="extra base URLs whose /debug/trace, "
+                        "/debug/varz and /metrics to sweep "
+                        "(default: localhost:2112)")
+    p.add_argument("--no-default-urls", action="store_true",
+                   help="sweep only the --url endpoints")
+    p.add_argument("--journal", action="append", default=[],
+                   help="CEA_TPU_TRACE_FILE journal files to fold "
+                        "into the merged timeline")
+    p.add_argument("--dev-dir", default="/dev")
+    p.add_argument("--state-dir", default="/run/tpu")
+    p.add_argument("--out", default="tpu_diagnose.json")
+    args = p.parse_args(argv)
+
+    urls = list(dict.fromkeys(
+        ([] if args.no_default_urls else list(DEFAULT_URLS))
+        + args.url))
+    bundle = collect(urls, args.journal, args.dev_dir, args.state_dir)
+
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(bundle, f, indent=1, default=repr)
+        f.write("\n")
+    os.replace(tmp, args.out)
+
+    merged = bundle["merged_trace"] or {}
+    print(json.dumps({
+        "wrote": args.out,
+        "endpoints_ok": {base: legs["trace"]["ok"]
+                         for base, legs in
+                         bundle["endpoints"].items()},
+        "journals_ok": {path: leg["ok"]
+                        for path, leg in bundle["journals"].items()},
+        "merged_processes": bundle["merged_processes"],
+        "merged_trace_events": len(merged.get("traceEvents", [])),
+        "straggler_flagged": bundle["straggler_scan"]["flagged"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

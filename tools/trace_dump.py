@@ -14,7 +14,7 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Pull a trace journal and emit Perfetto-loadable JSON.
+"""Pull trace journal(s) and emit Perfetto-loadable JSON.
 
 Sources (first match wins):
   --url http://host:port       GETs <url>/debug/trace from a live
@@ -23,15 +23,28 @@ Sources (first match wins):
   --file PATH                  reads a journal file written at exit
                                via CEA_TPU_TRACE_FILE (or a saved
                                /debug/trace body)
+  --merge A B [C...]           reads SEVERAL journal files/URLs and
+                               merges them into ONE timeline: each
+                               journal's (host, pid, role) identity
+                               stamp becomes its own named Perfetto
+                               process track, and spans parented
+                               across processes via gRPC traceparent
+                               propagation share trace ids in their
+                               args. Entries starting with http(s)://
+                               are fetched live; anything else is a
+                               file path.
 
 Output is Chrome/Perfetto ``trace_event`` JSON on --out (default
 trace.perfetto.json): open it at https://ui.perfetto.dev or
-chrome://tracing. Pass --raw to emit the journal snapshot unconverted
-(spans/events with ids intact) for programmatic consumers.
+chrome://tracing. Pass --raw to emit the journal snapshot(s)
+unconverted (spans/events with ids intact) for programmatic
+consumers; with --merge, --raw emits {"journals": [...]}.
 
 Usage:
   python tools/trace_dump.py --url http://localhost:2112
   python tools/trace_dump.py --file /tmp/plugin_trace.json --raw
+  python tools/trace_dump.py --merge serving.json plugin.json \\
+      --out cross_process.perfetto.json
 """
 
 import argparse
@@ -45,6 +58,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from container_engine_accelerators_tpu.obs import (  # noqa: E402
     TRACE_PATH,
+    merge_perfetto,
     perfetto_trace,
 )
 
@@ -58,6 +72,13 @@ def load_snapshot(url=None, path=None, timeout=10):
         return json.load(f), path
 
 
+def load_source(source, timeout=10):
+    """One --merge operand: URL when it looks like one, else a file."""
+    if source.startswith(("http://", "https://")):
+        return load_snapshot(url=source, timeout=timeout)
+    return load_snapshot(path=source, timeout=timeout)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description=__doc__.split("\n")[0])
@@ -68,6 +89,9 @@ def main(argv=None):
     src.add_argument("--file",
                      help="journal file written via "
                           "CEA_TPU_TRACE_FILE")
+    src.add_argument("--merge", nargs="+", metavar="SRC",
+                     help="merge several journals (files or base "
+                          "URLs) into one multi-process timeline")
     p.add_argument("--out", default="trace.perfetto.json")
     p.add_argument("--raw", action="store_true",
                    help="emit the journal snapshot as-is instead of "
@@ -75,20 +99,34 @@ def main(argv=None):
     p.add_argument("--timeout", type=float, default=10)
     args = p.parse_args(argv)
 
+    snapshots, sources = [], []
     try:
-        snapshot, source = load_snapshot(args.url, args.file,
+        if args.merge:
+            for src_arg in args.merge:
+                snap, source = load_source(src_arg, args.timeout)
+                snapshots.append(snap)
+                sources.append(source)
+        else:
+            snap, source = load_snapshot(args.url, args.file,
                                          args.timeout)
+            snapshots.append(snap)
+            sources.append(source)
     except (OSError, ValueError) as e:
-        print(f"error: could not load trace from "
-              f"{args.url or args.file}: {e}", file=sys.stderr)
+        failed = args.url or args.file or "/".join(args.merge or [])
+        print(f"error: could not load trace from {failed}: {e}",
+              file=sys.stderr)
         return 1
 
-    spans = len(snapshot.get("spans", []))
-    events = len(snapshot.get("events", []))
-    if args.raw:
-        payload = snapshot
+    spans = sum(len(s.get("spans", [])) for s in snapshots)
+    open_spans = sum(len(s.get("open_spans", [])) for s in snapshots)
+    events = sum(len(s.get("events", [])) for s in snapshots)
+    if args.merge:
+        payload = ({"journals": snapshots} if args.raw
+                   else merge_perfetto(snapshots))
+    elif args.raw:
+        payload = snapshots[0]
     else:
-        payload = perfetto_trace(snapshot)
+        payload = perfetto_trace(snapshots[0])
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1)
@@ -96,9 +134,10 @@ def main(argv=None):
     os.replace(tmp, args.out)
     print(json.dumps({
         "wrote": args.out,
-        "source": source,
+        "source": sources if args.merge else sources[0],
+        "processes": len(snapshots),
         "spans": spans,
-        "open_spans": len(snapshot.get("open_spans", [])),
+        "open_spans": open_spans,
         "events": events,
         "format": "journal" if args.raw else "trace_event",
     }))
