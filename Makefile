@@ -48,6 +48,14 @@ trace-check:
 diagnose-check:
 	python3 tools/diagnose_check.py
 
+# Continuous-batching regression guard: replay one Poisson arrival
+# trace through the slot engine (real decode, CPU fake backend) and
+# the pre-engine sequential-batch policy; fail unless engine goodput
+# is >= 2x the baseline on the same trace AND every greedy output is
+# bit-identical to per-request decode(). Pure CPU, ~1 min.
+occupancy-check:
+	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py --check
+
 bench:
 	python3 bench.py
 
@@ -72,4 +80,5 @@ clean:
 	$(MAKE) -C demo/tpu-error clean
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
-	trace-check diagnose-check container partition-tpu push clean
+	trace-check diagnose-check occupancy-check container \
+	partition-tpu push clean

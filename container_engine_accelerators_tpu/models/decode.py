@@ -913,6 +913,334 @@ def _beam_impl(model, params, prompt, max_new_tokens, eos_id, alpha,
     return full, (eff if use_lp else scores)
 
 
+# ---------------------------------------------------------------------
+# Continuous-batching slot engine
+# ---------------------------------------------------------------------
+#
+# The serving hot path above runs WHOLE batches to completion: a row
+# that finishes early keeps burning a program row as EOS padding, and
+# a request that arrives mid-batch waits a full horizon. The slot
+# engine decodes a persistent pool of `slots` KV-cache rows with ONE
+# jitted single-token step over all of them; at every step boundary
+# the caller retires finished rows and prefills queued requests into
+# the freed slots (serving/server.py drives the loop). Static shapes
+# throughout: the step is always a [slots, 1] program against a
+# [slots, slot_len] cache, admission is a per-bucket [1, bucket]
+# prefill program plus one scatter-insert program, and every sampling
+# knob (temperature / top_k / top_p / min_p / repetition penalty)
+# rides as a per-row TRACED vector — mixed greedy/sampling/filtered
+# configs share the one compiled step program, so the program count
+# is buckets + 2 regardless of traffic mix.
+#
+# Exactness: a slot's token stream is the per-request decode()
+# stream. Admission prefill is the same one-shot chunk forward
+# fast_prefill uses (token-for-token equal to stepwise, pinned by
+# test_decode); after insert the slot's per-row cache index rewinds
+# to its true prompt length, so a right-padded row's generation
+# overwrites its padding exactly like decode(prompt_len=...), and the
+# per-row attention mask (transformer.py per_row_index) keeps junk
+# beyond each row's own position invisible.
+
+
+def _with_row_index(cache, row_pos):
+    """Inject the engine's per-row positions into every index leaf.
+
+    The per-row cache tree holds [slots]-shaped cache_index/pos_index
+    counters (the only ndim-1 leaves; KV buffers and int8 scales are
+    ndim >= 2). The engine owns row positions — the module's own
+    increments are overwritten here every step, which is what lets
+    retire/admit rewind a single row without touching the others."""
+    return jax.tree_util.tree_map(
+        lambda a: row_pos if a.ndim == 1 else a, cache)
+
+
+def _mask_top_k_rows(logits, top_k):
+    """Per-row top-k as a TRACED [B] int vector (0 = off): full sort
+    + per-row k-th gather instead of lax.top_k — k is data here, not
+    shape, so one compiled program serves any mix of k values."""
+    desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        desc, jnp.clip(top_k - 1, 0, logits.shape[-1] - 1)[:, None],
+        axis=1)
+    return jnp.where((top_k[:, None] > 0) & (logits < kth),
+                     -jnp.inf, logits)
+
+
+def _slot_sample(raw, seen, temps, top_ks, top_ps, min_ps, rep_pens,
+                 rngs):
+    """The engine's per-row sampling chain: every knob a [B] vector,
+    greedy rows (temp == 0) take argmax — one program for any mix.
+
+    Greedy parity with decode(): penalty applies to raw logits first
+    (1.0 rows are exact no-ops), argmax runs on the penalized logits,
+    and the returned logprob scores the chosen token under the RAW
+    logits (decode's scoring quantity). The sort-bearing filters only
+    execute when some row needs them (lax.cond), so all-default
+    traffic never pays the vocab sort. Returns
+    (token [B] i32, logprob [B] f32, advanced rngs [B, 2])."""
+    logits = _apply_repetition_penalty(raw, seen, rep_pens)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    def filtered(l):
+        l = _mask_top_k_rows(l, top_ks)
+        l = _mask_top_p(l, top_ps)
+        return _mask_min_p(l, min_ps)
+
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    need_filters = jnp.any((temps > 0.0)
+                           & ((top_ks > 0) | (top_ps < 1.0)
+                              | (min_ps > 0.0)))
+    scaled = jax.lax.cond(need_filters, filtered, lambda l: l, scaled)
+    split = jax.vmap(jax.random.split)(rngs)         # [B, 2, 2]
+    new_rngs, subs = split[:, 0], split[:, 1]
+    sampled = jax.vmap(
+        lambda key, l: jax.random.categorical(key, l))(subs, scaled)
+    tok = jnp.where(temps > 0.0, sampled, greedy_tok).astype(jnp.int32)
+    lsm = jax.nn.log_softmax(raw.astype(jnp.float32), axis=-1)
+    lp = jnp.take_along_axis(lsm, tok[:, None], axis=1)[:, 0]
+    return tok, lp, new_rngs
+
+
+@functools.partial(jax.jit, static_argnames=("model", "slot_len"))
+def _slot_prefill_impl(model, params, row, prompt_len, temperature,
+                       top_k, top_p, min_p, rep_pen, rng, *,
+                       slot_len):
+    """Admission prefill: ONE chunk forward of the bucket-padded row
+    into a fresh batch-1 cache sized slot_len (the same chunked-flash
+    path fast_prefill rides), first token sampled from the logits at
+    prompt_len - 1, echo logprobs for the prompt for free. Padding
+    positions' K/V are junk the insert rewind makes unreachable.
+
+    One compiled program per bucket width. Returns
+    (cache, first [1], first_lp [1], echo_lps [bucket],
+    seen_row [V] bool, rng [2])."""
+    decode_model, cache = init_cache(model, 1, slot_len)
+    outputs, updated = decode_model.apply(
+        {"params": params, "cache": cache}, row,
+        train=False, mutable=["cache"])
+    logits = _logits_of(outputs)[0]                  # [bucket, V]
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    echo = jnp.concatenate([
+        jnp.zeros((1,), jnp.float32),
+        jnp.take_along_axis(lsm[:-1], row[0, 1:, None].astype(
+            jnp.int32), axis=1)[:, 0]])
+    # Seen-token mask for the repetition penalty: the TRUE prompt
+    # only — right-padding must not mark token 0 (OOB-index scatter
+    # with mode="drop" skips the masked rows).
+    vocab = logits.shape[-1]
+    valid = jnp.arange(row.shape[1]) < prompt_len
+    seen_row = jnp.zeros((vocab,), bool).at[
+        jnp.where(valid, row[0], vocab)].set(True, mode="drop")
+    last = jax.lax.dynamic_index_in_dim(
+        logits, jnp.maximum(prompt_len - 1, 0), 0, keepdims=False)
+    first, first_lp, rng = _slot_sample(
+        last[None], seen_row[None], temperature[None], top_k[None],
+        top_p[None], min_p[None], rep_pen[None], rng[None])
+    seen_row = seen_row.at[first[0]].set(True)
+    return (updated["cache"], first, first_lp, echo, seen_row,
+            rng[0])
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _slot_insert_impl(cache, row_pos, seen, rngs, pre_cache, slot,
+                      prompt_len, seen_row, rng_row):
+    """Scatter a batch-1 prefilled cache into pool row ``slot`` and
+    rewind that row's position to its true prompt length (generation
+    then overwrites the padding region, decode(prompt_len=...)
+    semantics). Index leaves are skipped — the engine injects row
+    positions afresh every step. One compiled program total (slot and
+    prompt_len are traced)."""
+    cache = jax.tree_util.tree_map(
+        lambda eng, pre: (eng.at[slot].set(pre[0])
+                          if pre.ndim >= 2 else eng),
+        cache, pre_cache)
+    return (cache, row_pos.at[slot].set(prompt_len),
+            seen.at[slot].set(seen_row), rngs.at[slot].set(rng_row))
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnums=(2, 3, 4, 5))
+def _slot_step_impl(model, params, cache, row_pos, seen, rngs, tok,
+                    active, temps, top_ks, top_ps, min_ps, rep_pens):
+    """ONE decode step over every slot: feed each row's last token at
+    its own position, sample each row's next under its own knobs.
+    Free rows step too (static shapes) — their position is clamped
+    in-range, does not advance, and their output is ignored; their
+    writes land on their own junk, invisible to every other row
+    through the per-row mask."""
+    slot_len = next(leaf for leaf in jax.tree_util.tree_leaves(cache)
+                    if leaf.ndim >= 2).shape[1]
+    pos = jnp.minimum(row_pos, slot_len - 1)
+    outputs, updated = model.apply(
+        {"params": params, "cache": _with_row_index(cache, pos)},
+        tok[:, None], train=False, mutable=["cache"])
+    raw = _logits_of(outputs)[:, 0]
+    nxt, lp, rngs = _slot_sample(raw, seen, temps, top_ks, top_ps,
+                                 min_ps, rep_pens, rngs)
+    seen = seen.at[jnp.arange(nxt.shape[0]), nxt].set(True)
+    return (updated["cache"], row_pos + active.astype(jnp.int32),
+            seen, rngs, nxt, lp)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "slots",
+                                             "slot_len"))
+def _slot_cache_init(model, slots, slot_len):
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((slots, slot_len),
+                                         jnp.int32), train=False)
+    return variables["cache"]
+
+
+class SlotDecodeEngine:
+    """Persistent decode slot pool with in-flight admission.
+
+    The device-side half of continuous batching: ``admit`` prefills a
+    request into a free slot (and hands back its first token),
+    ``step`` advances every slot one token, ``release`` frees a slot
+    for the next admission — retirement policy (EOS, budgets,
+    cancellation) belongs to the caller, which sees every token at
+    every step boundary. All engine methods must be called from ONE
+    thread (the serving engine loop); the pool state is deliberately
+    unsynchronized.
+
+    Requires a dense KV cache (``attention_window == 0``): a reused
+    ring slot's stale position metadata could leak stale keys into a
+    rewound row's window, so windowed models stay on the batch path.
+    """
+
+    def __init__(self, model, params, slots, slot_len):
+        if getattr(model, "attention_window", 0):
+            raise ValueError(
+                "SlotDecodeEngine requires a dense cache "
+                "(attention_window=0); windowed models use the "
+                "run-to-completion batch path")
+        if slot_len > model.max_seq_len:
+            raise ValueError(
+                f"slot_len {slot_len} exceeds max_seq_len "
+                f"{model.max_seq_len}")
+        if slots < 1 or slot_len < 2:
+            raise ValueError("need slots >= 1 and slot_len >= 2")
+        self._base_model = model
+        self._params = params
+        self._step_model = _decode_clone(model).clone(
+            per_row_index=True)
+        self.slots = int(slots)
+        self.slot_len = int(slot_len)
+        self._cache = _slot_cache_init(self._step_model, self.slots,
+                                       self.slot_len)
+        self._row_pos = jnp.zeros((self.slots,), jnp.int32)
+        self._seen = jnp.zeros((self.slots, model.vocab_size), bool)
+        self._rngs = jnp.stack(
+            [jax.random.PRNGKey(i) for i in range(self.slots)])
+        self._tok = np.zeros((self.slots,), np.int32)
+        self._active = np.zeros((self.slots,), bool)
+        self._temps = np.zeros((self.slots,), np.float32)
+        self._top_ks = np.zeros((self.slots,), np.int32)
+        self._top_ps = np.ones((self.slots,), np.float32)
+        self._min_ps = np.zeros((self.slots,), np.float32)
+        self._rep_pens = np.ones((self.slots,), np.float32)
+        self.steps = 0          # step() calls (device programs run)
+        self.row_steps = 0      # sum of active slots over steps
+        self.prefills = 0
+
+    def free_slots(self):
+        return int((~self._active).sum())
+
+    def active_count(self):
+        return int(self._active.sum())
+
+    def occupancy_avg(self):
+        return self.row_steps / self.steps if self.steps else None
+
+    def _prefill(self, tokens, prompt_len, temperature, top_k, top_p,
+                 min_p, repetition_penalty, seed):
+        row = jnp.asarray(tokens, jnp.int32)[None, :]
+        self.prefills += 1
+        return _slot_prefill_impl(
+            self._base_model, self._params, row,
+            jnp.asarray(prompt_len, jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(min_p, jnp.float32),
+            jnp.asarray(repetition_penalty, jnp.float32),
+            jax.random.PRNGKey(seed), slot_len=self.slot_len)
+
+    def score(self, tokens, prompt_len):
+        """Prompt echo logprobs only (the max_new_tokens=0 scoring
+        mode): rides the same per-bucket prefill program, consumes no
+        slot. Returns a [len(tokens)] f32 array (entry 0 = 0.0);
+        entries at and beyond prompt_len are padding scratch."""
+        _, _, _, echo, _, _ = self._prefill(
+            tokens, prompt_len, 0.0, 0, 1.0, 0.0, 1.0, 0)
+        return np.asarray(echo)
+
+    def admit(self, tokens, prompt_len, *, temperature=0.0, top_k=0,
+              top_p=1.0, min_p=0.0, repetition_penalty=1.0, seed=0):
+        """Prefill ``tokens`` (a bucket-padded [width] int row with
+        ``prompt_len`` true tokens) into a free slot. Returns
+        (slot, first_token, first_logprob, echo_logprobs). The first
+        generated token is produced HERE — the next ``step`` yields
+        the second."""
+        free = np.flatnonzero(~self._active)
+        if free.size == 0:
+            raise RuntimeError("no free slot; release one first")
+        slot = int(free[0])
+        pre_cache, first, first_lp, echo, seen_row, rng_row = (
+            self._prefill(tokens, prompt_len, temperature, top_k,
+                          top_p, min_p, repetition_penalty, seed))
+        self._cache, self._row_pos, self._seen, self._rngs = (
+            _slot_insert_impl(self._cache, self._row_pos, self._seen,
+                              self._rngs, pre_cache,
+                              jnp.asarray(slot, jnp.int32),
+                              jnp.asarray(prompt_len, jnp.int32),
+                              seen_row, rng_row))
+        first_tok = int(first[0])
+        self._tok[slot] = first_tok
+        self._active[slot] = True
+        self._temps[slot] = temperature
+        self._top_ks[slot] = top_k
+        self._top_ps[slot] = top_p
+        self._min_ps[slot] = min_p
+        self._rep_pens[slot] = repetition_penalty
+        return slot, first_tok, float(first_lp[0]), np.asarray(echo)
+
+    def step(self):
+        """Advance EVERY slot one token (one compiled program call).
+        Returns (tokens [slots] i32, logprobs [slots] f32) — entries
+        for free slots are scratch. No-op (returns None) when the
+        pool is empty."""
+        if not self._active.any():
+            return None
+        (self._cache, self._row_pos, self._seen, self._rngs, nxt,
+         lp) = _slot_step_impl(
+            self._step_model, self._params, self._cache,
+            self._row_pos, self._seen, self._rngs,
+            jnp.asarray(self._tok), jnp.asarray(self._active),
+            jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+            jnp.asarray(self._top_ps), jnp.asarray(self._min_ps),
+            jnp.asarray(self._rep_pens))
+        toks = np.asarray(nxt)
+        np.copyto(self._tok, toks, where=self._active)
+        self.steps += 1
+        self.row_steps += int(self._active.sum())
+        return toks, np.asarray(lp)
+
+    def release(self, slot):
+        """Free a slot for the next admission. The retired row's
+        cache content stays resident but unreachable (admission
+        overwrites the whole row; per-row masks hide it meanwhile).
+        Its sampling knobs reset to the no-op values — a lingering
+        filtered row would keep _slot_sample's need-filters cond
+        (and its full-vocab sorts) firing for every later step."""
+        self._active[slot] = False
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 1.0
+        self._min_ps[slot] = 0.0
+        self._rep_pens[slot] = 1.0
+
+
 def beam_search(model, params, prompt, max_new_tokens, *,
                 num_beams=4, eos_id=None, length_penalty=0.0):
     """Beam-search generation: the num_beams highest sum-logprob

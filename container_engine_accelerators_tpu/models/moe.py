@@ -142,6 +142,7 @@ class MoEBlock(nn.Module):
     weights: str = "native"
     chunk_attends_cache: bool = False
     ring_slack: int = 0
+    per_row_index: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -157,6 +158,7 @@ class MoEBlock(nn.Module):
                                 chunk_attends_cache=(
                                     self.chunk_attends_cache),
                                 ring_slack=self.ring_slack,
+                                per_row_index=self.per_row_index,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -201,6 +203,9 @@ class MoETransformerLM(nn.Module):
     # Extra ring slots for speculation on sliding-window models (see
     # CausalSelfAttention.ring_slack; changes the cache shape).
     ring_slack: int = 0
+    # Per-row cache positions for the continuous-batching slot engine
+    # (see CausalSelfAttention.per_row_index; changes the cache tree).
+    per_row_index: bool = False
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -218,10 +223,13 @@ class MoETransformerLM(nn.Module):
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype, name="tok_embed")(tokens)
         if self.pos_embedding == "learned":
-            pos = cached_positions(self, s, self.decode)
+            pos = cached_positions(
+                self, s, self.decode,
+                per_row_batch=(tokens.shape[0] if self.per_row_index
+                               else None))
             pos = nn.Embed(self.max_seq_len, self.embed_dim,
                            dtype=self.dtype, name="pos_embed")(pos)
-            x = x + pos[None]
+            x = x + (pos if pos.ndim == 3 else pos[None])
         x = residual_constraint(x, self.mesh)
         aux_losses = []
         for i in range(self.num_layers):
@@ -240,6 +248,7 @@ class MoETransformerLM(nn.Module):
                     weights=self.weights,
                     chunk_attends_cache=self.chunk_attends_cache,
                     ring_slack=self.ring_slack,
+                    per_row_index=self.per_row_index,
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -254,6 +263,7 @@ class MoETransformerLM(nn.Module):
                           weights=self.weights,
                           chunk_attends_cache=self.chunk_attends_cache,
                           ring_slack=self.ring_slack,
+                          per_row_index=self.per_row_index,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

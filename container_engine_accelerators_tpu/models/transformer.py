@@ -47,18 +47,27 @@ def _linear(quantized, features, dtype, name):
     return nn.DenseGeneral(features, dtype=dtype, name=name)
 
 
-def cached_positions(module, s, decode):
+def cached_positions(module, s, decode, per_row_batch=None):
     """Position ids for a pos embed: arange normally; in decode mode,
     offset by a step counter kept in ``module``'s cache collection
-    (shared by the dense and MoE LMs)."""
+    (shared by the dense and MoE LMs).
+
+    ``per_row_batch`` (the slot-engine path): the counter is a [B]
+    vector — every batch row sits at its OWN sequence position — and
+    the returned ids are [B, S] instead of [S]."""
     if not decode:
         return jnp.arange(s, dtype=jnp.int32)
     is_init = not module.has_variable("cache", "pos_index")
+    shape = () if per_row_batch is None else (per_row_batch,)
     index = module.variable("cache", "pos_index",
-                            lambda: jnp.zeros((), jnp.int32))
+                            lambda: jnp.zeros(shape, jnp.int32))
     if is_init:
         return jnp.arange(s, dtype=jnp.int32)
-    pos = index.value + jnp.arange(s, dtype=jnp.int32)
+    steps = jnp.arange(s, dtype=jnp.int32)
+    if per_row_batch is None:
+        pos = index.value + steps
+    else:
+        pos = index.value[:, None] + steps[None, :]
     index.value = index.value + s
     return pos
 
@@ -78,7 +87,8 @@ def _quantize_rows_int8(x):
 
 def apply_rope(x, positions, base=10000.0):
     """Rotary position embedding. x: [B, S, H, D]; positions: [S]
-    int32 (global sequence positions of the S axis).
+    int32 (global sequence positions of the S axis), or [B, S] when
+    every batch row sits at its own position (per-row decode).
 
     Pairs dimension i with i + D/2 (the split layout); attention
     scores then depend only on relative positions, so there is no
@@ -92,9 +102,14 @@ def apply_rope(x, positions, base=10000.0):
             f"(embed_dim must be divisible by 2*num_heads)")
     d2 = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
-    angles = positions.astype(jnp.float32)[:, None] * freqs  # [S, D/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = (positions.astype(jnp.float32)[..., None]
+              * freqs)  # [S, D/2] or [B, S, D/2]
+    if angles.ndim == 2:
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :d2], x[..., d2:]
     rotated = jnp.concatenate([x1 * cos - x2 * sin,
                                x1 * sin + x2 * cos], axis=-1)
@@ -168,6 +183,16 @@ class CausalSelfAttention(nn.Module):
     # proof). Affects the CACHE SHAPE: a slacked clone's cache is not
     # interchangeable with a ring_slack=0 cache.
     ring_slack: int = 0
+    # Per-row cache index (the continuous-batching slot engine,
+    # models/decode.py SlotDecodeEngine): cache_index/pos_index are
+    # [B] vectors instead of shared scalars, so every batch row can
+    # sit at its OWN sequence position — decode steps write each
+    # row's K/V at its own slot-local offset and mask attention at
+    # its own horizon. Changes the cache TREE (vector counters), so a
+    # per-row cache is not interchangeable with a scalar-index cache.
+    # Dense caches only (no sliding-window ring), single-token steps
+    # after init.
+    per_row_index: bool = False
 
     def _kv_heads(self):
         kv = self.num_kv_heads or self.num_heads
@@ -243,6 +268,19 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 f"unsupported kv_cache_dtype {self.kv_cache_dtype!r}; "
                 f"use None or \"int8\"")
+        if self.per_row_index and (self.window or self.ring_slack):
+            # A freed-then-reused ring slot's stale slot_pos could
+            # pass the window band for a row rewound to an earlier
+            # per-row position — the engine rejects windowed models
+            # instead of serving silently-corrupt attention.
+            raise ValueError(
+                "per_row_index requires a dense cache "
+                "(attention_window=0)")
+        if self.per_row_index and self.chunk_attends_cache:
+            raise ValueError(
+                "per_row_index does not compose with "
+                "chunk_attends_cache (speculative verify chunks use "
+                "the shared scalar index)")
         cache_dtype = jnp.int8 if quantized else k.dtype
         is_init = not self.has_variable("cache", "cached_key")
         # Sliding-window models keep a RING buffer of window slots
@@ -277,15 +315,27 @@ class CausalSelfAttention(nn.Module):
             slot_pos = self.variable(
                 "cache", "slot_pos",
                 lambda: jnp.full((k.shape[0], c_len), -1, jnp.int32))
+        index_shape = (k.shape[0],) if self.per_row_index else ()
         index = self.variable("cache", "cache_index",
-                              lambda: jnp.zeros((), jnp.int32))
+                              lambda: jnp.zeros(index_shape, jnp.int32))
 
         def cache_write(buf, val):
             """Write a [B, Q, ...] update at positions i..i+Q-1
             (ring-aware; the prefill chunk's wrap split is static
             because Q and the ring length are static and i == 0 by
-            the one-shot-prefill contract)."""
+            the one-shot-prefill contract). Per-row index: i is [B]
+            and Q == 1 — each row writes at its OWN offset (scatter;
+            rows are distinct, so update order is immaterial)."""
             zeros = (0,) * (val.ndim - 2)
+            if self.per_row_index:
+                if val.shape[1] != 1:
+                    raise ValueError(
+                        "per_row_index caches take single-token "
+                        "steps only after init (the slot engine "
+                        "prefills through a scalar-index cache and "
+                        "inserts)")
+                return buf.at[jnp.arange(val.shape[0]), i].set(
+                    val[:, 0])
             if not ring:
                 return jax.lax.dynamic_update_slice(
                     buf, val, (0, i) + zeros)
@@ -332,8 +382,11 @@ class CausalSelfAttention(nn.Module):
         if self.rope:
             # Rotate at the tokens' global positions before the cache
             # write: the cache then holds rotated keys and the step
-            # stays an ordinary dot product against it.
-            pos = i + jnp.arange(q.shape[1], dtype=jnp.int32)
+            # stays an ordinary dot product against it. Per-row index:
+            # [B] offsets -> [B, Q] positions (each row at its own).
+            pos = jnp.arange(q.shape[1], dtype=jnp.int32)
+            pos = (i[:, None] + pos[None, :] if self.per_row_index
+                   else i + pos)
             q, k = apply_rope(q, pos), apply_rope(k, pos)
         if quantized:
             kq, ks = _quantize_rows_int8(k)
@@ -395,7 +448,12 @@ class CausalSelfAttention(nn.Module):
         # Queries in a multi-token chunk (one-shot prefill) sit at
         # positions i..i+Q-1; each attends causally to its own
         # prefix. Single-token decode (Q=1) reduces to k_pos <= i.
-        q_pos = i + jax.lax.broadcasted_iota(
+        # Per-row index: each row masks at its OWN horizon, so a
+        # freshly-admitted slot never sees a neighbour slot's junk
+        # beyond its position (rows are attention-independent).
+        i_bc = (i.reshape((-1,) + (1,) * 4) if self.per_row_index
+                else i)
+        q_pos = i_bc + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, dimension=3)
         if ring:
             # Ring cache: slot j holds global position slot_pos[b, j]
@@ -435,6 +493,7 @@ class Block(nn.Module):
     weights: str = "native"
     chunk_attends_cache: bool = False
     ring_slack: int = 0
+    per_row_index: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -451,6 +510,7 @@ class Block(nn.Module):
                                 chunk_attends_cache=(
                                     self.chunk_attends_cache),
                                 ring_slack=self.ring_slack,
+                                per_row_index=self.per_row_index,
                                 name="attn")(x)
         quant = self.weights == "int8"
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -493,6 +553,9 @@ class TransformerLM(nn.Module):
     # Extra ring slots for speculation on sliding-window models (see
     # CausalSelfAttention.ring_slack; changes the cache shape).
     ring_slack: int = 0
+    # Per-row cache positions for the continuous-batching slot engine
+    # (see CausalSelfAttention.per_row_index; changes the cache tree).
+    per_row_index: bool = False
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -512,10 +575,15 @@ class TransformerLM(nn.Module):
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype, name="tok_embed")(tokens)
         if self.pos_embedding == "learned":
-            pos = cached_positions(self, s, self.decode)
+            pos = cached_positions(
+                self, s, self.decode,
+                per_row_batch=(tokens.shape[0] if self.per_row_index
+                               else None))
             pos = nn.Embed(self.max_seq_len, self.embed_dim,
                            dtype=self.dtype, name="pos_embed")(pos)
-            x = x + pos[None]
+            # Per-row decode positions come back [B, S] -> [B, S, E];
+            # the shared-[S] form broadcasts over the batch as before.
+            x = x + (pos if pos.ndim == 3 else pos[None])
         x = residual_constraint(x, self.mesh)
         for i in range(self.num_layers):
             x = Block(num_heads=self.num_heads,
@@ -529,6 +597,7 @@ class TransformerLM(nn.Module):
                       weights=self.weights,
                       chunk_attends_cache=self.chunk_attends_cache,
                       ring_slack=self.ring_slack,
+                      per_row_index=self.per_row_index,
                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,

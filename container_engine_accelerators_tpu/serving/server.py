@@ -48,6 +48,24 @@ log = get_logger("serving")
 
 REQUEST_HISTOGRAM = "serving_request_latency_seconds"
 DECODE_HISTOGRAM = "serving_decode_latency_seconds"
+# Per-step slot occupancy (active / total, 0..1] — the continuous-
+# batching efficiency signal the engine exists to move.
+OCCUPANCY_HISTOGRAM = "tpu_serving_slot_occupancy"
+OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
+                     1.0)
+
+
+def _maybe_enable_compile_cache():
+    """Honor CEA_TPU_COMPILE_CACHE: point jax's persistent XLA
+    compile cache at the named directory (hostPath/PVC) so HPA
+    replica restarts reuse compiled programs instead of re-paying the
+    multi-second per-program cold-start compiles. Called from the
+    serving entry points right before the first compile (warm-up)."""
+    cache_dir = os.environ.get("CEA_TPU_COMPILE_CACHE")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 class _Admission:
@@ -214,6 +232,300 @@ class _StreamBody:
             if not self._released:
                 self._released = True
                 self._release()
+
+
+class _EngineWork:
+    """One request row's lifetime through the slot engine: queued ->
+    admitted (slot assigned, first token produced by the admission
+    prefill) -> stepped -> retired (EOS / budget / cancel)."""
+
+    __slots__ = ("row", "p_len", "new", "temperature", "top_k",
+                 "top_p", "min_p", "rep_pen", "eos_id", "want_lp",
+                 "seed", "done", "stream_q", "ctx", "cancel", "slot",
+                 "tokens", "lps", "score_only")
+
+    def __init__(self, row, p_len, new, temperature, top_k, top_p,
+                 min_p, rep_pen, eos_id, want_lp, seed, ctx,
+                 stream_q=None, score_only=False):
+        self.row = row
+        self.p_len = p_len
+        self.new = new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.min_p = min_p
+        self.rep_pen = rep_pen
+        self.eos_id = eos_id
+        self.want_lp = want_lp
+        self.seed = seed
+        self.ctx = ctx
+        self.stream_q = stream_q
+        self.done = queue.Queue(maxsize=1) if stream_q is None else None
+        self.cancel = threading.Event()
+        self.slot = None
+        self.tokens = []
+        self.lps = []
+        self.score_only = score_only
+
+
+class _EngineService:
+    """The continuous-batching decode loop behind GenerationServer.
+
+    One background thread owns the SlotDecodeEngine (its pool state
+    is single-threaded by contract) and runs the step loop: at every
+    step boundary it (a) retires rows that hit EOS, their token
+    budget, or a stream cancel — freeing their slots immediately —
+    (b) admits queued rows into free slots (per-bucket prefill + the
+    scatter insert; the freed slot serves its next occupant on the
+    very next step), and (c) runs ONE jitted decode step over all
+    slots. ``admission`` (the server-wide _Admission) bounds
+    admitted-but-unretired rows: past it submissions shed (503).
+
+    Telemetry: per-step `serving.engine_step` spans (parented to the
+    longest-waiting admitted request's trace, mirroring the old batch
+    span), the tpu_serving_slot_occupancy histogram, and
+    slots_active/slots_free gauges through the process tracer.
+    """
+
+    def __init__(self, engine, admission):
+        self._engine = engine
+        self._admission = admission
+        self._queue = queue.Queue()
+        self._pending = []          # popped but waiting for a slot
+        self._slot_work = {}
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._stopping = False      # gates submit_many under _lock
+        self._admitted = 0
+        self._retired = 0
+        self._occ_hist = obs.histogram(
+            OCCUPANCY_HISTOGRAM,
+            "Decode-step slot occupancy (active/total)",
+            buckets=OCCUPANCY_BUCKETS)
+        self._step_hist = obs.histogram(
+            DECODE_HISTOGRAM,
+            "Device decode-call latency by program kind",
+            labels={"kind": "engine_step"})
+        self._prefill_hist = obs.histogram(
+            DECODE_HISTOGRAM,
+            "Device decode-call latency by program kind",
+            labels={"kind": "engine_prefill"})
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-engine", daemon=True)
+        self._thread.start()
+
+    def submit_many(self, works):
+        """Admit all rows or none (the all-or-nothing _Admission
+        discipline); returns the works, or None on shed/shutdown.
+        The _stopping gate is checked under _lock so no work can
+        slip into the queue after stop() drained it (a late work
+        would leave its handler blocked on done.get() forever)."""
+        with self._lock:
+            if self._stopping:
+                return None
+            if not self._admission.try_acquire(len(works)):
+                return None
+            for work in works:
+                self._queue.put(work)
+        return works
+
+    def queue_depth(self):
+        with self._lock:
+            return self._queue.qsize() + len(self._pending)
+
+    def stats(self):
+        eng = self._engine
+        with self._lock:
+            steps, row_steps = eng.steps, eng.row_steps
+            active = eng.active_count()
+            occ = (round(row_steps / steps, 3) if steps else None)
+            return {
+                "slots": eng.slots,
+                "slots_active": active,
+                "slots_free": eng.slots - active,
+                "queue_depth": (self._queue.qsize()
+                                + len(self._pending)),
+                "engine_steps": steps,
+                "engine_prefills": eng.prefills,
+                "rows_decoded": row_steps,
+                "batch_occupancy_avg": occ,
+                "requests_admitted": self._admitted,
+                "requests_retired": self._retired,
+            }
+
+    def reset_counters(self):
+        """Drop warm-up's synthetic traffic from the occupancy
+        telemetry (the /stats signal must describe real traffic, the
+        same discipline as speculative acceptance accounting)."""
+        with self._lock:
+            self._engine.steps = 0
+            self._engine.row_steps = 0
+            self._engine.prefills = 0
+            self._admitted = 0
+            self._retired = 0
+
+    def stop(self):
+        with self._lock:
+            self._stopping = True   # no further submissions land
+        self._stop.set()
+        self._queue.put(None)
+        self._thread.join(timeout=10)
+        # In-flight work (_pending/_slot_work) belongs to the loop
+        # thread, which finishes it on exit — touching it here would
+        # double-_finish if the join timed out mid-step (the done
+        # queues are maxsize=1; a second put blocks forever, and the
+        # admission budget would release twice). Queue items are safe
+        # either way: get_nowait hands each to exactly one drainer.
+        if self._thread.is_alive():
+            log.warning("engine loop still stepping at stop(); "
+                        "in-flight requests answer when it lands")
+        try:
+            while True:
+                item = self._queue.get_nowait()
+                if item is not None:
+                    self._finish(item, error="server stopping")
+        except queue.Empty:
+            pass
+
+    # ----- loop internals (service thread only) ----------------------
+
+    def _finish(self, work, error=None):
+        if work.slot is not None:
+            self._engine.release(work.slot)
+            self._slot_work.pop(work.slot, None)
+            work.slot = None
+        self._admission.release(1)
+        with self._lock:
+            self._retired += 1
+        if work.stream_q is not None:
+            work.stream_q.put(("error", error) if error else ("end",))
+        elif error is not None:
+            work.done.put(("error", error))
+        else:
+            work.done.put(("ok", self._result(work)))
+
+    def _result(self, work):
+        """Row payload in the batch path's shape: the [p_len + new]
+        sequence (EOS-padded past an early stop, like the fixed-
+        horizon decode), plus the logprob row when asked."""
+        pad = work.new - (len(work.tokens))
+        fill = work.eos_id if work.eos_id >= 0 else 0
+        seq = np.concatenate([
+            np.asarray(work.row[:work.p_len], np.int32),
+            np.asarray(work.tokens + [fill] * pad, np.int32)])
+        if not work.want_lp:
+            return seq
+        lps = np.concatenate([
+            np.asarray(work.lps, np.float32),
+            np.zeros((pad,), np.float32)])
+        return (seq, lps)
+
+    def _deliver(self, work, tok, lp):
+        work.tokens.append(tok)
+        if work.want_lp:
+            work.lps.append(lp)
+        if work.stream_q is not None:
+            work.stream_q.put(("tok", tok))
+        if (tok == work.eos_id and work.eos_id >= 0) \
+                or len(work.tokens) >= work.new:
+            self._finish(work)
+
+    def _admit(self, work):
+        t0 = time.perf_counter()
+        try:
+            with obs.span("serving.prefill", parent=work.ctx,
+                          bucket=int(work.row.shape[0]),
+                          phase="engine_admission"):
+                if work.score_only:
+                    echo = self._engine.score(work.row, work.p_len)
+                    work.lps = list(echo[:work.p_len])
+                    with self._lock:
+                        self._admitted += 1
+                    self._finish(work)
+                    return
+                slot, first, first_lp, echo = self._engine.admit(
+                    work.row, work.p_len,
+                    temperature=work.temperature, top_k=work.top_k,
+                    top_p=work.top_p, min_p=work.min_p,
+                    repetition_penalty=work.rep_pen, seed=work.seed)
+        except Exception as e:
+            log.exception("engine admission failed")
+            self._finish(work, error=str(e))
+            return
+        finally:
+            self._prefill_hist.observe(time.perf_counter() - t0)
+        work.slot = slot
+        self._slot_work[slot] = work
+        with self._lock:
+            self._admitted += 1
+        if work.want_lp:
+            work.lps = list(echo[:work.p_len])
+        self._deliver(work, first, first_lp)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            # Drain arrivals; block only when the pool is idle.
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    self._pending.append(item)
+            if not self._pending and not self._slot_work:
+                try:
+                    item = self._queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if item is not None:
+                    self._pending.append(item)
+                continue  # drain any burst before admitting
+            # Retire cancelled streams first: their slots admit
+            # queued work THIS boundary.
+            for slot, work in list(self._slot_work.items()):
+                if work.cancel.is_set():
+                    self._finish(work, error="cancelled")
+            while self._pending and (self._engine.free_slots()
+                                     or self._pending[0].cancel.is_set()
+                                     or self._pending[0].score_only):
+                work = self._pending.pop(0)
+                if work.cancel.is_set():
+                    self._finish(work, error="cancelled")
+                    continue
+                self._admit(work)
+            if not self._slot_work:
+                continue
+            active = self._engine.active_count()
+            parent = next((w.ctx for w in self._slot_work.values()
+                           if w.ctx is not None), None)
+            t0 = time.perf_counter()
+            try:
+                with obs.span("serving.engine_step", parent=parent,
+                              slots_active=active,
+                              slots_free=self._engine.slots - active):
+                    out = self._engine.step()
+            except Exception as e:
+                log.exception("engine step failed")
+                for work in list(self._slot_work.values()):
+                    self._finish(work, error=str(e))
+                continue
+            finally:
+                self._step_hist.observe(time.perf_counter() - t0)
+            self._occ_hist.observe(active / self._engine.slots)
+            obs.gauge("tpu_serving_slots_active", active)
+            obs.gauge("tpu_serving_slots_free",
+                      self._engine.slots - active)
+            if out is None:
+                continue
+            toks, lps = out
+            for slot, work in list(self._slot_work.items()):
+                self._deliver(work, int(toks[slot]), float(lps[slot]))
+        # Loop exit (stop()): this thread OWNS _pending/_slot_work,
+        # so it also answers them — exactly once each.
+        for work in (self._pending
+                     + list(self._slot_work.values())):
+            self._finish(work, error="server stopping")
+        self._pending.clear()
 
 
 class _BaseServer:
@@ -588,19 +900,33 @@ class GenerationServer(_BaseServer):
 
     All prompts in one request must share a length. Client-visible
     shapes never reach the compiler: prompts are right-padded into a
-    fixed set of length buckets, the batch is padded to ``max_batch``,
-    and the decode horizon is always ``max_new_tokens`` (the response
-    is sliced to what was asked). Default traffic (no top_k) costs
-    2 programs per bucket (greedy/sampling, both optionally compiled
-    before traffic via ``warm=True`` so no such request blocks on a
-    compile); sampling filters add bounded variants compiled on first
-    use — top_p one nucleus variant per (bucket, top_k), top_k one
-    program per power-of-two value (client values quantize up, so at
-    most log2(vocab) per bucket); "logprobs": true doubles a key's
-    variants (its own compiled program + batcher, compiled on first
-    use — warm=True does not precompile them). Batcher threads
-    follow the same bound: one per (bucket, mode, effective top_k,
-    logprobs) actually seen.
+    fixed set of length buckets and the response is sliced to what
+    was asked.
+
+    **Continuous batching (the default data path).** Generation runs
+    on a persistent slot pool (models.decode.SlotDecodeEngine,
+    ``max_batch`` slots, one KV-cache row each) driven by a single
+    step loop: rows that hit EOS or their token budget retire at the
+    step boundary and their slots are recycled to queued requests
+    immediately — a request admits MID-FLIGHT instead of waiting for
+    a whole batch to run to completion, and a short request never
+    pays a long neighbour's horizon. Every sampling knob
+    (temperature, top_k, top_p, min_p, repetition_penalty) rides as a
+    per-row traced vector, and greedy/sampling is a per-row select,
+    so mixed configs — different buckets included — share ONE
+    compiled step program; the whole program set is
+    len(buckets) prefill programs + an insert + the step.
+    ``"logprobs": true`` and scoring mode ride the same programs.
+    /stats reports the engine's `batch_occupancy_avg`,
+    `slots_active`, and `queue_depth`.
+
+    **Batch mode (legacy path).** Servers configured with
+    ``speculative_k``, ``prefix_tokens``, or a sliding-window model
+    keep the run-to-completion cross-request batcher: one _Batcher
+    per (bucket, mode, effective top_k, logprobs, plain, filtered)
+    actually seen, top_k quantized to a power-of-two grid, decode
+    horizon always ``max_new_tokens``. Everything below about
+    speculation and prefix serving applies to that path.
 
     ``prefix_tokens`` turns on system-prompt serving: the shared
     prefix prefills ONE KV cache at construction
@@ -772,14 +1098,35 @@ class GenerationServer(_BaseServer):
                 self._draft_prefix_state = prefill_prefix(
                     draft_model, draft_params, prefix_arr[None, :],
                     max_total_len=min(want, draft_model.max_seq_len))
-        # Cross-request batching: one _Batcher per (bucket, sampling
-        # mode, effective top_k) — rows from concurrent requests with
-        # the same key share one decode call. Rows carry per-row
-        # temperature, true prompt length, and top_p (decode accepts
-        # [B] vectors for all three), so clients differing only in
-        # those still batch together; greedy and sampling stay
-        # separate (different compiled programs), as does each
-        # power-of-two top_k. See the class docstring for the bound.
+        # Continuous batching: plain LM servers decode on the slot
+        # engine (one pool, in-flight admission, EOS slot recycling).
+        # Speculation, prefix serving, and sliding-window models keep
+        # the run-to-completion batch path below — their decode
+        # programs are structurally whole-horizon (spec verify
+        # rounds, shared-prefix fan-out) or need ring-cache metadata
+        # the pool's rewind would corrupt.
+        self._engine_service = None
+        if not (self._spec_k or self._prefix_len
+                or getattr(model, "attention_window", 0)):
+            from ..models.decode import SlotDecodeEngine
+            # Before the FIRST compile (the pool-cache init below) so
+            # warm=False servers honor the env var too, not only the
+            # warm-up path.
+            _maybe_enable_compile_cache()
+            self._engine_service = _EngineService(
+                SlotDecodeEngine(
+                    model, params, max_batch,
+                    self._buckets[-1] + max_new_tokens),
+                self._admission)
+        # Cross-request batching (legacy batch mode): one _Batcher
+        # per (bucket, sampling mode, effective top_k) — rows from
+        # concurrent requests with the same key share one decode
+        # call. Rows carry per-row temperature, true prompt length,
+        # and top_p (decode accepts [B] vectors for all three), so
+        # clients differing only in those still batch together;
+        # greedy and sampling stay separate (different compiled
+        # programs), as does each power-of-two top_k. See the class
+        # docstring for the bound.
         self._batchers = {}
         self._batchers_lock = threading.Lock()
         self._stopping = False
@@ -808,17 +1155,43 @@ class GenerationServer(_BaseServer):
             log.exception("warm-up failed; server stays unready")
 
     def _warm_up(self):
-        """Compile the per-bucket program set before traffic.
+        """Compile the program set before traffic.
 
-        Always both default programs (greedy and plain sampling);
-        each entry of ``warm_filters`` — a dict with any of top_k,
-        top_p, min_p, repetition_penalty, logprobs, temperature —
-        additionally compiles the variant that traffic with those
-        options would select (top_k quantizes to the same
+        Engine mode: one warm request per bucket compiles that
+        bucket's prefill program plus (on the first) the insert and
+        step programs — the COMPLETE engine set; every sampling
+        variant shares those programs, so ``warm_filters`` has
+        nothing left to precompile (accepted and ignored for config
+        compatibility). Warm traffic is dropped from the occupancy
+        telemetry afterwards.
+
+        Batch mode: both default programs per bucket (greedy and
+        plain sampling); each entry of ``warm_filters`` — a dict with
+        any of top_k, top_p, min_p, repetition_penalty, logprobs,
+        temperature — additionally compiles the variant that traffic
+        with those options would select (top_k quantizes to the same
         power-of-two grid as request handling). VERDICT r2 weak #5:
         warm previously skipped every sampling-filter variant, so
         configs using them still paid first-request compiles.
         """
+        _maybe_enable_compile_cache()
+        if self._engine_service is not None:
+            for b in self._buckets:
+                work = _EngineWork(
+                    np.zeros((b,), np.int32), b,
+                    min(2, self._max_new), 0.0, 0, 1.0, 0.0, 1.0,
+                    -1, False, 0, None)
+                if self._engine_service.submit_many([work]) is None:
+                    raise RuntimeError(
+                        "warm-up shed by admission control")
+                status, out = work.done.get(timeout=600)
+                if status != "ok":
+                    raise RuntimeError(f"warm-up decode failed: {out}")
+            self._engine_service.reset_counters()
+            self._ready.set()
+            log.info("warm-up complete: %d bucket prefill programs "
+                     "+ engine insert/step", len(self._buckets))
+            return
         for b in self._buckets:
             zeros = np.zeros((b,), np.int32)
             # pad_temp selects greedy vs sampling mode. With a draft
@@ -1322,7 +1695,15 @@ class GenerationServer(_BaseServer):
 
     def _extra_stats(self):
         """Decode-batch occupancy: rows served per compiled call —
-        the batching-efficiency signal for load tests."""
+        the batching-efficiency signal for load tests. Engine mode
+        reports the slot pool's live numbers (batch_occupancy_avg =
+        mean active slots per decode step, plus current
+        slots_active/slots_free and queue depth); avg_batch_occupancy
+        stays as an alias so existing load harnesses keep working."""
+        if self._engine_service is not None:
+            out = self._engine_service.stats()
+            out["avg_batch_occupancy"] = out["batch_occupancy_avg"]
+            return out
         calls = self._decode_calls
         # k=1 proposes zero drafts per round — no acceptance to
         # rate, so None (0.0 would read as "every proposal
@@ -1350,6 +1731,8 @@ class GenerationServer(_BaseServer):
             self._batchers.clear()
         for batcher in batchers:
             batcher.stop()
+        if self._engine_service is not None:
+            self._engine_service.stop()
 
     def _handle_post(self, payload):
         try:
@@ -1415,7 +1798,12 @@ class GenerationServer(_BaseServer):
         if self._prefix_len and want_lp:
             return 400, {"error": "logprobs is not supported on a "
                                   "prefix-serving server"}
-        top_k = self._quantize_top_k(top_k)
+        if self._engine_service is None:
+            # Batch mode bounds compiled top_k variants by quantizing
+            # to a power-of-two grid; the engine's per-row top_k is
+            # traced data (one program for every k), so it honors the
+            # client's exact value.
+            top_k = self._quantize_top_k(top_k)
         if not prompts or len(prompts) > self._max_batch:
             return 400, {"error": f"need 1..{self._max_batch} prompts"}
         if texts is None and len({len(p) for p in prompts}) != 1:
@@ -1455,6 +1843,10 @@ class GenerationServer(_BaseServer):
                                   f"max {self._buckets[-1]}"}
         padded = np.zeros((arr.shape[0], bucket), np.int32)
         padded[:, :p_len] = arr
+        if self._engine_service is not None:
+            return self._engine_post(padded, p_lens, new, temperature,
+                                     top_k, top_p, min_p, eos_id,
+                                     rep_pen, want_lp, stream, texts)
         if stream:
             if arr.shape[0] != 1:
                 return 400, {"error": "stream requires exactly one "
@@ -1509,27 +1901,114 @@ class GenerationServer(_BaseServer):
                 if status != "ok":
                     return 500, {"error": out}
                 rows.append(out)
+        return 200, self._compose_response(rows, p_lens, new,
+                                           want_lp, texts, eos_id)
+
+    def _compose_response(self, rows, p_lens, new, want_lp, texts,
+                          eos_id):
+        """Result rows -> response JSON — ONE shape for the engine
+        and batch paths (rows are [>= p_len + new] sequences, or
+        (sequence, logprobs) pairs with want_lp)."""
+        seqs = [np.asarray(r[0] if want_lp else r) for r in rows]
+        resp = {"sequences": [s[:pl + new].tolist()
+                              for s, pl in zip(seqs, p_lens)]}
         if want_lp:
-            seq = np.stack([r[0] for r in rows])
-            lps = np.stack([r[1] for r in rows])
-            resp = {
-                "sequences": [s[:pl + new].tolist()
-                              for s, pl in zip(seq, p_lens)],
-                "logprobs": [[round(float(x), 6)
-                              for x in row[:pl + new]]
-                             for row, pl in zip(lps, p_lens)],
-            }
-        else:
-            seq = np.stack(rows)
-            resp = {"sequences": [s[:pl + new].tolist()
-                                  for s, pl in zip(seq, p_lens)]}
+            resp["logprobs"] = [
+                [round(float(x), 6)
+                 for x in np.asarray(r[1])[:pl + new]]
+                for r, pl in zip(rows, p_lens)]
         if texts is not None:
             # Decoded generated region (eos_id tokens trimmed).
             comps = []
-            for row, pl in zip(seq, p_lens):
+            for row, pl in zip(seqs, p_lens):
                 ids = row[pl:pl + new].tolist()
                 if eos_id >= 0 and eos_id in ids:
                     ids = ids[:ids.index(eos_id)]
                 comps.append(self._tokenizer.decode(ids))
             resp["completions"] = comps
-        return 200, resp
+        return resp
+
+    def _engine_post(self, padded, p_lens, new, temperature, top_k,
+                     top_p, min_p, eos_id, rep_pen, want_lp, stream,
+                     texts):
+        """Route one validated request onto the slot engine: every
+        row takes (at most) one slot, admitted by the engine loop at
+        the next step boundary with a free slot; scoring rows
+        (max_new_tokens 0) ride the prefill program only."""
+        with self._stats_lock:
+            seed = self._seed + 1
+            self._seed += len(p_lens)
+        ctx = obs.TRACER.current_context()
+        if stream:
+            if padded.shape[0] != 1:
+                return 400, {"error": "stream requires exactly one "
+                                      "prompt"}
+            if new < 1:
+                return 400, {"error": "stream requires "
+                                      "max_new_tokens >= 1"}
+            stream_q = queue.Queue()
+            work = _EngineWork(padded[0], int(p_lens[0]), new,
+                               temperature, top_k, top_p, min_p,
+                               rep_pen, eos_id, False, seed, ctx,
+                               stream_q=stream_q)
+            if self._engine_service.submit_many([work]) is None:
+                with self._stats_lock:
+                    self._shed += 1
+                return 503, {"error": "server overloaded; retry"}
+            decode_text = (self._tokenizer.decode
+                           if texts is not None else None)
+            # close() cancels the work; the engine loop retires the
+            # slot (and releases the admission permit) at the next
+            # step boundary — no leak however early the client left.
+            return 200, _StreamBody(
+                self._engine_stream(work, decode_text, eos_id),
+                work.cancel.set)
+        works = [
+            _EngineWork(row, int(pl), new, temperature, top_k, top_p,
+                        min_p, rep_pen, eos_id, want_lp, seed + i,
+                        ctx, score_only=(new == 0))
+            for i, (row, pl) in enumerate(zip(padded, p_lens))]
+        with obs.span("serving.admission", bucket=padded.shape[1],
+                      rows=len(works)) as adm:
+            if self._engine_service.submit_many(works) is None:
+                adm.set(shed=True)
+                with self._stats_lock:
+                    self._shed += 1
+                return 503, {"error": "server overloaded; retry"}
+        rows = []
+        with obs.span("serving.wait", rows=len(works)):
+            for work in works:
+                try:
+                    status, out = work.done.get(timeout=120)
+                except queue.Empty:
+                    return 500, {"error": "decode timed out"}
+                if status != "ok":
+                    return 500, {"error": out}
+                rows.append(out)
+        return 200, self._compose_response(rows, p_lens, new,
+                                           want_lp, texts, eos_id)
+
+    def _engine_stream(self, work, decode_text, eos_id):
+        """ndjson generator over the engine's per-step token queue:
+        one {"tokens": [t]} line per decode step — tokens reach the
+        client as each step lands — then {"done": true}."""
+        while True:
+            try:
+                item = work.stream_q.get(timeout=120)
+            except queue.Empty:
+                yield {"error": "decode timed out"}
+                return
+            if item[0] == "tok":
+                tok = item[1]
+                line = {"tokens": [tok]}
+                if decode_text is not None:
+                    ids = ([] if eos_id >= 0 and tok == eos_id
+                           else [tok])
+                    line["completion_delta"] = decode_text(ids)
+                yield line
+            elif item[0] == "end":
+                yield {"done": True}
+                return
+            else:
+                yield {"error": item[1]}
+                return

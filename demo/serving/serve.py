@@ -157,11 +157,15 @@ def main(argv=None):
                         "empty serves randomly-initialized weights "
                         "(load-testing only)")
     p.add_argument("--compilation-cache-dir",
-                   default=os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                          ""),
+                   default=(os.environ.get("CEA_TPU_COMPILE_CACHE")
+                            or os.environ.get(
+                                "JAX_COMPILATION_CACHE_DIR", "")),
                    help="persistent XLA compile cache (hostPath or "
                         "PVC); replica restarts then skip the "
-                        "20-40s per-program compiles")
+                        "20-40s per-program compiles. Also set via "
+                        "CEA_TPU_COMPILE_CACHE (the HPA manifest's "
+                        "env hook; GenerationServer warm-up honors "
+                        "it too)")
     p.add_argument("--tensor-parallel", type=int, default=1,
                    help="shard wide parameters over an N-way model "
                         "axis (all visible chips of the replica's "

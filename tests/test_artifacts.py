@@ -261,3 +261,18 @@ def test_promote_serving_refusals_and_success(tmp_path):
     assert promoted["cold_start"]["requests"] == 300
     assert promoted["config"]["readiness_gated"] is True
     assert promoted["provenance"]["devices"] == ["TPU v5 lite0"]
+    assert "server_stats" not in promoted  # pre-engine stats shape
+
+    # Engine-era /stats: the occupancy fields ride into the artifact
+    # first-class (they replaced the free-text server_stats_note).
+    stats.write_text(json.dumps(
+        {"platform": "tpu", "devices": ["TPU v5 lite0"],
+         "batch_occupancy_avg": 5.21, "slots_active": 3,
+         "slots_free": 5, "queue_depth": 2, "engine_steps": 4096,
+         "rows_decoded": 21340}))
+    p = _promote("serving", str(raw), str(stats), str(out))
+    assert p.returncode == 0, p.stderr
+    promoted = json.loads(out.read_text())
+    assert promoted["server_stats"]["batch_occupancy_avg"] == 5.21
+    assert promoted["server_stats"]["slots_active"] == 3
+    assert promoted["server_stats"]["queue_depth"] == 2

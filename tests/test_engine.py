@@ -1,0 +1,198 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Continuous-batching slot engine (models.decode.SlotDecodeEngine).
+
+The engine's correctness contract is EXACTNESS against the
+per-request decode paths: a slot's greedy token stream — admitted
+mid-flight into a pool whose other slots are at arbitrary positions —
+must be token-for-token what ``decode`` produces for that request
+alone. These tests drive the engine directly (no HTTP; the serving
+loop's tests live in test_serving.py) on models small enough for
+tier-1.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import TransformerLM
+from container_engine_accelerators_tpu.models.decode import (
+    SlotDecodeEngine,
+    decode,
+    greedy_decode,
+)
+
+
+def _make_lm(**kw):
+    kwargs = dict(vocab_size=48, embed_dim=32, num_layers=2,
+                  num_heads=4, max_seq_len=32, dtype=jnp.float32)
+    kwargs.update(kw)
+    model = TransformerLM(**kwargs)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _make_lm()
+
+
+def _drain(engine, slot, n):
+    out = []
+    for _ in range(n):
+        toks, _ = engine.step()
+        out.append(int(toks[slot]))
+    return out
+
+
+def test_staggered_admission_matches_greedy_decode(lm):
+    """Two requests admitted TWO STEPS APART — the in-flight
+    admission no batch decode can do — each emit exactly their
+    per-request decode() stream; a ragged (right-padded) row matches
+    the prompt_len-vector reference."""
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=3, slot_len=14)
+
+    prompt_a = np.array([1, 2, 3, 4], np.int32)          # full width
+    slot_a, first_a, _, _ = eng.admit(prompt_a, 4)
+    out_a = [first_a] + _drain(eng, slot_a, 2)
+
+    prompt_b = np.array([7, 9, 0, 0], np.int32)          # true len 2
+    slot_b, first_b, _, _ = eng.admit(prompt_b, 2)
+    out_b = [first_b]
+    for _ in range(3):
+        toks, _ = eng.step()
+        out_a.append(int(toks[slot_a]))
+        out_b.append(int(toks[slot_b]))
+    eng.release(slot_a)
+    out_b += _drain(eng, slot_b, 2)
+    eng.release(slot_b)
+
+    ref_a = np.asarray(greedy_decode(
+        model, params, jnp.asarray(prompt_a[None]), 6))[0]
+    assert out_a == ref_a[4:10].tolist()
+    ref_b = np.asarray(decode(
+        model, params, jnp.asarray(prompt_b[None]), 6,
+        prompt_len=np.array([2]), fast_prefill=False))[0]
+    assert out_b == ref_b[2:8].tolist()
+    # Occupancy accounting saw the overlap: 3 of the 7 steps ran 2
+    # rows.
+    assert eng.steps == 7 and eng.row_steps == 10
+
+
+def test_freed_slot_reused_immediately(lm):
+    """EOS-style early retirement: releasing a finished slot makes it
+    admissible on the SAME boundary, and the new occupant's stream is
+    exact — the recycled cache row carries no trace of its previous
+    occupant."""
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=1, slot_len=14)
+
+    prompt_a = np.array([1, 2, 3, 4], np.int32)
+    slot_a, first_a, _, _ = eng.admit(prompt_a, 4)
+    _drain(eng, slot_a, 2)          # A "hits EOS" after 3 tokens
+    eng.release(slot_a)
+    assert eng.free_slots() == 1
+
+    prompt_b = np.array([5, 6, 7, 8], np.int32)
+    slot_b, first_b, _, _ = eng.admit(prompt_b, 4)
+    assert slot_b == slot_a         # the recycled slot
+    out_b = [first_b] + _drain(eng, slot_b, 5)
+    eng.release(slot_b)
+    ref_b = np.asarray(greedy_decode(
+        model, params, jnp.asarray(prompt_b[None]), 6))[0]
+    assert out_b == ref_b[4:10].tolist()
+
+
+def test_mixed_sampling_pool_keeps_greedy_rows_exact(lm):
+    """One step program serves any knob mix: a greedy row co-resident
+    with a filtered-sampling row still emits its exact reference
+    stream, and the sampled row stays in-vocab."""
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=14)
+    slot_g, tok_g, _, _ = eng.admit(np.array([1, 2, 3, 4], np.int32), 4)
+    slot_s, tok_s, _, _ = eng.admit(
+        np.array([5, 6, 7, 8], np.int32), 4, temperature=0.9,
+        top_k=5, top_p=0.9, min_p=0.02, seed=7)
+    out_g, out_s = [tok_g], [tok_s]
+    for _ in range(5):
+        toks, _ = eng.step()
+        out_g.append(int(toks[slot_g]))
+        out_s.append(int(toks[slot_s]))
+    ref_g = np.asarray(greedy_decode(
+        model, params, jnp.asarray([[1, 2, 3, 4]], jnp.int32), 6))[0]
+    assert out_g == ref_g[4:10].tolist()
+    assert all(0 <= t < model.vocab_size for t in out_s)
+
+
+def test_repetition_penalty_and_logprobs_match_decode(lm):
+    """Per-slot penalty state (the seen-token mask survives across
+    steps) and the logprob stream both match decode()'s reference."""
+    model, params = lm
+    prompt = np.array([3, 9, 3, 0], np.int32)
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=14)
+    slot, tok0, _, _ = eng.admit(prompt, 3, repetition_penalty=2.5)
+    out = [tok0] + _drain(eng, slot, 5)
+    ref = np.asarray(decode(
+        model, params, jnp.asarray(prompt[None]), 6,
+        prompt_len=np.array([3]), fast_prefill=False,
+        repetition_penalty=2.5))[0]
+    assert out == ref[3:9].tolist()
+    eng.release(slot)
+
+    _, lps_ref = decode(
+        model, params, jnp.asarray(prompt[None]), 6,
+        prompt_len=np.array([3]), fast_prefill=False,
+        return_logprobs=True)
+    slot, tok0, lp0, echo = eng.admit(prompt, 3)
+    lps = list(echo[:3]) + [lp0]
+    for _ in range(5):
+        toks, lp = eng.step()
+        lps.append(float(lp[slot]))
+    np.testing.assert_allclose(np.asarray(lps),
+                               np.asarray(lps_ref)[0][:9], atol=1e-4)
+
+
+def test_engine_rejects_unsupported_configs():
+    model, params = _make_lm(attention_window=8)
+    with pytest.raises(ValueError, match="dense cache"):
+        SlotDecodeEngine(model, params, slots=2, slot_len=14)
+    model, params = _make_lm()
+    with pytest.raises(ValueError, match="max_seq_len"):
+        SlotDecodeEngine(model, params, slots=2, slot_len=64)
+
+
+def test_admit_requires_free_slot(lm):
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=1, slot_len=14)
+    eng.admit(np.array([1, 2], np.int32), 2)
+    with pytest.raises(RuntimeError, match="free slot"):
+        eng.admit(np.array([3, 4], np.int32), 2)
+
+
+def test_score_consumes_no_slot(lm):
+    """Scoring (prompt echo logprobs) rides the prefill program only
+    and matches decode(return_logprobs=True)'s echo region."""
+    model, params = lm
+    prompt = np.array([2, 4, 6, 8], np.int32)
+    eng = SlotDecodeEngine(model, params, slots=1, slot_len=14)
+    echo = eng.score(prompt, 4)
+    assert eng.free_slots() == 1
+    _, lps_ref = decode(model, params, jnp.asarray(prompt[None]), 1,
+                        return_logprobs=True)
+    np.testing.assert_allclose(echo[:4], np.asarray(lps_ref)[0][:4],
+                               atol=1e-4)

@@ -158,10 +158,12 @@ def test_generate_sampling_and_batch(lm_server):
 
 def test_generate_span_tree_on_debug_trace(lm_server):
     """One generate request produces a nested span tree — request ->
-    admission/wait on the handler thread, batch -> decode parented
-    across threads into the same trace — retrievable from the
-    serving port's own /debug/trace, with the request latency in
-    the serving_request_latency_seconds histogram."""
+    admission/wait on the handler thread, with the engine thread's
+    admission prefill and decode steps parented across threads into
+    the same trace — retrievable from the serving port's own
+    /debug/trace, with the request latency in the
+    serving_request_latency_seconds histogram and the per-step
+    occupancy in tpu_serving_slot_occupancy."""
     from container_engine_accelerators_tpu import obs
 
     obs.TRACER.reset()
@@ -175,23 +177,27 @@ def test_generate_span_tree_on_debug_trace(lm_server):
     for s in trace["spans"]:
         spans.setdefault(s["name"], s)
     for name in ("serving.request", "serving.admission",
-                 "serving.wait", "serving.batch", "serving.decode"):
+                 "serving.wait", "serving.prefill",
+                 "serving.engine_step"):
         assert name in spans, sorted(spans)
     req = spans["serving.request"]
-    assert spans["serving.batch"]["trace_id"] == req["trace_id"]
-    assert spans["serving.decode"]["trace_id"] == req["trace_id"]
-    assert (spans["serving.decode"]["parent_id"]
-            == spans["serving.batch"]["span_id"])
-    assert spans["serving.decode"]["attrs"]["mode"] == "greedy"
+    assert spans["serving.prefill"]["trace_id"] == req["trace_id"]
+    assert spans["serving.engine_step"]["trace_id"] == req["trace_id"]
+    assert spans["serving.engine_step"]["attrs"]["slots_active"] >= 1
     assert not trace["open_spans"]
     text = obs.prometheus_text(obs.TRACER)
     assert "serving_request_latency_seconds_bucket" in text
+    assert "tpu_serving_slot_occupancy_bucket" in text
 
 
-def test_generate_cross_request_batching():
-    """Concurrent same-bucket generate requests share one decode
-    call — even with different temperatures AND different true
-    prompt lengths, which ride as per-row vectors."""
+def test_generate_cross_request_sharing_on_engine():
+    """Concurrent generate requests — different temperatures,
+    different true prompt lengths, different BUCKETS — share the one
+    slot pool: both come back correct and /stats reports the engine's
+    occupancy fields (batch_occupancy_avg, slots_active, queue
+    depth). Requests arriving while the pool is mid-decode admit
+    in-flight instead of waiting a batch boundary, so the pool sees
+    multi-row steps whenever lifetimes overlap."""
     import threading
 
     from container_engine_accelerators_tpu.models import TransformerLM
@@ -205,16 +211,8 @@ def test_generate_cross_request_batching():
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     srv = GenerationServer("lm", model, params, port=0,
-                           max_new_tokens=8, max_batch=4,
-                           max_wait_ms=300)
-    calls = []
-    inner = srv._decode
-
-    def counting_decode(*args, **kwargs):
-        calls.append(kwargs.get("temperature"))
-        return inner(*args, **kwargs)
-
-    srv._decode = counting_decode
+                           max_new_tokens=8, max_batch=4)
+    assert srv._engine_service is not None
     srv.start()
     try:
         results = {}
@@ -222,7 +220,7 @@ def test_generate_cross_request_batching():
         def fire(tag, prompt, temp):
             results[tag] = post(
                 srv, "/v1/models/lm:generate",
-                {"prompts": [prompt], "max_new_tokens": 4,
+                {"prompts": [prompt], "max_new_tokens": 8,
                  "temperature": temp})
 
         threads = [
@@ -234,20 +232,23 @@ def test_generate_cross_request_batching():
             t.start()
         for t in threads:
             t.join()
-        assert len(calls) == 1, calls  # one decode for both requests
-        temps = sorted(np.asarray(calls[0])[:2].tolist())
-        np.testing.assert_allclose(temps, [0.7, 1.3], rtol=1e-6)
-        assert len(results["a"]["sequences"][0]) == 7
+        assert len(results["a"]["sequences"][0]) == 11
         assert results["a"]["sequences"][0][:3] == [1, 2, 3]
-        assert len(results["b"]["sequences"][0]) == 8
+        assert len(results["b"]["sequences"][0]) == 12
         assert results["b"]["sequences"][0][:4] == [4, 5, 6, 7]
         with urllib.request.urlopen(
                 f"http://localhost:{srv.port}/stats",
                 timeout=10) as resp:
             stats = json.loads(resp.read())
-        assert stats["decode_calls"] == 1
-        assert stats["decode_rows"] == 2
-        assert stats["avg_batch_occupancy"] == 2.0
+        assert stats["engine_steps"] >= 1
+        assert stats["rows_decoded"] >= stats["engine_steps"]
+        assert stats["batch_occupancy_avg"] is not None
+        assert stats["avg_batch_occupancy"] \
+            == stats["batch_occupancy_avg"]
+        assert stats["slots_active"] == 0
+        assert stats["slots_free"] == 4
+        assert stats["queue_depth"] == 0
+        assert stats["requests_retired"] == 2
     finally:
         srv.stop()
 
@@ -301,9 +302,11 @@ def test_train_checkpoint_serve_roundtrip(tmp_path):
                for g, f in zip(got, fresh))
 
 
-def test_generate_warm_compiles_both_modes():
-    """warm=True runs one greedy and one sampling decode per bucket
-    before traffic, as the class docstring promises."""
+def test_generate_warm_compiles_engine_programs():
+    """warm=True (engine mode) runs one warm request per bucket
+    through the slot engine — compiling every prefill program plus
+    the insert/step pair — then resets the occupancy counters so
+    /stats describes real traffic only."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -317,22 +320,24 @@ def test_generate_warm_compiles_both_modes():
     srv = GenerationServer("lm", model, params, port=0,
                            max_new_tokens=8, max_batch=2,
                            buckets=[8, 16], warm=True)
-    assert srv._decode_calls == 4  # 2 buckets x (greedy + sampling)
+    assert srv._ready.is_set()
+    assert srv.stats()["engine_prefills"] == 0  # warm traffic reset
     srv.start()
     try:
         out = post(srv, "/v1/models/lm:generate",
                    {"prompts": [[1, 2, 3]], "max_new_tokens": 2})
         assert len(out["sequences"][0]) == 5
+        assert srv.stats()["engine_prefills"] == 1
     finally:
         srv.stop()
 
 
-def test_generate_warm_filters_compile_variants():
-    """warm_filters must precompile the sampling-filter/penalty
-    variants a config uses (VERDICT r2 weak #5): one extra decode
-    per bucket per filter spec, and a matching live request then
-    reuses the program (decode_calls grows by exactly the request's
-    one batched call, not a compile-triggering variant miss)."""
+def test_engine_honors_exact_top_k():
+    """The engine's per-row top_k is traced data, not a compiled
+    shape, so the client's EXACT k applies (no power-of-two
+    quantization): top_k=1 sampling is a point mass and must
+    reproduce greedy output token-for-token — proof the filter
+    reached the step program unquantized."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -346,19 +351,16 @@ def test_generate_warm_filters_compile_variants():
     srv = GenerationServer(
         "lm", model, params, port=0, max_new_tokens=8, max_batch=2,
         buckets=[8], warm=True,
-        warm_filters=[{"top_k": 3, "top_p": 0.9},
-                      {"logprobs": True, "temperature": 0.0}])
-    # 1 bucket x (greedy + sampling + 2 filter specs).
-    assert srv._decode_calls == 4
+        warm_filters=[{"top_k": 3, "top_p": 0.9}])  # accepted, inert
     assert srv._ready.is_set()
     srv.start()
     try:
-        out = post(srv, "/v1/models/lm:generate",
-                   {"prompts": [[1, 2, 3]], "max_new_tokens": 2,
-                    "temperature": 0.9, "top_k": 3, "top_p": 0.9})
-        assert len(out["sequences"][0]) == 5
-        # top_k 3 quantizes to 4 — same grid the warm spec used.
-        assert srv._decode_calls == 5
+        greedy = post(srv, "/v1/models/lm:generate",
+                      {"prompts": [[1, 2, 3]], "max_new_tokens": 6})
+        topk1 = post(srv, "/v1/models/lm:generate",
+                     {"prompts": [[1, 2, 3]], "max_new_tokens": 6,
+                      "temperature": 1.0, "top_k": 1})
+        assert greedy["sequences"] == topk1["sequences"]
     finally:
         srv.stop()
 
@@ -398,7 +400,9 @@ def test_generate_async_warm_gates_healthz():
             time.sleep(0.1)
         with urllib.request.urlopen(url, timeout=10) as resp:
             assert json.loads(resp.read())["status"] == "ok"
-        assert srv._decode_calls == 4  # 2 buckets x (greedy+sampling)
+        out = post(srv, "/v1/models/lm:generate",
+                   {"prompts": [[1, 2, 3]], "max_new_tokens": 2})
+        assert len(out["sequences"][0]) == 5
     finally:
         srv.stop()
 
@@ -747,6 +751,10 @@ def test_admission_budget_shared_across_variant_batchers():
 
 
 def test_generation_server_batchers_share_admission():
+    """Batch mode (a windowed model keeps the legacy batcher path):
+    every program-variant batcher shares the server's one admission
+    budget. Engine mode shares the same budget by construction (one
+    service); a windowed model pins the batcher side."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -754,12 +762,13 @@ def test_generation_server_batchers_share_admission():
 
     model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
                           num_heads=4, max_seq_len=32,
-                          dtype=jnp.float32)
+                          attention_window=8, dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     srv = GenerationServer("lm", model, params, port=0,
                            max_new_tokens=8, max_batch=2, buckets=[8])
     try:
+        assert srv._engine_service is None  # windowed -> batch mode
         b_greedy = srv._batcher_for(8, False, 0)
         b_sample = srv._batcher_for(8, True, 0)
         assert b_greedy._admission is srv._admission
@@ -1245,13 +1254,13 @@ def test_stream_largest_bucket_fits_budget(prefix_server):
     assert got == one["sequences"][0][20:]
 
 
-def test_stream_warm_filter_precompiles():
-    """Stream warm specs compile each bucket's COMPLETE stream
-    program set — every horizon x use_eos on/off (ADVICE r4: eos is
-    a static jit arg, so an unwarmed eos variant would stall the
-    first eos-bearing stream on a compile) — and the warm
-    composition is pinned exactly, so deleting the stream branch (or
-    draining full streams again) fails this test."""
+def test_stream_rides_warmed_engine_programs():
+    """Engine streams need NO extra compiled programs: a stream is an
+    ordinary slot whose tokens are forwarded per step, so after warm
+    (prefill programs + insert/step) a streaming request — eos-
+    bearing included — runs without growing the program set
+    (engine_prefills counts one admission, and the stream arrives
+    one token per line)."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -1262,27 +1271,129 @@ def test_stream_warm_filter_precompiles():
                           dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
-    # max_new 24, STREAM_CHUNK 16 -> chunk 16, rem 8, max_new < 2*16:
-    # per bucket one stream pass is first(16) + remainder(8) = 2
-    # calls, and each spec warms the pass twice (eos=None + eos set)
-    # = 4 calls. Buckets for max_prompt 40: [16, 32, 40] -> 3
-    # buckets. Default warm = 2 calls/bucket; two stream specs
-    # (greedy + sampling) add 2*4 calls/bucket:
-    # total 3 * (2 + 8) = 30.
     srv = GenerationServer(
         "lm-ws", model, params, port=0, max_new_tokens=24,
         max_batch=2, warm=True,
         warm_filters=[{"stream": True, "temperature": 0},
-                      {"stream": True}])
+                      {"stream": True}])  # accepted, inert in engine
     srv.start()
     try:
-        assert srv.stats()["decode_calls"] == 30
+        assert srv.stats()["engine_prefills"] == 0  # reset post-warm
         lines = _post_stream(srv, "/v1/models/lm-ws:generate",
                              {"prompts": [[1, 2, 3]],
-                              "max_new_tokens": 6, "stream": True})
+                              "max_new_tokens": 6, "stream": True,
+                              "eos_id": 63})
         assert lines[-1] == {"done": True}
         got = [t for line in lines[:-1] for t in line["tokens"]]
-        assert len(got) == 6
+        assert 1 <= len(got) <= 6
+        assert all(len(line["tokens"]) == 1 for line in lines[:-1])
+        assert srv.stats()["engine_prefills"] == 1
+    finally:
+        srv.stop()
+
+
+def test_stream_close_mid_stream_releases_slot():
+    """_StreamBody.close() mid-stream cancels the engine work: the
+    slot retires at the next step boundary with no leak —
+    slots_free returns to max, the admission permit frees, and the
+    pool keeps serving."""
+    import time
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm-sc", model, params, port=0,
+                           max_new_tokens=48, max_batch=2,
+                           buckets=[8])
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://localhost:{srv.port}/v1/models/lm-sc:generate",
+            data=json.dumps({"prompts": [[1, 2, 3]],
+                             "max_new_tokens": 48,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        # Read a couple of lines mid-stream, then abandon the
+        # connection with most of the horizon unserved.
+        for _ in range(2):
+            resp.readline()
+        resp.close()
+        deadline = time.monotonic() + 30
+        while True:
+            stats = srv.stats()
+            if (stats["slots_free"] == 2 and stats["slots_active"] == 0
+                    and stats["queue_depth"] == 0):
+                break
+            assert time.monotonic() < deadline, stats
+            time.sleep(0.1)
+        # The freed slot (and admission permit) serve the next
+        # request.
+        out = post(srv, "/v1/models/lm-sc:generate",
+                   {"prompts": [[4, 5]], "max_new_tokens": 4})
+        assert len(out["sequences"][0]) == 6
+    finally:
+        srv.stop()
+
+
+def test_engine_eos_recycles_slot_under_load():
+    """A 1-slot pool with a queued request behind an EOS-terminating
+    stream: the first request's early retirement hands its slot to
+    the queued one without waiting out the horizon — steps stay far
+    under two full budgets (run-to-completion cost)."""
+    import threading
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm-re", model, params, port=0,
+                           max_new_tokens=32, max_batch=1,
+                           buckets=[8])
+    srv.start()
+    try:
+        # Discover A's second generated token and use it as A's EOS:
+        # A then retires after 2 of its 32-token budget.
+        probe = post(srv, "/v1/models/lm-re:generate",
+                     {"prompts": [[1, 2, 3]], "max_new_tokens": 2})
+        eos = probe["sequences"][0][4]
+        base = srv.stats()["engine_steps"]
+        results = {}
+
+        def fire(tag, payload):
+            results[tag] = post(srv, "/v1/models/lm-re:generate",
+                                payload)
+
+        t_a = threading.Thread(target=fire, args=(
+            "a", {"prompts": [[1, 2, 3]], "max_new_tokens": 32,
+                  "eos_id": eos}))
+        t_b = threading.Thread(target=fire, args=(
+            "b", {"prompts": [[4, 5, 6]], "max_new_tokens": 4}))
+        t_a.start()
+        t_a.join(timeout=0.0)  # let A hit the queue first
+        t_b.start()
+        t_a.join()
+        t_b.join()
+        seq_a = results["a"]["sequences"][0]
+        assert eos in seq_a[3:]  # early EOS, padded to the horizon
+        assert len(results["b"]["sequences"][0]) == 7
+        steps = srv.stats()["engine_steps"] - base
+        # Run-to-completion would cost ~31 + 3 steps; early retire +
+        # recycle keeps it near 2 + 3 (slack for scheduling skew).
+        assert steps <= 15, steps
     finally:
         srv.stop()
 

@@ -100,12 +100,25 @@ def main(argv=None):
                         "path) instead of one compiled scan — the "
                         "row quantifies the chunked-decode tax vs "
                         "one-shot")
+    p.add_argument("--engine", action="store_true",
+                   help="decode through the continuous-batching "
+                        "slot engine (models.decode.SlotDecodeEngine"
+                        "): per-bucket admission prefill + one "
+                        "jitted step per token — the row quantifies "
+                        "the per-step dispatch tax the engine pays "
+                        "for in-flight admission vs the one-shot "
+                        "compiled scan")
     args = p.parse_args(argv)
     if args.prefix_len and args.speculative_k:
         p.error("--prefix-len does not compose with --speculative-k")
     if args.stream_chunk and (args.speculative_k or args.prefix_len):
         p.error("--stream-chunk does not compose with "
                 "--speculative-k/--prefix-len")
+    if args.engine and (args.speculative_k or args.prefix_len
+                        or args.stream_chunk
+                        or args.attention_window):
+        p.error("--engine does not compose with --speculative-k/"
+                "--prefix-len/--stream-chunk/--attention-window")
 
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.models.decode import decode
@@ -227,6 +240,32 @@ def main(argv=None):
                 last = block
             return last
 
+    engine_extra = {}
+    if args.engine:
+        from container_engine_accelerators_tpu.models.decode import (
+            SlotDecodeEngine,
+        )
+
+        engine_extra = {"engine": True}
+        engines = {}
+
+        def run(prompt):
+            b = prompt.shape[0]
+            eng = engines.get(b)
+            if eng is None:
+                eng = engines[b] = SlotDecodeEngine(
+                    model, params, b,
+                    args.prompt_len + args.new_tokens)
+            slots = [eng.admit(prompt[i], args.prompt_len)[0]
+                     for i in range(b)]
+            last = None
+            for _ in range(args.new_tokens - 1):
+                last, _ = eng.step()
+            for slot in slots:
+                eng.release(slot)
+            return jnp.asarray(last if last is not None
+                               else jnp.zeros((b,), jnp.int32))
+
     for b in args.batch:
         prompt = jax.random.randint(
             jax.random.PRNGKey(1), (b, args.prompt_len), 0,
@@ -280,6 +319,7 @@ def main(argv=None):
             **spec,
             **prefix_extra,
             **stream_extra,
+            **engine_extra,
         }))
 
 

@@ -100,7 +100,7 @@ def promote_serving(raw_path, stats_path, out_path):
     if stats.get("platform") != "tpu":
         raise Refused(
             f"server platform {stats.get('platform')!r}, want tpu")
-    _write_atomic(out_path, {
+    out = {
         "config": {
             "model": "transformer", "max_new_tokens": 32,
             "max_prompt_len": 48, "parallelism": 8,
@@ -110,7 +110,17 @@ def promote_serving(raw_path, stats_path, out_path):
         "steady_state": raw["warm"],
         "server_platform": stats.get("platform"),
         "provenance": stamp(stats.get("devices") or []),
-    })
+    }
+    # Batching-efficiency fields, first-class (they replaced the old
+    # free-text server_stats_note): the slot engine's occupancy is
+    # the number the continuous-batching work exists to move, so the
+    # artifact must carry it when the server reports it.
+    engine_stats = {k: stats[k] for k in (
+        "batch_occupancy_avg", "slots_active", "slots_free",
+        "queue_depth", "engine_steps", "rows_decoded") if k in stats}
+    if engine_stats:
+        out["server_stats"] = engine_stats
+    _write_atomic(out_path, out)
 
 
 def main(argv):

@@ -88,6 +88,17 @@ timeout -k 10 120 python tools/diagnose_check.py \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "diagnose-check preflight"
 
+# Continuous-batching preflight (CPU fake backend, ~1 min): the slot
+# engine must beat the sequential-batch policy >= 2x in goodput on a
+# replayed Poisson trace with greedy outputs bit-identical to
+# per-request decode. A regression here means the serving bench
+# below would capture engine numbers that don't hold.
+echo "[suite] occupancy-check preflight" >&2
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python tools/bench_serving_occupancy.py --check \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "occupancy-check preflight"
+
 # ---------------------------------------------------------------------
 # 1. Serving bench — the stalest artifact: no warmed capture has ever
 #    landed (the committed SERVING_BENCH.json predates round 3's
