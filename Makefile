@@ -48,6 +48,14 @@ trace-check:
 diagnose-check:
 	python3 tools/diagnose_check.py
 
+# Efficiency-accounting guard: a synthetic journal with known
+# compile/data-wait/step timings must replay to the exact goodput
+# ratio (buckets summing to wall within 1%), and a real tiny Trainer
+# on the CPU fake backend must produce the analytic 6NBS FLOPs
+# fallback exactly + publish the MFU gauge. Pure CPU, seconds.
+goodput-check:
+	JAX_PLATFORMS=cpu python3 tools/goodput_check.py
+
 # Continuous-batching regression guard: replay one Poisson arrival
 # trace through the slot engine (real decode, CPU fake backend) and
 # the pre-engine sequential-batch policy; fail unless engine goodput
@@ -80,5 +88,5 @@ clean:
 	$(MAKE) -C demo/tpu-error clean
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
-	trace-check diagnose-check occupancy-check container \
-	partition-tpu push clean
+	trace-check diagnose-check goodput-check occupancy-check \
+	container partition-tpu push clean

@@ -1122,6 +1122,27 @@ class SlotDecodeEngine:
             raise ValueError("need slots >= 1 and slot_len >= 2")
         self._base_model = model
         self._params = params
+        # Parameter counts: the 2·N-FLOPs-per-token analytic basis
+        # the serving loop's tpu_decode_mfu gauge rates against
+        # (obs.efficiency.transformer_decode_flops). For MoE models
+        # a decoded token executes only top_k of num_experts expert
+        # MLPs, so expert-stacked leaves (leading dim ==
+        # num_experts, rank >= 3 — w_in/w_out; the [d, E] router
+        # gate is fully used) count at k/E weight in
+        # ``active_param_count`` — rating against the TOTAL count
+        # would overstate MFU by ~E/k.
+        leaves = jax.tree_util.tree_leaves(params)
+        self.param_count = sum(int(p.size) for p in leaves)
+        experts = int(getattr(model, "num_experts", 0) or 0)
+        top_k = int(getattr(model, "top_k", 0) or 0)
+        if experts and top_k and top_k < experts:
+            self.active_param_count = sum(
+                (int(p.size) * top_k // experts
+                 if getattr(p, "ndim", 0) >= 3
+                 and p.shape[0] == experts else int(p.size))
+                for p in leaves)
+        else:
+            self.active_param_count = self.param_count
         self._step_model = _decode_clone(model).clone(
             per_row_index=True)
         self.slots = int(slots)

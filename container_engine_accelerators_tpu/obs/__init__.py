@@ -32,12 +32,23 @@ processes, span context propagates over gRPC metadata
 (obs.propagate + obs.grpc_client inject / obs.grpc_interceptor
 extract), merge_perfetto joins many processes' journals into one
 timeline, and obs.postmortem flushes the journal at signal/fault
-time. obs.straggler watches per-host step-time skew. Keep this
-module dependency-free: the plugin path must import it without jax,
-and the serving path without grpc (the grpc client/server
-interceptors stay in their own modules for that reason).
+time. obs.straggler watches per-host step-time skew. obs.efficiency
+holds the MFU/goodput ledgers, obs.memory samples allocator HBM
+stats, and obs.profiler serves the /debug/profile one-at-a-time
+capture. Keep this module dependency-free: the plugin path must
+import it without jax (efficiency/memory/profiler import jax only
+lazily, inside calls), and the serving path without grpc (the grpc
+client/server interceptors stay in their own modules for that
+reason).
 """
 
+from .efficiency import (
+    FlopsLedger,
+    GoodputLedger,
+    flops_from_cost_analysis,
+    peak_flops_per_chip,
+    report_from_snapshots,
+)
 from .export import (
     dump_json,
     merge_perfetto,
@@ -47,6 +58,7 @@ from .export import (
 )
 from .http import TRACE_PATH, VARZ_PATH, debug_response
 from .identity import identity, process_label, set_role
+from .profiler import PROFILE_PATH, profile_response
 from .propagate import (
     TRACEPARENT_KEY,
     context_from_metadata,
@@ -95,11 +107,14 @@ def enabled():
 
 
 __all__ = [
-    "DEFAULT_BUCKETS", "NULL_SPAN", "Histogram", "Span", "TRACEPARENT_KEY",
-    "TRACER", "TRACE_PATH", "Tracer", "VARZ_PATH",
-    "context_from_metadata", "counter", "debug_response", "dump_json",
-    "enabled", "event", "format_traceparent", "gauge", "get_tracer",
-    "histogram", "identity", "merge_perfetto", "parse_traceparent",
-    "perfetto_trace", "process_label", "prometheus_text", "set_role",
-    "span", "varz", "write_journal",
+    "DEFAULT_BUCKETS", "FlopsLedger", "GoodputLedger", "Histogram",
+    "NULL_SPAN", "PROFILE_PATH", "Span", "TRACEPARENT_KEY", "TRACER",
+    "TRACE_PATH", "Tracer", "VARZ_PATH", "context_from_metadata",
+    "counter", "debug_response", "dump_json", "enabled", "event",
+    "flops_from_cost_analysis", "format_traceparent", "gauge",
+    "get_tracer", "histogram", "identity", "merge_perfetto",
+    "parse_traceparent", "peak_flops_per_chip", "perfetto_trace",
+    "process_label", "profile_response", "prometheus_text",
+    "report_from_snapshots", "set_role", "span", "varz",
+    "write_journal",
 ]

@@ -1,0 +1,432 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Efficiency accounting: MFU and goodput ledgers.
+
+The reference stack's utilization story stops at the chip (duty
+cycle, HBM used — plugin/metrics.py); this module answers the fleet
+operator's question one level up: *what fraction of the hardware's
+peak is the WORKLOAD getting* (MFU), and *what fraction of wall time
+is productive training* (goodput). MISO/ParvaGPU-style placement
+decisions (PAPERS.md) are only as good as this accounting beneath
+them.
+
+Two ledgers, one journal:
+
+  - ``FlopsLedger``: model FLOPs per step (from
+    jit(...).lower(...).cost_analysis(), or the analytic 6·N·B·S
+    transformer fallback) divided by wall time and per-chip peak
+    FLOPs (``TPU_PEAK_FLOPS`` generation table, overridable with
+    ``CEA_TPU_PEAK_FLOPS``), published as the ``tpu_train_mfu`` /
+    ``tpu_decode_mfu`` gauges.
+  - ``GoodputLedger``: attributes every wall-clock second of a run to
+    exactly ONE bucket — productive step, compile, data wait,
+    checkpoint, restart/recovery, straggler stall, or ``other``
+    (unattributed remainder, so the buckets always sum to wall time)
+    — published as ``tpu_train_goodput_ratio`` plus the per-bucket
+    ``tpu_train_badput_seconds{bucket=...}`` breakdown.
+
+``report_from_snapshots`` replays the same attribution OFFLINE over
+journal snapshots (live /debug/trace payloads or CEA_TPU_TRACE_FILE
+files) — the engine behind ``tools/goodput_report.py`` and the
+diagnose bundle's goodput section.
+
+This module must import without jax (the plugin path imports obs
+jax-free); anything touching a backend is the caller's job — the
+ledgers take plain numbers.
+"""
+
+import os
+import threading
+import time
+
+from .trace import get_tracer
+
+TRAIN_MFU_GAUGE = "tpu_train_mfu"
+DECODE_MFU_GAUGE = "tpu_decode_mfu"
+GOODPUT_GAUGE = "tpu_train_goodput_ratio"
+BADPUT_GAUGE = "tpu_train_badput_seconds"
+
+# Per-chip dense peak FLOP/s at the training-relevant precision
+# (bf16). Public per-generation numbers; matched by SUBSTRING against
+# jax's ``device.device_kind`` (e.g. "TPU v5 lite", "TPU v4"), longest
+# key first so "v5 lite" wins over "v5". CEA_TPU_PEAK_FLOPS overrides
+# the whole table — the escape hatch for new generations and for
+# deliberately rating against a different precision.
+TPU_PEAK_FLOPS = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+PEAK_FLOPS_ENV = "CEA_TPU_PEAK_FLOPS"
+
+# Every second of a run lands in exactly one of these. "productive"
+# is the only goodput bucket; "other" is the unattributed remainder
+# (host-side orchestration, eval, idle) that keeps the sum honest.
+GOODPUT_BUCKETS = ("productive", "compile", "data_wait", "checkpoint",
+                   "restart", "straggler_stall", "other")
+
+# Span name -> bucket for the offline replay; these are the spans the
+# stack already emits (parallel/train.py, parallel/data.py, demo
+# train driver).
+SPAN_BUCKETS = {
+    "train.step_run": "productive",
+    "train.step_compile": "compile",
+    "train.data_wait": "data_wait",
+    "train.checkpoint": "checkpoint",
+}
+
+
+def peak_flops_per_chip(device_kind=None):
+    """Peak FLOP/s for one chip of ``device_kind``, or None when the
+    generation is unknown. The CEA_TPU_PEAK_FLOPS env override wins
+    unconditionally (it is how operators rate new hardware, or rate
+    int8 serving against the int8 peak)."""
+    override = os.environ.get(PEAK_FLOPS_ENV)
+    if override:
+        try:
+            return float(override)
+        except ValueError:
+            pass  # a broken override must not kill telemetry
+    if not device_kind:
+        return None
+    kind = str(device_kind).lower()
+    for key in sorted(TPU_PEAK_FLOPS, key=len, reverse=True):
+        if key in kind:
+            return TPU_PEAK_FLOPS[key]
+    return None
+
+
+def flops_from_cost_analysis(cost):
+    """Total FLOPs out of a ``Lowered.cost_analysis()`` payload.
+
+    jax has returned a dict, a list of one dict per computation, and
+    None-on-unsupported-backend over its releases; normalize all
+    three. Returns None when the payload carries no flops figure —
+    callers then fall back to the analytic estimate."""
+    if cost is None:
+        return None
+    if isinstance(cost, (list, tuple)):
+        total = None
+        for entry in cost:
+            f = flops_from_cost_analysis(entry)
+            if f is not None:
+                total = (total or 0.0) + f
+        return total
+    try:
+        f = cost.get("flops")
+    except AttributeError:
+        return None
+    return float(f) if f else None
+
+
+def transformer_train_flops(param_count, tokens):
+    """Analytic per-step training FLOPs: 6·N·(B·S) — 2N forward +
+    4N backward per token (Kaplan et al.'s accounting), the standard
+    MFU numerator when cost_analysis is unavailable."""
+    return 6.0 * float(param_count) * float(tokens)
+
+
+def transformer_decode_flops(param_count, tokens):
+    """Analytic decode FLOPs: forward-only, 2·N per generated
+    token."""
+    return 2.0 * float(param_count) * float(tokens)
+
+
+class FlopsLedger:
+    """Rolling MFU accounting behind one gauge.
+
+    ``observe(flops, seconds)`` records one step/program dispatch;
+    every ``publish_every`` observations (and on the first) the gauge
+    updates to window-FLOPs / window-seconds / (peak · chips).
+    Without a known peak the ledger still tracks achieved FLOP/s
+    (``achieved_flops``), it just cannot rate it — no gauge is
+    published rather than a made-up one.
+    """
+
+    def __init__(self, gauge=TRAIN_MFU_GAUGE, peak_flops=None,
+                 chips=1, publish_every=32, tracer=None):
+        self._gauge = gauge
+        self.peak_flops = peak_flops
+        self.chips = max(1, int(chips))
+        self._publish_every = max(1, int(publish_every))
+        self._tracer = tracer or get_tracer()
+        self._lock = threading.Lock()
+        self._window_flops = 0.0
+        self._window_seconds = 0.0
+        self._observations = 0
+        self._mfu = None
+        self._achieved = None
+
+    def observe(self, flops, seconds):
+        if seconds <= 0 or flops is None:
+            return
+        with self._lock:
+            self._window_flops += float(flops)
+            self._window_seconds += float(seconds)
+            self._observations += 1
+            due = (self._observations == 1
+                   or self._observations % self._publish_every == 0)
+            if not due:
+                return
+            self._achieved = self._window_flops / self._window_seconds
+            if self.peak_flops:
+                self._mfu = (self._achieved
+                             / (self.peak_flops * self.chips))
+            self._window_flops = 0.0
+            self._window_seconds = 0.0
+            mfu = self._mfu
+        if mfu is not None:
+            # Unrounded: a CPU rig's 1e-8 "MFU" must not flatten to
+            # an indistinguishable-from-broken 0.0 on the gauge.
+            self._tracer.gauge(self._gauge, mfu)
+
+    def mfu(self):
+        with self._lock:
+            return self._mfu
+
+    def achieved_flops(self):
+        """Last window's achieved FLOP/s (peak-independent)."""
+        with self._lock:
+            return self._achieved
+
+    def reset(self):
+        """Drop the window AND the published value — serving's
+        post-warm-up discipline: a compile-laden warm-up observation
+        must not stand as the rig's MFU until real traffic rolls the
+        window."""
+        with self._lock:
+            self._window_flops = 0.0
+            self._window_seconds = 0.0
+            self._observations = 0
+            self._mfu = None
+            self._achieved = None
+
+
+class GoodputLedger:
+    """Wall-clock attribution: every second in exactly one bucket.
+
+    Live use (a Trainer records into it as the run executes): the
+    wall clock starts at construction, ``record(bucket, seconds)``
+    attributes time, and ``summary()`` closes the books — the
+    unattributed remainder lands in ``other``, so the buckets always
+    sum to wall time. When attributions OVERLAP (an async checkpoint
+    riding under compute) the attributed total can exceed wall;
+    summary() then scales every bucket down proportionally, keeping
+    the sum-to-wall invariant over a lying input rather than
+    reporting >100% time.
+    """
+
+    def __init__(self, tracer=None, clock=time.monotonic):
+        self._tracer = tracer or get_tracer()
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Every documented bucket is recordable — "other" included
+        # (an explicit record lands there like any other attribution;
+        # the unattributed remainder is ADDED on top in summary()).
+        self._buckets = {b: 0.0 for b in GOODPUT_BUCKETS}
+        self._started = clock() if clock else None
+        self._wall_override = None
+
+    def record(self, bucket, seconds):
+        if bucket not in self._buckets:
+            raise ValueError(
+                f"unknown goodput bucket {bucket!r}; "
+                f"one of {sorted(self._buckets)}")
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._buckets[bucket] += float(seconds)
+
+    def set_wall(self, seconds):
+        """Pin the wall-time denominator explicitly — the offline
+        replay path, where wall is the journal's observed window, not
+        this process's uptime."""
+        self._wall_override = max(0.0, float(seconds))
+
+    def wall_seconds(self):
+        if self._wall_override is not None:
+            return self._wall_override
+        if self._started is None:
+            return 0.0
+        return max(0.0, self._clock() - self._started)
+
+    def summary(self):
+        """{wall_s, goodput_ratio, buckets:{...}} with buckets
+        summing to wall_s (the ``other`` remainder absorbs
+        unattributed time; proportional rescale absorbs overlap)."""
+        wall = self.wall_seconds()
+        with self._lock:
+            buckets = dict(self._buckets)
+        attributed = sum(buckets.values())
+        if wall <= 0.0:
+            # No observed window: report raw attributions as the
+            # wall so the ratio still means something.
+            wall = attributed
+        if attributed > wall and attributed > 0.0:
+            scale = wall / attributed
+            buckets = {b: v * scale for b, v in buckets.items()}
+            attributed = wall
+        buckets["other"] += max(0.0, wall - attributed)
+        ratio = buckets["productive"] / wall if wall > 0 else None
+        return {
+            "wall_s": round(wall, 6),
+            "goodput_ratio": (round(ratio, 6)
+                              if ratio is not None else None),
+            "buckets": {b: round(buckets[b], 6)
+                        for b in GOODPUT_BUCKETS},
+        }
+
+    def publish(self):
+        """Export the current books as gauges: the goodput ratio plus
+        a per-bucket badput breakdown (everything but productive —
+        productive is the ratio's numerator already)."""
+        s = self.summary()
+        if s["goodput_ratio"] is not None:
+            self._tracer.gauge(GOODPUT_GAUGE, s["goodput_ratio"])
+        for bucket, seconds in s["buckets"].items():
+            if bucket == "productive":
+                continue
+            self._tracer.gauge(BADPUT_GAUGE, round(seconds, 3),
+                               bucket=bucket)
+        return s
+
+
+# -- offline replay ---------------------------------------------------
+
+def _span_window(snapshot):
+    """(start, end) unix bounds of everything this journal observed."""
+    lo = hi = None
+    for span in (snapshot.get("spans") or []) + (
+            snapshot.get("open_spans") or []):
+        start = span.get("start_unix")
+        if start is None:
+            continue
+        dur = span.get("duration_s") or 0.0
+        lo = start if lo is None else min(lo, start)
+        hi = (start + dur) if hi is None else max(hi, start + dur)
+    for ev in snapshot.get("events") or []:
+        t = ev.get("unix")
+        if t is None:
+            continue
+        lo = t if lo is None else min(lo, t)
+        hi = t if hi is None else max(hi, t)
+    return lo, hi
+
+
+def ledger_from_snapshot(snapshot):
+    """Replay ONE journal snapshot into a GoodputLedger.
+
+    Attribution rules (the same semantics the live wiring applies):
+
+      - spans named in SPAN_BUCKETS contribute their duration to the
+        named bucket;
+      - ``train.restart`` events contribute their ``recovery_s``
+        field to the restart bucket (checkpoint-restore on resume);
+      - straggler episodes — a ``straggler.detected`` event until the
+        matching ``straggler.recovered`` (or the journal window's
+        end) — attribute the fleet's *excess wait*,
+        episode_duration · (1 − 1/skew_ratio), to straggler_stall by
+        MOVING it out of productive (the stalled steps were counted
+        as productive by their train.step_run spans, but the fleet
+        only got 1/skew of them), clamped to the productive time the
+        journal actually recorded.
+
+    Wall time is the journal's observed window (first to last span or
+    event); ``other`` absorbs the remainder in summary().
+    """
+    ledger = GoodputLedger(clock=None)
+    lo, hi = _span_window(snapshot)
+    ledger.set_wall((hi - lo) if lo is not None else 0.0)
+    stall = 0.0
+    for span in (snapshot.get("spans") or []) + (
+            snapshot.get("open_spans") or []):
+        bucket = SPAN_BUCKETS.get(span.get("name"))
+        dur = span.get("duration_s")
+        if bucket and dur:
+            ledger.record(bucket, dur)
+    episodes = {}  # host -> detected unix
+    for ev in sorted(snapshot.get("events") or [],
+                     key=lambda e: e.get("unix", 0.0)):
+        name, fields = ev.get("name"), ev.get("fields") or {}
+        if name == "train.restart":
+            rec = fields.get("recovery_s")
+            if rec:
+                ledger.record("restart", float(rec))
+        elif name == "straggler.detected":
+            episodes[fields.get("host")] = (ev.get("unix"),
+                                            fields.get("skew_ratio"))
+        elif name == "straggler.recovered":
+            start = episodes.pop(fields.get("host"), None)
+            if start and start[0] is not None and start[1]:
+                dur = max(0.0, ev.get("unix", start[0]) - start[0])
+                stall += dur * (1.0 - 1.0 / float(start[1]))
+    for started, skew in episodes.values():  # never recovered
+        if started is not None and skew and hi is not None:
+            dur = max(0.0, hi - started)
+            stall += dur * (1.0 - 1.0 / float(skew))
+    if stall > 0.0:
+        # Stall is RECLASSIFIED productive time (the stalled steps
+        # were counted by their train.step_run spans), so it can
+        # never exceed what was recorded as productive — clamping
+        # both sides keeps the books balanced even when the ring
+        # buffer dropped most step spans but kept the episode
+        # events (unrecorded time stays honestly in "other").
+        with ledger._lock:
+            moved = min(stall, ledger._buckets["productive"])
+            ledger._buckets["productive"] -= moved
+            ledger._buckets["straggler_stall"] += moved
+    return ledger
+
+
+def report_from_snapshots(snapshots):
+    """Per-process ledgers + a combined view over several journal
+    snapshots (the tools/goodput_report.py payload). The combined
+    buckets are straight sums — each process's wall is attributed
+    independently, so the combined books still balance."""
+    processes = []
+    combined = {b: 0.0 for b in GOODPUT_BUCKETS}
+    combined_wall = 0.0
+    for snap in snapshots:
+        summary = ledger_from_snapshot(snap).summary()
+        ident = snap.get("identity") or {}
+        processes.append({
+            "identity": {k: ident.get(k)
+                         for k in ("role", "host", "pid")},
+            **summary,
+        })
+        combined_wall += summary["wall_s"]
+        for b in GOODPUT_BUCKETS:
+            combined[b] += summary["buckets"][b]
+    ratio = (combined["productive"] / combined_wall
+             if combined_wall > 0 else None)
+    return {
+        "metric": "goodput_report",
+        "processes": processes,
+        "combined": {
+            "wall_s": round(combined_wall, 6),
+            "goodput_ratio": (round(ratio, 6)
+                              if ratio is not None else None),
+            "buckets": {b: round(combined[b], 6)
+                        for b in GOODPUT_BUCKETS},
+        },
+    }

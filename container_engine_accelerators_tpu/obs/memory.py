@@ -1,0 +1,208 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Live HBM memory telemetry from the WORKLOAD's point of view.
+
+The plugin's per-chip gauges (plugin/metrics.py ``memory_total`` /
+``memory_used``) come from libtpuinfo outside the process; this
+module samples ``device.memory_stats()`` from inside the jax runtime
+— the allocator's own bytes_in_use / peak / limit — which is the
+number an OOM postmortem actually needs. Per-device gauges:
+
+  tpu_hbm_bytes_in_use{device=...}   allocator bytes live right now
+  tpu_hbm_peak_bytes{device=...}     high watermark since process
+                                     start (allocator's own peak, or
+                                     ours when the backend omits it)
+  tpu_hbm_bytes_limit{device=...}    allocator budget
+
+plus a soft-limit pressure event: crossing
+``CEA_TPU_HBM_SOFT_LIMIT`` (fraction of limit, default 0.9) emits
+exactly ONE ``memory.pressure`` journal event per episode, with
+hysteresis (``memory.pressure_recovered`` re-arms it) — the same
+one-event-per-episode discipline as obs.straggler. The monitor
+registers as a postmortem state provider, so an OOM flight record
+carries the last watermarks.
+
+jax is imported lazily inside the sampling call only: importing this
+module stays legal on the jax-free plugin path, where sampling simply
+reports nothing.
+"""
+
+import os
+import threading
+import time
+
+from .trace import get_tracer
+
+IN_USE_GAUGE = "tpu_hbm_bytes_in_use"
+PEAK_GAUGE = "tpu_hbm_peak_bytes"
+LIMIT_GAUGE = "tpu_hbm_bytes_limit"
+PRESSURE_EVENT = "memory.pressure"
+RECOVERED_EVENT = "memory.pressure_recovered"
+
+SOFT_LIMIT_ENV = "CEA_TPU_HBM_SOFT_LIMIT"
+DEFAULT_SOFT_LIMIT = 0.9
+# Hysteresis: a device must drop this far back under the soft limit
+# before another pressure event can fire (fractions of the limit).
+RECOVERY_MARGIN = 0.05
+
+STATE_PROVIDER_NAME = "hbm_memory"
+
+
+def device_memory_stats(devices=None):
+    """{device_label: {bytes_in_use, peak_bytes_in_use, bytes_limit}}
+    for every local device that reports allocator stats. Backends
+    without the API (CPU; older runtimes) simply contribute nothing —
+    an empty dict is the documented degraded answer, never a raise."""
+    if devices is None:
+        try:
+            import jax
+            devices = jax.local_devices()
+        except Exception:
+            return {}
+    out = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out[str(d)] = {
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+        }
+    return out
+
+
+class MemoryMonitor:
+    """Samples allocator stats into gauges + a watermark tracker.
+
+    ``sample(min_interval_s=N)`` is safe on a hot loop: inside the
+    interval it returns the cached stats without touching the
+    backend. All state is behind one lock; sampling from the serving
+    engine loop and /stats handler threads concurrently is fine.
+    """
+
+    def __init__(self, soft_limit=None, tracer=None):
+        if soft_limit is None:
+            try:
+                soft_limit = float(os.environ.get(
+                    SOFT_LIMIT_ENV, DEFAULT_SOFT_LIMIT))
+            except ValueError:
+                soft_limit = DEFAULT_SOFT_LIMIT
+        self.soft_limit = soft_limit
+        self._tracer = tracer or get_tracer()
+        self._lock = threading.Lock()
+        self._watermarks = {}     # device -> peak bytes_in_use seen
+        self._last_sample = {}
+        self._last_sample_t = None
+        self._pressured = set()   # devices in an open episode
+
+    def sample(self, devices=None, min_interval_s=0.0):
+        """Sample every device, publish gauges, update watermarks,
+        and fire/clear pressure episodes. Returns the per-device
+        stats dict (possibly the cached one inside min_interval_s)."""
+        with self._lock:
+            if (min_interval_s and self._last_sample_t is not None
+                    and time.monotonic() - self._last_sample_t
+                    < min_interval_s):
+                return dict(self._last_sample)
+        stats = device_memory_stats(devices)
+        fire = []
+        with self._lock:
+            self._last_sample = stats
+            self._last_sample_t = time.monotonic()
+            for dev, s in stats.items():
+                in_use = s.get("bytes_in_use")
+                limit = s.get("bytes_limit")
+                peak = s.get("peak_bytes_in_use")
+                if in_use is None:
+                    continue
+                mark = max(self._watermarks.get(dev, 0), in_use,
+                           peak or 0)
+                self._watermarks[dev] = mark
+                self._tracer.gauge(IN_USE_GAUGE, in_use, device=dev)
+                self._tracer.gauge(PEAK_GAUGE, mark, device=dev)
+                if not limit:
+                    continue
+                self._tracer.gauge(LIMIT_GAUGE, limit, device=dev)
+                frac = in_use / limit
+                if dev not in self._pressured \
+                        and frac >= self.soft_limit:
+                    self._pressured.add(dev)
+                    fire.append((PRESSURE_EVENT, dev, in_use, limit,
+                                 frac))
+                elif dev in self._pressured and frac <= max(
+                        0.0, self.soft_limit - RECOVERY_MARGIN):
+                    self._pressured.discard(dev)
+                    fire.append((RECOVERED_EVENT, dev, in_use, limit,
+                                 frac))
+        for name, dev, in_use, limit, frac in fire:
+            self._tracer.event(
+                name, device=dev, bytes_in_use=int(in_use),
+                bytes_limit=int(limit), fraction=round(frac, 4),
+                soft_limit=self.soft_limit)
+        return stats
+
+    def watermarks(self):
+        with self._lock:
+            return dict(self._watermarks)
+
+    def totals(self):
+        """Aggregate view for /stats: summed current in-use and
+        summed watermarks across local devices, or Nones when no
+        backend reports allocator stats (CPU; plugin process)."""
+        with self._lock:
+            stats, marks = self._last_sample, self._watermarks
+            in_use = [s["bytes_in_use"] for s in stats.values()
+                      if s.get("bytes_in_use") is not None]
+            return {
+                "hbm_in_use_bytes": sum(in_use) if in_use else None,
+                "hbm_peak_bytes": (sum(marks.values())
+                                   if marks else None),
+            }
+
+    def state(self):
+        """JSON-safe snapshot for the postmortem flight record."""
+        with self._lock:
+            return {
+                "soft_limit": self.soft_limit,
+                "watermarks": dict(self._watermarks),
+                "last_sample": dict(self._last_sample),
+                "pressured": sorted(self._pressured),
+            }
+
+
+_MONITOR = None
+_monitor_lock = threading.Lock()
+
+
+def get_monitor():
+    """The process-wide monitor (one watermark history per process)."""
+    global _MONITOR
+    with _monitor_lock:
+        if _MONITOR is None:
+            _MONITOR = MemoryMonitor()
+        return _MONITOR
+
+
+def install_postmortem_provider(monitor=None):
+    """Register the monitor as a postmortem state provider, so OOM /
+    SIGTERM flight records carry the last HBM watermarks."""
+    from . import postmortem
+
+    postmortem.register_state_provider(
+        STATE_PROVIDER_NAME, (monitor or get_monitor()).state)

@@ -1,0 +1,164 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""On-demand jax.profiler capture behind ``/debug/profile``.
+
+``GET /debug/profile?seconds=N`` on any obs-instrumented HTTP surface
+(every serving server; the plugin metrics port) captures N seconds of
+jax.profiler trace into a fresh directory and answers with the
+artifact path. Three hard rules:
+
+  - ONE capture at a time, process-wide: the profiler is global
+    mutable state in jax, and two overlapping start_trace calls
+    corrupt both. A second concurrent request gets HTTP 409.
+  - the artifact path lands in the journal (``profiler.capture``
+    event), so tools/tpu_diagnose.py can enumerate captures taken
+    during an incident;
+  - where jax.profiler is unavailable (the jax-free plugin process;
+    a backend without profiling), the endpoint DEGRADES to a
+    documented error JSON (HTTP 501), never a traceback.
+
+jax is imported lazily inside the capture only — importing this
+module is legal on the jax-free plugin path.
+"""
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+from .trace import get_tracer
+
+PROFILE_PATH = "/debug/profile"
+CAPTURE_EVENT = "profiler.capture"
+OUT_DIR_ENV = "CEA_TPU_PROFILE_DIR"
+
+DEFAULT_SECONDS = 1.0
+MAX_SECONDS = 60.0
+
+
+class ProfilerBusy(Exception):
+    """A capture is already in progress (the 409 surface)."""
+
+
+class ProfilerUnavailable(Exception):
+    """jax.profiler cannot run in this process (the 501 surface)."""
+
+
+class ProfileCapture:
+    """One-at-a-time guarded jax.profiler trace capture."""
+
+    def __init__(self, tracer=None):
+        self._tracer = tracer or get_tracer()
+        self._lock = threading.Lock()
+        self._captures = 0
+        self._last = None
+
+    def capture(self, seconds=DEFAULT_SECONDS, out_dir=None):
+        """Trace for ``seconds``; returns {artifact, seconds,
+        capture_unix}. Raises ProfilerBusy when a capture is running,
+        ProfilerUnavailable when jax.profiler can't be used here."""
+        seconds = min(max(float(seconds), 0.01), MAX_SECONDS)
+        if not self._lock.acquire(blocking=False):
+            raise ProfilerBusy("profiler capture already in progress")
+        try:
+            try:
+                from jax import profiler as jax_profiler
+            except Exception as e:
+                raise ProfilerUnavailable(
+                    f"jax.profiler not importable here: {e!r}")
+            base = out_dir or os.environ.get(OUT_DIR_ENV) \
+                or tempfile.gettempdir()
+            os.makedirs(base, exist_ok=True)
+            # mkdtemp, not a timestamp name: two sequential captures
+            # inside one second must not interleave into (or
+            # overwrite) a shared directory.
+            artifact = tempfile.mkdtemp(
+                prefix=f"tpu-profile-{int(time.time())}-", dir=base)
+            try:
+                jax_profiler.start_trace(artifact)
+            except Exception as e:
+                raise ProfilerUnavailable(
+                    f"jax.profiler.start_trace failed: {e!r}")
+            try:
+                time.sleep(seconds)
+            finally:
+                # stop_trace must run whatever happens after start —
+                # a leaked running profiler blocks every later
+                # capture AND taxes the workload forever.
+                jax_profiler.stop_trace()
+            result = {"artifact": artifact, "seconds": seconds,
+                      "capture_unix": time.time()}
+            self._captures += 1
+            self._last = result
+            self._tracer.event(CAPTURE_EVENT, artifact=artifact,
+                               seconds=seconds)
+            self._tracer.counter("tpu_profile_captures_total")
+            return result
+        finally:
+            self._lock.release()
+
+    def busy(self):
+        """True while a capture holds the guard (test seam)."""
+        if self._lock.acquire(blocking=False):
+            self._lock.release()
+            return False
+        return True
+
+    def last(self):
+        return self._last
+
+
+# Process-wide: the one-at-a-time guard must span every HTTP surface
+# in the process (a serving server AND the metrics port share jax's
+# one profiler).
+CAPTURE = ProfileCapture()
+
+
+def _parse_seconds(query):
+    for part in (query or "").split("&"):
+        key, _, value = part.partition("=")
+        if key == "seconds":
+            return float(value)
+    return DEFAULT_SECONDS
+
+
+def profile_response(path, query=""):
+    """(http_status, content_type, body_bytes) for /debug/profile, or
+    None when ``path`` is some other endpoint. One shape for every
+    server (the same seam discipline as obs.http.debug_response)."""
+    if path != PROFILE_PATH:
+        return None
+
+    def reply(status, payload):
+        return (status, "application/json",
+                (json.dumps(payload) + "\n").encode())
+
+    try:
+        seconds = _parse_seconds(query)
+    except ValueError:
+        return reply(400, {"error": "seconds must be a number"})
+    try:
+        result = CAPTURE.capture(seconds)
+    except ProfilerBusy as e:
+        return reply(409, {"error": str(e), "busy": True})
+    except ProfilerUnavailable as e:
+        # The documented degraded answer: profiling simply does not
+        # exist in this process (jax-free plugin, backend without
+        # profiler support) — say so, machine-readably.
+        return reply(501, {"error": str(e), "available": False})
+    except Exception as e:  # never a traceback on a debug surface
+        return reply(500, {"error": f"capture failed: {e!r}"})
+    return reply(200, dict(result, ok=True))

@@ -98,6 +98,15 @@ class Histogram:
         with self._lock:
             return list(self.counts), self.sum, self.count
 
+    def reset(self):
+        """Zero IN PLACE: long-lived holders keep their reference
+        and stay wired to the export surface (the Tracer.reset rule;
+        serving's post-warm-up counter reset shares it)."""
+        with self._lock:
+            self.counts = [0] * (len(self.buckets) + 1)
+            self.sum = 0.0
+            self.count = 0
+
     def quantile(self, q):
         """Estimated quantile via linear interpolation inside the
         owning bucket (the Prometheus histogram_quantile method);
@@ -403,10 +412,7 @@ class Tracer:
             self._events.clear()
             self._open.clear()
             for h in self._histograms.values():
-                with h._lock:
-                    h.counts = [0] * (len(h.buckets) + 1)
-                    h.sum = 0.0
-                    h.count = 0
+                h.reset()
             self._counters.clear()
             self._gauges.clear()
             self._dropped_spans = self._dropped_events = 0

@@ -37,6 +37,14 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import obs
+from ..obs.efficiency import (
+    FlopsLedger,
+    GoodputLedger,
+    TRAIN_MFU_GAUGE,
+    flops_from_cost_analysis,
+    peak_flops_per_chip,
+    transformer_train_flops,
+)
 from .mesh import DATA_AXIS, build_mesh
 from .sharding import batch_sharding, param_shardings, replicated
 
@@ -66,7 +74,8 @@ class Trainer:
                  donate_state=True, remat=False, grad_accum=1,
                  augment_fn=None, ema_decay=0.0, fsdp=False,
                  host_id=None, straggler=None,
-                 summary_every=32):
+                 summary_every=32, mfu_source="auto",
+                 goodput=None):
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1: {grad_accum}")
         if not 0.0 <= ema_decay < 1.0:
@@ -114,6 +123,26 @@ class Trainer:
         self._step_window = []
         self._wait_window = []
         self._pending_data_wait = 0.0
+        # Efficiency accounting (obs.efficiency). ``mfu_source``
+        # picks the per-step FLOPs numerator: "auto" tries
+        # cost_analysis on the lowered step and falls back to the
+        # analytic 6·N·B·S estimate, "analytic" forces the fallback,
+        # "off" disables MFU, a number pins it outright. The goodput
+        # ledger starts its wall clock at construction; the demo
+        # driver records checkpoint/restart badput into it via
+        # record_badput(). Both publish at summary_every boundaries
+        # on the traced path.
+        if not (mfu_source in ("auto", "analytic", "off")
+                or isinstance(mfu_source, (int, float))):
+            raise ValueError(
+                f"mfu_source must be auto/analytic/off or a FLOPs "
+                f"count: {mfu_source!r}")
+        self._mfu_source = mfu_source
+        self._flops_per_step = None
+        self._mfu_ledger = None
+        self.goodput = goodput if goodput is not None \
+            else GoodputLedger()
+        self._last_step_end = None
 
     # -- state --------------------------------------------------------
 
@@ -289,10 +318,29 @@ class Trainer:
         no kwargs dicts on the per-step hot path.
         """
         if self._train_step is None:
+            # The jit build is lazy — XLA compiles inside the FIRST
+            # dispatch below, so that whole first call (trace +
+            # compile + run) is attributed to the goodput ledger's
+            # compile bucket, not to productive step time.
+            t0 = time.perf_counter()
             with obs.span("train.step_compile"):
                 self._train_step = self._build_train_step(state)
+                self._resolve_flops(state, batch)
+                out = self._train_step(state, batch)
+            self.goodput.record("compile",
+                                time.perf_counter() - t0)
+            return out
         if not obs.TRACER.enabled and self._straggler is None:
-            return self._train_step(state, batch)
+            # Bare path: no span objects or kwargs dicts — but the
+            # efficiency LEDGERS still record (goodput/MFU follow
+            # the histogram rule: metrics live regardless of the
+            # enabled flag, or a CEA_TPU_TRACE=0 run would report
+            # its compile/data-wait as badput with zero productive
+            # time against it). Two perf_counter reads per step.
+            t0 = time.perf_counter()
+            out = self._train_step(state, batch)
+            self._record_step(time.perf_counter() - t0)
+            return out
         t0 = time.perf_counter()
         with obs.span("train.step_run"):
             out = self._train_step(state, batch)
@@ -311,6 +359,67 @@ class Trainer:
         enough for its single-consumer use (the train loop thread
         both waits on data and steps)."""
         self._pending_data_wait += float(seconds)
+        self.goodput.record("data_wait", seconds)
+
+    def record_badput(self, bucket, seconds):
+        """Attribute non-step wall time (checkpoint, restart
+        recovery...) to the goodput ledger — the driver's seam (the
+        Trainer never sees checkpoints itself)."""
+        self.goodput.record(bucket, seconds)
+
+    def flops_per_step(self):
+        """Model FLOPs one compiled step executes (None before the
+        first compile, or with mfu_source='off')."""
+        return self._flops_per_step
+
+    def _resolve_flops(self, state, batch):
+        """Pin the per-step FLOPs numerator at compile time.
+
+        "auto" asks XLA first — lower() costs one extra trace, and
+        cost_analysis on the unoptimized module is cheap — because
+        the compiler's count covers whatever the step really does
+        (MoE, remat recompute excluded, fused augmentation). The
+        analytic 6·N·B·S fallback covers backends whose
+        cost_analysis is unavailable; grad_accum needs no correction
+        in either form (the microbatches are inside the one step)."""
+        src = self._mfu_source
+        if src == "off":
+            return
+        if isinstance(src, (int, float)):
+            self._flops_per_step = float(src)
+            return
+        if src == "auto":
+            try:
+                cost = self._train_step.lower(
+                    state, batch).cost_analysis()
+                self._flops_per_step = flops_from_cost_analysis(cost)
+            except Exception:
+                self._flops_per_step = None
+        if self._flops_per_step is None:
+            params = jax.tree_util.tree_leaves(state.params)
+            n = sum(int(p.size) for p in params)
+            images = batch[0]
+            # B·S for token models ([B, S] int batches); B for image
+            # models (the "sequence" is one sample).
+            tokens = int(images.shape[0]) * (
+                int(images.shape[1])
+                if images.ndim == 2 else 1)
+            self._flops_per_step = transformer_train_flops(n, tokens)
+
+    def _mfu(self):
+        """Lazily built MFU ledger: peak FLOPs resolve from the
+        mesh's device generation at first use (the backend is
+        guaranteed up by then), rated across every chip in the
+        mesh."""
+        if self._mfu_ledger is None:
+            devices = self.mesh.devices
+            kind = getattr(devices.flat[0], "device_kind", None)
+            self._mfu_ledger = FlopsLedger(
+                gauge=TRAIN_MFU_GAUGE,
+                peak_flops=peak_flops_per_chip(kind),
+                chips=int(devices.size),
+                publish_every=self._summary_every)
+        return self._mfu_ledger
 
     def _record_step(self, dt):
         """Per-host step telemetry behind every traced train_step:
@@ -323,12 +432,31 @@ class Trainer:
         wait, self._pending_data_wait = self._pending_data_wait, 0.0
         if self._straggler is not None:
             self._straggler.observe(host, dt, wait)
+        self.goodput.record("productive", dt)
+        if self._flops_per_step:
+            # MFU's denominator is WALL time between step
+            # completions, not dispatch time: on an async backend
+            # dispatch returns before the device finishes, and the
+            # gap to the next step is where the device actually
+            # computed. The first recorded step has no predecessor —
+            # it only anchors the clock (its dispatch time would
+            # inflate MFU by orders of magnitude on async backends).
+            now = time.perf_counter()
+            if self._last_step_end is not None:
+                self._mfu().observe(self._flops_per_step,
+                                    now - self._last_step_end)
+            self._last_step_end = now
+        self._steps_seen += 1
+        boundary = self._steps_seen % self._summary_every == 0
+        if boundary:
+            # Gauges follow the histogram rule — they export on
+            # every scrape whether or not span recording is on.
+            self.goodput.publish()
         if not obs.TRACER.enabled:
             return
         self._step_window.append(dt)
         self._wait_window.append(wait)
-        self._steps_seen += 1
-        if self._steps_seen % self._summary_every:
+        if not boundary or not self._step_window:
             return
         times = sorted(self._step_window)
         waits = sorted(self._wait_window)

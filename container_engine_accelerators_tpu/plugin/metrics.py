@@ -27,7 +27,9 @@ on the duty_cycle metric exactly as the reference's TF-Serving HPA
 does (demo/serving/tensorflow-serving.yaml:62-80).
 """
 
+import http.client
 import os
+import socketserver
 import threading
 import wsgiref.simple_server
 
@@ -144,9 +146,22 @@ class MetricServer:
                       "text/plain; version=0.0.4; charset=utf-8"),
                      ("Content-Length", str(len(body)))])
                 return [body]
+            query = environ.get("QUERY_STRING", "")
+            # /debug/profile carries its own status codes: 409 while
+            # another capture runs, 501 where jax.profiler cannot
+            # (this plugin process is typically jax-free — the
+            # documented degraded answer, never a traceback).
+            prof = obs.profile_response(req_path, query)
+            if prof is not None:
+                status, ctype, body = prof
+                reason = http.client.responses.get(status, "OK")
+                start_response(
+                    f"{status} {reason}",
+                    [("Content-Type", ctype),
+                     ("Content-Length", str(len(body)))])
+                return [body]
             debug = obs.debug_response(obs.get_tracer(), req_path,
-                                       environ.get("QUERY_STRING",
-                                                   ""))
+                                       query)
             if debug is not None:
                 ctype, body = debug
                 start_response("200 OK",
@@ -157,10 +172,16 @@ class MetricServer:
                            [("Content-Type", "text/plain")])
             return [b"not found; metrics at " + path.encode()
                     + b", traces at /debug/trace, vars at "
-                      b"/debug/varz"]
+                      b"/debug/varz, profile at /debug/profile"]
 
+        # Threaded, because /debug/profile holds its handler for the
+        # capture's whole window (up to 60s): on the stock
+        # single-threaded WSGIServer one capture would starve every
+        # concurrent /metrics scrape and debug poll — during an
+        # incident, exactly when both are in use.
         self._httpd = wsgiref.simple_server.make_server(
             "", self._port, routed,
+            server_class=_ThreadingWSGIServer,
             handler_class=_QuietHandler)
         threading.Thread(target=self._httpd.serve_forever,
                          name="tpu-metrics-http", daemon=True).start()
@@ -252,6 +273,11 @@ class MetricServer:
                 # process — and must not fail silently either.
                 self._collect_errors.inc()
                 log.exception("metric collection pass failed")
+
+
+class _ThreadingWSGIServer(socketserver.ThreadingMixIn,
+                           wsgiref.simple_server.WSGIServer):
+    daemon_threads = True
 
 
 class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):

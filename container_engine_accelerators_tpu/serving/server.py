@@ -42,6 +42,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..obs.efficiency import (
+    DECODE_MFU_GAUGE,
+    FlopsLedger,
+    peak_flops_per_chip,
+    transformer_decode_flops,
+)
+from ..obs.memory import get_monitor, install_postmortem_provider
 from ..utils import get_logger
 
 log = get_logger("serving")
@@ -53,6 +60,33 @@ DECODE_HISTOGRAM = "serving_decode_latency_seconds"
 OCCUPANCY_HISTOGRAM = "tpu_serving_slot_occupancy"
 OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
                      1.0)
+# Serving SLO metrics (engine mode): TTFT = admission-queue entry to
+# first token out of the admission prefill; TPOT = gap between
+# consecutive tokens of one row at step-forwarding time. The env
+# thresholds arm the burn counter.
+TTFT_HISTOGRAM = "tpu_serving_ttft_seconds"
+TPOT_HISTOGRAM = "tpu_serving_tpot_seconds"
+SLO_COUNTER = "tpu_serving_slo_violations_total"
+SLO_TTFT_ENV = "CEA_TPU_SLO_TTFT_MS"
+SLO_TPOT_ENV = "CEA_TPU_SLO_TPOT_MS"
+# HBM sampling cadence on the engine loop: allocator stats are a
+# runtime call per device — amortize across steps.
+MEMORY_SAMPLE_INTERVAL_S = 2.0
+
+
+def _slo_threshold_s(env_key):
+    raw = os.environ.get(env_key)
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", env_key, raw)
+        return None
+    # <= 0 disarms, exactly like unset: a 0 threshold would count
+    # every observation as a violation while /stats (where 0.0 is
+    # rendered null) claimed no SLO was armed.
+    return ms / 1e3 if ms > 0 else None
 
 
 def _maybe_enable_compile_cache():
@@ -242,11 +276,12 @@ class _EngineWork:
     __slots__ = ("row", "p_len", "new", "temperature", "top_k",
                  "top_p", "min_p", "rep_pen", "eos_id", "want_lp",
                  "seed", "done", "stream_q", "ctx", "cancel", "slot",
-                 "tokens", "lps", "score_only")
+                 "tokens", "lps", "score_only", "account",
+                 "submit_t", "last_tok_t")
 
     def __init__(self, row, p_len, new, temperature, top_k, top_p,
                  min_p, rep_pen, eos_id, want_lp, seed, ctx,
-                 stream_q=None, score_only=False):
+                 stream_q=None, score_only=False, account=True):
         self.row = row
         self.p_len = p_len
         self.new = new
@@ -266,6 +301,11 @@ class _EngineWork:
         self.tokens = []
         self.lps = []
         self.score_only = score_only
+        # account=False (warm-up's synthetic rows) keeps compile-time
+        # TTFT out of the SLO telemetry, mirroring account_spec.
+        self.account = account
+        self.submit_t = None    # stamped at admission-queue entry
+        self.last_tok_t = None  # previous token's delivery time
 
 
 class _EngineService:
@@ -310,6 +350,28 @@ class _EngineService:
             DECODE_HISTOGRAM,
             "Device decode-call latency by program kind",
             labels={"kind": "engine_prefill"})
+        # Serving SLO telemetry: per-request TTFT + per-token TPOT,
+        # with burn counters against the env thresholds.
+        self._ttft_hist = obs.histogram(
+            TTFT_HISTOGRAM,
+            "Admission-to-first-token latency per request")
+        self._tpot_hist = obs.histogram(
+            TPOT_HISTOGRAM,
+            "Inter-token latency per generated token")
+        self._slo_ttft_s = _slo_threshold_s(SLO_TTFT_ENV)
+        self._slo_tpot_s = _slo_threshold_s(SLO_TPOT_ENV)
+        self._slo_violations = {"ttft": 0, "tpot": 0}
+        # Decode MFU: 2·N analytic FLOPs per active row per step,
+        # rated against this process's device generation. The gauge
+        # only appears when a peak is known (TPU generation table or
+        # CEA_TPU_PEAK_FLOPS) — no made-up ratings on CPU rigs.
+        devices = jax.local_devices()
+        self._mfu = FlopsLedger(
+            gauge=DECODE_MFU_GAUGE,
+            peak_flops=peak_flops_per_chip(
+                getattr(devices[0], "device_kind", None)),
+            chips=len(devices), publish_every=32)
+        self._memory = get_monitor()
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True)
         self._thread.start()
@@ -320,12 +382,14 @@ class _EngineService:
         The _stopping gate is checked under _lock so no work can
         slip into the queue after stop() drained it (a late work
         would leave its handler blocked on done.get() forever)."""
+        now = time.perf_counter()
         with self._lock:
             if self._stopping:
                 return None
             if not self._admission.try_acquire(len(works)):
                 return None
             for work in works:
+                work.submit_t = now  # TTFT clock starts at admission
                 self._queue.put(work)
         return works
 
@@ -333,12 +397,18 @@ class _EngineService:
         with self._lock:
             return self._queue.qsize() + len(self._pending)
 
+    @staticmethod
+    def _q_ms(hist, q):
+        v = hist.quantile(q)
+        return round(v * 1e3, 3) if v is not None else None
+
     def stats(self):
         eng = self._engine
         with self._lock:
             steps, row_steps = eng.steps, eng.row_steps
             active = eng.active_count()
             occ = (round(row_steps / steps, 3) if steps else None)
+            violations = dict(self._slo_violations)
             return {
                 "slots": eng.slots,
                 "slots_active": active,
@@ -351,18 +421,42 @@ class _EngineService:
                 "batch_occupancy_avg": occ,
                 "requests_admitted": self._admitted,
                 "requests_retired": self._retired,
+                # Serving SLO surface: bucket-interpolated TTFT/TPOT
+                # percentiles + the burn counters (null thresholds =
+                # counters armed off).
+                "ttft_p50_ms": self._q_ms(self._ttft_hist, 0.5),
+                "ttft_p99_ms": self._q_ms(self._ttft_hist, 0.99),
+                "tpot_p50_ms": self._q_ms(self._tpot_hist, 0.5),
+                "tpot_p99_ms": self._q_ms(self._tpot_hist, 0.99),
+                "slo": {
+                    "ttft_ms": (self._slo_ttft_s * 1e3
+                                if self._slo_ttft_s else None),
+                    "tpot_ms": (self._slo_tpot_s * 1e3
+                                if self._slo_tpot_s else None),
+                    "violations": violations,
+                },
+                "decode_mfu": self._mfu.mfu(),
             }
 
     def reset_counters(self):
         """Drop warm-up's synthetic traffic from the occupancy
         telemetry (the /stats signal must describe real traffic, the
-        same discipline as speculative acceptance accounting)."""
+        same discipline as speculative acceptance accounting). The
+        TTFT/TPOT histograms are zeroed IN PLACE (warm rows pass
+        account=False, but belt-and-braces: a compile-time TTFT in
+        the p99 would poison the SLO story), and the decode-MFU
+        ledger drops its warm-up window — its compile-laden steps
+        must not stand as the rig's published MFU."""
         with self._lock:
             self._engine.steps = 0
             self._engine.row_steps = 0
             self._engine.prefills = 0
             self._admitted = 0
             self._retired = 0
+            self._slo_violations = {"ttft": 0, "tpot": 0}
+        self._ttft_hist.reset()
+        self._tpot_hist.reset()
+        self._mfu.reset()
 
     def stop(self):
         with self._lock:
@@ -420,8 +514,30 @@ class _EngineService:
             np.zeros((pad,), np.float32)])
         return (seq, lps)
 
+    def _record_slo(self, which, hist, threshold, seconds):
+        hist.observe(seconds)
+        if threshold is not None and seconds > threshold:
+            with self._lock:
+                self._slo_violations[which] += 1
+            obs.counter(SLO_COUNTER, slo=which)
+
     def _deliver(self, work, tok, lp):
         work.tokens.append(tok)
+        if work.account:
+            # First token closes the TTFT clock (admission queue +
+            # prefill); every later token is one TPOT observation
+            # (the step-forwarding gap the client actually sees).
+            now = time.perf_counter()
+            if len(work.tokens) == 1:
+                if work.submit_t is not None:
+                    self._record_slo("ttft", self._ttft_hist,
+                                     self._slo_ttft_s,
+                                     now - work.submit_t)
+            elif work.last_tok_t is not None:
+                self._record_slo("tpot", self._tpot_hist,
+                                 self._slo_tpot_s,
+                                 now - work.last_tok_t)
+            work.last_tok_t = now
         if work.want_lp:
             work.lps.append(lp)
         if work.stream_q is not None:
@@ -510,11 +626,23 @@ class _EngineService:
                     self._finish(work, error=str(e))
                 continue
             finally:
-                self._step_hist.observe(time.perf_counter() - t0)
+                step_dt = time.perf_counter() - t0
+                self._step_hist.observe(step_dt)
             self._occ_hist.observe(active / self._engine.slots)
             obs.gauge("tpu_serving_slots_active", active)
             obs.gauge("tpu_serving_slots_free",
                       self._engine.slots - active)
+            # Decode MFU (2·N FLOPs per active row per step; N =
+            # the ACTIVE param count, so MoE's unrouted experts
+            # don't inflate the ratio) and the HBM watermark sample
+            # ride the same boundary; memory is throttled —
+            # allocator stats are a runtime call.
+            self._mfu.observe(
+                transformer_decode_flops(
+                    self._engine.active_param_count, active),
+                step_dt)
+            self._memory.sample(
+                min_interval_s=MEMORY_SAMPLE_INTERVAL_S)
             if out is None:
                 continue
             toks, lps = out
@@ -557,6 +685,12 @@ class _BaseServer:
         # tunnel the first time /stats is hit.
         self._platform = jax.devices()[0].platform
         self._devices = [str(d) for d in jax.devices()]
+        # HBM telemetry: the process-wide allocator monitor, also
+        # registered as a postmortem state provider so an OOM flight
+        # record carries the last watermarks (idempotent by name —
+        # several servers in one process share the one provider).
+        self._memory_monitor = get_monitor()
+        install_postmortem_provider(self._memory_monitor)
         self._requests = 0
         self._shed = 0
         # Request latency lives in a fixed-bucket histogram (bounded
@@ -583,6 +717,19 @@ class _BaseServer:
 
             def do_GET(self):
                 path, _, query = self.path.partition("?")
+                # /debug/profile first: it carries its own status
+                # codes (409 busy, 501 unavailable), unlike the
+                # always-200 trace/varz surface.
+                prof = obs.profile_response(path, query)
+                if prof is not None:
+                    status, ctype, body = prof
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 debug = obs.debug_response(obs.get_tracer(), path,
                                            query)
                 if debug is not None:
@@ -765,6 +912,12 @@ class _BaseServer:
         plugin_devices = self._plugin_status()
         p50 = self._latency_hist.quantile(0.5)
         p99 = self._latency_hist.quantile(0.99)
+        # Fresh allocator sample (throttled): /stats is the load
+        # harness's one-stop surface, and hbm_peak_bytes is what the
+        # bench artifact promotes. Nones on backends without
+        # memory_stats (CPU) — documented degraded answer.
+        self._memory_monitor.sample(min_interval_s=1.0)
+        hbm = self._memory_monitor.totals()
         with self._stats_lock:
             out = {
                 "requests": self._requests,
@@ -782,6 +935,8 @@ class _BaseServer:
                            if p50 is not None else None),
                 "p99_ms": (round(p99 * 1000, 3)
                            if p99 is not None else None),
+                "hbm_in_use_bytes": hbm["hbm_in_use_bytes"],
+                "hbm_peak_bytes": hbm["hbm_peak_bytes"],
             }
             if plugin_devices is not None:
                 out["plugin_devices"] = plugin_devices
@@ -1180,7 +1335,7 @@ class GenerationServer(_BaseServer):
                 work = _EngineWork(
                     np.zeros((b,), np.int32), b,
                     min(2, self._max_new), 0.0, 0, 1.0, 0.0, 1.0,
-                    -1, False, 0, None)
+                    -1, False, 0, None, account=False)
                 if self._engine_service.submit_many([work]) is None:
                     raise RuntimeError(
                         "warm-up shed by admission control")

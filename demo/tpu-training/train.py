@@ -51,6 +51,7 @@ if os.environ.get("JAX_PLATFORMS"):
 import jax.numpy as jnp
 import optax
 
+from container_engine_accelerators_tpu import obs
 from container_engine_accelerators_tpu.models import (
     InceptionV3,
     MnistMLP,
@@ -766,12 +767,21 @@ def main(argv=None):
                   "skipping checkpointing", file=sys.stderr)
             args.model_dir = ""
         else:
+            t_restore = time.perf_counter()
             state = jax.device_put(restore_checkpoint(args.model_dir, state),
                                    trainer.state_shardings(state))
             # Checkpoints written without EMA restore with
             # ema_params=None; re-seed the shadow from the restored
             # params so tracking just continues.
             state = trainer.ensure_ema(state)
+            recovery_s = time.perf_counter() - t_restore
+            if int(state.step) > 0:
+                # A restored run spent this wall time on recovery:
+                # the goodput ledger's restart bucket, and a journal
+                # event for the offline goodput_report replay.
+                trainer.record_badput("restart", recovery_s)
+                obs.event("train.restart", step=int(state.step),
+                          recovery_s=round(recovery_s, 6))
     if loader is None:
         # Real-data loader, deferred above: resume fast-forwards the
         # shard stream past the batches the restored step already
@@ -806,9 +816,17 @@ def main(argv=None):
             print(f"step {step} loss {loss_val:.4f}", file=sys.stderr)
         if (args.model_dir and args.checkpoint_every
                 and (step + 1) % args.checkpoint_every == 0):
-            save_checkpoint(args.model_dir, state)
-            if args.keep_checkpoints:
-                prune_checkpoints(args.model_dir, args.keep_checkpoints)
+            # The save is async (orbax AsyncCheckpointer): the span
+            # and badput bucket measure the host-blocking dispatch
+            # part, which is what actually steals step time.
+            t_ckpt = time.perf_counter()
+            with obs.span("train.checkpoint", step=step + 1):
+                save_checkpoint(args.model_dir, state)
+                if args.keep_checkpoints:
+                    prune_checkpoints(args.model_dir,
+                                      args.keep_checkpoints)
+            trainer.record_badput("checkpoint",
+                                  time.perf_counter() - t_ckpt)
     wall_sync(state.params)
     t_end = time.perf_counter()
     # A prefetching loader would otherwise keep staged batches pinned

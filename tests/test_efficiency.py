@@ -1,0 +1,570 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Efficiency accounting: MFU/goodput ledgers, HBM memory telemetry,
+on-demand profiler capture, and the serving SLO surface (TTFT/TPOT)
+on a real engine-mode server."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from container_engine_accelerators_tpu import obs
+from container_engine_accelerators_tpu.obs import efficiency, memory
+from container_engine_accelerators_tpu.obs import (
+    postmortem,
+    profiler,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    obs.TRACER.reset()
+    yield
+    obs.TRACER.reset()
+
+
+# -- peak FLOPs + numerators ------------------------------------------
+
+def test_peak_flops_table_and_override(monkeypatch):
+    monkeypatch.delenv(efficiency.PEAK_FLOPS_ENV, raising=False)
+    assert efficiency.peak_flops_per_chip("TPU v4") == 275e12
+    # Longest-match: "v5 lite" must not resolve through the bare
+    # "v5" (v5p-class) entry.
+    assert efficiency.peak_flops_per_chip("TPU v5 lite") == 197e12
+    assert efficiency.peak_flops_per_chip("TPU v5") == 459e12
+    assert efficiency.peak_flops_per_chip("cpu") is None
+    assert efficiency.peak_flops_per_chip(None) is None
+    monkeypatch.setenv(efficiency.PEAK_FLOPS_ENV, "123.5e12")
+    assert efficiency.peak_flops_per_chip("cpu") == 123.5e12
+    monkeypatch.setenv(efficiency.PEAK_FLOPS_ENV, "junk")
+    assert efficiency.peak_flops_per_chip("TPU v4") == 275e12
+
+
+def test_flops_from_cost_analysis_shapes():
+    f = efficiency.flops_from_cost_analysis
+    assert f(None) is None
+    assert f({"bytes accessed": 5.0}) is None
+    assert f({"flops": 1024.0}) == 1024.0
+    assert f([{"flops": 10.0}, {"flops": 5.0}]) == 15.0
+    assert f([{"other": 1}]) is None
+    assert f("not a dict") is None
+
+
+def test_analytic_flops_formulas():
+    assert efficiency.transformer_train_flops(100, 32) == 6 * 100 * 32
+    assert efficiency.transformer_decode_flops(100, 4) == 2 * 100 * 4
+
+
+def test_flops_ledger_publishes_gauge():
+    ledger = efficiency.FlopsLedger(
+        gauge="test_mfu", peak_flops=1000.0, chips=2,
+        publish_every=4)
+    # First observation publishes; achieved = 100/0.1 = 1000 FLOP/s
+    # over peak 1000*2 -> 0.5.
+    ledger.observe(100.0, 0.1)
+    assert ledger.mfu() == pytest.approx(0.5)
+    gauges = {n: v for (n, _), v in obs.TRACER.gauges().items()}
+    assert gauges["test_mfu"] == pytest.approx(0.5)
+    assert ledger.achieved_flops() == pytest.approx(1000.0)
+    # No peak -> no gauge, but achieved FLOP/s still tracked.
+    obs.TRACER.reset()
+    nop = efficiency.FlopsLedger(gauge="test_mfu2", peak_flops=None)
+    nop.observe(100.0, 0.1)
+    assert nop.mfu() is None
+    assert nop.achieved_flops() == pytest.approx(1000.0)
+    assert not obs.TRACER.gauges()
+    # Zero/None observations are ignored, never a divide.
+    ledger.observe(None, 0.1)
+    ledger.observe(100.0, 0.0)
+
+
+# -- goodput ledger ---------------------------------------------------
+
+def test_goodput_ledger_live_books_balance():
+    t = [0.0]
+    ledger = efficiency.GoodputLedger(clock=lambda: t[0])
+    ledger.record("compile", 2.0)
+    ledger.record("productive", 5.0)
+    ledger.record("data_wait", 1.0)
+    t[0] = 10.0
+    s = ledger.summary()
+    assert s["wall_s"] == 10.0
+    assert s["goodput_ratio"] == pytest.approx(0.5)
+    assert s["buckets"]["other"] == pytest.approx(2.0)
+    assert sum(s["buckets"].values()) == pytest.approx(10.0)
+    out = ledger.publish()
+    gauges = {(n, labels): v
+              for (n, labels), v in obs.TRACER.gauges().items()}
+    assert gauges[(efficiency.GOODPUT_GAUGE, ())] \
+        == pytest.approx(0.5)
+    assert gauges[(efficiency.BADPUT_GAUGE,
+                   (("bucket", "compile"),))] == pytest.approx(2.0)
+    assert out == s
+
+
+def test_goodput_ledger_overlap_rescales_to_wall():
+    """Overlapping attributions (async checkpoint under compute) can
+    exceed wall; the books rescale rather than report >100%."""
+    t = [0.0]
+    ledger = efficiency.GoodputLedger(clock=lambda: t[0])
+    ledger.record("productive", 6.0)
+    ledger.record("checkpoint", 2.0)
+    t[0] = 4.0
+    s = ledger.summary()
+    assert s["wall_s"] == 4.0
+    assert sum(s["buckets"].values()) == pytest.approx(4.0)
+    assert s["buckets"]["productive"] == pytest.approx(3.0)
+    assert s["buckets"]["checkpoint"] == pytest.approx(1.0)
+
+
+def test_goodput_ledger_rejects_unknown_bucket():
+    with pytest.raises(ValueError, match="unknown goodput bucket"):
+        efficiency.GoodputLedger().record("coffee", 1.0)
+
+
+def test_replay_known_timings_sum_to_wall():
+    t0 = 500.0
+    snapshot = {
+        "identity": {"role": "train", "host": "h0", "pid": 7},
+        "spans": [
+            {"name": "train.step_compile", "start_unix": t0,
+             "duration_s": 1.0},
+            {"name": "train.step_run", "start_unix": t0 + 1.0,
+             "duration_s": 2.0},
+            {"name": "train.data_wait", "start_unix": t0 + 3.0,
+             "duration_s": 0.5},
+            {"name": "train.checkpoint", "start_unix": t0 + 3.5,
+             "duration_s": 0.5},
+            {"name": "unrelated.span", "start_unix": t0 + 4.0,
+             "duration_s": 1.0},  # -> other
+        ],
+        "events": [{"name": "train.restart", "unix": t0,
+                    "fields": {"recovery_s": 0.25}}],
+    }
+    s = efficiency.ledger_from_snapshot(snapshot).summary()
+    assert s["wall_s"] == pytest.approx(5.0)
+    b = s["buckets"]
+    assert b["compile"] == pytest.approx(1.0)
+    assert b["productive"] == pytest.approx(2.0)
+    assert b["data_wait"] == pytest.approx(0.5)
+    assert b["checkpoint"] == pytest.approx(0.5)
+    assert b["restart"] == pytest.approx(0.25)
+    assert b["other"] == pytest.approx(0.75)
+    assert sum(b.values()) == pytest.approx(s["wall_s"], rel=0.01)
+    assert s["goodput_ratio"] == pytest.approx(0.4)
+
+
+def test_replay_straggler_episode_moves_productive_to_stall():
+    """A detected->recovered episode at skew 2.0 converts half the
+    episode's span to straggler_stall, deducted from productive."""
+    t0 = 100.0
+    snapshot = {
+        "identity": {"role": "train", "host": "h1", "pid": 8},
+        "spans": [
+            {"name": "train.step_run", "start_unix": t0,
+             "duration_s": 8.0},
+        ],
+        "events": [
+            {"name": "straggler.detected", "unix": t0 + 2.0,
+             "fields": {"host": "h1", "skew_ratio": 2.0}},
+            {"name": "straggler.recovered", "unix": t0 + 6.0,
+             "fields": {"host": "h1"}},
+        ],
+    }
+    s = efficiency.ledger_from_snapshot(snapshot).summary()
+    # stall = 4s episode * (1 - 1/2) = 2s
+    assert s["buckets"]["straggler_stall"] == pytest.approx(2.0)
+    assert s["buckets"]["productive"] == pytest.approx(6.0)
+    assert sum(s["buckets"].values()) == pytest.approx(s["wall_s"])
+
+
+def test_replay_stall_clamped_to_recorded_productive():
+    """A dropped-span journal (episode events survive, most step
+    spans fell off the ring): stall can only reclassify time the
+    journal actually recorded as productive — the books still
+    balance and unrecorded time stays in 'other'."""
+    t0 = 100.0
+    snapshot = {
+        "identity": {"role": "train", "host": "h1", "pid": 9},
+        "spans": [
+            {"name": "train.step_run", "start_unix": t0,
+             "duration_s": 1.0},
+        ],
+        "events": [
+            {"name": "straggler.detected", "unix": t0,
+             "fields": {"host": "h1", "skew_ratio": 10.0}},
+            {"name": "straggler.recovered", "unix": t0 + 100.0,
+             "fields": {"host": "h1"}},
+        ],
+    }
+    s = efficiency.ledger_from_snapshot(snapshot).summary()
+    # Raw stall would be 90s; only the 1s of recorded productive
+    # time can move.
+    assert s["buckets"]["straggler_stall"] == pytest.approx(1.0)
+    assert s["buckets"]["productive"] == pytest.approx(0.0)
+    assert sum(s["buckets"].values()) == pytest.approx(s["wall_s"])
+
+
+def test_report_combines_processes():
+    snap = {
+        "identity": {"role": "train", "host": "h", "pid": 1},
+        "spans": [{"name": "train.step_run", "start_unix": 0.0,
+                   "duration_s": 1.0}],
+        "events": [],
+    }
+    other = dict(snap, identity={"role": "serving", "host": "h",
+                                 "pid": 2})
+    report = efficiency.report_from_snapshots([snap, other])
+    assert len(report["processes"]) == 2
+    assert report["processes"][0]["identity"]["role"] == "train"
+    combined = report["combined"]
+    assert combined["wall_s"] == pytest.approx(2.0)
+    assert combined["buckets"]["productive"] == pytest.approx(2.0)
+    assert combined["goodput_ratio"] == pytest.approx(1.0)
+
+
+def test_engine_active_param_count_discounts_unrouted_experts():
+    """MoE decode executes only top_k of num_experts expert MLPs per
+    token: the MFU numerator's param count must discount the
+    unrouted experts (expert-stacked leaves), not the router gate."""
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import (
+        MoETransformerLM,
+        TransformerLM,
+    )
+    from container_engine_accelerators_tpu.models.decode import (
+        SlotDecodeEngine,
+    )
+
+    dense = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = dense.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = SlotDecodeEngine(dense, params, slots=1, slot_len=14)
+    assert eng.active_param_count == eng.param_count
+
+    moe = MoETransformerLM(vocab_size=48, embed_dim=32,
+                           num_layers=2, num_heads=4,
+                           max_seq_len=32, num_experts=4, top_k=1,
+                           dtype=jnp.float32)
+    moe_params = moe.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = SlotDecodeEngine(moe, moe_params, slots=1, slot_len=14)
+    assert eng.active_param_count < eng.param_count
+    # Exactly: expert-stacked leaves (leading dim == num_experts,
+    # rank >= 3) count at top_k/num_experts.
+    import jax.tree_util as jtu
+    expected = sum(
+        (int(p.size) * 1 // 4 if p.ndim >= 3 and p.shape[0] == 4
+         else int(p.size))
+        for p in jtu.tree_leaves(moe_params))
+    assert eng.active_param_count == expected
+
+
+# -- HBM memory telemetry ---------------------------------------------
+
+class _FakeDev:
+    def __init__(self, name, in_use, limit, peak=None, stats=True):
+        self._name = name
+        self._stats = ({"bytes_in_use": in_use,
+                        "peak_bytes_in_use": peak or in_use,
+                        "bytes_limit": limit} if stats else None)
+
+    def memory_stats(self):
+        return self._stats
+
+    def __str__(self):
+        return self._name
+
+
+def test_memory_monitor_gauges_and_watermark():
+    mon = memory.MemoryMonitor(soft_limit=0.9)
+    stats = mon.sample(devices=[
+        _FakeDev("tpu0", 400, 1000, peak=450),
+        _FakeDev("cpu0", 0, 0, stats=False),  # no allocator stats
+    ])
+    assert set(stats) == {"tpu0"}
+    gauges = {(n, labels): v
+              for (n, labels), v in obs.TRACER.gauges().items()}
+    dev = (("device", "tpu0"),)
+    assert gauges[(memory.IN_USE_GAUGE, dev)] == 400
+    assert gauges[(memory.PEAK_GAUGE, dev)] == 450
+    assert gauges[(memory.LIMIT_GAUGE, dev)] == 1000
+    # Watermark only ratchets up.
+    mon.sample(devices=[_FakeDev("tpu0", 300, 1000)])
+    assert mon.watermarks()["tpu0"] == 450
+    mon.sample(devices=[_FakeDev("tpu0", 700, 1000)])
+    assert mon.watermarks()["tpu0"] == 700
+    totals = mon.totals()
+    assert totals["hbm_in_use_bytes"] == 700
+    assert totals["hbm_peak_bytes"] == 700
+
+
+def test_memory_pressure_exactly_one_event_per_episode():
+    mon = memory.MemoryMonitor(soft_limit=0.9)
+
+    def events():
+        return [e for e in obs.TRACER.snapshot()["events"]
+                if e["name"] in (memory.PRESSURE_EVENT,
+                                 memory.RECOVERED_EVENT)]
+
+    mon.sample(devices=[_FakeDev("tpu0", 950, 1000)])
+    mon.sample(devices=[_FakeDev("tpu0", 960, 1000)])  # still in
+    assert [e["name"] for e in events()] == [memory.PRESSURE_EVENT]
+    assert events()[0]["fields"]["device"] == "tpu0"
+    # Above the recovery threshold (0.85): the episode stays open.
+    mon.sample(devices=[_FakeDev("tpu0", 870, 1000)])
+    assert len(events()) == 1
+    # Recovery fires once, re-arming the alarm.
+    mon.sample(devices=[_FakeDev("tpu0", 800, 1000)])
+    assert [e["name"] for e in events()] == [
+        memory.PRESSURE_EVENT, memory.RECOVERED_EVENT]
+    mon.sample(devices=[_FakeDev("tpu0", 990, 1000)])
+    assert [e["name"] for e in events()] == [
+        memory.PRESSURE_EVENT, memory.RECOVERED_EVENT,
+        memory.PRESSURE_EVENT]
+
+
+def test_memory_monitor_throttles_inside_interval():
+    mon = memory.MemoryMonitor(soft_limit=0.9)
+    mon.sample(devices=[_FakeDev("tpu0", 100, 1000)])
+    # Inside the interval the cached sample answers; the new device
+    # list is not consulted.
+    cached = mon.sample(devices=[_FakeDev("tpu0", 999, 1000)],
+                        min_interval_s=60.0)
+    assert cached["tpu0"]["bytes_in_use"] == 100
+
+
+def test_memory_postmortem_provider_carries_watermarks(tmp_path):
+    mon = memory.MemoryMonitor(soft_limit=0.9)
+    mon.sample(devices=[_FakeDev("tpu0", 640, 1000)])
+    memory.install_postmortem_provider(mon)
+    try:
+        out = postmortem.capture("test",
+                                 path=str(tmp_path / "pm.json"))
+        doc = json.loads((tmp_path / "pm.json").read_text())
+        state = doc["postmortem_state"][memory.STATE_PROVIDER_NAME]
+        assert state["watermarks"] == {"tpu0": 640}
+        assert state["soft_limit"] == 0.9
+        assert out == str(tmp_path / "pm.json")
+    finally:
+        postmortem.unregister_state_provider(
+            memory.STATE_PROVIDER_NAME)
+
+
+def test_device_memory_stats_on_cpu_backend_degrades():
+    """The real CPU backend reports no allocator stats — the
+    documented degraded answer is an empty dict, not a raise."""
+    import jax
+
+    assert memory.device_memory_stats(jax.local_devices()) == {}
+
+
+# -- profiler capture -------------------------------------------------
+
+def test_profiler_capture_produces_artifact(tmp_path, monkeypatch):
+    monkeypatch.setenv(profiler.OUT_DIR_ENV, str(tmp_path))
+    cap = profiler.ProfileCapture()
+    result = cap.capture(seconds=0.05)
+    assert result["artifact"].startswith(str(tmp_path))
+    assert os.path.isdir(result["artifact"])
+    # jax.profiler wrote something into the artifact directory.
+    assert any(os.scandir(result["artifact"]))
+    events = [e for e in obs.TRACER.snapshot()["events"]
+              if e["name"] == profiler.CAPTURE_EVENT]
+    assert events and events[0]["fields"]["artifact"] \
+        == result["artifact"]
+    assert cap.last() == result
+
+
+def test_profiler_serialized_second_caller_busy():
+    cap = profiler.ProfileCapture()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        with cap._lock:
+            entered.set()
+            release.wait(timeout=10)
+
+    t = threading.Thread(target=hold)
+    t.start()
+    entered.wait(timeout=10)
+    try:
+        with pytest.raises(profiler.ProfilerBusy):
+            cap.capture(seconds=0.01)
+    finally:
+        release.set()
+        t.join(timeout=10)
+
+
+def test_profile_response_status_codes(monkeypatch, tmp_path):
+    assert profiler.profile_response("/debug/varz") is None
+    status, ctype, body = profiler.profile_response(
+        "/debug/profile", "seconds=abc")
+    assert status == 400
+    # Busy surface -> 409 with a machine-readable body.
+    monkeypatch.setattr(profiler, "CAPTURE",
+                        profiler.ProfileCapture())
+    assert profiler.CAPTURE._lock.acquire(blocking=False)
+    try:
+        status, _, body = profiler.profile_response(
+            "/debug/profile", "seconds=0.01")
+        assert status == 409
+        assert json.loads(body)["busy"] is True
+    finally:
+        profiler.CAPTURE._lock.release()
+    # Unavailable backend -> documented 501 error JSON.
+    import jax
+
+    def boom(*a, **kw):
+        raise RuntimeError("no profiler here")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    status, _, body = profiler.profile_response(
+        "/debug/profile", "seconds=0.01")
+    assert status == 501
+    doc = json.loads(body)
+    assert doc["available"] is False and "error" in doc
+    # Available backend -> 200 + artifact.
+    monkeypatch.undo()
+    monkeypatch.setenv(profiler.OUT_DIR_ENV, str(tmp_path))
+    monkeypatch.setattr(profiler, "CAPTURE",
+                        profiler.ProfileCapture())
+    status, _, body = profiler.profile_response(
+        "/debug/profile", "seconds=0.02")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["ok"] is True and os.path.isdir(doc["artifact"])
+
+
+# -- serving SLO metrics on a real engine-mode server -----------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://localhost:{port}{path}", timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_engine_request_populates_slo_metrics(monkeypatch, tmp_path):
+    """The tier-1 acceptance path: one real greedy engine-mode
+    request (CPU fake backend) must populate the TTFT/TPOT
+    histograms (in /stats percentiles AND Prometheus text), burn the
+    SLO counter against an absurdly tight threshold, report the hbm_*
+    stats keys, stay token-identical to per-request decode(), and
+    serve a serialized /debug/profile capture."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from container_engine_accelerators_tpu.models import (
+        TransformerLM,
+    )
+    from container_engine_accelerators_tpu.models.decode import (
+        decode,
+    )
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+    from container_engine_accelerators_tpu.serving.server import (
+        SLO_COUNTER,
+        TPOT_HISTOGRAM,
+        TTFT_HISTOGRAM,
+    )
+
+    # Impossible-to-meet SLOs: every observation is a violation, so
+    # the burn counter provably wires through. Read at engine
+    # construction, hence set before the server exists.
+    monkeypatch.setenv("CEA_TPU_SLO_TTFT_MS", "0.0001")
+    monkeypatch.setenv("CEA_TPU_SLO_TPOT_MS", "0.0001")
+    # Rate the CPU rig so the decode-MFU gauge publishes too.
+    monkeypatch.setenv("CEA_TPU_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv(profiler.OUT_DIR_ENV, str(tmp_path))
+    monkeypatch.setattr(profiler, "CAPTURE",
+                        profiler.ProfileCapture())
+
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=2,
+                           buckets=[8])
+    assert srv._engine_service is not None
+    srv.start()
+    try:
+        prompts = [[1, 2, 3, 4], [5, 6, 7, 8]]
+        new = 6
+        req = urllib.request.Request(
+            f"http://localhost:{srv.port}/v1/models/lm:generate",
+            data=json.dumps({"prompts": prompts,
+                             "max_new_tokens": new}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+
+        # Greedy engine output stays token-identical to per-request
+        # decode — the instrumentation perturbed nothing.
+        padded = np.zeros((2, 8), np.int32)
+        padded[:, :4] = np.asarray(prompts, np.int32)
+        ref = np.asarray(decode(
+            model, params, jnp.asarray(padded), new,
+            prompt_len=np.array([4, 4]), fast_prefill=False))
+        for i, seq in enumerate(out["sequences"]):
+            assert seq == ref[i][:4 + new].tolist()
+
+        _, stats = _get(srv.port, "/stats")
+        assert stats["ttft_p50_ms"] is not None
+        assert stats["ttft_p99_ms"] is not None
+        assert stats["tpot_p50_ms"] is not None
+        assert stats["tpot_p99_ms"] is not None
+        # 2 TTFT observations; (new-1) TPOT observations per row.
+        assert stats["slo"]["ttft_ms"] == pytest.approx(0.0001)
+        assert stats["slo"]["violations"]["ttft"] == 2
+        assert stats["slo"]["violations"]["tpot"] == 2 * (new - 1)
+        assert "hbm_in_use_bytes" in stats
+        assert "hbm_peak_bytes" in stats
+        assert stats["decode_mfu"] is not None \
+            and stats["decode_mfu"] > 0
+
+        # Histograms populated with non-zero counts, scrapeable.
+        hists = {h.name: h for h in obs.TRACER.histograms()}
+        assert hists[TTFT_HISTOGRAM].count == 2
+        assert hists[TPOT_HISTOGRAM].count == 2 * (new - 1)
+        text = obs.prometheus_text(obs.TRACER)
+        assert f"{TTFT_HISTOGRAM}_bucket" in text
+        assert f"{TPOT_HISTOGRAM}_bucket" in text
+        assert f'{SLO_COUNTER}{{slo="ttft"}} 2' in text
+
+        # /debug/profile: 200 + artifact when free, 409 while held.
+        status, doc = _get(srv.port, "/debug/profile?seconds=0.02")
+        assert status == 200 and os.path.isdir(doc["artifact"])
+        assert profiler.CAPTURE._lock.acquire(blocking=False)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.port, "/debug/profile?seconds=0.02")
+            assert err.value.code == 409
+        finally:
+            profiler.CAPTURE._lock.release()
+    finally:
+        srv.stop()

@@ -139,6 +139,49 @@ def test_histogram_buckets_and_quantiles():
     assert h.quantile(1.0) == 10.0
 
 
+def test_histogram_quantile_edge_cases():
+    """The /stats percentiles now back SLO reporting — pin the
+    interpolation's corners: empty, single observation, q=0/q=1,
+    and an all-overflow histogram."""
+    h = obs.Histogram("edge", buckets=(1.0, 2.0, 4.0))
+    assert h.quantile(0.0) is None and h.quantile(1.0) is None
+
+    # Single observation in (1, 2]: every quantile stays inside the
+    # owning bucket; q=0 pins its lower bound, q=1 its upper.
+    h.observe(1.5)
+    assert h.quantile(0.0) == pytest.approx(1.0)
+    assert h.quantile(1.0) == pytest.approx(2.0)
+    assert 1.0 <= h.quantile(0.5) <= 2.0
+
+    # q=0 with a populated FIRST bucket starts from 0 (the implicit
+    # lower bound), and q=1 reaches the last populated bound.
+    h2 = obs.Histogram("edge2", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0):
+        h2.observe(v)
+    assert h2.quantile(0.0) == pytest.approx(0.0)
+    assert h2.quantile(1.0) == pytest.approx(4.0)
+    # Monotone in q, always within [0, largest bound].
+    qs = [h2.quantile(q / 10) for q in range(11)]
+    assert qs == sorted(qs)
+    assert all(0.0 <= v <= 4.0 for v in qs)
+
+    # All-overflow: every observation past the largest finite bound
+    # reports that bound (an upper-bound-less estimate is a lie).
+    h3 = obs.Histogram("edge3", buckets=(1.0, 2.0))
+    for _ in range(5):
+        h3.observe(100.0)
+    for q in (0.0, 0.5, 1.0):
+        assert h3.quantile(q) == 2.0
+
+    # Zero-count buckets between populated ones don't distort the
+    # rank walk (the `and c` guard).
+    h4 = obs.Histogram("edge4", buckets=(1.0, 2.0, 4.0, 8.0))
+    h4.observe(0.5)
+    h4.observe(7.0)  # buckets 2 and 3 empty in between
+    assert h4.quantile(0.5) == pytest.approx(1.0)
+    assert 4.0 <= h4.quantile(0.99) <= 8.0
+
+
 def test_prometheus_text_format():
     tracer = Tracer(enabled=True)
     h = tracer.histogram("x_seconds", "help text",

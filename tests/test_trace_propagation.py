@@ -316,3 +316,30 @@ def test_cross_process_journals_merge(fake_node, tmp_path):
               for ev in by_name.get("process_name", [])}
     assert any(lbl.startswith("serving@") for lbl in labels), labels
     assert any(lbl.startswith("plugin@") for lbl in labels), labels
+
+    # tools/goodput_report.py over the same two-process journals: a
+    # goodput ratio and a per-bucket breakdown whose buckets sum to
+    # the observed wall time within 1% — per process AND combined.
+    spec = importlib.util.spec_from_file_location(
+        "goodput_report", os.path.join(REPO_ROOT, "tools",
+                                       "goodput_report.py"))
+    goodput_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(goodput_report)
+    report_out = tmp_path / "goodput.json"
+    rc = goodput_report.main([str(client_journal),
+                              str(plugin_journal),
+                              "--out", str(report_out)])
+    assert rc == 0
+    report = json.loads(report_out.read_text())
+    assert len(report["processes"]) == 2
+    assert {p["identity"]["role"] for p in report["processes"]} \
+        == {"serving", "plugin"}
+    for scope in report["processes"] + [report["combined"]]:
+        total = sum(scope["buckets"].values())
+        assert total == pytest.approx(scope["wall_s"], rel=0.01,
+                                      abs=1e-6)
+    # No train spans in these journals: everything lands honestly in
+    # "other", and the ratio reports 0 productive — never a fake
+    # positive.
+    assert report["combined"]["wall_s"] > 0
+    assert report["combined"]["goodput_ratio"] == 0.0

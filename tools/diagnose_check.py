@@ -66,10 +66,19 @@ import grpc  # noqa: E402
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CHILD_JOURNAL_CODE = (
+    "import time\n"
     "from container_engine_accelerators_tpu import obs\n"
     "obs.set_role('serving')\n"
     "with obs.span('serving.request', synthetic=True):\n"
-    "    obs.event('serving.mark', ok=True)\n")
+    "    obs.event('serving.mark', ok=True)\n"
+    # Efficiency-section fodder: a productive span for the goodput
+    # replay and a capture event for the profile enumeration (the
+    # journal CONTRACT is what's guarded here; the real profiler
+    # writes the same event shape).
+    "with obs.span('train.step_run'):\n"
+    "    time.sleep(0.02)\n"
+    "obs.event('profiler.capture', artifact='/tmp/fake-profile',\n"
+    "          seconds=0.5)\n")
 
 
 def fake_node(root):
@@ -176,6 +185,38 @@ def main():
                             f"want 4")
         if bundle.get("device_state", {}).get("topology") != "2x2":
             failures.append("device state topology missing")
+        # Efficiency sections (goodput ledger replay, HBM memory
+        # view, profiler capture paths) must be present and
+        # internally consistent — the bundle is the offline home of
+        # the accounting layer.
+        goodput = bundle.get("goodput") or {}
+        combined = goodput.get("combined") or {}
+        if not combined.get("wall_s", 0) > 0:
+            failures.append(
+                f"goodput section missing or empty: {goodput}")
+        else:
+            buckets = combined.get("buckets") or {}
+            if buckets.get("productive", 0) <= 0:
+                failures.append(
+                    "goodput replay saw no productive time from the "
+                    "child's train.step_run span")
+            total = sum(buckets.values())
+            if abs(total - combined["wall_s"]) > 0.01 * max(
+                    combined["wall_s"], 1e-9):
+                failures.append(
+                    f"goodput buckets {total} don't sum to wall "
+                    f"{combined['wall_s']} within 1%")
+        memory = bundle.get("memory")
+        if not (isinstance(memory, dict) and "gauges" in memory
+                and "postmortem" in memory):
+            failures.append(f"memory section malformed: {memory!r}")
+        profiles = bundle.get("profiles")
+        if not (isinstance(profiles, list) and any(
+                p.get("artifact") == "/tmp/fake-profile"
+                for p in profiles)):
+            failures.append(
+                f"profiles section missing the child's capture: "
+                f"{profiles!r}")
     finally:
         metrics.stop()
         manager.stop()

@@ -1,0 +1,229 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Efficiency-accounting guard (the `make goodput-check` preflight).
+
+Two independent legs, both pure CPU and a few seconds:
+
+  1. **Goodput replay exactness**: a synthetic journal with KNOWN
+     compile / step / data-wait / checkpoint / restart timings goes
+     through tools/goodput_report.py; the report must reproduce the
+     known goodput ratio exactly and its buckets must sum to the
+     journal's wall time within 1% — the acceptance bar for every
+     real replay.
+  2. **MFU numerator**: a real (tiny) Trainer on the CPU fake
+     backend must (a) produce EXACTLY the analytic 6·N·B·S FLOPs
+     when forced onto the fallback (mfu_source="analytic"), (b) find
+     a positive cost_analysis figure in auto mode within a sane
+     factor of the analytic one, and (c) publish the tpu_train_mfu
+     gauge once CEA_TPU_PEAK_FLOPS rates the rig.
+
+Exit 0 = clean, 1 = check failed, 2 = harness error.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Rate the fake backend BEFORE any ledger looks: the gauge leg needs
+# a known peak (CPU has no generation-table entry).
+PEAK = 1.0e9
+os.environ["CEA_TPU_PEAK_FLOPS"] = str(PEAK)
+
+WALL_TOLERANCE = 0.01
+
+
+def check_goodput_replay(failures):
+    """Leg 1: known-timings journal -> report must reproduce it."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "goodput_report", os.path.join(repo, "tools",
+                                       "goodput_report.py"))
+    goodput_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(goodput_report)
+
+    t0 = 1000.0
+
+    def span(name, start, dur):
+        return {"name": name, "start_unix": start, "duration_s": dur}
+
+    spans = [span("train.step_compile", t0, 2.0)]
+    for i in range(10):  # 10 productive steps of 0.5s
+        spans.append(span("train.step_run", t0 + 2.0 + i * 0.6, 0.5))
+    spans.append(span("train.data_wait", t0 + 8.2, 0.375))
+    spans.append(span("train.data_wait", t0 + 8.6, 0.375))
+    spans.append(span("train.checkpoint", t0 + 9.0, 0.25))
+    journal = {
+        "identity": {"role": "train", "host": "checkhost", "pid": 1},
+        "spans": spans,
+        "events": [
+            {"name": "train.restart", "unix": t0,
+             "fields": {"recovery_s": 0.5}},
+            # Pins the wall window's right edge at t0 + 10.
+            {"name": "train.mark", "unix": t0 + 10.0, "fields": {}},
+        ],
+    }
+    expected = {"productive": 5.0, "compile": 2.0, "data_wait": 0.75,
+                "checkpoint": 0.25, "restart": 0.5,
+                "straggler_stall": 0.0, "other": 1.5}
+
+    with tempfile.TemporaryDirectory(prefix="goodput-check") as tmp:
+        jpath = os.path.join(tmp, "journal.json")
+        opath = os.path.join(tmp, "report.json")
+        with open(jpath, "w") as f:
+            json.dump(journal, f)
+        rc = goodput_report.main([jpath, "--out", opath])
+        if rc != 0:
+            failures.append(f"goodput_report exited {rc}")
+            return None
+        with open(opath) as f:
+            report = json.load(f)
+
+    combined = report["combined"]
+    wall = combined["wall_s"]
+    if abs(wall - 10.0) > 1e-6:
+        failures.append(f"wall_s {wall} != 10.0")
+    total = sum(combined["buckets"].values())
+    if abs(total - wall) > WALL_TOLERANCE * max(wall, 1e-9):
+        failures.append(
+            f"buckets sum {total} vs wall {wall}: off by more "
+            f"than {WALL_TOLERANCE:.0%}")
+    for bucket, want in expected.items():
+        got = combined["buckets"].get(bucket)
+        if got is None or abs(got - want) > 1e-6:
+            failures.append(
+                f"bucket {bucket}: got {got}, want {want}")
+    if abs((combined["goodput_ratio"] or 0.0) - 0.5) > 1e-6:
+        failures.append(
+            f"goodput_ratio {combined['goodput_ratio']} != 0.5")
+    return report
+
+
+def check_mfu_fallback(failures):
+    """Leg 2: fake-backend MFU — analytic fallback exact, auto mode
+    sane, gauge published against the env-rated peak."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from container_engine_accelerators_tpu import obs
+    from container_engine_accelerators_tpu.obs.efficiency import (
+        TRAIN_MFU_GAUGE,
+        transformer_train_flops,
+    )
+    from container_engine_accelerators_tpu.parallel.train import (
+        Trainer,
+        cross_entropy_loss,
+    )
+
+    def apply_fn(variables, images, train):
+        logits = images.reshape(images.shape[0], -1) @ \
+            variables["params"]["w"]
+        return logits, {}
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                ("data", "model"))
+    variables = {"params": {"w": np.zeros((4, 2), np.float32)}}
+    batch = (np.ones((4, 2, 2), np.float32),
+             np.zeros((4,), np.int32))
+    n_params, tokens = 8, 4  # w is 4x2; image batch -> B tokens
+    analytic = transformer_train_flops(n_params, tokens)
+
+    summary = {}
+    for source in ("analytic", "auto"):
+        trainer = Trainer(apply_fn, cross_entropy_loss,
+                          optax.sgd(0.1), mesh=mesh,
+                          donate_state=False, summary_every=1,
+                          mfu_source=source)
+        state = trainer.init_state(variables)
+        for _ in range(3):
+            state, _ = trainer.train_step(state, batch)
+        flops = trainer.flops_per_step()
+        summary[f"{source}_flops"] = flops
+        if source == "analytic":
+            if flops != analytic:
+                failures.append(
+                    f"analytic fallback produced {flops}, want "
+                    f"6*N*B*S = {analytic}")
+        else:
+            if not flops or flops <= 0:
+                failures.append(
+                    f"auto mode found no FLOPs figure: {flops}")
+            elif not (analytic / 50 <= flops <= analytic * 50):
+                # cost_analysis counts the true HLO (optimizer ops
+                # included) so it differs from 6·N·B·S — but not by
+                # orders of magnitude on a plain linear model.
+                failures.append(
+                    f"auto FLOPs {flops} implausible vs analytic "
+                    f"{analytic}")
+        gauges = {name: v for (name, _), v
+                  in obs.TRACER.gauges().items()}
+        mfu = gauges.get(TRAIN_MFU_GAUGE)
+        summary[f"{source}_mfu_gauge"] = mfu
+        if mfu is None or mfu <= 0:
+            failures.append(
+                f"{source}: {TRAIN_MFU_GAUGE} gauge not published "
+                f"(got {mfu}) with CEA_TPU_PEAK_FLOPS set")
+        goodput = trainer.goodput.summary()
+        if goodput["buckets"]["compile"] <= 0:
+            failures.append(
+                f"{source}: compile bucket empty: {goodput}")
+        if goodput["buckets"]["productive"] <= 0:
+            failures.append(
+                f"{source}: productive bucket empty: {goodput}")
+        total = sum(goodput["buckets"].values())
+        if abs(total - goodput["wall_s"]) > WALL_TOLERANCE * max(
+                goodput["wall_s"], 1e-9):
+            failures.append(
+                f"{source}: live ledger buckets {total} vs wall "
+                f"{goodput['wall_s']}")
+        obs.TRACER.reset()
+    return summary
+
+
+def main():
+    failures = []
+    try:
+        report = check_goodput_replay(failures)
+        mfu = check_mfu_fallback(failures)
+    except Exception as e:
+        import traceback
+        traceback.print_exc()
+        print(f"goodput-check: harness error: {e!r}", file=sys.stderr)
+        return 2
+    print(json.dumps({
+        "failures": failures,
+        "combined": (report or {}).get("combined"),
+        "mfu": mfu,
+    }))
+    if failures:
+        for f in failures:
+            print(f"goodput-check FAILED: {f}", file=sys.stderr)
+        return 1
+    print("goodput-check: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

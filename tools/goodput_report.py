@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Offline goodput replay over journal snapshots.
+
+Feeds any number of journals — CEA_TPU_TRACE_FILE files (atexit or
+postmortem captures) and/or live /debug/trace endpoints — through the
+obs.efficiency attribution rules and prints one JSON report: per
+process, every wall-clock second of the observed window lands in
+exactly one bucket (productive step, compile, data wait, checkpoint,
+restart recovery, straggler stall, other), plus a combined fleet
+view. The buckets always sum to the wall time — ``other`` absorbs
+whatever the journal didn't attribute, so a low goodput ratio is
+never hidden by dropped time.
+
+Usage:
+  python tools/goodput_report.py /tmp/host0.json /tmp/host1.json
+  python tools/goodput_report.py --url http://localhost:8500
+  python tools/goodput_report.py journal.json --out goodput.json
+
+Exit 0 when at least one journal loaded (the report is the
+deliverable, even if some legs failed — failures are recorded in
+place); 1 when nothing could be loaded.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+
+FETCH_TIMEOUT_S = 5
+
+
+def load_snapshots(paths, urls):
+    """(snapshots, sources) — sources records per-leg outcomes."""
+    snapshots, sources = [], []
+    for path in paths:
+        try:
+            with open(path) as f:
+                snapshots.append(json.load(f))
+            sources.append({"source": path, "ok": True})
+        except (OSError, ValueError) as e:
+            sources.append({"source": path, "ok": False,
+                            "error": str(e)[:300]})
+    for base in urls:
+        url = base.rstrip("/") + obs.TRACE_PATH
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=FETCH_TIMEOUT_S) as resp:
+                snapshots.append(json.load(resp))
+            sources.append({"source": url, "ok": True})
+        except Exception as e:
+            sources.append({"source": url, "ok": False,
+                            "error": str(e)[:300]})
+    return snapshots, sources
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("journals", nargs="*",
+                   help="journal files (CEA_TPU_TRACE_FILE bodies)")
+    p.add_argument("--url", action="append", default=[],
+                   help="live base URLs whose /debug/trace to fold "
+                        "into the report")
+    p.add_argument("--out", default=None,
+                   help="also write the report JSON here")
+    args = p.parse_args(argv)
+    if not args.journals and not args.url:
+        p.error("need at least one journal file or --url")
+
+    snapshots, sources = load_snapshots(args.journals, args.url)
+    if not snapshots:
+        for s in sources:
+            if not s["ok"]:
+                print(f"[goodput] {s['source']}: {s['error']}",
+                      file=sys.stderr)
+        print("[goodput] no journal could be loaded",
+              file=sys.stderr)
+        return 1
+
+    report = obs.report_from_snapshots(snapshots)
+    report["sources"] = sources
+    body = json.dumps(report, indent=1) + "\n"
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, args.out)
+    sys.stdout.write(body)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,10 +114,15 @@ def promote_serving(raw_path, stats_path, out_path):
     # Batching-efficiency fields, first-class (they replaced the old
     # free-text server_stats_note): the slot engine's occupancy is
     # the number the continuous-batching work exists to move, so the
-    # artifact must carry it when the server reports it.
+    # artifact must carry it when the server reports it. The serving
+    # SLO percentiles (TTFT/TPOT) and the HBM high watermark ride
+    # along the same way — the latency and memory truth of the
+    # captured run, straight from /stats.
     engine_stats = {k: stats[k] for k in (
         "batch_occupancy_avg", "slots_active", "slots_free",
-        "queue_depth", "engine_steps", "rows_decoded") if k in stats}
+        "queue_depth", "engine_steps", "rows_decoded",
+        "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms",
+        "hbm_peak_bytes") if k in stats}
     if engine_stats:
         out["server_stats"] = engine_stats
     _write_atomic(out_path, out)

@@ -88,6 +88,16 @@ timeout -k 10 120 python tools/diagnose_check.py \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "diagnose-check preflight"
 
+# Efficiency-accounting preflight (CPU, seconds): the goodput replay
+# must reproduce a known-timings journal exactly and the Trainer's
+# analytic MFU fallback must equal 6NBS. A broken ledger means the
+# goodput/MFU numbers every later section reports are fiction.
+echo "[suite] goodput-check preflight" >&2
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python tools/goodput_check.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "goodput-check preflight"
+
 # Continuous-batching preflight (CPU fake backend, ~1 min): the slot
 # engine must beat the sequential-batch policy >= 2x in goodput on a
 # replayed Poisson trace with greedy outputs bit-identical to

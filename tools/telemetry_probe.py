@@ -57,6 +57,7 @@ _CANDIDATE_ADDRS = ("localhost:8431",)
 _CANDIDATE_VARZ = ("localhost:2112",)
 SDK_LEG_TIMEOUT_S = 30
 VARZ_LEG_TIMEOUT_S = 5
+MEMORY_LEG_TIMEOUT_S = 120
 
 
 def _outcome(fn):
@@ -128,6 +129,71 @@ def probe_varz(addr):
         return {"ok": False, "url": url,
                 "error_type": type(e).__name__,
                 "error": str(e)[:500]}
+
+
+_MEMORY_PROBE_CODE = """
+import json, sys
+import jax
+out = []
+for d in jax.local_devices():
+    try:
+        stats = d.memory_stats()
+    except Exception as e:
+        stats = None
+        out.append({"device": str(d), "platform": d.platform,
+                    "device_kind": getattr(d, "device_kind", None),
+                    "memory_stats": False,
+                    "error": repr(e)[:200]})
+        continue
+    out.append({"device": str(d), "platform": d.platform,
+                "device_kind": getattr(d, "device_kind", None),
+                "memory_stats": stats is not None,
+                "keys": sorted(stats) if stats else None,
+                "bytes_in_use": (stats or {}).get("bytes_in_use"),
+                "bytes_limit": (stats or {}).get("bytes_limit")})
+print(json.dumps(out))
+"""
+
+
+def probe_memory_stats():
+    """HBM-memory-stats leg: does THIS host's jax backend expose
+    ``device.memory_stats()`` (the source behind obs.memory's
+    tpu_hbm_* gauges and the serving /stats hbm_* fields)? Probed in
+    a SUBPROCESS with a hard deadline — a wedged backend dial (the
+    tunnel's known failure mode) must cost one leg, not the whole
+    artifact — and recorded per device. ``ok`` requires at least one
+    device actually reporting allocator stats: an importable jax
+    whose devices all answer None (the CPU fallback) is NOT a real
+    memory-telemetry source."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _MEMORY_PROBE_CODE],
+            capture_output=True, text=True,
+            timeout=MEMORY_LEG_TIMEOUT_S,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error_type": "TimeoutError",
+                "error": f"leg exceeded {MEMORY_LEG_TIMEOUT_S}s "
+                         f"deadline (backend dial wedged?)"}
+    if proc.returncode != 0:
+        return {"ok": False, "error_type": "SubprocessError",
+                "error": proc.stderr[-500:]}
+    try:
+        devices = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"ok": False, "error_type": type(e).__name__,
+                "error": str(e)[:300]}
+    with_stats = [d for d in devices if d.get("memory_stats")]
+    out = {"ok": bool(with_stats), "devices": devices,
+           "devices_with_stats": len(with_stats)}
+    if not with_stats:
+        out["error"] = ("jax constructed but no device reports "
+                        "memory_stats (CPU fallback or pre-API "
+                        "runtime)")
+    return out
 
 
 def host_observations(addrs):
@@ -215,6 +281,8 @@ def main(argv=None):
             "grpc_ok": {a: r.get("ok") for a, r in
                         (old.get("grpc") or {}).items()},
             "had_varz_leg": "varz" in old,
+            "memory_stats_ok": (old.get("memory_stats")
+                                or {}).get("ok"),
         }
         if old.get("previous_record"):
             # One level of history only; the full chain is git's job.
@@ -259,6 +327,9 @@ def main(argv=None):
     varz_addrs = list(dict.fromkeys(
         list(_CANDIDATE_VARZ) + args.varz_addr))
     record["varz"] = {addr: probe_varz(addr) for addr in varz_addrs}
+    # HBM allocator-stats leg: whether device.memory_stats() answers
+    # on this host's backend — the source behind obs.memory.
+    record["memory_stats"] = probe_memory_stats()
 
     any_ok = record["sdk"]["ok"] or any(
         r["ok"] for r in record["grpc"].values())
@@ -270,7 +341,9 @@ def main(argv=None):
                       "grpc": {a: r["ok"]
                                for a, r in record["grpc"].items()},
                       "varz": {a: r["ok"]
-                               for a, r in record["varz"].items()}}))
+                               for a, r in record["varz"].items()},
+                      "memory_stats_ok":
+                          record["memory_stats"]["ok"]}))
     return 0
 
 

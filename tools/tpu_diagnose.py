@@ -30,7 +30,14 @@ incident:
   - device/slice state: accel nodes in --dev-dir, topology and
     per-chip leaf files from --state-dir;
   - a fleet straggler scan over all collected ``train.step_summary``
-    events (obs.straggler.scan_events).
+    events (obs.straggler.scan_events);
+  - a goodput replay over every collected journal (per-process
+    wall-time attribution + combined ratio, obs.efficiency);
+  - HBM memory watermarks (tpu_hbm_* gauges from each varz leg, plus
+    any postmortem hbm_memory state the dead processes flushed);
+  - every profiler capture the journals record (``profiler.capture``
+    events -> artifact paths), so the operator can grab the traces
+    taken during the incident.
 
 Endpoint failures are recorded in place (a structured error per
 surface), never raised: on a half-dead node the partial bundle IS the
@@ -146,6 +153,49 @@ def device_state(dev_dir, state_dir):
     return state
 
 
+def memory_section(endpoints, journals):
+    """HBM view: the tpu_hbm_* gauges every reachable varz reports,
+    plus the hbm_memory postmortem state of any dead process whose
+    journal we loaded (the OOM story: the gauges are gone with the
+    process, the flight record's watermarks are not)."""
+    gauges = {}
+    for base, legs in endpoints.items():
+        if not legs["varz"]["ok"]:
+            continue
+        for key, value in (legs["varz"]["payload"]
+                           .get("gauges") or {}).items():
+            if key.startswith("tpu_hbm_"):
+                gauges.setdefault(base, {})[key] = value
+    postmortem = {}
+    for path, leg in journals.items():
+        if not leg["ok"]:
+            continue
+        state = (leg["payload"].get("postmortem_state")
+                 or {}).get("hbm_memory")
+        if state is not None:
+            postmortem[path] = state
+    return {"gauges": gauges, "postmortem": postmortem}
+
+
+def profile_captures(snapshots):
+    """Profiler artifacts recorded in any collected journal."""
+    captures = []
+    for snap in snapshots:
+        ident = snap.get("identity") or {}
+        for ev in snap.get("events") or []:
+            if ev.get("name") != "profiler.capture":
+                continue
+            fields = ev.get("fields") or {}
+            captures.append({
+                "artifact": fields.get("artifact"),
+                "seconds": fields.get("seconds"),
+                "unix": ev.get("unix"),
+                "process": obs.process_label(ident) if ident
+                else None,
+            })
+    return captures
+
+
 def collect(urls, journal_paths, dev_dir, state_dir):
     endpoints = sweep_endpoints(urls)
     journals = load_journals(journal_paths)
@@ -171,6 +221,12 @@ def collect(urls, journal_paths, dev_dir, state_dir):
         "flagged": det.flagged(),
     }
 
+    try:
+        goodput = obs.report_from_snapshots(snapshots)
+    except Exception as e:  # a bad journal must not void the bundle
+        goodput = {"error_type": type(e).__name__,
+                   "error": str(e)[:300]}
+
     return {
         "metric": "tpu_diagnose_bundle",
         "collected_unix": time.time(),
@@ -181,6 +237,9 @@ def collect(urls, journal_paths, dev_dir, state_dir):
         "merged_processes": len(snapshots),
         "device_state": device_state(dev_dir, state_dir),
         "straggler_scan": straggler,
+        "goodput": goodput,
+        "memory": memory_section(endpoints, journals),
+        "profiles": profile_captures(snapshots),
         "provenance": stamp(
             devices=["host (diagnostics sweep; reads debug "
                      "endpoints and state files only)"]),
@@ -225,6 +284,10 @@ def main(argv=None):
         "merged_processes": bundle["merged_processes"],
         "merged_trace_events": len(merged.get("traceEvents", [])),
         "straggler_flagged": bundle["straggler_scan"]["flagged"],
+        "goodput_ratio": (bundle["goodput"].get("combined") or {}
+                          ).get("goodput_ratio")
+        if isinstance(bundle["goodput"], dict) else None,
+        "profile_captures": len(bundle["profiles"]),
     }))
     return 0
 
