@@ -56,6 +56,17 @@ diagnose-check:
 goodput-check:
 	JAX_PLATFORMS=cpu python3 tools/goodput_check.py
 
+# Elastic-training guard: a 4-host fake fleet has one host SIGKILLed
+# (chips wedged -> plugin health flip) and one SIGSTOPped (stale
+# heartbeat) mid-step; the ElasticSupervisor must evict both (exactly
+# one train.eviction + train.reshape event each), reshape the mesh
+# 4x2 -> 3x2 -> 2x2, resume resharded from the latest async
+# checkpoint, and converge to the uninterrupted run's loss with
+# goodput >= 0.5 and async checkpoint badput < 10% of sync.
+# CPU fake backend, ~3 min.
+chaos-check:
+	JAX_PLATFORMS=cpu python3 tools/chaos_check.py
+
 # Continuous-batching regression guard: replay one Poisson arrival
 # trace through the slot engine (real decode, CPU fake backend) and
 # the pre-engine sequential-batch policy; fail unless engine goodput
@@ -88,5 +99,5 @@ clean:
 	$(MAKE) -C demo/tpu-error clean
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
-	trace-check diagnose-check goodput-check occupancy-check \
-	container partition-tpu push clean
+	trace-check diagnose-check goodput-check chaos-check \
+	occupancy-check container partition-tpu push clean

@@ -368,7 +368,11 @@ def ledger_from_snapshot(snapshot):
     for ev in sorted(snapshot.get("events") or [],
                      key=lambda e: e.get("unix", 0.0)):
         name, fields = ev.get("name"), ev.get("fields") or {}
-        if name == "train.restart":
+        if name in ("train.restart", "train.recovered"):
+            # train.restart: checkpoint-restore on an ordinary
+            # resume; train.recovered: an elastic eviction's
+            # teardown->reshape->resharded-restore window
+            # (parallel.elastic) — both are restart-bucket badput.
             rec = fields.get("recovery_s")
             if rec:
                 ledger.record("restart", float(rec))

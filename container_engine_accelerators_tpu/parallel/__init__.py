@@ -21,6 +21,13 @@ chips the device plugin handed to the pod, parameter/batch shardings,
 and a pjit-compiled train step whose collectives ride ICI.
 """
 
+from .checkpoint import (
+    CheckpointManager,
+    latest_meta,
+    list_checkpoints,
+    restore_state,
+    state_payload,
+)
 from .context import (
     build_context_mesh,
     chunked_reference_attention,
@@ -33,6 +40,14 @@ from .data import (
     PrefetchLoader,
     SyntheticLoader,
     SyntheticTokenLoader,
+    reassign_shards,
+    shard_assignment,
+)
+from .elastic import (
+    ElasticSupervisor,
+    EvictionPolicy,
+    FleetExhausted,
+    ReshapePlan,
 )
 from .expert import (
     build_expert_mesh,
@@ -46,6 +61,7 @@ from .mesh import (
     build_mesh,
     chips_from_env,
     host_grid_mesh,
+    reshape_spec,
 )
 from .pipeline import (
     build_pipeline_mesh,
@@ -60,7 +76,19 @@ from .sharding import batch_sharding, param_shardings, replicated
 from .train import TrainState, Trainer
 
 __all__ = [
+    "CheckpointManager",
+    "ElasticSupervisor",
+    "EvictionPolicy",
+    "FleetExhausted",
     "MeshSpec",
+    "ReshapePlan",
+    "latest_meta",
+    "list_checkpoints",
+    "reassign_shards",
+    "reshape_spec",
+    "restore_state",
+    "shard_assignment",
+    "state_payload",
     "NpzShardDataset",
     "PrefetchLoader",
     "SyntheticLoader",

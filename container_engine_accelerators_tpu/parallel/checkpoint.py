@@ -1,0 +1,605 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Library-level async checkpointing: snapshot, then write in the
+background.
+
+The demo driver used to call an orbax AsyncCheckpointer directly;
+this module promotes that capability into a first-class
+CheckpointManager the Trainer path, the serving loader, and the
+elastic supervisor all share — with the three properties elastic
+training needs and the orbax wrapper could not guarantee:
+
+  - **The blocking cost is the snapshot only.** ``save()`` copies the
+    (possibly donated) device arrays to host, attributes *that* time
+    to the goodput ledger's ``checkpoint`` bucket, and returns; the
+    serialize + write + fsync + atomic-rename runs on one background
+    worker thread. Under periodic saves the checkpoint badput bucket
+    therefore approaches the device->host copy time, not disk time.
+  - **Checkpoints are mesh-agnostic.** Leaves are stored as plain
+    host arrays keyed by their pytree path; ``restore(...,
+    shardings=)`` lays them out for whatever mesh the *restoring*
+    process built — save under a 4x2 mesh, restore under 3x2 or 1-D
+    after an eviction, parameter-exact, optimizer state included
+    (its leaves travel the same path-keyed route as params).
+  - **A reader can trust the directory.** A checkpoint is written
+    under ``checkpoint_N.tmp-<pid>-<seq>`` and os.replace'd to
+    ``checkpoint_N`` only after every file (and the directory entry)
+    is fsynced; listing counts only finished dirs that carry a
+    ``meta.json``, so a crash mid-write can never be restored from
+    or counted by retention.
+
+On a multi-host fleet exactly one process writes (``primary=True``,
+normally ``jax.process_index() == 0``); the payload must be fully
+addressable from that process (replicated params / pure DP — the
+FSDP gather-first case raises rather than writing a shard and
+calling it a checkpoint). Non-primary saves are free no-ops, and
+every process restores by reading the same directory.
+"""
+
+import json
+import os
+import queue
+import shutil
+import sys
+import threading
+import time
+
+import numpy as np
+
+from .. import obs
+from ..utils import get_logger
+
+log = get_logger("checkpoint")
+
+CHECKPOINT_PREFIX = "checkpoint_"
+META_NAME = "meta.json"
+ARRAYS_NAME = "arrays.npz"
+FORMAT_VERSION = 1
+
+SAVED_EVENT = "train.checkpoint_saved"
+
+_SAVE_HISTOGRAM = "tpu_train_checkpoint_block_seconds"
+
+
+def _leaf_items(tree):
+    """[(path_key, leaf)] with stable, unique string keys.
+
+    jax.tree_util.keystr renders a path as "['params']['w']" /
+    ".step" — unique per leaf and stable across processes, which is
+    what makes the archive a flat, mesh-free map.
+    """
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def list_checkpoints(directory):
+    """Sorted (step, name) pairs of FINISHED checkpoints.
+
+    Finished = integer-suffixed ``checkpoint_N`` directory holding a
+    ``meta.json``. In-flight ``checkpoint_N.tmp-*`` siblings and
+    foreign entries never qualify.
+    """
+    entries = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return entries
+    for name in names:
+        if not name.startswith(CHECKPOINT_PREFIX):
+            continue
+        try:
+            step = int(name[len(CHECKPOINT_PREFIX):])
+        except ValueError:
+            continue
+        if os.path.exists(os.path.join(directory, name, META_NAME)):
+            entries.append((step, name))
+    return sorted(entries)
+
+
+def unrecognized_checkpoints(directory):
+    """``checkpoint_``-prefixed entries that are NOT finished library
+    checkpoints and NOT this format's in-flight ``.tmp-`` siblings —
+    the signature of a model_dir written in a different format (e.g.
+    the pre-library orbax driver). Restore paths warn loudly on
+    these: silently starting from scratch next to unreadable
+    checkpoints would look like a lost run, and same-step saves
+    would replace them."""
+    finished = {name for _, name in list_checkpoints(directory)}
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if (name.startswith(CHECKPOINT_PREFIX)
+                and ".tmp-" not in name and name not in finished):
+            out.append(name)
+    return sorted(out)
+
+
+def warn_unrecognized_checkpoints(directory, action, stream=None):
+    """Warn (to ``stream``, default stderr) when ``directory`` holds
+    unrecognized ``checkpoint_*`` entries, and return them. ``action``
+    finishes the sentence with what the caller does instead (e.g.
+    "serving INITIALIZED weights instead") — one shared phrasing for
+    every restore path, so the drivers cannot drift."""
+    foreign = unrecognized_checkpoints(directory)
+    if foreign:
+        if stream is None:
+            stream = sys.stderr
+        plural = "y" if len(foreign) == 1 else "ies"
+        more = "..." if len(foreign) > 3 else ""
+        print(f"WARNING: {directory!r} holds {len(foreign)} "
+              f"checkpoint entr{plural} in an unrecognized format "
+              f"(pre-library orbax run?): {foreign[:3]}{more} — "
+              f"{action}", file=stream)
+    return foreign
+
+
+def latest_meta(directory):
+    """The newest finished checkpoint's meta dict (plus its path), or
+    None — the provenance a diagnose bundle shows for "where would
+    this fleet resume from". Reads only json; safe without jax."""
+    entries = list_checkpoints(directory)
+    if not entries:
+        return None
+    _, name = entries[-1]
+    path = os.path.join(directory, name)
+    try:
+        with open(os.path.join(path, META_NAME)) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"path": path, "error": f"{type(e).__name__}: {e}"}
+    meta["path"] = path
+    return meta
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class CheckpointError(RuntimeError):
+    """A background write failed; raised at the next save() or
+    wait_until_finished() so the failure cannot pass silently."""
+
+
+class CheckpointManager:
+    """Owns one checkpoint directory: async saves, retention,
+    cross-mesh restore.
+
+    ``goodput`` is the Trainer's GoodputLedger (or any object with
+    ``record(bucket, seconds)``): the manager attributes exactly its
+    blocking time to the ``checkpoint`` bucket — the snapshot alone
+    when ``async_save`` (the default), the whole serialize+write when
+    synchronous. ``keep > 0`` retains only the newest ``keep``
+    finished checkpoints. ``primary=False`` turns saves into no-ops
+    (the non-writer hosts of a fleet).
+    """
+
+    def __init__(self, directory, keep=0, async_save=True,
+                 goodput=None, primary=True, fsync=True):
+        self.directory = str(directory)
+        self.keep = int(keep)
+        self.async_save = bool(async_save)
+        self.primary = bool(primary)
+        self._fsync = bool(fsync)
+        self._goodput = goodput
+        self._seq = 0
+        self._error = None
+        self._queue = None
+        self._worker = None
+        self._closed = False
+        self._lock = threading.Lock()
+        # Pending-write count under the lock, not a queue-emptiness
+        # probe: between save()'s flag-clear and its put() the queue
+        # IS empty, and an emptiness-based idle flag would let
+        # wait_until_finished() return with a write still pending.
+        self._pending = 0
+        self._all_done = threading.Condition(self._lock)
+
+    def configure(self, keep=None, goodput=None):
+        """Re-point a long-lived manager (callers share one per
+        directory per process). Explicit values only — None leaves a
+        setting alone."""
+        if keep is not None:
+            self.keep = int(keep)
+        if goodput is not None:
+            self._goodput = goodput
+
+    # -- listing ------------------------------------------------------
+
+    def steps(self):
+        return [step for step, _ in list_checkpoints(self.directory)]
+
+    def latest_step(self):
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def manifest(self, step=None):
+        """meta dict of ``step`` (default: newest), or None."""
+        if step is None:
+            return latest_meta(self.directory)
+        path = os.path.join(self.directory,
+                            f"{CHECKPOINT_PREFIX}{int(step)}")
+        if not os.path.exists(os.path.join(path, META_NAME)):
+            return None
+        with open(os.path.join(path, META_NAME)) as f:
+            meta = json.load(f)
+        meta["path"] = path
+        return meta
+
+    # -- save ---------------------------------------------------------
+
+    def save(self, payload, step, blocking=False):
+        """Snapshot ``payload`` (any pytree of arrays/scalars) and
+        schedule the write of ``checkpoint_<step>``.
+
+        Returns the final path (None on a non-primary host). The call
+        blocks only for the device->host snapshot unless
+        ``blocking=True`` or the manager is synchronous.
+        """
+        self._raise_pending()
+        if not self.primary:
+            return None
+        step = int(step)
+        path = os.path.join(self.directory,
+                            f"{CHECKPOINT_PREFIX}{step}")
+        t0 = time.perf_counter()
+        with obs.span("train.checkpoint", step=step,
+                      mode="sync" if (blocking or not self.async_save)
+                      else "async"):
+            arrays, meta = self._snapshot(payload, step)
+            if self.async_save and not blocking:
+                self._ensure_worker()
+                # Enqueue under the lock: a concurrent close() puts
+                # its shutdown sentinel under the same lock, so an
+                # accepted save can never land behind the sentinel
+                # (where the exiting worker would silently drop it).
+                with self._lock:
+                    if self._closed:
+                        raise CheckpointError(
+                            "save() on a closed CheckpointManager")
+                    self._pending += 1
+                    self._queue.put((arrays, meta, path))
+            blocked = time.perf_counter() - t0
+            if not self.async_save or blocking:
+                self._write(arrays, meta, path)
+                blocked = time.perf_counter() - t0
+        obs.histogram(
+            _SAVE_HISTOGRAM,
+            "Host-blocking portion of a checkpoint save").observe(
+                blocked)
+        if self._goodput is not None:
+            self._goodput.record("checkpoint", blocked)
+        return path
+
+    def _snapshot(self, payload, step):
+        """The blocking part: device arrays -> host numpy, plus the
+        meta block. Runs before the train loop's next step can donate
+        the state buffers away."""
+        import jax
+
+        arrays = {}
+        mesh_axes = None
+        for key, leaf in _leaf_items(payload):
+            if leaf is None:
+                continue
+            if isinstance(leaf, jax.Array):
+                if not leaf.is_fully_addressable:
+                    raise CheckpointError(
+                        f"leaf {key} is not fully addressable from "
+                        f"this process; gather (or run pure-DP) "
+                        f"before checkpointing — writing one shard "
+                        f"would not be a checkpoint")
+                sharding = getattr(leaf, "sharding", None)
+                mesh = getattr(sharding, "mesh", None)
+                if mesh_axes is None and mesh is not None \
+                        and hasattr(mesh, "shape"):
+                    try:
+                        mesh_axes = {str(k): int(v)
+                                     for k, v in dict(mesh.shape).items()}
+                    except TypeError:
+                        mesh_axes = None
+            if key in arrays:
+                raise CheckpointError(
+                    f"duplicate pytree path key {key!r}")
+            value = np.asarray(jax.device_get(leaf))
+            if value is leaf or not value.flags.owndata:
+                # device_get is zero-copy for host-resident (and
+                # CPU-backed) leaves; the background writer must
+                # never alias a buffer the train loop can mutate or
+                # donate away after save() returns.
+                value = np.array(value)
+            arrays[key] = value
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "step": step,
+            "created_unix": time.time(),
+            "identity": obs.identity(),
+            "mesh_axes": mesh_axes,
+            "async": bool(self.async_save),
+            "leaf_count": len(arrays),
+            "keys": sorted(arrays),
+            "bytes": int(sum(a.nbytes for a in arrays.values())),
+        }
+        return arrays, meta
+
+    def _write(self, arrays, meta, path):
+        # _write runs on the worker thread for queued saves and on
+        # the caller thread for blocking ones — take the seq under
+        # the lock so concurrent writers can never share a tmp dir.
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        tmp = f"{path}.tmp-{os.getpid()}-{seq}"
+        os.makedirs(tmp, exist_ok=True)
+        stale = None
+        try:
+            arrays_path = os.path.join(tmp, ARRAYS_NAME)
+            with open(arrays_path, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                if self._fsync:
+                    os.fsync(f.fileno())
+            with open(os.path.join(tmp, META_NAME), "w") as f:
+                json.dump(meta, f, indent=1)
+                f.write("\n")
+                f.flush()
+                if self._fsync:
+                    os.fsync(f.fileno())
+            if os.path.isdir(path):
+                # Same-step overwrite (a re-run after restore): move
+                # the old finished dir aside, land the new one, and
+                # only THEN delete — a crash can at worst lose the
+                # two-rename window, never strand a long rmtree of
+                # the only finished checkpoint.
+                stale = f"{path}.tmp-stale-{os.getpid()}-{seq}"
+                os.replace(path, stale)
+            os.replace(tmp, path)
+            if self._fsync:
+                _fsync_dir(self.directory)
+            if stale is not None:
+                shutil.rmtree(stale, ignore_errors=True)
+        except BaseException:
+            if stale is not None and not os.path.isdir(path):
+                # The final rename failed with the old checkpoint
+                # moved aside: put it back rather than lose it.
+                try:
+                    os.replace(stale, path)
+                except OSError:
+                    pass
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        obs.event(SAVED_EVENT, step=meta["step"], path=path,
+                  bytes=meta["bytes"], leaves=meta["leaf_count"])
+        if self.keep > 0:
+            self.prune()
+        return path
+
+    def prune(self):
+        """Delete all but the newest ``keep`` finished checkpoints."""
+        if self.keep < 1:
+            return
+        for _, name in list_checkpoints(self.directory)[:-self.keep]:
+            victim = os.path.join(self.directory, name)
+            shutil.rmtree(victim, ignore_errors=True)
+            log.info("pruned checkpoint %s", victim)
+
+    # -- background worker --------------------------------------------
+
+    def _ensure_worker(self):
+        with self._lock:
+            if self._closed:
+                raise CheckpointError(
+                    "save() on a closed CheckpointManager")
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._queue = queue.Queue()
+            self._worker = threading.Thread(
+                target=self._drain, name="tpu-checkpoint-writer",
+                daemon=True)
+            self._worker.start()
+
+    def _drain(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            arrays, meta, path = item
+            try:
+                self._write(arrays, meta, path)
+            except BaseException as e:  # surfaced at next save/wait
+                log.exception("background checkpoint write failed: %s",
+                              path)
+                with self._lock:
+                    self._error = e
+            finally:
+                with self._all_done:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._all_done.notify_all()
+
+    def wait_until_finished(self, timeout=None):
+        """Block until every queued write has landed; re-raises the
+        first background failure."""
+        with self._all_done:
+            ok = self._all_done.wait_for(
+                lambda: self._pending == 0, timeout)
+        if not ok:
+            raise CheckpointError(
+                f"checkpoint writes still pending after {timeout}s")
+        self._raise_pending()
+
+    def _raise_pending(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise CheckpointError(
+                f"background checkpoint write failed: {err}") from err
+
+    def close(self):
+        """Finish queued writes and stop the worker thread; raises
+        if a write is still in flight after 60s (the daemon thread
+        would be killed mid-write at interpreter exit, losing the
+        run's final checkpoint with exit code 0). Later save() calls
+        raise rather than enqueue behind the shutdown sentinel."""
+        with self._lock:
+            self._closed = True
+            worker = self._worker
+            if worker is not None:
+                self._queue.put(None)
+        if worker is not None:
+            worker.join(timeout=60)
+            if worker.is_alive():
+                raise CheckpointError(
+                    "checkpoint writer still running after 60s; the "
+                    "final write may not have landed")
+            self._worker = None
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- restore ------------------------------------------------------
+
+    def restore(self, template, step=None, shardings=None,
+                missing="error"):
+        """Rebuild ``template``'s pytree from ``checkpoint_<step>``
+        (default: newest).
+
+        ``template`` supplies only the STRUCTURE (its leaves may be
+        arrays or jax.eval_shape structs); values come from the
+        archive, looked up by pytree path — so a template holding a
+        subset of the saved tree (serving wants params, not
+        opt_state) restores cleanly, and the archive's layout never
+        depends on the mesh that wrote it. ``shardings`` (a matching
+        pytree of NamedSharding, e.g. Trainer.state_shardings) lays
+        leaves out for the RESTORING mesh; without it leaves come
+        back as host numpy arrays.
+
+        ``missing="error"`` (default) raises on a template path the
+        archive lacks; ``missing="template"`` keeps the template's
+        own leaf for it (how a newly-enabled EMA shadow rides through
+        restores of pre-EMA checkpoints).
+        """
+        import jax
+
+        if missing not in ("error", "template"):
+            raise ValueError(f"missing must be error|template: "
+                             f"{missing!r}")
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no finished checkpoints under "
+                    f"{self.directory!r}")
+        path = os.path.join(self.directory,
+                            f"{CHECKPOINT_PREFIX}{int(step)}")
+        with obs.span("train.checkpoint_restore", step=int(step)):
+            with np.load(os.path.join(path, ARRAYS_NAME)) as archive:
+                flat, treedef = jax.tree_util.tree_flatten_with_path(
+                    template)
+                leaves = []
+                for p, leaf in flat:
+                    key = jax.tree_util.keystr(p)
+                    if key in archive.files:
+                        leaves.append(archive[key])
+                    elif missing == "template":
+                        leaves.append(leaf)
+                    else:
+                        raise KeyError(
+                            f"checkpoint {path} has no leaf {key}; "
+                            f"saved keys: {sorted(archive.files)[:8]}"
+                            f"...")
+            out = jax.tree_util.tree_unflatten(treedef, leaves)
+            if shardings is not None:
+                out = jax.device_put(out, shardings)
+        return out
+
+    def has_leaf(self, key_substring, step=None):
+        """True when the checkpoint archives any pytree path
+        containing ``key_substring`` (cheap: reads meta only)."""
+        meta = self.manifest(step)
+        if not meta:
+            return False
+        return any(key_substring in k for k in meta.get("keys", []))
+
+
+# -- TrainState convenience -------------------------------------------
+
+def state_payload(state):
+    """The canonical on-disk payload for a TrainState — a plain dict,
+    so checkpoints outlive TrainState field churn and partial readers
+    (serving wants params only) stay trivial. The EMA shadow is
+    archived only when tracked."""
+    payload = {"step": state.step, "params": state.params,
+               "opt_state": state.opt_state,
+               "batch_stats": state.batch_stats}
+    if state.ema_params is not None:
+        payload["ema_params"] = state.ema_params
+    return payload
+
+
+def restore_state(manager, state_template, shardings=None, step=None):
+    """TrainState from ``manager``'s newest (or ``step``'s)
+    checkpoint, laid out for the RESTORING mesh.
+
+    ``state_template`` is a freshly-initialized TrainState on the new
+    mesh (values ignored — it provides structure); ``shardings`` is
+    the matching Trainer.state_shardings result. A template tracking
+    EMA restores the archived shadow when one exists and re-seeds it
+    from the restored params otherwise (checkpoints written before
+    EMA was enabled resume seamlessly).
+    """
+    import jax
+
+    from .train import TrainState
+
+    template = {"step": state_template.step,
+                "params": state_template.params,
+                "opt_state": state_template.opt_state,
+                "batch_stats": state_template.batch_stats}
+    # has_leaf reads meta only, so the archive itself is opened
+    # exactly once — restores sit on the recovery hot path.
+    track_ema = state_template.ema_params is not None
+    archived_ema = track_ema and manager.has_leaf("['ema_params']",
+                                                  step=step)
+    if archived_ema:
+        template["ema_params"] = state_template.ema_params
+    restored = manager.restore(template, step=step)
+    ema = None
+    if track_ema:
+        ema = (restored["ema_params"] if archived_ema
+               else restored["params"])
+    state = TrainState(step=restored["step"],
+                       params=restored["params"],
+                       opt_state=restored["opt_state"],
+                       batch_stats=restored["batch_stats"],
+                       ema_params=ema)
+    if shardings is not None:
+        state = jax.device_put(state, shardings)
+    return state

@@ -52,6 +52,69 @@ def synthetic_batch(batch_size, image_shape, num_classes, seed=0,
     return images, labels
 
 
+def synthetic_step_batch(step, batch_size, image_shape, num_classes,
+                         seed=0, dtype=np.float32):
+    """The GLOBAL batch for one step, deterministic in (seed, step).
+
+    Every host can regenerate any step's batch independently, which
+    is what makes elastic recovery replayable: after an eviction the
+    surviving hosts resume from the checkpointed step and recompute
+    the exact batches the full fleet would have seen — the loss
+    trajectory is mesh-layout-independent (same global batch -> same
+    mean gradient, up to reduction order).
+    """
+    rng = np.random.default_rng(np.random.SeedSequence(
+        [int(seed), int(step)]))
+    images = rng.standard_normal(
+        (batch_size, *image_shape), dtype=np.float32).astype(dtype)
+    labels = rng.integers(0, num_classes, size=(batch_size,),
+                          dtype=np.int32)
+    return images, labels
+
+
+def shard_assignment(num_shards, hosts):
+    """{host: [shard indices]} — contiguous blocks, remainder to the
+    leading hosts. The unit of elastic data reassignment: a "shard"
+    is whatever the pipeline splits by host (a batch-row range, an
+    .npz file set, a queue partition)."""
+    hosts = list(hosts)
+    if not hosts:
+        raise ValueError("no hosts to assign shards to")
+    if num_shards < len(hosts):
+        raise ValueError(
+            f"{num_shards} shards cannot cover {len(hosts)} hosts; "
+            f"an unfed host would idle its chips")
+    base, extra = divmod(num_shards, len(hosts))
+    out, next_shard = {}, 0
+    for i, host in enumerate(hosts):
+        n = base + (1 if i < extra else 0)
+        out[host] = list(range(next_shard, next_shard + n))
+        next_shard += n
+    return out
+
+
+def reassign_shards(assignment, departed):
+    """Fold departed hosts' shards onto the survivors.
+
+    Each survivor keeps its own shards IN ORDER (the
+    "same data order per surviving shard" recovery contract) and
+    gains recovered shards appended least-loaded-first, so the
+    post-eviction load spread stays within one shard.
+    """
+    departed = set(departed)
+    survivors = {h: list(s) for h, s in assignment.items()
+                 if h not in departed}
+    if not survivors:
+        raise ValueError("eviction would leave no hosts")
+    orphaned = sorted(s for h in departed & set(assignment)
+                      for s in assignment[h])
+    order = sorted(survivors)  # deterministic tie-break
+    for shard in orphaned:
+        host = min(order, key=lambda h: len(survivors[h]))
+        survivors[host].append(shard)
+    return survivors
+
+
 class _PoolLoader:
     """Infinite loader cycling a small pool of device-resident batches.
 

@@ -23,22 +23,66 @@ communication to the workload's framework (SURVEY.md section 2.4).
 """
 
 import os
+import time
 
-from ..utils import get_logger
+from .. import obs
+from ..utils import env_number, get_logger
 
 log = get_logger("distributed")
 
 DEFAULT_COORDINATOR_PORT = 8476
 
+# Bounded-hang knobs. An unreachable coordinator used to block
+# initialize() for jax's five-minute default PER attempt with no
+# retry; elastic recovery needs a deadline it can act on instead.
+COORD_TIMEOUT_ENV = "CEA_TPU_COORD_TIMEOUT_MS"
+COORD_RETRIES_ENV = "CEA_TPU_COORD_RETRIES"
+COORD_BACKOFF_ENV = "CEA_TPU_COORD_BACKOFF_MS"
 
-def initialize_from_plugin_env(coordinator_port=None):
-    """Initialize jax.distributed from plugin-injected envs.
+DEFAULT_COORD_TIMEOUT_MS = 60_000
+DEFAULT_COORD_RETRIES = 2
+DEFAULT_COORD_BACKOFF_MS = 500
+_BACKOFF_CAP_MS = 30_000
+
+# Shares the elastic layer's recovery counter so one Prometheus
+# query covers every recovery-path action (eviction reasons AND
+# coordinator retries/timeouts). Import would be circular-free but
+# keep this module importable without the elastic module loaded.
+RECOVERY_COUNTER = "tpu_train_recovery_total"
+
+
+class DeadlineExceeded(TimeoutError):
+    """A bounded distributed-runtime operation ran out its deadline
+    (coordinator connect, barrier). Carries enough context for the
+    supervisor to act — which host, which op, how long."""
+
+
+def _env_int(name, default):
+    return env_number(name, default, parse=int)
+
+
+def initialize_from_plugin_env(coordinator_port=None, timeout_ms=None,
+                               retries=None, backoff_ms=None,
+                               _initialize=None):
+    """Initialize jax.distributed from plugin-injected envs, with
+    bounded retries instead of indefinite hangs.
 
     No-op (returns False) when the pod holds a single-host slice.
     Worker 0's hostname serves as the coordinator by default;
     CEA_COORDINATOR_ADDRESS (full host:port) or CEA_COORDINATOR_PORT
     override it for Jobs whose coordinator lives behind a different
     Service name or port.
+
+    Each connect attempt is capped at ``timeout_ms``
+    (CEA_TPU_COORD_TIMEOUT_MS, default 60s); failures retry up to
+    ``retries`` times (CEA_TPU_COORD_RETRIES, default 2) with
+    exponential backoff starting at ``backoff_ms``
+    (CEA_TPU_COORD_BACKOFF_MS, default 500ms, doubling, capped at
+    30s). The terminal failure raises DeadlineExceeded — a signal a
+    supervisor can evict/relaunch on — and every retry bumps
+    ``tpu_train_recovery_total{reason="coordinator_retry"}``.
+    ``_initialize`` is the test seam (defaults to
+    jax.distributed.initialize).
     """
     hostnames = [h for h in
                  os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
@@ -63,12 +107,110 @@ def initialize_from_plugin_env(coordinator_port=None):
                 "CEA_COORDINATOR_PORT", DEFAULT_COORDINATOR_PORT))
         coordinator = f"{hostnames[0]}:{coordinator_port}"
 
+    timeout_ms = (timeout_ms if timeout_ms is not None
+                  else _env_int(COORD_TIMEOUT_ENV,
+                                DEFAULT_COORD_TIMEOUT_MS))
+    retries = (retries if retries is not None
+               else _env_int(COORD_RETRIES_ENV, DEFAULT_COORD_RETRIES))
+    backoff_ms = (backoff_ms if backoff_ms is not None
+                  else _env_int(COORD_BACKOFF_ENV,
+                                DEFAULT_COORD_BACKOFF_MS))
+
+    if _initialize is None:
+        import jax
+
+        _initialize = jax.distributed.initialize
+
+        def _cleanup_failed_attempt():
+            # A failed connect leaves jax.distributed's global state
+            # partially initialized (client assigned BEFORE the
+            # connect), and a second initialize() then refuses with
+            # "should only be called once" — tear it down so the
+            # retry actually reconnects.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+    else:
+        def _cleanup_failed_attempt():
+            return None
+
+    last_error = None
+    for attempt in range(max(0, int(retries)) + 1):
+        try:
+            _initialize(
+                coordinator_address=coordinator,
+                num_processes=len(hostnames),
+                process_id=worker_id,
+                initialization_timeout=max(1, timeout_ms // 1000))
+            log.info("jax.distributed up: process %d/%d via %s "
+                     "(attempt %d)", worker_id, len(hostnames),
+                     coordinator, attempt + 1)
+            return True
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            last_error = e
+            _cleanup_failed_attempt()
+            if attempt >= retries:
+                break
+            pause = min(backoff_ms * (2 ** attempt),
+                        _BACKOFF_CAP_MS) / 1e3
+            log.warning(
+                "jax.distributed initialize failed (attempt %d/%d, "
+                "coordinator %s): %s; retrying in %.1fs",
+                attempt + 1, retries + 1, coordinator, e, pause)
+            obs.counter(RECOVERY_COUNTER, 1,
+                        reason="coordinator_retry")
+            time.sleep(pause)
+    obs.counter(RECOVERY_COUNTER, 1, reason="coordinator_timeout")
+    raise DeadlineExceeded(
+        f"jax.distributed initialize failed after {retries + 1} "
+        f"attempt(s) against {coordinator} "
+        f"(timeout {timeout_ms}ms each): {last_error}") from last_error
+
+
+def barrier(name, timeout_ms=None):
+    """Fleet barrier with a deadline — never an indefinite hang.
+
+    Rides the distributed coordination service's key-value barrier
+    (every process must call with the same ``name``); raises
+    DeadlineExceeded when the fleet does not assemble within
+    ``timeout_ms`` (default CEA_TPU_COORD_TIMEOUT_MS) — the signature
+    of a dead or hung peer, and the supervisor's cue to evict rather
+    than wait forever. Single-process runs return immediately.
+    """
+    from jax._src import distributed as jax_distributed
+
+    client = getattr(jax_distributed.global_state, "client", None)
+    if client is None:
+        return False  # single-host: nothing to synchronize with
+    timeout_ms = (timeout_ms if timeout_ms is not None
+                  else _env_int(COORD_TIMEOUT_ENV,
+                                DEFAULT_COORD_TIMEOUT_MS))
+    t0 = time.perf_counter()
+    try:
+        client.wait_at_barrier(str(name), timeout_in_ms=int(timeout_ms))
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        obs.counter(RECOVERY_COUNTER, 1, reason="barrier_timeout")
+        raise DeadlineExceeded(
+            f"barrier {name!r} did not assemble within "
+            f"{timeout_ms}ms "
+            f"(waited {time.perf_counter() - t0:.1f}s): {e}") from e
+    return True
+
+
+def shutdown():
+    """Tear down this process's distributed runtime (mesh teardown
+    half of an elastic reshape); safe to call when never
+    initialized."""
     import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=len(hostnames),
-        process_id=worker_id)
-    log.info("jax.distributed up: process %d/%d via %s",
-             worker_id, len(hostnames), coordinator)
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:
+        log.info("jax.distributed shutdown: %s", e)
+        return False
     return True

@@ -116,6 +116,28 @@ def build_mesh(spec=None, devices=None):
     return grid_mesh(devices, spec.data, spec.model, MODEL_AXIS)
 
 
+def reshape_spec(n_devices, model_parallelism=1):
+    """MeshSpec for a fleet that just lost hosts (elastic reshape).
+
+    Keeps the model axis when it still divides the surviving device
+    count (4x2 -> 3x2 after one of four 2-chip hosts departs);
+    otherwise falls back to a 1-D data mesh — tensor parallelism is a
+    layout optimization, and a degraded fleet that can still train
+    beats one wedged on a non-dividing axis.
+    """
+    if n_devices < 1:
+        raise ValueError(f"no devices to reshape onto: {n_devices}")
+    if model_parallelism > 1 and n_devices % model_parallelism == 0:
+        return MeshSpec(data=n_devices // model_parallelism,
+                        model=model_parallelism)
+    if model_parallelism > 1:
+        log.warning(
+            "model=%d does not divide %d surviving devices; "
+            "falling back to a 1-D data mesh", model_parallelism,
+            n_devices)
+    return MeshSpec(data=n_devices, model=1)
+
+
 HOST_AXES = ("host_x", "host_y", "host_z")
 
 

@@ -347,6 +347,23 @@ class Trainer:
         self._record_step(time.perf_counter() - t0)
         return out
 
+    def remesh(self, mesh):
+        """A fresh Trainer bound to ``mesh`` with this one's exact
+        configuration — the elastic-recovery path. The compiled step
+        and sharding caches are mesh-specific, so they start empty;
+        the goodput ledger carries over (recovery is one run's wall
+        time, not a new run), and host identity re-resolves lazily
+        (worker ids renumber after an eviction)."""
+        return Trainer(self._apply, self._loss, self._tx, mesh=mesh,
+                       donate_state=self._donate, remat=self._remat,
+                       grad_accum=self._grad_accum,
+                       augment_fn=self._augment,
+                       ema_decay=self._ema_decay, fsdp=self._fsdp,
+                       straggler=self._straggler,
+                       summary_every=self._summary_every,
+                       mfu_source=self._mfu_source,
+                       goodput=self.goodput)
+
     def host_id(self):
         """This trainer's host identity for step telemetry."""
         if self._host_id is None:

@@ -12,10 +12,27 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Shared helpers: structured logging, path utilities."""
+"""Shared helpers: structured logging, path utilities, env parsing."""
+
+import os
 
 from .paths import accel_index, device_name_from_path, is_accel_name
 from .log import get_logger, set_verbosity
 
-__all__ = ["accel_index", "device_name_from_path", "is_accel_name",
-           "get_logger", "set_verbosity"]
+__all__ = ["accel_index", "device_name_from_path", "env_number",
+           "is_accel_name", "get_logger", "set_verbosity"]
+
+
+def env_number(name, default, parse=float):
+    """Numeric env-var knob: ``parse``d value, or ``default`` when
+    unset/empty; junk warns and falls back rather than crashing the
+    process that reads a mistyped deployment manifest."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return parse(raw)
+    except ValueError:
+        get_logger("env").warning("ignoring non-numeric %s=%r",
+                                  name, raw)
+        return default

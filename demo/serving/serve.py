@@ -57,35 +57,33 @@ from container_engine_accelerators_tpu.serving import (
 def load_checkpoint_variables(model_dir, init_variables):
     """Restore {"params"[, "batch_stats"]} from the newest finished
     checkpoint_N under model_dir (train.py's layout); falls back to
-    the given init when the directory has no checkpoints."""
-    import orbax.checkpoint as ocp
+    the given init when the directory has no checkpoints.
 
-    entries = []
-    try:
-        names = os.listdir(model_dir)
-    except OSError:
-        names = []
-    for name in names:
-        if not name.startswith("checkpoint_"):
-            continue
-        try:
-            entries.append((int(name.rsplit("_", 1)[1]), name))
-        except ValueError:
-            continue
-    if not entries:
-        print(f"no checkpoints under {model_dir!r}; serving "
-              f"initialized weights", file=sys.stderr)
+    Rides the library CheckpointManager: checkpoints are flat
+    path-keyed archives, so serving restores exactly the model
+    variables (opt_state stays on disk) — partial restore is the
+    format's natural mode, not a version-dependent reader flag.
+    """
+    from container_engine_accelerators_tpu.parallel.checkpoint import (
+        CheckpointManager,
+        warn_unrecognized_checkpoints,
+    )
+
+    mgr = CheckpointManager(model_dir)
+    step = mgr.latest_step()
+    if step is None:
+        foreign = warn_unrecognized_checkpoints(
+            model_dir, "serving INITIALIZED weights instead")
+        if not foreign:
+            print(f"no checkpoints under {model_dir!r}; serving "
+                  f"initialized weights", file=sys.stderr)
         return init_variables
-    path = os.path.abspath(
-        os.path.join(model_dir, sorted(entries)[-1][1]))
-    # Serving needs only the model variables; leave opt_state on disk.
     template = {"params": init_variables["params"]}
     if "batch_stats" in init_variables:
         template["batch_stats"] = init_variables["batch_stats"]
-    restored = ocp.PyTreeCheckpointer().restore(
-        path, args=ocp.args.PyTreeRestore(item=template,
-                                          partial_restore=True))
-    print(f"serving weights from {path}", file=sys.stderr)
+    restored = mgr.restore(template, step=step)
+    print(f"serving weights from {mgr.manifest(step)['path']}",
+          file=sys.stderr)
     out = {"params": restored["params"]}
     if "batch_stats" in init_variables:
         out["batch_stats"] = restored["batch_stats"]

@@ -230,117 +230,86 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-_async_checkpointer = None
+# Checkpointing delegates to the library manager
+# (parallel/checkpoint.py): async snapshot-then-background-write,
+# retention, cross-mesh resharded restore. The driver only decides
+# WHEN to save; the manager owns the how, the badput accounting
+# (blocking snapshot -> the `checkpoint` goodput bucket), and the
+# directory protocol.
+_managers = {}
 
 
-def _checkpointer():
-    """Process-wide orbax AsyncCheckpointer (lazily created).
+def _manager(model_dir, keep=None, goodput=None):
+    """One CheckpointManager per model_dir for this process (repeat
+    main() calls in one process — the test path — share the writer
+    thread); explicit keep/goodput reconfigure it, None leaves the
+    prior setting alone (the restore path passes neither)."""
+    from container_engine_accelerators_tpu.parallel.checkpoint import (
+        CheckpointManager,
+    )
 
-    Async saves snapshot device arrays and write on a background
-    thread, so periodic --checkpoint-every saves overlap the next
-    training steps instead of stalling the TPU on host IO — the
-    point of checkpointing being an aux subsystem, not a pause
-    button. finalize_checkpoints() must run before the process exits.
-    """
-    global _async_checkpointer
-    if _async_checkpointer is None:
-        import orbax.checkpoint as ocp
+    model_dir = os.path.abspath(model_dir)
+    mgr = _managers.get(model_dir)
+    if mgr is None:
+        # primary=: on a multi-host fleet exactly one process writes
+        # the shared model_dir; N concurrent writers would race the
+        # same-step overwrite dance and each other's retention prune.
+        mgr = _managers[model_dir] = CheckpointManager(
+            model_dir, keep=keep or 0, async_save=True,
+            goodput=goodput, primary=jax.process_index() == 0)
+    else:
+        mgr.configure(keep=keep, goodput=goodput)
+    return mgr
 
-        _async_checkpointer = ocp.AsyncCheckpointer(
-            ocp.PyTreeCheckpointHandler())
-    return _async_checkpointer
 
+def save_checkpoint(model_dir, state, keep=0, goodput=None):
+    """Checkpoint the TrainState (demo parity with the reference's
+    --model_dir GCS checkpoints). Returns as soon as the on-device
+    state is snapshotted; the write completes in the background
+    (finalize_checkpoints() joins it) and retention prunes there
+    too."""
+    from container_engine_accelerators_tpu.parallel.checkpoint import (
+        state_payload,
+    )
 
-def save_checkpoint(model_dir, state):
-    """Checkpoint params/opt/batch_stats with orbax (demo parity with
-    the reference's --model_dir GCS checkpoints). Returns as soon as
-    the on-device state is snapshotted; the write completes in the
-    background (finalize_checkpoints() joins it)."""
-    step = int(state.step)
-    path = os.path.abspath(os.path.join(model_dir, f"checkpoint_{step}"))
-    payload = {"step": step, "params": state.params,
-               "opt_state": state.opt_state,
-               "batch_stats": state.batch_stats}
-    if state.ema_params is not None:
-        payload["ema_params"] = state.ema_params
-    _checkpointer().save(path, payload, force=True)
+    mgr = _manager(model_dir, keep=keep, goodput=goodput)
+    path = mgr.save(state_payload(state), step=int(state.step))
     print(f"saving checkpoint {path} (async)", file=sys.stderr)
     return path
 
 
 def finalize_checkpoints():
     """Block until every async checkpoint write has landed."""
-    if _async_checkpointer is not None:
-        _async_checkpointer.wait_until_finished()
+    for mgr in _managers.values():
+        mgr.wait_until_finished()
 
 
 def _list_checkpoints(model_dir):
-    """Sorted (step, name) pairs of finished checkpoint_N dirs.
+    """Sorted (step, name) pairs of finished checkpoint_N dirs
+    (in-flight .tmp-* siblings never count)."""
+    from container_engine_accelerators_tpu.parallel.checkpoint import (
+        list_checkpoints,
+    )
 
-    Skips names whose suffix is not an integer — orbax async writes
-    go through "checkpoint_N.orbax-checkpoint-tmp-*" siblings that
-    must be neither restored from nor pruned.
-    """
-    entries = []
-    try:
-        names = os.listdir(model_dir)
-    except OSError:
-        return entries
-    for name in names:
-        if not name.startswith("checkpoint_"):
-            continue
-        try:
-            entries.append((int(name.rsplit("_", 1)[1]), name))
-        except ValueError:
-            continue
-    return sorted(entries)
+    return list_checkpoints(model_dir)
 
 
-def prune_checkpoints(model_dir, keep):
-    """Delete all but the newest ``keep`` finished checkpoints."""
-    import shutil
+def restore_checkpoint(model_dir, state, shardings=None):
+    """Resume from the newest checkpoint_N under model_dir, if any —
+    laid out for THIS run's mesh (resharded restore), whatever mesh
+    wrote it."""
+    from container_engine_accelerators_tpu.parallel.checkpoint import (
+        restore_state,
+    )
 
-    if keep < 1:
-        return
-    for _, name in _list_checkpoints(model_dir)[:-keep]:
-        path = os.path.join(model_dir, name)
-        shutil.rmtree(path, ignore_errors=True)
-        print(f"pruned checkpoint {path}", file=sys.stderr)
-
-
-def restore_checkpoint(model_dir, state):
-    """Resume from the newest checkpoint_N under model_dir, if any."""
-    import orbax.checkpoint as ocp
-
-    from container_engine_accelerators_tpu.parallel.train import TrainState
-
-    entries = _list_checkpoints(model_dir)
-    if not entries:
+    mgr = _manager(model_dir)
+    if mgr.latest_step() is None:
+        _warn_foreign_checkpoints(model_dir)
         return state
-    path = os.path.abspath(os.path.join(model_dir, entries[-1][1]))
-    item = {"step": 0, "params": state.params,
-            "opt_state": state.opt_state,
-            "batch_stats": state.batch_stats}
-    ema = None
-    if state.ema_params is not None:
-        # EMA-tracking run: prefer restoring the shadow too (written
-        # by EMA-enabled runs); checkpoints from before EMA lack the
-        # key, in which case the caller re-seeds via ensure_ema.
-        try:
-            restored = ocp.PyTreeCheckpointer().restore(
-                path, item=dict(item, ema_params=state.ema_params))
-            ema = restored["ema_params"]
-        except Exception:
-            restored = ocp.PyTreeCheckpointer().restore(path, item=item)
-    else:
-        restored = ocp.PyTreeCheckpointer().restore(path, item=item)
-    print(f"restored checkpoint {path}", file=sys.stderr)
-    import jax.numpy as _jnp
-    return TrainState(step=_jnp.asarray(restored["step"], _jnp.int32),
-                      params=restored["params"],
-                      opt_state=restored["opt_state"],
-                      batch_stats=restored["batch_stats"],
-                      ema_params=ema)
+    restored = restore_state(mgr, state, shardings=shardings)
+    print(f"restored checkpoint step {int(restored.step)} from "
+          f"{model_dir}", file=sys.stderr)
+    return restored
 
 
 def build_lm(args, mesh):
@@ -535,10 +504,8 @@ def run_pipeline_lm(args, devices):
             save_pipeline_checkpoint(
                 args.model_dir,
                 {"step": step0 + step + 1, "params": params,
-                 "opt_state": opt_state})
-            if args.keep_checkpoints:
-                prune_checkpoints(args.model_dir,
-                                  args.keep_checkpoints)
+                 "opt_state": opt_state},
+                keep=args.keep_checkpoints)
     wall_sync(params)
     t_end = time.perf_counter()
     if hasattr(loader, "close"):
@@ -551,10 +518,9 @@ def run_pipeline_lm(args, devices):
         save_pipeline_checkpoint(
             args.model_dir,
             {"step": step0 + args.steps, "params": params,
-             "opt_state": opt_state})
+             "opt_state": opt_state},
+            keep=args.keep_checkpoints)
         finalize_checkpoints()
-        if args.keep_checkpoints:
-            prune_checkpoints(args.model_dir, args.keep_checkpoints)
     result = {
         "model": "transformer",
         "pipeline_parallelism": pp,
@@ -572,27 +538,39 @@ def run_pipeline_lm(args, devices):
     return result
 
 
-def save_pipeline_checkpoint(model_dir, payload):
+def save_pipeline_checkpoint(model_dir, payload, keep=0):
     """Async-checkpoint the pipeline payload ({step, params,
     opt_state}) under the same checkpoint_N naming as the main
     driver."""
-    step = int(payload["step"])
-    path = os.path.abspath(
-        os.path.join(model_dir, f"checkpoint_{step}"))
-    _checkpointer().save(path, payload, force=True)
+    mgr = _manager(model_dir, keep=keep)
+    path = mgr.save(payload, step=int(payload["step"]))
     print(f"saving checkpoint {path} (async)", file=sys.stderr)
     return path
+
+
+def _warn_foreign_checkpoints(model_dir):
+    """A model_dir holding checkpoint_* entries this driver cannot
+    read (a pre-library orbax run, a torn copy) must not look like a
+    clean from-scratch start — the operator loses the run silently
+    and same-step saves then replace the old dirs."""
+    from container_engine_accelerators_tpu.parallel.checkpoint import (
+        warn_unrecognized_checkpoints,
+    )
+
+    warn_unrecognized_checkpoints(
+        model_dir,
+        "they will NOT be restored, and saves at the same step "
+        "numbers will replace them")
 
 
 def restore_pipeline_checkpoint(model_dir, template):
     """Newest finished checkpoint restored against ``template``'s
     tree, or None when the dir holds none."""
-    entries = _list_checkpoints(model_dir)
-    if not entries:
+    mgr = _manager(model_dir)
+    if mgr.latest_step() is None:
+        _warn_foreign_checkpoints(model_dir)
         return None
-    _, name = entries[-1]
-    path = os.path.abspath(os.path.join(model_dir, name))
-    return _checkpointer().restore(path, item=template)
+    return mgr.restore(template)
 
 
 def _dense_lm_loss(logits, labels, label_smoothing=0.0):
@@ -768,12 +746,12 @@ def main(argv=None):
             args.model_dir = ""
         else:
             t_restore = time.perf_counter()
-            state = jax.device_put(restore_checkpoint(args.model_dir, state),
-                                   trainer.state_shardings(state))
-            # Checkpoints written without EMA restore with
-            # ema_params=None; re-seed the shadow from the restored
-            # params so tracking just continues.
-            state = trainer.ensure_ema(state)
+            # Resharded restore: laid out for THIS run's mesh,
+            # whatever mesh wrote the checkpoint. EMA shadows from
+            # pre-EMA checkpoints re-seed inside restore_state.
+            state = restore_checkpoint(
+                args.model_dir, state,
+                shardings=trainer.state_shardings(state))
             recovery_s = time.perf_counter() - t_restore
             if int(state.step) > 0:
                 # A restored run spent this wall time on recovery:
@@ -816,17 +794,13 @@ def main(argv=None):
             print(f"step {step} loss {loss_val:.4f}", file=sys.stderr)
         if (args.model_dir and args.checkpoint_every
                 and (step + 1) % args.checkpoint_every == 0):
-            # The save is async (orbax AsyncCheckpointer): the span
-            # and badput bucket measure the host-blocking dispatch
-            # part, which is what actually steals step time.
-            t_ckpt = time.perf_counter()
-            with obs.span("train.checkpoint", step=step + 1):
-                save_checkpoint(args.model_dir, state)
-                if args.keep_checkpoints:
-                    prune_checkpoints(args.model_dir,
-                                      args.keep_checkpoints)
-            trainer.record_badput("checkpoint",
-                                  time.perf_counter() - t_ckpt)
+            # Async save: the manager snapshots (the only blocking
+            # part — that time alone lands in the `checkpoint`
+            # badput bucket and the train.checkpoint span), writes
+            # and prunes on its background thread.
+            save_checkpoint(args.model_dir, state,
+                            keep=args.keep_checkpoints,
+                            goodput=trainer.goodput)
     wall_sync(state.params)
     t_end = time.perf_counter()
     # A prefetching loader would otherwise keep staged batches pinned
@@ -864,10 +838,10 @@ def main(argv=None):
         print(f"eval accuracy top1 {result['eval_accuracy']} "
               f"top5 {result['eval_top5_accuracy']}", file=sys.stderr)
     if args.model_dir:
-        save_checkpoint(args.model_dir, state)
+        save_checkpoint(args.model_dir, state,
+                        keep=args.keep_checkpoints,
+                        goodput=trainer.goodput)
         finalize_checkpoints()
-        if args.keep_checkpoints:
-            prune_checkpoints(args.model_dir, args.keep_checkpoints)
     print(json.dumps(result))
     return result
 

@@ -184,11 +184,26 @@ def test_int8_kv_cache_matches_bf16_greedy(dense_lm):
     seq_f = greedy_decode(model, params, prompt, N)
     np.testing.assert_array_equal(np.asarray(seq_q[:, :P]),
                                   np.asarray(prompt))
-    # Near-tie argmaxes may legitimately flip under ~0.4% quant
-    # error; demand strong (not bit-exact) agreement so the test
-    # survives numerics-neutral JAX/seed changes.
-    agree = np.mean(np.asarray(seq_q[:, P:]) == np.asarray(seq_f[:, P:]))
-    assert agree >= 0.9, f"token agreement {agree:.2f}"
+    assert seq_q.shape == seq_f.shape
+    # DEFLAKED: free-running token agreement is the wrong metric —
+    # one near-tie argmax flip makes every later token diverge, so
+    # the old >= 0.9 agreement assertion was bimodal (observed
+    # spread across prompt seeds 0-7 on this rig: 1.0 for seven
+    # seeds, 0.55 for PRNGKey(0) — a flip at the 5th generated
+    # token, after which the sequences are unrelated). Instead,
+    # teacher-force the SAME text (the f32 greedy output) through
+    # both caches and compare each step's echo logprobs: this
+    # measures the actual quantization error per position, with no
+    # compounding. Observed max |delta| here is ~0.009 nats; 0.05
+    # leaves 5x margin while still catching a broken quantizer
+    # (zeroed scales or wrong-axis quantization shift logprobs by
+    # >> 0.1).
+    _, lp_f = decode(model, params, seq_f, 1, return_logprobs=True,
+                     fast_prefill=False)
+    _, lp_q = decode(q_model, params, seq_f, 1, return_logprobs=True,
+                     fast_prefill=False)
+    np.testing.assert_allclose(np.asarray(lp_q), np.asarray(lp_f),
+                               atol=0.05)
 
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         greedy_decode(model.clone(kv_cache_dtype="fp8"), params,

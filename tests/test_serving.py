@@ -287,15 +287,17 @@ def test_train_checkpoint_serve_roundtrip(tmp_path):
     restored = serve_mod.load_checkpoint_variables(
         str(tmp_path), init_vars)
 
-    import orbax.checkpoint as ocp
+    # Independent read of what train.py wrote: the raw npz archive,
+    # not the library reader the serving loader itself uses.
     names = sorted(n for n in tmp_path.iterdir()
                    if n.name.startswith("checkpoint_"))
-    raw = ocp.PyTreeCheckpointer().restore(str(names[-1]))
-    got = jax.tree_util.tree_leaves(restored["params"])
-    want = jax.tree_util.tree_leaves(raw["params"])
-    assert len(got) == len(want)
-    for g, w in zip(got, want):
-        onp.testing.assert_array_equal(onp.asarray(g), onp.asarray(w))
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        {"params": restored["params"]})
+    assert flat
+    with onp.load(names[-1] / "arrays.npz") as raw:
+        for path, got in flat:
+            key = jax.tree_util.keystr(path)
+            onp.testing.assert_array_equal(onp.asarray(got), raw[key])
     # And they differ from a fresh init (training moved them).
     fresh = jax.tree_util.tree_leaves(init_vars["params"])
     assert any(not onp.array_equal(onp.asarray(g), onp.asarray(f))

@@ -1,0 +1,563 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Chaos harness (the `make chaos-check` preflight).
+
+Trains a 4-host x 2-chip fleet on the CPU fake backend, then breaks
+it mid-step the two ways real fleets break:
+
+  - **kill**: one host's worker process gets SIGKILL and its chips
+    start reporting WEDGED to the fake-chip plugin — the health
+    poller flips them Unhealthy, and the ElasticSupervisor consumes
+    the ``health.transition`` journal events (the plugin-health
+    eviction path);
+  - **hang**: another host's worker gets SIGSTOP — every thread
+    frozen, so its liveness heartbeat goes stale while its chips
+    stay green (the hung-process signature the skew/health signals
+    can't see).
+
+Each failure must produce EXACTLY one ``train.eviction`` and one
+``train.reshape`` event, a mesh reshape (4x2 -> 3x2 -> 2x2), data-
+shard reassignment, and a resharded restore from the latest async
+checkpoint — after which the fleet must converge to the SAME final
+loss as an uninterrupted reference run (deterministic step-keyed
+global batches make the trajectory mesh-layout-independent), with
+``tpu_train_goodput_ratio`` >= 0.5 over the whole episode.
+
+A final leg compares the ``checkpoint`` badput bucket under periodic
+ASYNC checkpointing against the equivalent synchronous-save run: the
+async bucket (the blocking snapshot only) must be < 10% of the sync
+one (snapshot + serialize + write + fsync).
+
+The failure INJECTION is real (processes killed/stopped, chip state
+files flipped); the training fleet is simulated in-process on the
+8-device CPU mesh, with each "host" owning 2 devices — the same
+fleet model tests/test_elastic.py uses, scaled up and driven by real
+process-level signals.
+
+Exit 0 = clean, 1 = check failed, 2 = harness error.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla:
+    os.environ["XLA_FLAGS"] = (
+        _xla + " --xla_force_host_platform_device_count=8").strip()
+os.environ["CEA_TPU_TRACE"] = "1"  # events are the acceptance surface
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+
+obs.set_role("train")
+
+# Fleet model: 4 hosts x 2 chips, mesh 4x2 (data x model).
+HOSTS = ["h0", "h1", "h2", "h3"]
+CHIPS_PER_HOST = 2
+MODEL_PARALLEL = 2
+
+# Sized so productive step time (~0.5s/step on this CPU rig)
+# dominates the 3 mesh compiles and 2 recoveries: the goodput floor
+# must be meetable honestly, not via sleeps.
+HIDDEN = 2048
+BATCH = 480  # divisible by every surviving data-axis size (4, 3, 2)
+DATA_SEED = 7
+TOTAL_STEPS = 36
+CHECKPOINT_EVERY = 6
+
+KILL_AT = 13   # SIGKILL h1 + wedge its chips, right after this step
+HANG_AT = 25   # SIGSTOP h2 right after this step
+KILL_HOST, HANG_HOST = "h1", "h2"
+# Heartbeats tick every 100ms; the threshold sits 25x above that so
+# a loaded CI box descheduling a healthy child for a second or two
+# cannot fake a hang (a spurious third eviction fails the gate). The
+# hung host still detects a few steps after its SIGSTOP.
+STALE_AFTER_S = 2.5
+
+GOODPUT_FLOOR = 0.5
+CKPT_BADPUT_MAX_RATIO = 0.10
+CKPT_COMPARE_SAVES = 6
+# Reshapes regroup the data-axis reduction, so the surviving fleet's
+# psum order differs from the reference's — bit-exactness is not on
+# the table, convergence to the same loss is. Observed |delta| on
+# this rig is ~1e-6 over 35 post-reshape steps; 1e-3 still cleanly
+# separates "same trajectory" from a lost/corrupt restore (which
+# lands whole loss units away).
+LOSS_TOL = 1e-3
+
+DEADLINE_S = 420.0
+
+_HEARTBEAT_CHILD = (
+    "import os, sys, time\n"
+    "hb = sys.argv[1]\n"
+    "while True:\n"
+    "    os.utime(hb, None)\n"
+    "    time.sleep(0.1)\n")
+
+
+def fake_node(root):
+    """8-chip 4x2 fake node; host hN owns chips 2N and 2N+1."""
+    dev = os.path.join(root, "dev")
+    state = os.path.join(root, "state")
+    os.makedirs(dev)
+    os.makedirs(state)
+    for i in range(len(HOSTS) * CHIPS_PER_HOST):
+        open(os.path.join(dev, f"accel{i}"), "w").close()
+        os.makedirs(os.path.join(state, f"accel{i}"))
+    with open(os.path.join(state, "topology"), "w") as f:
+        f.write("4x2")
+    return dev, state
+
+
+def wedge_chips(state_dir, host):
+    """Flip ``host``'s chips to WEDGED in the fake backend state —
+    the next health poll marks their devices Unhealthy."""
+    base = HOSTS.index(host) * CHIPS_PER_HOST
+    for chip in range(base, base + CHIPS_PER_HOST):
+        with open(os.path.join(state_dir, f"accel{chip}",
+                               "health"), "w") as f:
+            f.write("wedged")
+
+
+def start_workers(hb_dir):
+    """One real child process per host: touches its heartbeat file
+    every 100ms. SIGKILL/SIGSTOP on these is the chaos injection."""
+    workers, heartbeats = {}, {}
+    for host in HOSTS:
+        hb = os.path.join(hb_dir, f"{host}.hb")
+        open(hb, "w").close()
+        heartbeats[host] = hb
+        workers[host] = subprocess.Popen(
+            [sys.executable, "-c", _HEARTBEAT_CHILD, hb],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    return workers, heartbeats
+
+
+def stop_workers(workers):
+    for proc in workers.values():
+        try:
+            proc.send_signal(signal.SIGCONT)  # un-freeze hung ones
+        except OSError:
+            pass
+        try:
+            proc.kill()
+        except OSError:
+            pass
+    for proc in workers.values():
+        try:
+            proc.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def make_trainer(mesh):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from container_engine_accelerators_tpu.models import MnistMLP
+    from container_engine_accelerators_tpu.models import mlp as mlp_mod
+    from container_engine_accelerators_tpu.parallel import Trainer
+    from container_engine_accelerators_tpu.parallel.train import (
+        cross_entropy_loss,
+    )
+
+    model = MnistMLP(hidden=HIDDEN, dtype=jnp.float32)
+    trainer = Trainer(mlp_mod.make_apply_fn(model), cross_entropy_loss,
+                      optax.sgd(0.1, momentum=0.9), mesh=mesh,
+                      summary_every=10)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 28, 28, 1)))
+    return trainer, variables
+
+
+def pregenerate_batches():
+    """Every step's GLOBAL batch as host arrays, generated once: the
+    deterministic step-keyed data elastic replay depends on, staged
+    up front so batch generation does not pollute the goodput wall
+    (a real pipeline prefetches; this harness pre-stages)."""
+    from container_engine_accelerators_tpu.parallel.data import (
+        synthetic_step_batch,
+    )
+
+    return [synthetic_step_batch(step, BATCH, (28, 28, 1), 10,
+                                 seed=DATA_SEED)
+            for step in range(TOTAL_STEPS)]
+
+
+def step_batch(batches, step, mesh):
+    import jax
+
+    from container_engine_accelerators_tpu.parallel.sharding import (
+        batch_sharding,
+    )
+
+    images, labels = batches[step]
+    sh = batch_sharding(mesh)
+    return jax.device_put(images, sh), jax.device_put(labels, sh)
+
+
+def blocked_step(trainer, state, batch):
+    """One step, synchronized to completion. The Trainer's ledger
+    records the DISPATCH time as productive; on an async backend the
+    device-compute tail would otherwise land in `other`, so the tail
+    between dispatch return and result readiness is recorded
+    through the same public ledger seam the demo driver uses."""
+    import jax
+
+    state, loss = trainer.train_step(state, batch)
+    t1 = time.perf_counter()
+    jax.block_until_ready((state, loss))
+    trainer.goodput.record("productive", time.perf_counter() - t1)
+    return state, loss
+
+
+def reference_run(batches):
+    """Uninterrupted 4x2 run: the trajectory the chaos fleet must
+    converge back onto."""
+    from container_engine_accelerators_tpu.parallel import (
+        MeshSpec,
+        build_mesh,
+    )
+
+    mesh = build_mesh(MeshSpec(data=len(HOSTS), model=MODEL_PARALLEL))
+    trainer, variables = make_trainer(mesh)
+    state = trainer.init_state(variables)
+    loss = None
+    for step in range(TOTAL_STEPS):
+        state, loss = trainer.train_step(
+            state, step_batch(batches, step, mesh))
+    return float(loss), state
+
+
+def chaos_run(batches, workers, heartbeats, checker, state_dir,
+              ckpt_dir, report, failures):
+    import jax
+
+    from container_engine_accelerators_tpu.parallel import (
+        CheckpointManager,
+        ElasticSupervisor,
+        EvictionPolicy,
+        MeshSpec,
+        build_mesh,
+        state_payload,
+    )
+    from container_engine_accelerators_tpu.parallel.elastic import (
+        down_hosts_from_events,
+    )
+
+    devices = jax.devices()
+    host_devices = {
+        h: devices[i * CHIPS_PER_HOST:(i + 1) * CHIPS_PER_HOST]
+        for i, h in enumerate(HOSTS)}
+    device_to_host = {f"accel{i * CHIPS_PER_HOST + c}": h
+                      for i, h in enumerate(HOSTS)
+                      for c in range(CHIPS_PER_HOST)}
+
+    mesh = build_mesh(MeshSpec(data=len(HOSTS), model=MODEL_PARALLEL))
+    trainer, variables = make_trainer(mesh)
+    state = trainer.init_state(variables)
+    mgr = CheckpointManager(ckpt_dir, keep=3, async_save=True,
+                            goodput=trainer.goodput)
+    sup = ElasticSupervisor(
+        hosts=HOSTS, chips_per_host=CHIPS_PER_HOST,
+        model_parallel=MODEL_PARALLEL, goodput=trainer.goodput,
+        policy=EvictionPolicy(skew_factor=2.0, skew_windows=3,
+                              stale_after_s=STALE_AFTER_S),
+        host_devices=host_devices)
+
+    def supervise():
+        """One supervision round: health poll + liveness scan ->
+        supervisor signals."""
+        checker.poll_once()
+        events = obs.TRACER.snapshot()["events"]
+        down = down_hosts_from_events(events, device_to_host)
+        now = time.time()
+        stale = {}
+        for host in sup.hosts:
+            try:
+                stale[host] = now - os.path.getmtime(heartbeats[host])
+            except OSError:
+                stale[host] = float("inf")
+        return sup.observe(down=down, stale=stale)
+
+    deadline = time.monotonic() + DEADLINE_S
+    pending = set()
+    injected = set()  # a rewound step counter must not re-inject
+    recoveries = []
+    step, loss = 0, None
+    while True:
+        if time.monotonic() > deadline:
+            failures.append(
+                f"chaos run exceeded {DEADLINE_S}s deadline at step "
+                f"{step} (pending: {sorted(pending)})")
+            break
+        if step < TOTAL_STEPS:
+            state, loss = blocked_step(trainer, state,
+                                       step_batch(batches, step, mesh))
+            step += 1
+            if step % CHECKPOINT_EVERY == 0:
+                mgr.save(state_payload(state), step=step)
+            if step == KILL_AT and KILL_HOST not in injected:
+                print(f"[chaos] step {step}: SIGKILL {KILL_HOST} + "
+                      f"wedging its chips", file=sys.stderr)
+                workers[KILL_HOST].kill()
+                wedge_chips(state_dir, KILL_HOST)
+                injected.add(KILL_HOST)
+                pending.add(KILL_HOST)
+            elif step == HANG_AT and HANG_HOST not in injected:
+                print(f"[chaos] step {step}: SIGSTOP {HANG_HOST}",
+                      file=sys.stderr)
+                workers[HANG_HOST].send_signal(signal.SIGSTOP)
+                injected.add(HANG_HOST)
+                pending.add(HANG_HOST)
+        plan = supervise()
+        if plan is not None:
+            pending -= {h for h, _ in plan.evicted}
+            mgr.wait_until_finished()
+            trainer, state, mesh = sup.rebuild(
+                plan, trainer, mgr,
+                init_state=lambda t: t.init_state(variables))
+            spec = plan.mesh_spec
+            recoveries.append({
+                "evicted": plan.evicted,
+                "resume_step": plan.resume_step,
+                "mesh": f"{spec.data}x{spec.model}",
+                "at_step": step,
+            })
+            print(f"[chaos] recovered: evicted {plan.evicted}, "
+                  f"mesh -> {spec.data}x{spec.model}, resumed at "
+                  f"step {plan.resume_step}", file=sys.stderr)
+            step = int(state.step)
+            continue
+        if step >= TOTAL_STEPS:
+            if pending:  # injected, not yet detected: keep watching
+                time.sleep(0.1)
+                continue
+            break
+
+    mgr.close()  # join the writer; a late failure must surface here
+    goodput = trainer.goodput.publish()
+    report["recoveries"] = recoveries
+    report["goodput"] = goodput
+    report["final_mesh"] = (f"{sup.mesh_spec.data}x"
+                            f"{sup.mesh_spec.model}")
+    report["chaos_checkpoint_badput_s"] = \
+        goodput["buckets"]["checkpoint"]
+    return float(loss) if loss is not None else None, state
+
+
+def check_param_delta(ref_state, chaos_state, report):
+    import jax
+    import numpy as np
+
+    deltas = jax.tree_util.tree_map(
+        lambda a, b: float(np.max(np.abs(
+            np.asarray(a) - np.asarray(b)))),
+        ref_state.params, chaos_state.params)
+    delta = max(jax.tree_util.tree_leaves(deltas) or [0.0])
+    report["max_param_delta"] = delta
+    return delta
+
+
+def check_chaos_events(report, failures):
+    """Exactly one eviction + one reshape per injected failure, with
+    the right reasons, plus the recovery counters."""
+    from container_engine_accelerators_tpu.parallel.elastic import (
+        EVICTION_EVENT,
+        RECOVERY_COUNTER,
+        RESHAPE_EVENT,
+    )
+
+    snap = obs.TRACER.snapshot()
+    evictions = [e for e in snap["events"]
+                 if e["name"] == EVICTION_EVENT]
+    reshapes = [e for e in snap["events"]
+                if e["name"] == RESHAPE_EVENT]
+    report["eviction_events"] = [e["fields"] for e in evictions]
+    report["reshape_events"] = [e["fields"] for e in reshapes]
+    if len(evictions) != 2:
+        failures.append(f"{len(evictions)} eviction events for 2 "
+                        f"injected failures; want exactly 2")
+    if len(reshapes) != 2:
+        failures.append(f"{len(reshapes)} reshape events for 2 "
+                        f"injected failures; want exactly 2")
+    reasons = {e["fields"].get("host"): e["fields"].get("reason")
+               for e in evictions}
+    if reasons.get(KILL_HOST) != "health_down":
+        failures.append(
+            f"killed host {KILL_HOST} evicted as "
+            f"{reasons.get(KILL_HOST)!r}; want health_down (the "
+            f"plugin health-flip path)")
+    if reasons.get(HANG_HOST) != "host_hung":
+        failures.append(
+            f"hung host {HANG_HOST} evicted as "
+            f"{reasons.get(HANG_HOST)!r}; want host_hung (the stale-"
+            f"heartbeat path)")
+    counters = {reason: value for (name, labels), value
+                in obs.TRACER.counters().items()
+                if name == RECOVERY_COUNTER
+                for _, reason in labels}
+    report["recovery_counters"] = counters
+    for reason in ("health_down", "host_hung"):
+        if counters.get(reason) != 1:
+            failures.append(
+                f"{RECOVERY_COUNTER}{{reason={reason}}} = "
+                f"{counters.get(reason)}; want 1")
+
+
+def check_goodput(report, failures):
+    from container_engine_accelerators_tpu.obs.efficiency import (
+        GOODPUT_GAUGE,
+    )
+
+    ratio = report["goodput"]["goodput_ratio"]
+    if ratio is None or ratio < GOODPUT_FLOOR:
+        failures.append(
+            f"goodput ratio {ratio} across the chaos episode; floor "
+            f"is {GOODPUT_FLOOR} (buckets: "
+            f"{report['goodput']['buckets']})")
+    gauges = {name: v for (name, _), v in obs.TRACER.gauges().items()}
+    published = gauges.get(GOODPUT_GAUGE)
+    report["goodput_gauge"] = published
+    if published is None or published < GOODPUT_FLOOR:
+        failures.append(
+            f"{GOODPUT_GAUGE} gauge {published}; floor is "
+            f"{GOODPUT_FLOOR}")
+
+
+def checkpoint_badput_compare(state, root, report, failures):
+    """Periodic async vs sync checkpointing: the async run's
+    ``checkpoint`` bucket (blocking snapshots only) must be < 10% of
+    the sync run's (snapshot + serialize + write + fsync)."""
+    import jax
+
+    from container_engine_accelerators_tpu.obs.efficiency import (
+        GoodputLedger,
+    )
+    from container_engine_accelerators_tpu.parallel import (
+        CheckpointManager,
+        state_payload,
+    )
+
+    payload = state_payload(state)
+    jax.device_get(payload)  # warm the transfer path for both modes
+    buckets = {}
+    for mode in ("async", "sync"):
+        ledger = GoodputLedger()
+        with CheckpointManager(os.path.join(root, f"ckpt-{mode}"),
+                               async_save=(mode == "async"),
+                               goodput=ledger) as mgr:
+            for i in range(1, CKPT_COMPARE_SAVES + 1):
+                mgr.save(payload, step=i)
+            mgr.wait_until_finished()
+        buckets[mode] = ledger.summary()["buckets"]["checkpoint"]
+    ratio = (buckets["async"] / buckets["sync"]
+             if buckets["sync"] > 0 else float("inf"))
+    report["checkpoint_badput"] = {
+        "async_blocking_s": round(buckets["async"], 6),
+        "sync_blocking_s": round(buckets["sync"], 6),
+        "ratio": round(ratio, 4),
+        "saves": CKPT_COMPARE_SAVES,
+    }
+    if ratio >= CKPT_BADPUT_MAX_RATIO:
+        failures.append(
+            f"async checkpoint badput {buckets['async']:.4f}s is "
+            f"{ratio:.1%} of sync {buckets['sync']:.4f}s; must be "
+            f"< {CKPT_BADPUT_MAX_RATIO:.0%}")
+
+
+def main():
+    from container_engine_accelerators_tpu.chip import PyChipBackend
+    from container_engine_accelerators_tpu.plugin.health import (
+        TpuHealthChecker,
+    )
+    from container_engine_accelerators_tpu.plugin.manager import (
+        TpuManager,
+    )
+
+    failures = []
+    report = {}
+    root = tempfile.mkdtemp(prefix="tpu-chaos-check")
+    dev, state_dir = fake_node(root)
+    backend = PyChipBackend()
+    manager = TpuManager(dev_dir=dev, state_dir=state_dir,
+                         backend=backend)
+    manager.start()
+    checker = TpuHealthChecker(manager, backend)
+    workers, heartbeats = start_workers(root)
+    try:
+        batches = pregenerate_batches()
+        ref_loss, ref_state = reference_run(batches)
+        report["reference_loss"] = ref_loss
+        chaos_loss, final_state = chaos_run(
+            batches, workers, heartbeats, checker, state_dir,
+            os.path.join(root, "ckpt"), report, failures)
+        report["chaos_loss"] = chaos_loss
+        if chaos_loss is None:
+            failures.append("chaos run produced no final loss")
+        elif abs(chaos_loss - ref_loss) > LOSS_TOL:
+            failures.append(
+                f"chaos fleet final loss {chaos_loss:.6f} vs "
+                f"uninterrupted {ref_loss:.6f}: |delta| "
+                f"{abs(chaos_loss - ref_loss):.2e} > {LOSS_TOL}")
+        if final_state is not None:
+            # Same TRAJECTORY, not just a similar loss: the final
+            # parameters must agree too (a lost/corrupt restore
+            # lands whole units away; reduction-order drift across
+            # two reshapes stays ~1e-6 here).
+            delta = check_param_delta(ref_state, final_state, report)
+            if delta > LOSS_TOL:
+                failures.append(
+                    f"max |param delta| vs uninterrupted run "
+                    f"{delta:.2e} > {LOSS_TOL}")
+        check_chaos_events(report, failures)
+        check_goodput(report, failures)
+        if final_state is not None:
+            checkpoint_badput_compare(final_state, root, report,
+                                      failures)
+    except Exception as e:
+        import traceback
+        traceback.print_exc()
+        print(f"chaos-check: harness error: {e!r}", file=sys.stderr)
+        return 2
+    finally:
+        stop_workers(workers)
+        manager.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+    report["failures"] = failures
+    print(json.dumps(report))
+    if failures:
+        for f in failures:
+            print(f"chaos-check FAILED: {f}", file=sys.stderr)
+        return 1
+    print("chaos-check: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

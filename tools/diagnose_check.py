@@ -27,7 +27,12 @@ journal and fails unless the bundle carries:
     (distinct pids — the flight recorder's whole point is the
     cross-process timeline),
   - an ok /debug/varz snapshot with the RPC latency histogram,
-  - the fake node's device state (chips + topology).
+  - the fake node's device state (chips + topology),
+  - the elastic section: the child journal's eviction/reshape/
+    recovery events, the recovery counter from the varz leg, and the
+    newest finished checkpoint's provenance from --checkpoint-dir
+    (postmortems must show what the supervisor DID, not just what it
+    saw).
 
 Pure CPU, no jax, a few seconds: cheap enough to run before every
 suite next to trace-check. Exit 0 = clean, 1 = check failed,
@@ -78,7 +83,20 @@ _CHILD_JOURNAL_CODE = (
     "with obs.span('train.step_run'):\n"
     "    time.sleep(0.02)\n"
     "obs.event('profiler.capture', artifact='/tmp/fake-profile',\n"
-    "          seconds=0.5)\n")
+    "          seconds=0.5)\n"
+    # Elastic-section fodder: the event shapes parallel/elastic.py
+    # emits on a real eviction (again, the journal CONTRACT is what
+    # this check guards; chaos_check.py drives the real supervisor).
+    "obs.event('train.eviction', host='h1', reason='health_down',\n"
+    "          survivors=3)\n"
+    "obs.event('train.reshape', evicted='h1',\n"
+    "          reasons='health_down', old_shape='4x2',\n"
+    "          new_shape='3x2', survivors=3)\n"
+    "obs.event('train.recovered', evicted='h1', new_shape='3x2',\n"
+    "          resume_step=12, recovery_s=1.5)\n"
+    "obs.event('train.checkpoint_saved', step=12,\n"
+    "          path='/tmp/ckpt/checkpoint_12', bytes=1024,\n"
+    "          leaves=4)\n")
 
 
 def fake_node(root):
@@ -123,6 +141,21 @@ def main():
                     api.v1beta1_pb2.ContainerAllocateRequest(
                         devicesIDs=["accel0"])]), timeout=10)
 
+        # The recovery counter rides varz (this process IS the
+        # plugin the bundle sweeps), and a finished checkpoint dir
+        # supplies resume provenance — both halves of the elastic
+        # section's endpoint-side contract.
+        obs.counter("tpu_train_recovery_total", 1,
+                    reason="health_down")
+        ckpt_dir = os.path.join(root, "ckpt")
+        finished = os.path.join(ckpt_dir, "checkpoint_12")
+        os.makedirs(finished)
+        os.makedirs(os.path.join(ckpt_dir, "checkpoint_13.tmp-1-0"))
+        with open(os.path.join(finished, "meta.json"), "w") as f:
+            json.dump({"format_version": 1, "step": 12,
+                       "leaf_count": 4, "bytes": 1024,
+                       "keys": ["['params']['w']"]}, f)
+
         # A second process's journal: the serving-replica stand-in.
         journal = os.path.join(root, "serving_journal.json")
         env = dict(os.environ, CEA_TPU_TRACE_FILE=journal,
@@ -144,6 +177,7 @@ def main():
              "--url", f"http://localhost:{metrics.port}",
              "--journal", journal,
              "--dev-dir", dev, "--state-dir", state,
+             "--checkpoint-dir", ckpt_dir,
              "--out", bundle_path],
             capture_output=True, text=True, timeout=120,
             cwd=REPO_ROOT)
@@ -217,6 +251,37 @@ def main():
             failures.append(
                 f"profiles section missing the child's capture: "
                 f"{profiles!r}")
+        elastic = bundle.get("elastic") or {}
+        if elastic.get("evictions") != 1 or \
+                elastic.get("reshapes") != 1:
+            failures.append(
+                f"elastic section lost the child's eviction/reshape "
+                f"events: {elastic.get('evictions')}/"
+                f"{elastic.get('reshapes')}")
+        ev_names = [e.get("name") for e in
+                    elastic.get("events") or []]
+        if ev_names != sorted(
+                ev_names, key=["train.eviction", "train.reshape",
+                               "train.recovered"].index):
+            failures.append(
+                f"elastic events not in timeline order: {ev_names}")
+        counters = elastic.get("recovery_counters") or {}
+        if not any("health_down" in k for legs in counters.values()
+                   for k in legs):
+            failures.append(
+                f"recovery counter missing from the varz leg: "
+                f"{counters!r}")
+        meta = (elastic.get("checkpoints") or {}).get(ckpt_dir)
+        if not (isinstance(meta, dict) and meta.get("step") == 12
+                and meta.get("path", "").endswith("checkpoint_12")):
+            failures.append(
+                f"checkpoint provenance missing/wrong (in-flight "
+                f".tmp dir must not win): {meta!r}")
+        last = elastic.get("last_save") or {}
+        if (last.get("fields") or {}).get("step") != 12:
+            failures.append(
+                f"last_save missing the child's checkpoint_saved "
+                f"event: {last!r}")
     finally:
         metrics.stop()
         manager.stop()

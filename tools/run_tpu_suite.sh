@@ -98,6 +98,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "goodput-check preflight"
 
+# Elastic-training preflight (CPU fake backend, ~3 min): kill one
+# host and hang another mid-step; the supervisor must evict (exactly
+# one eviction+reshape event each), reshape 4x2 -> 3x2 -> 2x2,
+# resume resharded from the async checkpoint, and converge to the
+# uninterrupted run's loss with goodput ratio >= 0.5 and async
+# checkpoint badput < 10% of sync. A regression here means a real
+# fleet failure during this suite's window would wedge training
+# instead of recovering.
+echo "[suite] chaos-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/chaos_check.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "chaos-check preflight"
+
 # Continuous-batching preflight (CPU fake backend, ~1 min): the slot
 # engine must beat the sequential-batch policy >= 2x in goodput on a
 # replayed Poisson trace with greedy outputs bit-identical to

@@ -37,7 +37,13 @@ incident:
     any postmortem hbm_memory state the dead processes flushed);
   - every profiler capture the journals record (``profiler.capture``
     events -> artifact paths), so the operator can grab the traces
-    taken during the incident.
+    taken during the incident;
+  - what the elastic supervisor DID, not just what it saw: every
+    ``train.eviction``/``train.reshape``/``train.recovered`` event
+    in timeline order, the ``tpu_train_recovery_total`` counters
+    from each varz leg, and the newest finished checkpoint's
+    provenance from any --checkpoint-dir (where the fleet would
+    resume from).
 
 Endpoint failures are recorded in place (a structured error per
 surface), never raised: on a half-dead node the partial bundle IS the
@@ -177,6 +183,87 @@ def memory_section(endpoints, journals):
     return {"gauges": gauges, "postmortem": postmortem}
 
 
+ELASTIC_EVENTS = ("train.eviction", "train.reshape",
+                  "train.recovered")
+RECOVERY_COUNTER = "tpu_train_recovery_total"
+
+
+def _latest_checkpoint_meta(directory):
+    """Newest finished checkpoint's meta.json (plus its path), or
+    None. Reads the parallel/checkpoint.py on-disk contract directly
+    (``checkpoint_N/meta.json``; a dir without meta.json is an
+    unfinished write) — plain json so this tool stays jax-free."""
+    entries = []
+    try:
+        names = os.listdir(directory)
+    except OSError as e:
+        return {"directory": directory,
+                "error": f"{type(e).__name__}: {e}"}
+    for name in names:
+        if not name.startswith("checkpoint_"):
+            continue
+        try:
+            step = int(name[len("checkpoint_"):])
+        except ValueError:
+            continue
+        if os.path.exists(os.path.join(directory, name, "meta.json")):
+            entries.append((step, name))
+    if not entries:
+        return None
+    _, name = max(entries)
+    path = os.path.join(directory, name)
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"path": path, "error": f"{type(e).__name__}: {e}"}
+    meta["path"] = path
+    return meta
+
+
+def elastic_section(endpoints, snapshots, checkpoint_dirs):
+    """The supervisor's actions: eviction/reshape/recovery events in
+    timeline order, recovery counters per varz leg, and the latest
+    checkpoint provenance a resuming fleet would restore from."""
+    events = []
+    saves = []
+    for snap in snapshots:
+        ident = snap.get("identity") or {}
+        label = obs.process_label(ident) if ident else None
+        for ev in snap.get("events") or []:
+            name = ev.get("name")
+            if name in ELASTIC_EVENTS:
+                events.append({"name": name, "unix": ev.get("unix"),
+                               "fields": ev.get("fields") or {},
+                               "process": label})
+            elif name == "train.checkpoint_saved":
+                saves.append({"unix": ev.get("unix"),
+                              "fields": ev.get("fields") or {},
+                              "process": label})
+    events.sort(key=lambda e: e.get("unix") or 0.0)
+    saves.sort(key=lambda e: e.get("unix") or 0.0)
+    counters = {}
+    for base, legs in endpoints.items():
+        if not legs["varz"]["ok"]:
+            continue
+        for key, value in (legs["varz"]["payload"]
+                           .get("counters") or {}).items():
+            if key.startswith(RECOVERY_COUNTER):
+                counters.setdefault(base, {})[key] = value
+    return {
+        "events": events,
+        "evictions": sum(1 for e in events
+                         if e["name"] == "train.eviction"),
+        "reshapes": sum(1 for e in events
+                        if e["name"] == "train.reshape"),
+        "recovery_counters": counters,
+        "checkpoints": {d: _latest_checkpoint_meta(d)
+                        for d in checkpoint_dirs},
+        "last_save": saves[-1] if saves else None,
+        "saves_observed": len(saves),
+    }
+
+
 def profile_captures(snapshots):
     """Profiler artifacts recorded in any collected journal."""
     captures = []
@@ -196,7 +283,8 @@ def profile_captures(snapshots):
     return captures
 
 
-def collect(urls, journal_paths, dev_dir, state_dir):
+def collect(urls, journal_paths, dev_dir, state_dir,
+            checkpoint_dirs=()):
     endpoints = sweep_endpoints(urls)
     journals = load_journals(journal_paths)
 
@@ -240,6 +328,8 @@ def collect(urls, journal_paths, dev_dir, state_dir):
         "goodput": goodput,
         "memory": memory_section(endpoints, journals),
         "profiles": profile_captures(snapshots),
+        "elastic": elastic_section(endpoints, snapshots,
+                                   checkpoint_dirs),
         "provenance": stamp(
             devices=["host (diagnostics sweep; reads debug "
                      "endpoints and state files only)"]),
@@ -259,13 +349,19 @@ def main(argv=None):
                         "into the merged timeline")
     p.add_argument("--dev-dir", default="/dev")
     p.add_argument("--state-dir", default="/run/tpu")
+    p.add_argument("--checkpoint-dir", action="append", default=[],
+                   help="checkpoint directories whose newest "
+                        "finished checkpoint's provenance to record "
+                        "(where an elastic resume would restore "
+                        "from)")
     p.add_argument("--out", default="tpu_diagnose.json")
     args = p.parse_args(argv)
 
     urls = list(dict.fromkeys(
         ([] if args.no_default_urls else list(DEFAULT_URLS))
         + args.url))
-    bundle = collect(urls, args.journal, args.dev_dir, args.state_dir)
+    bundle = collect(urls, args.journal, args.dev_dir, args.state_dir,
+                     checkpoint_dirs=args.checkpoint_dir)
 
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
