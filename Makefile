@@ -67,6 +67,15 @@ goodput-check:
 chaos-check:
 	JAX_PLATFORMS=cpu python3 tools/chaos_check.py
 
+# Placement-subsystem guard: on the fake-chip backend, a mixed
+# allocate trace must show the PlacementScorer retaining at least as
+# much (and in total strictly more) largest-allocatable-box capacity
+# than first-fit, and a forced-fragmentation episode must yield
+# exactly one repartition proposal that is applied only once the node
+# is drained and restores full-box allocations. Pure CPU, seconds.
+placement-check:
+	python3 tools/placement_check.py
+
 # Continuous-batching regression guard: replay one Poisson arrival
 # trace through the slot engine (real decode, CPU fake backend) and
 # the pre-engine sequential-batch policy; fail unless engine goodput
@@ -100,4 +109,4 @@ clean:
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
 	trace-check diagnose-check goodput-check chaos-check \
-	occupancy-check container partition-tpu push clean
+	placement-check occupancy-check container partition-tpu push clean

@@ -47,6 +47,9 @@ from container_engine_accelerators_tpu.plugin.metrics import (
     DEFAULT_PORT,
     MetricServer,
 )
+from container_engine_accelerators_tpu.plugin import (
+    placement as placement_mod,
+)
 from container_engine_accelerators_tpu.utils import (
     get_logger,
     set_verbosity,
@@ -79,6 +82,11 @@ def parse_args(argv=None):
                    default=DEFAULT_INTERVAL_MS, metavar="MS")
     p.add_argument("--enable-health-monitoring", action="store_true",
                    help="poll chip health and gate allocations")
+    p.add_argument("--enable-placement-policy", action="store_true",
+                   help="run the repartitioning policy loop: watch "
+                        "fragmentation, propose a better subslice "
+                        "tiling, apply it when the node is drained "
+                        "(CEA_TPU_PLACEMENT_* envs tune it)")
     p.add_argument("--health-poll-interval", type=float, default=5.0,
                    metavar="SECONDS")
     p.add_argument("--tpu-worker-id", type=int,
@@ -156,6 +164,27 @@ def main(argv=None):
                                   poll_interval_s=args.health_poll_interval)
         health.start()
 
+    placement_loop = None
+    if args.enable_placement_policy:
+        if not obs.get_tracer().enabled:
+            # The policy still works (gauges publish and the demand
+            # fallback rides the manager's own counter), but the
+            # proposal/apply audit trail lives in the journal.
+            log.warning(
+                "placement policy enabled with CEA_TPU_TRACE=0: "
+                "repartition proposals will not be journaled (the "
+                "diagnose bundle's placement section will be empty); "
+                "set CEA_TPU_TRACE=1 for the audit trail")
+        policy = placement_mod.RepartitionPolicy(manager)
+        # Liveness comes from the kubelet pod-resources socket — the
+        # same source the metrics ticker attributes telemetry with;
+        # when it is unreachable the policy skips the pass (unknown
+        # liveness must never read as "drained").
+        placement_loop = placement_mod.PlacementLoop(
+            policy, placement_mod.live_devices_from_pod_resources)
+        placement_loop.start()
+        postmortem.register_state_provider("placement", policy.state)
+
     def shutdown(signum, frame):
         log.info("signal %d; shutting down", signum)
         manager.stop()
@@ -174,6 +203,8 @@ def main(argv=None):
     try:
         manager.serve(args.plugin_directory, cfg.KUBELET_SOCKET, "tpu")
     finally:
+        if placement_loop is not None:
+            placement_loop.stop()
         if health is not None:
             health.stop()
         if metrics is not None:

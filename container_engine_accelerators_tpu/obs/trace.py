@@ -396,6 +396,27 @@ class Tracer:
         with self._lock:
             return dict(self._gauges)
 
+    def drop_gauges(self, names, keep_labels=None):
+        """Drop label series of the named gauges.
+
+        The stale-label reset seam: series whose label values stop
+        being produced (a repartitioned node's old `shape=`, a
+        departed device) would otherwise be scraped forever at their
+        last value. With ``keep_labels`` (a labels dict), series
+        carrying ALL of those label pairs survive — so a reset can
+        shed stale series without blinking the live ones off the
+        scrape until their owner's next (possibly slower-cadence)
+        publish. Without it, every series of the named gauges drops
+        (the MetricServer reset-cycle shape, metrics.go:63,158-167).
+        """
+        names = set(names)
+        keep = set((keep_labels or {}).items())
+        with self._lock:
+            for key in [k for k in self._gauges
+                        if k[0] in names
+                        and not (keep and keep <= set(k[1]))]:
+                del self._gauges[key]
+
     def open_span_count(self):
         with self._lock:
             return len(self._open)

@@ -27,6 +27,7 @@ from .api import (
     V1ALPHA_VERSION,
     DevicePluginV1AlphaServicer,
     RegistrationV1AlphaStub,
+    abort_invalid_argument,
     v1alpha_pb2,
 )
 
@@ -71,9 +72,7 @@ class PluginServiceV1Alpha(DevicePluginV1AlphaServicer):
                     self._m.allocate_envs(list(request.devicesIDs)).items()):
                 resp.envs[key] = val
         except (KeyError, ValueError) as e:
-            msg = e.args[0] if e.args else str(e)
-            log.warning("Allocate (v1alpha) failed: %s", msg)
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(msg))
+            abort_invalid_argument(context, log, e, "Allocate (v1alpha)")
         for mount in self._m.mounts():
             resp.mounts.append(v1alpha_pb2.Mount(
                 container_path=mount.container_path,

@@ -26,6 +26,7 @@ from . import podresources_v1alpha1_pb2 as podresources_pb2
 from . import tpu_runtime_metrics_pb2 as runtime_metrics_pb2
 from .grpc_bindings import (
     RuntimeMetricServiceServicer,
+    abort_invalid_argument,
     add_runtime_metric_service,
     V1BETA1_VERSION,
     V1ALPHA_VERSION,
@@ -52,6 +53,7 @@ __all__ = [
     "podresources_pb2",
     "runtime_metrics_pb2",
     "RuntimeMetricServiceServicer",
+    "abort_invalid_argument",
     "add_runtime_metric_service",
     "V1BETA1_VERSION",
     "V1ALPHA_VERSION",

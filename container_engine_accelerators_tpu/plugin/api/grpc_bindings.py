@@ -285,3 +285,19 @@ class PodResourcesListerStub:
             request_serializer=pr.ListPodResourcesRequest.SerializeToString,
             response_deserializer=pr.ListPodResourcesResponse.FromString,
         )
+
+
+def abort_invalid_argument(context, logger, exc, rpc_name):
+    """The ONE manager-error -> gRPC-status mapping for the plugin
+    services.
+
+    The manager's allocation/preference contract is KeyError (unknown
+    device) / ValueError (unhealthy device, unsatisfiable request) —
+    both are caller mistakes, INVALID_ARGUMENT. v1alpha and v1beta1
+    each used to inline this mapping; sharing it keeps the two
+    surfaces from drifting (the stress suite treats any UNKNOWN-coded
+    internal exception as a bug).
+    """
+    msg = exc.args[0] if exc.args else str(exc)
+    logger.warning("%s failed: %s", rpc_name, msg)
+    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(msg))

@@ -28,6 +28,7 @@ from .api import (
     V1BETA1_VERSION,
     DevicePluginV1Beta1Servicer,
     RegistrationV1Beta1Stub,
+    abort_invalid_argument,
     v1beta1_pb2,
 )
 
@@ -83,20 +84,30 @@ class PluginServiceV1Beta1(DevicePluginV1Beta1Servicer):
                         self._m.allocate_envs(list(creq.devicesIDs)).items()):
                     cresp.envs[key] = val
             except (KeyError, ValueError) as e:
-                msg = e.args[0] if e.args else str(e)
-                log.warning("Allocate failed: %s", msg)
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(msg))
+                abort_invalid_argument(context, log, e, "Allocate")
             cresp.mounts.extend(self._m.mounts())
             resp.container_responses.append(cresp)
         return resp
 
     def GetPreferredAllocation(self, request, context):
+        """Scored preference (manager.preferred_allocation).
+
+        An unsatisfiable request — allocation_size above the
+        available count, must-include outside the available set —
+        aborts INVALID_ARGUMENT instead of silently truncating: the
+        kubelet treats a short answer as a valid preference, which
+        would strand the pod with fewer devices than requested.
+        """
         resp = v1beta1_pb2.PreferredAllocationResponse()
         for creq in request.container_requests:
-            chosen = self._m.preferred_allocation(
-                list(creq.available_deviceIDs),
-                list(creq.must_include_deviceIDs),
-                creq.allocation_size)
+            try:
+                chosen = self._m.preferred_allocation(
+                    list(creq.available_deviceIDs),
+                    list(creq.must_include_deviceIDs),
+                    creq.allocation_size)
+            except (KeyError, ValueError) as e:
+                abort_invalid_argument(context, log, e,
+                                       "GetPreferredAllocation")
             resp.container_responses.append(
                 v1beta1_pb2.ContainerPreferredAllocationResponse(
                     deviceIDs=chosen))

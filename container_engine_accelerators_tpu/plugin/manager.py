@@ -26,8 +26,8 @@ TPU-specific departures:
   - MIG partitions become ICI subslices (slice.py).
 """
 
+import json
 import os
-import re
 import threading
 import time
 from concurrent import futures
@@ -36,9 +36,11 @@ import grpc
 
 from .. import obs
 from ..chip import ChipBackendError, get_backend
+from ..chip.backend import parse_shape
 from ..obs.grpc_interceptor import TracingServerInterceptor
 from ..utils import accel_index, get_logger, is_accel_name
 from . import config as cfg
+from . import placement
 from .api import (
     HEALTHY,
     add_device_plugin_v1alpha,
@@ -46,6 +48,7 @@ from .api import (
     v1beta1_pb2,
 )
 from .envs import topology_envs
+from .placement import natural_key
 from .slice import SliceManager, is_slice_device_id
 
 log = get_logger("manager")
@@ -76,6 +79,32 @@ class TpuManager:
                           process_bounds=process_bounds)
         self._process_bounds = process_bounds
         self._backend = backend or get_backend()
+        self._placement = placement.PlacementScorer()
+        # preferred_allocation -> Allocate score handoff: the kubelet
+        # calls the two RPCs seconds apart with the same device set,
+        # and the allocate.decision journal event should carry the
+        # score the preference was chosen at (bounded; see
+        # _remember_score).
+        self._scores = {}
+        # Tracer-independent demand record: {chips requested: count}.
+        # The repartition policy's primary demand input is the
+        # allocate.decision journal, but CEA_TPU_TRACE=0 empties the
+        # journal — this counter keeps the policy from going silently
+        # inert on the bare path (at most a handful of distinct chip
+        # counts per node, so unbounded is fine).
+        self._demand_hist = {}
+        # Allocate-vs-repartition serialization: repartition swaps
+        # every advertised device id, so it must not interleave with
+        # an Allocate, and the policy's drained-liveness snapshot
+        # must be provably newer than the last allocation (the epoch
+        # check in repartition closes the snapshot->apply race).
+        self._alloc_gate = threading.Lock()
+        self._alloc_epoch = 0
+        # The operator-configured partition size, before any applied
+        # re-tiling mutated the working config: a stored re-tiling is
+        # resumed at restart only while this still matches what it
+        # was computed against (an operator reconfigure wins).
+        self._configured_partition = self._config.tpu_partition_size
         self._devices = {}          # device id -> health string
         self._lock = threading.Lock()
         self._changed = threading.Condition(self._lock)
@@ -108,10 +137,54 @@ class TpuManager:
         n = self._backend.init(self._dev_dir, self._state_dir)
         self._known_chips = set(self._chip_indices())
         if self._config.tpu_partition_size:
-            self._slice_mgr.start(self._config.tpu_partition_size)
+            applied = self._stored_partition()
+            if applied and applied != self._config.tpu_partition_size:
+                # A previous process applied a policy re-tiling; the
+                # config file still says the old size (it is usually a
+                # read-only hostPath). Resume the applied tiling so a
+                # plugin restart doesn't silently revert it — unless
+                # the topology stopped tiling into it.
+                try:
+                    self._slice_mgr.start(applied)
+                    self._config.tpu_partition_size = applied
+                    log.info("resumed applied re-tiling %r "
+                             "(configured %r)", applied,
+                             self._configured_partition)
+                except ChipBackendError as e:
+                    log.warning("stored re-tiling %r no longer tiles "
+                                "(%s); using the configured size",
+                                applied, e)
+                    self._slice_mgr.start(
+                        self._config.tpu_partition_size)
+            else:
+                self._slice_mgr.start(self._config.tpu_partition_size)
         self._refresh_devices()
         log.info("started with %d chips, partition=%r", n,
                  self._config.tpu_partition_size)
+
+    def _partition_file(self):
+        return os.path.join(self._state_dir, "applied_partition.json")
+
+    def _stored_partition(self):
+        """Partition size a previous process's policy re-tiling
+        applied, or None. Honored only while the operator-configured
+        size still matches the one the re-tiling superseded — a
+        config change is an explicit operator decision and wins."""
+        try:
+            with open(self._partition_file()) as f:
+                stored = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(stored, dict):
+            return None
+        if stored.get("configured") != self._configured_partition:
+            log.info("ignoring stored re-tiling %r: configured size "
+                     "changed %r -> %r", stored.get("applied"),
+                     stored.get("configured"),
+                     self._configured_partition)
+            return None
+        applied = stored.get("applied")
+        return applied if isinstance(applied, str) and applied else None
 
     def _refresh_devices(self):
         """Rebuild the device map from backend state, keeping health."""
@@ -275,29 +348,62 @@ class TpuManager:
         across hosts (the XLA-over-ICI/DCN counterpart of the
         reference leaving NCCL to the workload, SURVEY.md s2.4).
         """
-        chips = sorted({c for d in device_ids for c in self.device_chips(d)})
-        # The allocation decision as a journal event: which devices
-        # resolved to which chips — the record placement work (ICI
-        # subslice allocator, ROADMAP) will mine for decisions made
-        # under each topology state.
-        obs.event("allocate.decision", devices=sorted(device_ids),
-                  chips=chips)
-        try:
-            coords = [self._backend.chip_coords(c) for c in chips]
-        except ChipBackendError as e:
-            # Hot-unplug race: the device passed the health gate but
-            # its chip left the backend before the coord read. The
-            # Allocate error contract is KeyError/ValueError (mapped
-            # to INVALID_ARGUMENT); a raw backend error would surface
-            # as gRPC UNKNOWN — the internal-exception shape the
-            # stress suite treats as a bug. The kubelet re-gates via
-            # the ListAndWatch update the same rescan publishes.
-            raise KeyError(
-                f"invalid allocation request: chip vanished during "
-                f"allocation ({e})") from e
+        # Under the alloc gate: a concurrent repartition either sees
+        # this allocation's epoch bump (and refuses) or finishes its
+        # swap first (and this request fails the device lookup ->
+        # INVALID_ARGUMENT, the safe answer — the kubelet re-syncs
+        # the new id set from ListAndWatch).
+        with self._alloc_gate:
+            self._alloc_epoch += 1
+            chips = sorted({c for d in device_ids
+                            for c in self.device_chips(d)})
+            # The allocation decision as a journal event: which
+            # devices resolved to which chips, stamped with the
+            # placement score the preference was chosen at (when this
+            # set went through GetPreferredAllocation) — the
+            # repartition policy loop replays these for its demand
+            # histogram, and tpu_diagnose surfaces the scores in its
+            # placement section.
+            score = self._recall_score(device_ids)
+            self._demand_hist[len(chips)] = (
+                self._demand_hist.get(len(chips), 0) + 1)
+            fields = {"devices": sorted(device_ids), "chips": chips}
+            if score is not None:
+                fields["score"] = score
+            obs.event("allocate.decision", **fields)
+            try:
+                coords = [self._backend.chip_coords(c) for c in chips]
+            except ChipBackendError as e:
+                # Hot-unplug race: the device passed the health gate
+                # but its chip left the backend before the coord
+                # read. The Allocate error contract is
+                # KeyError/ValueError (mapped to INVALID_ARGUMENT); a
+                # raw backend error would surface as gRPC UNKNOWN —
+                # the internal-exception shape the stress suite
+                # treats as a bug. The kubelet re-gates via the
+                # ListAndWatch update the same rescan publishes.
+                raise KeyError(
+                    f"invalid allocation request: chip vanished "
+                    f"during allocation ({e})") from e
         return topology_envs(chips, coords, worker_id=self._worker_id,
                              worker_hostnames=self._worker_hostnames,
                              process_bounds=self._process_bounds)
+
+    def demand_histogram(self):
+        """{chips requested: count} across this process's Allocates —
+        the journal-free demand view the repartition policy falls
+        back to when CEA_TPU_TRACE=0 leaves it no events to replay."""
+        with self._alloc_gate:
+            return dict(self._demand_hist)
+
+    def allocation_epoch(self):
+        """Monotonic count of allocations handed out. The repartition
+        policy records it BEFORE reading liveness; repartition refuses
+        when it moved — an Allocate that landed after the drained
+        snapshot would otherwise have its chips re-tiled out from
+        under it."""
+        with self._alloc_gate:
+            return self._alloc_epoch
 
     def mounts(self):
         return [
@@ -310,34 +416,100 @@ class TpuManager:
         """must_include + first available fillers (NATURAL id order:
         accel2 before accel10 — a lexicographic sort would scatter
         the fallback across the torus on 10+-chip hosts), the
-        advisory fallback when topology can't be consulted."""
-        def natural(d):
-            return [int(t) if t.isdigit() else t
-                    for t in re.split(r"(\d+)", d)]
-
+        advisory fallback when topology can't be consulted. Assumes
+        the caller already ran _validated_preference."""
         chosen = list(must_include)
-        for d in sorted(available, key=natural):
+        for d in sorted(available, key=natural_key):
             if len(chosen) >= size:
                 break
             if d not in chosen:
                 chosen.append(d)
         return chosen[:size]
 
+    @staticmethod
+    def _validated_preference(available, must_include, size):
+        """The ONE must-include/size check for every preference path.
+
+        The first-N fallback and the subslice gang path used to each
+        re-derive this, which is how the alpha/beta services could
+        drift apart; now both call here once. Raises ValueError —
+        mapped to INVALID_ARGUMENT at the gRPC surface — instead of
+        silently truncating an unsatisfiable request (the kubelet
+        treats a short answer as a valid preference and allocates
+        it, which strands the pod with fewer devices than it asked
+        for).
+        """
+        available = list(dict.fromkeys(available))
+        must = list(dict.fromkeys(must_include))
+        if size > len(available):
+            raise ValueError(
+                f"invalid preferred-allocation request: "
+                f"allocation_size {size} exceeds {len(available)} "
+                f"available devices")
+        avail_set = set(available)
+        missing = sorted(d for d in must if d not in avail_set)
+        if missing:
+            raise ValueError(
+                f"invalid preferred-allocation request: must-include "
+                f"devices not in the available set: {missing}")
+        if len(must) > size:
+            raise ValueError(
+                f"invalid preferred-allocation request: {len(must)} "
+                f"must-include devices exceed allocation_size {size}")
+        return available, must
+
+    def _scored_choice(self, candidates, free_coords, dims, chip_total,
+                       size, workload, demand, **extra_fields):
+        """The ONE scored-decision tail for both preference paths:
+        choose, stash the score for the Allocate handoff, journal one
+        placement.decision schema. The flat and gang paths used to
+        each inline this, which is how their journal shapes (what the
+        repartition policy and tpu_diagnose replay) could drift."""
+        chosen, score = self._placement.choose(
+            candidates, free_coords, dims, chip_total, demand=demand)
+        self._remember_score(chosen, score)
+        obs.event(placement.DECISION_EVENT, devices=list(chosen),
+                  score=round(score, 4), size=size,
+                  candidates=len(candidates), workload=workload,
+                  effective_chips=self._placement.profiles
+                  .effective_chips(workload, chip_total),
+                  **extra_fields)
+        return chosen
+
+    def _remember_score(self, device_ids, score):
+        """Stash a preference's score for the Allocate that follows
+        (bounded: the kubelet allocates or forgets within seconds)."""
+        with self._lock:
+            self._scores[frozenset(device_ids)] = score
+            while len(self._scores) > 32:
+                self._scores.pop(next(iter(self._scores)))
+
+    def _recall_score(self, device_ids):
+        with self._lock:
+            return self._scores.pop(frozenset(device_ids), None)
+
     def preferred_allocation(self, available, must_include, size):
-        """Topology-compact preferred set.
+        """Profile-and-topology-scored preferred set.
 
         Real implementation of the RPC the reference stubs out
-        (beta_plugin.go:95-98): prefer a chip set forming a contiguous
-        box on the ICI torus (minimal-hop collectives), falling back
-        to first-N when no box fits the availability.
+        (beta_plugin.go:95-98). Candidate chip sets are contiguous
+        boxes on the ICI torus; the PlacementScorer ranks them by
+        compactness + fragmentation cost + profile fit
+        (placement.py), with the natural-order first-N as the
+        deterministic fallback when topology can't be consulted or no
+        box fits the availability. With the scorer disabled
+        (CEA_TPU_PLACEMENT=0) the choice degrades to the pre-scorer
+        first-fit: the first full box of the most cube-like shape.
 
         Cost: box shapes are the divisor triples of `size` (not all
-        dims^3 shapes) and each candidate box is checked with O(size)
-        membership lookups, so a 256-chip slice costs thousands of set
-        probes, not millions of per-chip scans.
+        dims^3 shapes), each candidate box is checked with O(size)
+        membership lookups, and the scorer sees at most
+        placement.MAX_CANDIDATES boxes.
         """
-        if size <= 0 or size > len(available):
-            return list(available)[:max(size, 0)]
+        if size <= 0:
+            return []
+        available, must_include = self._validated_preference(
+            available, must_include, size)
         try:
             if self._config.tpu_partition_size:
                 return self._preferred_slices(available, must_include,
@@ -360,48 +532,219 @@ class TpuManager:
             log.warning("preferred_allocation: backend unavailable "
                         "(%s); falling back to first-N", e)
             return self._first_n(available, must_include, size)
-        best = None
-        for bx, by, bz in _box_shapes(size, dims):
-            # Prefer the most cube-like box; skip shapes that cannot
-            # beat the current best.
-            score = max(bx, by, bz) - min(bx, by, bz)
-            if best is not None and score >= best[0]:
-                continue
-            box = _find_full_box((bx, by, bz), dims, chip_at, must_chips)
-            if box is not None:
-                best = (score, box)
-        if best is not None:
-            return sorted(avail_chips[c] for c in best[1])
-        # No box fits the availability: same advisory fallback as the
-        # backend-unavailable path (one implementation, natural chip
-        # order).
-        return self._first_n(
-            available, [avail_chips[c] for c in sorted(must_chips)],
-            size)
+        coord_of = {c: xyz for xyz, c in chip_at.items()}
+        candidates = []
+        for shape in sorted(_box_shapes(size, dims),
+                            key=lambda s: (max(s) - min(s), s)):
+            for box in _full_boxes(shape, dims, chip_at, must_chips):
+                candidates.append(
+                    ([avail_chips[c] for c in box],
+                     [coord_of[c] for c in box]))
+                if len(candidates) >= placement.MAX_CANDIDATES:
+                    break
+            if len(candidates) >= placement.MAX_CANDIDATES:
+                break
+        if not self._placement.enabled:
+            # Pre-scorer first-fit: candidates arrive most-cube-like
+            # shape first, origin-scan order within a shape.
+            if candidates:
+                return sorted(candidates[0][0], key=natural_key)
+            return self._first_n(available, must_include, size)
+        workload = placement.pending_workload_hint()
+        demand = self._placement.profiles.demand(workload)
+        if (demand is not None and demand < placement.LIGHT_DEMAND
+                and not must_chips):
+            # MISO-style light-workload candidate: a measured-light
+            # job also considers the scattered first-N set, which may
+            # preserve the big box a heavy job will want (the frag
+            # term decides; a box still wins when it costs nothing).
+            scatter = self._first_n(available, [], size)
+            candidates.append(
+                (scatter,
+                 [coord_of[self.device_chips(d)[0]] for d in scatter]))
+        if not candidates:
+            return self._first_n(available, must_include, size)
+        # Un-partitioned devices are one chip each: size IS the chip
+        # total.
+        return self._scored_choice(candidates, list(chip_at), dims,
+                                   size, size, workload, demand)
 
     def _preferred_slices(self, available, must_include, size):
-        """Preferred set of subslice devices: greedy, ICI-adjacent.
+        """Gang allocation across subslices (Flex-MIG style).
 
-        Each subslice is already a topology-compact unit; when a pod
-        asks for several, prefer slices whose chip sets pack into the
-        smallest union bounding box (adjacent tiles share ICI links,
-        so inter-slice traffic stays short-hop) instead of first-N.
+        One job may span several subslices; candidate gangs are sets
+        of `size` available slices whose chip union forms one
+        contiguous ICI box (so the Allocate env contract hands the
+        container a coherent multi-slice topology), ranked by the
+        PlacementScorer. When no box gang exists — odd sizes, holes
+        in the availability — fall back to the greedy smallest-
+        union-bounding-box packing (adjacent tiles share ICI links,
+        so inter-slice traffic stays short-hop), which also serves
+        as the deterministic scorer-off behavior.
         """
+        table = self._slice_mgr.table()   # ONE table generation
         coords_of = {}
         for d in available:
-            chips = self._slice_mgr.slice_chips(d) or []
+            chips = table.get(d) or []
             coords_of[d] = [self._backend.chip_coords(c) for c in chips]
+        if self._placement.enabled:
+            candidates = self._gang_candidates(available, must_include,
+                                               size, coords_of)
+            if candidates:
+                dims = self._backend.topology()
+                free_coords = [xyz for d in available
+                               for xyz in coords_of[d]]
+                workload = placement.pending_workload_hint()
+                demand = self._placement.profiles.demand(workload)
+                total = sum(len(coords_of[d]) for d in candidates[0][2])
+                scored = [(ids, coords) for ids, coords, _ in candidates]
+                return self._scored_choice(
+                    scored, free_coords, dims, max(total, 1), size,
+                    workload, demand, gang=size > 1)
         chosen = list(must_include)
         while len(chosen) < size:
             pool = [d for d in available if d not in chosen]
             if not pool:
                 break
             picked = min(pool, key=lambda d: (
-                _union_box_volume([xyz for s in chosen + [d]
-                                   for xyz in coords_of.get(s, [])]),
-                d))
+                placement.bounding_volume(
+                    [xyz for s in chosen + [d]
+                     for xyz in coords_of.get(s, [])]),
+                natural_key(d)))
             chosen.append(picked)
         return chosen[:size]
+
+    def _gang_candidates(self, available, must_include, size,
+                         coords_of):
+        """Box-union gangs: [(ids, coords, id_set), ...].
+
+        A gang qualifies when a (shape, origin) box of exactly
+        size * tile_volume cells is fully covered by available
+        slices AND touches exactly `size` of them — uniform tiles
+        mean that second test is equivalent to "every touched slice
+        lies fully inside the box", so the union IS the box.
+        """
+        vols = {len(coords_of[d]) for d in available if coords_of[d]}
+        if len(vols) != 1:
+            return []   # stale/mixed table mid-repartition
+        total = size * vols.pop()
+        dims = self._backend.topology()
+        owner = {}
+        for d in available:
+            for xyz in coords_of[d]:
+                owner[xyz] = d
+        must = set(must_include)
+        # O(1) box-fullness over the availability; only boxes that
+        # pass pay the O(volume) owner walk below.
+        grid = placement.CoordGrid(list(owner), dims)
+        candidates, seen = [], set()
+        for shape in sorted(_box_shapes(total, dims),
+                            key=lambda s: (max(s) - min(s), s)):
+            bx, by, bz = shape
+            for ox in range(dims[0] - bx + 1):
+                for oy in range(dims[1] - by + 1):
+                    for oz in range(dims[2] - bz + 1):
+                        if not grid.box_full((ox, oy, oz), shape):
+                            continue
+                        ids = {owner[(x, y, z)]
+                               for x in range(ox, ox + bx)
+                               for y in range(oy, oy + by)
+                               for z in range(oz, oz + bz)}
+                        if len(ids) != size or not must <= ids:
+                            continue
+                        key = frozenset(ids)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        ordered = sorted(ids, key=natural_key)
+                        candidates.append(
+                            (ordered,
+                             [xyz for d in ordered
+                              for xyz in coords_of[d]], ids))
+                        if len(candidates) >= placement.MAX_CANDIDATES:
+                            return candidates
+        return candidates
+
+    # -- placement policy surface -------------------------------------
+
+    def placement_scorer(self):
+        """The manager's PlacementScorer (profile feed + test seam)."""
+        return self._placement
+
+    def placement_profiles(self):
+        """ProfileStore the metrics ticker folds telemetry into."""
+        return self._placement.profiles
+
+    def chip_coords(self, chip):
+        """(x, y, z) of a chip — policy-loop seam (backend-private
+        otherwise)."""
+        return self._backend.chip_coords(chip)
+
+    def topology_dims(self):
+        return self._backend.topology()
+
+    def partition_shape(self):
+        """Current subslice tiling shape, or "" when un-partitioned."""
+        return self._slice_mgr.shape if self._config.tpu_partition_size \
+            else ""
+
+    def repartition(self, partition_size, expected_epoch=None):
+        """Re-tile the node's subslices to a new shape.
+
+        The drain gate lives in the CALLER (RepartitionPolicy
+        .maybe_apply): re-tiling swaps every advertised device id, so
+        doing it under a live container would orphan its chips.
+        `expected_epoch` closes the snapshot->apply race: the caller
+        passes allocation_epoch() as read BEFORE its liveness
+        snapshot, and an Allocate that landed since raises
+        DrainRaceError (under the same gate Allocate holds, so no
+        allocation can interleave with the swap either). Here:
+        validate the shape, rebuild the slice table, persist the
+        applied size to the state dir (the config file is usually a
+        read-only hostPath; a restart resumes the applied tiling via
+        _stored_partition), and wake ListAndWatch so the kubelet
+        re-syncs the new id set.
+        """
+        if not self._config.tpu_partition_size:
+            raise ValueError("repartition: node is not partitioned")
+        parse_shape(partition_size)   # BadShapeError before any swap
+        with self._alloc_gate:
+            if (expected_epoch is not None
+                    and self._alloc_epoch != expected_epoch):
+                raise placement.DrainRaceError(
+                    f"allocation landed after the drained-liveness "
+                    f"snapshot (epoch {expected_epoch} -> "
+                    f"{self._alloc_epoch}); not re-tiling")
+            old = self._slice_mgr.shape
+            self._slice_mgr.start(partition_size)
+            self._config.tpu_partition_size = partition_size
+            self._persist_partition(partition_size)
+        self._refresh_devices()
+        obs.event(placement.APPLIED_EVENT, old_shape=old,
+                  new_shape=partition_size,
+                  subslices=len(self._slice_mgr.list_devices()))
+        log.info("repartitioned %s -> %s", old, partition_size)
+        return partition_size
+
+    def _persist_partition(self, partition_size):
+        """Record the applied re-tiling (best-effort: a read-only
+        state dir costs restart persistence, never the re-tile).
+        flush+fsync before the atomic rename — the checkpoint layer's
+        discipline — so a power cut after the re-tile cannot leave an
+        empty file that silently reverts the tiling at restart."""
+        try:
+            tmp = self._partition_file() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"applied": partition_size,
+                           "configured": self._configured_partition},
+                          f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._partition_file())
+        except OSError as e:
+            log.warning("could not persist applied partition %r "
+                        "(%s); a plugin restart will revert to the "
+                        "configured size", partition_size, e)
 
     # -- serve loop ---------------------------------------------------
 
@@ -525,12 +868,13 @@ def _box_shapes(size, dims):
     return shapes
 
 
-def _find_full_box(shape, dims, chip_at, must_chips):
-    """First fully-available `shape` box containing `must_chips`.
+def _full_boxes(shape, dims, chip_at, must_chips):
+    """Yield every fully-available `shape` box containing `must_chips`
+    (deterministic origin-scan order — first yield is the pre-scorer
+    first-fit choice).
 
     chip_at maps (x, y, z) -> chip index for available chips only; a
-    box qualifies when every cell is available. Returns the chip set
-    or None.
+    box qualifies when every cell is available. Yields chip lists.
     """
     bx, by, bz = shape
     for ox in range(dims[0] - bx + 1):
@@ -542,16 +886,8 @@ def _find_full_box(shape, dims, chip_at, must_chips):
                          for z in range(oz, oz + bz)]
                 if not all(cell in chip_at for cell in cells):
                     continue
-                box = {chip_at[cell] for cell in cells}
-                if must_chips <= box:
-                    return box
-    return None
+                box = [chip_at[cell] for cell in cells]
+                if must_chips <= set(box):
+                    yield box
 
 
-def _union_box_volume(coords):
-    """Volume of the bounding box of a coordinate set (0 when empty)."""
-    if not coords:
-        return 0
-    spans = [max(c[i] for c in coords) - min(c[i] for c in coords) + 1
-             for i in range(3)]
-    return spans[0] * spans[1] * spans[2]

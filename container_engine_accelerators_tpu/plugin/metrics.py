@@ -40,6 +40,7 @@ from prometheus_client.core import CollectorRegistry
 from .. import obs
 from ..utils import get_logger
 from . import config as cfg
+from . import placement
 from .devices import get_devices_for_all_containers
 
 log = get_logger("metrics")
@@ -229,6 +230,7 @@ class MetricServer:
         for cd in containers:
             self._request.labels(cd.namespace, cd.pod, cd.container).set(
                 len(cd.device_ids))
+            duties, hbm_fracs = [], []
             for dev_id in cd.device_ids:
                 try:
                     chips = self._m.device_chips(dev_id)
@@ -237,7 +239,27 @@ class MetricServer:
                                 dev_id)
                     continue
                 for chip in chips:
-                    self._sample_chip(cd, f"accel{chip}", chip)
+                    duty, hbm = self._sample_chip(cd, f"accel{chip}",
+                                                  chip)
+                    if duty is not None:
+                        duties.append(duty)
+                    if hbm is not None and hbm[0] > 0:
+                        hbm_fracs.append(hbm[1] / hbm[0])
+            self._observe_profile(cd, duties, hbm_fracs)
+
+    def _observe_profile(self, cd, duties, hbm_fracs):
+        """Fold this pass's samples into the workload's placement
+        profile (the MISO side: measured duty cycle and HBM watermark
+        become the demand the PlacementScorer sizes future requests
+        by). Keyed namespace/container — the identity the
+        pod-resources API attributes the telemetry to."""
+        if not duties and not hbm_fracs:
+            return
+        profiles = self._m.placement_profiles()
+        profiles.observe(
+            f"{cd.namespace}/{cd.container}",
+            mfu=(sum(duties) / len(duties) / 100.0) if duties else None,
+            hbm_frac=max(hbm_fracs) if hbm_fracs else None)
 
     def _sample_chip(self, cd, device_label, chip):
         base = (cd.namespace, cd.pod, cd.container, device_label)
@@ -249,14 +271,27 @@ class MetricServer:
         if hbm is not None:
             self._memory_total.labels(*base).set(hbm[0])
             self._memory_used.labels(*base).set(hbm[1])
+        return duty, hbm
 
     def _reset(self):
-        """Drop stale label sets (metrics.go:63,158-167)."""
+        """Drop stale label sets (metrics.go:63,158-167).
+
+        The placement gauges ride the same cycle with one refinement:
+        only series under a STALE `shape=` label drop (a repartition
+        changed the tiling; the old shape's series must stop being
+        scraped at its last value). The current shape's series
+        survive the reset — the policy loop re-publishes on its own
+        cadence (default 60s, same order as the reset interval), and
+        dropping the live series too would blink them off the scrape
+        once a minute."""
         self._duty_cycle.clear()
         self._memory_total.clear()
         self._memory_used.clear()
         self._request.clear()
         self._health.clear()
+        obs.get_tracer().drop_gauges(
+            placement.PLACEMENT_GAUGES,
+            keep_labels={"shape": self._m.partition_shape() or "none"})
 
     def _run(self):
         since_reset = 0.0

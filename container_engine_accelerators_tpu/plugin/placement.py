@@ -1,0 +1,845 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Profile-driven subslice placement: scorer, profiles, repartitioning.
+
+The static first-fit placement the reference stack (and our own
+pre-placement manager) ships wastes capacity on mixed workloads —
+MISO (arXiv:2207.11428) recovers most of it by sizing GPU instances
+to *measured* demand instead of the request's worst case, and
+ParvaGPU (arXiv:2409.14447) shows the win compounds when placement
+and repartitioning are co-designed. This module is the TPU analogue,
+in three parts:
+
+  PlacementScorer   ranks candidate chip sets for
+                    ``GetPreferredAllocation`` by a composite of
+                    ICI-box compactness, fragmentation cost (how much
+                    the choice shrinks the largest remaining
+                    allocatable box), and profile fit (demand-weighted
+                    blend of the two, so a workload measured at 15%
+                    duty doesn't get handed the pristine box a
+                    training job will want). Deterministic: ties
+                    break on the natural-sorted device-id tuple, so
+                    the same request always gets the same answer.
+
+  ProfileStore      the MISO side: per-workload demand learned from
+                    the telemetry the plugin already collects —
+                    per-container duty cycle and HBM watermarks from
+                    the metrics ticker (keyed ``namespace/container``,
+                    the workload annotation proxy the pod-resources
+                    API exposes), seeded/overridden by an operator
+                    JSON file (``CEA_TPU_PLACEMENT_PROFILES``).
+
+  RepartitionPolicy the policy loop: replays ``allocate.decision``
+                    journal events plus current slice health into a
+                    fragmentation score, publishes the
+                    ``tpu_plugin_fragmentation`` and
+                    ``tpu_plugin_placement_score`` gauges, emits
+                    exactly ONE ``placement.repartition_proposed``
+                    event per episode (hysteresis, the
+                    straggler/memory-pressure discipline), and — only
+                    when the node is drained of live allocations —
+                    applies the proposed re-tiling through
+                    ``TpuManager.repartition``.
+
+Everything here is backend-agnostic (coordinates in, scores out) and
+jax-free: the plugin process stays importable without jax.
+
+Environment knobs (all optional; see docs/operations.md):
+
+  CEA_TPU_PLACEMENT=0                   disable the scorer (first-fit
+                                        fallback everywhere)
+  CEA_TPU_PLACEMENT_W_COMPACT=1.0       compactness weight
+  CEA_TPU_PLACEMENT_W_FRAG=1.0          fragmentation-cost weight
+  CEA_TPU_PLACEMENT_W_PROFILE=1.0       profile-fit weight
+  CEA_TPU_PLACEMENT_PROFILES=path       operator-seeded profile JSON
+  CEA_TPU_PLACEMENT_HINT_FILE=path      pending-workload hint file
+  CEA_TPU_PLACEMENT_FRAG_THRESHOLD=0.5  fragmentation that opens an
+                                        episode
+  CEA_TPU_PLACEMENT_EVAL_S=60           policy-loop cadence
+"""
+
+import collections
+import json
+import math
+import os
+import re
+import threading
+
+from ..utils import env_number, get_logger
+from .api import HEALTHY
+
+log = get_logger("placement")
+
+FRAGMENTATION_GAUGE = "tpu_plugin_fragmentation"
+PLACEMENT_SCORE_GAUGE = "tpu_plugin_placement_score"
+PLACEMENT_GAUGES = (FRAGMENTATION_GAUGE, PLACEMENT_SCORE_GAUGE)
+
+DECISION_EVENT = "placement.decision"
+ALLOCATE_DECISION_EVENT = "allocate.decision"
+PROPOSED_EVENT = "placement.repartition_proposed"
+APPLIED_EVENT = "placement.repartition_applied"
+RECOVERED_EVENT = "placement.fragmentation_recovered"
+
+ENABLE_ENV = "CEA_TPU_PLACEMENT"
+W_COMPACT_ENV = "CEA_TPU_PLACEMENT_W_COMPACT"
+W_FRAG_ENV = "CEA_TPU_PLACEMENT_W_FRAG"
+W_PROFILE_ENV = "CEA_TPU_PLACEMENT_W_PROFILE"
+PROFILE_FILE_ENV = "CEA_TPU_PLACEMENT_PROFILES"
+HINT_FILE_ENV = "CEA_TPU_PLACEMENT_HINT_FILE"
+FRAG_THRESHOLD_ENV = "CEA_TPU_PLACEMENT_FRAG_THRESHOLD"
+EVAL_INTERVAL_ENV = "CEA_TPU_PLACEMENT_EVAL_S"
+
+DEFAULT_FRAG_THRESHOLD = 0.5
+# Hysteresis: fragmentation must fall this far back under the
+# threshold before another episode can open (the straggler/
+# memory-pressure re-arm discipline).
+FRAG_RECOVERY_MARGIN = 0.1
+DEFAULT_EVAL_INTERVAL_S = 60.0
+# EWMA weight of a fresh telemetry sample against the stored profile.
+PROFILE_ALPHA = 0.3
+# Below this measured demand a workload is "light": the scorer also
+# considers a scattered (non-box) candidate chosen to preserve the
+# largest remaining box, MISO-style.
+LIGHT_DEMAND = 0.5
+# Fragmentation scoring walks every (shape, origin) box of the free
+# set per candidate; past this chip count the O(n^2)-ish sweep stops
+# paying for itself on the RPC path, so the frag term degrades to 0
+# (compactness still ranks) — logged once, never silent.
+FRAG_CHIP_CAP = 128
+# Candidate-set ceiling per preference request: boxes are enumerated
+# most-cube-like shape first, so the cap sheds the least compact
+# shapes — it bounds RPC latency, never correctness (the fallback
+# paths stay reachable).
+MAX_CANDIDATES = 64
+
+_NAT_SPLIT = re.compile(r"(\d+)")
+
+
+class DrainRaceError(RuntimeError):
+    """An allocation landed between the drained-liveness snapshot and
+    the re-tile. The proposal is still valid — the caller retries at
+    the next pass with a fresh snapshot."""
+
+
+def natural_key(device_id):
+    """Natural-order sort key: accel2 before accel10, tpu-2x2-2
+    before tpu-2x2-10. The ONE id-ordering authority for placement
+    fallbacks and tie-breaks (manager._first_n shares it)."""
+    return [int(t) if t.isdigit() else t
+            for t in _NAT_SPLIT.split(device_id)]
+
+
+def bounding_volume(coords):
+    """Volume of the bounding box of a coordinate set (0 when empty)."""
+    if not coords:
+        return 0
+    spans = [max(c[i] for c in coords) - min(c[i] for c in coords) + 1
+             for i in range(3)]
+    return spans[0] * spans[1] * spans[2]
+
+
+def _box_intersects(coords, origin, shape):
+    """Whether any coordinate falls inside the box at ``origin`` of
+    ``shape``."""
+    ox, oy, oz = origin
+    bx, by, bz = shape
+    return any(ox <= x < ox + bx and oy <= y < oy + by
+               and oz <= z < oz + bz for x, y, z in coords)
+
+
+class CoordGrid:
+    """O(1) box-fullness queries over a set of torus coordinates.
+
+    A 3-D summed-volume table over ``dims``: ``box_full`` answers
+    "is every cell of this box present?" with eight lookups, and
+    ``largest_box_volume`` sweeps all (shape, origin) pairs with that
+    O(1) check — the workhorse behind both the fragmentation term and
+    the policy loop's fragmentation score.
+    """
+
+    def __init__(self, coords, dims):
+        dx = max(int(dims[0]), 1)
+        dy = max(int(dims[1]), 1)
+        dz = max(int(dims[2]), 1)
+        self.dims = (dx, dy, dz)
+        cells = {c for c in coords
+                 if 0 <= c[0] < dx and 0 <= c[1] < dy and 0 <= c[2] < dz}
+        self.cells = frozenset(cells)
+        self.count = len(cells)
+        self._largest = None   # memo: the grid is immutable
+        p = [[[0] * (dz + 1) for _ in range(dy + 1)]
+             for _ in range(dx + 1)]
+        for x in range(dx):
+            px, pxn = p[x], p[x + 1]
+            for y in range(dy):
+                row = pxn[y + 1]
+                for z in range(dz):
+                    row[z + 1] = (
+                        ((x, y, z) in cells)
+                        + px[y + 1][z + 1] + pxn[y][z + 1] + row[z]
+                        - px[y][z + 1] - px[y + 1][z] - pxn[y][z]
+                        + px[y][z])
+        self._p = p
+
+    def box_count(self, origin, shape):
+        """Cells present inside the box at ``origin`` of ``shape``."""
+        x0, y0, z0 = origin
+        x1, y1, z1 = x0 + shape[0], y0 + shape[1], z0 + shape[2]
+        p = self._p
+        return (p[x1][y1][z1] - p[x0][y1][z1] - p[x1][y0][z1]
+                - p[x1][y1][z0] + p[x0][y0][z1] + p[x0][y1][z0]
+                + p[x1][y0][z0] - p[x0][y0][z0])
+
+    def box_full(self, origin, shape):
+        return self.box_count(origin, shape) == (
+            shape[0] * shape[1] * shape[2])
+
+    def largest_box(self):
+        """(volume, origin, shape) of one largest full axis-aligned
+        box inside the set ((0, None, None) when empty).
+
+        Memoized: the scorer asks once per candidate against the same
+        pre-choice grid (up to MAX_CANDIDATES times per RPC). The
+        witness origin/shape lets the scorer skip recomputation for
+        candidates disjoint from the box (removing cells outside a
+        maximal box cannot shrink it)."""
+        if not self.count:
+            return 0, None, None
+        if self._largest is not None:
+            return self._largest
+        dx, dy, dz = self.dims
+        best = (0, None, None)
+        for bx in range(dx, 0, -1):
+            for by in range(dy, 0, -1):
+                for bz in range(dz, 0, -1):
+                    vol = bx * by * bz
+                    if vol <= best[0] or vol > self.count:
+                        continue
+                    for ox in range(dx - bx + 1):
+                        for oy in range(dy - by + 1):
+                            for oz in range(dz - bz + 1):
+                                if self.box_full((ox, oy, oz),
+                                                 (bx, by, bz)):
+                                    best = (vol, (ox, oy, oz),
+                                            (bx, by, bz))
+                                    break
+                            if best[0] == vol:
+                                break
+                        if best[0] == vol:
+                            break
+        self._largest = best
+        return best
+
+    def largest_box_volume(self):
+        return self.largest_box()[0]
+
+
+def largest_box_volume(coords, dims):
+    return CoordGrid(coords, dims).largest_box_volume()
+
+
+# -- profiles ---------------------------------------------------------
+
+
+class ProfileStore:
+    """Per-workload measured demand (the MISO learning side).
+
+    A profile is an EWMA over observed utilization: ``mfu`` (duty
+    cycle / model-FLOPs fraction, 0..1) and ``hbm_frac`` (HBM
+    watermark over capacity, 0..1). ``demand()`` is the max of the
+    two — the binding resource decides how much hardware the workload
+    actually uses. Keys are ``namespace/container`` (what the
+    pod-resources API attributes telemetry to) or any operator-chosen
+    annotation value; an operator JSON file seeds/overrides entries:
+
+        {"default/trainer": {"mfu": 0.9, "hbm_frac": 0.7},
+         "default/embedder": {"mfu": 0.12}}
+
+    Thread-safe; the metrics ticker writes while the RPC path reads.
+    """
+
+    def __init__(self, path=None, alpha=PROFILE_ALPHA):
+        self._alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._profiles = {}   # key -> {"mfu": x, "hbm_frac": y, "samples": n}
+        path = path if path is not None else os.environ.get(
+            PROFILE_FILE_ENV, "")
+        if path:
+            self.load(path)
+
+    def load(self, path):
+        """Seed from an operator JSON file; malformed files warn and
+        load nothing (a bad mount must not kill the plugin)."""
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError) as e:
+            log.warning("placement profiles %s unreadable (%s); "
+                        "starting empty", path, e)
+            return 0
+        loaded = 0
+        if isinstance(raw, dict):
+            for key, row in raw.items():
+                if not isinstance(row, dict):
+                    continue
+                self.observe(key, mfu=row.get("mfu"),
+                             hbm_frac=row.get("hbm_frac"),
+                             weight=1.0)
+                loaded += 1
+        log.info("loaded %d placement profiles from %s", loaded, path)
+        return loaded
+
+    @staticmethod
+    def _clamp(value):
+        return max(0.0, min(1.0, float(value)))
+
+    def observe(self, workload, mfu=None, hbm_frac=None, weight=None):
+        """Fold one telemetry sample into ``workload``'s profile."""
+        if not workload or (mfu is None and hbm_frac is None):
+            return
+        alpha = self._alpha if weight is None else float(weight)
+        with self._lock:
+            prof = self._profiles.setdefault(
+                str(workload), {"mfu": None, "hbm_frac": None,
+                                "samples": 0})
+            for field, value in (("mfu", mfu), ("hbm_frac", hbm_frac)):
+                if value is None:
+                    continue
+                value = self._clamp(value)
+                old = prof[field]
+                prof[field] = (value if old is None
+                               else (1 - alpha) * old + alpha * value)
+            prof["samples"] += 1
+
+    def demand(self, workload):
+        """Measured demand fraction for ``workload`` (0..1), or None
+        when the workload has no profile — the caller's signal to use
+        the deterministic first-fit-equivalent scoring."""
+        if not workload:
+            return None
+        with self._lock:
+            prof = self._profiles.get(str(workload))
+            if prof is None:
+                return None
+            parts = [v for v in (prof["mfu"], prof["hbm_frac"])
+                     if v is not None]
+        return max(parts) if parts else None
+
+    def effective_chips(self, workload, requested):
+        """MISO-style advisory sizing: the chips the measured demand
+        would actually need (ceil(requested * demand), >= 1). Purely
+        informational — the kubelet owns the request size — but
+        journaled on every decision so operators can see the gap."""
+        d = self.demand(workload)
+        if d is None:
+            return None
+        return max(1, math.ceil(int(requested) * d))
+
+    def state(self):
+        """JSON-safe snapshot (diagnose bundle / postmortem)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._profiles.items()}
+
+    def __len__(self):
+        with self._lock:
+            return len(self._profiles)
+
+
+def pending_workload_hint(path=None):
+    """The requesting workload's key, when the scheduler side supplies
+    one. ``GetPreferredAllocation`` carries no pod identity, so the
+    hint rides a hostPath file (``CEA_TPU_PLACEMENT_HINT_FILE``) that
+    an admission webhook / scheduler plugin writes before binding.
+    Best-effort: missing/unreadable file means no profile fit — the
+    documented first-fit-equivalent degraded mode, never an error."""
+    path = path if path is not None else os.environ.get(
+        HINT_FILE_ENV, "")
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            key = f.read().strip()
+    except OSError:
+        return None
+    return key or None
+
+
+# -- scorer -----------------------------------------------------------
+
+
+class PlacementScorer:
+    """Composite candidate ranking: compactness + fragmentation cost
+    + profile fit. Lower scores win; ties break on the natural-sorted
+    device-id tuple so the answer is stable across runs.
+
+    Terms, each >= 0:
+      compact  bounding_volume(candidate)/size - 1 (0 = a full box)
+      frag     (largest free box before - after) / size — how much of
+               the node's best remaining box this choice eats,
+               normalized by the request so weights compose
+      profile  demand-weighted blend d*compact + (1-d)*frag: heavy
+               workloads (d->1) pay double for sprawl, light ones
+               (d->0) pay double for eating the big box
+    """
+
+    def __init__(self, profiles=None, w_compact=None, w_frag=None,
+                 w_profile=None, enabled=None):
+        self.profiles = profiles if profiles is not None else ProfileStore()
+        self.w_compact = (env_number(W_COMPACT_ENV, 1.0)
+                          if w_compact is None else float(w_compact))
+        self.w_frag = (env_number(W_FRAG_ENV, 1.0)
+                       if w_frag is None else float(w_frag))
+        self.w_profile = (env_number(W_PROFILE_ENV, 1.0)
+                          if w_profile is None else float(w_profile))
+        if enabled is None:
+            enabled = os.environ.get(ENABLE_ENV, "1") != "0"
+        self.enabled = bool(enabled)
+        self._frag_cap_logged = False
+
+    def score(self, cand_coords, free_grid, dims, size, demand=None):
+        """Score one candidate against the pre-choice free set.
+
+        ``free_grid`` is the CoordGrid of ALL currently-free chips
+        (candidate included); build it once per request and score
+        every candidate against it.
+        """
+        size = max(int(size), 1)
+        compact = bounding_volume(cand_coords) / size - 1.0
+        frag = 0.0
+        if free_grid.count <= FRAG_CHIP_CAP:
+            before, w_origin, w_shape = free_grid.largest_box()
+            if w_origin is not None and not _box_intersects(
+                    cand_coords, w_origin, w_shape):
+                # The candidate never touches the witness largest box,
+                # so that box survives the removal intact: the largest
+                # free box cannot shrink — frag is exactly 0, no
+                # rebuild needed (most scattered/far candidates take
+                # this path).
+                frag = 0.0
+            else:
+                # Free set minus the candidate: rebuild is O(n) and
+                # the candidate list is capped, so this stays cheap at
+                # node scale (the cap above keeps 256-chip hosts off
+                # the quadratic cliff).
+                remaining = CoordGrid(
+                    self._free_minus(free_grid, cand_coords), dims)
+                frag = max(
+                    0, before - remaining.largest_box_volume()) / size
+        elif not self._frag_cap_logged:
+            self._frag_cap_logged = True
+            log.warning(
+                "placement: %d free chips exceeds the fragmentation-"
+                "scoring cap (%d); ranking on compactness only",
+                free_grid.count, FRAG_CHIP_CAP)
+        total = self.w_compact * compact + self.w_frag * frag
+        if demand is not None:
+            d = max(0.0, min(1.0, float(demand)))
+            total += self.w_profile * (d * compact + (1.0 - d) * frag)
+        return total
+
+    @staticmethod
+    def _free_minus(free_grid, cand_coords):
+        cand = set(cand_coords)
+        return [c for c in free_grid.cells if c not in cand]
+
+    def choose(self, candidates, free_coords, dims, size, demand=None):
+        """Best candidate from ``[(device_ids, coords), ...]``; returns
+        (device_ids, score) or (None, None) when empty. Deterministic:
+        equal scores resolve to the natural-least id tuple."""
+        if not candidates:
+            return None, None
+        free_grid = CoordGrid(free_coords, dims)
+        best = None
+        for ids, coords in candidates:
+            ids = tuple(sorted(ids, key=natural_key))
+            s = self.score(coords, free_grid, dims, size, demand=demand)
+            key = (round(s, 9), tuple(natural_key(i) for i in ids))
+            if best is None or key < best[0]:
+                best = (key, ids, s)
+        return list(best[1]), best[2]
+
+
+# -- repartitioning policy --------------------------------------------
+
+
+def _tiling_shapes(size, dims):
+    """Divisor triples of ``size`` that uniformly tile ``dims``,
+    most-cube-like first (deterministic)."""
+    shapes = []
+    for bx in range(1, size + 1):
+        if size % bx:
+            continue
+        rest = size // bx
+        for by in range(1, rest + 1):
+            if rest % by:
+                continue
+            bz = rest // by
+            if (dims[0] % bx == 0 and dims[1] % by == 0
+                    and dims[2] % bz == 0
+                    and bx <= dims[0] and by <= dims[1]
+                    and bz <= dims[2]):
+                shapes.append((bx, by, bz))
+    shapes.sort(key=lambda s: (max(s) - min(s), s))
+    return shapes
+
+
+def format_shape(shape):
+    """Canonical slice-shape string; trailing z=1 dropped ("2x2", not
+    "2x2x1") to match the operator-facing tpuPartitionSize grammar."""
+    bx, by, bz = shape
+    return f"{bx}x{by}" + (f"x{bz}" if bz > 1 else "")
+
+
+class RepartitionPolicy:
+    """Fragmentation watcher + drain-gated re-tiler.
+
+    ``evaluate(live_device_ids)`` computes the node's fragmentation —
+    1 - largest_free_box / free_chips over healthy, unallocated chips
+    (0 = the free capacity is one clean box, -> 1 as it shatters) —
+    publishes the gauges, and runs the episode state machine. A live
+    view the caller cannot supply (pod-resources unreachable) skips
+    the pass entirely: unknown liveness must never read as "drained".
+
+    ``maybe_apply(live_device_ids)`` applies the pending proposal
+    through ``TpuManager.repartition`` — only with zero live
+    allocations, the invariant the whole loop is built around
+    (re-tiling swaps every advertised device id; doing it under a
+    live container would orphan its chips).
+    """
+
+    def __init__(self, manager, threshold=None, recovery_margin=None,
+                 tracer=None, decision_window=20):
+        from .. import obs
+        self._m = manager
+        self._obs = obs
+        self._tracer = tracer or obs.get_tracer()
+        self.threshold = (env_number(FRAG_THRESHOLD_ENV,
+                                     DEFAULT_FRAG_THRESHOLD)
+                          if threshold is None else float(threshold))
+        self.recovery_margin = (FRAG_RECOVERY_MARGIN
+                                if recovery_margin is None
+                                else float(recovery_margin))
+        self._decision_window = int(decision_window)
+        self._lock = threading.Lock()
+        self._episode = False
+        self._pending = None       # proposed partition-size string
+        self._proposals = 0        # lifetime count (test seam)
+        self._last = None          # last evaluate() result dict
+
+    # -- inputs -------------------------------------------------------
+
+    def _journal_events(self, events):
+        if events is not None:
+            return events
+        return self._tracer.snapshot().get("events", [])
+
+    @staticmethod
+    def demand_histogram(events):
+        """{chips_requested: count} replayed from allocate.decision
+        journal events — the demand mix the node actually served."""
+        hist = collections.Counter()
+        for ev in events:
+            if ev.get("name") != ALLOCATE_DECISION_EVENT:
+                continue
+            fields = ev.get("fields") or {}
+            chips = fields.get("chips")
+            if isinstance(chips, (list, tuple)) and chips:
+                hist[len(chips)] += 1
+        return dict(hist)
+
+    def _recent_scores(self, events):
+        """Last-N preference scores. An allocated preference journals
+        its score twice (placement.decision, then the forwarded copy
+        on allocate.decision) — counting both would double-weight
+        allocated decisions in the gauge, so only placement.decision
+        feeds it, with the allocate copies as the fallback when the
+        ring has already dropped the older preference events."""
+        def collect(name):
+            rows = [(ev.get("unix", 0.0),
+                     (ev.get("fields") or {}).get("score"))
+                    for ev in events
+                    if ev.get("name") == name
+                    and isinstance((ev.get("fields") or {}).get("score"),
+                                   (int, float))]
+            rows.sort(key=lambda t: t[0])
+            return [s for _, s in rows[-self._decision_window:]]
+
+        return collect(DECISION_EVENT) or collect(ALLOCATE_DECISION_EVENT)
+
+    # -- the loop body ------------------------------------------------
+
+    def evaluate(self, live_device_ids=None, events=None):
+        """One policy pass. Returns the evaluation dict, or None when
+        liveness is unknown (no gauges move, nothing fires)."""
+        if live_device_ids is None:
+            log.debug("placement evaluate skipped: liveness unknown")
+            return None
+        live = set(live_device_ids)
+        devices = self._m.list_devices()
+        free_coords = []
+        for dev_id, health in devices.items():
+            if health != HEALTHY or dev_id in live:
+                continue
+            try:
+                chips = self._m.device_chips(dev_id)
+                free_coords.extend(self._m.chip_coords(c)
+                                   for c in chips)
+            except Exception:
+                # Re-partition / hot-unplug race mid-pass: skip the
+                # vanished device, keep the sweep alive.
+                continue
+        dims = self._m.topology_dims()
+        free_count = len(free_coords)
+        if free_count:
+            largest = largest_box_volume(free_coords, dims)
+            frag = 1.0 - largest / free_count
+        else:
+            largest, frag = 0, 0.0
+        events = self._journal_events(events)
+        scores = self._recent_scores(events)
+        shape = self._m.partition_shape() or "none"
+        self._tracer.gauge(FRAGMENTATION_GAUGE, round(frag, 4),
+                           shape=shape)
+        if scores:
+            self._tracer.gauge(PLACEMENT_SCORE_GAUGE,
+                               round(sum(scores) / len(scores), 4),
+                               shape=shape)
+
+        fire = None
+        with self._lock:
+            if not self._episode and frag >= self.threshold:
+                proposal = self.propose(events)
+                if proposal is not None:
+                    self._episode = True
+                    self._pending = proposal
+                    self._proposals += 1
+                    fire = (PROPOSED_EVENT, proposal)
+                else:
+                    log.info("fragmentation %.2f over threshold but no "
+                             "viable re-tiling proposal", frag)
+            elif self._episode and frag <= max(
+                    0.0, self.threshold - self.recovery_margin):
+                self._episode = False
+                # The pending proposal survives recovery: a drain
+                # naturally drops fragmentation to 0 moments before
+                # maybe_apply gets its chance, and the tiling-vs-
+                # demand mismatch the proposal fixes is still there.
+                fire = (RECOVERED_EVENT, self._pending)
+            result = {
+                "fragmentation": round(frag, 4),
+                "free_chips": free_count,
+                "largest_free_box": largest,
+                "live_devices": sorted(live),
+                "episode": self._episode,
+                "pending_proposal": self._pending,
+                "shape": shape,
+            }
+            self._last = result
+        if fire is not None:
+            name, proposal = fire
+            self._obs.event(
+                name, fragmentation=round(frag, 4),
+                free_chips=free_count, largest_free_box=largest,
+                current_shape=shape, proposal=proposal,
+                demand_histogram=self.demand_histogram(events))
+        return result
+
+    def propose(self, events=None):
+        """Partition size fitting the observed demand mix, or None.
+
+        The dominant requested chip count from the allocate journal,
+        shaped as the most cube-like tile of the current topology
+        (compact tiles minimize intra-slice ICI hops). No journal
+        demand, an un-partitioned node, or a proposal equal to the
+        current tiling all yield None.
+        """
+        current = self._m.partition_shape()
+        if not current:
+            return None
+        hist = self.demand_histogram(self._journal_events(events))
+        if not hist:
+            # CEA_TPU_TRACE=0 records no allocate.decision events —
+            # fall back to the manager's tracer-independent counter
+            # so the policy isn't silently inert on the bare path
+            # (the PR-5 efficiency-ledger discipline).
+            fallback = getattr(self._m, "demand_histogram", None)
+            hist = fallback() if fallback is not None else {}
+        if not hist:
+            return None
+        # Most frequent request size; ties to the smaller size (the
+        # finer tiling also serves the bigger request as a gang).
+        dominant = min(hist, key=lambda c: (-hist[c], c))
+        dims = self._m.topology_dims()
+        shapes = _tiling_shapes(dominant, dims)
+        if not shapes:
+            return None
+        proposal = format_shape(shapes[0])
+        from ..chip.backend import parse_shape
+        if parse_shape(proposal) == parse_shape(current):
+            return None
+        return proposal
+
+    def maybe_apply(self, live_device_ids=None, epoch=None):
+        """Apply the pending proposal iff the node is drained.
+
+        Returns the applied shape string, or None. The drain gate is
+        absolute: ``live_device_ids`` must be an EMPTY, KNOWN set —
+        None (liveness unknown) never applies. ``epoch`` is the
+        manager's allocation_epoch() as read BEFORE the liveness
+        snapshot: the manager's repartition gate (held jointly with
+        Allocate) refuses with DrainRaceError when any Allocate
+        landed after that read, so a pod admitted between the
+        drained-liveness snapshot and the re-tile can never have its
+        chips swapped out from under it. An Allocate completing just
+        BEFORE the epoch read is covered by kubelet ordering: the
+        device manager records the assignment in its podDevices view
+        (what the pod-resources API serves) before issuing the
+        plugin's Allocate RPC, so a completed Allocate is always
+        visible to the liveness read that follows the epoch read.
+        The proposal survives a deferral for the next pass.
+        """
+        if live_device_ids is None or set(live_device_ids):
+            return None
+        with self._lock:
+            pending = self._pending
+        if pending is None:
+            return None
+        try:
+            self._m.repartition(pending, expected_epoch=epoch)
+        except DrainRaceError as e:
+            # The drained snapshot went stale mid-pass; nothing is
+            # wrong with the proposal — retry at the next pass.
+            log.info("repartition deferred: %s", e)
+            return None
+        except Exception as e:
+            # The topology stopped tiling into the proposal (hot-plug
+            # since it was computed). Drop it AND close the episode:
+            # a still-fragmented node must be able to re-propose
+            # against the new topology at the next pass (an open
+            # episode with no pending proposal would wedge the loop).
+            log.warning("repartition to %r failed (%s); dropping the "
+                        "proposal", pending, e)
+            with self._lock:
+                self._pending = None
+                self._episode = False
+            return None
+        with self._lock:
+            self._pending = None
+            self._episode = False
+        return pending
+
+    def manager_epoch(self):
+        """The manager's allocation epoch (read this BEFORE the
+        liveness snapshot that feeds maybe_apply)."""
+        return self._m.allocation_epoch()
+
+    # -- introspection ------------------------------------------------
+
+    def pending_proposal(self):
+        with self._lock:
+            return self._pending
+
+    def proposal_count(self):
+        """Lifetime placement.repartition_proposed count (test seam)."""
+        with self._lock:
+            return self._proposals
+
+    def state(self):
+        """JSON-safe snapshot (diagnose bundle / postmortem)."""
+        with self._lock:
+            return {
+                "threshold": self.threshold,
+                "recovery_margin": self.recovery_margin,
+                "episode": self._episode,
+                "pending_proposal": self._pending,
+                "proposals": self._proposals,
+                "last": self._last,
+            }
+
+
+class PlacementLoop:
+    """Background policy-loop driver (the health-checker shape).
+
+    ``live_devices_fn`` returns the set of device ids currently held
+    by containers, or None when liveness cannot be determined (the
+    pod-resources socket is down) — the policy then skips the pass.
+    """
+
+    def __init__(self, policy, live_devices_fn, interval_s=None):
+        self._policy = policy
+        self._live_fn = live_devices_fn
+        self._interval = (env_number(EVAL_INTERVAL_ENV,
+                                     DEFAULT_EVAL_INTERVAL_S)
+                          if interval_s is None else float(interval_s))
+        self._stop = threading.Event()
+        self._thread = None
+
+    def loop_once(self):
+        """One evaluate + maybe_apply pass; the test seam.
+
+        Epoch before liveness: any Allocate that lands after the
+        liveness read moves the epoch, and repartition refuses —
+        the snapshot->apply TOCTOU closed at the manager gate.
+        """
+        epoch = self._policy.manager_epoch()
+        live = self._live_fn()
+        self._policy.evaluate(live)
+        return self._policy.maybe_apply(live, epoch=epoch)
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-placement-policy", daemon=True)
+        self._thread.start()
+        log.info("placement policy loop started (interval %.1fs, "
+                 "threshold %.2f)", self._interval,
+                 self._policy.threshold)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 2)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                applied = self.loop_once()
+                if applied:
+                    log.info("repartition applied: %s", applied)
+            except Exception:
+                # One bad pass (backend hiccup mid-sweep) must not
+                # kill the policy thread for the process lifetime.
+                log.exception("placement policy pass failed; will retry")
+
+
+def live_devices_from_pod_resources(socket_path=None,
+                                    resource_name=None):
+    """Device ids currently attributed to containers, or None when
+    the kubelet pod-resources endpoint is unreachable (liveness
+    UNKNOWN — the policy must not treat that as drained)."""
+    import grpc
+
+    from . import config as cfg
+    from .devices import get_devices_for_all_containers
+
+    try:
+        containers = get_devices_for_all_containers(
+            socket_path or cfg.POD_RESOURCES_SOCKET,
+            resource_name or cfg.RESOURCE_NAME)
+    except grpc.RpcError as e:
+        log.debug("pod-resources liveness query failed: %s", e)
+        return None
+    return {dev_id for cd in containers for dev_id in cd.device_ids}

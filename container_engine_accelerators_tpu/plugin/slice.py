@@ -153,6 +153,17 @@ class SliceManager:
             chips = self._slices.get(device_id)
             return list(chips) if chips is not None else None
 
+    def table(self):
+        """One consistent {device id -> [chip indices]} snapshot.
+
+        The gang-placement and repartition paths need the whole
+        slice->chip view at once; per-id slice_chips() calls could
+        interleave with a re-tiling and mix two generations of the
+        table."""
+        with self._lock:
+            return {dev_id: list(chips)
+                    for dev_id, chips in self._slices.items()}
+
     def owning_slice(self, chip):
         """Device ID of the subslice containing a chip, or None."""
         with self._lock:

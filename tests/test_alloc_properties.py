@@ -29,8 +29,10 @@ invariants that kubelet correctness depends on:
 """
 
 import numpy as np
+import pytest
 
 from container_engine_accelerators_tpu.chip import PyChipBackend
+from container_engine_accelerators_tpu.plugin import config as cfg
 from container_engine_accelerators_tpu.plugin.envs import (
     chips_form_box,
     topology_envs,
@@ -40,7 +42,7 @@ from container_engine_accelerators_tpu.plugin.manager import TpuManager
 TOPOLOGIES = ["2x2", "2x4", "4x4", "2x2x2", "4x4x2"]
 
 
-def _node(fake_node, topo):
+def _node(fake_node, topo, partition=""):
     dims = [int(d) for d in topo.split("x")]
     while len(dims) < 3:
         dims.append(1)
@@ -50,7 +52,9 @@ def _node(fake_node, topo):
     fake_node.set_topology(topo)
     mgr = TpuManager(dev_dir=fake_node.dev_dir,
                      state_dir=fake_node.state_dir,
-                     backend=PyChipBackend())
+                     backend=PyChipBackend(),
+                     tpu_config=cfg.TpuConfig(
+                         tpu_partition_size=partition))
     mgr.start()
     return mgr, n
 
@@ -126,6 +130,88 @@ def test_subslice_solver_invariants(fake_node):
             assert _bounding_volume(coords) == vol, (shape, i, chips)
             seen.extend(chips)
         assert sorted(seen) == list(range(n)), shape  # exact partition
+
+
+@pytest.mark.parametrize("partition", ["1x2", "2x2"])
+def test_gang_allocation_invariants(fake_node, partition):
+    """The Flex-MIG gang path: every returned gang is chip-disjoint,
+    drawn from `available`, honors `must_include`, and is exactly
+    `size` slices; ties and scoring are deterministic (same request
+    -> same answer, across fresh managers)."""
+    rng = np.random.default_rng(7)
+    mgr, n = _node(fake_node, "4x4", partition=partition)
+    all_slices = sorted(mgr.list_devices())
+    for _ in range(60):
+        n_avail = int(rng.integers(1, len(all_slices) + 1))
+        available = sorted(rng.choice(
+            all_slices, size=n_avail, replace=False).tolist())
+        size = int(rng.integers(1, n_avail + 1))
+        n_must = int(rng.integers(0, size + 1))
+        must = sorted(rng.choice(
+            available, size=n_must, replace=False).tolist())
+        gang = mgr.preferred_allocation(available, must, size)
+        assert len(gang) == size, (available, must, size, gang)
+        assert len(set(gang)) == size
+        assert set(gang) <= set(available)
+        assert set(must) <= set(gang)
+        chips = [c for d in gang for c in mgr.device_chips(d)]
+        assert len(chips) == len(set(chips)), "gang not chip-disjoint"
+        # Determinism: the same request must produce the same gang.
+        assert mgr.preferred_allocation(available, must, size) == gang
+
+
+@pytest.mark.parametrize("partition,size", [
+    ("1x2", 2), ("1x2", 4), ("2x2", 2), ("2x2", 4), ("4x1", 2)])
+def test_gang_union_is_contiguous_box(fake_node, partition, size):
+    """With the whole node free and a gang size whose chip total has
+    an aligned tiling, the gang's chip union must form one contiguous
+    ICI box — the coherent-topology-env guarantee of gang
+    allocation."""
+    mgr, n = _node(fake_node, "4x4", partition=partition)
+    backend = mgr._backend
+    all_slices = sorted(mgr.list_devices())
+    gang = mgr.preferred_allocation(all_slices, [], size)
+    chips = sorted(c for d in gang for c in mgr.device_chips(d))
+    coords = [backend.chip_coords(c) for c in chips]
+    assert _bounding_volume(coords) == len(chips), (gang, coords)
+    assert chips_form_box(coords)
+    # must_include steering keeps the box property.
+    pinned = all_slices[-1]
+    gang2 = mgr.preferred_allocation(all_slices, [pinned], size)
+    assert pinned in gang2
+    coords2 = [backend.chip_coords(c) for d in gang2
+               for c in mgr.device_chips(d)]
+    assert _bounding_volume(coords2) == len(coords2), (gang2, coords2)
+
+
+def test_gang_determinism_across_fresh_managers(fake_node):
+    """Scorer ties break on the natural-sorted id tuple, so a fresh
+    manager over the same node state answers identically (stable
+    across runs — the kubelet may ask any plugin restart)."""
+    mgr1, _ = _node(fake_node, "4x4", partition="2x2")
+    available = sorted(mgr1.list_devices())
+    first = [mgr1.preferred_allocation(available, [], s)
+             for s in (1, 2, 3, 4)]
+    mgr2 = TpuManager(dev_dir=fake_node.dev_dir,
+                      state_dir=fake_node.state_dir,
+                      backend=PyChipBackend(),
+                      tpu_config=cfg.TpuConfig(
+                          tpu_partition_size="2x2"))
+    mgr2.start()
+    second = [mgr2.preferred_allocation(available, [], s)
+              for s in (1, 2, 3, 4)]
+    assert first == second
+
+
+def test_preferred_allocation_oversize_is_value_error(fake_node):
+    """allocation_size above the available count must raise (mapped
+    to INVALID_ARGUMENT at the gRPC surface), never silently
+    truncate."""
+    mgr, n = _node(fake_node, "2x2")
+    with pytest.raises(ValueError, match="exceeds"):
+        mgr.preferred_allocation(["accel0", "accel1"], [], 3)
+    with pytest.raises(ValueError, match="must-include"):
+        mgr.preferred_allocation(["accel0"], ["accel2"], 1)
 
 
 def test_topology_envs_invariants(fake_node):

@@ -155,6 +155,9 @@ def test_collect_and_scrape(node2):
 
 
 def test_reset_drops_stale_labels(node2):
+    from container_engine_accelerators_tpu import obs
+    from container_engine_accelerators_tpu.plugin import placement
+
     backend = PyChipBackend()
     mgr = TpuManager(dev_dir=node2.dev_dir, state_dir=node2.state_dir,
                      backend=backend)
@@ -166,13 +169,57 @@ def test_reset_drops_stale_labels(node2):
     server.start()
     try:
         server.collect_once()
+        # The placement gauges ride the same reset cycle — a series
+        # under a stale shape label (what a repartition leaves
+        # behind) drops; the current shape's series ("none" on this
+        # un-partitioned node) survives so the scrape never blinks
+        # between policy passes.
+        obs.gauge(placement.FRAGMENTATION_GAUGE, 0.5, shape="4x1")
+        obs.gauge(placement.FRAGMENTATION_GAUGE, 0.0, shape="none")
+        obs.gauge(placement.PLACEMENT_SCORE_GAUGE, 1.25, shape="4x1")
         body = urllib.request.urlopen(
             f"http://localhost:{server.port}/metrics").read().decode()
         assert 'pod="train-0"' in body
+        assert 'tpu_plugin_fragmentation{shape="4x1"} 0.5' in body
         server._reset()
         body = urllib.request.urlopen(
             f"http://localhost:{server.port}/metrics").read().decode()
         assert 'pod="train-0"' not in body
+        assert 'shape="4x1"' not in body
+        assert 'tpu_plugin_fragmentation{shape="none"} 0.0' in body
+    finally:
+        server.stop()
+        stub.stop()
+
+
+def test_collect_feeds_placement_profiles(node2):
+    """The metrics ticker is the MISO learning loop: per-container
+    duty/HBM samples land in the manager's ProfileStore keyed
+    namespace/container."""
+    backend = PyChipBackend()
+    mgr = TpuManager(dev_dir=node2.dev_dir, state_dir=node2.state_dir,
+                     backend=backend)
+    mgr.start()
+    node2.set_state(0, "hbm", "1000 400")
+    node2.set_state(1, "hbm", "1000 800")
+    node2.set_state(0, "duty_cycle", "0 0")
+    node2.set_state(1, "duty_cycle", "0 0")
+    sock = os.path.join(short_tmpdir(), "podres.sock")
+    stub = PodResourcesStub(sock, payload_two_pods())
+    stub.start()
+    server = MetricServer(mgr, backend, port=0,
+                          pod_resources_socket=sock)
+    try:
+        server.collect_once()
+        node2.set_state(0, "duty_cycle", "600000 1000000")
+        node2.set_state(1, "duty_cycle", "300000 1000000")
+        server.collect_once()
+        demand = mgr.placement_profiles().demand("default/jax")
+        # HBM watermark is the binding resource: max(400/1000,
+        # 800/1000) = 0.8 beats the mean duty cycle.
+        assert demand == pytest.approx(0.8)
+        state = mgr.placement_profiles().state()["default/jax"]
+        assert 0.0 < state["mfu"] <= 0.6
     finally:
         server.stop()
         stub.stop()

@@ -1,0 +1,437 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Placement subsystem tests: profile store, scorer, repartition
+policy, and the gRPC INVALID_ARGUMENT contract.
+
+The scorer/policy math is checked against hand-computed values on
+small tori (the formulas in placement.py are simple enough to verify
+by hand); the episode state machine is driven through forced
+fragmentation exactly as tools/placement_check.py drives it, but at
+the unit seam.
+"""
+
+import json
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu import obs
+from container_engine_accelerators_tpu.chip import PyChipBackend
+from container_engine_accelerators_tpu.plugin import api
+from container_engine_accelerators_tpu.plugin import config as cfg
+from container_engine_accelerators_tpu.plugin import placement
+from container_engine_accelerators_tpu.plugin.manager import TpuManager
+from tests.plugin_helpers import ServingManager, short_tmpdir
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    obs.TRACER.reset()
+    yield
+    obs.TRACER.reset()
+
+
+def make_manager(fake_node, topo="4x4", partition=""):
+    dims = [int(d) for d in topo.split("x")]
+    while len(dims) < 3:
+        dims.append(1)
+    n = dims[0] * dims[1] * dims[2]
+    for i in range(n):
+        fake_node.add_chip(i)
+    fake_node.set_topology(topo)
+    mgr = TpuManager(
+        dev_dir=fake_node.dev_dir, state_dir=fake_node.state_dir,
+        backend=PyChipBackend(),
+        tpu_config=cfg.TpuConfig(tpu_partition_size=partition))
+    mgr.start()
+    return mgr
+
+
+# -- profile store ----------------------------------------------------
+
+
+def test_profile_store_ewma_and_demand():
+    store = placement.ProfileStore(path="", alpha=0.5)
+    assert store.demand("default/train") is None
+    store.observe("default/train", mfu=0.8, hbm_frac=0.4)
+    assert store.demand("default/train") == pytest.approx(0.8)
+    store.observe("default/train", mfu=0.4)
+    # EWMA: 0.5*0.8 + 0.5*0.4 = 0.6; hbm stays 0.4 -> max is mfu.
+    assert store.demand("default/train") == pytest.approx(0.6)
+    # Values clamp into [0, 1] (a junk telemetry sample must not
+    # poison the profile).
+    store.observe("default/clamp", mfu=7.0, hbm_frac=-3.0)
+    assert store.demand("default/clamp") == pytest.approx(1.0)
+
+
+def test_profile_store_effective_chips_advisory():
+    store = placement.ProfileStore(path="")
+    store.observe("default/embedder", mfu=0.2, weight=1.0)
+    # MISO sizing: ceil(8 * 0.2) = 2, floor of 1.
+    assert store.effective_chips("default/embedder", 8) == 2
+    assert store.effective_chips("default/embedder", 1) == 1
+    assert store.effective_chips("default/unknown", 8) is None
+
+
+def test_profile_store_operator_seed_file(tmp_path):
+    path = tmp_path / "profiles.json"
+    path.write_text(json.dumps(
+        {"default/trainer": {"mfu": 0.9, "hbm_frac": 0.7},
+         "default/embedder": {"mfu": 0.1},
+         "junk": "not-a-dict"}))
+    store = placement.ProfileStore(path=str(path))
+    assert len(store) == 2
+    assert store.demand("default/trainer") == pytest.approx(0.9)
+    # A malformed file warns and loads nothing (bad mounts must not
+    # kill the plugin).
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    assert placement.ProfileStore(path=str(bad)).demand("x") is None
+
+
+# -- scorer -----------------------------------------------------------
+
+
+def test_scorer_terms_hand_computed():
+    """4x4 free grid, size-4 candidates: an edge 1x4 row costs less
+    largest-box than a center 2x2, exactly as hand-computed."""
+    dims = (4, 4, 1)
+    free = [(x, y, 0) for x in range(4) for y in range(4)]
+    scorer = placement.PlacementScorer(
+        w_compact=1.0, w_frag=1.0, w_profile=1.0, enabled=True)
+    grid = placement.CoordGrid(free, dims)
+    row = [(0, y, 0) for y in range(4)]        # edge row
+    center = [(x, y, 0) for x in (1, 2) for y in (1, 2)]
+    # row: compact 0; largest box 16 -> 12 (3x4): frag (16-12)/4 = 1.
+    assert scorer.score(row, grid, dims, 4) == pytest.approx(1.0)
+    # center 2x2: compact 0; it blocks both middle rows AND columns,
+    # so 16 -> 4 (edge rows/cols only): frag (16-4)/4 = 3.
+    assert scorer.score(center, grid, dims, 4) == pytest.approx(3.0)
+    # Profile fit: heavy demand (1.0) weights compactness (0 for a
+    # box), light demand (0.0) doubles the fragmentation penalty.
+    assert scorer.score(center, grid, dims, 4, demand=0.0) == \
+        pytest.approx(6.0)
+    assert scorer.score(center, grid, dims, 4, demand=1.0) == \
+        pytest.approx(3.0)
+
+
+def test_scorer_choose_deterministic_tie_break():
+    dims = (2, 2, 1)
+    free = [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+    scorer = placement.PlacementScorer(enabled=True)
+    cands = [(["accel2", "accel3"], [(1, 0, 0), (1, 1, 0)]),
+             (["accel0", "accel1"], [(0, 0, 0), (0, 1, 0)])]
+    ids, score = scorer.choose(cands, free, dims, 2)
+    assert ids == ["accel0", "accel1"]   # natural-least wins the tie
+    ids2, _ = scorer.choose(list(reversed(cands)), free, dims, 2)
+    assert ids2 == ids
+
+
+def test_largest_box_volume():
+    dims = (4, 4, 1)
+    coords = [(x, y, 0) for x in range(4) for y in range(4)
+              if (x, y) != (1, 1)]
+    assert placement.largest_box_volume(coords, dims) == 8
+    assert placement.largest_box_volume([], dims) == 0
+
+
+def test_profile_fit_changes_the_choice(fake_node, monkeypatch):
+    """A measured-light workload gets the scatter that preserves the
+    big box when the box candidates are more destructive — the
+    MISO behavior, end to end through preferred_allocation."""
+    mgr = make_manager(fake_node, "4x4")
+    hint = fake_node.dev_dir + "/hint"
+    with open(hint, "w") as f:
+        f.write("default/embedder")
+    monkeypatch.setenv(placement.HINT_FILE_ENV, hint)
+    profiles = mgr.placement_profiles()
+    profiles.observe("default/embedder", mfu=0.05, weight=1.0)
+    available = [f"accel{i}" for i in range(16)]
+    light = mgr.preferred_allocation(available, [], 2)
+    # The decision is journaled with workload + advisory sizing.
+    events = [e for e in obs.TRACER.snapshot()["events"]
+              if e["name"] == placement.DECISION_EVENT]
+    assert events, "no placement.decision event"
+    assert events[-1]["fields"]["workload"] == "default/embedder"
+    assert events[-1]["fields"]["effective_chips"] == 1
+    assert len(light) == 2
+
+
+# -- repartition policy -----------------------------------------------
+
+
+def live_slices(*ids):
+    return set(ids)
+
+
+def test_policy_episode_hysteresis_and_drain_gate(fake_node):
+    mgr = make_manager(fake_node, "4x4", partition="4x1")
+    mgr.allocate_envs(["tpu-4x1-0"])
+    mgr.allocate_envs(["tpu-4x1-2"])
+    live = {"tpu-4x1-0", "tpu-4x1-2"}
+    policy = placement.RepartitionPolicy(mgr, threshold=0.5)
+
+    # Liveness unknown: the pass is skipped entirely.
+    assert policy.evaluate(live_device_ids=None) is None
+    assert not obs.TRACER.gauges()
+
+    for _ in range(3):
+        result = policy.evaluate(live_device_ids=live)
+    assert result["fragmentation"] == pytest.approx(0.5)
+    assert policy.proposal_count() == 1           # one per episode
+    assert policy.pending_proposal() == "2x2"
+
+    # Drain gate: live or unknown liveness never applies.
+    assert policy.maybe_apply(live) is None
+    assert policy.maybe_apply(None) is None
+    assert mgr.partition_shape() == "4x1"
+
+    # Recovery (the allocations drain): fragmentation falls to 0,
+    # the episode closes once, and the pending proposal SURVIVES —
+    # the tiling/demand mismatch it fixes is still there.
+    policy.evaluate(live_device_ids=set())
+    assert policy.pending_proposal() == "2x2"
+    names = [e["name"] for e in obs.TRACER.snapshot()["events"]]
+    assert names.count(placement.PROPOSED_EVENT) == 1
+    assert names.count(placement.RECOVERED_EVENT) == 1
+
+    assert policy.maybe_apply(set()) == "2x2"
+    assert mgr.partition_shape() == "2x2"
+    assert sorted(mgr.list_devices()) == [
+        "tpu-2x2-0", "tpu-2x2-1", "tpu-2x2-2", "tpu-2x2-3"]
+    assert names.count(placement.APPLIED_EVENT) == 0  # pre-apply snap
+    names = [e["name"] for e in obs.TRACER.snapshot()["events"]]
+    assert names.count(placement.APPLIED_EVENT) == 1
+    # Applying clears the pending proposal; a fresh drained pass
+    # proposes nothing new (demand now matches the tiling).
+    assert policy.maybe_apply(set()) is None
+
+
+def test_policy_gauges_ride_the_stale_label_reset(fake_node):
+    """The placement gauges participate in the metrics stale-label
+    reset cycle: series under a superseded shape label drop, the
+    live shape's series survive (the policy re-publishes on its own
+    cadence; dropping the live series would blink them off the
+    scrape between passes)."""
+    from container_engine_accelerators_tpu.plugin.metrics import (
+        MetricServer,
+    )
+
+    mgr = make_manager(fake_node, "4x4", partition="4x1")
+    policy = placement.RepartitionPolicy(mgr, threshold=0.5)
+    policy.evaluate(live_device_ids=set())
+    gauges = obs.get_tracer().gauges()
+    assert any(k[0] == placement.FRAGMENTATION_GAUGE
+               and ("shape", "4x1") in k[1] for k in gauges)
+
+    # A repartition supersedes the 4x1 series; the next reset sheds
+    # them while the 2x2 series (published post-repartition) stays.
+    mgr.repartition("2x2")
+    policy.evaluate(live_device_ids=set())
+    server = MetricServer(mgr, mgr._backend, port=0)
+    server._reset()
+    gauges = obs.get_tracer().gauges()
+    assert not any(("shape", "4x1") in k[1] for k in gauges
+                   if k[0] in placement.PLACEMENT_GAUGES)
+    assert any(k[0] == placement.FRAGMENTATION_GAUGE
+               and ("shape", "2x2") in k[1] for k in gauges)
+
+
+def test_policy_propose_needs_journal_demand(fake_node):
+    """No allocate.decision history -> nothing to size a re-tiling
+    for -> no proposal even over the fragmentation threshold."""
+    mgr = make_manager(fake_node, "4x4", partition="4x1")
+    obs.TRACER.reset()   # drop the allocate-free startup journal
+    policy = placement.RepartitionPolicy(mgr, threshold=0.1)
+    result = policy.evaluate(
+        live_device_ids={"tpu-4x1-0", "tpu-4x1-2"})
+    assert result["fragmentation"] > 0.1
+    assert policy.proposal_count() == 0
+    assert policy.pending_proposal() is None
+
+
+def test_policy_proposes_with_tracing_disabled(fake_node):
+    """CEA_TPU_TRACE=0 records no allocate.decision events; the
+    policy must fall back to the manager's tracer-independent demand
+    counter instead of going silently inert (the PR-5 efficiency-
+    ledger bare-path discipline)."""
+    mgr = make_manager(fake_node, "4x4", partition="4x1")
+    obs.TRACER.enabled = False
+    try:
+        mgr.allocate_envs(["tpu-4x1-0"])
+        mgr.allocate_envs(["tpu-4x1-2"])
+        assert mgr.demand_histogram() == {4: 2}
+        policy = placement.RepartitionPolicy(mgr, threshold=0.5)
+        result = policy.evaluate(
+            live_device_ids={"tpu-4x1-0", "tpu-4x1-2"})
+        assert result["fragmentation"] == pytest.approx(0.5)
+        assert policy.pending_proposal() == "2x2"
+    finally:
+        obs.TRACER.enabled = True
+
+
+def test_failed_apply_reopens_the_episode(fake_node):
+    """A re-tile that fails for a non-drain reason (topology changed
+    under the proposal) drops the proposal AND closes the episode: a
+    still-fragmented node must re-propose at the next pass, not wedge
+    with episode=True and nothing pending."""
+    mgr = make_manager(fake_node, "4x4", partition="4x1")
+    mgr.allocate_envs(["tpu-4x1-0"])
+    mgr.allocate_envs(["tpu-4x1-2"])
+    live = {"tpu-4x1-0", "tpu-4x1-2"}
+    policy = placement.RepartitionPolicy(mgr, threshold=0.5)
+    policy.evaluate(live_device_ids=live)
+    assert policy.pending_proposal() == "2x2"
+
+    orig = mgr.repartition
+
+    def boom(*a, **k):
+        raise RuntimeError("topology changed")
+
+    mgr.repartition = boom
+    assert policy.maybe_apply(set()) is None
+    assert policy.pending_proposal() is None
+    mgr.repartition = orig
+
+    # The node is still fragmented: the next pass opens a fresh
+    # episode and proposes again.
+    policy.evaluate(live_device_ids=live)
+    assert policy.pending_proposal() == "2x2"
+    assert policy.proposal_count() == 2
+    assert policy.maybe_apply(set()) == "2x2"
+    assert mgr.partition_shape() == "2x2"
+
+
+def test_drain_race_defers_and_keeps_the_proposal(fake_node):
+    """An Allocate landing between the drained-liveness snapshot and
+    the apply must NOT be re-tiled out from under: the epoch guard
+    defers the apply and the proposal survives for the next pass."""
+    mgr = make_manager(fake_node, "4x4", partition="4x1")
+    mgr.allocate_envs(["tpu-4x1-0"])
+    mgr.allocate_envs(["tpu-4x1-2"])
+    policy = placement.RepartitionPolicy(mgr, threshold=0.5)
+    policy.evaluate(live_device_ids={"tpu-4x1-0", "tpu-4x1-2"})
+    assert policy.pending_proposal() == "2x2"
+
+    epoch = policy.manager_epoch()
+    # ... liveness snapshot says drained, then a pod sneaks in:
+    mgr.allocate_envs(["tpu-4x1-1"])
+    assert policy.maybe_apply(set(), epoch=epoch) is None
+    assert mgr.partition_shape() == "4x1"          # no re-tile
+    assert policy.pending_proposal() == "2x2"      # proposal kept
+    # A fresh (genuinely drained) pass applies.
+    assert policy.maybe_apply(set(),
+                              epoch=policy.manager_epoch()) == "2x2"
+    assert mgr.partition_shape() == "2x2"
+
+
+def test_applied_repartition_survives_plugin_restart(fake_node):
+    """The config file (usually a read-only hostPath) still says the
+    old size after a policy re-tiling; a restarted plugin must resume
+    the applied tiling, not silently revert — unless the operator
+    changed the configured size, which wins."""
+    mgr = make_manager(fake_node, "4x4", partition="4x1")
+    mgr.repartition("2x2")
+
+    restarted = TpuManager(
+        dev_dir=fake_node.dev_dir, state_dir=fake_node.state_dir,
+        backend=PyChipBackend(),
+        tpu_config=cfg.TpuConfig(tpu_partition_size="4x1"))
+    restarted.start()
+    assert restarted.partition_shape() == "2x2"
+    assert sorted(restarted.list_devices()) == [
+        "tpu-2x2-0", "tpu-2x2-1", "tpu-2x2-2", "tpu-2x2-3"]
+
+    # Operator reconfigure invalidates the stored re-tiling.
+    reconfigured = TpuManager(
+        dev_dir=fake_node.dev_dir, state_dir=fake_node.state_dir,
+        backend=PyChipBackend(),
+        tpu_config=cfg.TpuConfig(tpu_partition_size="1x4"))
+    reconfigured.start()
+    assert reconfigured.partition_shape() == "1x4"
+
+
+def test_repartition_refuses_unpartitioned_node(fake_node):
+    mgr = make_manager(fake_node, "2x2")
+    with pytest.raises(ValueError, match="not partitioned"):
+        mgr.repartition("1x2")
+
+
+def test_placement_loop_once_applies_when_drained(fake_node):
+    mgr = make_manager(fake_node, "4x4", partition="4x1")
+    mgr.allocate_envs(["tpu-4x1-0"])
+    mgr.allocate_envs(["tpu-4x1-2"])
+    live = [{"tpu-4x1-0", "tpu-4x1-2"}, set()]
+    policy = placement.RepartitionPolicy(mgr, threshold=0.5)
+    loop = placement.PlacementLoop(policy, lambda: live[0],
+                                   interval_s=3600)
+    assert loop.loop_once() is None          # fragmented but live
+    live[0] = set()
+    assert loop.loop_once() == "2x2"         # drained -> applied
+    assert mgr.partition_shape() == "2x2"
+
+
+# -- gRPC contract ----------------------------------------------------
+
+
+def test_oversize_preference_is_invalid_argument_over_grpc(fake_node):
+    mgr = make_manager(fake_node, "2x2")
+    plugin_dir = short_tmpdir()
+    with ServingManager(mgr, plugin_dir) as sm:
+        with sm.channel() as ch:
+            stub = api.DevicePluginV1Beta1Stub(ch)
+            with pytest.raises(grpc.RpcError) as exc:
+                stub.GetPreferredAllocation(
+                    api.v1beta1_pb2.PreferredAllocationRequest(
+                        container_requests=[
+                            api.v1beta1_pb2
+                            .ContainerPreferredAllocationRequest(
+                                available_deviceIDs=["accel0",
+                                                     "accel1"],
+                                allocation_size=5)]), timeout=10)
+            assert exc.value.code() == \
+                grpc.StatusCode.INVALID_ARGUMENT
+            # A satisfiable request on the same stream still works.
+            resp = stub.GetPreferredAllocation(
+                api.v1beta1_pb2.PreferredAllocationRequest(
+                    container_requests=[
+                        api.v1beta1_pb2
+                        .ContainerPreferredAllocationRequest(
+                            available_deviceIDs=[
+                                "accel0", "accel1", "accel2",
+                                "accel3"],
+                            allocation_size=2)]), timeout=10)
+            assert list(resp.container_responses[0].deviceIDs) == \
+                ["accel0", "accel1"]
+
+
+def test_allocate_decision_carries_preference_score(fake_node):
+    """The preferred_allocation -> Allocate handoff: the journal's
+    allocate.decision for a set the kubelet just asked a preference
+    for carries that preference's score."""
+    mgr = make_manager(fake_node, "4x4")
+    available = [f"accel{i}" for i in range(16)]
+    chosen = mgr.preferred_allocation(available, [], 4)
+    mgr.allocate_envs(chosen)
+    decisions = [e for e in obs.TRACER.snapshot()["events"]
+                 if e["name"] == "allocate.decision"]
+    assert decisions
+    assert isinstance(decisions[-1]["fields"].get("score"),
+                      (int, float))
+    # An Allocate that never went through a preference has no score.
+    mgr.allocate_envs(["accel15"])
+    last = [e for e in obs.TRACER.snapshot()["events"]
+            if e["name"] == "allocate.decision"][-1]
+    assert "score" not in last["fields"]

@@ -96,7 +96,14 @@ _CHILD_JOURNAL_CODE = (
     "          resume_step=12, recovery_s=1.5)\n"
     "obs.event('train.checkpoint_saved', step=12,\n"
     "          path='/tmp/ckpt/checkpoint_12', bytes=1024,\n"
-    "          leaves=4)\n")
+    "          leaves=4)\n"
+    # Placement-section fodder: the repartition event shapes the
+    # policy loop emits (plugin/placement.py) — the bundle must keep
+    # them in timeline order next to the plugin's own decisions.
+    "obs.event('placement.repartition_proposed', proposal='2x2',\n"
+    "          fragmentation=0.5, current_shape='4x1')\n"
+    "obs.event('placement.repartition_applied', old_shape='4x1',\n"
+    "          new_shape='2x2', subslices=4)\n")
 
 
 def fake_node(root):
@@ -136,10 +143,31 @@ def main():
         with grpc.insecure_channel(
                 f"unix://{os.path.join(plugin_dir, socks[0])}") as ch:
             stub = api.DevicePluginV1Beta1Stub(ch)
+            # Preference first, then Allocate: the placement section
+            # must carry the scored decision the preference journals
+            # through the REAL gRPC surface.
+            pref = stub.GetPreferredAllocation(
+                api.v1beta1_pb2.PreferredAllocationRequest(
+                    container_requests=[
+                        api.v1beta1_pb2
+                        .ContainerPreferredAllocationRequest(
+                            available_deviceIDs=[
+                                "accel0", "accel1", "accel2",
+                                "accel3"],
+                            allocation_size=2)]), timeout=10)
+            preferred = list(pref.container_responses[0].deviceIDs)
             stub.Allocate(api.v1beta1_pb2.AllocateRequest(
                 container_requests=[
                     api.v1beta1_pb2.ContainerAllocateRequest(
                         devicesIDs=["accel0"])]), timeout=10)
+        # One policy pass with known-drained liveness publishes the
+        # fragmentation/score gauges the bundle's varz leg must pick
+        # up.
+        from container_engine_accelerators_tpu.plugin import (
+            placement,
+        )
+        placement.RepartitionPolicy(manager).evaluate(
+            live_device_ids=set())
 
         # The recovery counter rides varz (this process IS the
         # plugin the bundle sweeps), and a finished checkpoint dir
@@ -282,6 +310,30 @@ def main():
             failures.append(
                 f"last_save missing the child's checkpoint_saved "
                 f"event: {last!r}")
+        # Placement section: the scored preference this harness drove
+        # through gRPC, the policy pass's gauges, and the child's
+        # repartition events in timeline order.
+        placement_sec = bundle.get("placement") or {}
+        pgauges = placement_sec.get("gauges") or {}
+        if not any(k.startswith("tpu_plugin_fragmentation")
+                   for legs in pgauges.values() for k in legs):
+            failures.append(
+                f"fragmentation gauge missing from the varz leg: "
+                f"{pgauges!r}")
+        decisions = placement_sec.get("decisions") or []
+        if not any(isinstance(d.get("score"), (int, float))
+                   and sorted(d.get("devices") or []) == preferred
+                   for d in decisions):
+            failures.append(
+                f"placement section lost the scored preference for "
+                f"{preferred}: {decisions!r}")
+        pev_names = [e.get("name") for e in
+                     placement_sec.get("events") or []]
+        if pev_names != ["placement.repartition_proposed",
+                         "placement.repartition_applied"]:
+            failures.append(
+                f"placement events missing or out of timeline "
+                f"order: {pev_names}")
     finally:
         metrics.stop()
         manager.stop()

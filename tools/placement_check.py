@@ -1,0 +1,228 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Placement-subsystem guard (the `make placement-check` preflight).
+
+Two scenarios on the fake-chip backend, pure CPU, seconds:
+
+1. MIXED TRACE — the same allocate/free sequence is replayed against
+   the PlacementScorer and against natural-order first-fit; after
+   every allocation the largest remaining allocatable ICI box is
+   recorded. The scorer must retain AT LEAST as much box capacity at
+   every step and strictly more in total — the MISO/ParvaGPU claim
+   this subsystem exists for, asserted rather than assumed.
+
+2. FORCED FRAGMENTATION — a 4x1-tiled 4x4 node with alternating
+   slices allocated fragments the free set to 0.5; the
+   RepartitionPolicy must open exactly ONE episode (one
+   `placement.repartition_proposed` event across repeated evaluate
+   passes — the hysteresis discipline), must REFUSE to re-tile while
+   any allocation is live or liveness is unknown, and once the node
+   drains must apply the proposed 2x2 re-tiling, after which a fresh
+   allocation gets a full-box chip set again.
+
+Exit 0 = clean, 1 = check failed, 2 = harness error.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ["CEA_TPU_TRACE"] = "1"   # the episode guard reads events
+os.environ.pop("CEA_TPU_PLACEMENT", None)
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.chip import (  # noqa: E402
+    PyChipBackend,
+)
+from container_engine_accelerators_tpu.plugin import (  # noqa: E402
+    config as cfg,
+)
+from container_engine_accelerators_tpu.plugin import (  # noqa: E402
+    placement,
+)
+from container_engine_accelerators_tpu.plugin.envs import (  # noqa: E402
+    chips_form_box,
+)
+from container_engine_accelerators_tpu.plugin.manager import (  # noqa: E402
+    TpuManager,
+)
+
+# Allocate/free mix chosen so that scattered-availability points —
+# where first-fit provably shreds the big box — actually occur.
+MIXED_TRACE = (
+    ("alloc", "A", 4),
+    ("alloc", "B", 2),
+    ("alloc", "C", 4),
+    ("free", "B", 0),
+    ("alloc", "D", 2),
+    ("alloc", "E", 4),
+)
+
+
+def fake_node(topo, n):
+    root = tempfile.mkdtemp(prefix="tpu-placement-check")
+    dev = os.path.join(root, "dev")
+    state = os.path.join(root, "state")
+    os.makedirs(dev)
+    os.makedirs(state)
+    for i in range(n):
+        open(os.path.join(dev, f"accel{i}"), "w").close()
+        os.makedirs(os.path.join(state, f"accel{i}"))
+    with open(os.path.join(state, "topology"), "w") as f:
+        f.write(topo)
+    return dev, state
+
+
+def make_manager(topo="4x4", n=16, partition=""):
+    dev, state = fake_node(topo, n)
+    mgr = TpuManager(
+        dev_dir=dev, state_dir=state, backend=PyChipBackend(),
+        tpu_config=cfg.TpuConfig(tpu_partition_size=partition))
+    mgr.start()
+    return mgr
+
+
+def replay_trace(mgr, allocator):
+    """Run MIXED_TRACE with `allocator(free_devs, size)`; returns the
+    largest-free-box volume recorded after every allocation."""
+    dims = mgr.topology_dims()
+    all_devs = sorted(mgr.list_devices(), key=placement.natural_key)
+    free = list(all_devs)
+    held = {}
+    retained = []
+    for op, name, size in MIXED_TRACE:
+        if op == "free":
+            free.extend(held.pop(name))
+            free.sort(key=placement.natural_key)
+            continue
+        chosen = allocator(list(free), size)
+        assert len(chosen) == size and set(chosen) <= set(free), (
+            name, chosen)
+        held[name] = list(chosen)
+        free = [d for d in free if d not in set(chosen)]
+        coords = [mgr.chip_coords(mgr.device_chips(d)[0]) for d in free]
+        retained.append(placement.largest_box_volume(coords, dims))
+    return retained
+
+
+def check_mixed_trace(failures):
+    mgr = make_manager()
+    scored = replay_trace(
+        mgr, lambda free, size: mgr.preferred_allocation(free, [], size))
+    firstfit = replay_trace(
+        mgr, lambda free, size: mgr._first_n(free, [], size))
+    if any(s < f for s, f in zip(scored, firstfit)):
+        failures.append(
+            f"scorer retained a smaller box than first-fit at some "
+            f"step: scorer={scored} first-fit={firstfit}")
+    if sum(scored) <= sum(firstfit):
+        failures.append(
+            f"scorer did not beat first-fit on total largest-box "
+            f"retention: scorer={scored} first-fit={firstfit}")
+    return {"scorer": scored, "first_fit": firstfit}
+
+
+def check_repartition(failures):
+    mgr = make_manager(partition="4x1")
+    # Demand journal: two 4-chip allocations on alternating columns —
+    # the layout that shreds the free set while telling the policy
+    # the node's demand is 4-chip jobs.
+    mgr.allocate_envs(["tpu-4x1-0"])
+    mgr.allocate_envs(["tpu-4x1-2"])
+    live = {"tpu-4x1-0", "tpu-4x1-2"}
+    policy = placement.RepartitionPolicy(mgr, threshold=0.5)
+
+    for _ in range(3):   # repeated passes must open ONE episode
+        result = policy.evaluate(live_device_ids=live)
+    if result is None or abs(result["fragmentation"] - 0.5) > 1e-9:
+        failures.append(f"fragmentation not 0.5: {result}")
+    if policy.proposal_count() != 1:
+        failures.append(
+            f"{policy.proposal_count()} proposals for one episode; "
+            f"hysteresis broken")
+    if policy.pending_proposal() != "2x2":
+        failures.append(
+            f"proposal {policy.pending_proposal()!r}; want '2x2' "
+            f"(most cube-like tile of the dominant 4-chip demand)")
+
+    # The drain gate: live allocations or unknown liveness never
+    # re-tile.
+    if policy.maybe_apply(live) is not None:
+        failures.append("re-tiled under live allocations")
+    if policy.maybe_apply(None) is not None:
+        failures.append("re-tiled with liveness unknown")
+    if mgr.partition_shape() != "4x1":
+        failures.append("slice table changed before the drain")
+
+    applied = policy.maybe_apply(set())
+    if applied != "2x2":
+        failures.append(f"drained apply returned {applied!r}")
+    if mgr.partition_shape() != "2x2":
+        failures.append(f"shape after apply: {mgr.partition_shape()}")
+
+    # The point of the exercise: a fresh allocation is a full box
+    # again.
+    devices = sorted(mgr.list_devices(), key=placement.natural_key)
+    gang = mgr.preferred_allocation(devices, [], 1)
+    coords = [mgr.chip_coords(c) for c in mgr.device_chips(gang[0])]
+    if not chips_form_box(coords):
+        failures.append(
+            f"post-repartition allocation {gang} is not a full box")
+
+    events = obs.get_tracer().snapshot()["events"]
+    names = [e["name"] for e in events]
+    proposed = names.count(placement.PROPOSED_EVENT)
+    if proposed != 1:
+        failures.append(
+            f"{proposed} {placement.PROPOSED_EVENT} events; want "
+            f"exactly 1 per episode")
+    if names.count(placement.APPLIED_EVENT) != 1:
+        failures.append("repartition_applied event missing/duplicated")
+    gauges = {k[0] for k in obs.get_tracer().gauges()}
+    for g in placement.PLACEMENT_GAUGES:
+        if g not in gauges:
+            failures.append(f"gauge {g} never published")
+    return {"fragmentation": result and result["fragmentation"],
+            "proposal": applied, "proposed_events": proposed}
+
+
+def main():
+    failures = []
+    try:
+        mixed = check_mixed_trace(failures)
+        repart = check_repartition(failures)
+    except Exception as e:
+        import traceback
+        traceback.print_exc()
+        print(f"placement-check: harness error: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps({"mixed_trace": mixed, "repartition": repart,
+                      "failures": failures}))
+    if failures:
+        for f in failures:
+            print(f"placement-check FAILED: {f}", file=sys.stderr)
+        return 1
+    print("placement-check: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

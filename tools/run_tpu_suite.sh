@@ -112,6 +112,17 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "chaos-check preflight"
 
+# Placement preflight (CPU fake backend, seconds): the scorer must
+# beat first-fit on largest-remaining-box retention over a mixed
+# allocate trace, and a forced-fragmentation episode must produce
+# exactly one repartition proposal, applied only when drained. A
+# regression here means the plugin is quietly shredding the very ICI
+# boxes the benchmarks below depend on being allocatable.
+echo "[suite] placement-check preflight" >&2
+timeout -k 10 120 python tools/placement_check.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "placement-check preflight"
+
 # Continuous-batching preflight (CPU fake backend, ~1 min): the slot
 # engine must beat the sequential-batch policy >= 2x in goodput on a
 # replayed Poisson trace with greedy outputs bit-identical to

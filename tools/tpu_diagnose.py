@@ -43,7 +43,13 @@ incident:
     in timeline order, the ``tpu_train_recovery_total`` counters
     from each varz leg, and the newest finished checkpoint's
     provenance from any --checkpoint-dir (where the fleet would
-    resume from).
+    resume from);
+  - the placement subsystem's decisions: fragmentation /
+    placement-score gauge values per varz leg, the last N scored
+    ``allocate.decision``/``placement.decision`` events, and every
+    ``placement.repartition_proposed/applied`` event in timeline
+    order (did the policy see the fragmentation, what did it
+    propose, and was the drain gate honored).
 
 Endpoint failures are recorded in place (a structured error per
 surface), never raised: on a half-dead node the partial bundle IS the
@@ -186,6 +192,69 @@ def memory_section(endpoints, journals):
 ELASTIC_EVENTS = ("train.eviction", "train.reshape",
                   "train.recovered")
 RECOVERY_COUNTER = "tpu_train_recovery_total"
+
+PLACEMENT_EVENTS = ("placement.repartition_proposed",
+                    "placement.repartition_applied",
+                    "placement.fragmentation_recovered")
+PLACEMENT_GAUGE_PREFIXES = ("tpu_plugin_fragmentation",
+                            "tpu_plugin_placement_score")
+DECISION_SCORE_EVENTS = ("placement.decision", "allocate.decision")
+LAST_N_DECISIONS = 20
+
+
+def placement_section(endpoints, snapshots):
+    """What the placement subsystem decided and why: fragmentation /
+    score gauges per varz leg, the last N scored allocation
+    decisions, and every repartition proposal/application in
+    timeline order (the drain-then-repartition story, replayable
+    offline)."""
+    gauges = {}
+    for base, legs in endpoints.items():
+        if not legs["varz"]["ok"]:
+            continue
+        for key, value in (legs["varz"]["payload"]
+                           .get("gauges") or {}).items():
+            if key.startswith(PLACEMENT_GAUGE_PREFIXES):
+                gauges.setdefault(base, {})[key] = value
+    by_name = {name: [] for name in DECISION_SCORE_EVENTS}
+    events = []
+    for snap in snapshots:
+        ident = snap.get("identity") or {}
+        label = obs.process_label(ident) if ident else None
+        for ev in snap.get("events") or []:
+            name = ev.get("name")
+            fields = ev.get("fields") or {}
+            if name in PLACEMENT_EVENTS:
+                events.append({"name": name, "unix": ev.get("unix"),
+                               "fields": fields, "process": label})
+            elif (name in DECISION_SCORE_EVENTS
+                    and isinstance(fields.get("score"), (int, float))):
+                by_name[name].append(
+                    {"name": name, "unix": ev.get("unix"),
+                     "score": fields.get("score"),
+                     "devices": fields.get("devices"),
+                     "workload": fields.get("workload")})
+    events.sort(key=lambda e: e.get("unix") or 0.0)
+    # An allocated preference journals its score twice
+    # (placement.decision, then the forwarded copy on
+    # allocate.decision) — listing both would duplicate every
+    # allocated decision and halve the effective window, so
+    # placement.decision rows are authoritative with the allocate
+    # copies as the fallback when the ring already dropped them
+    # (same rule as RepartitionPolicy._recent_scores).
+    decisions = (by_name["placement.decision"]
+                 or by_name["allocate.decision"])
+    decisions.sort(key=lambda e: e.get("unix") or 0.0)
+    return {
+        "gauges": gauges,
+        "decisions": decisions[-LAST_N_DECISIONS:],
+        "decisions_observed": len(decisions),
+        "events": events,
+        "proposals": sum(1 for e in events
+                         if e["name"].endswith("repartition_proposed")),
+        "applied": sum(1 for e in events
+                       if e["name"].endswith("repartition_applied")),
+    }
 
 
 def _latest_checkpoint_meta(directory):
@@ -330,6 +399,7 @@ def collect(urls, journal_paths, dev_dir, state_dir,
         "profiles": profile_captures(snapshots),
         "elastic": elastic_section(endpoints, snapshots,
                                    checkpoint_dirs),
+        "placement": placement_section(endpoints, snapshots),
         "provenance": stamp(
             devices=["host (diagnostics sweep; reads debug "
                      "endpoints and state files only)"]),
@@ -384,6 +454,8 @@ def main(argv=None):
                           ).get("goodput_ratio")
         if isinstance(bundle["goodput"], dict) else None,
         "profile_captures": len(bundle["profiles"]),
+        "placement_decisions": bundle["placement"]["decisions_observed"],
+        "repartition_proposals": bundle["placement"]["proposals"],
     }))
     return 0
 
