@@ -84,6 +84,17 @@ placement-check:
 occupancy-check:
 	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py --check
 
+# Paged-KV capacity guard: replay one shared-prefix Poisson trace
+# (80% of requests opening with one system prompt) through the paged
+# block-pool engine and the dense per-slot pool at EQUAL KV HBM
+# budget; fail unless the paged pool sustains >= 2x concurrent
+# rows/step, its prefix index actually hit (prefix_hit_rate > 0),
+# and every greedy stream (both pools) is bit-identical to
+# per-request decode(). Pure CPU, ~2 min.
+paging-check:
+	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
+		--paging-check
+
 bench:
 	python3 bench.py
 
@@ -109,4 +120,5 @@ clean:
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
 	trace-check diagnose-check goodput-check chaos-check \
-	placement-check occupancy-check container partition-tpu push clean
+	placement-check occupancy-check paging-check container \
+	partition-tpu push clean

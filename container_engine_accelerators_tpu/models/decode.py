@@ -29,11 +29,16 @@ pair is unwrapped); MoE decode uses the dense dispatch path
 batch to shard.
 """
 
+import collections
 import functools
+import hashlib
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from flax import traverse_util
+from flax.core import unfreeze
 
 
 def _decode_clone(model):
@@ -1091,6 +1096,375 @@ def _slot_cache_init(model, slots, slot_len):
     return variables["cache"]
 
 
+# ---------------------------------------------------------------------
+# Paged KV-cache block pool
+# ---------------------------------------------------------------------
+#
+# The dense pool above is static partitioning of HBM: every slot
+# reserves a worst-case [slot_len] cache row however short its
+# request, and N rows sharing one system prompt store its K/V N
+# times. The paged pool replaces the per-row buffers with ONE
+# [num_blocks, block_size, H, D] arena per layer plus per-row block
+# tables (transformer.py kv_pages): a row holds only the blocks its
+# USED tokens occupy, identical prompt prefixes map the same physical
+# blocks refcounted across rows (fork-on-first-write for the partial
+# boundary block), and admission capacity is blocks, not slots.
+# Ownership, refcounts, the free list, and the content-keyed prefix
+# index are HOST state (this thread-unsafe-by-contract engine is
+# driven by one loop thread); the device only ever sees traced block
+# tables and copy vectors, so the program set stays exactly the dense
+# pool's bound: one prefill program per admission width + one insert
+# + one step. CEA_TPU_PAGED_KV=0 restores the dense pool bit-for-bit.
+
+PAGED_KV_ENV = "CEA_TPU_PAGED_KV"
+KV_BLOCK_ENV = "CEA_TPU_KV_BLOCK"
+KV_BLOCKS_ENV = "CEA_TPU_KV_BLOCKS"
+
+# Arena data leaves, by flax variable name — everything else in the
+# paged cache tree is per-row engine state (block_table vectors,
+# cache_index/pos_index) the host re-injects every program call.
+_PAGED_DATA_LEAVES = ("cached_key", "cached_value", "key_scale",
+                      "value_scale")
+
+
+def paged_kv_enabled(default=True):
+    """CEA_TPU_PAGED_KV gate: unset/empty -> ``default`` (the paged
+    pool); 0/false/off/no -> the dense fallback."""
+    raw = os.environ.get(PAGED_KV_ENV)
+    if raw is None or not raw.strip():
+        return default
+    return raw.strip().lower() not in ("0", "false", "off", "no")
+
+
+class _BlockPool:
+    """Host-side allocator for the paged KV arena.
+
+    Blocks are refcounted: a row's table entry holds one reference;
+    identical prompt prefixes share blocks by incref. Freed blocks
+    (refcount 0) join the free list but keep their prefix-index keys
+    until REUSED (lazy purge) — a later admission with the same
+    prefix revives the block instead of re-prefilling it, which is
+    what makes sequential same-system-prompt traffic hit, not just
+    temporally overlapping rows.
+
+    ``committed`` counts blocks reserved for admitted rows' worst-case
+    remaining growth but not yet physically allocated: admission
+    gates on free - committed, so a mid-generation block-boundary
+    allocation can never fail — the exhaustion failure mode is a
+    QUEUED admission, never a corrupted table.
+    """
+
+    def __init__(self, num_blocks, block_size):
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # The last block is the TRASH block: never allocated, the
+        # gather/scatter target of every unallocated table entry and
+        # every free row — junk lands there, masked by the per-row
+        # horizon, so a free row's write can never touch a live block.
+        self.trash = self.num_blocks - 1
+        self.usable = self.num_blocks - 1
+        self.ref = np.zeros((self.num_blocks,), np.int64)
+        self._free_order = collections.deque(range(self.usable))
+        self._free_set = set(range(self.usable))
+        self._index = {}        # content key -> block id
+        self._block_keys = {}   # block id -> [keys] (purged on reuse)
+        self.committed = 0
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.shared_tokens = 0
+
+    def free_count(self):
+        return len(self._free_set)
+
+    def available(self):
+        """Blocks an admission may claim without endangering any
+        already-admitted row's reserved growth."""
+        return self.free_count() - self.committed
+
+    def shared_count(self):
+        return int((self.ref > 1).sum())
+
+    def _purge(self, bid):
+        for key in self._block_keys.pop(bid, ()):
+            if self._index.get(key) == bid:
+                del self._index[key]
+
+    def alloc(self):
+        while self._free_order:
+            bid = self._free_order.popleft()
+            if bid in self._free_set:
+                self._free_set.discard(bid)
+                self._purge(bid)  # content is about to be overwritten
+                self.ref[bid] = 1
+                return bid
+        raise RuntimeError(
+            "KV block pool exhausted — admission accounting should "
+            "have queued this request (engine invariant violated)")
+
+    def incref(self, bid):
+        if self.ref[bid] == 0:
+            # Revival: a free-listed block whose indexed content a
+            # new admission matched — back to resident, keys kept.
+            self._free_set.discard(bid)
+        self.ref[bid] += 1
+
+    def decref(self, bid):
+        self.ref[bid] -= 1
+        if self.ref[bid] < 0:
+            raise RuntimeError(f"KV block {bid} refcount underflow")
+        if self.ref[bid] == 0:
+            self._free_set.add(bid)
+            self._free_order.append(bid)
+            # Keys stay until reuse (lazy purge) for revival hits.
+
+    # -- content-keyed prefix index -----------------------------------
+
+    @staticmethod
+    def _chain(prev, payload):
+        # Running SHA-256 digest over the chain content: O(block) to
+        # extend one level, O(1) to hash/compare as a dict key (a
+        # nested-tuple key would re-hash the whole chain on every
+        # probe — quadratic in prompt length, paid per step while a
+        # queued head re-plans), and collisions are cryptographically
+        # infeasible (a bare hash() key could be forced to alias two
+        # prompts and silently share another request's KV blocks).
+        h = hashlib.sha256(b"" if prev is None else prev)
+        if (isinstance(payload, tuple) and payload
+                and payload[0] == "partial"):
+            h.update(b"partial")
+            payload = payload[1]
+        h.update(np.asarray(payload, np.int64).tobytes())
+        return h.digest()
+
+    def lookup(self, tokens, count=True):
+        """Longest indexed prefix of ``tokens`` usable for sharing,
+        clipped to len(tokens) - 1 (at least one suffix token must
+        remain to feed the admission prefill). Full blocks chain-hash
+        block contents; the prompt-tail partial block matches via
+        (chain, partial-tokens) keys and comes back as ``fork_src`` —
+        the new row WRITES inside that block's span, so it must fork
+        a copy instead of taking a reference (copy-on-write).
+        Returns (shared_len, full_block_ids, fork_src)."""
+        if count:
+            self.prefix_lookups += 1
+        bs = self.block_size
+        limit = len(tokens) - 1
+        chain = None
+        blocks = []
+        i = 0
+        while (i + 1) * bs <= limit:
+            key = self._chain(chain, tuple(tokens[i * bs:(i + 1) * bs]))
+            bid = self._index.get(key)
+            if bid is None:
+                break
+            blocks.append(bid)
+            chain = key
+            i += 1
+        shared = i * bs
+        fork_src, best_q = None, 0
+        for q in range(1, bs):
+            if shared + q > limit:
+                break
+            pk = self._chain(
+                chain, ("partial", tuple(tokens[shared:shared + q])))
+            bid = self._index.get(pk)
+            if bid is not None:
+                fork_src, best_q = bid, q
+        shared += best_q
+        if count:
+            if shared:
+                self.prefix_hits += 1
+            self.shared_tokens += shared
+        return shared, blocks, fork_src
+
+    def register(self, tokens, plen, block_of_index):
+        """Index an admitted row's prompt blocks: one chain key per
+        full prompt block (immutable content — the row only ever
+        writes at positions >= plen) plus partial keys for every
+        prefix of the prompt-tail partial block (its sub-plen offsets
+        are immutable too; generated K/V lands at offsets >= the
+        registered content). ``block_of_index``: logical block index
+        -> physical block id for this row."""
+        bs = self.block_size
+        chain = None
+        full = plen // bs
+        for i in range(full):
+            key = self._chain(chain, tuple(tokens[i * bs:(i + 1) * bs]))
+            self._set_key(key, int(block_of_index[i]))
+            chain = key
+        rem = plen - full * bs
+        if rem:
+            bid = int(block_of_index[full])
+            for q in range(1, rem + 1):
+                pk = self._chain(
+                    chain,
+                    ("partial", tuple(tokens[full * bs:full * bs + q])))
+                self._set_key(pk, bid)
+
+    def _set_key(self, key, bid):
+        if self._index.get(key) == bid:
+            return
+        self._index[key] = bid
+        self._block_keys.setdefault(bid, []).append(key)
+
+    def state(self, max_rows=32):
+        """JSON-safe snapshot for the postmortem flight recorder."""
+        free = list(self._free_set)
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "free": len(free),
+            "free_list_head": sorted(free)[:max_rows],
+            "committed": int(self.committed),
+            "shared": self.shared_count(),
+            "max_refcount": int(self.ref.max()) if self.usable else 0,
+            "indexed_keys": len(self._index),
+            "prefix_lookups": int(self.prefix_lookups),
+            "prefix_hits": int(self.prefix_hits),
+        }
+
+
+def _arena_to_dense(dense, arena, table, shared_len):
+    """Gather a row's (prefix) blocks out of the paged arena into the
+    batch-1 dense cache tree the admission prefill runs against.
+
+    Name-keyed surgery: the two trees differ by the arena's
+    block_table leaves and [slots]-shaped index vectors, so ndim
+    heuristics don't apply — data leaves gather+reshape through
+    ``table`` (logical position p comes back at dense index p), index
+    leaves become the traced chunk offset ``shared_len``. Entries of
+    ``table`` beyond the shared span point at the trash block; their
+    junk sits at positions >= shared_len, where the chunk's causal
+    mask never reaches before the chunk's own writes land."""
+    flat_d = traverse_util.flatten_dict(unfreeze(dense))
+    flat_a = traverse_util.flatten_dict(unfreeze(arena))
+    out = {}
+    for path, dval in flat_d.items():
+        if path[-1] in _PAGED_DATA_LEAVES:
+            aval = flat_a[path]
+            g = aval[table].reshape((1, -1) + aval.shape[2:])
+            out[path] = g[:, :dval.shape[1]].astype(dval.dtype)
+        else:  # cache_index / pos_index scalars
+            out[path] = jnp.asarray(shared_len, jnp.int32)
+    return traverse_util.unflatten_dict(out)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "slot_len"))
+def _paged_prefill_impl(model, params, arena, prefix_table, row,
+                        shared_len, suffix_len, temperature, top_k,
+                        top_p, min_p, rep_pen, rng, *, slot_len):
+    """Admission prefill against RESIDENT prefix blocks: gather the
+    shared span's K/V out of the arena, then run the (bucket-padded)
+    suffix as ONE mid-cache chunk forward (the chunk_attends_cache
+    path speculative verify uses) at traced offset ``shared_len`` —
+    the shared span's prefill FLOPs are skipped entirely, and a long
+    system prompt costs only its suffix's bucket. shared_len == 0
+    (no prefix hit) degenerates to a full prefill through the same
+    compiled program, so the program count per admission width stays
+    exactly one regardless of traffic mix. Returns
+    (dense cache, first [1], first_lp [1], echo [width],
+    seen_row [V] bool, rng [2])."""
+    decode_model, cache = init_cache(model, 1, slot_len)
+    cache = _arena_to_dense(cache, arena, prefix_table, shared_len)
+    chunk_model = decode_model.clone(chunk_attends_cache=True)
+    outputs, updated = chunk_model.apply(
+        {"params": params, "cache": cache}, row,
+        train=False, mutable=["cache"])
+    logits = _logits_of(outputs)[0]                # [width, V]
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    echo = jnp.concatenate([
+        jnp.zeros((1,), jnp.float32),
+        jnp.take_along_axis(lsm[:-1], row[0, 1:, None].astype(
+            jnp.int32), axis=1)[:, 0]])
+    vocab = logits.shape[-1]
+    valid = jnp.arange(row.shape[1]) < suffix_len
+    seen_row = jnp.zeros((vocab,), bool).at[
+        jnp.where(valid, row[0], vocab)].set(True, mode="drop")
+    last = jax.lax.dynamic_index_in_dim(
+        logits, jnp.maximum(suffix_len - 1, 0), 0, keepdims=False)
+    first, first_lp, rng = _slot_sample(
+        last[None], seen_row[None], temperature[None], top_k[None],
+        top_p[None], min_p[None], rep_pen[None], rng[None])
+    seen_row = seen_row.at[first[0]].set(True)
+    return (updated["cache"], first, first_lp, echo, seen_row,
+            rng[0])
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _paged_insert_impl(cache, row_pos, seen, rngs, pre_cache, slot,
+                       row_len, seen_row, rng_row, dest_per_pos,
+                       cow_src, cow_dst):
+    """Scatter a batch-1 prefilled dense cache into arena blocks.
+
+    ``dest_per_pos[p]`` is the physical block backing dense position
+    p (num_blocks = drop sentinel: the shared span is NOT rewritten —
+    that is the whole point — and the tail beyond the prompt has no
+    blocks yet). The admission COW fork copies the shared partial
+    boundary block src -> dst FIRST, so the suffix scatter then
+    overwrites exactly the fork's tail; scatters to the sentinel
+    drop (JAX default out-of-bounds scatter semantics). ``slot`` may
+    be the out-of-bounds pin sentinel, in which case the per-row
+    state updates drop too (pin_prefix consumes no slot). One
+    compiled program total — slot, lengths, tables, and copy pairs
+    are all traced."""
+    flat_c = traverse_util.flatten_dict(unfreeze(cache))
+    flat_p = traverse_util.flatten_dict(unfreeze(pre_cache))
+    for path, leaf in flat_c.items():
+        if path[-1] not in _PAGED_DATA_LEAVES:
+            continue
+        pre = flat_p[path]
+        nb, bs = leaf.shape[0], leaf.shape[1]
+        leaf = leaf.at[cow_dst].set(
+            leaf[jnp.minimum(cow_src, nb - 1)], mode="drop")
+        offsets = jnp.arange(pre.shape[1], dtype=jnp.int32) % bs
+        leaf = leaf.at[dest_per_pos, offsets].set(
+            pre[0].astype(leaf.dtype), mode="drop")
+        flat_c[path] = leaf
+    return (traverse_util.unflatten_dict(flat_c),
+            row_pos.at[slot].set(row_len),
+            seen.at[slot].set(seen_row), rngs.at[slot].set(rng_row))
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnums=(2, 3, 4, 5))
+def _paged_step_impl(model, params, cache, row_pos, seen, rngs, tok,
+                     active, temps, top_ks, top_ps, min_ps, rep_pens,
+                     tables, cow_src, cow_dst):
+    """ONE decode step over every slot on the paged arena: apply the
+    step's COW forks first (per-row src -> dst block copies, sentinel
+    num_blocks = no-op), inject the host-owned block tables and row
+    positions, then run the same per-row step + sample chain as the
+    dense pool. Free rows step too (static shapes) — their tables
+    point at the trash block, so their writes land on junk no
+    horizon mask ever admits."""
+    flat = traverse_util.flatten_dict(unfreeze(cache))
+    block_size = next(leaf.shape[1] for path, leaf in flat.items()
+                      if path[-1] in _PAGED_DATA_LEAVES)
+    for path, leaf in flat.items():
+        name = path[-1]
+        if name in _PAGED_DATA_LEAVES:
+            nb = leaf.shape[0]
+            flat[path] = leaf.at[cow_dst].set(
+                leaf[jnp.clip(cow_src, 0, nb - 1)], mode="drop")
+    pos = jnp.minimum(row_pos, tables.shape[1] * block_size - 1)
+    for path in list(flat):
+        name = path[-1]
+        if name in ("cache_index", "pos_index"):
+            flat[path] = pos
+        elif name == "block_table":
+            flat[path] = tables
+    outputs, updated = model.apply(
+        {"params": params,
+         "cache": traverse_util.unflatten_dict(flat)},
+        tok[:, None], train=False, mutable=["cache"])
+    raw = _logits_of(outputs)[:, 0]
+    nxt, lp, rngs = _slot_sample(raw, seen, temps, top_ks, top_ps,
+                                 min_ps, rep_pens, rngs)
+    seen = seen.at[jnp.arange(nxt.shape[0]), nxt].set(True)
+    return (updated["cache"], row_pos + active.astype(jnp.int32),
+            seen, rngs, nxt, lp)
+
+
 class SlotDecodeEngine:
     """Persistent decode slot pool with in-flight admission.
 
@@ -1106,9 +1480,27 @@ class SlotDecodeEngine:
     Requires a dense KV cache (``attention_window == 0``): a reused
     ring slot's stale position metadata could leak stale keys into a
     rewound row's window, so windowed models stay on the batch path.
+
+    **Paged mode** (default; ``CEA_TPU_PAGED_KV=0`` or ``paged=False``
+    restores the dense pool bit-for-bit): the per-slot cache rows
+    become ONE [num_blocks, block_size, H, D] arena per layer with
+    per-row block tables. A row holds blocks for its USED tokens
+    only, admission is gated on block availability (worst-case
+    remaining growth is *reserved*, so mid-generation allocation
+    never fails — exhaustion queues admissions instead), and prompt
+    prefixes resident in the pool are shared: admission looks the
+    prompt up in a content-keyed prefix index, maps matching full
+    blocks refcounted, copy-on-write-forks the partial boundary
+    block, and prefills ONLY the unshared suffix (the shared span's
+    FLOPs are skipped). ``max_new`` at ``admit`` bounds the
+    reservation; ``pin_prefix`` keeps a system prompt's blocks
+    permanently resident. Program set: one prefill program per
+    admission width + one insert + one step — the dense pool's bound.
     """
 
-    def __init__(self, model, params, slots, slot_len):
+    def __init__(self, model, params, slots, slot_len, *, paged=None,
+                 kv_block_size=None, kv_blocks=None, buckets=None,
+                 pin_reserve_tokens=0):
         if getattr(model, "attention_window", 0):
             raise ValueError(
                 "SlotDecodeEngine requires a dense cache "
@@ -1143,10 +1535,50 @@ class SlotDecodeEngine:
                 for p in leaves)
         else:
             self.active_param_count = self.param_count
-        self._step_model = _decode_clone(model).clone(
-            per_row_index=True)
         self.slots = int(slots)
         self.slot_len = int(slot_len)
+        self.paged = (paged_kv_enabled() if paged is None
+                      else bool(paged))
+        if self.paged:
+            bs = int(kv_block_size
+                     or os.environ.get(KV_BLOCK_ENV) or 16)
+            if bs < 1:
+                raise ValueError(f"kv_block_size must be >= 1: {bs}")
+            self._block_size = bs
+            self._n_blk = -(-self.slot_len // bs)
+            nb = kv_blocks or os.environ.get(KV_BLOCKS_ENV)
+            # Default arena = the dense pool's exact KV byte budget
+            # (+1 trash block): sharing then goes strictly further
+            # than dense at equal HBM — the occupancy bench's claim.
+            # pin_reserve_tokens (a prefix the caller will pin_prefix)
+            # adds its block span on top: pinned blocks are
+            # permanently resident, and without the reserve a
+            # worst-case row on a small pool could NEVER admit — a
+            # queued-forever wedge, not the transient queueing
+            # exhaustion is supposed to mean.
+            pin_blocks = -(-int(pin_reserve_tokens) // bs)
+            nb = (int(nb) if nb
+                  else self.slots * self._n_blk + pin_blocks + 1)
+            if nb < self._n_blk + 1:
+                raise ValueError(
+                    f"kv_blocks {nb} cannot hold even one full row "
+                    f"({self._n_blk} blocks) plus the trash block")
+            self._num_blocks = nb
+            self._trash = nb - 1
+            self._pool = _BlockPool(nb, bs)
+            self._tables = np.full((self.slots, self._n_blk),
+                                   self._trash, np.int32)
+            self._slot_blocks = [[] for _ in range(self.slots)]
+            self._committed_slot = np.zeros((self.slots,), np.int64)
+            self._pos_host = np.zeros((self.slots,), np.int64)
+            self._pinned = []
+            self._buckets = (sorted({int(b) for b in buckets})
+                             if buckets else None)
+            self._step_model = _decode_clone(model).clone(
+                per_row_index=True, kv_pages=(nb, bs))
+        else:
+            self._step_model = _decode_clone(model).clone(
+                per_row_index=True)
         self._cache = _slot_cache_init(self._step_model, self.slots,
                                        self.slot_len)
         self._row_pos = jnp.zeros((self.slots,), jnp.int32)
@@ -1190,32 +1622,317 @@ class SlotDecodeEngine:
     def score(self, tokens, prompt_len):
         """Prompt echo logprobs only (the max_new_tokens=0 scoring
         mode): rides the same per-bucket prefill program, consumes no
-        slot. Returns a [len(tokens)] f32 array (entry 0 = 0.0);
+        slot (and, paged, no blocks — scoring never touches the
+        arena). Returns a [>= prompt_len] f32 array (entry 0 = 0.0);
         entries at and beyond prompt_len are padding scratch."""
+        if self.paged:
+            _, _, _, echo, _, _ = self._paged_prefill(
+                np.asarray(tokens, np.int32).reshape(-1)[:prompt_len],
+                0, np.full((self._n_blk,), self._trash, np.int32),
+                0.0, 0, 1.0, 0.0, 1.0, 0)
+            return np.asarray(echo)
         _, _, _, echo, _, _ = self._prefill(
             tokens, prompt_len, 0.0, 0, 1.0, 0.0, 1.0, 0)
         return np.asarray(echo)
 
+    # ----- paged-pool internals --------------------------------------
+
+    def _pick_width(self, suffix_len, shared_len):
+        """Admission prefill width: the smallest configured bucket
+        that holds the suffix AND fits the dense prefill cache after
+        the shared offset; exact width when none does (its program
+        compiles on first use — off the warmed path, so only exotic
+        share geometries pay it)."""
+        for b in (self._buckets or ()):
+            if b >= suffix_len and shared_len + b <= self.slot_len:
+                return b
+        return suffix_len
+
+    def _paged_plan(self, tokens, prompt_len, max_new, allow_prefix,
+                    repetition_penalty, count=True):
+        """Admission plan: prefix-index lookup + block accounting.
+        ``needed`` counts what this admission must be able to claim:
+        its whole private span (prompt blocks beyond the shared
+        prefix + worst-case generation growth, reserved up front so
+        step-time allocation cannot fail) plus any shared blocks it
+        revives off the free list."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)[:prompt_len]
+        share = (allow_prefix and prompt_len >= 2
+                 and float(repetition_penalty) == 1.0)
+        if share:
+            shared, blocks, fork_src = self._pool.lookup(
+                toks, count=count)
+        else:
+            shared, blocks, fork_src = 0, [], None
+        if max_new is None:
+            max_new = self.slot_len - prompt_len
+        bs = self._block_size
+        total_span = -(-(prompt_len + int(max_new)) // bs)
+        private_total = total_span - len(blocks)
+        revived = sum(1 for b in blocks if self._pool.ref[b] == 0)
+        return {"tokens": toks, "shared": shared, "blocks": blocks,
+                "fork_src": fork_src, "total_span": total_span,
+                "private_total": private_total,
+                "needed": private_total + revived,
+                # ONE authority for lookup AND registration: a
+                # diverged copy in admit() could register blocks it
+                # never looked up (or vice versa).
+                "share_eligible": share}
+
+    def can_admit(self, tokens, prompt_len, max_new=None, *,
+                  allow_prefix=True, repetition_penalty=1.0):
+        """Whether ``admit`` with these arguments would succeed NOW.
+        Dense pool: a free slot suffices. Paged pool: additionally
+        the block budget (free minus other rows' reservations) must
+        cover the row's worst-case private span — the
+        block-availability-driven admission gate the serving loop
+        checks before popping its queue."""
+        if self.free_slots() == 0:
+            return False
+        if not self.paged:
+            return True
+        plan = self._paged_plan(tokens, prompt_len, max_new,
+                                allow_prefix, repetition_penalty,
+                                count=False)
+        return self._pool.available() >= plan["needed"]
+
+    def _paged_prefill(self, suffix, shared_len, prefix_table,
+                       temperature, top_k, top_p, min_p, rep_pen,
+                       seed):
+        width = self._pick_width(max(len(suffix), 1), shared_len)
+        row = np.zeros((width,), np.int32)
+        row[:len(suffix)] = suffix
+        self.prefills += 1
+        return _paged_prefill_impl(
+            self._base_model, self._params, self._cache,
+            jnp.asarray(prefix_table), jnp.asarray(row[None]),
+            jnp.asarray(shared_len, jnp.int32),
+            jnp.asarray(len(suffix), jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(min_p, jnp.float32),
+            jnp.asarray(rep_pen, jnp.float32),
+            jax.random.PRNGKey(seed), slot_len=self.slot_len)
+
+    def _take_commit(self, slot):
+        if self._committed_slot[slot] > 0:
+            self._committed_slot[slot] -= 1
+            self._pool.committed -= 1
+
+    def _paged_admit(self, slot, plan, prompt_len, temperature,
+                     top_k, top_p, min_p, repetition_penalty, seed):
+        pool, bs = self._pool, self._block_size
+        if pool.available() < plan["needed"]:
+            raise RuntimeError(
+                f"insufficient free KV blocks "
+                f"(need {plan['needed']}, "
+                f"available {pool.available()}); queue the admission")
+        toks, shared = plan["tokens"], plan["shared"]
+        fork_src = plan["fork_src"]
+        # Prefill the suffix against the resident prefix: full shared
+        # blocks by reference, the partial boundary block READ from
+        # its current owner (the fork copy happens at insert).
+        ptab = np.full((self._n_blk,), self._trash, np.int32)
+        ptab[:len(plan["blocks"])] = plan["blocks"]
+        if fork_src is not None:
+            ptab[len(plan["blocks"])] = fork_src
+        pre_cache, first, first_lp, echo, seen_row, rng_row = (
+            self._paged_prefill(toks[shared:], shared, ptab,
+                                temperature, top_k, top_p, min_p,
+                                repetition_penalty, seed))
+        # Map + allocate this row's blocks. Shared full blocks take a
+        # reference; the partial boundary block forks (COW — the row
+        # is about to write inside its span); the rest of the prompt
+        # span allocates fresh.
+        table_row = self._tables[slot]
+        slot_blocks = self._slot_blocks[slot]
+        for i, b in enumerate(plan["blocks"]):
+            pool.incref(b)
+            table_row[i] = b
+            slot_blocks.append(b)
+        cow_src = cow_dst = self._num_blocks  # drop sentinel
+        aligned_idx = shared // bs
+        if fork_src is not None:
+            dst = pool.alloc()
+            table_row[aligned_idx] = dst
+            slot_blocks.append(dst)
+            cow_src, cow_dst = fork_src, dst
+            fresh_from = aligned_idx + 1
+        else:
+            fresh_from = aligned_idx
+        last_idx = (prompt_len - 1) // bs
+        for bi in range(fresh_from, last_idx + 1):
+            b = pool.alloc()
+            table_row[bi] = b
+            slot_blocks.append(b)
+        remaining = plan["total_span"] - (last_idx + 1)
+        self._committed_slot[slot] = remaining
+        pool.committed += remaining
+        dest_per_pos = np.full((self.slot_len,), self._num_blocks,
+                               np.int32)
+        span = np.arange(shared, prompt_len)
+        dest_per_pos[span] = table_row[span // bs]
+        self._cache, self._row_pos, self._seen, self._rngs = (
+            _paged_insert_impl(
+                self._cache, self._row_pos, self._seen, self._rngs,
+                pre_cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(prompt_len, jnp.int32), seen_row,
+                rng_row, jnp.asarray(dest_per_pos),
+                jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(cow_dst, jnp.int32)))
+        if plan["share_eligible"]:
+            pool.register(toks, prompt_len, table_row)
+        self._pos_host[slot] = prompt_len
+        return first, first_lp, echo
+
+    def pin_prefix(self, tokens):
+        """Prefill a shared prompt prefix ONCE into permanently-held
+        arena blocks and register it in the prefix index: every later
+        admission whose prompt starts with it maps the blocks and
+        prefills only its own suffix (the engine-mode system-prompt
+        serving path). Consumes no slot; blocks stay resident for the
+        engine's lifetime. Call from the engine's owning thread
+        before the step loop starts. Returns the pinned block
+        count."""
+        if not self.paged:
+            raise ValueError("pin_prefix requires the paged KV pool "
+                             f"({PAGED_KV_ENV}=1)")
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        plen = int(toks.size)
+        if not 1 <= plen <= self.slot_len - 1:
+            raise ValueError(
+                f"prefix length {plen} must be in "
+                f"1..{self.slot_len - 1}")
+        bs = self._block_size
+        n_need = -(-plen // bs)
+        if self._pool.available() < n_need:
+            raise RuntimeError(
+                f"insufficient free KV blocks to pin a "
+                f"{n_need}-block prefix")
+        if self._pool.usable - n_need < self._n_blk:
+            # Pinned blocks are permanently resident: if what remains
+            # cannot hold one worst-case (unshared, full-span) row,
+            # the first such request would queue FOREVER — an
+            # operator-sized CEA_TPU_KV_BLOCKS pool must fail loudly
+            # at construction instead (the default sizing reserves
+            # the pin via pin_reserve_tokens and never hits this).
+            raise ValueError(
+                f"kv_blocks too small for a pinned "
+                f"{n_need}-block prefix plus one worst-case "
+                f"{self._n_blk}-block row; raise {KV_BLOCKS_ENV} to "
+                f">= {self._n_blk + n_need + 1}")
+        pre_cache, _, _, _, seen_row, rng_row = self._paged_prefill(
+            toks, 0, np.full((self._n_blk,), self._trash, np.int32),
+            0.0, 0, 1.0, 0.0, 1.0, 0)
+        blocks = [self._pool.alloc() for _ in range(n_need)]
+        dest_per_pos = np.full((self.slot_len,), self._num_blocks,
+                               np.int32)
+        span = np.arange(plen)
+        dest_per_pos[span] = np.asarray(blocks, np.int32)[span // bs]
+        sentinel = self._num_blocks
+        # slot = slots is out of bounds: the per-row state updates
+        # drop, so the pin touches ONLY arena blocks.
+        self._cache, self._row_pos, self._seen, self._rngs = (
+            _paged_insert_impl(
+                self._cache, self._row_pos, self._seen, self._rngs,
+                pre_cache, jnp.asarray(self.slots, jnp.int32),
+                jnp.asarray(plen, jnp.int32), seen_row, rng_row,
+                jnp.asarray(dest_per_pos),
+                jnp.asarray(sentinel, jnp.int32),
+                jnp.asarray(sentinel, jnp.int32)))
+        self._pool.register(toks, plen, blocks)
+        self._pinned.extend(blocks)
+        return n_need
+
+    def kv_block_stats(self):
+        """Block-pool telemetry (None on the dense pool): totals for
+        the gauges plus the /stats utilization and prefix-hit-rate
+        ratios."""
+        if not self.paged:
+            return None
+        pool = self._pool
+        used = pool.usable - pool.free_count()
+        return {
+            "kv_blocks_total": pool.usable,
+            "kv_blocks_free": pool.free_count(),
+            "kv_blocks_shared": pool.shared_count(),
+            "kv_block_size": pool.block_size,
+            "kv_block_utilization": (round(used / pool.usable, 4)
+                                     if pool.usable else None),
+            "prefix_lookups": pool.prefix_lookups,
+            "prefix_hits": pool.prefix_hits,
+            "prefix_hit_rate": (
+                round(pool.prefix_hits / pool.prefix_lookups, 4)
+                if pool.prefix_lookups else None),
+            "prefix_tokens_shared": pool.shared_tokens,
+        }
+
+    def reset_prefix_counters(self):
+        """Zero the prefix-sharing telemetry counters (no-op on the
+        dense pool). The serving layer calls this after warm-up so
+        the published hit rate describes real traffic only — prefix
+        servers' warm rows deliberately admit THROUGH the pinned
+        prefix and would otherwise inflate it."""
+        if self.paged:
+            self._pool.prefix_lookups = 0
+            self._pool.prefix_hits = 0
+            self._pool.shared_tokens = 0
+
+    def block_pool_state(self):
+        """Postmortem state provider: free-list/refcount/table
+        snapshot bundled by tpu_diagnose on a crash."""
+        if not self.paged:
+            return {"paged": False}
+        state = self._pool.state()
+        state["paged"] = True
+        state["pinned_blocks"] = len(self._pinned)
+        state["tables"] = {
+            int(s): [int(b) for b in self._tables[s]
+                     if b != self._trash]
+            for s in np.flatnonzero(self._active)[:32]}
+        state["committed_per_slot"] = {
+            int(s): int(self._committed_slot[s])
+            for s in np.flatnonzero(self._committed_slot)[:32]}
+        return state
+
     def admit(self, tokens, prompt_len, *, temperature=0.0, top_k=0,
-              top_p=1.0, min_p=0.0, repetition_penalty=1.0, seed=0):
-        """Prefill ``tokens`` (a bucket-padded [width] int row with
-        ``prompt_len`` true tokens) into a free slot. Returns
+              top_p=1.0, min_p=0.0, repetition_penalty=1.0, seed=0,
+              max_new=None, allow_prefix=True):
+        """Prefill ``tokens`` (a [>= prompt_len] int row — bucket-
+        padded on the dense pool, padding ignored on the paged pool)
+        into a free slot. Returns
         (slot, first_token, first_logprob, echo_logprobs). The first
         generated token is produced HERE — the next ``step`` yields
-        the second."""
+        the second.
+
+        Paged pool extras: ``max_new`` bounds the row's block
+        reservation (default: worst case to slot_len);
+        ``allow_prefix=False`` disables prefix-index sharing AND
+        registration for this row (warm-up traffic, and rows needing
+        full-prompt echo logprobs — a shared span's echo is never
+        computed). Raises RuntimeError when the block budget cannot
+        cover the row — callers queue and retry after a release."""
         free = np.flatnonzero(~self._active)
         if free.size == 0:
             raise RuntimeError("no free slot; release one first")
         slot = int(free[0])
-        pre_cache, first, first_lp, echo, seen_row, rng_row = (
-            self._prefill(tokens, prompt_len, temperature, top_k,
-                          top_p, min_p, repetition_penalty, seed))
-        self._cache, self._row_pos, self._seen, self._rngs = (
-            _slot_insert_impl(self._cache, self._row_pos, self._seen,
-                              self._rngs, pre_cache,
-                              jnp.asarray(slot, jnp.int32),
-                              jnp.asarray(prompt_len, jnp.int32),
-                              seen_row, rng_row))
+        if self.paged:
+            plan = self._paged_plan(tokens, prompt_len, max_new,
+                                    allow_prefix, repetition_penalty)
+            first, first_lp, echo = self._paged_admit(
+                slot, plan, prompt_len, temperature, top_k, top_p,
+                min_p, repetition_penalty, seed)
+        else:
+            pre_cache, first, first_lp, echo, seen_row, rng_row = (
+                self._prefill(tokens, prompt_len, temperature, top_k,
+                              top_p, min_p, repetition_penalty, seed))
+            self._cache, self._row_pos, self._seen, self._rngs = (
+                _slot_insert_impl(self._cache, self._row_pos,
+                                  self._seen, self._rngs, pre_cache,
+                                  jnp.asarray(slot, jnp.int32),
+                                  jnp.asarray(prompt_len, jnp.int32),
+                                  seen_row, rng_row))
         first_tok = int(first[0])
         self._tok[slot] = first_tok
         self._active[slot] = True
@@ -1226,6 +1943,41 @@ class SlotDecodeEngine:
         self._rep_pens[slot] = repetition_penalty
         return slot, first_tok, float(first_lp[0]), np.asarray(echo)
 
+    def _paged_prestep(self):
+        """Host-side block upkeep before a step: every active row is
+        about to WRITE at its current position — allocate the block
+        when the row just crossed a block boundary (reservation
+        accounting guarantees success), and copy-on-write-fork when
+        the write target is shared (refcount > 1: defensive — prompt-
+        block sharing never writes a shared block by construction,
+        but the invariant is cheap to enforce and keeps any future
+        sharing policy corruption-proof). Returns the step's
+        (cow_src, cow_dst) vectors."""
+        sentinel = self._num_blocks
+        cow_src = np.full((self.slots,), sentinel, np.int32)
+        cow_dst = np.full((self.slots,), sentinel, np.int32)
+        bs = self._block_size
+        for slot in np.flatnonzero(self._active):
+            wp = int(self._pos_host[slot])
+            if wp >= self.slot_len:
+                continue  # clamped row; its writes rewrite junk
+            bi = wp // bs
+            cur = int(self._tables[slot, bi])
+            if cur == self._trash:
+                b = self._pool.alloc()
+                self._tables[slot, bi] = b
+                self._slot_blocks[slot].append(b)
+                self._take_commit(slot)
+            elif self._pool.ref[cur] > 1:
+                dst = self._pool.alloc()
+                cow_src[slot], cow_dst[slot] = cur, dst
+                self._tables[slot, bi] = dst
+                self._slot_blocks[slot].remove(cur)
+                self._slot_blocks[slot].append(dst)
+                self._pool.decref(cur)
+                self._take_commit(slot)
+        return cow_src, cow_dst
+
     def step(self):
         """Advance EVERY slot one token (one compiled program call).
         Returns (tokens [slots] i32, logprobs [slots] f32) — entries
@@ -1233,14 +1985,28 @@ class SlotDecodeEngine:
         pool is empty."""
         if not self._active.any():
             return None
-        (self._cache, self._row_pos, self._seen, self._rngs, nxt,
-         lp) = _slot_step_impl(
-            self._step_model, self._params, self._cache,
-            self._row_pos, self._seen, self._rngs,
-            jnp.asarray(self._tok), jnp.asarray(self._active),
-            jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-            jnp.asarray(self._top_ps), jnp.asarray(self._min_ps),
-            jnp.asarray(self._rep_pens))
+        if self.paged:
+            cow_src, cow_dst = self._paged_prestep()
+            (self._cache, self._row_pos, self._seen, self._rngs, nxt,
+             lp) = _paged_step_impl(
+                self._step_model, self._params, self._cache,
+                self._row_pos, self._seen, self._rngs,
+                jnp.asarray(self._tok), jnp.asarray(self._active),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps), jnp.asarray(self._min_ps),
+                jnp.asarray(self._rep_pens),
+                jnp.asarray(self._tables), jnp.asarray(cow_src),
+                jnp.asarray(cow_dst))
+            self._pos_host += self._active
+        else:
+            (self._cache, self._row_pos, self._seen, self._rngs, nxt,
+             lp) = _slot_step_impl(
+                self._step_model, self._params, self._cache,
+                self._row_pos, self._seen, self._rngs,
+                jnp.asarray(self._tok), jnp.asarray(self._active),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps), jnp.asarray(self._min_ps),
+                jnp.asarray(self._rep_pens))
         toks = np.asarray(nxt)
         np.copyto(self._tok, toks, where=self._active)
         self.steps += 1
@@ -1253,7 +2019,23 @@ class SlotDecodeEngine:
         overwrites the whole row; per-row masks hide it meanwhile).
         Its sampling knobs reset to the no-op values — a lingering
         filtered row would keep _slot_sample's need-filters cond
-        (and its full-vocab sorts) firing for every later step."""
+        (and its full-vocab sorts) firing for every later step.
+
+        Paged pool: every block reference the row holds is dropped —
+        blocks whose refcount reaches zero return to the free list
+        (their prefix-index keys linger for revival until the block
+        is reused) — the row's table resets to the trash block, and
+        its unspent growth reservation is returned to the budget, so
+        a queued admission can land on the very next boundary."""
+        if self.paged and self._slot_blocks[slot]:
+            for b in self._slot_blocks[slot]:
+                self._pool.decref(b)
+            self._slot_blocks[slot] = []
+        if self.paged:
+            self._tables[slot, :] = self._trash
+            self._pool.committed -= int(self._committed_slot[slot])
+            self._committed_slot[slot] = 0
+            self._pos_host[slot] = 0
         self._active[slot] = False
         self._temps[slot] = 0.0
         self._top_ks[slot] = 0

@@ -143,6 +143,7 @@ class MoEBlock(nn.Module):
     chunk_attends_cache: bool = False
     ring_slack: int = 0
     per_row_index: bool = False
+    kv_pages: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -159,6 +160,7 @@ class MoEBlock(nn.Module):
                                     self.chunk_attends_cache),
                                 ring_slack=self.ring_slack,
                                 per_row_index=self.per_row_index,
+                                kv_pages=self.kv_pages,
                                 name="attn")(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h, aux = MoEMlp(num_experts=self.num_experts,
@@ -206,6 +208,9 @@ class MoETransformerLM(nn.Module):
     # Per-row cache positions for the continuous-batching slot engine
     # (see CausalSelfAttention.per_row_index; changes the cache tree).
     per_row_index: bool = False
+    # Paged KV block pool: (num_blocks, block_size) — see
+    # CausalSelfAttention.kv_pages; changes the cache tree.
+    kv_pages: Any = None
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -249,6 +254,7 @@ class MoETransformerLM(nn.Module):
                     chunk_attends_cache=self.chunk_attends_cache,
                     ring_slack=self.ring_slack,
                     per_row_index=self.per_row_index,
+                    kv_pages=self.kv_pages,
                     name=f"block{i}")(x)
                 aux_losses.append(aux)
             else:
@@ -264,6 +270,7 @@ class MoETransformerLM(nn.Module):
                           chunk_attends_cache=self.chunk_attends_cache,
                           ring_slack=self.ring_slack,
                           per_row_index=self.per_row_index,
+                          kv_pages=self.kv_pages,
                           name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,

@@ -193,6 +193,20 @@ class CausalSelfAttention(nn.Module):
     # Dense caches only (no sliding-window ring), single-token steps
     # after init.
     per_row_index: bool = False
+    # Paged KV cache (the slot engine's block pool): a
+    # (num_blocks, block_size) tuple replaces the per-row dense
+    # [B, S, H, D] cache with ONE global
+    # [num_blocks, block_size, H, D] arena per layer plus a
+    # [B, blocks_per_row] "block_table" cache variable mapping each
+    # row's logical block b to a physical arena block. Writes become
+    # (block, offset)-addressed scatters; attention gathers the row's
+    # blocks back through the table (the paged-gather tax
+    # tools/bench_decode.py --paged measures) and masks at the same
+    # per-row horizon, so junk in unallocated (trash-pointed) table
+    # tails is never attended. Requires per_row_index; block
+    # ownership/refcounts/copy-on-write live in the ENGINE — the
+    # module trusts the injected tables. Changes the cache TREE.
+    kv_pages: Any = None
 
     def _kv_heads(self):
         kv = self.num_kv_heads or self.num_heads
@@ -281,6 +295,11 @@ class CausalSelfAttention(nn.Module):
                 "per_row_index does not compose with "
                 "chunk_attends_cache (speculative verify chunks use "
                 "the shared scalar index)")
+        paged = self.kv_pages is not None
+        if paged and not self.per_row_index:
+            raise ValueError(
+                "kv_pages (paged KV cache) requires per_row_index "
+                "(the block table is per-row slot-engine state)")
         cache_dtype = jnp.int8 if quantized else k.dtype
         is_init = not self.has_variable("cache", "cached_key")
         # Sliding-window models keep a RING buffer of window slots
@@ -294,7 +313,23 @@ class CausalSelfAttention(nn.Module):
         # the ring length from the existing buffer instead.
         c_len = (min(k.shape[1], self.window + self.ring_slack)
                  if ring else k.shape[1])
-        cache_shape = k.shape[:1] + (c_len,) + k.shape[2:]
+        if paged:
+            # ONE global arena shared by every row; capacity is
+            # blocks, not rows — the engine's allocator decides which
+            # physical block backs each row's logical position.
+            num_blocks, block_size = (int(x) for x in self.kv_pages)
+            if num_blocks < 2 or block_size < 1:
+                raise ValueError(
+                    f"kv_pages needs num_blocks >= 2 and "
+                    f"block_size >= 1: {self.kv_pages}")
+            cache_shape = (num_blocks, block_size) + k.shape[2:]
+            blocks_per_row = -(-k.shape[1] // block_size)
+            block_table = self.variable(
+                "cache", "block_table",
+                lambda: jnp.full((k.shape[0], blocks_per_row),
+                                 num_blocks - 1, jnp.int32))
+        else:
+            cache_shape = k.shape[:1] + (c_len,) + k.shape[2:]
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
                                  cache_shape, cache_dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros,
@@ -334,6 +369,20 @@ class CausalSelfAttention(nn.Module):
                         "steps only after init (the slot engine "
                         "prefills through a scalar-index cache and "
                         "inserts)")
+                if paged:
+                    # (block, offset) addressing: row b writes at
+                    # physical block table[b, i//bs], offset i%bs.
+                    # Active rows own their write block exclusively
+                    # (engine refcount/COW invariant), so the scatter
+                    # has no meaningful collisions; free rows' tables
+                    # all point at the trash block, whose junk no
+                    # horizon mask ever admits.
+                    tbl = block_table.value
+                    bs = cached_k.value.shape[1]
+                    phys = tbl[jnp.arange(val.shape[0]),
+                               jnp.minimum(i // bs,
+                                           tbl.shape[1] - 1)]
+                    return buf.at[phys, i % bs].set(val[:, 0])
                 return buf.at[jnp.arange(val.shape[0]), i].set(
                     val[:, 0])
             if not ring:
@@ -429,6 +478,29 @@ class CausalSelfAttention(nn.Module):
         b, q_len, heads, d = q.shape
         kv_heads = k.shape[2]
         g = heads // kv_heads
+        if paged:
+            # Gather each row's blocks back through its table:
+            # [num_blocks, bs, ...] -> [B, n_blk, bs, ...] ->
+            # [B, n_blk*bs, ...]. Logical position p lives at
+            # (table[b, p//bs], p%bs), so the row-major reshape puts
+            # it back at index p — the per-row horizon mask below
+            # then applies unchanged. The materialized copy is the
+            # paged-gather tax (bench_decode --paged measures it).
+            tbl = block_table.value
+
+            def from_pages(arena):
+                gathered = arena[tbl]
+                return gathered.reshape((b, -1) + arena.shape[2:])
+
+            k_read = from_pages(cached_k.value)
+            v_read = from_pages(cached_v.value)
+            if quantized:
+                ks_read = from_pages(k_scale.value)
+                vs_read = from_pages(v_scale.value)
+        else:
+            k_read, v_read = cached_k.value, cached_v.value
+            if quantized:
+                ks_read, vs_read = k_scale.value, v_scale.value
         # Grouped form (g == 1 is plain MHA): queries reshape to
         # [B, Q, Hkv, G, D] and attend their KV head directly — no
         # repeated/materialized copy of the cache, which at decode
@@ -437,14 +509,14 @@ class CausalSelfAttention(nn.Module):
         # read; only the O(B*S*Hkv) score/prob scaling is extra.
         qg = q.reshape(b, q_len, kv_heads, g, d)
         scores = jnp.einsum(
-            "bqhgd,bkhd->bhgqk", qg, cached_k.value.astype(self.dtype),
+            "bqhgd,bkhd->bhgqk", qg, k_read.astype(self.dtype),
             preferred_element_type=jnp.float32) / jnp.sqrt(
                 jnp.asarray(d, jnp.float32))
         if quantized:
             # k_scale [B,S,Hkv,1] -> [B,Hkv,1,1,S] broadcast over
             # (group, query).
             scores = scores * jnp.transpose(
-                k_scale.value[..., 0], (0, 2, 1))[:, :, None, None, :]
+                ks_read[..., 0], (0, 2, 1))[:, :, None, None, :]
         # Queries in a multi-token chunk (one-shot prefill) sit at
         # positions i..i+Q-1; each attends causally to its own
         # prefix. Single-token decode (Q=1) reduces to k_pos <= i.
@@ -471,9 +543,9 @@ class CausalSelfAttention(nn.Module):
         probs = jax.nn.softmax(scores, axis=-1)
         if quantized:
             probs = probs * jnp.transpose(
-                v_scale.value[..., 0], (0, 2, 1))[:, :, None, None, :]
+                vs_read[..., 0], (0, 2, 1))[:, :, None, None, :]
         out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(self.dtype),
-                         cached_v.value.astype(self.dtype))
+                         v_read.astype(self.dtype))
         return out.reshape(b, q_len, heads, d)
 
 
@@ -494,6 +566,7 @@ class Block(nn.Module):
     chunk_attends_cache: bool = False
     ring_slack: int = 0
     per_row_index: bool = False
+    kv_pages: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -511,6 +584,7 @@ class Block(nn.Module):
                                     self.chunk_attends_cache),
                                 ring_slack=self.ring_slack,
                                 per_row_index=self.per_row_index,
+                                kv_pages=self.kv_pages,
                                 name="attn")(x)
         quant = self.weights == "int8"
         h = nn.LayerNorm(dtype=self.dtype)(x)
@@ -556,6 +630,9 @@ class TransformerLM(nn.Module):
     # Per-row cache positions for the continuous-batching slot engine
     # (see CausalSelfAttention.per_row_index; changes the cache tree).
     per_row_index: bool = False
+    # Paged KV block pool: (num_blocks, block_size) — see
+    # CausalSelfAttention.kv_pages; changes the cache tree.
+    kv_pages: Any = None
 
     @nn.compact
     def __call__(self, tokens, train=True):
@@ -598,6 +675,7 @@ class TransformerLM(nn.Module):
                       chunk_attends_cache=self.chunk_attends_cache,
                       ring_slack=self.ring_slack,
                       per_row_index=self.per_row_index,
+                      kv_pages=self.kv_pages,
                       name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # f32 logits: the xent kernel's numerics want full precision,

@@ -277,11 +277,12 @@ class _EngineWork:
                  "top_p", "min_p", "rep_pen", "eos_id", "want_lp",
                  "seed", "done", "stream_q", "ctx", "cancel", "slot",
                  "tokens", "lps", "score_only", "account",
-                 "submit_t", "last_tok_t")
+                 "submit_t", "last_tok_t", "no_prefix")
 
     def __init__(self, row, p_len, new, temperature, top_k, top_p,
                  min_p, rep_pen, eos_id, want_lp, seed, ctx,
-                 stream_q=None, score_only=False, account=True):
+                 stream_q=None, score_only=False, account=True,
+                 no_prefix=False):
         self.row = row
         self.p_len = p_len
         self.new = new
@@ -304,6 +305,12 @@ class _EngineWork:
         # account=False (warm-up's synthetic rows) keeps compile-time
         # TTFT out of the SLO telemetry, mirroring account_spec.
         self.account = account
+        # no_prefix=True (warm-up's synthetic rows) keeps warm
+        # traffic out of the paged pool's prefix index: warm rows of
+        # different buckets share leading zeros, and a prefix hit
+        # would compile a suffix-width program instead of the
+        # bucket-width program warm-up exists to build.
+        self.no_prefix = no_prefix
         self.submit_t = None    # stamped at admission-queue entry
         self.last_tok_t = None  # previous token's delivery time
 
@@ -372,6 +379,15 @@ class _EngineService:
                 getattr(devices[0], "device_kind", None)),
             chips=len(devices), publish_every=32)
         self._memory = get_monitor()
+        if getattr(engine, "paged", False):
+            # Block-pool flight-record state: a crash/OOM bundle
+            # (tpu_diagnose) then shows the tables and free list the
+            # allocator died with. Idempotent by name — one provider
+            # per process, last engine wins (servers are 1:1 with
+            # engines in practice).
+            from ..obs import postmortem
+            postmortem.register_state_provider(
+                "serving_kv_blocks", engine.block_pool_state)
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True)
         self._thread.start()
@@ -436,6 +452,9 @@ class _EngineService:
                     "violations": violations,
                 },
                 "decode_mfu": self._mfu.mfu(),
+                # Paged-pool surface (absent on the dense fallback):
+                # block occupancy + prefix sharing effectiveness.
+                **(eng.kv_block_stats() or {}),
             }
 
     def reset_counters(self):
@@ -454,6 +473,11 @@ class _EngineService:
             self._admitted = 0
             self._retired = 0
             self._slo_violations = {"ttft": 0, "tpot": 0}
+            # Prefix servers' warm rows admit THROUGH the pinned
+            # prefix (counted hits by design — they compile the real
+            # traffic shape); the published hit rate must describe
+            # real traffic only.
+            self._engine.reset_prefix_counters()
         self._ttft_hist.reset()
         self._tpot_hist.reset()
         self._mfu.reset()
@@ -514,6 +538,16 @@ class _EngineService:
             np.zeros((pad,), np.float32)])
         return (seq, lps)
 
+    @staticmethod
+    def _allow_prefix(work):
+        """Whether a row may share (and register) prompt-prefix
+        blocks: echo-logprob rows need the FULL prompt forward (a
+        shared span's echo is never computed), and warm rows must not
+        seed the index (see _EngineWork.no_prefix). Repetition-
+        penalty rows are excluded engine-side for the same
+        seen-token-visibility reason."""
+        return not (work.want_lp or work.no_prefix)
+
     def _record_slo(self, which, hist, threshold, seconds):
         hist.observe(seconds)
         if threshold is not None and seconds > threshold:
@@ -563,7 +597,9 @@ class _EngineService:
                     work.row, work.p_len,
                     temperature=work.temperature, top_k=work.top_k,
                     top_p=work.top_p, min_p=work.min_p,
-                    repetition_penalty=work.rep_pen, seed=work.seed)
+                    repetition_penalty=work.rep_pen, seed=work.seed,
+                    max_new=work.new,
+                    allow_prefix=self._allow_prefix(work))
         except Exception as e:
             log.exception("engine admission failed")
             self._finish(work, error=str(e))
@@ -601,15 +637,36 @@ class _EngineService:
             for slot, work in list(self._slot_work.items()):
                 if work.cancel.is_set():
                     self._finish(work, error="cancelled")
-            while self._pending and (self._engine.free_slots()
-                                     or self._pending[0].cancel.is_set()
-                                     or self._pending[0].score_only):
-                work = self._pending.pop(0)
-                if work.cancel.is_set():
-                    self._finish(work, error="cancelled")
+            # Admission is BLOCK-availability-driven on the paged
+            # pool (can_admit covers the slot check AND the KV block
+            # budget — the row's worst-case span must be reservable)
+            # and slot-count-driven on the dense fallback. FIFO:
+            # head-of-line waits rather than letting later small
+            # requests starve a big one.
+            while self._pending:
+                head = self._pending[0]
+                if head.cancel.is_set():
+                    self._pending.pop(0)
+                    self._finish(head, error="cancelled")
                     continue
-                self._admit(work)
+                if head.score_only:
+                    self._admit(self._pending.pop(0))
+                    continue
+                if not self._engine.can_admit(
+                        head.row, head.p_len, head.new,
+                        allow_prefix=self._allow_prefix(head),
+                        repetition_penalty=head.rep_pen):
+                    break
+                self._admit(self._pending.pop(0))
             if not self._slot_work:
+                if self._pending:
+                    # Head blocked on KV blocks with NOTHING active:
+                    # no step boundary will free anything, so only an
+                    # external event (cancel, stop) changes
+                    # admissibility — wait briefly instead of
+                    # busy-re-planning the head's admission (a full
+                    # prefix-index lookup) in a zero-sleep spin.
+                    self._stop.wait(0.05)
                 continue
             active = self._engine.active_count()
             parent = next((w.ctx for w in self._slot_work.values()
@@ -632,6 +689,15 @@ class _EngineService:
             obs.gauge("tpu_serving_slots_active", active)
             obs.gauge("tpu_serving_slots_free",
                       self._engine.slots - active)
+            kv = self._engine.kv_block_stats()
+            if kv is not None:
+                # Host-integer reads — no device sync rides on these.
+                obs.gauge("tpu_serving_kv_blocks_total",
+                          kv["kv_blocks_total"])
+                obs.gauge("tpu_serving_kv_blocks_free",
+                          kv["kv_blocks_free"])
+                obs.gauge("tpu_serving_kv_blocks_shared",
+                          kv["kv_blocks_shared"])
             # Decode MFU (2·N FLOPs per active row per step; N =
             # the ACTIVE param count, so MoE's unrouted experts
             # don't inflate the ratio) and the HBM watermark sample
@@ -1075,25 +1141,39 @@ class GenerationServer(_BaseServer):
     /stats reports the engine's `batch_occupancy_avg`,
     `slots_active`, and `queue_depth`.
 
+    **Paged KV block pool (engine default).** The engine's cache is
+    a global block arena with per-row block tables
+    (CEA_TPU_PAGED_KV=0 restores the dense per-slot pool): rows hold
+    blocks for their USED tokens only, admission is
+    block-availability-driven (`can_admit` — exhaustion queues,
+    never corrupts), and identical prompt prefixes share physical
+    blocks refcounted with copy-on-write forks. /stats adds
+    `kv_block_utilization` / `prefix_hit_rate`;
+    `tpu_serving_kv_blocks_*` gauges track the pool per step. See
+    docs/serving.md "Paged KV-cache block pool".
+
     **Batch mode (legacy path).** Servers configured with
-    ``speculative_k``, ``prefix_tokens``, or a sliding-window model
-    keep the run-to-completion cross-request batcher: one _Batcher
-    per (bucket, mode, effective top_k, logprobs, plain, filtered)
+    ``speculative_k`` or a sliding-window model keep the
+    run-to-completion cross-request batcher: one _Batcher per
+    (bucket, mode, effective top_k, logprobs, plain, filtered)
     actually seen, top_k quantized to a power-of-two grid, decode
     horizon always ``max_new_tokens``. Everything below about
-    speculation and prefix serving applies to that path.
+    speculation applies to that path.
 
-    ``prefix_tokens`` turns on system-prompt serving: the shared
-    prefix prefills ONE KV cache at construction
-    (models.decode.prefill_prefix) and every request's prompt is the
-    part AFTER it — per-request cost drops to suffix prefill +
-    generation, and responses carry suffix-relative sequences (the
-    prefix is never re-emitted). Requests needing prefix-token
-    visibility (repetition_penalty, logprobs) are rejected with 400.
-    The mode COMPOSES with speculative_k: the draft prefills the
-    same prefix into its own state at construction and default-knob
-    traffic rides speculative_decode_with_prefix (sliding-window
-    models refuse the combination at construction).
+    ``prefix_tokens`` turns on system-prompt serving: clients send
+    only the part AFTER the shared prefix and responses carry
+    suffix-relative sequences (the prefix is never re-emitted);
+    requests needing prefix-token visibility (repetition_penalty,
+    logprobs) are rejected with 400. With the paged pool the mode
+    rides the ENGINE: the prefix is pinned into shared arena blocks
+    at construction (SlotDecodeEngine.pin_prefix) and every
+    admission prefix-hits the block index, prefilling only its
+    suffix. With paged KV off — or combined with speculative_k —
+    the legacy path prefills ONE KV cache at construction
+    (models.decode.prefill_prefix) and the draft prefills the same
+    prefix into its own state, default-knob traffic riding
+    speculative_decode_with_prefix (sliding-window models refuse the
+    combination at construction).
     """
 
     def __init__(self, model_name, model, params, port=8500,
@@ -1221,8 +1301,20 @@ class GenerationServer(_BaseServer):
             {b for b in buckets if 1 <= b <= max_prompt})
         if not self._buckets:
             raise ValueError("no valid prompt-length buckets")
+        # Engine eligibility: plain LM servers always; prefix-serving
+        # servers ride the engine's prefix INDEX when the paged KV
+        # pool is on (the pinned system prompt's blocks are shared
+        # refcounted across rows and admission prefills only the
+        # client suffix) — the legacy fixed-horizon batcher shrinks
+        # to speculative/windowed configs only. CEA_TPU_PAGED_KV=0
+        # restores the legacy prefix path too.
+        from ..models.decode import paged_kv_enabled
+        self._prefix_arr = (prefix_arr if self._prefix_len else None)
+        engine_mode = not (
+            self._spec_k or getattr(model, "attention_window", 0)
+            or (self._prefix_len and not paged_kv_enabled()))
         self._draft_prefix_state = None
-        if self._prefix_len:
+        if self._prefix_len and not engine_mode:
             from ..models.decode import (
                 decode_with_prefix,
                 prefill_prefix,
@@ -1253,26 +1345,34 @@ class GenerationServer(_BaseServer):
                 self._draft_prefix_state = prefill_prefix(
                     draft_model, draft_params, prefix_arr[None, :],
                     max_total_len=min(want, draft_model.max_seq_len))
-        # Continuous batching: plain LM servers decode on the slot
-        # engine (one pool, in-flight admission, EOS slot recycling).
-        # Speculation, prefix serving, and sliding-window models keep
-        # the run-to-completion batch path below — their decode
-        # programs are structurally whole-horizon (spec verify
-        # rounds, shared-prefix fan-out) or need ring-cache metadata
-        # the pool's rewind would corrupt.
+        # Continuous batching: plain LM servers — and, with the paged
+        # KV pool, prefix-serving servers — decode on the slot engine
+        # (one pool, in-flight admission, EOS slot recycling, block-
+        # availability-driven admission). Speculation and
+        # sliding-window models keep the run-to-completion batch path
+        # below — their decode programs are structurally
+        # whole-horizon (spec verify rounds) or need ring-cache
+        # metadata the pool's rewind would corrupt.
         self._engine_service = None
-        if not (self._spec_k or self._prefix_len
-                or getattr(model, "attention_window", 0)):
+        if engine_mode:
             from ..models.decode import SlotDecodeEngine
             # Before the FIRST compile (the pool-cache init below) so
             # warm=False servers honor the env var too, not only the
             # warm-up path.
             _maybe_enable_compile_cache()
-            self._engine_service = _EngineService(
-                SlotDecodeEngine(
-                    model, params, max_batch,
-                    self._buckets[-1] + max_new_tokens),
-                self._admission)
+            engine = SlotDecodeEngine(
+                model, params, max_batch,
+                self._prefix_len + self._buckets[-1] + max_new_tokens,
+                buckets=self._buckets,
+                pin_reserve_tokens=self._prefix_len)
+            if self._prefix_len:
+                # Pin the system prompt's blocks before the loop
+                # thread exists (engine methods are single-threaded
+                # by contract); every admission then prefix-hits and
+                # prefills only its suffix.
+                engine.pin_prefix(self._prefix_arr)
+            self._engine_service = _EngineService(engine,
+                                                  self._admission)
         # Cross-request batching (legacy batch mode): one _Batcher
         # per (bucket, sampling mode, effective top_k) — rows from
         # concurrent requests with the same key share one decode
@@ -1332,10 +1432,32 @@ class GenerationServer(_BaseServer):
         _maybe_enable_compile_cache()
         if self._engine_service is not None:
             for b in self._buckets:
-                work = _EngineWork(
-                    np.zeros((b,), np.int32), b,
-                    min(2, self._max_new), 0.0, 0, 1.0, 0.0, 1.0,
-                    -1, False, 0, None, account=False)
+                if self._prefix_len:
+                    # Prefix servers warm THROUGH the pinned prefix
+                    # (the real traffic shape: prefix-hit + suffix-
+                    # bucket prefill). Suffix content is distinct per
+                    # bucket so one warm row's registered blocks can
+                    # never prefix-match a later warm row and shrink
+                    # its compiled width.
+                    suffix = ((b + np.arange(b))
+                              % self._model.vocab_size)
+                    row = np.concatenate(
+                        [self._prefix_arr,
+                         suffix.astype(np.int32)])
+                    work = _EngineWork(
+                        row, self._prefix_len + b,
+                        min(2, self._max_new), 0.0, 0, 1.0, 0.0,
+                        1.0, -1, False, 0, None, account=False)
+                else:
+                    # no_prefix: warm zeros of different buckets
+                    # share leading tokens; an index hit would
+                    # compile a suffix-width program instead of this
+                    # bucket's.
+                    work = _EngineWork(
+                        np.zeros((b,), np.int32), b,
+                        min(2, self._max_new), 0.0, 0, 1.0, 0.0, 1.0,
+                        -1, False, 0, None, account=False,
+                        no_prefix=True)
                 if self._engine_service.submit_many([work]) is None:
                     raise RuntimeError(
                         "warm-up shed by admission control")
@@ -2094,6 +2216,17 @@ class GenerationServer(_BaseServer):
             seed = self._seed + 1
             self._seed += len(p_lens)
         ctx = obs.TRACER.current_context()
+        if self._prefix_len:
+            # Engine-mode system-prompt serving: the work rows carry
+            # prefix + client suffix; the engine's prefix index maps
+            # the pinned prefix blocks and prefills only the suffix.
+            # Responses stay suffix-relative (stripped below).
+            rows = [np.concatenate([self._prefix_arr,
+                                    row[:pl].astype(np.int32)])
+                    for row, pl in zip(padded, p_lens)]
+            row_lens = [self._prefix_len + int(pl) for pl in p_lens]
+        else:
+            rows, row_lens = list(padded), [int(pl) for pl in p_lens]
         if stream:
             if padded.shape[0] != 1:
                 return 400, {"error": "stream requires exactly one "
@@ -2102,7 +2235,7 @@ class GenerationServer(_BaseServer):
                 return 400, {"error": "stream requires "
                                       "max_new_tokens >= 1"}
             stream_q = queue.Queue()
-            work = _EngineWork(padded[0], int(p_lens[0]), new,
+            work = _EngineWork(rows[0], row_lens[0], new,
                                temperature, top_k, top_p, min_p,
                                rep_pen, eos_id, False, seed, ctx,
                                stream_q=stream_q)
@@ -2119,10 +2252,10 @@ class GenerationServer(_BaseServer):
                 self._engine_stream(work, decode_text, eos_id),
                 work.cancel.set)
         works = [
-            _EngineWork(row, int(pl), new, temperature, top_k, top_p,
+            _EngineWork(row, pl, new, temperature, top_k, top_p,
                         min_p, rep_pen, eos_id, want_lp, seed + i,
                         ctx, score_only=(new == 0))
-            for i, (row, pl) in enumerate(zip(padded, p_lens))]
+            for i, (row, pl) in enumerate(zip(rows, row_lens))]
         with obs.span("serving.admission", bucket=padded.shape[1],
                       rows=len(works)) as adm:
             if self._engine_service.submit_many(works) is None:
@@ -2130,7 +2263,7 @@ class GenerationServer(_BaseServer):
                 with self._stats_lock:
                     self._shed += 1
                 return 503, {"error": "server overloaded; retry"}
-        rows = []
+        results = []
         with obs.span("serving.wait", rows=len(works)):
             for work in works:
                 try:
@@ -2139,8 +2272,13 @@ class GenerationServer(_BaseServer):
                     return 500, {"error": "decode timed out"}
                 if status != "ok":
                     return 500, {"error": out}
-                rows.append(out)
-        return 200, self._compose_response(rows, p_lens, new,
+                results.append(out)
+        if self._prefix_len:
+            # Suffix-relative responses: the shared prefix is never
+            # re-emitted (the prefix-serving contract).
+            results = [np.asarray(r)[self._prefix_len:]
+                       for r in results]
+        return 200, self._compose_response(results, p_lens, new,
                                            want_lp, texts, eos_id)
 
     def _engine_stream(self, work, decode_text, eos_id):

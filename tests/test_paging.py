@@ -1,0 +1,361 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Paged KV-cache block pool (SlotDecodeEngine paged mode).
+
+The paged pool's correctness contract stacks on the engine's: greedy
+streams stay token-identical to per-request ``decode`` WHILE the
+physical cache is block-scattered, prefix-shared, and copy-on-write
+forked under the rows. These tests drive the engine directly on
+tier-1-sized models; the serving loop's paged behavior rides
+test_serving.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import (
+    MoETransformerLM,
+    TransformerLM,
+)
+from container_engine_accelerators_tpu.models.decode import (
+    SlotDecodeEngine,
+    _paged_insert_impl,
+    _paged_step_impl,
+    decode,
+    greedy_decode,
+)
+
+
+def _make_lm(**kw):
+    kwargs = dict(vocab_size=48, embed_dim=32, num_layers=2,
+                  num_heads=4, max_seq_len=32, dtype=jnp.float32)
+    kwargs.update(kw)
+    model = TransformerLM(**kwargs)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _make_lm()
+
+
+def _paged(model, params, slots=3, slot_len=14, block_size=4,
+           **kw):
+    return SlotDecodeEngine(model, params, slots=slots,
+                            slot_len=slot_len, paged=True,
+                            kv_block_size=block_size, **kw)
+
+
+def _pool_is_clean(eng):
+    """Refcount exactness: every non-pinned block free, no shared
+    blocks, no outstanding commitment, every table row all-trash."""
+    pool = eng._pool
+    pinned = set(eng._pinned)
+    return (pool.free_count() == pool.usable - len(pinned)
+            and pool.shared_count() == 0
+            and pool.committed == 0
+            and bool((eng._tables == eng._trash).all())
+            and int(np.abs(pool.ref).sum()) == len(pinned))
+
+
+def test_staggered_shared_prefix_matches_decode(lm):
+    """Three rows admitted at different steps, two sharing a long
+    prompt prefix (full blocks refcounted + the partial boundary
+    block COW-forked): every greedy stream is exactly its per-request
+    decode() stream, and the prefix index actually hit."""
+    model, params = lm
+    eng = _paged(model, params, slots=3, slot_len=16)
+    base = np.array([5, 6, 7, 8, 9, 10], np.int32)       # plen 6
+    other = np.array([20, 21, 22, 23, 24, 25], np.int32)
+
+    s1, f1, _, _ = eng.admit(base, 6)
+    o1 = [f1]
+    for _ in range(2):
+        toks, _ = eng.step()
+        o1.append(int(toks[s1]))
+    s2, f2, _, _ = eng.admit(base, 6)   # prefix hit: 1 full + 1 fork
+    s3, f3, _, _ = eng.admit(other, 6)  # no hit
+    assert eng.kv_block_stats()["prefix_hits"] == 1
+    assert eng.kv_block_stats()["kv_blocks_shared"] >= 1
+    o2, o3 = [f2], [f3]
+    for _ in range(4):
+        toks, _ = eng.step()
+        o1.append(int(toks[s1]))
+        o2.append(int(toks[s2]))
+        o3.append(int(toks[s3]))
+    refs = np.asarray(greedy_decode(
+        model, params, jnp.asarray(np.stack([base, base, other])), 7))
+    assert o1 == refs[0, 6:13].tolist()
+    assert o2 == refs[1, 6:11].tolist()
+    assert o3 == refs[2, 6:11].tolist()
+    for s in (s1, s2, s3):
+        eng.release(s)
+    assert _pool_is_clean(eng)
+
+
+def test_cow_isolation_between_forked_rows(lm):
+    """Two rows forked from one shared prefix never see each other's
+    writes: both decode independently past the fork point and match
+    their OWN per-request references, including the donor, which
+    keeps writing generated K/V into the partial block it donated."""
+    model, params = lm
+    eng = _paged(model, params, slots=2, slot_len=16)
+    shared = np.array([3, 1, 4, 1, 5, 9], np.int32)       # plen 6
+    sa = np.concatenate([shared, [11]]).astype(np.int32)  # plen 7
+    sb = np.concatenate([shared, [17]]).astype(np.int32)  # plen 7
+
+    slot_a, fa, _, _ = eng.admit(sa, 7)
+    # The donor writes generated tokens INTO its partial prompt block
+    # before the second row forks it.
+    oa = [fa]
+    toks, _ = eng.step()
+    oa.append(int(toks[slot_a]))
+    slot_b, fb, _, _ = eng.admit(sb, 7)   # forks the partial block
+    assert eng.kv_block_stats()["prefix_hits"] == 1
+    ob = [fb]
+    for _ in range(4):
+        toks, _ = eng.step()
+        oa.append(int(toks[slot_a]))
+        ob.append(int(toks[slot_b]))
+    ref = np.asarray(greedy_decode(
+        model, params, jnp.asarray(np.stack([sa, sb])), 6))
+    assert oa == ref[0, 7:13].tolist()
+    assert ob == ref[1, 7:12].tolist()
+    eng.release(slot_a)
+    eng.release(slot_b)
+    assert _pool_is_clean(eng)
+
+
+def test_refcounts_exact_across_recycling_and_cancel(lm):
+    """EOS-style retirement and mid-stream cancel (both are
+    release()) drop every block reference exactly once: after any
+    admission/release interleaving the pool returns to all-free with
+    zero refcounts — no leak, no double free."""
+    model, params = lm
+    eng = _paged(model, params, slots=2, slot_len=16)
+    shared = np.array([2, 4, 6, 8, 10, 12], np.int32)
+    s1, _, _, _ = eng.admit(shared, 6)
+    s2, _, _, _ = eng.admit(shared, 6)            # shares s1's blocks
+    eng.step()
+    eng.release(s1)                               # donor retires first
+    # The survivor's shared blocks stay resident (ref 1, not freed).
+    assert eng.kv_block_stats()["kv_blocks_free"] < eng._pool.usable
+    eng.step()                                    # survivor still live
+    s3, _, _, _ = eng.admit(shared, 6)            # revives/shares again
+    eng.step()
+    eng.release(s3)                               # "cancel" mid-stream
+    eng.release(s2)
+    assert _pool_is_clean(eng)
+    # Freed-but-indexed blocks revive: a fresh admission of the same
+    # prompt still hits the index without any resident row.
+    before = eng.kv_block_stats()["prefix_hits"]
+    s4, _, _, _ = eng.admit(shared, 6)
+    assert eng.kv_block_stats()["prefix_hits"] == before + 1
+    eng.release(s4)
+    assert _pool_is_clean(eng)
+
+
+def test_exhaustion_queues_admission_without_corruption(lm):
+    """A pool too small for another row refuses admission
+    (can_admit False, admit raises) and the resident rows' tables
+    stay intact: their streams stay exact through the refusal, and
+    after a release the queued admission lands and is exact too."""
+    model, params = lm
+    # 2 slots but only one row's worth of blocks (+trash): the
+    # second admission must queue on BLOCKS, not slots.
+    eng = _paged(model, params, slots=2, slot_len=12,
+                 block_size=4, kv_blocks=4)
+    pa = np.array([1, 2, 3, 4], np.int32)
+    pb = np.array([9, 8, 7, 6], np.int32)
+    slot_a, fa, _, _ = eng.admit(pa, 4, max_new=8)
+    assert eng.free_slots() == 1
+    assert not eng.can_admit(pb, 4, 8)
+    with pytest.raises(RuntimeError, match="KV block"):
+        eng.admit(pb, 4, max_new=8)
+    oa = [fa]
+    for _ in range(5):
+        toks, _ = eng.step()
+        oa.append(int(toks[slot_a]))
+    ref_a = np.asarray(greedy_decode(
+        model, params, jnp.asarray(pa[None]), 6))[0]
+    assert oa == ref_a[4:10].tolist()
+    eng.release(slot_a)
+    assert eng.can_admit(pb, 4, 8)
+    slot_b, fb, _, _ = eng.admit(pb, 4, max_new=8)
+    ob = [fb]
+    for _ in range(5):
+        toks, _ = eng.step()
+        ob.append(int(toks[slot_b]))
+    ref_b = np.asarray(greedy_decode(
+        model, params, jnp.asarray(pb[None]), 6))[0]
+    assert ob == ref_b[4:10].tolist()
+    eng.release(slot_b)
+    assert _pool_is_clean(eng)
+
+
+def test_dense_fallback_parity(lm, monkeypatch):
+    """CEA_TPU_PAGED_KV=0 restores the dense pool bit-for-bit: same
+    slots, same stream, no paged state; and the env default is paged
+    when unset."""
+    model, params = lm
+    prompt = np.array([1, 2, 3, 4], np.int32)
+    monkeypatch.setenv("CEA_TPU_PAGED_KV", "0")
+    dense = SlotDecodeEngine(model, params, slots=2, slot_len=14)
+    assert not dense.paged
+    assert dense.kv_block_stats() is None
+    monkeypatch.delenv("CEA_TPU_PAGED_KV")
+    paged = SlotDecodeEngine(model, params, slots=2, slot_len=14,
+                             kv_block_size=4)
+    assert paged.paged
+    outs = []
+    for eng in (dense, paged):
+        slot, first, _, _ = eng.admit(prompt, 4)
+        out = [first]
+        for _ in range(5):
+            toks, _ = eng.step()
+            out.append(int(toks[slot]))
+        eng.release(slot)
+        outs.append(out)
+    assert outs[0] == outs[1]
+    ref = np.asarray(greedy_decode(
+        model, params, jnp.asarray(prompt[None]), 6))[0]
+    assert outs[0] == ref[4:10].tolist()
+
+
+def test_one_step_program_for_all_paged_traffic(lm):
+    """The PR 4 program-count bound holds on the paged pool: one
+    jitted step program serves every traffic mix (greedy + filtered
+    sampling + penalties + prefix-shared rows + COW forks + block-
+    boundary growth), and one insert program serves every
+    admission."""
+    model, params = lm
+    step0 = _paged_step_impl._cache_size()
+    ins0 = _paged_insert_impl._cache_size()
+    # A pool shape no other test uses: the jit caches are process-
+    # global, so a shape-colliding earlier test would hide compiles.
+    eng = _paged(model, params, slots=4, slot_len=16)
+    shared = np.array([4, 5, 6, 7, 8, 9], np.int32)
+    eng.admit(shared, 6)
+    eng.step()
+    eng.admit(shared, 6, temperature=0.9, top_k=7, top_p=0.9,
+              min_p=0.01, seed=3)
+    eng.admit(np.array([30, 31, 32], np.int32), 3,
+              repetition_penalty=1.5)
+    for _ in range(6):   # crosses block boundaries (bs=4)
+        eng.step()
+    assert _paged_step_impl._cache_size() - step0 == 1
+    assert _paged_insert_impl._cache_size() - ins0 == 1
+
+
+def test_pin_prefix_system_prompt_serving(lm):
+    """pin_prefix keeps a system prompt's blocks resident without a
+    slot; admissions prefix-hit it and their greedy streams equal
+    decode(prefix + suffix); releasing every row leaves exactly the
+    pinned blocks held."""
+    model, params = lm
+    eng = _paged(model, params, slots=2, slot_len=20,
+                 buckets=[4], pin_reserve_tokens=6)
+    prefix = np.array([7, 11, 13, 17, 19, 23], np.int32)  # 6 tokens
+    pinned = eng.pin_prefix(prefix)
+    assert pinned == 2                                    # bs=4
+    # The default arena reserved the pin's span on top of the rows'
+    # worst case, so even a full pool of worst-case rows can admit
+    # (the review-caught 1-slot wedge: pinned blocks ate the only
+    # row's budget and the queue waited forever).
+    worst = np.concatenate([prefix, np.array([1, 2, 3, 4], np.int32)])
+    assert eng.can_admit(worst, 10, eng.slot_len - 10)
+    suffix = np.array([1, 2, 3], np.int32)
+    full = np.concatenate([prefix, suffix])
+    slot, first, _, _ = eng.admit(full, 9)
+    assert eng.kv_block_stats()["prefix_hits"] == 1
+    out = [first]
+    for _ in range(4):
+        toks, _ = eng.step()
+        out.append(int(toks[slot]))
+    ref = np.asarray(greedy_decode(
+        model, params, jnp.asarray(full[None]), 5))[0]
+    assert out == ref[9:14].tolist()
+    eng.release(slot)
+    assert _pool_is_clean(eng)
+    assert eng.kv_block_stats()["kv_blocks_free"] == (
+        eng._pool.usable - pinned)
+
+
+def test_paged_moe_and_int8_cache(lm):
+    """The block pool composes with the MoE family and the int8 KV
+    cache (quantized arena + scale blocks): greedy streams stay
+    exact against per-request decode."""
+    del lm
+    for model, params in (
+            (lambda m: (m, m.init(jax.random.PRNGKey(1),
+                                  jnp.zeros((1, 8), jnp.int32))
+                        ["params"]))(MoETransformerLM(
+                            vocab_size=48, embed_dim=32,
+                            num_layers=2, num_heads=4,
+                            num_experts=2, max_seq_len=32,
+                            dtype=jnp.float32)),
+            _make_lm(kv_cache_dtype="int8", pos_embedding="rope")):
+        eng = _paged(model, params, slots=2, slot_len=14)
+        shared = np.array([5, 6, 7, 8, 9], np.int32)
+        s1, f1, _, _ = eng.admit(shared, 5)
+        s2, f2, _, _ = eng.admit(shared, 5)
+        assert eng.kv_block_stats()["prefix_hits"] == 1
+        o1, o2 = [f1], [f2]
+        for _ in range(4):
+            toks, _ = eng.step()
+            o1.append(int(toks[s1]))
+            o2.append(int(toks[s2]))
+        ref = np.asarray(greedy_decode(
+            model, params, jnp.asarray(shared[None]), 5))[0]
+        assert o1 == ref[5:10].tolist()
+        assert o2 == ref[5:10].tolist()
+        eng.release(s1)
+        eng.release(s2)
+        assert _pool_is_clean(eng)
+
+
+def test_paged_score_and_logprobs_consume_no_blocks(lm):
+    """Scoring rides the prefill program only — no slot, no blocks —
+    and matches decode's echo; an admission needing full echo
+    (allow_prefix=False) skips sharing and still matches."""
+    model, params = lm
+    eng = _paged(model, params, slots=1, slot_len=14)
+    prompt = np.array([2, 4, 6, 8], np.int32)
+    echo = eng.score(prompt, 4)
+    assert eng.free_slots() == 1
+    assert _pool_is_clean(eng)
+    _, lps_ref = decode(model, params, jnp.asarray(prompt[None]), 1,
+                        return_logprobs=True)
+    np.testing.assert_allclose(echo[:4], np.asarray(lps_ref)[0][:4],
+                               atol=1e-4)
+    # Echo-bearing admission after an identical prompt is resident:
+    # sharing must NOT eat the echo region.
+    slot, _, _, _ = eng.admit(prompt, 4)
+    eng.release(slot)
+    slot, tok0, lp0, echo2 = eng.admit(prompt, 4,
+                                       allow_prefix=False)
+    lps = list(echo2[:4]) + [lp0]
+    for _ in range(3):
+        _, lp = eng.step()
+        lps.append(float(lp[slot]))
+    _, ref = decode(model, params, jnp.asarray(prompt[None]), 5,
+                    return_logprobs=True)
+    np.testing.assert_allclose(np.asarray(lps),
+                               np.asarray(ref)[0][:8], atol=1e-4)
+    eng.release(slot)

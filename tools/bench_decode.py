@@ -108,7 +108,20 @@ def main(argv=None):
                         "the per-step dispatch tax the engine pays "
                         "for in-flight admission vs the one-shot "
                         "compiled scan")
+    p.add_argument("--paged", action="store_true",
+                   help="with --engine: use the paged KV block pool "
+                        "(block-table gather attention) instead of "
+                        "the dense per-slot pool — the row "
+                        "quantifies the per-step gather tax of "
+                        "block-addressed attention vs dense "
+                        "contiguous cache reads, next to the "
+                        "--engine row")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="paged-pool block size (with --paged)")
     args = p.parse_args(argv)
+    if args.paged and not args.engine:
+        p.error("--paged requires --engine (it is a slot-engine "
+                "pool layout)")
     if args.prefix_len and args.speculative_k:
         p.error("--prefix-len does not compose with --speculative-k")
     if args.stream_chunk and (args.speculative_k or args.prefix_len):
@@ -246,7 +259,9 @@ def main(argv=None):
             SlotDecodeEngine,
         )
 
-        engine_extra = {"engine": True}
+        engine_extra = {"engine": True, "paged": args.paged}
+        if args.paged:
+            engine_extra["kv_block_size"] = args.kv_block_size
         engines = {}
 
         def run(prompt):
@@ -255,8 +270,16 @@ def main(argv=None):
             if eng is None:
                 eng = engines[b] = SlotDecodeEngine(
                     model, params, b,
-                    args.prompt_len + args.new_tokens)
-            slots = [eng.admit(prompt[i], args.prompt_len)[0]
+                    args.prompt_len + args.new_tokens,
+                    paged=args.paged,
+                    kv_block_size=args.kv_block_size)
+            # allow_prefix=False: a repeat iteration would otherwise
+            # prefix-hit the previous iteration's freed blocks and
+            # swap in a 1-token-suffix prefill program mid-timing —
+            # this row measures the block-table GATHER tax, not
+            # sharing.
+            slots = [eng.admit(prompt[i], args.prompt_len,
+                               allow_prefix=False)[0]
                      for i in range(b)]
             last = None
             for _ in range(args.new_tokens - 1):

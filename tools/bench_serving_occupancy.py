@@ -48,6 +48,18 @@ drawn in the same unit. Metrics:
 ``--check`` exits non-zero unless engine goodput >= --check-factor x
 baseline goodput AND every greedy output matched its reference —
 the CI gate behind ``make occupancy-check`` (CPU fake backend).
+
+**Shared-prefix trace (``--paging-check``, ``make paging-check``).**
+A second Poisson trace where ``--shared-frac`` of requests open with
+one ``--shared-prefix-len``-token system prompt (the dominant
+millions-of-users traffic shape) replays through the PAGED block-pool
+engine and the dense per-slot pool at EQUAL KV HBM budget (the paged
+arena's usable blocks hold exactly the dense pool's bytes). The paged
+pool stores the shared prefix once, refcounted, and admits on block
+availability, so it sustains more concurrent rows from the same
+memory; the gate fails unless paged sustained rows/step >=
+--paging-factor x dense, prefix_hit_rate > 0, and every greedy
+stream (both pools) is bit-identical to per-request ``decode``.
 """
 
 import argparse
@@ -142,6 +154,149 @@ def run_engine(model, params, trace, args):
     }
 
 
+def build_shared_trace(args, rng):
+    """Poisson arrivals where --shared-frac of requests open with one
+    fixed --shared-prefix-len system prompt followed by a personal
+    suffix; the rest are fully random prompts of the same widths."""
+    pre_len = args.shared_prefix_len
+    prefix = rng.integers(1, args.vocab_size,
+                          size=(pre_len,)).astype(np.int32)
+    t = 0.0
+    trace = []
+    for _ in range(args.paging_requests):
+        t += rng.exponential(1.0 / args.paging_arrival_rate)
+        new = int(rng.integers(2, args.max_new + 1))
+        s_len = int(rng.integers(1, args.prompt_len + 1))
+        sfx = rng.integers(1, args.vocab_size,
+                           size=(s_len,)).astype(np.int32)
+        if rng.random() < args.shared_frac:
+            prompt = np.concatenate([prefix, sfx])
+        else:
+            prompt = rng.integers(
+                1, args.vocab_size,
+                size=(pre_len + s_len,)).astype(np.int32)
+        trace.append({"arrival": t, "p_len": int(prompt.size),
+                      "new": new, "prompt": prompt})
+    return trace
+
+
+def replay_pool(eng, trace):
+    """Replay ``trace`` through one SlotDecodeEngine (dense or
+    paged): admission is gated by the engine's own can_admit —
+    block-availability-driven on the paged pool, slot-driven on the
+    dense pool — with per-request max_new reservations. Returns
+    (outputs, metrics)."""
+    t = 0.0
+    queue = list(range(len(trace)))
+    outputs = [[] for _ in trace]
+    slot_req = {}
+    peak = 0
+
+    def admit_ready():
+        nonlocal t, peak
+        while queue:
+            i = queue[0]
+            r = trace[i]
+            if r["arrival"] > t:
+                break
+            if not eng.can_admit(r["prompt"], r["p_len"], r["new"]):
+                break
+            queue.pop(0)
+            slot, first, _, _ = eng.admit(r["prompt"], r["p_len"],
+                                          max_new=r["new"])
+            t += 1.0                   # the prefill device call
+            outputs[i].append(first)
+            if r["new"] == 1:
+                eng.release(slot)
+            else:
+                slot_req[slot] = i
+            peak = max(peak, eng.active_count())
+
+    while queue or slot_req:
+        admit_ready()
+        if not slot_req:
+            if queue:
+                t = max(t, trace[queue[0]]["arrival"])
+            continue
+        toks, _ = eng.step()
+        t += 1.0
+        for slot, i in list(slot_req.items()):
+            outputs[i].append(int(toks[slot]))
+            if len(outputs[i]) >= trace[i]["new"]:
+                eng.release(slot)
+                del slot_req[slot]
+    return outputs, {
+        "steps": eng.steps,
+        "prefills": eng.prefills,
+        "rows_per_step": round(eng.row_steps / max(eng.steps, 1), 3),
+        "peak_rows": peak,
+    }
+
+
+def run_paging(model, params, args):
+    """Dense vs paged pools at EQUAL KV HBM budget on the
+    shared-prefix trace. The dense pool holds --slots rows of
+    slot_len each; the paged arena's usable blocks hold exactly the
+    same bytes (num_blocks * block_size == slots * slot_len, + the
+    1-block trash sentinel), with a wider slot axis so concurrency
+    is bounded by MEMORY, not the program width — the capacity the
+    paged pool is supposed to unlock."""
+    from container_engine_accelerators_tpu.models.decode import (
+        SlotDecodeEngine,
+    )
+
+    trace = build_shared_trace(args,
+                               np.random.default_rng(args.seed + 1))
+    slot_len = (args.shared_prefix_len + args.prompt_len
+                + args.server_max_new)
+    bs = args.kv_block_size
+    slot_len = -(-slot_len // bs) * bs     # block-align the budget
+    usable = args.slots * (slot_len // bs)
+    # Analytic per-token KV bytes across layers (f32 cache on the
+    # bench model): the equal-HBM claim made concrete.
+    head_dim = args.embed_dim // args.num_heads
+    tok_bytes = args.num_layers * 2 * args.num_heads * head_dim * 4
+    results = {}
+    exact = {}
+    for kind in ("dense", "paged"):
+        if kind == "dense":
+            eng = SlotDecodeEngine(model, params, args.slots,
+                                   slot_len, paged=False)
+        else:
+            eng = SlotDecodeEngine(
+                model, params, args.paged_slots, slot_len,
+                paged=True, kv_block_size=bs,
+                kv_blocks=usable + 1)
+        outputs, metrics = replay_pool(eng, trace)
+        metrics["kv_hbm_bytes"] = (
+            usable * bs * tok_bytes if kind == "paged"
+            else args.slots * slot_len * tok_bytes)
+        if kind == "paged":
+            kv = eng.kv_block_stats()
+            metrics["prefix_hit_rate"] = kv["prefix_hit_rate"]
+            metrics["kv_blocks_shared_final"] = kv["kv_blocks_shared"]
+            metrics["prefix_tokens_shared"] = kv["prefix_tokens_shared"]
+        ok, bad = verify_greedy(model, params, trace, outputs, args)
+        exact[kind] = ok
+        results[kind] = metrics
+    ratio = (results["paged"]["rows_per_step"]
+             / max(results["dense"]["rows_per_step"], 1e-9))
+    return {
+        "trace": {"requests": args.paging_requests,
+                  "shared_prefix_len": args.shared_prefix_len,
+                  "shared_frac": args.shared_frac,
+                  "arrival_rate": args.paging_arrival_rate,
+                  "kv_block_size": bs, "slot_len": slot_len,
+                  "dense_slots": args.slots,
+                  "paged_slots": args.paged_slots,
+                  "usable_blocks": usable},
+        "dense": results["dense"],
+        "paged": results["paged"],
+        "sustained_rows_ratio": round(ratio, 3),
+        "greedy_exact": exact["dense"] and exact["paged"],
+    }
+
+
 def run_baseline(trace, args):
     """The pre-engine batcher policy on the same trace: FIFO groups
     of up to max_batch arrived rows, each batch run to completion
@@ -184,7 +339,8 @@ def verify_greedy(model, params, trace, outputs, args):
     budget."""
     from container_engine_accelerators_tpu.models.decode import decode
 
-    prompts = np.zeros((len(trace), args.prompt_len), np.int32)
+    width = max(r["p_len"] for r in trace)
+    prompts = np.zeros((len(trace), width), np.int32)
     p_lens = np.zeros((len(trace),), np.int32)
     for i, r in enumerate(trace):
         prompts[i, :r["p_len"]] = r["prompt"]
@@ -224,17 +380,70 @@ def main(argv=None):
                         "--check-factor x baseline AND greedy "
                         "outputs are bit-identical to decode()")
     p.add_argument("--check-factor", type=float, default=2.0)
+    p.add_argument("--shared-prefix-len", type=int, default=24,
+                   help="system-prompt length of the shared-prefix "
+                        "trace (--paging / --paging-check)")
+    p.add_argument("--shared-frac", type=float, default=0.8,
+                   help="fraction of requests opening with the "
+                        "shared system prompt")
+    p.add_argument("--paging-requests", type=int, default=40,
+                   help="request count for the shared-prefix trace")
+    p.add_argument("--paging-arrival-rate", type=float, default=4.0,
+                   help="arrivals per device call for the shared-"
+                        "prefix trace (high: capacity, not arrivals, "
+                        "should bound concurrency)")
+    p.add_argument("--paged-slots", type=int, default=16,
+                   help="paged pool's slot-axis width (its HBM "
+                        "budget still equals the dense pool's)")
+    p.add_argument("--kv-block-size", type=int, default=4)
+    p.add_argument("--paging", action="store_true",
+                   help="run the shared-prefix dense-vs-paged "
+                        "equal-HBM comparison instead of the "
+                        "engine-vs-batcher replay")
+    p.add_argument("--paging-check", action="store_true",
+                   help="exit 1 unless the paged pool sustains >= "
+                        "--paging-factor x the dense pool's "
+                        "rows/step at equal HBM on the shared-prefix "
+                        "trace, with prefix_hit_rate > 0 and every "
+                        "greedy stream bit-identical to decode() — "
+                        "the CI gate behind `make paging-check`")
+    p.add_argument("--paging-factor", type=float, default=2.0)
     args = p.parse_args(argv)
 
     from container_engine_accelerators_tpu.models import TransformerLM
 
+    max_len = args.prompt_len + args.server_max_new
+    if args.paging or args.paging_check:
+        bs = args.kv_block_size
+        max_len = -(-(args.shared_prefix_len + max_len) // bs) * bs
     model = TransformerLM(
         vocab_size=args.vocab_size, embed_dim=args.embed_dim,
         num_layers=args.num_layers, num_heads=args.num_heads,
-        max_seq_len=args.prompt_len + args.server_max_new,
-        dtype=jnp.float32)
+        max_seq_len=max_len, dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+    if args.paging or args.paging_check:
+        summary = run_paging(model, params, args)
+        summary["platform"] = jax.devices()[0].platform
+        print(json.dumps(summary))
+        if not summary["greedy_exact"]:
+            print("[paging] FAIL: a greedy stream diverged from "
+                  "per-request decode", file=sys.stderr)
+            return 1
+        hit = summary["paged"]["prefix_hit_rate"]
+        if not hit or hit <= 0:
+            print("[paging] FAIL: prefix_hit_rate is 0 — sharing "
+                  "never engaged", file=sys.stderr)
+            return 1
+        if (args.paging_check
+                and summary["sustained_rows_ratio"]
+                < args.paging_factor):
+            print(f"[paging] FAIL: sustained-rows ratio "
+                  f"{summary['sustained_rows_ratio']:.2f} < required "
+                  f"{args.paging_factor}", file=sys.stderr)
+            return 1
+        return 0
 
     trace = build_trace(args, np.random.default_rng(args.seed))
     outputs, engine = run_engine(model, params, trace, args)

@@ -122,7 +122,14 @@ def promote_serving(raw_path, stats_path, out_path):
         "batch_occupancy_avg", "slots_active", "slots_free",
         "queue_depth", "engine_steps", "rows_decoded",
         "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms",
-        "hbm_peak_bytes") if k in stats}
+        "hbm_peak_bytes",
+        # Paged KV block pool (absent on the dense fallback): block
+        # occupancy + prefix-sharing effectiveness of the captured
+        # run — the capacity levers the paging work exists to move.
+        "kv_blocks_total", "kv_blocks_free", "kv_blocks_shared",
+        "kv_block_size", "kv_block_utilization", "prefix_hits",
+        "prefix_lookups", "prefix_hit_rate",
+        "prefix_tokens_shared") if k in stats}
     if engine_stats:
         out["server_stats"] = engine_stats
     _write_atomic(out_path, out)

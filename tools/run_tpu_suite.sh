@@ -123,6 +123,19 @@ timeout -k 10 120 python tools/placement_check.py \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "placement-check preflight"
 
+# Paged-KV capacity preflight (CPU fake backend, ~2 min): on one
+# shared-prefix Poisson trace the paged block pool must sustain
+# >= 2x the dense pool's concurrent rows/step at EQUAL KV HBM
+# budget, with a non-zero prefix-index hit rate and greedy streams
+# bit-identical to per-request decode on BOTH pools. A regression
+# here means the serving capacity story (block sharing) is broken
+# or, worse, sharing corrupts streams.
+echo "[suite] paging-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/bench_serving_occupancy.py --paging-check \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "paging-check preflight"
+
 # Continuous-batching preflight (CPU fake backend, ~1 min): the slot
 # engine must beat the sequential-batch policy >= 2x in goodput on a
 # replayed Poisson trace with greedy outputs bit-identical to
