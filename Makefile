@@ -34,6 +34,17 @@ presubmit:
 	./build/check_python.sh
 	./build/check_logging.sh
 	./build/check_boilerplate.sh
+	python3 -m container_engine_accelerators_tpu.analysis
+
+# Project-native analysis gate: the AST lint must report ZERO
+# findings over the tree while every seeded fixture violation fires;
+# the lock-order sanitizer (CEA_TPU_TSAN=1) must flag the
+# inverted-lock fixture and run clean over the engine/elastic/
+# placement suites; the retrace guard must hold the engine's
+# program-count bound (buckets + insert + step) over a mixed-traffic
+# trace and catch the seeded retracer. Pure CPU, ~3 min.
+analysis-check:
+	JAX_PLATFORMS=cpu python3 tools/analysis_check.py
 
 # Tracer leak/regression guard: fake-chip plugin up, one Allocate
 # through the real gRPC surface, fail on empty /debug/trace or any
@@ -119,6 +130,6 @@ clean:
 	$(MAKE) -C demo/tpu-error clean
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
-	trace-check diagnose-check goodput-check chaos-check \
-	placement-check occupancy-check paging-check container \
-	partition-tpu push clean
+	analysis-check trace-check diagnose-check goodput-check \
+	chaos-check placement-check occupancy-check paging-check \
+	container partition-tpu push clean

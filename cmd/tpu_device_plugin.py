@@ -51,6 +51,8 @@ from container_engine_accelerators_tpu.plugin import (
     placement as placement_mod,
 )
 from container_engine_accelerators_tpu.utils import (
+    env_number,
+    env_str,
     get_logger,
     set_verbosity,
 )
@@ -103,8 +105,8 @@ def parse_args(argv=None):
                         "for a 4-host v5e-16); empty selects the "
                         "linear 1,1,N default")
     p.add_argument("-v", "--verbosity", type=int,
-                   default=int(os.environ.get("TPU_PLUGIN_VERBOSITY",
-                                              "0")),
+                   default=env_number("TPU_PLUGIN_VERBOSITY", 0,
+                                      parse=int),
                    help="glog-style verbosity (>= 3 enables DEBUG); "
                         "applied via utils.log.set_verbosity so the "
                         "flag wins over a stale first-import latch")
@@ -118,9 +120,9 @@ def main(argv=None):
     tpu_config = cfg.parse_tpu_config(args.config_file)
     log.info("TPU device plugin starting; partition=%r",
              tpu_config.tpu_partition_size)
-    if os.environ.get("CEA_TPU_TRACE_FILE"):
+    if env_str("CEA_TPU_TRACE_FILE"):
         log.info("trace journal will be written to %s at exit",
-                 os.environ["CEA_TPU_TRACE_FILE"])
+                 env_str("CEA_TPU_TRACE_FILE"))
 
     backend = get_backend()
     mounts = [(args.container_path, args.host_path)] \

@@ -1,0 +1,49 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Project-native static analysis and dynamic sanitizers.
+
+Three legs, replacing reviewer vigilance with tooling (the Python
+answer to the reference repo's `go vet` + `go test -race` discipline):
+
+* :mod:`.lint` + :mod:`.rules` — an AST lint engine whose rules
+  encode THIS project's conventions: every ``CEA_TPU_*`` /
+  ``TPU_PLUGIN_*`` env knob reads through ``utils.env_number`` /
+  ``utils.env_str`` and appears in the docs/operations.md env table;
+  every ``tpu_*`` metric name is declared once in
+  ``obs.metric_names`` and documented; modules pinned jax-free stay
+  jax-free (import-graph walk, not regex); locks are acquired via
+  ``with``; no wall-clock calls inside jitted functions. Run it as
+  ``python -m container_engine_accelerators_tpu.analysis``
+  (``--changed`` for the git-diff subset).
+
+* :mod:`.tsan` — an opt-in (``CEA_TPU_TSAN=1``) lock-order
+  sanitizer: wraps ``threading.Lock``/``RLock`` construction, records
+  per-thread acquisition stacks, builds the lock-order graph, and
+  reports cycles (potential deadlock) plus unguarded writes to
+  registered hot structures.
+
+* :mod:`.retrace` — a compilation-counting guard around jitted entry
+  points that holds the engine's program-count bound (buckets +
+  insert + step) across mixed traffic and fails loudly on silent
+  recompiles.
+
+This package is jax-free at import time by contract (retrace imports
+jax lazily, inside calls) — the lint's own ``jax-free-import`` rule
+enforces it.
+"""
+
+from .lint import Finding, Project, run_lint
+
+__all__ = ["Finding", "Project", "run_lint"]

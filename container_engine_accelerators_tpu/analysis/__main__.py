@@ -1,0 +1,76 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""CLI: ``python -m container_engine_accelerators_tpu.analysis``.
+
+Zero findings exits 0; any finding prints ``path:line: [rule]
+message (fix: hint)`` and exits 1. ``--changed`` lints only files
+changed vs git HEAD (plus untracked) — the fast pre-commit loop; the
+full-tree run is the ``make analysis-check`` / tier-1 gate.
+"""
+
+import argparse
+import sys
+
+from .lint import Project, changed_files, run_lint, _find_repo_root
+from .rules import all_rules, rule_ids
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m container_engine_accelerators_tpu.analysis",
+        description="Project-native AST lint.")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: the "
+                             "package, tools/, cmd/, demo/)")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files changed vs git HEAD")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="ID", help="run only these rule ids")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        sys.stdout.write("\n".join(rule_ids()) + "\n")
+        return 0
+
+    root = args.root or _find_repo_root()
+    paths = args.paths or None
+    if args.changed:
+        paths = changed_files(root)
+        if not paths:
+            sys.stderr.write("lint: no changed python files\n")
+            return 0
+    rules = all_rules()
+    if args.rule:
+        unknown = set(args.rule) - set(rule_ids())
+        if unknown:
+            sys.stderr.write(
+                f"lint: unknown rule ids {sorted(unknown)}\n")
+            return 2
+        rules = [r for r in rules if r.id in args.rule]
+    findings = run_lint(paths=paths, root=root, rules=rules,
+                        project=Project(root))
+    for finding in findings:
+        sys.stdout.write(finding.format() + "\n")
+    if findings:
+        sys.stderr.write(f"lint: {len(findings)} finding(s)\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

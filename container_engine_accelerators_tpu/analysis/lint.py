@@ -1,0 +1,449 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""AST lint engine: file contexts, rule protocol, escapes, runner.
+
+Rules live in :mod:`.rules`; this module owns everything rule-neutral:
+parsing, the ``# lint: disable=<rule>`` escape grammar, the
+:class:`Project` cache of cross-file facts (docs env table, metric
+registry, package import graph), and the tree walker. Stdlib-only and
+jax-free — it must lint the tree from inside the jax-free plugin
+image.
+
+Escapes:
+
+* ``# lint: disable=rule-a,rule-b`` trailing on a line suppresses
+  those rules' findings ON that line;
+* ``# lint: disable-file=rule-a`` anywhere in a file suppresses the
+  rule for the whole file (for the rare module that IS the exception,
+  e.g. a compat shim).
+
+Every suppression is deliberate and greppable — that is the point.
+"""
+
+import ast
+import os
+import re
+import subprocess
+import tokenize
+
+PACKAGE_NAME = "container_engine_accelerators_tpu"
+
+# Directories linted by default, relative to the repo root. tests/
+# are deliberately out of scope: they monkeypatch envs and seed
+# violations on purpose (the fixture suite under tests/ is the lint's
+# own regression surface).
+DEFAULT_SCOPE = (PACKAGE_NAME, "tools", "cmd", "demo")
+
+# Generated wire-protocol bindings are not held to hand-written
+# conventions (the reference repo ignores its vendored pb.go the same
+# way).
+EXCLUDE_SUFFIXES = ("_pb2.py",)
+
+_DISABLE_LINE_RE = re.compile(
+    r"#\s*lint:\s*disable=([A-Za-z0-9_,-]+)")
+_DISABLE_FILE_RE = re.compile(
+    r"#\s*lint:\s*disable-file=([A-Za-z0-9_,-]+)")
+
+PROJECT_ENV_RE = re.compile(
+    r"^(?:CEA_TPU|TPU_PLUGIN)_[A-Z0-9_]*[A-Z0-9]$")
+ENV_TOKEN_RE = re.compile(
+    r"\b((?:CEA_TPU|TPU_PLUGIN)_[A-Z0-9_]*[A-Z0-9])\b")
+METRIC_NAME_RE = re.compile(r"^tpu_[a-z0-9_]*[a-z0-9]$")
+
+
+class Finding:
+    """One lint hit: where, which rule, what, and how to fix it."""
+
+    __slots__ = ("path", "line", "rule", "message", "hint")
+
+    def __init__(self, path, line, rule, message, hint=""):
+        self.path = path
+        self.line = int(line)
+        self.rule = rule
+        self.message = message
+        self.hint = hint
+
+    def format(self):
+        text = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.hint:
+            text += f"  (fix: {self.hint})"
+        return text
+
+    def __repr__(self):
+        return f"Finding({self.format()!r})"
+
+    def key(self):
+        return (self.path, self.line, self.rule)
+
+
+class FileContext:
+    """One parsed source file plus its escape comments.
+
+    ``constants`` maps module-level ``NAME = "literal"`` string
+    assignments — rules resolve indirected env/metric names through
+    it (``env_number(EVICT_SKEW_ENV, ...)``).
+    """
+
+    def __init__(self, path, rel, source):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.line_disables = {}
+        self.file_disables = set()
+        self._scan_comments()
+        self.constants = {}
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                self.constants[node.targets[0].id] = node.value.value
+
+    def _scan_comments(self):
+        # tokenize, not a per-line regex over raw source: a disable
+        # grammar inside a string literal must not disable anything.
+        lines = iter(self.source.splitlines(True))
+        try:
+            for tok in tokenize.generate_tokens(
+                    lambda: next(lines, "")):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _DISABLE_FILE_RE.search(tok.string)
+                if m:
+                    self.file_disables.update(
+                        r.strip() for r in m.group(1).split(","))
+                    continue
+                m = _DISABLE_LINE_RE.search(tok.string)
+                if m:
+                    self.line_disables.setdefault(
+                        tok.start[0], set()).update(
+                            r.strip() for r in m.group(1).split(","))
+        except tokenize.TokenError:
+            pass
+
+    def disabled(self, rule, line):
+        return (rule in self.file_disables
+                or rule in self.line_disables.get(line, ()))
+
+    def resolve_str(self, node):
+        """A string literal, or a Name bound to one at module level;
+        None when the value is not statically known."""
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                        str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.constants.get(node.id)
+        return None
+
+
+def _find_repo_root():
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+class Project:
+    """Lazily computed cross-file facts shared by every rule."""
+
+    def __init__(self, root=None):
+        self.root = os.path.abspath(root or _find_repo_root())
+        self._documented_envs = None
+        self._metrics = None
+        self._non_metric_tokens = None
+        self._docs_text = None
+        self._import_graph = None
+
+    # -- docs ---------------------------------------------------------
+
+    @property
+    def documented_envs(self):
+        """Env names appearing in docs/operations.md TABLE rows — the
+        registry the env-registry rule holds every read against."""
+        if self._documented_envs is None:
+            envs = set()
+            path = os.path.join(self.root, "docs", "operations.md")
+            try:
+                with open(path) as f:
+                    for line in f:
+                        if not line.lstrip().startswith("|"):
+                            continue
+                        envs.update(ENV_TOKEN_RE.findall(line))
+            except OSError:
+                pass
+            self._documented_envs = envs
+        return self._documented_envs
+
+    @property
+    def docs_text(self):
+        """Concatenated docs/*.md — the metric-registry rule's
+        "documented somewhere" surface."""
+        if self._docs_text is None:
+            chunks = []
+            docs = os.path.join(self.root, "docs")
+            try:
+                names = sorted(os.listdir(docs))
+            except OSError:
+                names = []
+            for name in names:
+                if name.endswith(".md"):
+                    try:
+                        with open(os.path.join(docs, name)) as f:
+                            chunks.append(f.read())
+                    except OSError:
+                        pass
+            self._docs_text = "\n".join(chunks)
+        return self._docs_text
+
+    # -- metric registry ----------------------------------------------
+
+    def _load_metric_registry(self):
+        from ..obs import metric_names
+        self._metrics = dict(metric_names.METRICS)
+        self._non_metric_tokens = set(metric_names.NON_METRIC_TOKENS)
+
+    @property
+    def metrics(self):
+        if self._metrics is None:
+            self._load_metric_registry()
+        return self._metrics
+
+    @property
+    def non_metric_tokens(self):
+        if self._non_metric_tokens is None:
+            self._load_metric_registry()
+        return self._non_metric_tokens
+
+    # -- import graph -------------------------------------------------
+
+    @property
+    def import_graph(self):
+        """module dotted name -> [(imported dotted name, lineno)]
+        over MODULE-SCOPE imports of every package module (function-
+        body imports are the sanctioned lazy pattern and excluded)."""
+        if self._import_graph is None:
+            graph = {}
+            pkg_dir = os.path.join(self.root, PACKAGE_NAME)
+            modules = {}
+            for dirpath, _, files in os.walk(pkg_dir):
+                for name in files:
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, name)
+                    rel = os.path.relpath(path, self.root)
+                    dotted = rel[:-3].replace(os.sep, ".")
+                    if dotted.endswith(".__init__"):
+                        dotted = dotted[:-len(".__init__")]
+                    modules[dotted] = path
+            for dotted, path in modules.items():
+                try:
+                    with open(path) as f:
+                        tree = ast.parse(f.read(), filename=path)
+                except (OSError, SyntaxError):
+                    graph[dotted] = []
+                    continue
+                graph[dotted] = resolve_module_imports(
+                    tree, dotted, is_package=modules[dotted].endswith(
+                        "__init__.py"), known=modules)
+            self._import_graph = graph
+        return self._import_graph
+
+
+def module_scope_imports(tree):
+    """Yield (ast node, in_type_checking=False excluded) import nodes
+    executed at module import time: module body, class bodies, and
+    top-level try/if blocks — NOT function bodies (the lazy-import
+    escape hatch), NOT ``if TYPE_CHECKING:`` blocks."""
+    def is_type_checking(test):
+        return (isinstance(test, ast.Name)
+                and test.id == "TYPE_CHECKING") or (
+            isinstance(test, ast.Attribute)
+            and test.attr == "TYPE_CHECKING")
+
+    def walk(body):
+        for node in body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                yield node
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body)
+            elif isinstance(node, ast.If):
+                if not is_type_checking(node.test):
+                    yield from walk(node.body)
+                    yield from walk(node.orelse)
+            elif isinstance(node, (ast.Try, ast.With)):
+                for blk in (getattr(node, "body", []),
+                            getattr(node, "orelse", []),
+                            getattr(node, "finalbody", [])):
+                    yield from walk(blk)
+                for h in getattr(node, "handlers", []):
+                    yield from walk(h.body)
+
+    yield from walk(tree.body)
+
+
+def resolve_module_imports(tree, dotted, is_package, known):
+    """Resolve a module's module-scope imports to dotted names.
+
+    Package-internal relative imports resolve against ``known`` (the
+    package's module map): ``from . import config`` inside
+    plugin/devices.py resolves to plugin.config if that module
+    exists, else to the package __init__ itself. External imports
+    resolve to their top-level form as written (``jax.numpy`` stays
+    ``jax.numpy``).
+    """
+    parts = dotted.split(".")
+    # The package a relative import is relative to.
+    pkg_parts = parts if is_package else parts[:-1]
+    edges = []
+
+    def note(name, lineno):
+        edges.append((name, lineno))
+
+    for node in module_scope_imports(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                note(alias.name, node.lineno)
+            continue
+        if node.level == 0:
+            base = node.module or ""
+            for alias in node.names:
+                sub = f"{base}.{alias.name}"
+                note(sub if sub in known else base, node.lineno)
+            continue
+        # Relative: climb level-1 packages up from this module's pkg.
+        anchor = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+        base = ".".join(anchor + (node.module.split(".")
+                                  if node.module else []))
+        for alias in node.names:
+            sub = f"{base}.{alias.name}"
+            note(sub if sub in known else base, node.lineno)
+    return edges
+
+
+def iter_source_files(root, paths=None):
+    """Absolute paths of .py files in scope, sorted."""
+    root = os.path.abspath(root)
+    if paths:
+        out = []
+        for p in paths:
+            p = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isdir(p):
+                for dirpath, dirnames, files in os.walk(p):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    out.extend(os.path.join(dirpath, f)
+                               for f in files if f.endswith(".py"))
+            elif p.endswith(".py") and os.path.exists(p):
+                out.append(p)
+        files = out
+    else:
+        files = []
+        for scope in DEFAULT_SCOPE:
+            base = os.path.join(root, scope)
+            for dirpath, dirnames, names in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                files.extend(os.path.join(dirpath, n)
+                             for n in names if n.endswith(".py"))
+    files = [f for f in files
+             if not f.endswith(EXCLUDE_SUFFIXES)]
+    return sorted(set(files))
+
+
+def changed_files(root):
+    """Repo-relative .py files changed vs HEAD plus untracked — the
+    fast ``--changed`` iteration scope."""
+    out = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others",
+                  "--exclude-standard"]):
+        try:
+            res = subprocess.run(args, cwd=root, capture_output=True,
+                                 text=True, check=True)
+        except (OSError, subprocess.CalledProcessError):
+            continue
+        out.update(line.strip() for line in res.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    scoped = []
+    for rel in sorted(out):
+        top = rel.split("/", 1)[0]
+        if top in DEFAULT_SCOPE and not rel.endswith(
+                EXCLUDE_SUFFIXES):
+            path = os.path.join(root, rel)
+            if os.path.exists(path):
+                scoped.append(path)
+    return scoped
+
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Za-z0-9_,-]+)")
+
+
+def fixture_expectations(path, rel):
+    """(rel, line, rule) triples a seeded-violation fixture declares
+    via trailing ``# EXPECT: rule-a,rule-b`` comments."""
+    expected = set()
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                expected.update((rel, lineno, rule.strip())
+                                for rule in m.group(1).split(","))
+    return expected
+
+
+def verify_fixtures(fixture_dir, root=None, project=None):
+    """Lint the seeded-violation fixture tree and diff findings
+    against its inline EXPECT annotations. Returns (missing,
+    unexpected) — both empty means every rule fires exactly where
+    the fixtures say and nowhere else. Shared by tests/test_analysis
+    and tools/analysis_check."""
+    root = os.path.abspath(root or _find_repo_root())
+    expected = set()
+    for path in iter_source_files(root, [fixture_dir]):
+        rel = os.path.relpath(path, root)
+        expected |= fixture_expectations(path, rel)
+    findings = run_lint(paths=[fixture_dir], root=root,
+                        project=project)
+    got = {f.key() for f in findings}
+    return sorted(expected - got), sorted(got - expected)
+
+
+def run_lint(paths=None, root=None, rules=None, project=None):
+    """Lint ``paths`` (default: the whole DEFAULT_SCOPE tree under
+    ``root``) with ``rules`` (default: every registered rule).
+    Returns findings sorted by (path, line, rule); disable escapes
+    already applied. A file that does not parse yields one
+    ``syntax-error`` finding instead of aborting the run."""
+    from .rules import all_rules
+    root = os.path.abspath(root or _find_repo_root())
+    project = project or Project(root)
+    rules = list(rules) if rules is not None else all_rules()
+    findings = []
+    for path in iter_source_files(root, paths):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path) as f:
+                source = f.read()
+            ctx = FileContext(path, rel, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding(rel, getattr(e, "lineno", 1) or 1,
+                                    "syntax-error", str(e)))
+            continue
+        for rule in rules:
+            for finding in rule.check(ctx, project):
+                if not ctx.disabled(finding.rule, finding.line):
+                    findings.append(finding)
+    findings.sort(key=Finding.key)
+    return findings

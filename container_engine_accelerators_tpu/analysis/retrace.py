@@ -1,0 +1,140 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Retrace guard: the engine's program-count bound, enforced.
+
+The slot engine's whole performance story rests on ONE invariant: the
+compiled-program set is ``prefill-per-bucket + insert + step``,
+independent of traffic mix (PR 4), and the paged pool kept it (PR 8).
+A silent recompile — a weak_type flip, a host int reaching a traced
+argument, a shape leak — doesn't fail anything today; it just turns a
+100-step trace into a 100-compile crawl. Until now the only guard was
+one jit-cache assertion in test_paging.
+
+:class:`RetraceGuard` snapshots the jit caches of watched callables
+(``fn._cache_size()``) on entry and asserts each function's new-
+compile budget on exit, failing loudly with WHICH program retraced
+and by how much. :func:`engine_programs` names the slot-engine
+program set; bench_serving_occupancy runs its replays under the
+guard and ``make analysis-check`` drives a mixed-traffic trace plus
+a seeded always-retracing fixture.
+
+jax is imported lazily (inside :func:`engine_programs`) so the
+analysis package stays importable on the jax-free plugin path.
+"""
+
+
+class RetraceError(AssertionError):
+    """A watched jitted callable compiled more programs than its
+    budget across the guarded region."""
+
+
+class RetraceGuard:
+    """Context manager asserting per-function compile budgets.
+
+    >>> guard = RetraceGuard()
+    >>> guard.watch("engine.step", _paged_step_impl, max_new=1)
+    >>> with guard:
+    ...     drive_mixed_traffic()
+    # raises RetraceError if step compiled > 1 new program
+    """
+
+    def __init__(self):
+        self._watches = []      # (name, fn, budget)
+        self._baseline = None
+
+    def watch(self, name, fn, max_new=1):
+        """Watch ``fn`` (a jax.jit product — anything exposing
+        ``_cache_size()``); allow at most ``max_new`` new compiles
+        inside the guarded region."""
+        if not hasattr(fn, "_cache_size"):
+            raise TypeError(
+                f"{name}: {fn!r} has no _cache_size(); pass the "
+                "jitted callable itself, not a wrapper")
+        self._watches.append((name, fn, int(max_new)))
+        if self._baseline is not None:
+            # Late watch inside an open guard: baseline it now.
+            self._baseline[name] = fn._cache_size()
+        return self
+
+    def __enter__(self):
+        self._baseline = {name: fn._cache_size()
+                          for name, fn, _ in self._watches}
+        return self
+
+    def new_compiles(self):
+        """{name: programs compiled since __enter__}."""
+        if self._baseline is None:
+            raise RuntimeError("guard not entered")
+        return {name: fn._cache_size() - self._baseline[name]
+                for name, fn, _ in self._watches}
+
+    def check(self):
+        """Raise RetraceError when any watched function exceeded its
+        budget; returns the new-compile counts otherwise."""
+        counts = self.new_compiles()
+        over = [
+            (name, counts[name], budget)
+            for name, fn, budget in self._watches
+            if counts[name] > budget
+        ]
+        if over:
+            detail = "; ".join(
+                f"{name}: {got} new programs (budget {budget})"
+                for name, got, budget in over)
+            raise RetraceError(
+                "program-count bound violated — silent recompiles "
+                f"detected: {detail}. Likely a weak_type/shape leak "
+                "into a traced argument (check that host scalars "
+                "reach jit as jnp.asarray with explicit dtypes).")
+        return counts
+
+    def __exit__(self, exc_type, exc, tb):
+        # Only assert on the clean path: an exception inside the
+        # region already carries the real failure.
+        if exc_type is None:
+            self.check()
+        return False
+
+
+def engine_programs(paged=True):
+    """(name, fn) pairs of the slot-engine program set — the watch
+    list for the buckets + insert + step bound."""
+    from ..models import decode
+
+    if paged:
+        return (
+            ("engine.paged_prefill", decode._paged_prefill_impl),
+            ("engine.paged_insert", decode._paged_insert_impl),
+            ("engine.paged_step", decode._paged_step_impl),
+        )
+    return (
+        ("engine.prefill", decode._slot_prefill_impl),
+        ("engine.insert", decode._slot_insert_impl),
+        ("engine.step", decode._slot_step_impl),
+    )
+
+
+def engine_guard(paged=True, prefill_budget=1):
+    """A guard preloaded with the engine bound: ``prefill_budget``
+    programs for admission prefill (= number of distinct admission
+    widths the trace may legally compile), ONE insert program, ONE
+    step program. Enter AFTER constructing the engine (construction
+    compiles the cache-init program, which is setup, not traffic)."""
+    guard = RetraceGuard()
+    names = engine_programs(paged)
+    guard.watch(names[0][0], names[0][1], max_new=prefill_budget)
+    guard.watch(names[1][0], names[1][1], max_new=1)
+    guard.watch(names[2][0], names[2][1], max_new=1)
+    return guard

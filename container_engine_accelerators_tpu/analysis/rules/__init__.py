@@ -1,0 +1,44 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Rule registry: one instance of every project convention rule."""
+
+from .env_rules import BareEnvReadRule, EnvRegistryRule
+from .hygiene_rules import TimeInJitRule
+from .import_rules import JaxFreeImportRule
+from .lock_rules import LockWithRule
+from .metric_rules import MetricRegistryRule
+
+_ALL = (
+    EnvRegistryRule,
+    BareEnvReadRule,
+    MetricRegistryRule,
+    JaxFreeImportRule,
+    LockWithRule,
+    TimeInJitRule,
+)
+
+
+def all_rules():
+    """Fresh instances of every registered rule, in report order."""
+    return [cls() for cls in _ALL]
+
+
+def rule_ids():
+    return [cls.id for cls in _ALL]
+
+
+__all__ = ["all_rules", "rule_ids", "BareEnvReadRule",
+           "EnvRegistryRule", "JaxFreeImportRule", "LockWithRule",
+           "MetricRegistryRule", "TimeInJitRule"]

@@ -1,0 +1,109 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Env-knob conventions.
+
+``env-registry``: every project env name (``CEA_TPU_*`` /
+``TPU_PLUGIN_*``) that appears as a string literal in the tree must
+have a row in the docs/operations.md env tables, which are parsed at
+lint time — an undocumented knob is the convention drift PRs 2-8 kept
+catching by hand.
+
+``bare-env-read``: project env vars are READ only through
+``utils.env_number`` / ``utils.env_str`` — never raw ``os.environ``
+— so typed parsing, junk-value fallback, and the registry above stay
+one seam. Writes (``os.environ[k] = v`` in tools/harnesses) and
+non-project names are out of scope. The ``utils`` package itself is
+exempt: it is where the helpers live.
+"""
+
+import ast
+
+from ..lint import Finding, PROJECT_ENV_RE
+
+_HELPERS = ("env_number", "env_str")
+
+
+def _call_name(node):
+    """Dotted tail of a Call's func: "os.environ.get", "env_str"..."""
+    parts = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def _is_environ(node):
+    """True for an ``os.environ`` expression."""
+    return (isinstance(node, ast.Attribute)
+            and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os")
+
+
+class EnvRegistryRule:
+    """Project env literals must appear in the ops env table."""
+
+    id = "env-registry"
+    hint = ("add a row to the docs/operations.md environment table "
+            "(the lint parses it)")
+
+    def check(self, ctx, project):
+        documented = project.documented_envs
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            name = node.value
+            if not PROJECT_ENV_RE.match(name):
+                continue
+            if name in documented or (name, node.lineno) in seen:
+                continue
+            seen.add((name, node.lineno))
+            yield Finding(ctx.rel, node.lineno, self.id,
+                          f"env var {name} is not documented in the "
+                          "docs/operations.md env table", self.hint)
+
+
+class BareEnvReadRule:
+    """Project env vars read raw instead of via utils.env_*."""
+
+    id = "bare-env-read"
+    hint = "read it through utils.env_number / utils.env_str"
+
+    def check(self, ctx, project):
+        if ctx.rel.replace("\\", "/").startswith(
+                "container_engine_accelerators_tpu/utils/"):
+            return
+        for node in ast.walk(ctx.tree):
+            name = None
+            if isinstance(node, ast.Call):
+                called = _call_name(node)
+                if called in ("os.environ.get", "environ.get",
+                              "os.getenv", "getenv") and node.args:
+                    name = ctx.resolve_str(node.args[0])
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)
+                  and (_is_environ(node.value)
+                       or (isinstance(node.value, ast.Name)
+                           and node.value.id == "environ"))):
+                name = ctx.resolve_str(node.slice)
+            if name and PROJECT_ENV_RE.match(name):
+                yield Finding(ctx.rel, node.lineno, self.id,
+                              f"raw os.environ read of {name}",
+                              self.hint)

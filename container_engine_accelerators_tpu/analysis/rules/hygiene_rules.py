@@ -1,0 +1,84 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``time-in-jit``: no wall-clock reads inside jitted functions.
+
+A ``time.time()`` (or ``perf_counter`` / ``datetime.now``) inside a
+``@jax.jit`` function executes ONCE, at trace time, and the value is
+baked into the compiled program as a constant — every later call
+replays the timestamp of the first. The bug reads like a working
+timer until a cache hit serves a stale constant. Timing belongs
+around the dispatch (and through ``utils.sync.wall_sync`` on async
+backends), never inside the traced function.
+"""
+
+import ast
+
+from ..lint import Finding
+
+_CLOCK_CALLS = {
+    ("time", "time"), ("time", "perf_counter"),
+    ("time", "monotonic"), ("time", "time_ns"),
+    ("time", "perf_counter_ns"), ("time", "monotonic_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"),
+}
+
+
+def _is_jit_decorator(dec):
+    """jax.jit / jit / functools.partial(jax.jit, ...) /
+    jax.jit(...) decorator shapes."""
+    if isinstance(dec, ast.Call):
+        # partial(jax.jit, ...) or jax.jit(...)
+        if _is_jit_decorator(dec.func):
+            return True
+        return any(_is_jit_name(a) for a in dec.args)
+    return _is_jit_name(dec)
+
+
+def _is_jit_name(node):
+    if isinstance(node, ast.Name):
+        return node.id in ("jit", "pjit")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("jit", "pjit")
+    return False
+
+
+class TimeInJitRule:
+    id = "time-in-jit"
+    hint = ("move the clock read outside the jitted function; the "
+            "traced value is a compile-time constant")
+
+    def check(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_jit_decorator(d)
+                       for d in node.decorator_list):
+                continue
+            for inner in ast.walk(node):
+                if not (isinstance(inner, ast.Call)
+                        and isinstance(inner.func, ast.Attribute)):
+                    continue
+                owner = inner.func.value
+                owner_name = owner.id if isinstance(owner, ast.Name) \
+                    else (owner.attr if isinstance(owner,
+                                                   ast.Attribute)
+                          else None)
+                if (owner_name, inner.func.attr) in _CLOCK_CALLS:
+                    yield Finding(
+                        ctx.rel, inner.lineno, self.id,
+                        f"wall-clock call {owner_name}."
+                        f"{inner.func.attr}() inside jitted "
+                        f"function {node.name}", self.hint)

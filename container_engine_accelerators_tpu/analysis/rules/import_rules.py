@@ -1,0 +1,107 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``jax-free-import``: packages pinned jax-free stay jax-free.
+
+The plugin container ships without jax; obs must be importable there
+(postmortem capture inside a dying plugin), and analysis must lint
+from the same image. The check is an IMPORT-GRAPH walk, not a regex:
+``plugin/x.py`` importing ``utils.sync`` would be flagged through the
+chain even though the word "jax" never appears in x.py. Function-body
+imports are the sanctioned lazy pattern and don't count; neither do
+``if TYPE_CHECKING:`` blocks.
+
+A module outside the pinned packages opts in with a ``# lint:
+jax-free`` comment (how the fixture suite seeds violations).
+"""
+
+import ast
+
+from ..lint import Finding, PACKAGE_NAME, module_scope_imports
+
+# Package subtrees that must import (transitively, at module scope)
+# no jax. flax counts as jax: importing it pulls jax in.
+JAX_FREE_PACKAGES = ("obs", "plugin", "chip", "analysis")
+FORBIDDEN_ROOTS = ("jax", "flax")
+
+_MARKER = "# lint: jax-free"
+
+
+def _forbidden_root(name):
+    root = name.split(".", 1)[0]
+    return root if root in FORBIDDEN_ROOTS else None
+
+
+class JaxFreeImportRule:
+    id = "jax-free-import"
+    hint = ("import jax lazily inside the call that needs it, or "
+            "move the jax-bound code out of the jax-free package")
+
+    def _declared(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        for pkg in JAX_FREE_PACKAGES:
+            if rel.startswith(f"{PACKAGE_NAME}/{pkg}/"):
+                return True
+        return _MARKER in ctx.source
+
+    def check(self, ctx, project):
+        if not self._declared(ctx):
+            return
+        # Direct module-scope imports, from this file's own AST (so
+        # marker-declared fixture files outside the package work).
+        reported = set()
+        for node in module_scope_imports(ctx.tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif node.level == 0:
+                names = [node.module or ""]
+            else:
+                continue  # relative import: package-internal
+            for name in names:
+                root = _forbidden_root(name)
+                if root and root not in reported:
+                    reported.add(root)
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        f"module-scope import of {root} in a "
+                        "jax-free module", self.hint)
+        # Transitive reach through package-internal imports.
+        rel = ctx.rel.replace("\\", "/")
+        if not rel.startswith(PACKAGE_NAME + "/"):
+            return
+        dotted = rel[:-3].replace("/", ".")
+        if dotted.endswith(".__init__"):
+            dotted = dotted[:-len(".__init__")]
+        graph = project.import_graph
+        # BFS; each frontier entry carries (module, chain-so-far,
+        # lineno of THIS file's import that opened the chain).
+        queue = [(dotted, [dotted], None)]
+        seen = {dotted}
+        while queue:
+            mod, chain, entry_line = queue.pop(0)
+            for dep, lineno in graph.get(mod, ()):
+                first = entry_line if entry_line is not None \
+                    else lineno
+                root = _forbidden_root(dep)
+                if root and mod != dotted:
+                    via = " -> ".join(chain + [root])
+                    yield Finding(
+                        ctx.rel, first, self.id,
+                        "jax reaches this jax-free module at "
+                        f"import time via {via}", self.hint)
+                    return
+                if (dep.startswith(PACKAGE_NAME) and dep in graph
+                        and dep not in seen):
+                    seen.add(dep)
+                    queue.append((dep, chain + [dep], first))

@@ -1,0 +1,46 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``lock-with``: locks are held via ``with``, never a bare blocking
+``.acquire()``.
+
+A bare ``lock.acquire()`` whose ``release()`` is not reached on every
+path (an early return, an exception between the two) wedges every
+later waiter — the failure is remote from the bug and only under
+load. ``with lock:`` makes the release structural. A NON-blocking
+probe (``acquire(blocking=False)`` / ``acquire(timeout=...)``) whose
+result is checked is a legitimate pattern (obs.profiler's
+one-at-a-time capture guard) and is not flagged: the rule fires only
+on argument-less ``.acquire()`` calls.
+"""
+
+import ast
+
+from ..lint import Finding
+
+
+class LockWithRule:
+    id = "lock-with"
+    hint = ("hold the lock with `with lock:` (or use a checked "
+            "non-blocking acquire, released in try/finally)")
+
+    def check(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                    and not node.args and not node.keywords):
+                yield Finding(ctx.rel, node.lineno, self.id,
+                              "bare blocking .acquire() call",
+                              self.hint)

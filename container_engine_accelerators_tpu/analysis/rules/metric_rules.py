@@ -1,0 +1,83 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``metric-registry``: every ``tpu_*`` metric-name literal resolves
+against ``obs.metric_names.METRICS``.
+
+Two failure modes, both real in this repo's history:
+
+* a literal that is not a registry key — either a typo'd/drifted copy
+  of a real series name (the Prometheus/varz/stats keys silently
+  fork) or a new metric that skipped registration;
+* a registered metric whose name never appears under ``docs/`` —
+  declared but undocumented (flagged once, at the registry itself).
+
+The prometheus_client exposition suffix (``name_total`` for a
+registered counter ``name``) and registered non-metric tokens (label
+keys like ``tpu_device``) are accepted.
+"""
+
+import ast
+
+from ..lint import Finding, METRIC_NAME_RE
+
+_REGISTRY_REL = ("container_engine_accelerators_tpu/obs/"
+                 "metric_names.py")
+
+
+class MetricRegistryRule:
+    id = "metric-registry"
+    hint = ("declare the name once in obs/metric_names.py and import "
+            "it")
+
+    def check(self, ctx, project):
+        rel = ctx.rel.replace("\\", "/")
+        metrics = project.metrics
+        if rel == _REGISTRY_REL:
+            # The registry itself: every declared metric must be
+            # documented somewhere under docs/.
+            docs = project.docs_text
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in metrics
+                        and node.value not in docs
+                        and node.value + "_total" not in docs):
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        f"metric {node.value} is registered but "
+                        "never mentioned under docs/",
+                        "document the series (operations.md, "
+                        "serving.md, or training.md)")
+            return
+        known = set(metrics) | project.non_metric_tokens
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            name = node.value
+            if not METRIC_NAME_RE.match(name):
+                continue
+            if name in known:
+                continue
+            # Prometheus exposition variants of a registered name:
+            # counter `_total`, histogram `_bucket`/`_sum`/`_count`.
+            base = name.rsplit("_", 1)[0]
+            if (name.rsplit("_", 1)[-1] in ("total", "bucket",
+                                            "sum", "count")
+                    and base in known):
+                continue
+            yield Finding(ctx.rel, node.lineno, self.id,
+                          f"tpu_* literal {name!r} is not declared "
+                          "in obs/metric_names.py", self.hint)

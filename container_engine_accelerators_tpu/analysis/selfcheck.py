@@ -1,0 +1,115 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The analysis suite's seeded self-check scenarios, shared between
+tests/test_analysis.py and tools/analysis_check.py — a gate that
+cannot fire is worse than no gate, and two drifting copies of the
+fixture traffic would let exactly that happen (lint.verify_fixtures
+plays the same role for the lint rules).
+
+jax-heavy helpers import jax lazily so this module stays importable
+on the jax-free plugin path (the package's own rule checks it).
+"""
+
+import threading
+
+from . import tsan
+from .retrace import RetraceError, RetraceGuard, engine_guard
+
+
+def run_serialized(*targets):
+    """Run each target to completion on its own thread, one after
+    another — deterministic interleaving with no real deadlock
+    risk."""
+    for target in targets:
+        t = threading.Thread(target=target)
+        t.start()
+        t.join()
+
+
+def inverted_lock_report():
+    """Two threads taking (a, b) and (b, a) under a forced sanitizer
+    session: the returned report must contain a cycle."""
+    with tsan.session(force=True) as state:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def a_then_b():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def b_then_a():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        run_serialized(a_then_b, b_then_a)
+        return state.report()
+
+
+def seeded_retracer_caught():
+    """A jit function driven with a new shape every call must trip a
+    1-program RetraceGuard. Returns True when the guard raised."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def leaky(x):
+        return x * 2
+
+    guard = RetraceGuard().watch("seeded-retracer", leaky, max_new=1)
+    try:
+        with guard:
+            for width in range(1, 5):
+                leaky(jnp.zeros((width,), jnp.float32))
+    except RetraceError:
+        return True
+    return False
+
+
+def mixed_traffic_compile_counts():
+    """The acceptance trace: a bucketed paged engine serving greedy +
+    filtered sampling + repetition penalty + prefix-shared rows + a
+    post-release revival fork, across block boundaries, under the
+    buckets(1) + insert + step engine guard. Returns the per-program
+    new-compile counts; raises RetraceError on a bound violation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import TransformerLM
+    from ..models.decode import SlotDecodeEngine
+
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = SlotDecodeEngine(model, params, slots=4, slot_len=20,
+                           paged=True, kv_block_size=4, buckets=[8])
+    shared = np.array([4, 5, 6, 7, 8, 9], np.int32)
+    with engine_guard(paged=True, prefill_budget=1) as guard:
+        s1, *_ = eng.admit(shared, 6)               # greedy
+        eng.step()
+        eng.admit(shared, 6, temperature=0.9,       # filters + share
+                  top_k=7, top_p=0.9, min_p=0.01, seed=3)
+        eng.admit(np.array([30, 31, 32], np.int32), 3,
+                  repetition_penalty=1.5)           # penalty row
+        for _ in range(6):                          # block boundaries
+            eng.step()
+        eng.release(s1)
+        eng.admit(shared, 6)                        # revival fork
+        eng.step()
+    return guard.new_compiles()

@@ -26,6 +26,7 @@ import os
 import re
 import threading
 
+from ..utils import env_str
 from .backend import (
     ChipBackend,
     ChipBackendError,
@@ -225,7 +226,7 @@ class PyChipBackend(ChipBackend):
         # Precedence: explicit override env; node-published state file;
         # ambient TPU_TOPOLOGY (a per-process libtpu hint, least
         # trustworthy for node-level facts); inference from chip count.
-        spec = os.environ.get("CEA_TPU_TOPOLOGY", "")
+        spec = env_str("CEA_TPU_TOPOLOGY", "")
         if not spec:
             try:
                 with open(os.path.join(self._state_dir, "topology")) as f:
@@ -233,7 +234,7 @@ class PyChipBackend(ChipBackend):
             except OSError:
                 spec = ""
         if not spec:
-            spec = os.environ.get("TPU_TOPOLOGY", "")
+            spec = env_str("TPU_TOPOLOGY", "")
         if spec:
             try:
                 self._dims = parse_shape(spec)

@@ -32,13 +32,15 @@ batch to shard.
 import collections
 import functools
 import hashlib
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import traverse_util
 from flax.core import unfreeze
+
+from ..analysis import tsan
+from ..utils import env_number, env_str
 
 
 def _decode_clone(model):
@@ -1130,7 +1132,7 @@ _PAGED_DATA_LEAVES = ("cached_key", "cached_value", "key_scale",
 def paged_kv_enabled(default=True):
     """CEA_TPU_PAGED_KV gate: unset/empty -> ``default`` (the paged
     pool); 0/false/off/no -> the dense fallback."""
-    raw = os.environ.get(PAGED_KV_ENV)
+    raw = env_str(PAGED_KV_ENV)
     if raw is None or not raw.strip():
         return default
     return raw.strip().lower() not in ("0", "false", "off", "no")
@@ -1190,6 +1192,7 @@ class _BlockPool:
                 del self._index[key]
 
     def alloc(self):
+        tsan.note_write("engine.block_pool", self)
         while self._free_order:
             bid = self._free_order.popleft()
             if bid in self._free_set:
@@ -1202,6 +1205,7 @@ class _BlockPool:
             "have queued this request (engine invariant violated)")
 
     def incref(self, bid):
+        tsan.note_write("engine.block_pool", self)
         if self.ref[bid] == 0:
             # Revival: a free-listed block whose indexed content a
             # new admission matched — back to resident, keys kept.
@@ -1209,6 +1213,7 @@ class _BlockPool:
         self.ref[bid] += 1
 
     def decref(self, bid):
+        tsan.note_write("engine.block_pool", self)
         self.ref[bid] -= 1
         if self.ref[bid] < 0:
             raise RuntimeError(f"KV block {bid} refcount underflow")
@@ -1541,12 +1546,13 @@ class SlotDecodeEngine:
                       else bool(paged))
         if self.paged:
             bs = int(kv_block_size
-                     or os.environ.get(KV_BLOCK_ENV) or 16)
+                     or env_number(KV_BLOCK_ENV, 16, parse=int))
             if bs < 1:
                 raise ValueError(f"kv_block_size must be >= 1: {bs}")
             self._block_size = bs
             self._n_blk = -(-self.slot_len // bs)
-            nb = kv_blocks or os.environ.get(KV_BLOCKS_ENV)
+            nb = kv_blocks or env_number(KV_BLOCKS_ENV, None,
+                                         parse=int)
             # Default arena = the dense pool's exact KV byte budget
             # (+1 trash block): sharing then goes strictly further
             # than dense at equal HBM — the occupancy bench's claim.
@@ -1557,7 +1563,10 @@ class SlotDecodeEngine:
             # queued-forever wedge, not the transient queueing
             # exhaustion is supposed to mean.
             pin_blocks = -(-int(pin_reserve_tokens) // bs)
-            nb = (int(nb) if nb
+            # `is not None`, not truthiness: an explicit 0 (manifest
+            # typo) must hit the too-small guard below, not silently
+            # select the default arena.
+            nb = (int(nb) if nb is not None
                   else self.slots * self._n_blk + pin_blocks + 1)
             if nb < self._n_blk + 1:
                 raise ValueError(
@@ -1913,6 +1922,7 @@ class SlotDecodeEngine:
         full-prompt echo logprobs — a shared span's echo is never
         computed). Raises RuntimeError when the block budget cannot
         cover the row — callers queue and retry after a release."""
+        tsan.note_write("engine.slot_tables", self)
         free = np.flatnonzero(~self._active)
         if free.size == 0:
             raise RuntimeError("no free slot; release one first")
@@ -1985,6 +1995,7 @@ class SlotDecodeEngine:
         pool is empty."""
         if not self._active.any():
             return None
+        tsan.note_write("engine.slot_tables", self)
         if self.paged:
             cow_src, cow_dst = self._paged_prestep()
             (self._cache, self._row_pos, self._seen, self._rngs, nxt,
@@ -2027,6 +2038,7 @@ class SlotDecodeEngine:
         is reused) — the row's table resets to the trash block, and
         its unspent growth reservation is returned to the budget, so
         a queued admission can land on the very next boundary."""
+        tsan.note_write("engine.slot_tables", self)
         if self.paged and self._slot_blocks[slot]:
             for b in self._slot_blocks[slot]:
                 self._pool.decref(b)

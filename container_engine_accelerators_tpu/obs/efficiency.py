@@ -47,16 +47,17 @@ jax-free); anything touching a backend is the caller's job — the
 ledgers take plain numbers.
 """
 
-import os
 import threading
 import time
 
+from ..utils import env_number
+from .metric_names import (
+    DECODE_MFU as DECODE_MFU_GAUGE,
+    TRAIN_BADPUT_SECONDS as BADPUT_GAUGE,
+    TRAIN_GOODPUT_RATIO as GOODPUT_GAUGE,
+    TRAIN_MFU as TRAIN_MFU_GAUGE,
+)
 from .trace import get_tracer
-
-TRAIN_MFU_GAUGE = "tpu_train_mfu"
-DECODE_MFU_GAUGE = "tpu_decode_mfu"
-GOODPUT_GAUGE = "tpu_train_goodput_ratio"
-BADPUT_GAUGE = "tpu_train_badput_seconds"
 
 # Per-chip dense peak FLOP/s at the training-relevant precision
 # (bf16). Public per-generation numbers; matched by SUBSTRING against
@@ -101,12 +102,9 @@ def peak_flops_per_chip(device_kind=None):
     generation is unknown. The CEA_TPU_PEAK_FLOPS env override wins
     unconditionally (it is how operators rate new hardware, or rate
     int8 serving against the int8 peak)."""
-    override = os.environ.get(PEAK_FLOPS_ENV)
-    if override:
-        try:
-            return float(override)
-        except ValueError:
-            pass  # a broken override must not kill telemetry
+    override = env_number(PEAK_FLOPS_ENV, None)
+    if override is not None:
+        return override
     if not device_kind:
         return None
     kind = str(device_kind).lower()

@@ -36,9 +36,8 @@ import time
 import grpc
 
 from .propagate import TRACEPARENT_KEY, format_traceparent
+from .metric_names import CLIENT_RPC_LATENCY as CLIENT_RPC_HISTOGRAM
 from .trace import get_tracer
-
-CLIENT_RPC_HISTOGRAM = "tpu_client_rpc_latency_seconds"
 
 
 class _CallDetails(

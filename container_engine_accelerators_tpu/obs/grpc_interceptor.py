@@ -34,9 +34,8 @@ import time
 import grpc
 
 from .propagate import context_from_metadata
+from .metric_names import PLUGIN_RPC_LATENCY as RPC_HISTOGRAM
 from .trace import get_tracer
-
-RPC_HISTOGRAM = "tpu_plugin_rpc_latency_seconds"
 
 
 def _short_method(full_method):

@@ -29,6 +29,8 @@ import os
 import socket
 import threading
 
+from ..utils import env_str
+
 _lock = threading.Lock()
 _role = None
 
@@ -46,7 +48,7 @@ def set_role(role):
 def identity():
     """The (host, pid, role) stamp as a dict — JSON-ready."""
     with _lock:
-        role = os.environ.get("CEA_TPU_ROLE") or _role or "unknown"
+        role = env_str("CEA_TPU_ROLE") or _role or "unknown"
     return {
         "host": socket.gethostname(),
         "pid": os.getpid(),

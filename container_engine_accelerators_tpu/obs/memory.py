@@ -39,15 +39,16 @@ module stays legal on the jax-free plugin path, where sampling simply
 reports nothing.
 """
 
-import os
 import threading
 import time
 
+from ..utils import env_number
+from .metric_names import (
+    HBM_BYTES_IN_USE as IN_USE_GAUGE,
+    HBM_BYTES_LIMIT as LIMIT_GAUGE,
+    HBM_PEAK_BYTES as PEAK_GAUGE,
+)
 from .trace import get_tracer
-
-IN_USE_GAUGE = "tpu_hbm_bytes_in_use"
-PEAK_GAUGE = "tpu_hbm_peak_bytes"
-LIMIT_GAUGE = "tpu_hbm_bytes_limit"
 PRESSURE_EVENT = "memory.pressure"
 RECOVERED_EVENT = "memory.pressure_recovered"
 
@@ -98,11 +99,8 @@ class MemoryMonitor:
 
     def __init__(self, soft_limit=None, tracer=None):
         if soft_limit is None:
-            try:
-                soft_limit = float(os.environ.get(
-                    SOFT_LIMIT_ENV, DEFAULT_SOFT_LIMIT))
-            except ValueError:
-                soft_limit = DEFAULT_SOFT_LIMIT
+            soft_limit = env_number(SOFT_LIMIT_ENV,
+                                    DEFAULT_SOFT_LIMIT)
         self.soft_limit = soft_limit
         self._tracer = tracer or get_tracer()
         self._lock = threading.Lock()

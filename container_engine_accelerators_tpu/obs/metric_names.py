@@ -1,0 +1,106 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The single registry of project (``tpu_*``) metric names.
+
+Every ``tpu_*`` gauge/counter/histogram name is declared here exactly
+ONCE and imported by its publisher — a string literal that drifts
+between the Prometheus, varz, and /stats surfaces is a bug class this
+file exists to kill (PR 6's `tpu_train_recovery_total` lived in two
+modules; the metric-registry lint now fails any `tpu_*` literal that
+is not a key of :data:`METRICS`). The help text doubles as the
+documentation hook: the lint also asserts each name appears in
+`docs/`, so adding a metric here without a docs mention fails CI.
+
+jax-free and dependency-free by construction — the plugin path
+imports it.
+"""
+
+# -- plugin (device-plugin process) -----------------------------------
+PLUGIN_RPC_LATENCY = "tpu_plugin_rpc_latency_seconds"
+CLIENT_RPC_LATENCY = "tpu_client_rpc_latency_seconds"
+PLUGIN_HEALTH_SWEEP = "tpu_plugin_health_sweep_seconds"
+PLUGIN_BUILD_INFO = "tpu_plugin_build_info"
+# prometheus_client appends the `_total` suffix at exposition.
+PLUGIN_COLLECT_ERRORS = "tpu_plugin_metrics_collect_errors"
+PLUGIN_FRAGMENTATION = "tpu_plugin_fragmentation"
+PLUGIN_PLACEMENT_SCORE = "tpu_plugin_placement_score"
+
+# -- training ---------------------------------------------------------
+TRAIN_MFU = "tpu_train_mfu"
+DECODE_MFU = "tpu_decode_mfu"
+TRAIN_GOODPUT_RATIO = "tpu_train_goodput_ratio"
+TRAIN_BADPUT_SECONDS = "tpu_train_badput_seconds"
+TRAIN_STEP_SKEW = "tpu_train_step_skew_ratio"
+TRAIN_RECOVERY = "tpu_train_recovery_total"
+TRAIN_CHECKPOINT_BLOCK = "tpu_train_checkpoint_block_seconds"
+
+# -- memory / profiler ------------------------------------------------
+HBM_BYTES_IN_USE = "tpu_hbm_bytes_in_use"
+HBM_PEAK_BYTES = "tpu_hbm_peak_bytes"
+HBM_BYTES_LIMIT = "tpu_hbm_bytes_limit"
+PROFILE_CAPTURES = "tpu_profile_captures_total"
+
+# -- serving ----------------------------------------------------------
+SERVING_SLOT_OCCUPANCY = "tpu_serving_slot_occupancy"
+SERVING_TTFT = "tpu_serving_ttft_seconds"
+SERVING_TPOT = "tpu_serving_tpot_seconds"
+SERVING_SLO_VIOLATIONS = "tpu_serving_slo_violations_total"
+SERVING_SLOTS_ACTIVE = "tpu_serving_slots_active"
+SERVING_SLOTS_FREE = "tpu_serving_slots_free"
+SERVING_KV_BLOCKS_TOTAL = "tpu_serving_kv_blocks_total"
+SERVING_KV_BLOCKS_FREE = "tpu_serving_kv_blocks_free"
+SERVING_KV_BLOCKS_SHARED = "tpu_serving_kv_blocks_shared"
+
+# name -> one-line help. The authoritative set: the metric-registry
+# lint resolves every tpu_* literal in the tree against these keys
+# (accepting the prometheus_client `_total` exposition variant) and
+# requires each key to be mentioned somewhere under docs/.
+METRICS = {
+    PLUGIN_RPC_LATENCY: "plugin gRPC server method latency",
+    CLIENT_RPC_LATENCY: "traced client-side RPC latency",
+    PLUGIN_HEALTH_SWEEP: "one health-poll sweep over all devices",
+    PLUGIN_BUILD_INFO: "constant 1, build version as a label",
+    PLUGIN_COLLECT_ERRORS: "metric collection passes that failed",
+    PLUGIN_FRAGMENTATION: "1 - largest_free_box/free_chips per tiling",
+    PLUGIN_PLACEMENT_SCORE: "last scored placement decision",
+    TRAIN_MFU: "model FLOP utilization of the train step",
+    DECODE_MFU: "model FLOP utilization of the serving decode loop",
+    TRAIN_GOODPUT_RATIO: "productive fraction of train wall time",
+    TRAIN_BADPUT_SECONDS: "non-productive wall seconds by bucket",
+    TRAIN_STEP_SKEW: "per-host step-time skew vs fleet median",
+    TRAIN_RECOVERY: "elastic-training recovery actions by reason",
+    TRAIN_CHECKPOINT_BLOCK: "train-thread-blocking checkpoint time",
+    HBM_BYTES_IN_USE: "allocator bytes in use per device",
+    HBM_PEAK_BYTES: "allocator peak bytes per device",
+    HBM_BYTES_LIMIT: "allocator byte limit per device",
+    PROFILE_CAPTURES: "completed /debug/profile captures",
+    SERVING_SLOT_OCCUPANCY: "active/total slot fraction per step",
+    SERVING_TTFT: "admission-to-first-token latency",
+    SERVING_TPOT: "per-token gap of in-flight rows",
+    SERVING_SLO_VIOLATIONS: "TTFT/TPOT SLO threshold burns",
+    SERVING_SLOTS_ACTIVE: "engine slots decoding this step",
+    SERVING_SLOTS_FREE: "engine slots free this step",
+    SERVING_KV_BLOCKS_TOTAL: "paged KV arena size in blocks",
+    SERVING_KV_BLOCKS_FREE: "paged KV blocks on the free list",
+    SERVING_KV_BLOCKS_SHARED: "paged KV blocks with refcount > 1",
+}
+
+# tpu_-prefixed tokens that are NOT metric names (label keys, module
+# prefixes); the metric-registry lint treats these as known.
+NON_METRIC_TOKENS = frozenset({
+    "tpu_device",           # label key on the plugin gauge set
+    "tpu_metrics_bridge",   # sidecar module name (cmd/)
+    "tpu_diagnose_bundle",  # diagnostics bundle format tag
+})

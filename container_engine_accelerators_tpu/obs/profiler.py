@@ -39,6 +39,8 @@ import tempfile
 import threading
 import time
 
+from ..utils import env_str
+from .metric_names import PROFILE_CAPTURES
 from .trace import get_tracer
 
 PROFILE_PATH = "/debug/profile"
@@ -79,7 +81,7 @@ class ProfileCapture:
             except Exception as e:
                 raise ProfilerUnavailable(
                     f"jax.profiler not importable here: {e!r}")
-            base = out_dir or os.environ.get(OUT_DIR_ENV) \
+            base = out_dir or env_str(OUT_DIR_ENV) \
                 or tempfile.gettempdir()
             os.makedirs(base, exist_ok=True)
             # mkdtemp, not a timestamp name: two sequential captures
@@ -105,7 +107,7 @@ class ProfileCapture:
             self._last = result
             self._tracer.event(CAPTURE_EVENT, artifact=artifact,
                                seconds=seconds)
-            self._tracer.counter("tpu_profile_captures_total")
+            self._tracer.counter(PROFILE_CAPTURES)
             return result
         finally:
             self._lock.release()

@@ -42,9 +42,8 @@ import statistics
 import threading
 from collections import deque
 
+from .metric_names import TRAIN_STEP_SKEW as SKEW_GAUGE
 from .trace import get_tracer
-
-SKEW_GAUGE = "tpu_train_step_skew_ratio"
 DETECTED_EVENT = "straggler.detected"
 RECOVERED_EVENT = "straggler.recovered"
 

@@ -45,6 +45,7 @@ import random
 import threading
 import time
 
+from ..utils import env_number, env_str
 from .identity import identity
 
 DEFAULT_CAP = 4096
@@ -219,10 +220,10 @@ class Tracer:
 
     def __init__(self, capacity=None, enabled=None):
         if capacity is None:
-            capacity = int(os.environ.get("CEA_TPU_TRACE_CAP",
-                                          DEFAULT_CAP))
+            capacity = env_number("CEA_TPU_TRACE_CAP", DEFAULT_CAP,
+                                  parse=int)
         if enabled is None:
-            enabled = os.environ.get("CEA_TPU_TRACE", "1") != "0"
+            enabled = env_str("CEA_TPU_TRACE", "1") != "0"
         self.enabled = bool(enabled)
         self.capacity = max(1, capacity)
         self._lock = threading.Lock()
@@ -471,7 +472,7 @@ def write_journal(path=None, reason=None, state=None, final=False):
     None — it must never raise on an exit path.
     """
     global _final_written
-    env_path = os.environ.get("CEA_TPU_TRACE_FILE")
+    env_path = env_str("CEA_TPU_TRACE_FILE")
     path = path or env_path
     if not path:
         return None

@@ -59,6 +59,8 @@ import time
 import numpy as np
 
 from .. import obs
+from ..analysis import tsan
+from ..obs.metric_names import TRAIN_CHECKPOINT_BLOCK
 from ..utils import get_logger
 
 log = get_logger("checkpoint")
@@ -70,7 +72,7 @@ FORMAT_VERSION = 1
 
 SAVED_EVENT = "train.checkpoint_saved"
 
-_SAVE_HISTOGRAM = "tpu_train_checkpoint_block_seconds"
+_SAVE_HISTOGRAM = TRAIN_CHECKPOINT_BLOCK
 
 
 def _leaf_items(tree):
@@ -143,10 +145,11 @@ def warn_unrecognized_checkpoints(directory, action, stream=None):
             stream = sys.stderr
         plural = "y" if len(foreign) == 1 else "ies"
         more = "..." if len(foreign) > 3 else ""
-        print(f"WARNING: {directory!r} holds {len(foreign)} "
-              f"checkpoint entr{plural} in an unrecognized format "
-              f"(pre-library orbax run?): {foreign[:3]}{more} — "
-              f"{action}", file=stream)
+        stream.write(
+            f"WARNING: {directory!r} holds {len(foreign)} "
+            f"checkpoint entr{plural} in an unrecognized format "
+            f"(pre-library orbax run?): {foreign[:3]}{more} — "
+            f"{action}\n")
     return foreign
 
 
@@ -280,6 +283,7 @@ class CheckpointManager:
                     if self._closed:
                         raise CheckpointError(
                             "save() on a closed CheckpointManager")
+                    tsan.note_write("checkpoint.queue", self)
                     self._pending += 1
                     self._queue.put((arrays, meta, path))
             blocked = time.perf_counter() - t0
@@ -436,6 +440,7 @@ class CheckpointManager:
                     self._error = e
             finally:
                 with self._all_done:
+                    tsan.note_write("checkpoint.queue", self)
                     self._pending -= 1
                     if self._pending == 0:
                         self._all_done.notify_all()

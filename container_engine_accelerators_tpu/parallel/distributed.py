@@ -26,6 +26,7 @@ import os
 import time
 
 from .. import obs
+from ..obs.metric_names import TRAIN_RECOVERY
 from ..utils import env_number, get_logger
 
 log = get_logger("distributed")
@@ -46,9 +47,8 @@ _BACKOFF_CAP_MS = 30_000
 
 # Shares the elastic layer's recovery counter so one Prometheus
 # query covers every recovery-path action (eviction reasons AND
-# coordinator retries/timeouts). Import would be circular-free but
-# keep this module importable without the elastic module loaded.
-RECOVERY_COUNTER = "tpu_train_recovery_total"
+# coordinator retries/timeouts).
+RECOVERY_COUNTER = TRAIN_RECOVERY
 
 
 class DeadlineExceeded(TimeoutError):

@@ -48,6 +48,7 @@ import dataclasses
 import time
 
 from .. import obs
+from ..obs.metric_names import TRAIN_RECOVERY
 from ..utils import env_number, get_logger
 from .data import reassign_shards, shard_assignment
 from .mesh import build_mesh, reshape_spec
@@ -56,7 +57,7 @@ log = get_logger("elastic")
 
 EVICTION_EVENT = "train.eviction"
 RESHAPE_EVENT = "train.reshape"
-RECOVERY_COUNTER = "tpu_train_recovery_total"
+RECOVERY_COUNTER = TRAIN_RECOVERY
 
 EVICT_SKEW_ENV = "CEA_TPU_EVICT_SKEW"
 EVICT_WINDOWS_ENV = "CEA_TPU_EVICT_WINDOWS"

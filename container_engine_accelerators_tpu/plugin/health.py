@@ -36,13 +36,14 @@ import time
 
 from .. import obs
 from ..chip.backend import ChipBackendError, Health
+from ..obs.metric_names import PLUGIN_HEALTH_SWEEP
 from ..utils import get_logger
 from .api import HEALTHY, UNHEALTHY
 from .slice import is_slice_device_id
 
 log = get_logger("health")
 
-_SWEEP_HISTOGRAM = "tpu_plugin_health_sweep_seconds"
+_SWEEP_HISTOGRAM = PLUGIN_HEALTH_SWEEP
 
 DEFAULT_POLL_INTERVAL_S = 5.0
 

@@ -38,6 +38,7 @@ import prometheus_client
 from prometheus_client.core import CollectorRegistry
 
 from .. import obs
+from ..obs.metric_names import PLUGIN_BUILD_INFO, PLUGIN_COLLECT_ERRORS
 from ..utils import get_logger
 from . import config as cfg
 from . import placement
@@ -108,14 +109,14 @@ class MetricServer:
         # joins against any other series on a dashboard to answer
         # "which plugin build produced these numbers".
         self._build_info = prometheus_client.Gauge(
-            "tpu_plugin_build_info", "Plugin build information",
+            PLUGIN_BUILD_INFO, "Plugin build information",
             ["version"], registry=self._registry)
         self._build_info.labels(_read_version()).set(1)
         # A collection pass that dies used to vanish into a log line;
         # a monotonically rising counter makes silent failure
         # scrapeable/alertable.
         self._collect_errors = prometheus_client.Counter(
-            "tpu_plugin_metrics_collect_errors",
+            PLUGIN_COLLECT_ERRORS,
             "Metric collection passes that failed",
             registry=self._registry)
         self._httpd = None

@@ -73,17 +73,21 @@ Environment knobs (all optional; see docs/operations.md):
 import collections
 import json
 import math
-import os
 import re
 import threading
 
-from ..utils import env_number, get_logger
+from ..analysis import tsan
+from ..obs.metric_names import (
+    PLUGIN_FRAGMENTATION,
+    PLUGIN_PLACEMENT_SCORE,
+)
+from ..utils import env_number, env_str, get_logger
 from .api import HEALTHY
 
 log = get_logger("placement")
 
-FRAGMENTATION_GAUGE = "tpu_plugin_fragmentation"
-PLACEMENT_SCORE_GAUGE = "tpu_plugin_placement_score"
+FRAGMENTATION_GAUGE = PLUGIN_FRAGMENTATION
+PLACEMENT_SCORE_GAUGE = PLUGIN_PLACEMENT_SCORE
 PLACEMENT_GAUGES = (FRAGMENTATION_GAUGE, PLACEMENT_SCORE_GAUGE)
 
 DECISION_EVENT = "placement.decision"
@@ -274,7 +278,7 @@ class ProfileStore:
         self._alpha = float(alpha)
         self._lock = threading.Lock()
         self._profiles = {}   # key -> {"mfu": x, "hbm_frac": y, "samples": n}
-        path = path if path is not None else os.environ.get(
+        path = path if path is not None else env_str(
             PROFILE_FILE_ENV, "")
         if path:
             self.load(path)
@@ -311,6 +315,7 @@ class ProfileStore:
             return
         alpha = self._alpha if weight is None else float(weight)
         with self._lock:
+            tsan.note_write("placement.profile_store", self)
             prof = self._profiles.setdefault(
                 str(workload), {"mfu": None, "hbm_frac": None,
                                 "samples": 0})
@@ -364,7 +369,7 @@ def pending_workload_hint(path=None):
     an admission webhook / scheduler plugin writes before binding.
     Best-effort: missing/unreadable file means no profile fit — the
     documented first-fit-equivalent degraded mode, never an error."""
-    path = path if path is not None else os.environ.get(
+    path = path if path is not None else env_str(
         HINT_FILE_ENV, "")
     if not path:
         return None
@@ -404,7 +409,7 @@ class PlacementScorer:
         self.w_profile = (env_number(W_PROFILE_ENV, 1.0)
                           if w_profile is None else float(w_profile))
         if enabled is None:
-            enabled = os.environ.get(ENABLE_ENV, "1") != "0"
+            enabled = env_str(ENABLE_ENV, "1") != "0"
         self.enabled = bool(enabled)
         self._frag_cap_logged = False
 

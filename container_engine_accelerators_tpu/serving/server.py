@@ -31,7 +31,6 @@ Endpoints:
 import contextlib
 import functools
 import json
-import os
 import queue
 import threading
 import time
@@ -42,6 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..analysis import tsan
+from ..obs import metric_names
 from ..obs.efficiency import (
     DECODE_MFU_GAUGE,
     FlopsLedger,
@@ -49,7 +50,7 @@ from ..obs.efficiency import (
     transformer_decode_flops,
 )
 from ..obs.memory import get_monitor, install_postmortem_provider
-from ..utils import get_logger
+from ..utils import env_number, env_str, get_logger
 
 log = get_logger("serving")
 
@@ -57,16 +58,16 @@ REQUEST_HISTOGRAM = "serving_request_latency_seconds"
 DECODE_HISTOGRAM = "serving_decode_latency_seconds"
 # Per-step slot occupancy (active / total, 0..1] — the continuous-
 # batching efficiency signal the engine exists to move.
-OCCUPANCY_HISTOGRAM = "tpu_serving_slot_occupancy"
+OCCUPANCY_HISTOGRAM = metric_names.SERVING_SLOT_OCCUPANCY
 OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
                      1.0)
 # Serving SLO metrics (engine mode): TTFT = admission-queue entry to
 # first token out of the admission prefill; TPOT = gap between
 # consecutive tokens of one row at step-forwarding time. The env
 # thresholds arm the burn counter.
-TTFT_HISTOGRAM = "tpu_serving_ttft_seconds"
-TPOT_HISTOGRAM = "tpu_serving_tpot_seconds"
-SLO_COUNTER = "tpu_serving_slo_violations_total"
+TTFT_HISTOGRAM = metric_names.SERVING_TTFT
+TPOT_HISTOGRAM = metric_names.SERVING_TPOT
+SLO_COUNTER = metric_names.SERVING_SLO_VIOLATIONS
 SLO_TTFT_ENV = "CEA_TPU_SLO_TTFT_MS"
 SLO_TPOT_ENV = "CEA_TPU_SLO_TPOT_MS"
 # HBM sampling cadence on the engine loop: allocator stats are a
@@ -75,18 +76,11 @@ MEMORY_SAMPLE_INTERVAL_S = 2.0
 
 
 def _slo_threshold_s(env_key):
-    raw = os.environ.get(env_key)
-    if not raw:
-        return None
-    try:
-        ms = float(raw)
-    except ValueError:
-        log.warning("ignoring malformed %s=%r", env_key, raw)
-        return None
+    ms = env_number(env_key, None)
     # <= 0 disarms, exactly like unset: a 0 threshold would count
     # every observation as a violation while /stats (where 0.0 is
     # rendered null) claimed no SLO was armed.
-    return ms / 1e3 if ms > 0 else None
+    return ms / 1e3 if ms is not None and ms > 0 else None
 
 
 def _maybe_enable_compile_cache():
@@ -95,7 +89,7 @@ def _maybe_enable_compile_cache():
     replica restarts reuse compiled programs instead of re-paying the
     multi-second per-program cold-start compiles. Called from the
     serving entry points right before the first compile (warm-up)."""
-    cache_dir = os.environ.get("CEA_TPU_COMPILE_CACHE")
+    cache_dir = env_str("CEA_TPU_COMPILE_CACHE")
     if cache_dir:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update(
@@ -510,6 +504,7 @@ class _EngineService:
     def _finish(self, work, error=None):
         if work.slot is not None:
             self._engine.release(work.slot)
+            tsan.note_write("serving.slot_work", self)
             self._slot_work.pop(work.slot, None)
             work.slot = None
         self._admission.release(1)
@@ -607,6 +602,7 @@ class _EngineService:
         finally:
             self._prefill_hist.observe(time.perf_counter() - t0)
         work.slot = slot
+        tsan.note_write("serving.slot_work", self)
         self._slot_work[slot] = work
         with self._lock:
             self._admitted += 1
@@ -686,17 +682,17 @@ class _EngineService:
                 step_dt = time.perf_counter() - t0
                 self._step_hist.observe(step_dt)
             self._occ_hist.observe(active / self._engine.slots)
-            obs.gauge("tpu_serving_slots_active", active)
-            obs.gauge("tpu_serving_slots_free",
+            obs.gauge(metric_names.SERVING_SLOTS_ACTIVE, active)
+            obs.gauge(metric_names.SERVING_SLOTS_FREE,
                       self._engine.slots - active)
             kv = self._engine.kv_block_stats()
             if kv is not None:
                 # Host-integer reads — no device sync rides on these.
-                obs.gauge("tpu_serving_kv_blocks_total",
+                obs.gauge(metric_names.SERVING_KV_BLOCKS_TOTAL,
                           kv["kv_blocks_total"])
-                obs.gauge("tpu_serving_kv_blocks_free",
+                obs.gauge(metric_names.SERVING_KV_BLOCKS_FREE,
                           kv["kv_blocks_free"])
-                obs.gauge("tpu_serving_kv_blocks_shared",
+                obs.gauge(metric_names.SERVING_KV_BLOCKS_SHARED,
                           kv["kv_blocks_shared"])
             # Decode MFU (2·N FLOPs per active row per step; N =
             # the ACTIVE param count, so MoE's unrouted experts
@@ -736,8 +732,7 @@ class _BaseServer:
 
     def __init__(self, model_name, port, plugin_socket=None):
         self._plugin_socket = (plugin_socket
-                               or os.environ.get(
-                                   "CEA_TPU_PLUGIN_SOCKET"))
+                               or env_str("CEA_TPU_PLUGIN_SOCKET"))
         self._plugin_status_cache = None  # (monotonic, result)
         self._name = model_name
         # Readiness: /healthz answers 503 until set. Servers that

@@ -20,7 +20,7 @@ from .paths import accel_index, device_name_from_path, is_accel_name
 from .log import get_logger, set_verbosity
 
 __all__ = ["accel_index", "device_name_from_path", "env_number",
-           "is_accel_name", "get_logger", "set_verbosity"]
+           "env_str", "is_accel_name", "get_logger", "set_verbosity"]
 
 
 def env_number(name, default, parse=float):
@@ -36,3 +36,14 @@ def env_number(name, default, parse=float):
         get_logger("env").warning("ignoring non-numeric %s=%r",
                                   name, raw)
         return default
+
+
+def env_str(name, default=None):
+    """String env-var knob: the raw value, or ``default`` when the
+    variable is UNSET (an explicitly empty value comes back as "" —
+    flag knobs distinguish "operator said nothing" from "operator
+    said off"). Every project env read (``CEA_TPU_*`` /
+    ``TPU_PLUGIN_*``) goes through this or :func:`env_number` so the
+    analysis suite's ``env-registry`` lint can hold the knob surface
+    to the docs/operations.md table."""
+    return os.environ.get(name, default)

@@ -52,6 +52,7 @@ from container_engine_accelerators_tpu.serving import (
     GenerationServer,
     InferenceServer,
 )
+from container_engine_accelerators_tpu.utils import env_str
 
 
 def load_checkpoint_variables(model_dir, init_variables):
@@ -155,7 +156,7 @@ def main(argv=None):
                         "empty serves randomly-initialized weights "
                         "(load-testing only)")
     p.add_argument("--compilation-cache-dir",
-                   default=(os.environ.get("CEA_TPU_COMPILE_CACHE")
+                   default=(env_str("CEA_TPU_COMPILE_CACHE")
                             or os.environ.get(
                                 "JAX_COMPILATION_CACHE_DIR", "")),
                    help="persistent XLA compile cache (hostPath or "

@@ -32,6 +32,21 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Lock-order sanitizer: CEA_TPU_TSAN=1 wraps threading.Lock/RLock for
+# the whole session (installed BEFORE jax/package imports so every
+# project lock construction is seen). pytest_sessionfinish below
+# writes the findings report; `make analysis-check` drives this and
+# fails on a dirty report.
+_TSAN = None
+if os.environ.get("CEA_TPU_TSAN", "") not in ("", "0"):
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from container_engine_accelerators_tpu.analysis import (  # noqa: E402
+        tsan as _tsan_mod,
+    )
+    _TSAN = _tsan_mod
+    _TSAN.install()
+
 # The axon sitecustomize pre-imports jax and pins
 # jax_platforms="axon,cpu" via jax.config (overriding the env), which
 # makes the first backends() call dial the remote TPU tunnel from
@@ -60,6 +75,23 @@ def _ensure_native_lib():
 
 
 NATIVE_LIB = _ensure_native_lib()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Under CEA_TPU_TSAN=1, print the sanitizer report and write it
+    to CEA_TPU_TSAN_REPORT (JSON) — tools/analysis_check.py reads the
+    file and fails the gate when the run was dirty."""
+    if _TSAN is None or not _TSAN.enabled():
+        return
+    rep = _TSAN.report()
+    path = os.environ.get("CEA_TPU_TSAN_REPORT")
+    if path:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(rep, f, indent=1)
+            f.write("\n")
+    print("\n" + _TSAN.format_report(rep), file=sys.stderr)
 
 
 @pytest.fixture(autouse=True, scope="module")

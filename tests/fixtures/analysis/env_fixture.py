@@ -1,0 +1,47 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Seeded violations for the env rules (never imported, only
+linted). Each trailing ``# EXPECT:`` names the rules that must fire
+on exactly that line; the escape lines must stay silent."""
+
+import os
+
+from container_engine_accelerators_tpu.utils import env_number, env_str
+
+# A raw read of a project env var: both the bare-read rule and (the
+# name being absent from the ops table) the registry rule fire.
+RAW = os.environ.get("CEA_TPU_FIXTURE_UNDOC")  # EXPECT: bare-env-read,env-registry
+
+# Subscript read form.
+RAW2 = os.environ["CEA_TPU_FIXTURE_UNDOC2"]  # EXPECT: bare-env-read,env-registry
+
+# Through the blessed helper, but the knob has no docs row.
+HELPED = env_str("CEA_TPU_FIXTURE_UNDOC3")  # EXPECT: env-registry
+
+# Name resolved through a module constant.
+KNOB_ENV = "CEA_TPU_FIXTURE_UNDOC4"  # EXPECT: env-registry
+KNOB = env_number(KNOB_ENV, 1.0)
+
+# Non-project names are out of scope.
+FINE = os.environ.get("PATH")
+
+# A documented project knob read through the helper: clean.
+TRACE = env_str("CEA_TPU_TRACE", "1")
+
+# Escapes silence both rules.
+ESCAPED = os.environ.get("CEA_TPU_FIXTURE_UNDOC")  # lint: disable=bare-env-read,env-registry
+
+# Writes are harness setup, not reads.
+os.environ["CEA_TPU_FIXTURE_UNDOC5"] = "1"  # EXPECT: env-registry

@@ -1,0 +1,29 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Seeded violation for jax-free-import: this module declares itself
+jax-free (the marker below) and then imports jax at module scope.
+Linted, never imported."""
+
+# lint: jax-free
+
+import os  # clean: stdlib
+
+import jax  # EXPECT: jax-free-import
+
+
+def lazy_is_fine():
+    import jax.numpy as jnp  # function-scope: the sanctioned pattern
+
+    return jnp, jax, os

@@ -1,0 +1,41 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Seeded violations for time-in-jit (linted, never imported)."""
+
+import functools
+import time
+
+import jax
+
+
+@jax.jit
+def bad_plain(x):
+    t0 = time.time()  # EXPECT: time-in-jit
+    return x + t0
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def bad_partial(x, flag=True):
+    return x * time.perf_counter()  # EXPECT: time-in-jit
+
+
+@jax.jit
+def escaped(x):
+    return x + time.monotonic()  # lint: disable=time-in-jit
+
+
+def timing_outside_is_fine():
+    t0 = time.perf_counter()
+    return t0

@@ -1,0 +1,47 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Seeded violations for lock-with (linted, never imported)."""
+
+import threading
+
+_LOCK = threading.Lock()
+
+
+def bare_blocking_acquire():
+    _LOCK.acquire()  # EXPECT: lock-with
+    try:
+        return 1
+    finally:
+        _LOCK.release()
+
+
+def checked_probe_is_fine():
+    # Non-blocking probe with a checked result: the profiler pattern.
+    if _LOCK.acquire(blocking=False):
+        try:
+            return 1
+        finally:
+            _LOCK.release()
+    return 0
+
+
+def with_is_fine():
+    with _LOCK:
+        return 2
+
+
+def escaped():
+    _LOCK.acquire()  # lint: disable=lock-with
+    _LOCK.release()

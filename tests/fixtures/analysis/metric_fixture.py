@@ -1,0 +1,32 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Seeded violations for the metric-registry rule (linted, never
+imported)."""
+
+# Unregistered metric literal: the drift the rule exists to kill.
+DRIFTED = "tpu_fixture_unregistered_series"  # EXPECT: metric-registry
+
+# A typo'd copy of a real name is exactly the same failure mode.
+TYPO = "tpu_serving_slot_occupancy_seconds"  # EXPECT: metric-registry
+
+# Registered names, exposition variants, and registered non-metric
+# tokens are all clean.
+OK = "tpu_train_mfu"
+OK_TOTAL = "tpu_plugin_metrics_collect_errors_total"
+OK_BUCKET = "tpu_serving_ttft_seconds_bucket"
+OK_LABEL = "tpu_device"
+
+# Escape hatch.
+ESCAPED = "tpu_fixture_escaped_series"  # lint: disable=metric-registry

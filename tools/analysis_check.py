@@ -1,0 +1,179 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The `make analysis-check` gate: lint + tsan + retrace, end to end.
+
+Four legs, each of which must BOTH pass on the real tree and fail on
+its seeded fixture (a gate that cannot fire is worse than no gate):
+
+1. **Lint, zero findings** over the default scope (package, tools/,
+   cmd/, demo/) — convention drift fails here, not in review.
+2. **Lint fixtures**: every seeded violation under
+   tests/fixtures/analysis fires exactly where its ``# EXPECT:``
+   annotation says, and nowhere else (escape comments respected).
+3. **Lock-order sanitizer**: the engine/elastic/placement test
+   suites run under ``CEA_TPU_TSAN=1`` and the session report must
+   be clean (no cycles, no unguarded writes, no recursive
+   acquires); a deliberately inverted-lock fixture run in-process
+   must be flagged.
+4. **Retrace guard**: a bucketed mixed-traffic trace (greedy +
+   filtered sampling + penalties + prefix sharing + COW forks +
+   block-boundary growth) through the paged engine must hold the
+   buckets + insert + step program bound, and a seeded
+   always-retracing jit function must be caught.
+
+Pure CPU; ~2-3 min dominated by the tsan pytest pass.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+FAILS = []
+
+
+def section(name, ok, detail=""):
+    tag = "ok" if ok else "FAIL"
+    print(f"[analysis-check] {name}: {tag}"
+          + (f" — {detail}" if detail else ""))
+    if not ok:
+        FAILS.append(name)
+
+
+def check_lint_tree():
+    from container_engine_accelerators_tpu.analysis import run_lint
+
+    findings = run_lint(root=REPO)
+    for f in findings:
+        print("  " + f.format())
+    section("lint zero findings on tree", not findings,
+            f"{len(findings)} finding(s)" if findings else "")
+
+
+def check_lint_fixtures():
+    from container_engine_accelerators_tpu.analysis.lint import (
+        verify_fixtures,
+    )
+
+    missing, unexpected = verify_fixtures(
+        os.path.join("tests", "fixtures", "analysis"), root=REPO)
+    for key in missing:
+        print(f"  fixture violation did NOT fire: {key}")
+    for key in unexpected:
+        print(f"  unexpected finding: {key}")
+    section("lint fixtures fire exactly as seeded",
+            not missing and not unexpected)
+
+
+def check_tsan_fixture():
+    """The inverted-lock fixture must produce a cycle."""
+    from container_engine_accelerators_tpu.analysis.selfcheck import (
+        inverted_lock_report,
+    )
+
+    rep = inverted_lock_report()
+    section("tsan flags the inverted-lock fixture",
+            bool(rep["cycles"]),
+            "no cycle reported" if not rep["cycles"] else "")
+
+
+def check_tsan_suites():
+    """Engine + elastic + placement suites under the shim; the
+    session report (written by conftest) must exist and be clean."""
+    from container_engine_accelerators_tpu.analysis import tsan
+
+    report_path = os.path.join(
+        tempfile.mkdtemp(prefix="tsan-check-"), "report.json")
+    env = dict(os.environ, CEA_TPU_TSAN="1",
+               CEA_TPU_TSAN_REPORT=report_path)
+    cmd = [sys.executable, "-m", "pytest",
+           "tests/test_paging.py", "tests/test_engine.py",
+           "tests/test_elastic.py", "tests/test_placement.py",
+           "-q", "-p", "no:cacheprovider"]
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        print(proc.stdout[-3000:])
+        print(proc.stderr[-3000:])
+        section("tsan pass over engine/elastic/placement suites",
+                False, f"pytest rc {proc.returncode}")
+        return
+    try:
+        with open(report_path) as f:
+            rep = json.load(f)
+    except (OSError, ValueError) as e:
+        section("tsan pass over engine/elastic/placement suites",
+                False, f"no report written: {e}")
+        return
+    clean = tsan.is_clean(rep)
+    if not clean:
+        print(tsan.format_report(rep))
+    section("tsan pass over engine/elastic/placement suites", clean,
+            f"{rep['locks_created']} locks, {rep['edges']} edges"
+            if clean else "")
+
+
+def check_retrace_bound():
+    """Mixed greedy/filtered/penalty/shared/fork traffic with block-
+    boundary growth must stay inside buckets + insert + step."""
+    from container_engine_accelerators_tpu.analysis.retrace import (
+        RetraceError,
+    )
+    from container_engine_accelerators_tpu.analysis.selfcheck import (
+        mixed_traffic_compile_counts,
+    )
+
+    try:
+        counts = mixed_traffic_compile_counts()
+        section("retrace bound holds on mixed traffic", True,
+                str(counts))
+    except RetraceError as e:
+        section("retrace bound holds on mixed traffic", False,
+                str(e))
+
+
+def check_retrace_fixture():
+    from container_engine_accelerators_tpu.analysis.selfcheck import (
+        seeded_retracer_caught,
+    )
+
+    section("retrace guard catches the seeded retracer",
+            seeded_retracer_caught())
+
+
+def main():
+    check_lint_tree()
+    check_lint_fixtures()
+    check_tsan_fixture()
+    check_tsan_suites()
+    check_retrace_bound()
+    check_retrace_fixture()
+    if FAILS:
+        print(f"[analysis-check] FAILED: {FAILS}")
+        return 1
+    print("[analysis-check] all legs passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

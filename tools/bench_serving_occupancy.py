@@ -48,6 +48,10 @@ drawn in the same unit. Metrics:
 ``--check`` exits non-zero unless engine goodput >= --check-factor x
 baseline goodput AND every greedy output matched its reference —
 the CI gate behind ``make occupancy-check`` (CPU fake backend).
+Every replay runs under the analysis suite's retrace guard: a
+silent recompile of the insert or step program (weak_type/shape
+leak) fails the bench loudly instead of quietly inflating every
+latency number it reports.
 
 **Shared-prefix trace (``--paging-check``, ``make paging-check``).**
 A second Poisson trace where ``--shared-frac`` of requests open with
@@ -95,6 +99,24 @@ def build_trace(args, rng):
     return trace
 
 
+def _step_insert_guard(paged):
+    """Retrace guard on the engine's ONE-insert + ONE-step bound for
+    a whole replay. Admission prefill legitimately compiles one
+    program per distinct width on these unbucketed traces, so only
+    insert/step carry a budget here; `make analysis-check` holds the
+    full buckets+insert+step bound on a bucketed mixed trace."""
+    from container_engine_accelerators_tpu.analysis.retrace import (
+        RetraceGuard,
+        engine_programs,
+    )
+
+    progs = engine_programs(paged)
+    guard = RetraceGuard()
+    guard.watch(progs[1][0], progs[1][1], max_new=1)
+    guard.watch(progs[2][0], progs[2][1], max_new=1)
+    return guard
+
+
 def run_engine(model, params, trace, args):
     """Real continuous-batching replay on the slot engine."""
     from container_engine_accelerators_tpu.models.decode import (
@@ -127,20 +149,21 @@ def run_engine(model, params, trace, args):
             else:
                 slot_req[slot] = i
 
-    while queue or slot_req:
-        admit_ready()
-        if not slot_req:
-            if queue:                   # idle until the next arrival
-                t = max(t, trace[queue[0]]["arrival"])
-            continue
-        toks, _ = eng.step()
-        t += 1.0
-        for slot, i in list(slot_req.items()):
-            outputs[i].append(int(toks[slot]))
-            if len(outputs[i]) >= trace[i]["new"]:
-                latency[i] = t - trace[i]["arrival"]
-                eng.release(slot)
-                del slot_req[slot]
+    with _step_insert_guard(eng.paged):
+        while queue or slot_req:
+            admit_ready()
+            if not slot_req:
+                if queue:               # idle until the next arrival
+                    t = max(t, trace[queue[0]]["arrival"])
+                continue
+            toks, _ = eng.step()
+            t += 1.0
+            for slot, i in list(slot_req.items()):
+                outputs[i].append(int(toks[slot]))
+                if len(outputs[i]) >= trace[i]["new"]:
+                    latency[i] = t - trace[i]["arrival"]
+                    eng.release(slot)
+                    del slot_req[slot]
 
     calls = eng.steps + eng.prefills
     tokens = sum(r["new"] for r in trace)
@@ -212,19 +235,20 @@ def replay_pool(eng, trace):
                 slot_req[slot] = i
             peak = max(peak, eng.active_count())
 
-    while queue or slot_req:
-        admit_ready()
-        if not slot_req:
-            if queue:
-                t = max(t, trace[queue[0]]["arrival"])
-            continue
-        toks, _ = eng.step()
-        t += 1.0
-        for slot, i in list(slot_req.items()):
-            outputs[i].append(int(toks[slot]))
-            if len(outputs[i]) >= trace[i]["new"]:
-                eng.release(slot)
-                del slot_req[slot]
+    with _step_insert_guard(eng.paged):
+        while queue or slot_req:
+            admit_ready()
+            if not slot_req:
+                if queue:
+                    t = max(t, trace[queue[0]]["arrival"])
+                continue
+            toks, _ = eng.step()
+            t += 1.0
+            for slot, i in list(slot_req.items()):
+                outputs[i].append(int(toks[slot]))
+                if len(outputs[i]) >= trace[i]["new"]:
+                    eng.release(slot)
+                    del slot_req[slot]
     return outputs, {
         "steps": eng.steps,
         "prefills": eng.prefills,
@@ -424,9 +448,23 @@ def main(argv=None):
                         jnp.zeros((1, 8), jnp.int32))["params"]
 
     if args.paging or args.paging_check:
-        summary = run_paging(model, params, args)
+        # The paged pool's host bookkeeping (refcounts, tables,
+        # committed reservations) runs under the lock-order
+        # sanitizer here: the engine contract is single-threaded
+        # and the suites run clean — pin that in the capacity gate.
+        from container_engine_accelerators_tpu.analysis import tsan
+
+        with tsan.session(force=True) as tsan_state:
+            summary = run_paging(model, params, args)
+            tsan_rep = tsan_state.report()
+        summary["tsan_clean"] = tsan.is_clean(tsan_rep)
         summary["platform"] = jax.devices()[0].platform
         print(json.dumps(summary))
+        if not summary["tsan_clean"]:
+            print(tsan.format_report(tsan_rep), file=sys.stderr)
+            print("[paging] FAIL: lock-order sanitizer reported "
+                  "findings during the replay", file=sys.stderr)
+            return 1
         if not summary["greedy_exact"]:
             print("[paging] FAIL: a greedy stream diverged from "
                   "per-request decode", file=sys.stderr)

@@ -71,6 +71,7 @@ if "xla_force_host_platform_device_count" not in _xla:
 os.environ["CEA_TPU_TRACE"] = "1"  # events are the acceptance surface
 
 from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.analysis import tsan  # noqa: E402
 
 obs.set_role("train")
 
@@ -501,6 +502,11 @@ def main():
 
     failures = []
     report = {}
+    # The whole episode runs under the lock-order sanitizer: the
+    # checkpoint worker, health poller, and supervisor interleavings
+    # this harness exercises are exactly where an inversion would
+    # hide, and the suites run clean today — pin that.
+    tsan_state = tsan.install(force=True)
     root = tempfile.mkdtemp(prefix="tpu-chaos-check")
     dev, state_dir = fake_node(root)
     backend = PyChipBackend()
@@ -548,7 +554,16 @@ def main():
         stop_workers(workers)
         manager.stop()
         shutil.rmtree(root, ignore_errors=True)
+        tsan_rep = tsan_state.report()
+        tsan.uninstall()
 
+    report["tsan"] = {"locks": tsan_rep["locks_created"],
+                      "edges": tsan_rep["edges"]}
+    if not tsan.is_clean(tsan_rep):
+        print(tsan.format_report(tsan_rep), file=sys.stderr)
+        failures.append(
+            "lock-order sanitizer reported findings over the chaos "
+            "episode (cycles/unguarded writes/recursive acquires)")
     report["failures"] = failures
     print(json.dumps(report))
     if failures:

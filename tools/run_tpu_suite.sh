@@ -136,6 +136,18 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "paging-check preflight"
 
+# Analysis preflight (CPU, ~3 min): zero lint findings on the tree
+# (with every seeded fixture violation firing), a clean lock-order
+# sanitizer pass over the engine/elastic/placement suites, and the
+# engine's program-count bound held by the retrace guard. A
+# regression here means convention drift or a concurrency hazard
+# landed that review has historically only caught by hand.
+echo "[suite] analysis-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/analysis_check.py \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "analysis-check preflight"
+
 # Continuous-batching preflight (CPU fake backend, ~1 min): the slot
 # engine must beat the sequential-batch policy >= 2x in goodput on a
 # replayed Poisson trace with greedy outputs bit-identical to
