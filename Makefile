@@ -35,6 +35,7 @@ presubmit:
 	./build/check_logging.sh
 	./build/check_boilerplate.sh
 	python3 -m container_engine_accelerators_tpu.analysis
+	JAX_PLATFORMS=cpu python3 tools/program_manifest.py --check
 
 # Project-native analysis gate: the AST lint must report ZERO
 # findings over the tree while every seeded fixture violation fires;
@@ -45,6 +46,17 @@ presubmit:
 # trace and catch the seeded retracer. Pure CPU, ~3 min.
 analysis-check:
 	JAX_PLATFORMS=cpu python3 tools/analysis_check.py
+
+# Program-manifest gate: lower every registered hot program (paged +
+# dense engine trios, parallel train step) with canonical example
+# args, run the IR hygiene rules (donation-miss, const-capture,
+# host-callback-in-hot-path, weak-type-leak, dtype-upcast — zero
+# findings required), and diff the derived fingerprints against the
+# committed PROGRAM_MANIFEST.json: unexpected programs, donation/
+# aval drift, or >10% FLOPs/bytes movement fail with --update
+# instructions. Pure CPU, ~1 min.
+program-check:
+	JAX_PLATFORMS=cpu python3 tools/program_manifest.py --check
 
 # Tracer leak/regression guard: fake-chip plugin up, one Allocate
 # through the real gRPC surface, fail on empty /debug/trace or any
@@ -130,6 +142,6 @@ clean:
 	$(MAKE) -C demo/tpu-error clean
 
 .PHONY: all native test test-native test-native-asan presubmit bench \
-	analysis-check trace-check diagnose-check goodput-check \
-	chaos-check placement-check occupancy-check paging-check \
-	container partition-tpu push clean
+	analysis-check program-check trace-check diagnose-check \
+	goodput-check chaos-check placement-check occupancy-check \
+	paging-check container partition-tpu push clean

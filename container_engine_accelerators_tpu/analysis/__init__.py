@@ -39,9 +39,16 @@ answer to the reference repo's `go vet` + `go test -race` discipline):
   insert + step) across mixed traffic and fails loudly on silent
   recompiles.
 
-This package is jax-free at import time by contract (retrace imports
-jax lazily, inside calls) — the lint's own ``jax-free-import`` rule
-enforces it.
+* :mod:`.xprog` — IR-level program hygiene: lowers every registered
+  hot program (``hot_program_specs`` in models.decode and
+  parallel.train) with canonical example args, walks the jaxpr for
+  donation masks, captured constants, host callbacks, weak types,
+  and bf16→f32 upcasts, and fingerprints each program into the
+  committed ``PROGRAM_MANIFEST.json`` (``make program-check``).
+
+This package is jax-free at import time by contract (retrace and
+xprog import jax lazily, inside calls) — the lint's own
+``jax-free-import`` rule enforces it.
 """
 
 from .lint import Finding, Project, run_lint

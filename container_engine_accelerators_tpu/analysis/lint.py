@@ -408,12 +408,29 @@ def verify_fixtures(fixture_dir, root=None, project=None):
     against its inline EXPECT annotations. Returns (missing,
     unexpected) — both empty means every rule fires exactly where
     the fixtures say and nowhere else. Shared by tests/test_analysis
-    and tools/analysis_check."""
+    and tools/analysis_check.
+
+    Expectations are filtered to the LINT rule registry: the IR
+    fixture file (analysis.xprog) shares the fixture tree and the
+    EXPECT grammar, and its rules are verified by
+    ``xprog.verify_fixtures`` — each verifier holds only its own
+    rules accountable. An EXPECT naming a rule NEITHER verifier
+    knows is a hard error, not a silent drop: a typo'd id would
+    otherwise leave its seeded violation verified by nothing."""
+    from .rules import rule_ids
+    from .xprog import IR_RULES
     root = os.path.abspath(root or _find_repo_root())
+    known = set(rule_ids()) | {"syntax-error"}
+    recognized = known | set(IR_RULES)
     expected = set()
     for path in iter_source_files(root, [fixture_dir]):
         rel = os.path.relpath(path, root)
-        expected |= fixture_expectations(path, rel)
+        keys = fixture_expectations(path, rel)
+        unknown = sorted(k for k in keys if k[2] not in recognized)
+        if unknown:
+            raise ValueError(
+                f"fixture EXPECT names unknown rule id(s): {unknown}")
+        expected |= {key for key in keys if key[2] in known}
     findings = run_lint(paths=[fixture_dir], root=root,
                         project=project)
     got = {f.key() for f in findings}

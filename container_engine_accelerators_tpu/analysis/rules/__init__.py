@@ -19,6 +19,7 @@ from .hygiene_rules import TimeInJitRule
 from .import_rules import JaxFreeImportRule
 from .lock_rules import LockWithRule
 from .metric_rules import MetricRegistryRule
+from .registry_rules import ProgramRegistryRule
 
 _ALL = (
     EnvRegistryRule,
@@ -27,6 +28,7 @@ _ALL = (
     JaxFreeImportRule,
     LockWithRule,
     TimeInJitRule,
+    ProgramRegistryRule,
 )
 
 
@@ -41,4 +43,5 @@ def rule_ids():
 
 __all__ = ["all_rules", "rule_ids", "BareEnvReadRule",
            "EnvRegistryRule", "JaxFreeImportRule", "LockWithRule",
-           "MetricRegistryRule", "TimeInJitRule"]
+           "MetricRegistryRule", "ProgramRegistryRule",
+           "TimeInJitRule"]

@@ -1,0 +1,93 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``program-registry``: module-scope jits must be in the registry.
+
+The program manifest (analysis.xprog, PROGRAM_MANIFEST.json) can only
+pin what the hot-program registry names. A new module-scope
+``jax.jit`` / ``functools.partial(jax.jit, ...)`` in ``models/`` or
+``parallel/`` that never reaches ``hot_program_specs()`` would make
+the manifest silently non-exhaustive — exactly the drift the gate
+exists to prevent. So: every module-scope jit in those trees must be
+referenced (by name) inside the module's ``hot_program_specs``
+function, or carry an explicit ``# lint: disable=program-registry``
+stating why it is not a hot program.
+
+A module outside models//parallel/ opts in with a ``# lint:
+program-module`` comment (how the fixture suite seeds violations).
+"""
+
+import ast
+
+from ..lint import PACKAGE_NAME, Finding
+from .hygiene_rules import _is_jit_decorator
+
+REGISTRY_FN = "hot_program_specs"
+
+_SCOPED_PREFIXES = (f"{PACKAGE_NAME}/models/",
+                    f"{PACKAGE_NAME}/parallel/")
+_MARKER = "# lint: program-module"
+
+
+class ProgramRegistryRule:
+    id = "program-registry"
+    hint = (f"reference the program in {REGISTRY_FN}() with "
+            "canonical example args so the manifest sees it, or "
+            "escape with # lint: disable=program-registry and say "
+            "why it is not a hot program")
+
+    def _declared(self, ctx):
+        rel = ctx.rel.replace("\\", "/")
+        return (rel.startswith(_SCOPED_PREFIXES)
+                or _MARKER in ctx.source)
+
+    def check(self, ctx, project):
+        if not self._declared(ctx):
+            return
+        registered = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == REGISTRY_FN):
+                registered.update(
+                    sub.id for sub in ast.walk(node)
+                    if isinstance(sub, ast.Name))
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                if not any(_is_jit_decorator(d)
+                           for d in node.decorator_list):
+                    continue
+                if node.name in registered:
+                    continue
+                line = (node.decorator_list[0].lineno
+                        if node.decorator_list else node.lineno)
+                yield Finding(
+                    ctx.rel, line, self.id,
+                    f"module-scope jitted program {node.name} is "
+                    f"not in {REGISTRY_FN}() — the program manifest "
+                    "cannot see inside it", self.hint)
+            elif isinstance(node, ast.Assign):
+                if not (isinstance(node.value, ast.Call)
+                        and _is_jit_decorator(node.value)):
+                    continue
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                if names and all(n in registered for n in names):
+                    continue
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f"module-scope jit binding "
+                    f"{', '.join(names) or '<expression>'} is not "
+                    f"in {REGISTRY_FN}() — the program manifest "
+                    "cannot see inside it", self.hint)

@@ -1,0 +1,580 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""IR-level program hygiene: facts and rules over lowered hot programs.
+
+The lint (PR 9) guards the *source* and the retrace guard the *count*
+of compiled programs; nothing inspects what is inside the programs the
+perf story rides on. A dropped ``donate_argnums`` silently doubles
+KV/state HBM, a closure-captured array bakes megabytes of constants
+into every executable, and a ``debug.print`` in the step program
+stalls every decode step — none of which fails any gate from the
+outside. This module lowers each REGISTERED hot program with canonical
+example args, walks its jaxpr, and extracts a :class:`ProgramFacts`
+record:
+
+* input/output avals (shape, dtype, ``weak_type``) with pytree paths;
+* the donation mask (``Lowered.args_info``);
+* closure-captured constants baked into the jaxpr, sized;
+* host callbacks (``pure_callback`` / ``io_callback`` /
+  ``debug_callback`` — ``jax.debug.print`` lowers to the latter),
+  found by a recursive equation walk through scan/cond/while bodies;
+* bf16→f32 ``convert_element_type`` upcast sites;
+* ``cost_analysis`` FLOPs / bytes accessed.
+
+On top of the facts, :func:`check_facts` runs lint-style IR rules
+(:data:`IR_RULES`) reusing the lint's :class:`~.lint.Finding` shape,
+anchored at the program's ``def``/decorator line so seeded fixtures
+pin firing lines with the same ``# EXPECT:`` grammar as the lint
+fixtures. Escapes are per-spec allowlists (:class:`HotProgram`
+fields), not comments — an IR finding has no source line of its own
+to escape on.
+
+The hot-program registry lives NEXT TO the jits
+(``models.decode.hot_program_specs`` and
+``parallel.train.hot_program_specs``; :func:`default_registry`
+concatenates them); ``tools/program_manifest.py`` derives the
+committed ``PROGRAM_MANIFEST.json`` from it via
+:func:`derive_manifest` and ``make program-check`` re-derives and
+:func:`diff_manifest`\\ s — unexpected programs, donation/aval drift,
+or >10% FLOPs/bytes movement fail with ``--update`` instructions.
+
+jax is imported lazily inside the functions that lower programs, so
+the analysis package stays importable on the jax-free plugin path.
+"""
+
+import hashlib
+import json
+import os
+import re
+
+from .lint import Finding, _find_repo_root
+
+# The IR rule set. Ordered as reported.
+IR_RULES = (
+    "donation-miss",
+    "const-capture",
+    "host-callback-in-hot-path",
+    "weak-type-leak",
+    "dtype-upcast",
+)
+
+# Host-callback primitives: every shape a host round trip can take in
+# a traced program (jax.debug.print lowers to debug_callback).
+CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+
+# Default byte thresholds. Cache/state-sized means "big enough that
+# double-buffering it is real HBM": one 4 KiB page. Canonical example
+# models are tiny, so the thresholds must sit below their cache leaves
+# (the paged arena leaves are ~12 KiB) yet above sampling-knob
+# vectors and rng keys.
+DONATION_MIN_BYTES = 4096
+CONST_MAX_BYTES = 4096
+
+# Relative FLOPs/bytes drift tolerated by the manifest diff: XLA's
+# cost model moves a little across versions; topology changes move a
+# lot. 10% separates the two (ISSUE 10 acceptance).
+COST_TOLERANCE = 0.10
+
+
+class HotProgram:
+    """One registered hot program: the jitted callable plus canonical
+    example args (captured from a real call site, so the facts pin the
+    program as production actually invokes it).
+
+    Per-spec allowlists are the IR rules' escape hatch:
+
+    * ``allow_undonated`` — input-path substrings the donation-miss
+      rule skips (a documented read-only aliasing input);
+    * ``allow_weak`` — input-path substrings weak-type-leak skips;
+    * ``allow_callbacks`` — True for a program whose callbacks are
+      the point (none in-tree today);
+    * ``compute_dtype`` — declare ``"bfloat16"`` to arm the
+      dtype-upcast rule; ``upcast_allow`` is the number of INTENDED
+      bf16→f32 upcast sites (e.g. an f32 logprob tail).
+    """
+
+    __slots__ = ("name", "fn", "args", "kwargs", "compute_dtype",
+                 "upcast_allow", "allow_undonated", "allow_weak",
+                 "allow_callbacks", "donation_min_bytes",
+                 "const_max_bytes")
+
+    def __init__(self, name, fn, args, kwargs=None, *,
+                 compute_dtype=None, upcast_allow=0,
+                 allow_undonated=(), allow_weak=(),
+                 allow_callbacks=False,
+                 donation_min_bytes=DONATION_MIN_BYTES,
+                 const_max_bytes=CONST_MAX_BYTES):
+        self.name = name
+        self.fn = fn
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+        self.compute_dtype = compute_dtype
+        self.upcast_allow = int(upcast_allow)
+        self.allow_undonated = tuple(allow_undonated)
+        self.allow_weak = tuple(allow_weak)
+        self.allow_callbacks = bool(allow_callbacks)
+        self.donation_min_bytes = int(donation_min_bytes)
+        self.const_max_bytes = int(const_max_bytes)
+
+
+class ProgramFacts:
+    """What is actually inside one lowered program."""
+
+    __slots__ = ("name", "anchor_path", "anchor_line", "inputs",
+                 "outputs", "const_count", "const_bytes",
+                 "consts_large", "callbacks", "upcasts", "flops",
+                 "bytes_accessed")
+
+    def __init__(self, **kw):
+        for slot in self.__slots__:
+            setattr(self, slot, kw[slot])
+
+
+def _anchor(fn):
+    """(abs file, line) of the program's definition — the decorator
+    line for decorated defs (where fixtures put their EXPECT
+    comments), the ``def`` line for dynamically built steps."""
+    target = getattr(fn, "__wrapped__", fn)
+    code = getattr(target, "__code__", None)
+    if code is None:
+        return "<unknown>", 1
+    return code.co_filename, code.co_firstlineno
+
+
+def _walk_eqns(jaxpr):
+    """Every equation of ``jaxpr`` and of every sub-jaxpr reachable
+    through equation params (scan/cond/while/pjit/remat bodies)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            vals = val if isinstance(val, (list, tuple)) else (val,)
+            for sub in vals:
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield from _walk_eqns(inner)
+                elif hasattr(sub, "eqns"):
+                    yield from _walk_eqns(sub)
+
+
+def _aval_entry(aval, path=None, donated=None):
+    entry = {
+        "shape": [int(d) for d in aval.shape],
+        "dtype": str(aval.dtype),
+        "weak_type": bool(getattr(aval, "weak_type", False)),
+    }
+    if path is not None:
+        entry["path"] = path
+    if donated is not None:
+        entry["donated"] = bool(donated)
+    return entry
+
+
+def _nbytes(entry):
+    import numpy as np
+
+    size = 1
+    for dim in entry["shape"]:
+        size *= int(dim)
+    return size * np.dtype(entry["dtype"]).itemsize
+
+
+def program_facts(spec):
+    """Trace + lower ``spec`` and extract its :class:`ProgramFacts`.
+
+    Tracing is abstract — donated example buffers (captured from a
+    real call that consumed them) still carry avals, which is all the
+    trace reads.
+    """
+    import jax
+    import jax.tree_util as jtu
+
+    traced = spec.fn.trace(*spec.args, **spec.kwargs)
+    lowered = traced.lower()
+    closed = traced.jaxpr
+
+    info_leaves = jtu.tree_leaves_with_path(lowered.args_info)
+    in_avals = closed.in_avals
+    if len(info_leaves) != len(in_avals):
+        raise RuntimeError(
+            f"{spec.name}: args_info has {len(info_leaves)} leaves "
+            f"but the jaxpr has {len(in_avals)} inputs — the flatten "
+            "orders diverged; cannot align donation with avals")
+    inputs = tuple(
+        _aval_entry(aval, path=jtu.keystr(path), donated=ai.donated)
+        for (path, ai), aval in zip(info_leaves, in_avals))
+    outputs = tuple(_aval_entry(aval) for aval in closed.out_avals)
+
+    const_entries = []
+    const_bytes = 0
+    for const in closed.consts:
+        shape = tuple(getattr(const, "shape", ()))
+        dtype = str(getattr(const, "dtype", "object"))
+        entry = {"shape": [int(d) for d in shape], "dtype": dtype}
+        entry["bytes"] = _nbytes(entry)
+        const_bytes += entry["bytes"]
+        const_entries.append(entry)
+
+    callbacks = []
+    upcasts = 0
+    for eqn in _walk_eqns(closed.jaxpr):
+        prim = str(eqn.primitive)
+        if prim in CALLBACK_PRIMS:
+            callbacks.append(prim)
+        elif prim == "convert_element_type":
+            in_aval = getattr(eqn.invars[0], "aval", None)
+            out_aval = eqn.outvars[0].aval
+            if (in_aval is not None
+                    and str(in_aval.dtype) == "bfloat16"
+                    and str(out_aval.dtype) == "float32"):
+                upcasts += 1
+
+    flops = bytes_accessed = None
+    try:
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if isinstance(cost, dict):
+            flops = cost.get("flops")
+            bytes_accessed = cost.get("bytes accessed")
+    except Exception:
+        pass  # backends without a cost model: facts stay structural
+
+    path, line = _anchor(spec.fn)
+    return ProgramFacts(
+        name=spec.name, anchor_path=path, anchor_line=line,
+        inputs=inputs, outputs=outputs,
+        const_count=len(const_entries), const_bytes=const_bytes,
+        consts_large=tuple(e for e in const_entries
+                           if e["bytes"] >= spec.const_max_bytes),
+        callbacks=tuple(sorted(callbacks)), upcasts=upcasts,
+        flops=float(flops) if flops is not None else None,
+        bytes_accessed=(float(bytes_accessed)
+                        if bytes_accessed is not None else None))
+
+
+# -- IR rules ---------------------------------------------------------
+
+
+def _rel_anchor(facts, root):
+    rel = os.path.relpath(facts.anchor_path, root)
+    return rel if not rel.startswith("..") else facts.anchor_path
+
+
+def check_facts(facts, spec, root=None):
+    """Run every IR rule over ``facts``; findings anchored at the
+    program's definition line."""
+    root = os.path.abspath(root or _find_repo_root())
+    rel = _rel_anchor(facts, root)
+    line = facts.anchor_line
+    findings = []
+
+    def hit(rule, message, hint):
+        findings.append(Finding(rel, line, rule,
+                                f"{facts.name}: {message}", hint))
+
+    out_shapes = {(tuple(o["shape"]), o["dtype"])
+                  for o in facts.outputs}
+    for entry in facts.inputs:
+        if entry["donated"]:
+            continue
+        if any(tok in entry["path"] for tok in spec.allow_undonated):
+            continue
+        if _nbytes(entry) < spec.donation_min_bytes:
+            continue
+        if (tuple(entry["shape"]), entry["dtype"]) in out_shapes:
+            hit("donation-miss",
+                f"input {entry['path']} "
+                f"({entry['dtype']}{entry['shape']}) aliases an "
+                "output shape but is not donated — the update "
+                "double-buffers it in HBM",
+                "add the argument to donate_argnums (or allowlist "
+                "it in the HotProgram spec if the alias is "
+                "read-only by design)")
+
+    for entry in facts.consts_large:
+        hit("const-capture",
+            f"{entry['bytes']} bytes of captured constant "
+            f"({entry['dtype']}{entry['shape']}) baked into the "
+            "executable",
+            "pass the array as an argument instead of closing over "
+            "it; every compiled variant re-embeds the constant")
+
+    if facts.callbacks and not spec.allow_callbacks:
+        hit("host-callback-in-hot-path",
+            "host callback(s) in the traced program: "
+            + ", ".join(facts.callbacks),
+            "remove debug.print/pure_callback from the hot program "
+            "— each call stalls the device on a host round trip")
+
+    for entry in facts.inputs:
+        if not entry["weak_type"]:
+            continue
+        if any(tok in entry["path"] for tok in spec.allow_weak):
+            continue
+        hit("weak-type-leak",
+            f"input {entry['path']} is weakly typed — a host "
+            "Python scalar reached the traced signature; the "
+            "first strongly-typed caller recompiles the program",
+            "wrap the argument in jnp.asarray(..., dtype) at the "
+            "call site")
+
+    if (spec.compute_dtype == "bfloat16"
+            and facts.upcasts > spec.upcast_allow):
+        hit("dtype-upcast",
+            f"{facts.upcasts} bf16->f32 upcast site(s) in a "
+            f"bfloat16 program (allowed: {spec.upcast_allow})",
+            "keep compute in bf16, or raise the spec's "
+            "upcast_allow if the new upcast is intended")
+    return findings
+
+
+# -- registry ---------------------------------------------------------
+
+
+def default_registry():
+    """The in-tree hot-program set: the slot engine's dense and paged
+    trios plus the compiled parallel train step. Builds real tiny
+    engines/trainers to capture canonical args, so it compiles
+    programs — call once and reuse."""
+    from ..models import decode
+    from ..parallel import train
+
+    return tuple(decode.hot_program_specs()) + tuple(
+        train.hot_program_specs())
+
+
+def load_registry(ref):
+    """Resolve ``module.path:callable`` or ``file.py:callable`` to
+    the spec tuple it returns."""
+    mod_ref, _, fn_name = ref.partition(":")
+    if not fn_name:
+        raise ValueError(
+            f"registry ref {ref!r} must be module:callable or "
+            "file.py:callable")
+    if mod_ref.endswith(".py"):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_xprog_registry", mod_ref)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+    else:
+        import importlib
+
+        module = importlib.import_module(mod_ref)
+    return tuple(getattr(module, fn_name)())
+
+
+# -- manifest ---------------------------------------------------------
+
+
+def manifest_entry(facts, root=None):
+    """The manifest entry for one program: structural identity
+    (digested exactly) plus the cost figures (diffed with
+    tolerance). Line numbers are deliberately absent — unrelated
+    edits must not churn the manifest."""
+    root = os.path.abspath(root or _find_repo_root())
+    structural = {
+        "anchor": _rel_anchor(facts, root).replace(os.sep, "/"),
+        "inputs": [dict(e) for e in facts.inputs],
+        "outputs": [dict(e) for e in facts.outputs],
+        "donated_count": sum(1 for e in facts.inputs if e["donated"]),
+        "consts": {"count": facts.const_count,
+                   "bytes": facts.const_bytes,
+                   "large": [dict(e) for e in facts.consts_large]},
+        "callbacks": list(facts.callbacks),
+        "upcasts": facts.upcasts,
+    }
+    digest = hashlib.sha256(
+        json.dumps(structural, sort_keys=True).encode()).hexdigest()
+    entry = dict(structural)
+    entry["digest"] = digest[:16]
+    entry["cost"] = {"flops": facts.flops,
+                     "bytes_accessed": facts.bytes_accessed}
+    return entry
+
+
+def registry_facts(specs):
+    """{program name: ProgramFacts}, rejecting duplicate names —
+    derive once and share between check_facts and derive_manifest
+    (each derivation re-traces and re-lowers every program)."""
+    facts = {}
+    for spec in specs:
+        if spec.name in facts:
+            raise ValueError(f"duplicate program name {spec.name}")
+        facts[spec.name] = program_facts(spec)
+    return facts
+
+
+def derive_manifest(specs, root=None, facts=None):
+    """{program name: fingerprint entry} for every spec, plus the
+    derivation platform (the manifest is platform-specific: `make
+    program-check` always derives under JAX_PLATFORMS=cpu). Pass
+    ``facts`` (from :func:`registry_facts`) to reuse an existing
+    derivation instead of lowering everything again."""
+    import jax
+
+    if facts is None:
+        facts = registry_facts(specs)
+    return {
+        "platform": jax.devices()[0].platform,
+        "programs": {spec.name: manifest_entry(facts[spec.name],
+                                               root=root)
+                     for spec in specs},
+    }
+
+
+def _cost_drift(old, new):
+    if old in (None, 0) or new is None:
+        return None if old == new else float("inf")
+    return abs(new - old) / abs(old)
+
+
+def diff_manifest(committed, derived, tolerance=COST_TOLERANCE):
+    """Problems (list of strings) between the committed manifest and
+    a fresh derivation; empty means clean. Structural fields diff
+    exactly; FLOPs/bytes within ``tolerance`` relative drift."""
+    problems = []
+    old_programs = committed.get("programs", {})
+    new_programs = derived.get("programs", {})
+    for name in sorted(set(old_programs) - set(new_programs)):
+        problems.append(
+            f"{name}: in the manifest but no longer registered")
+    for name in sorted(set(new_programs) - set(old_programs)):
+        problems.append(
+            f"{name}: registered but not in the manifest "
+            "(unexpected new program)")
+    for name in sorted(set(old_programs) & set(new_programs)):
+        old, new = old_programs[name], new_programs[name]
+        if old.get("digest") != new.get("digest"):
+            problems.extend(_structural_diff(name, old, new))
+        for key in ("flops", "bytes_accessed"):
+            drift = _cost_drift(old.get("cost", {}).get(key),
+                                new.get("cost", {}).get(key))
+            if drift is not None and drift > tolerance:
+                problems.append(
+                    f"{name}: {key} moved "
+                    f"{old.get('cost', {}).get(key)} -> "
+                    f"{new.get('cost', {}).get(key)} "
+                    f"({drift:.0%} > {tolerance:.0%} tolerance)")
+    return problems
+
+
+def _structural_diff(name, old, new):
+    """Human-readable field-level drift behind a digest mismatch."""
+    out = []
+    for side, label in (("inputs", "input"), ("outputs", "output")):
+        a, b = old.get(side, []), new.get(side, [])
+        if len(a) != len(b):
+            out.append(f"{name}: {label} count {len(a)} -> {len(b)}")
+            continue
+        for i, (ea, eb) in enumerate(zip(a, b)):
+            if ea != eb:
+                what = ea.get("path", f"#{i}")
+                out.append(
+                    f"{name}: {label} {what} changed: "
+                    f"{_entry_str(ea)} -> {_entry_str(eb)}")
+    for key in ("donated_count", "callbacks", "upcasts", "consts",
+                "anchor"):
+        if old.get(key) != new.get(key):
+            out.append(f"{name}: {key} {old.get(key)!r} -> "
+                       f"{new.get(key)!r}")
+    if not out:
+        out.append(f"{name}: digest changed "
+                   f"{old.get('digest')} -> {new.get('digest')}")
+    return out
+
+
+def _entry_str(entry):
+    tags = [f"{entry['dtype']}{entry['shape']}"]
+    if entry.get("weak_type"):
+        tags.append("weak")
+    if entry.get("donated"):
+        tags.append("donated")
+    return " ".join(tags)
+
+
+# -- fixtures ---------------------------------------------------------
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Za-z0-9_,-]+)")
+
+
+def _ir_expectations(path, rel):
+    """(rel, line, rule) triples of the file's IR-rule EXPECT
+    annotations. An id NO verifier (IR or lint) knows is a hard
+    error — a typo cannot silently disarm a seeded violation."""
+    from .rules import rule_ids
+
+    recognized = (set(IR_RULES) | set(rule_ids())
+                  | {"syntax-error"})
+    expected = set()
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            m = _EXPECT_RE.search(line)
+            if not m:
+                continue
+            for rule in m.group(1).split(","):
+                rule = rule.strip()
+                if rule not in recognized:
+                    raise ValueError(
+                        f"{rel}:{lineno}: EXPECT names unknown "
+                        f"rule id {rule!r}")
+                if rule in IR_RULES:
+                    expected.add((rel, lineno, rule))
+    return expected
+
+
+def verify_fixtures(fixture_path, root=None):
+    """Run the IR rules over every fixture module's
+    ``fixture_specs()`` programs and diff the findings against the
+    ``# EXPECT:`` annotations (filtered to IR rule ids — lint rules
+    hold their own fixtures accountable). ``fixture_path`` may be
+    one fixture module or a DIRECTORY: every .py in the directory
+    carrying an IR-rule EXPECT must define ``fixture_specs()`` (a
+    seeded IR violation in a file the verifier cannot load would
+    otherwise be verified by nothing — that is an error, not a
+    skip). Returns (missing, unexpected); both empty means every
+    seeded violation fires exactly where declared and nowhere
+    else."""
+    root = os.path.abspath(root or _find_repo_root())
+    fixture_path = (fixture_path if os.path.isabs(fixture_path)
+                    else os.path.join(root, fixture_path))
+    if os.path.isdir(fixture_path):
+        paths = sorted(
+            os.path.join(fixture_path, name)
+            for name in os.listdir(fixture_path)
+            if name.endswith(".py"))
+    else:
+        paths = [fixture_path]
+    expected = set()
+    got = set()
+    for path in paths:
+        rel = os.path.relpath(path, root)
+        file_expected = _ir_expectations(path, rel)
+        with open(path) as f:
+            has_specs = "def fixture_specs(" in f.read()
+        if not has_specs:
+            if file_expected:
+                raise ValueError(
+                    f"{rel}: IR-rule EXPECT annotations in a file "
+                    "with no fixture_specs() — the seeded "
+                    "violation would be verified by nothing")
+            continue
+        expected |= file_expected
+        for spec in load_registry(f"{path}:fixture_specs"):
+            for finding in check_facts(program_facts(spec), spec,
+                                       root=root):
+                got.add(finding.key())
+    return sorted(expected - got), sorted(got - expected)

@@ -225,7 +225,10 @@ def _mask_top_p(logits, top_p):
     return jnp.where(logits < cutoff, -jnp.inf, logits)
 
 
-@functools.partial(jax.jit,
+# Not in the hot-program registry: the static flag set makes this a
+# per-config program FAMILY (one variant per sampling-feature mix),
+# not one hot program — production traffic rides the slot engine.
+@functools.partial(jax.jit,  # lint: disable=program-registry
                    static_argnames=("model", "max_new_tokens",
                                     "sample", "fast_prefill",
                                     "top_k", "use_top_p", "use_eos",
@@ -445,7 +448,10 @@ def greedy_decode(model, params, prompt, max_new_tokens):
     return decode(model, params, prompt, max_new_tokens)
 
 
-@functools.partial(jax.jit,
+# Unregistered: legacy prefix batch path (engine-pinned prefixes via
+# pin_prefix serve this traffic now; the batcher keeps it for
+# spec/windowed configs only).
+@functools.partial(jax.jit,  # lint: disable=program-registry
                    static_argnames=("model", "max_total_len"))
 def _prefill_prefix_impl(model, params, prefix, max_total_len):
     b, _ = prefix.shape
@@ -524,7 +530,9 @@ def _ring_capacity(cache):
     return None
 
 
-@functools.partial(jax.jit,
+# Unregistered: legacy prefix batch path, same program-family shape
+# as _decode_impl.
+@functools.partial(jax.jit,  # lint: disable=program-registry
                    static_argnames=("model", "max_new_tokens",
                                     "fan_out", "sample", "top_k",
                                     "use_top_p", "use_min_p",
@@ -778,7 +786,8 @@ def stream_decode(model, params, prompt, max_new_tokens, *,
             return
 
 
-@functools.partial(jax.jit,
+# Unregistered: offline/batch beam search, not a serving hot path.
+@functools.partial(jax.jit,  # lint: disable=program-registry
                    static_argnames=("model", "max_new_tokens",
                                     "num_beams", "use_eos",
                                     "use_lp"))
@@ -1089,8 +1098,9 @@ def _slot_step_impl(model, params, cache, row_pos, seen, rngs, tok,
             seen, rngs, nxt, lp)
 
 
-@functools.partial(jax.jit, static_argnames=("model", "slots",
-                                             "slot_len"))
+# Unregistered: engine construction (one setup compile), not traffic.
+@functools.partial(jax.jit,  # lint: disable=program-registry
+                   static_argnames=("model", "slots", "slot_len"))
 def _slot_cache_init(model, slots, slot_len):
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((slots, slot_len),
@@ -1604,6 +1614,11 @@ class SlotDecodeEngine:
         self.steps = 0          # step() calls (device programs run)
         self.row_steps = 0      # sum of active slots over steps
         self.prefills = 0
+        # Admission-width histogram {width: prefill calls}: one
+        # compiled prefill program per DISTINCT width is legal; more
+        # programs than distinct widths is a silent-retrace leak —
+        # the occupancy bench derives its prefill budget from this.
+        self.prefill_widths = collections.Counter()
 
     def free_slots(self):
         return int((~self._active).sum())
@@ -1618,6 +1633,7 @@ class SlotDecodeEngine:
                  min_p, repetition_penalty, seed):
         row = jnp.asarray(tokens, jnp.int32)[None, :]
         self.prefills += 1
+        self.prefill_widths[int(row.shape[1])] += 1
         return _slot_prefill_impl(
             self._base_model, self._params, row,
             jnp.asarray(prompt_len, jnp.int32),
@@ -1712,6 +1728,7 @@ class SlotDecodeEngine:
         row = np.zeros((width,), np.int32)
         row[:len(suffix)] = suffix
         self.prefills += 1
+        self.prefill_widths[int(width)] += 1
         return _paged_prefill_impl(
             self._base_model, self._params, self._cache,
             jnp.asarray(prefix_table), jnp.asarray(row[None]),
@@ -2115,3 +2132,94 @@ def beam_search(model, params, prompt, max_new_tokens, *,
                       jnp.asarray(length_penalty, jnp.float32),
                       num_beams=int(num_beams), use_eos=use_eos,
                       use_lp=use_lp)
+
+
+# ---------------------------------------------------------------------
+# Hot-program registry (analysis.xprog)
+# ---------------------------------------------------------------------
+#
+# The programs the serving perf story rides on, registered with
+# canonical example args so the IR analyzer can lower them and pin
+# what is INSIDE each one (avals, donation, constants, callbacks,
+# cost) in the committed PROGRAM_MANIFEST.json. The example args are
+# CAPTURED from real engine calls rather than hand-built — they can
+# never drift from the engine's true calling convention. The
+# program-registry lint rule holds every module-scope jit in models/
+# and parallel/ against hot_program_specs().
+
+
+def _hot_example_engine(paged):
+    """The canonical tiny engine the manifest derives against:
+    deterministic init (fixed PRNG keys), one 8-wide bucket, block
+    size 4 — small enough to lower in seconds, structurally identical
+    to production (per-layer cache trees, block tables, the full
+    sampling-knob signature)."""
+    from .transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    kwargs = ({"paged": True, "kv_block_size": 4} if paged
+              else {"paged": False})
+    return SlotDecodeEngine(model, params, slots=4, slot_len=24,
+                            buckets=[8], **kwargs)
+
+
+def _hot_engine_calls(paged):
+    """{program global name: (args, kwargs)} of each engine program's
+    first REAL call, captured by swapping the module globals for
+    recorders while one admission + one step runs on the canonical
+    engine."""
+    names = (("_paged_prefill_impl", "_paged_insert_impl",
+              "_paged_step_impl") if paged else
+             ("_slot_prefill_impl", "_slot_insert_impl",
+              "_slot_step_impl"))
+    real = {name: globals()[name] for name in names}
+    calls = {}
+
+    def recorder(name):
+        def wrapped(*args, **kwargs):
+            calls.setdefault(name, (args, kwargs))
+            return real[name](*args, **kwargs)
+        return wrapped
+
+    for name in names:
+        globals()[name] = recorder(name)
+    try:
+        eng = _hot_example_engine(paged)
+        row = np.zeros((8,), np.int32)
+        row[:6] = np.arange(4, 10, dtype=np.int32)
+        eng.admit(row, 6)
+        eng.step()
+    finally:
+        for name in names:
+            globals()[name] = real[name]
+    return calls
+
+
+def hot_program_specs():
+    """The slot engine's registered hot programs: the dense and paged
+    prefill/insert/step trios, each bound to the args of a real call
+    on the canonical example engine. tools/program_manifest.py
+    derives PROGRAM_MANIFEST.json from this list and `make
+    program-check` re-derives and diffs."""
+    from ..analysis.xprog import HotProgram
+
+    dense = _hot_engine_calls(paged=False)
+    paged = _hot_engine_calls(paged=True)
+    return (
+        HotProgram("engine.dense_prefill", _slot_prefill_impl,
+                   *dense["_slot_prefill_impl"]),
+        HotProgram("engine.dense_insert", _slot_insert_impl,
+                   *dense["_slot_insert_impl"]),
+        HotProgram("engine.dense_step", _slot_step_impl,
+                   *dense["_slot_step_impl"]),
+        HotProgram("engine.paged_prefill", _paged_prefill_impl,
+                   *paged["_paged_prefill_impl"]),
+        HotProgram("engine.paged_insert", _paged_insert_impl,
+                   *paged["_paged_insert_impl"]),
+        HotProgram("engine.paged_step", _paged_step_impl,
+                   *paged["_paged_step_impl"]),
+    )

@@ -112,7 +112,11 @@ def _rewind(cache, position):
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
-@functools.partial(
+# Not in the hot-program registry: the static flag set makes this a
+# per-config program FAMILY, and speculation still rides the legacy
+# batch path (ROADMAP item 1 folds it into the slot engine — its step
+# program joins the registry then).
+@functools.partial(  # lint: disable=program-registry
     jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
                               "k", "return_stats", "ragged",
                               "use_eos", "sample", "use_active",

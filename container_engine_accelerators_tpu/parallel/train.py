@@ -520,6 +520,43 @@ class Trainer:
         return jax.jit(step_fn, in_shardings=(None, b_shard))
 
 
+def hot_program_specs():
+    """The compiled parallel train step's hot-program registry entry
+    (analysis.xprog): a canonical tiny token-model Trainer on a 1x1
+    ("data", "model") mesh with state donation ON — the configuration
+    whose donation mask, avals, and cost the committed
+    PROGRAM_MANIFEST.json pins. Deterministic by construction (fixed
+    PRNG keys, zero batches; avals and cost depend on neither)."""
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    from ..analysis.xprog import HotProgram
+    from ..models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=16,
+                          dtype=jnp.float32)
+
+    def apply_fn(variables, tokens, train):
+        return model.apply(variables, tokens, train=train), {}
+
+    def loss_fn(logits, labels):
+        return cross_entropy_loss(
+            logits.reshape(-1, logits.shape[-1]), labels.reshape(-1))
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                ("data", "model"))
+    trainer = Trainer(apply_fn, loss_fn, optax.sgd(0.1), mesh=mesh,
+                      donate_state=True)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((4, 8), jnp.int32))
+    state = trainer.init_state({"params": variables["params"]})
+    batch = (np.zeros((4, 8), np.int32), np.zeros((4, 8), np.int32))
+    step = trainer._build_train_step(state)
+    return (HotProgram("train.step", step, (state, batch)),)
+
+
 def cross_entropy_loss(logits, labels, label_smoothing=0.0):
     """Mean softmax cross entropy; labels are int class ids."""
     num_classes = logits.shape[-1]

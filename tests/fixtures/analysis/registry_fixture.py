@@ -1,0 +1,52 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Seeded violations for program-registry (linted, never imported).
+
+# lint: program-module
+"""
+
+import functools
+
+import jax
+
+
+@jax.jit  # EXPECT: program-registry
+def unregistered_step(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))  # EXPECT: program-registry
+def unregistered_partial_step(cache):
+    return cache * 2
+
+
+@jax.jit  # lint: disable=program-registry
+def escaped_step(x):
+    # Deliberately out of the manifest, with the escape saying so.
+    return x - 1
+
+
+@jax.jit
+def registered_step(x):
+    return x * 3
+
+
+unregistered_binding = jax.jit(lambda x: x)  # EXPECT: program-registry
+
+
+def hot_program_specs():
+    """The module's registry: referencing registered_step here is
+    exactly what keeps it out of the findings."""
+    return (registered_step,)

@@ -1,0 +1,106 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Seeded IR violations for analysis.xprog (imported, then lowered).
+
+Unlike the lint fixtures (linted, never imported), these programs are
+really traced: ``fixture_specs()`` hands each one to the IR analyzer
+with example args, and every EXPECT annotation must fire at its
+decorator line — verified by ``xprog.verify_fixtures`` from both
+tests/test_xprog.py and `make analysis-check`. ``clean_specs()`` is
+the manifest update-workflow test's tiny registry (no violations).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from container_engine_accelerators_tpu.analysis.xprog import HotProgram
+
+# 128 KiB baked into every executable that closes over it — the
+# const-capture seed (well above the 4 KiB threshold).
+_BIG_TABLE = jnp.zeros((32768,), jnp.float32)
+
+
+@jax.jit  # EXPECT: donation-miss
+def undonated_cache_step(cache, tok):
+    # cache is 64*16*4 = 4096 bytes, updated in place shape-to-shape
+    # and returned — the classic dropped-donate_argnums double-buffer.
+    return cache.at[:, 0].set(tok.astype(cache.dtype)), tok + 1
+
+
+@jax.jit  # EXPECT: host-callback-in-hot-path
+def callback_step(cache, tok):
+    jax.debug.print("step tok {t}", t=tok)
+    return jnp.sum(cache) + tok.astype(cache.dtype)
+
+
+@jax.jit  # EXPECT: weak-type-leak
+def weak_arg_step(x, alpha):
+    # alpha arrives as a host Python float (see fixture_specs): its
+    # aval is weakly typed, and the first caller passing a strong
+    # jnp scalar recompiles the program.
+    return x * alpha
+
+
+@jax.jit  # EXPECT: const-capture
+def const_capture_step(x):
+    return x + _BIG_TABLE[: x.shape[0]]
+
+
+@jax.jit  # EXPECT: dtype-upcast
+def upcast_step(x):
+    # Declared bfloat16 (see the spec) with an f32 excursion.
+    return (x.astype(jnp.float32) * 2.0).astype(jnp.bfloat16)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def clean_step(cache, tok):
+    """The well-behaved control: donates its cache, captures nothing,
+    calls nothing back, stays strongly typed."""
+    return cache.at[:, 0].set(tok.astype(cache.dtype)), tok + 1
+
+
+def _cache():
+    return jnp.zeros((64, 16), jnp.float32)
+
+
+def _tok():
+    return jnp.asarray(3, jnp.int32)
+
+
+def fixture_specs():
+    """Every seeded violation, one spec per program."""
+    return (
+        HotProgram("fixture.undonated", undonated_cache_step,
+                   (_cache(), _tok())),
+        HotProgram("fixture.callback", callback_step,
+                   (_cache(), _tok())),
+        HotProgram("fixture.weak", weak_arg_step,
+                   (jnp.zeros((8,), jnp.float32), 0.5)),
+        HotProgram("fixture.const", const_capture_step,
+                   (jnp.zeros((8,), jnp.float32),)),
+        HotProgram("fixture.upcast", upcast_step,
+                   (jnp.zeros((8,), jnp.bfloat16),),
+                   compute_dtype="bfloat16"),
+    )
+
+
+def clean_specs():
+    """A violation-free mini-registry for manifest round-trip tests."""
+    return (
+        HotProgram("fixture.clean_step", clean_step,
+                   (_cache(), _tok())),
+    )

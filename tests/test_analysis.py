@@ -94,6 +94,19 @@ def test_disable_file_suppresses_whole_module(tmp_path):
     assert findings == []
 
 
+def test_unknown_expect_rule_id_is_a_hard_error(tmp_path):
+    """An EXPECT naming a rule NEITHER verifier (lint or IR) knows
+    must raise, not silently drop — a typo'd id would otherwise
+    leave its seeded violation verified by nothing."""
+    mod = tmp_path / "f.py"
+    mod.write_text(
+        "import threading\n"
+        "L = threading.Lock()\n"
+        "L.acquire()  # EXPECT: lock-withh\n")
+    with pytest.raises(ValueError, match="unknown rule"):
+        verify_fixtures(str(tmp_path), root=REPO_ROOT)
+
+
 def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
     mod = tmp_path / "broken.py"
     mod.write_text("def f(:\n")
@@ -352,6 +365,54 @@ def test_retrace_guard_passes_within_budget():
 def test_retrace_watch_rejects_unjitted():
     with pytest.raises(TypeError, match="_cache_size"):
         RetraceGuard().watch("plain", lambda x: x)
+
+
+def test_retrace_late_watch_baselines_at_watch_time():
+    """watch() inside an OPEN guard baselines the cache size at that
+    moment — compiles that happened earlier in the region are not
+    charged against the late watch's budget."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def leaky(x):
+        return x * 2
+
+    with RetraceGuard() as guard:
+        leaky(jnp.zeros((1,), jnp.float32))   # pre-watch compile
+        leaky(jnp.zeros((2,), jnp.float32))   # pre-watch compile
+        guard.watch("late", leaky, max_new=1)
+        leaky(jnp.zeros((3,), jnp.float32))   # 1 new: inside budget
+    assert guard.new_compiles() == {"late": 1}
+    # ...and the budget still bites on post-watch compiles.
+    with pytest.raises(RetraceError, match="late"):
+        with RetraceGuard() as guard:
+            leaky(jnp.zeros((4,), jnp.float32))
+            guard.watch("late", leaky, max_new=1)
+            leaky(jnp.zeros((5,), jnp.float32))
+            leaky(jnp.zeros((6,), jnp.float32))
+
+
+def test_retrace_exit_with_active_exception_skips_check():
+    """__exit__ under an in-flight exception must NOT stack a
+    RetraceError on top — the region's real failure propagates, and
+    new_compiles() stays queryable for post-mortem."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def leaky(x):
+        return x + 1
+
+    guard = RetraceGuard().watch("leaky", leaky, max_new=1)
+    with pytest.raises(ValueError, match="boom"):
+        with guard:
+            for width in range(1, 4):     # blows the budget...
+                leaky(jnp.zeros((width,), jnp.float32))
+            raise ValueError("boom")      # ...but this is the error
+    assert guard.new_compiles() == {"leaky": 3}
+    with pytest.raises(RetraceError):
+        guard.check()
 
 
 def test_engine_guard_holds_on_mixed_traffic():

@@ -1,0 +1,252 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""IR-level program hygiene: facts, rules, and the golden manifest.
+
+The tier-1 half of `make program-check`: the registered hot programs
+(dense + paged engine trios, parallel train step) must show zero IR
+findings and fingerprint-match the committed PROGRAM_MANIFEST.json;
+the seeded IR fixtures must fire EXPECT-exact; and a deliberately
+dropped ``donate_argnums`` on the paged step program must fail BOTH
+the donation-miss rule and the manifest diff (ISSUE 10 acceptance).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from container_engine_accelerators_tpu.analysis import xprog
+from tests.conftest import REPO_ROOT
+
+_TOOLS = os.path.join(REPO_ROOT, "tools")
+if _TOOLS not in sys.path:
+    sys.path.append(_TOOLS)  # append: tools/ must not shadow imports
+import program_manifest  # noqa: E402
+
+MANIFEST = os.path.join(REPO_ROOT, "PROGRAM_MANIFEST.json")
+FIXTURE_DIR = os.path.join("tests", "fixtures", "analysis")
+FIXTURE = os.path.join(FIXTURE_DIR, "xprog_fixture.py")
+
+
+@pytest.fixture(scope="module")
+def registry():
+    """The real hot-program registry — built once (it compiles the
+    canonical example engines/trainer)."""
+    return xprog.default_registry()
+
+
+@pytest.fixture(scope="module")
+def registry_facts(registry):
+    return xprog.registry_facts(registry)
+
+
+# -- the tree is clean ------------------------------------------------
+
+
+def test_registry_names_the_hot_program_set(registry):
+    assert sorted(s.name for s in registry) == [
+        "engine.dense_insert", "engine.dense_prefill",
+        "engine.dense_step", "engine.paged_insert",
+        "engine.paged_prefill", "engine.paged_step", "train.step"]
+
+
+def test_tree_programs_have_zero_ir_findings(registry,
+                                             registry_facts):
+    """The tier-1 drift gate: donation masks intact, no captured
+    constants, no host callbacks, no weak-type inputs in any
+    registered hot program."""
+    findings = []
+    for spec in registry:
+        findings.extend(
+            xprog.check_facts(registry_facts[spec.name], spec,
+                              root=REPO_ROOT))
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_manifest_matches_tree(registry, registry_facts):
+    """The committed golden manifest re-derives cleanly — donation,
+    avals, callbacks, consts exact; FLOPs/bytes within tolerance."""
+    with open(MANIFEST) as f:
+        committed = json.load(f)
+    derived = {
+        "platform": committed.get("platform"),
+        "programs": {name: xprog.manifest_entry(facts, root=REPO_ROOT)
+                     for name, facts in registry_facts.items()},
+    }
+    problems = xprog.diff_manifest(committed, derived)
+    assert problems == [], "\n".join(problems) + (
+        "\n(intentional change? re-derive: JAX_PLATFORMS=cpu "
+        "python tools/program_manifest.py --update)")
+
+
+def test_known_facts_of_the_registered_set(registry_facts):
+    """Spot-checks that the facts mean what the manifest claims."""
+    step = registry_facts["engine.paged_step"]
+    # donate_argnums=(2,3,4,5): the cache tree + row state donate;
+    # params do not.
+    donated = [e for e in step.inputs if e["donated"]]
+    assert donated, "paged step donates its cache/state"
+    # The params tree never donates (embedding et al. are reused by
+    # every program); the donated set is cache + per-row state.
+    assert all("embedding" not in e["path"] for e in donated)
+    assert any("cached_key" in e["path"] for e in donated)
+    assert step.callbacks == ()
+    assert step.consts_large == ()
+    assert all(not e["weak_type"] for e in step.inputs)
+    train = registry_facts["train.step"]
+    # donate_state=True: every state leaf donates, the batch does not.
+    undonated = [e for e in train.inputs if not e["donated"]]
+    assert len(undonated) == 2            # (tokens, labels)
+    assert train.flops and train.flops > 0
+
+
+# -- seeded violations ------------------------------------------------
+
+
+def test_ir_fixtures_fire_exactly_as_seeded():
+    """Shared with `make analysis-check`: every seeded IR violation
+    under the fixture DIRECTORY fires at its EXPECT line and nowhere
+    else (the directory walk also errors on an IR EXPECT in a file
+    with no fixture_specs — a violation nothing would verify)."""
+    missing, unexpected = xprog.verify_fixtures(FIXTURE_DIR,
+                                                root=REPO_ROOT)
+    assert missing == [], f"seeded IR violations did not fire: " \
+                          f"{missing}"
+    assert unexpected == [], f"unexpected IR findings: {unexpected}"
+
+
+def test_ir_expect_without_fixture_specs_is_an_error(tmp_path):
+    """A seeded IR violation in a file the verifier cannot load
+    would be verified by nothing — the directory walk must error,
+    not skip."""
+    orphan = tmp_path / "orphan_fixture.py"
+    orphan.write_text(
+        "import jax\n\n\n"
+        "@jax.jit  # EXPECT: donation-miss\n"
+        "def unverified(cache):\n"
+        "    return cache * 2\n")
+    with pytest.raises(ValueError, match="fixture_specs"):
+        xprog.verify_fixtures(str(tmp_path), root=REPO_ROOT)
+
+
+def test_dropped_donation_fails_rule_and_manifest(registry,
+                                                  registry_facts):
+    """ISSUE 10 acceptance: deliberately re-jit the paged step with
+    its ``donate_argnums`` dropped — the donation-miss rule must
+    fire AND the manifest diff must flag the drift."""
+    import jax
+
+    from container_engine_accelerators_tpu.models import decode
+
+    spec = next(s for s in registry if s.name == "engine.paged_step")
+    undonated = jax.jit(decode._paged_step_impl.__wrapped__,
+                        static_argnames=("model",))
+    bad = xprog.HotProgram("engine.paged_step", undonated,
+                           spec.args, spec.kwargs)
+    facts = xprog.program_facts(bad)
+    findings = xprog.check_facts(facts, bad, root=REPO_ROOT)
+    rules = {f.rule for f in findings}
+    assert "donation-miss" in rules, [f.format() for f in findings]
+    # The finding anchors at the real program's decorator line.
+    assert all(f.path.endswith("models/decode.py")
+               for f in findings)
+
+    with open(MANIFEST) as f:
+        committed = json.load(f)
+    derived = {
+        "platform": committed.get("platform"),
+        "programs": {
+            **{name: xprog.manifest_entry(fct, root=REPO_ROOT)
+               for name, fct in registry_facts.items()},
+            "engine.paged_step": xprog.manifest_entry(facts,
+                                                   root=REPO_ROOT),
+        },
+    }
+    problems = xprog.diff_manifest(committed, derived)
+    assert any("engine.paged_step" in p and "donated" in p
+               for p in problems), problems
+
+
+# -- manifest diff mechanics ------------------------------------------
+
+
+def _mini_manifest():
+    return {
+        "platform": "cpu",
+        "programs": {
+            "p": {"digest": "abc", "donated_count": 1,
+                  "inputs": [], "outputs": [], "callbacks": [],
+                  "upcasts": 0, "anchor": "x.py",
+                  "consts": {"count": 0, "bytes": 0, "large": []},
+                  "cost": {"flops": 1000.0,
+                           "bytes_accessed": 500.0}},
+        },
+    }
+
+
+def test_diff_flags_cost_drift_beyond_tolerance():
+    old = _mini_manifest()
+    new = _mini_manifest()
+    new["programs"]["p"]["cost"]["flops"] = 1090.0   # 9%: inside
+    assert xprog.diff_manifest(old, new) == []
+    new["programs"]["p"]["cost"]["flops"] = 1200.0   # 20%: drift
+    problems = xprog.diff_manifest(old, new)
+    assert any("flops" in p for p in problems)
+
+
+def test_diff_flags_program_set_changes():
+    old = _mini_manifest()
+    new = _mini_manifest()
+    new["programs"]["q"] = dict(new["programs"]["p"])
+    problems = xprog.diff_manifest(old, new)
+    assert any("unexpected new program" in p for p in problems)
+    problems = xprog.diff_manifest(new, old)
+    assert any("no longer registered" in p for p in problems)
+
+
+# -- the update workflow ----------------------------------------------
+
+
+def test_manifest_update_round_trips_to_clean_check(tmp_path):
+    """`--update` writes a manifest that `--check` immediately
+    accepts (ISSUE 10 satellite: the update workflow round-trips to
+    a clean diff); a doctored manifest then fails the check."""
+    manifest = str(tmp_path / "manifest.json")
+    registry = os.path.join(REPO_ROOT, FIXTURE) + ":clean_specs"
+    rc = program_manifest.main(
+        ["--registry", registry, "--manifest", manifest, "--update"])
+    assert rc == 0
+    rc = program_manifest.main(
+        ["--registry", registry, "--manifest", manifest, "--check"])
+    assert rc == 0
+    with open(manifest) as f:
+        data = json.load(f)
+    data["programs"]["fixture.clean_step"]["cost"]["flops"] = 1e12
+    with open(manifest, "w") as f:
+        json.dump(data, f)
+    rc = program_manifest.main(
+        ["--registry", registry, "--manifest", manifest, "--check"])
+    assert rc == 1
+
+
+def test_update_refuses_live_ir_findings(tmp_path):
+    """A violating registry cannot be baked into a golden manifest."""
+    manifest = str(tmp_path / "manifest.json")
+    registry = os.path.join(REPO_ROOT, FIXTURE) + ":fixture_specs"
+    rc = program_manifest.main(
+        ["--registry", registry, "--manifest", manifest, "--update"])
+    assert rc == 1
+    assert not os.path.exists(manifest)

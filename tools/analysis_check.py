@@ -14,9 +14,9 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""The `make analysis-check` gate: lint + tsan + retrace, end to end.
+"""The `make analysis-check` gate: lint + IR + tsan + retrace.
 
-Four legs, each of which must BOTH pass on the real tree and fail on
+Five legs, each of which must BOTH pass on the real tree and fail on
 its seeded fixture (a gate that cannot fire is worse than no gate):
 
 1. **Lint, zero findings** over the default scope (package, tools/,
@@ -24,6 +24,11 @@ its seeded fixture (a gate that cannot fire is worse than no gate):
 2. **Lint fixtures**: every seeded violation under
    tests/fixtures/analysis fires exactly where its ``# EXPECT:``
    annotation says, and nowhere else (escape comments respected).
+2b. **IR fixtures**: every seeded IR violation in
+   xprog_fixture.py (undonated cache, callback-in-step, weak-type
+   arg, oversized captured constant, bf16 upcast) fires at its
+   EXPECT line when the program is really lowered — the program-
+   manifest gate itself is `make program-check`.
 3. **Lock-order sanitizer**: the engine/elastic/placement test
    suites run under ``CEA_TPU_TSAN=1`` and the session report must
    be clean (no cycles, no unguarded writes, no recursive
@@ -82,6 +87,22 @@ def check_lint_fixtures():
     for key in unexpected:
         print(f"  unexpected finding: {key}")
     section("lint fixtures fire exactly as seeded",
+            not missing and not unexpected)
+
+
+def check_ir_fixtures():
+    """Every seeded IR violation (undonated cache, callback-in-step,
+    weak-type arg, oversized constant, bf16 upcast) must fire at its
+    EXPECT line, and nowhere else — the xprog analog of leg 2."""
+    from container_engine_accelerators_tpu.analysis import xprog
+
+    missing, unexpected = xprog.verify_fixtures(
+        os.path.join("tests", "fixtures", "analysis"), root=REPO)
+    for key in missing:
+        print(f"  IR fixture violation did NOT fire: {key}")
+    for key in unexpected:
+        print(f"  unexpected IR finding: {key}")
+    section("IR fixtures fire exactly as seeded",
             not missing and not unexpected)
 
 
@@ -164,6 +185,7 @@ def check_retrace_fixture():
 def main():
     check_lint_tree()
     check_lint_fixtures()
+    check_ir_fixtures()
     check_tsan_fixture()
     check_tsan_suites()
     check_retrace_bound()

@@ -98,6 +98,13 @@ def main(argv=None):
                         "interpret-mode tests cannot)")
     args = p.parse_args(argv)
 
+    # Fail fast on a wedged accelerator tunnel (BENCH_r05) — probe
+    # in a deadlined subprocess before any in-process dispatch.
+    # After argparse, so --help/usage errors never pay the probe.
+    from bench_backend import ensure_backend
+
+    ensure_backend()
+
     from container_engine_accelerators_tpu.ops.attention import (
         flash_attention,
     )

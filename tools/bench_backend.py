@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fail-fast backend probe shared by the tools/bench_* entry points.
+
+A hard-hung accelerator tunnel blocks ``jax.devices()`` inside C
+where no signal fires, so a bench invocation on a rig whose backend
+is down just sits there — the BENCH_r05 pathology: three suite
+windows burned their entire budget on "backend probe hung" retries
+in bench.py while the tools/bench_* scripts, which had NO probe,
+would have hung with no message at all. :func:`ensure_backend` runs
+the device query in a short-lived subprocess under a hard deadline:
+a dead backend becomes an immediate, explained exit instead of a
+silent multi-hour wedge, and a healthy backend costs one extra
+interpreter start (~2 s on this rig).
+
+Call it at the top of ``main()``, BEFORE the first in-process
+``jax.devices()``/dispatch. The probe inherits the caller's
+environment, so ``JAX_PLATFORMS=cpu`` schedule-sanity runs probe the
+CPU backend and pass instantly.
+"""
+
+import os
+import subprocess
+import sys
+
+PROBE_TIMEOUT_S = 180
+
+_PROBE_CODE = (
+    "import os, jax\n"
+    "plat = os.environ.get('JAX_PLATFORMS')\n"
+    "if plat and jax.config.jax_platforms != plat:\n"
+    "    jax.config.update('jax_platforms', plat)\n"
+    "print(jax.devices()[0].platform)\n"
+)
+
+
+def ensure_backend(timeout_s=PROBE_TIMEOUT_S):
+    """Exit the process with a clear message when the backend cannot
+    even enumerate devices within ``timeout_s``; return the platform
+    string ('cpu', 'tpu', ...) when it can."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=os.environ.copy())
+    except subprocess.TimeoutExpired:
+        sys.exit(
+            f"[bench] backend probe hung (limit {timeout_s}s): "
+            "jax.devices() never returned — the accelerator tunnel "
+            "is down or wedged. Re-run when the chip window is up, "
+            "or set JAX_PLATFORMS=cpu for a schedule-sanity run.")
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip()[-1500:]
+        sys.exit(
+            f"[bench] backend probe failed (rc {proc.returncode}): "
+            f"{tail}")
+    return proc.stdout.strip().splitlines()[-1]

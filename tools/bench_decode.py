@@ -133,6 +133,14 @@ def main(argv=None):
         p.error("--engine does not compose with --speculative-k/"
                 "--prefix-len/--stream-chunk/--attention-window")
 
+    # Fail fast on a wedged accelerator tunnel (BENCH_r05: a down
+    # backend hangs jax.devices() in C, unkillable by SIGALRM) —
+    # probe in a deadlined subprocess before any in-process dispatch.
+    # After argparse, so --help/usage errors never pay the probe.
+    from bench_backend import ensure_backend
+
+    ensure_backend()
+
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.models.decode import decode
 

@@ -48,10 +48,14 @@ drawn in the same unit. Metrics:
 ``--check`` exits non-zero unless engine goodput >= --check-factor x
 baseline goodput AND every greedy output matched its reference —
 the CI gate behind ``make occupancy-check`` (CPU fake backend).
-Every replay runs under the analysis suite's retrace guard: a
-silent recompile of the insert or step program (weak_type/shape
-leak) fails the bench loudly instead of quietly inflating every
-latency number it reports.
+Every replay runs under the analysis suite's retrace guard: ONE
+insert + ONE step program, and a prefill budget DERIVED from the
+replayed trace's distinct admission widths (one compiled program per
+width is the engine's contract) — a silent recompile (weak_type/
+shape leak) fails the bench loudly, reporting which widths compiled,
+instead of quietly inflating every latency number it reports. The
+summary carries ``prefill_widths`` / ``prefill_programs`` per
+replay.
 
 **Shared-prefix trace (``--paging-check``, ``make paging-check``).**
 A second Poisson trace where ``--shared-frac`` of requests open with
@@ -99,22 +103,48 @@ def build_trace(args, rng):
     return trace
 
 
-def _step_insert_guard(paged):
-    """Retrace guard on the engine's ONE-insert + ONE-step bound for
-    a whole replay. Admission prefill legitimately compiles one
-    program per distinct width on these unbucketed traces, so only
-    insert/step carry a budget here; `make analysis-check` holds the
-    full buckets+insert+step bound on a bucketed mixed trace."""
+def _replay_guard(paged, prefill_budget):
+    """Retrace guard on the engine's program bound for a whole
+    replay (analysis.retrace.engine_guard — ONE insert + ONE step):
+    admission prefill is bounded by ``prefill_budget``, the number
+    of DISTINCT admission widths the replayed trace can legally
+    compile — derived from the trace (run_engine pads every row into
+    the one prompt bucket, so its budget is exactly 1) or bounded by
+    the admission count where prefix sharing makes suffix widths
+    replay-dependent (the shared-prefix traces;
+    :func:`_prefill_honesty` then tightens the bound to the widths
+    actually admitted)."""
     from container_engine_accelerators_tpu.analysis.retrace import (
-        RetraceGuard,
+        engine_guard,
+    )
+
+    return engine_guard(paged,
+                        prefill_budget=max(int(prefill_budget), 1))
+
+
+def _prefill_honesty(eng, guard):
+    """One compiled prefill program per DISTINCT admission width is
+    legal; more means a silent retrace (weak_type/shape leak) hid
+    inside the admission path. Called inside the guard, after the
+    replay: raises with the full width histogram when the budget is
+    consumed, returns {widths, programs} metrics otherwise."""
+    from container_engine_accelerators_tpu.analysis.retrace import (
+        RetraceError,
         engine_programs,
     )
 
-    progs = engine_programs(paged)
-    guard = RetraceGuard()
-    guard.watch(progs[1][0], progs[1][1], max_new=1)
-    guard.watch(progs[2][0], progs[2][1], max_new=1)
-    return guard
+    name = engine_programs(eng.paged)[0][0]
+    compiled = guard.new_compiles()[name]
+    widths = dict(sorted(eng.prefill_widths.items()))
+    if compiled > len(widths):
+        raise RetraceError(
+            f"{name}: {compiled} programs compiled for "
+            f"{len(widths)} distinct admission width(s) — "
+            f"widths admitted (width: prefills): {widths}. A width "
+            "compiling more than one program is a weak_type/shape "
+            "leak in the admission path.")
+    return {"prefill_widths": sorted(widths),
+            "prefill_programs": compiled}
 
 
 def run_engine(model, params, trace, args):
@@ -149,7 +179,14 @@ def run_engine(model, params, trace, args):
             else:
                 slot_req[slot] = i
 
-    with _step_insert_guard(eng.paged):
+    # Dense pool: every row pads into the one prompt bucket, so the
+    # trace admits at exactly ONE width — the derived budget. Paged
+    # pool: admission prefills the UNSHARED suffix, whose width
+    # depends on what is resident when the row arrives, so the
+    # up-front budget is the admission count and _prefill_honesty
+    # tightens it to the distinct widths actually admitted.
+    budget = len(trace) if eng.paged else 1
+    with _replay_guard(eng.paged, budget) as guard:
         while queue or slot_req:
             admit_ready()
             if not slot_req:
@@ -164,6 +201,7 @@ def run_engine(model, params, trace, args):
                     latency[i] = t - trace[i]["arrival"]
                     eng.release(slot)
                     del slot_req[slot]
+        honesty = _prefill_honesty(eng, guard)
 
     calls = eng.steps + eng.prefills
     tokens = sum(r["new"] for r in trace)
@@ -174,6 +212,7 @@ def run_engine(model, params, trace, args):
         "goodput_tokens_per_step": round(tokens / calls, 3),
         "p50_latency_steps": round(float(np.percentile(latency, 50)), 1),
         "p99_latency_steps": round(float(np.percentile(latency, 99)), 1),
+        **honesty,
     }
 
 
@@ -235,7 +274,11 @@ def replay_pool(eng, trace):
                 slot_req[slot] = i
             peak = max(peak, eng.active_count())
 
-    with _step_insert_guard(eng.paged):
+    # Prefix sharing makes paged suffix widths replay-dependent, so
+    # the up-front budget is the admission count (a pure backstop);
+    # _prefill_honesty tightens it to the distinct widths actually
+    # admitted before the guard closes.
+    with _replay_guard(eng.paged, len(trace)) as guard:
         while queue or slot_req:
             admit_ready()
             if not slot_req:
@@ -249,11 +292,13 @@ def replay_pool(eng, trace):
                 if len(outputs[i]) >= trace[i]["new"]:
                     eng.release(slot)
                     del slot_req[slot]
+        honesty = _prefill_honesty(eng, guard)
     return outputs, {
         "steps": eng.steps,
         "prefills": eng.prefills,
         "rows_per_step": round(eng.row_steps / max(eng.steps, 1), 3),
         "peak_rows": peak,
+        **honesty,
     }
 
 
@@ -433,6 +478,13 @@ def main(argv=None):
                         "the CI gate behind `make paging-check`")
     p.add_argument("--paging-factor", type=float, default=2.0)
     args = p.parse_args(argv)
+
+    # Fail fast on a wedged accelerator tunnel (BENCH_r05) — probe
+    # in a deadlined subprocess before any in-process dispatch.
+    # After argparse, so --help/usage errors never pay the probe.
+    from bench_backend import ensure_backend
+
+    ensure_backend()
 
     from container_engine_accelerators_tpu.models import TransformerLM
 

@@ -1,0 +1,152 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The `make program-check` gate: golden manifest for the hot programs.
+
+Lowers every program in the hot-program registry
+(models.decode.hot_program_specs + parallel.train.hot_program_specs)
+with its canonical example args, runs the IR hygiene rules
+(analysis.xprog: donation-miss, const-capture,
+host-callback-in-hot-path, weak-type-leak, dtype-upcast), and diffs
+the derived fingerprints against the committed PROGRAM_MANIFEST.json.
+Two legs, both required:
+
+1. **Zero IR findings** — a dropped ``donate_argnums``, a captured
+   megabyte constant, or a ``debug.print`` in a step program fails
+   here, not in a profiler three weeks later.
+2. **Manifest diff clean** — unexpected new programs, donation/aval
+   drift, or >10% FLOPs/bytes movement fail with instructions to
+   re-derive via ``--update`` when the change is intentional.
+
+The manifest is derived under ``JAX_PLATFORMS=cpu`` (the Makefile
+target pins it): avals, donation, and constants are
+platform-independent; the cost figures are the CPU lowering's and the
+diff tolerance absorbs cost-model noise. Pure CPU, ~1 min (dominated
+by example-engine builds).
+
+Usage:
+  program_manifest.py --check            # the CI gate (default)
+  program_manifest.py --update           # re-derive + rewrite
+  program_manifest.py --print            # dump the derived manifest
+  program_manifest.py --registry file.py:fixture_specs ...
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DEFAULT_MANIFEST = os.path.join(REPO, "PROGRAM_MANIFEST.json")
+
+UPDATE_HINT = (
+    "if this change is intentional, re-derive with\n"
+    "    JAX_PLATFORMS=cpu python tools/program_manifest.py --update\n"
+    "and commit the PROGRAM_MANIFEST.json diff (review it: every "
+    "line is a fact about what is inside a hot program)")
+
+
+def _load_specs(ref):
+    from container_engine_accelerators_tpu.analysis import xprog
+
+    if ref:
+        return xprog.load_registry(ref)
+    return xprog.default_registry()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--manifest", default=DEFAULT_MANIFEST)
+    p.add_argument("--registry", default=None,
+                   help="module:callable or file.py:callable "
+                        "returning HotProgram specs (default: the "
+                        "in-tree hot-program registry)")
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true",
+                      help="zero IR findings + manifest diff clean "
+                           "(the default)")
+    mode.add_argument("--update", action="store_true",
+                      help="re-derive and rewrite the manifest")
+    mode.add_argument("--print", dest="print_only",
+                      action="store_true",
+                      help="dump the derived manifest to stdout")
+    args = p.parse_args(argv)
+
+    from container_engine_accelerators_tpu.analysis import xprog
+
+    specs = _load_specs(args.registry)
+    # One derivation shared by both legs: each program_facts call
+    # re-traces and re-lowers its program.
+    facts = xprog.registry_facts(specs)
+    findings = []
+    for spec in specs:
+        findings.extend(
+            xprog.check_facts(facts[spec.name], spec, root=REPO))
+    derived = xprog.derive_manifest(specs, root=REPO, facts=facts)
+
+    if args.print_only:
+        print(json.dumps(derived, indent=2, sort_keys=True))
+        return 0
+
+    for finding in findings:
+        print("  " + finding.format())
+    ok_ir = not findings
+    print(f"[program-check] IR hygiene rules: "
+          f"{'ok' if ok_ir else 'FAIL'} — "
+          f"{len(findings)} finding(s) over "
+          f"{len(specs)} program(s)")
+
+    if args.update:
+        if not ok_ir:
+            print("[program-check] refusing to --update with live "
+                  "IR findings: fix (or allowlist in the HotProgram "
+                  "spec) first, then re-derive")
+            return 1
+        with open(args.manifest, "w") as f:
+            json.dump(derived, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[program-check] wrote {args.manifest} "
+              f"({len(derived['programs'])} programs)")
+        return 0
+
+    try:
+        with open(args.manifest) as f:
+            committed = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"[program-check] FAIL: cannot read {args.manifest}: "
+              f"{e}\n{UPDATE_HINT}")
+        return 1
+    problems = xprog.diff_manifest(committed, derived)
+    for problem in problems:
+        print("  " + problem)
+    ok_diff = not problems
+    print(f"[program-check] manifest diff: "
+          f"{'clean' if ok_diff else 'FAIL'} — "
+          f"{len(derived['programs'])} program(s)")
+    if not ok_diff:
+        print(UPDATE_HINT)
+    if ok_ir and ok_diff:
+        print("[program-check] all legs passed")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,6 +148,20 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "analysis-check preflight"
 
+# Program-manifest preflight (CPU, ~1 min): every registered hot
+# program (engine trios + train step) lowered against its canonical
+# example args must show zero IR findings (donation mask intact, no
+# captured constants, no host callbacks, no weak-type/dtype leaks)
+# and fingerprint-match the committed PROGRAM_MANIFEST.json within
+# the 10% cost tolerance. A regression here means something changed
+# INSIDE a hot program — exactly the drift every benchmark below
+# would otherwise mis-attribute to noise.
+echo "[suite] program-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/program_manifest.py --check \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "program-check preflight"
+
 # Continuous-batching preflight (CPU fake backend, ~1 min): the slot
 # engine must beat the sequential-batch policy >= 2x in goodput on a
 # replayed Poisson trace with greedy outputs bit-identical to
