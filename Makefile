@@ -118,6 +118,17 @@ paging-check:
 	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
 		--paging-check
 
+# Tiered-KV guard: replay one long-tail prefix trace (more distinct
+# system prompts than the arena holds) through the paged engine three
+# ways — bf16 + host spill tier, bf16 without it, and an int8 arena
+# at EQUAL HBM bytes; fail unless spill beats re-prefill on
+# token-forward goodput, the int8 arena sustains >= 1.8x the bf16
+# rows/step, and every greedy stream is bit-identical to its matching
+# dense-fallback decode(). Pure CPU, ~3 min.
+spill-check:
+	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
+		--spill-check
+
 bench:
 	python3 bench.py
 
@@ -144,4 +155,4 @@ clean:
 .PHONY: all native test test-native test-native-asan presubmit bench \
 	analysis-check program-check trace-check diagnose-check \
 	goodput-check chaos-check placement-check occupancy-check \
-	paging-check container partition-tpu push clean
+	paging-check spill-check container partition-tpu push clean

@@ -110,7 +110,8 @@ class RetraceGuard:
 
 def engine_programs(paged=True):
     """(name, fn) pairs of the slot-engine program set — the watch
-    list for the buckets + insert + step bound."""
+    list for the buckets + insert + step (+ hydrate, paged) bound.
+    Prefill is always first (bench honesty code indexes it)."""
     from ..models import decode
 
     if paged:
@@ -118,6 +119,10 @@ def engine_programs(paged=True):
             ("engine.paged_prefill", decode._paged_prefill_impl),
             ("engine.paged_insert", decode._paged_insert_impl),
             ("engine.paged_step", decode._paged_step_impl),
+            # Spill-tier rehydrate upload: per-admission, ONE
+            # compiled program however many blocks come back from
+            # the host tier.
+            ("engine.paged_hydrate", decode._paged_hydrate_impl),
         )
     return (
         ("engine.prefill", decode._slot_prefill_impl),
@@ -130,11 +135,12 @@ def engine_guard(paged=True, prefill_budget=1):
     """A guard preloaded with the engine bound: ``prefill_budget``
     programs for admission prefill (= number of distinct admission
     widths the trace may legally compile), ONE insert program, ONE
-    step program. Enter AFTER constructing the engine (construction
-    compiles the cache-init program, which is setup, not traffic)."""
+    step program (and, paged, ONE spill-rehydrate upload program).
+    Enter AFTER constructing the engine (construction compiles the
+    cache-init program, which is setup, not traffic)."""
     guard = RetraceGuard()
     names = engine_programs(paged)
     guard.watch(names[0][0], names[0][1], max_new=prefill_budget)
-    guard.watch(names[1][0], names[1][1], max_new=1)
-    guard.watch(names[2][0], names[2][1], max_new=1)
+    for name, fn in names[1:]:
+        guard.watch(name, fn, max_new=1)
     return guard

@@ -32,6 +32,7 @@ batch to shard.
 import collections
 import functools
 import hashlib
+import time
 
 import jax
 import jax.numpy as jnp
@@ -1131,6 +1132,14 @@ def _slot_cache_init(model, slots, slot_len):
 PAGED_KV_ENV = "CEA_TPU_PAGED_KV"
 KV_BLOCK_ENV = "CEA_TPU_KV_BLOCK"
 KV_BLOCKS_ENV = "CEA_TPU_KV_BLOCKS"
+KV_QUANT_ENV = "CEA_TPU_KV_QUANT"
+KV_SPILL_ENV = "CEA_TPU_KV_SPILL"
+KV_SPILL_BYTES_ENV = "CEA_TPU_KV_SPILL_BYTES"
+
+# Host-RAM spill tier default byte budget (256 MiB): bounded so a
+# long-tail prefix population can't grow host residency without
+# limit — the LRU evicts past it (a true miss then re-prefills).
+DEFAULT_SPILL_BYTES = 256 * 1024 * 1024
 
 # Arena data leaves, by flax variable name — everything else in the
 # paged cache tree is per-row engine state (block_table vectors,
@@ -1139,13 +1148,71 @@ _PAGED_DATA_LEAVES = ("cached_key", "cached_value", "key_scale",
                       "value_scale")
 
 
-def paged_kv_enabled(default=True):
-    """CEA_TPU_PAGED_KV gate: unset/empty -> ``default`` (the paged
-    pool); 0/false/off/no -> the dense fallback."""
-    raw = env_str(PAGED_KV_ENV)
+def _env_flag(env_name, default):
+    """Shared flag-knob parsing: unset/empty -> ``default``;
+    0/false/off/no -> False; anything else -> True."""
+    raw = env_str(env_name)
     if raw is None or not raw.strip():
         return default
     return raw.strip().lower() not in ("0", "false", "off", "no")
+
+
+def paged_kv_enabled(default=True):
+    """CEA_TPU_PAGED_KV gate: unset/empty -> ``default`` (the paged
+    pool); 0/false/off/no -> the dense fallback."""
+    return _env_flag(PAGED_KV_ENV, default)
+
+
+def kv_quant_mode(explicit=None):
+    """Resolve the engine's KV-cache quantization mode: the explicit
+    kwarg wins, else ``CEA_TPU_KV_QUANT``, else "bf16" (the model's
+    native cache dtype). A typo'd mode fails loudly — silently
+    serving a full-size cache would falsify capacity planning."""
+    mode = explicit if explicit is not None else env_str(KV_QUANT_ENV)
+    mode = (str(mode).strip().lower() or "bf16") if mode else "bf16"
+    if mode not in ("bf16", "int8", "int4"):
+        raise ValueError(
+            f"{KV_QUANT_ENV} must be one of bf16|int8|int4: {mode!r}")
+    return mode
+
+
+def kv_spill_enabled(default=True):
+    """CEA_TPU_KV_SPILL gate: unset/empty -> ``default`` (spill on
+    for paged pools); 0/false/off/no -> evicted cold blocks are
+    simply recycled (re-prefill on the next miss)."""
+    return _env_flag(KV_SPILL_ENV, default)
+
+
+def _model_quant_mode(model):
+    """The cache-dtype mode a model actually serves ("bf16" = the
+    native compute dtype)."""
+    native = getattr(model, "kv_cache_dtype", None)
+    if native == "int4":
+        return "int4"
+    if native in ("int8", jnp.int8):
+        return "int8"
+    return "bf16"
+
+
+def kv_token_bytes(model, mode="bf16"):
+    """Per-token per-layer KV-cache bytes (K + V, per-(token, head)
+    f32 scales included) for one cache mode — the analytic basis of
+    the paged arena's equal-HBM sizing: at a fixed byte budget an
+    int8 arena holds ~2x and an int4 arena ~4x the bf16 block count.
+    ``mode="bf16"`` means the model's OWN mode (native dtype, or its
+    own kv_cache_dtype when the model is already quantized)."""
+    heads = int(model.num_heads)
+    kv_heads = int(getattr(model, "num_kv_heads", None) or heads)
+    d = int(model.embed_dim) // heads
+    if mode == "bf16":
+        mode = _model_quant_mode(model)
+    if mode == "int8":
+        per_head = d + 4.0          # 1 byte/value + one f32 scale
+    elif mode == "int4":
+        per_head = d / 2 + 4.0      # packed value pairs + f32 scale
+    else:
+        per_head = float(d * jnp.dtype(model.dtype).itemsize)
+    return 2.0 * kv_heads * per_head
 
 
 class _BlockPool:
@@ -1184,6 +1251,26 @@ class _BlockPool:
         self.prefix_lookups = 0
         self.prefix_hits = 0
         self.shared_tokens = 0
+        # Host-RAM spill tier (off until configure_spill): when a
+        # REGISTERED free block is about to be reused, its contents
+        # copy to pinned host buffers keyed by the same content keys
+        # instead of being destroyed — a later admission whose chain
+        # misses the device index but hits here rehydrates (device
+        # upload + table splice) instead of re-prefilling. LRU over
+        # entries, bounded by a byte budget.
+        self.spill_bytes_limit = 0
+        self._fetch_block = None
+        self._spill_lru = collections.OrderedDict()   # seq -> entry
+        self._spill_index = {}                        # key -> entry
+        self._spill_seq = 0
+        self.spill_bytes_used = 0
+        self.spill_hits = 0
+        self.spill_probes = 0
+        self.spill_captures = 0
+        self.spill_evictions = 0
+        self.rehydrated_blocks = 0
+        self.rehydrate_seconds_total = 0.0
+        self._rehydrate_events = []
 
     def free_count(self):
         return len(self._free_set)
@@ -1207,6 +1294,10 @@ class _BlockPool:
             bid = self._free_order.popleft()
             if bid in self._free_set:
                 self._free_set.discard(bid)
+                if self.spill_enabled() and self._block_keys.get(bid):
+                    # The block's registered content is about to be
+                    # destroyed: evict it to the host tier first.
+                    self._spill_out(bid)
                 self._purge(bid)  # content is about to be overwritten
                 self.ref[bid] = 1
                 return bid
@@ -1231,6 +1322,109 @@ class _BlockPool:
             self._free_set.add(bid)
             self._free_order.append(bid)
             # Keys stay until reuse (lazy purge) for revival hits.
+
+    # -- host-RAM spill tier ------------------------------------------
+
+    def configure_spill(self, bytes_limit, fetch_block):
+        """Arm the spill tier: ``fetch_block(bid)`` must return the
+        block's data leaves as {cache path: host ndarray} (the
+        engine's device->host capture); ``bytes_limit`` bounds host
+        residency (LRU past it)."""
+        self.spill_bytes_limit = int(bytes_limit)
+        self._fetch_block = fetch_block
+
+    def spill_enabled(self):
+        return self.spill_bytes_limit > 0 and self._fetch_block is not None
+
+    def spill_block_count(self):
+        return len(self._spill_lru)
+
+    def _spill_out(self, bid):
+        """Capture a registered block's contents into the host tier
+        (called by ``alloc`` at the moment of reuse — the LRU order
+        is free-list order, i.e. coldness order). Keys whose index
+        pointer moved on to a newer block are skipped; if every key
+        is already host-resident the capture is skipped entirely
+        (content addressing: same chain key = same content)."""
+        keys = [k for k in self._block_keys.get(bid, ())
+                if self._index.get(k) == bid]
+        if not keys:
+            return
+        fresh = [k for k in keys if k not in self._spill_index]
+        if not fresh:
+            for k in keys:
+                self._spill_lru.move_to_end(self._spill_index[k]["seq"])
+            return
+        data = self._fetch_block(bid)
+        entry = {"keys": keys, "data": data,
+                 "nbytes": int(sum(a.nbytes for a in data.values()))}
+        self._spill_seq += 1
+        entry["seq"] = self._spill_seq
+        self._spill_lru[entry["seq"]] = entry
+        displaced = {}
+        for k in keys:
+            old = self._spill_index.get(k)
+            if old is not None:
+                displaced[old["seq"]] = old
+            self._spill_index[k] = entry
+        self.spill_bytes_used += entry["nbytes"]
+        self.spill_captures += 1
+        # Drop entries this capture fully displaced: a re-registered
+        # block whose key set grew would otherwise re-enter the tier
+        # while the stale entry's bytes stayed counted against the
+        # budget, shrinking effective capacity until LRU churn.
+        for old in displaced.values():
+            if not any(self._spill_index.get(k) is old
+                       for k in old["keys"]):
+                self._spill_lru.pop(old["seq"], None)
+                self.spill_bytes_used -= old["nbytes"]
+        self._spill_trim()
+
+    def _spill_trim(self):
+        while (self.spill_bytes_used > self.spill_bytes_limit
+               and self._spill_lru):
+            _, entry = self._spill_lru.popitem(last=False)
+            for k in entry["keys"]:
+                if self._spill_index.get(k) is entry:
+                    del self._spill_index[k]
+            self.spill_bytes_used -= entry["nbytes"]
+            self.spill_evictions += 1
+
+    def _spill_lookup(self, key, count):
+        """Consult the host tier for a chain key that missed the
+        device index. Counted probes/hits feed the
+        tpu_serving_kv_spill_hits_total surface."""
+        if not self.spill_enabled():
+            return None
+        entry = self._spill_index.get(key)
+        if count:
+            self.spill_probes += 1
+            if entry is not None:
+                self.spill_hits += 1
+        return entry
+
+    def take_spill(self, key):
+        """Host-tier content for ``key`` (the admitting engine
+        uploads it into a freshly allocated block). The entry STAYS
+        resident (LRU-refreshed): the host copy keeps serving later
+        admissions after the rehydrated device block is recycled
+        again — that is what makes this a two-level cache rather
+        than a one-shot parking lot."""
+        entry = self._spill_index[key]
+        self._spill_lru.move_to_end(entry["seq"])
+        return entry["data"]
+
+    def note_rehydrate(self, blocks, seconds):
+        self.rehydrated_blocks += int(blocks)
+        self.rehydrate_seconds_total += float(seconds)
+        self._rehydrate_events.append(float(seconds))
+
+    def drain_rehydrate_events(self):
+        """Rehydrate-latency samples since the last drain (the
+        serving loop feeds them into the
+        tpu_serving_kv_rehydrate_seconds histogram)."""
+        events, self._rehydrate_events = self._rehydrate_events, []
+        return events
 
     # -- content-keyed prefix index -----------------------------------
 
@@ -1259,20 +1453,30 @@ class _BlockPool:
         (chain, partial-tokens) keys and comes back as ``fork_src`` —
         the new row WRITES inside that block's span, so it must fork
         a copy instead of taking a reference (copy-on-write).
-        Returns (shared_len, full_block_ids, fork_src)."""
+
+        Two-level: a chain key that misses the device index falls
+        through to the host spill tier; such blocks come back as
+        ("spill", key) sources the admitting engine rehydrates into
+        fresh device blocks. Returns (shared_len, sources, fork_src)
+        where sources is an in-order list of ("dev", block_id) /
+        ("spill", key) and fork_src is None, ("dev", block_id), or
+        ("spill", key)."""
         if count:
             self.prefix_lookups += 1
         bs = self.block_size
         limit = len(tokens) - 1
         chain = None
-        blocks = []
+        sources = []
         i = 0
         while (i + 1) * bs <= limit:
             key = self._chain(chain, tuple(tokens[i * bs:(i + 1) * bs]))
             bid = self._index.get(key)
-            if bid is None:
+            if bid is not None:
+                sources.append(("dev", bid))
+            elif self._spill_lookup(key, count) is not None:
+                sources.append(("spill", key))
+            else:
                 break
-            blocks.append(bid)
             chain = key
             i += 1
         shared = i * bs
@@ -1284,13 +1488,20 @@ class _BlockPool:
                 chain, ("partial", tuple(tokens[shared:shared + q])))
             bid = self._index.get(pk)
             if bid is not None:
-                fork_src, best_q = bid, q
+                fork_src, best_q = ("dev", bid), q
+            elif self._spill_lookup(pk, count=False) is not None:
+                fork_src, best_q = ("spill", pk), q
         shared += best_q
         if count:
             if shared:
                 self.prefix_hits += 1
             self.shared_tokens += shared
-        return shared, blocks, fork_src
+            if fork_src is not None and fork_src[0] == "spill":
+                # The partial scan probes every q; count the one
+                # match so the hit rate stays per-block, not per-q.
+                self.spill_probes += 1
+                self.spill_hits += 1
+        return shared, sources, fork_src
 
     def register(self, tokens, plen, block_of_index):
         """Index an admitted row's prompt blocks: one chain key per
@@ -1336,6 +1547,17 @@ class _BlockPool:
             "indexed_keys": len(self._index),
             "prefix_lookups": int(self.prefix_lookups),
             "prefix_hits": int(self.prefix_hits),
+            "spill": {
+                "enabled": self.spill_enabled(),
+                "bytes_limit": int(self.spill_bytes_limit),
+                "bytes_used": int(self.spill_bytes_used),
+                "blocks": self.spill_block_count(),
+                "hits": int(self.spill_hits),
+                "probes": int(self.spill_probes),
+                "captures": int(self.spill_captures),
+                "evictions": int(self.spill_evictions),
+                "rehydrated_blocks": int(self.rehydrated_blocks),
+            },
         }
 
 
@@ -1440,6 +1662,27 @@ def _paged_insert_impl(cache, row_pos, seen, rngs, pre_cache, slot,
             seen.at[slot].set(seen_row), rngs.at[slot].set(rng_row))
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paged_hydrate_impl(cache, payload, dests):
+    """Upload spilled prefix-block contents back into the arena.
+
+    ``payload`` maps each data-leaf path (the flatten_dict tuple) to
+    an [n_blk, block_size, ...] host stack of block contents;
+    ``dests[j]`` is the physical arena block payload row j lands in
+    (num_blocks = drop sentinel for padding rows). The arena is
+    donated, so rehydration is an in-place scatter, not an arena
+    copy. ONE compiled program total, called at most once per
+    admission that hit the host tier — rehydration is per-admission
+    work, never per-step, so the engine's program bound gains
+    exactly one (registered, budgeted) program."""
+    flat = traverse_util.flatten_dict(unfreeze(cache))
+    for path, leaf in flat.items():
+        if path[-1] in _PAGED_DATA_LEAVES:
+            flat[path] = leaf.at[dests].set(
+                payload[path].astype(leaf.dtype), mode="drop")
+    return traverse_util.unflatten_dict(flat)
+
+
 @functools.partial(jax.jit, static_argnames=("model",),
                    donate_argnums=(2, 3, 4, 5))
 def _paged_step_impl(model, params, cache, row_pos, seen, rngs, tok,
@@ -1511,11 +1754,27 @@ class SlotDecodeEngine:
     reservation; ``pin_prefix`` keeps a system prompt's blocks
     permanently resident. Program set: one prefill program per
     admission width + one insert + one step — the dense pool's bound.
+
+    **Tiered KV** (this iteration): ``kv_quant`` /
+    ``CEA_TPU_KV_QUANT`` picks the arena's cache dtype —
+    ``bf16`` (native), ``int8``, or ``int4`` (two values per byte,
+    per-(token, head) f32 scale blocks gathered through the same
+    block table) — and the default arena block count is derived from
+    the dense pool's NATIVE byte budget, so int8/int4 arenas hold
+    ~2x/~4x the blocks at equal HBM. ``kv_spill`` /
+    ``CEA_TPU_KV_SPILL`` (default on; budget
+    ``CEA_TPU_KV_SPILL_BYTES``) adds a host-RAM spill tier under the
+    prefix index: a registered free block's contents evict to host
+    buffers at reuse time and rehydrate (one `_paged_hydrate_impl`
+    upload + table splice, COW and reservation accounting intact)
+    when a later admission's chain hits them — a real two-level
+    cache, so cold tenants park instead of re-prefilling.
     """
 
     def __init__(self, model, params, slots, slot_len, *, paged=None,
                  kv_block_size=None, kv_blocks=None, buckets=None,
-                 pin_reserve_tokens=0):
+                 pin_reserve_tokens=0, kv_quant=None, kv_spill=None,
+                 kv_spill_bytes=None):
         if getattr(model, "attention_window", 0):
             raise ValueError(
                 "SlotDecodeEngine requires a dense cache "
@@ -1527,6 +1786,19 @@ class SlotDecodeEngine:
                 f"{model.max_seq_len}")
         if slots < 1 or slot_len < 2:
             raise ValueError("need slots >= 1 and slot_len >= 2")
+        # Tiered-KV quantization (CEA_TPU_KV_QUANT / kv_quant=):
+        # int8/int4 clone the whole model family's cache dtype, so
+        # prefill/insert/step — and the dense fallback — all
+        # quantize identically (the token-identical-to-dense-
+        # fallback contract). Per-token native bytes are captured
+        # BEFORE the clone: they are the equal-HBM budget the
+        # quantized arena's block count is derived from.
+        quant = kv_quant_mode(kv_quant)
+        native_tok_bytes = kv_token_bytes(model)
+        quant_tok_bytes = kv_token_bytes(model, quant)
+        if quant != "bf16" and _model_quant_mode(model) != quant:
+            model = model.clone(kv_cache_dtype=quant)
+        self.kv_quant = _model_quant_mode(model)
         self._base_model = model
         self._params = params
         # Parameter counts: the 2·N-FLOPs-per-token analytic basis
@@ -1576,8 +1848,19 @@ class SlotDecodeEngine:
             # `is not None`, not truthiness: an explicit 0 (manifest
             # typo) must hit the too-small guard below, not silently
             # select the default arena.
-            nb = (int(nb) if nb is not None
-                  else self.slots * self._n_blk + pin_blocks + 1)
+            if nb is not None:
+                nb = int(nb)
+            else:
+                # Equal-HBM sizing: the budget is the dense pool's
+                # NATIVE KV bytes (slots x slot_len); a quantized
+                # arena holds the block count that budget buys at
+                # the quantized per-token cost — ~2x (int8) / ~4x
+                # (int4) the bf16 block count at the same memory.
+                # Unquantized arenas reduce exactly to the PR 8
+                # block-count equality (ratio 1).
+                usable = int(self.slots * self._n_blk
+                             * native_tok_bytes / quant_tok_bytes)
+                nb = usable + pin_blocks + 1
             if nb < self._n_blk + 1:
                 raise ValueError(
                     f"kv_blocks {nb} cannot hold even one full row "
@@ -1585,6 +1868,19 @@ class SlotDecodeEngine:
             self._num_blocks = nb
             self._trash = nb - 1
             self._pool = _BlockPool(nb, bs)
+            # Host-RAM spill tier (CEA_TPU_KV_SPILL, default on):
+            # cold registered prefix blocks evict their contents to
+            # host buffers at reuse time and rehydrate on a content-
+            # key hit instead of re-prefilling.
+            spill_on = (kv_spill if kv_spill is not None
+                        else kv_spill_enabled())
+            spill_bytes = int(
+                kv_spill_bytes if kv_spill_bytes is not None
+                else env_number(KV_SPILL_BYTES_ENV,
+                                DEFAULT_SPILL_BYTES, parse=int))
+            if spill_on and spill_bytes > 0:
+                self._pool.configure_spill(spill_bytes,
+                                           self._fetch_block)
             self._tables = np.full((self.slots, self._n_blk),
                                    self._trash, np.int32)
             self._slot_blocks = [[] for _ in range(self.slots)]
@@ -1600,6 +1896,15 @@ class SlotDecodeEngine:
                 per_row_index=True)
         self._cache = _slot_cache_init(self._step_model, self.slots,
                                        self.slot_len)
+        # Exact resident KV bytes (data leaves only — tables and
+        # counters excluded): the number kv_block_stats and the
+        # postmortem provider report so diagnose bundles distinguish
+        # "small arena" from "quantized arena" at a glance.
+        self.kv_arena_bytes = int(sum(
+            leaf.size * leaf.dtype.itemsize
+            for path, leaf in
+            traverse_util.flatten_dict(unfreeze(self._cache)).items()
+            if path[-1] in _PAGED_DATA_LEAVES))
         self._row_pos = jnp.zeros((self.slots,), jnp.int32)
         self._seen = jnp.zeros((self.slots, model.vocab_size), bool)
         self._rngs = jnp.stack(
@@ -1679,26 +1984,36 @@ class SlotDecodeEngine:
         ``needed`` counts what this admission must be able to claim:
         its whole private span (prompt blocks beyond the shared
         prefix + worst-case generation growth, reserved up front so
-        step-time allocation cannot fail) plus any shared blocks it
-        revives off the free list."""
+        step-time allocation cannot fail), any shared device blocks
+        it revives off the free list, and one fresh device block per
+        host-tier (spilled) source it must rehydrate into."""
         toks = np.asarray(tokens, np.int32).reshape(-1)[:prompt_len]
         share = (allow_prefix and prompt_len >= 2
                  and float(repetition_penalty) == 1.0)
         if share:
-            shared, blocks, fork_src = self._pool.lookup(
+            shared, sources, fork_src = self._pool.lookup(
                 toks, count=count)
         else:
-            shared, blocks, fork_src = 0, [], None
+            shared, sources, fork_src = 0, [], None
         if max_new is None:
             max_new = self.slot_len - prompt_len
         bs = self._block_size
         total_span = -(-(prompt_len + int(max_new)) // bs)
-        private_total = total_span - len(blocks)
-        revived = sum(1 for b in blocks if self._pool.ref[b] == 0)
-        return {"tokens": toks, "shared": shared, "blocks": blocks,
+        private_total = total_span - len(sources)
+        revived = sum(1 for kind, b in sources
+                      if kind == "dev" and self._pool.ref[b] == 0)
+        spilled = sum(1 for kind, _ in sources if kind == "spill")
+        # A rehydrating admission pins a free-listed (ref-0) device
+        # fork donor for its duration (see _paged_admit), taking it
+        # out of the free set — one extra block of headroom.
+        pin_donor = (spilled > 0 and fork_src is not None
+                     and fork_src[0] == "dev"
+                     and self._pool.ref[fork_src[1]] == 0)
+        return {"tokens": toks, "shared": shared, "sources": sources,
                 "fork_src": fork_src, "total_span": total_span,
                 "private_total": private_total,
-                "needed": private_total + revived,
+                "needed": (private_total + revived + spilled
+                           + (1 if pin_donor else 0)),
                 # ONE authority for lookup AND registration: a
                 # diverged copy in admit() could register blocks it
                 # never looked up (or vice versa).
@@ -1746,6 +2061,54 @@ class SlotDecodeEngine:
             self._committed_slot[slot] -= 1
             self._pool.committed -= 1
 
+    def _fetch_block(self, bid):
+        """Device->host copy of one arena block's data leaves — the
+        spill tier's capture callback ({cache path: host ndarray}).
+        Called by the pool at block-reuse time, always between
+        program calls on the engine's owning thread, so the arena
+        read is never racing a donated buffer. The transfers start
+        async and resolve in ONE device_get; what remains is the
+        spill tier's capture tax — one block's bytes over PCIe per
+        reuse of a registered block, amortized by the content-dedupe
+        in _spill_out (an already-host-resident block skips the
+        fetch entirely) and bounded per step by how many rows cross
+        a block boundary at once."""
+        flat = traverse_util.flatten_dict(unfreeze(self._cache))
+        out = {path: leaf[bid] for path, leaf in flat.items()
+               if path[-1] in _PAGED_DATA_LEAVES}
+        for arr in out.values():
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()
+        return jax.device_get(out)
+
+    def _rehydrate(self, pairs, spill_data):
+        """Upload spilled block contents into freshly allocated arena
+        blocks: ONE _paged_hydrate_impl call per admission (fixed
+        [n_blk]-row payload, sentinel-padded), timed into the
+        tpu_serving_kv_rehydrate_seconds surface."""
+        t0 = time.perf_counter()
+        dests = np.full((self._n_blk,), self._num_blocks, np.int32)
+        stacks = {}
+        for j, (bid, key) in enumerate(pairs):
+            dests[j] = bid
+            for path, arr in spill_data[key].items():
+                stacks.setdefault(path, []).append(arr)
+        payload = {}
+        for path, arrs in stacks.items():
+            buf = np.zeros((self._n_blk,) + arrs[0].shape,
+                           arrs[0].dtype)
+            buf[:len(arrs)] = np.stack(arrs)
+            payload[path] = buf
+        self._cache = _paged_hydrate_impl(self._cache, payload,
+                                          jnp.asarray(dests))
+        # Block before closing the clock: jit dispatch is async, and
+        # the histogram claims UPLOAD latency — without the sync the
+        # real transfer cost would land unattributed in the next
+        # prefill's TTFT while this surface reads near-zero.
+        jax.block_until_ready(self._cache)
+        self._pool.note_rehydrate(len(pairs),
+                                  time.perf_counter() - t0)
+
     def _paged_admit(self, slot, plan, prompt_len, temperature,
                      top_k, top_p, min_p, repetition_penalty, seed):
         pool, bs = self._pool, self._block_size
@@ -1756,57 +2119,131 @@ class SlotDecodeEngine:
                 f"available {pool.available()}); queue the admission")
         toks, shared = plan["tokens"], plan["shared"]
         fork_src = plan["fork_src"]
-        # Prefill the suffix against the resident prefix: full shared
-        # blocks by reference, the partial boundary block READ from
-        # its current owner (the fork copy happens at insert).
-        ptab = np.full((self._n_blk,), self._trash, np.int32)
-        ptab[:len(plan["blocks"])] = plan["blocks"]
-        if fork_src is not None:
-            ptab[len(plan["blocks"])] = fork_src
-        pre_cache, first, first_lp, echo, seen_row, rng_row = (
-            self._paged_prefill(toks[shared:], shared, ptab,
-                                temperature, top_k, top_p, min_p,
-                                repetition_penalty, seed))
-        # Map + allocate this row's blocks. Shared full blocks take a
-        # reference; the partial boundary block forks (COW — the row
-        # is about to write inside its span); the rest of the prompt
-        # span allocates fresh.
+        # Snapshot host-tier payloads FIRST: the allocations below
+        # can themselves spill blocks and trim the LRU, and a trimmed
+        # entry this admission planned to rehydrate must stay alive
+        # (the reference keeps the arrays; the pool may drop its
+        # pointers).
+        spill_keys = [ref for kind, ref in plan["sources"]
+                      if kind == "spill"]
+        if fork_src is not None and fork_src[0] == "spill":
+            spill_keys.append(fork_src[1])
+        spill_data = {key: pool.take_spill(key) for key in spill_keys}
+        # Materialize the shared span. Device blocks take a reference
+        # — incref BEFORE any alloc, so a revived (ref-0 free-listed)
+        # shared block can never be popped out from under the plan.
+        # Host-tier blocks allocate fresh device blocks and batch
+        # into one rehydrate upload.
         table_row = self._tables[slot]
         slot_blocks = self._slot_blocks[slot]
-        for i, b in enumerate(plan["blocks"]):
-            pool.incref(b)
-            table_row[i] = b
-            slot_blocks.append(b)
-        cow_src = cow_dst = self._num_blocks  # drop sentinel
-        aligned_idx = shared // bs
-        if fork_src is not None:
-            dst = pool.alloc()
-            table_row[aligned_idx] = dst
-            slot_blocks.append(dst)
-            cow_src, cow_dst = fork_src, dst
-            fresh_from = aligned_idx + 1
-        else:
-            fresh_from = aligned_idx
-        last_idx = (prompt_len - 1) // bs
-        for bi in range(fresh_from, last_idx + 1):
-            b = pool.alloc()
-            table_row[bi] = b
-            slot_blocks.append(b)
-        remaining = plan["total_span"] - (last_idx + 1)
-        self._committed_slot[slot] = remaining
-        pool.committed += remaining
-        dest_per_pos = np.full((self.slot_len,), self._num_blocks,
-                               np.int32)
-        span = np.arange(shared, prompt_len)
-        dest_per_pos[span] = table_row[span // bs]
-        self._cache, self._row_pos, self._seen, self._rngs = (
-            _paged_insert_impl(
-                self._cache, self._row_pos, self._seen, self._rngs,
-                pre_cache, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(prompt_len, jnp.int32), seen_row,
-                rng_row, jnp.asarray(dest_per_pos),
-                jnp.asarray(cow_src, jnp.int32),
-                jnp.asarray(cow_dst, jnp.int32)))
+        hold = None
+        try:
+            for i, (kind, ref) in enumerate(plan["sources"]):
+                if kind == "dev":
+                    pool.incref(ref)
+                    table_row[i] = ref
+                    slot_blocks.append(ref)
+            if (fork_src is not None and fork_src[0] == "dev"
+                    and any(kind == "spill"
+                            for kind, _ in plan["sources"])):
+                # Pin the fork donor while a rehydrate is in flight:
+                # it may be a free-listed (ref-0 revival) block, and
+                # the hydrate allocations below must never pop it —
+                # an upload landing IN the donor would destroy the
+                # partial content the prefill gather and the insert's
+                # COW copy still need. (Without a hydrate nothing
+                # writes the arena before the insert, so no pin is
+                # needed — host bookkeeping alone can't corrupt
+                # content.)
+                hold = fork_src[1]
+                pool.incref(hold)
+            hydrate = []                      # (dest block, key)
+            for i, (kind, ref) in enumerate(plan["sources"]):
+                if kind == "spill":
+                    bid = pool.alloc()
+                    table_row[i] = bid
+                    slot_blocks.append(bid)
+                    hydrate.append((bid, ref))
+            cow_src = cow_dst = self._num_blocks  # drop sentinel
+            aligned_idx = shared // bs
+            if fork_src is not None:
+                dst = pool.alloc()
+                table_row[aligned_idx] = dst
+                slot_blocks.append(dst)
+                kind, ref = fork_src
+                if kind == "dev":
+                    cow_src, cow_dst = ref, dst
+                    boundary = ref
+                else:
+                    # A spilled partial boundary block rehydrates
+                    # DIRECTLY into its fork destination: the upload
+                    # IS the copy-on-write copy, and the suffix
+                    # scatter then overwrites exactly the fork's
+                    # tail.
+                    hydrate.append((dst, ref))
+                    boundary = dst
+                fresh_from = aligned_idx + 1
+            else:
+                fresh_from = aligned_idx
+            if hydrate:
+                self._rehydrate(hydrate, spill_data)
+            # Prefill the suffix against the (now fully resident)
+            # prefix: full shared blocks + the partial boundary block
+            # read from its current owner (dev fork copies at insert;
+            # a rehydrated fork already owns its private copy).
+            ptab = np.full((self._n_blk,), self._trash, np.int32)
+            for i in range(len(plan["sources"])):
+                ptab[i] = table_row[i]
+            if fork_src is not None:
+                ptab[len(plan["sources"])] = boundary
+            pre_cache, first, first_lp, echo, seen_row, rng_row = (
+                self._paged_prefill(toks[shared:], shared, ptab,
+                                    temperature, top_k, top_p, min_p,
+                                    repetition_penalty, seed))
+            last_idx = (prompt_len - 1) // bs
+            for bi in range(fresh_from, last_idx + 1):
+                b = pool.alloc()
+                table_row[bi] = b
+                slot_blocks.append(b)
+            remaining = plan["total_span"] - (last_idx + 1)
+            self._committed_slot[slot] = remaining
+            pool.committed += remaining
+            dest_per_pos = np.full((self.slot_len,), self._num_blocks,
+                                   np.int32)
+            span = np.arange(shared, prompt_len)
+            dest_per_pos[span] = table_row[span // bs]
+            self._cache, self._row_pos, self._seen, self._rngs = (
+                _paged_insert_impl(
+                    self._cache, self._row_pos, self._seen,
+                    self._rngs, pre_cache,
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(prompt_len, jnp.int32), seen_row,
+                    rng_row, jnp.asarray(dest_per_pos),
+                    jnp.asarray(cow_src, jnp.int32),
+                    jnp.asarray(cow_dst, jnp.int32)))
+            if hold is not None:
+                # The COW copy has landed; drop the donor pin (a
+                # revival donor returns to the free list, keys
+                # intact).
+                pool.decref(hold)
+                hold = None
+        except BaseException:
+            # A device-side failure (compile error on a first-seen
+            # width, OOM in hydrate/prefill/insert) must leave the
+            # pool EXACTLY as it found it: the serving loop catches
+            # admission errors and keeps serving, so a leaked
+            # incref/alloc would shrink the admission budget forever
+            # and a stale _slot_blocks entry would double-decref at
+            # the next row's release.
+            for b in slot_blocks:
+                pool.decref(b)
+            self._slot_blocks[slot] = []
+            table_row[:] = self._trash
+            pool.committed -= int(self._committed_slot[slot])
+            self._committed_slot[slot] = 0
+            if hold is not None:
+                pool.decref(hold)
+            raise
         if plan["share_eligible"]:
             pool.register(toks, prompt_len, table_row)
         self._pos_host[slot] = prompt_len
@@ -1892,6 +2329,18 @@ class SlotDecodeEngine:
                 round(pool.prefix_hits / pool.prefix_lookups, 4)
                 if pool.prefix_lookups else None),
             "prefix_tokens_shared": pool.shared_tokens,
+            # Tiered-KV surface: what backs the arena (quant mode +
+            # exact resident bytes) and how the host spill tier is
+            # doing (blocks parked, two-level hit rate, rehydrates).
+            "kv_quant_mode": self.kv_quant,
+            "kv_arena_bytes": self.kv_arena_bytes,
+            "kv_spill_blocks": pool.spill_block_count(),
+            "kv_spill_bytes": int(pool.spill_bytes_used),
+            "kv_spill_hits": int(pool.spill_hits),
+            "kv_spill_hit_rate": (
+                round(pool.spill_hits / pool.spill_probes, 4)
+                if pool.spill_probes else None),
+            "kv_rehydrated_blocks": int(pool.rehydrated_blocks),
         }
 
     def reset_prefix_counters(self):
@@ -1904,6 +2353,17 @@ class SlotDecodeEngine:
             self._pool.prefix_lookups = 0
             self._pool.prefix_hits = 0
             self._pool.shared_tokens = 0
+            self._pool.spill_probes = 0
+            self._pool.spill_hits = 0
+
+    def drain_rehydrate_events(self):
+        """Rehydrate-latency samples (seconds) since the last call —
+        the serving loop feeds them into the
+        tpu_serving_kv_rehydrate_seconds histogram. Empty on the
+        dense pool."""
+        if not self.paged:
+            return []
+        return self._pool.drain_rehydrate_events()
 
     def block_pool_state(self):
         """Postmortem state provider: free-list/refcount/table
@@ -1912,6 +2372,8 @@ class SlotDecodeEngine:
             return {"paged": False}
         state = self._pool.state()
         state["paged"] = True
+        state["kv_quant_mode"] = self.kv_quant
+        state["kv_arena_bytes"] = self.kv_arena_bytes
         state["pinned_blocks"] = len(self._pinned)
         state["tables"] = {
             int(s): [int(b) for b in self._tables[s]
@@ -2148,12 +2610,7 @@ def beam_search(model, params, prompt, max_new_tokens, *,
 # and parallel/ against hot_program_specs().
 
 
-def _hot_example_engine(paged):
-    """The canonical tiny engine the manifest derives against:
-    deterministic init (fixed PRNG keys), one 8-wide bucket, block
-    size 4 — small enough to lower in seconds, structurally identical
-    to production (per-layer cache trees, block tables, the full
-    sampling-knob signature)."""
+def _hot_example_model():
     from .transformer import TransformerLM
 
     model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
@@ -2161,13 +2618,25 @@ def _hot_example_engine(paged):
                           dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _hot_example_engine(paged, kv_quant="bf16"):
+    """The canonical tiny engine the manifest derives against:
+    deterministic init (fixed PRNG keys), one 8-wide bucket, block
+    size 4 — small enough to lower in seconds, structurally identical
+    to production (per-layer cache trees, block tables, the full
+    sampling-knob signature). ``kv_quant`` selects the quantized-
+    arena variants (int8/int4 buffers + scale blocks change the
+    program avals, so each mode fingerprints separately)."""
+    model, params = _hot_example_model()
     kwargs = ({"paged": True, "kv_block_size": 4} if paged
               else {"paged": False})
     return SlotDecodeEngine(model, params, slots=4, slot_len=24,
-                            buckets=[8], **kwargs)
+                            buckets=[8], kv_quant=kv_quant, **kwargs)
 
 
-def _hot_engine_calls(paged):
+def _hot_engine_calls(paged, kv_quant="bf16"):
     """{program global name: (args, kwargs)} of each engine program's
     first REAL call, captured by swapping the module globals for
     recorders while one admission + one step runs on the canonical
@@ -2188,7 +2657,7 @@ def _hot_engine_calls(paged):
     for name in names:
         globals()[name] = recorder(name)
     try:
-        eng = _hot_example_engine(paged)
+        eng = _hot_example_engine(paged, kv_quant)
         row = np.zeros((8,), np.int32)
         row[:6] = np.arange(4, 10, dtype=np.int32)
         eng.admit(row, 6)
@@ -2199,16 +2668,57 @@ def _hot_engine_calls(paged):
     return calls
 
 
+def _hot_hydrate_call():
+    """The hydrate program's first REAL call, captured from a
+    scripted evict -> reuse -> rehydrate episode on a minimal
+    spill-enabled engine: admit A, release; two filler admissions
+    recycle A's blocks into the host tier; re-admitting A hits the
+    tier and uploads — the exact calling convention serving's
+    rehydrate path uses."""
+    real = globals()["_paged_hydrate_impl"]
+    calls = {}
+
+    def wrapped(*args, **kwargs):
+        calls.setdefault("_paged_hydrate_impl", (args, kwargs))
+        return real(*args, **kwargs)
+
+    globals()["_paged_hydrate_impl"] = wrapped
+    try:
+        model, params = _hot_example_model()
+        eng = SlotDecodeEngine(model, params, slots=1, slot_len=16,
+                               paged=True, kv_block_size=4,
+                               kv_blocks=5, buckets=[8],
+                               kv_quant="bf16", kv_spill=True,
+                               kv_spill_bytes=1 << 20)
+        for row in ((1, 2, 3, 4, 5, 6), (9, 8, 7, 6, 5, 4),
+                    (11, 12, 13, 14, 15, 16), (1, 2, 3, 4, 5, 6)):
+            slot, _, _, _ = eng.admit(np.asarray(row, np.int32), 6,
+                                      max_new=2)
+            eng.release(slot)
+    finally:
+        globals()["_paged_hydrate_impl"] = real
+    if "_paged_hydrate_impl" not in calls:
+        raise RuntimeError(
+            "hydrate capture episode never rehydrated — the spill "
+            "tier's reuse path changed; fix the scripted episode")
+    return calls["_paged_hydrate_impl"]
+
+
 def hot_program_specs():
     """The slot engine's registered hot programs: the dense and paged
-    prefill/insert/step trios, each bound to the args of a real call
-    on the canonical example engine. tools/program_manifest.py
-    derives PROGRAM_MANIFEST.json from this list and `make
-    program-check` re-derives and diffs."""
+    prefill/insert/step trios (the paged trio additionally in its
+    int8 and int4 quantized-arena modes) plus the spill-tier
+    rehydrate upload, each bound to the args of a real call on the
+    canonical example engine. tools/program_manifest.py derives
+    PROGRAM_MANIFEST.json from this list and `make program-check`
+    re-derives and diffs."""
     from ..analysis.xprog import HotProgram
 
     dense = _hot_engine_calls(paged=False)
     paged = _hot_engine_calls(paged=True)
+    int8 = _hot_engine_calls(paged=True, kv_quant="int8")
+    int4 = _hot_engine_calls(paged=True, kv_quant="int4")
+    hydrate = _hot_hydrate_call()
     return (
         HotProgram("engine.dense_prefill", _slot_prefill_impl,
                    *dense["_slot_prefill_impl"]),
@@ -2222,4 +2732,18 @@ def hot_program_specs():
                    *paged["_paged_insert_impl"]),
         HotProgram("engine.paged_step", _paged_step_impl,
                    *paged["_paged_step_impl"]),
+        HotProgram("engine.paged_int8_prefill", _paged_prefill_impl,
+                   *int8["_paged_prefill_impl"]),
+        HotProgram("engine.paged_int8_insert", _paged_insert_impl,
+                   *int8["_paged_insert_impl"]),
+        HotProgram("engine.paged_int8_step", _paged_step_impl,
+                   *int8["_paged_step_impl"]),
+        HotProgram("engine.paged_int4_prefill", _paged_prefill_impl,
+                   *int4["_paged_prefill_impl"]),
+        HotProgram("engine.paged_int4_insert", _paged_insert_impl,
+                   *int4["_paged_insert_impl"]),
+        HotProgram("engine.paged_int4_step", _paged_step_impl,
+                   *int4["_paged_step_impl"]),
+        HotProgram("engine.paged_hydrate", _paged_hydrate_impl,
+                   *hydrate),
     )

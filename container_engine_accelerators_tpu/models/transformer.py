@@ -85,6 +85,34 @@ def _quantize_rows_int8(x):
     return q, scale
 
 
+def _quantize_rows_int4(x):
+    """Symmetric int4 quantization per trailing-dim row, packed two
+    values per byte along the head dim (even head dims only).
+
+    Returns (uint8 packed values [..., D/2], f32 scale with a keepdim
+    trailing axis); value pair (x[2i], x[2i+1]) lives in the low and
+    high nibbles of packed[i], biased by +8 so the int4 range [-7, 7]
+    stores as [1, 15]. x ~= unpack(packed) * scale.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(xf / scale), -7, 7).astype(jnp.int32) + 8
+    lo, hi = q[..., 0::2], q[..., 1::2]
+    return (lo | (hi << 4)).astype(jnp.uint8), scale
+
+
+def _unpack_int4(packed):
+    """Inverse of the :func:`_quantize_rows_int4` pack: uint8
+    [..., D/2] -> int8 [..., D] in [-7, 7]. Integer arithmetic only —
+    the int->compute-dtype convert happens at the attention dot, the
+    same site the int8 path converts at."""
+    lo = (packed & 0xF).astype(jnp.int8) - 8
+    hi = (packed >> 4).astype(jnp.int8) - 8
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * packed.shape[-1],))
+
+
 def apply_rope(x, positions, base=10000.0):
     """Rotary position embedding. x: [B, S, H, D]; positions: [S]
     int32 (global sequence positions of the S axis), or [B, S] when
@@ -150,7 +178,9 @@ class CausalSelfAttention(nn.Module):
     mesh: Any = None  # residual-stream sharding pin (no extra params)
     # "int8" quantizes the decode KV cache (symmetric per-token/head
     # scales): cache residency halves vs bf16, so a serving replica
-    # holds ~2x the context or batch. None keeps the compute dtype.
+    # holds ~2x the context or batch. "int4" packs two values per
+    # byte along the (even) head dim for ~4x, same scale layout.
+    # None keeps the compute dtype.
     kv_cache_dtype: Any = None
     # Grouped-query attention: K/V projected to this many heads
     # (must divide num_heads); the KV cache shrinks by the same
@@ -272,16 +302,27 @@ class CausalSelfAttention(nn.Module):
         The scales are constant along the head dim, so they fold into
         the attention scores and probabilities (O(B*S*H) work) rather
         than into a dequantized full-size copy of the cache.
+        "int4" halves residency again: two values pack into each
+        byte along the head dim (uint8 buffers of width D/2, same
+        per-(position, head) f32 scale layout); the unpack is integer
+        nibble arithmetic fused into the gather path, and on the
+        paged arena the scale blocks gather through the same block
+        table as the values.
         """
         from ..parallel.context import dot_product_attention
 
-        quantized = self.kv_cache_dtype in ("int8", jnp.int8)
+        int4 = self.kv_cache_dtype == "int4"
+        quantized = int4 or self.kv_cache_dtype in ("int8", jnp.int8)
         if self.kv_cache_dtype is not None and not quantized:
             # A typo'd dtype silently serving a full-size cache would
             # falsify the operator's capacity planning.
             raise ValueError(
                 f"unsupported kv_cache_dtype {self.kv_cache_dtype!r}; "
-                f"use None or \"int8\"")
+                f"use None, \"int8\", or \"int4\"")
+        if int4 and (q.shape[-1] % 2):
+            raise ValueError(
+                f"kv_cache_dtype=\"int4\" packs value pairs along the "
+                f"head dim and needs it even, got {q.shape[-1]}")
         if self.per_row_index and (self.window or self.ring_slack):
             # A freed-then-reused ring slot's stale slot_pos could
             # pass the window band for a row rewound to an earlier
@@ -300,7 +341,11 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 "kv_pages (paged KV cache) requires per_row_index "
                 "(the block table is per-row slot-engine state)")
-        cache_dtype = jnp.int8 if quantized else k.dtype
+        cache_dtype = (jnp.uint8 if int4
+                       else jnp.int8 if quantized else k.dtype)
+        # Buffer tail shape: int4 packs two head-dim values per byte.
+        kv_tail = (k.shape[2:-1] + (k.shape[-1] // 2,) if int4
+                   else k.shape[2:])
         is_init = not self.has_variable("cache", "cached_key")
         # Sliding-window models keep a RING buffer of window slots
         # instead of the full sequence: position p lives in slot
@@ -322,14 +367,14 @@ class CausalSelfAttention(nn.Module):
                 raise ValueError(
                     f"kv_pages needs num_blocks >= 2 and "
                     f"block_size >= 1: {self.kv_pages}")
-            cache_shape = (num_blocks, block_size) + k.shape[2:]
+            cache_shape = (num_blocks, block_size) + kv_tail
             blocks_per_row = -(-k.shape[1] // block_size)
             block_table = self.variable(
                 "cache", "block_table",
                 lambda: jnp.full((k.shape[0], blocks_per_row),
                                  num_blocks - 1, jnp.int32))
         else:
-            cache_shape = k.shape[:1] + (c_len,) + k.shape[2:]
+            cache_shape = k.shape[:1] + (c_len,) + kv_tail
         cached_k = self.variable("cache", "cached_key", jnp.zeros,
                                  cache_shape, cache_dtype)
         cached_v = self.variable("cache", "cached_value", jnp.zeros,
@@ -438,8 +483,9 @@ class CausalSelfAttention(nn.Module):
                    else i + pos)
             q, k = apply_rope(q, pos), apply_rope(k, pos)
         if quantized:
-            kq, ks = _quantize_rows_int8(k)
-            vq, vs = _quantize_rows_int8(v)
+            quantize = _quantize_rows_int4 if int4 else _quantize_rows_int8
+            kq, ks = quantize(k)
+            vq, vs = quantize(v)
             cached_k.value = cache_write(cached_k.value, kq)
             cached_v.value = cache_write(cached_v.value, vq)
             k_scale.value = cache_write(k_scale.value, ks)
@@ -501,6 +547,12 @@ class CausalSelfAttention(nn.Module):
             k_read, v_read = cached_k.value, cached_v.value
             if quantized:
                 ks_read, vs_read = k_scale.value, v_scale.value
+        if int4:
+            # Nibble unpack (integer ops only): the int->compute-dtype
+            # convert below fuses into the dot's operand read exactly
+            # like the int8 path's.
+            k_read = _unpack_int4(k_read)
+            v_read = _unpack_int4(v_read)
         # Grouped form (g == 1 is plain MHA): queries reshape to
         # [B, Q, Hkv, G, D] and attend their KV head directly — no
         # repeated/materialized copy of the cache, which at decode

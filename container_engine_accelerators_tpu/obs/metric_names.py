@@ -62,6 +62,9 @@ SERVING_SLOTS_FREE = "tpu_serving_slots_free"
 SERVING_KV_BLOCKS_TOTAL = "tpu_serving_kv_blocks_total"
 SERVING_KV_BLOCKS_FREE = "tpu_serving_kv_blocks_free"
 SERVING_KV_BLOCKS_SHARED = "tpu_serving_kv_blocks_shared"
+SERVING_KV_SPILL_BLOCKS = "tpu_serving_kv_spill_blocks"
+SERVING_KV_SPILL_HITS = "tpu_serving_kv_spill_hits_total"
+SERVING_KV_REHYDRATE = "tpu_serving_kv_rehydrate_seconds"
 
 # name -> one-line help. The authoritative set: the metric-registry
 # lint resolves every tpu_* literal in the tree against these keys
@@ -95,6 +98,9 @@ METRICS = {
     SERVING_KV_BLOCKS_TOTAL: "paged KV arena size in blocks",
     SERVING_KV_BLOCKS_FREE: "paged KV blocks on the free list",
     SERVING_KV_BLOCKS_SHARED: "paged KV blocks with refcount > 1",
+    SERVING_KV_SPILL_BLOCKS: "prefix blocks parked in the host tier",
+    SERVING_KV_SPILL_HITS: "admissions served from the spill tier",
+    SERVING_KV_REHYDRATE: "spill-tier rehydrate upload latency",
 }
 
 # tpu_-prefixed tokens that are NOT metric names (label keys, module

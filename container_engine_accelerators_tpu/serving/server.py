@@ -359,6 +359,12 @@ class _EngineService:
         self._tpot_hist = obs.histogram(
             TPOT_HISTOGRAM,
             "Inter-token latency per generated token")
+        # Spill-tier rehydrate latency (device upload + splice) and
+        # the running hit count already published as a counter.
+        self._rehydrate_hist = obs.histogram(
+            metric_names.SERVING_KV_REHYDRATE,
+            "Spill-tier rehydrate upload latency per admission")
+        self._spill_hits_pub = 0
         self._slo_ttft_s = _slo_threshold_s(SLO_TTFT_ENV)
         self._slo_tpot_s = _slo_threshold_s(SLO_TPOT_ENV)
         self._slo_violations = {"ttft": 0, "tpot": 0}
@@ -470,8 +476,12 @@ class _EngineService:
             # Prefix servers' warm rows admit THROUGH the pinned
             # prefix (counted hits by design — they compile the real
             # traffic shape); the published hit rate must describe
-            # real traffic only.
+            # real traffic only. The spill-hit counter baseline must
+            # reset WITH the engine's count: a stale high-water mark
+            # would swallow the first post-reset hits from the
+            # tpu_serving_kv_spill_hits_total deltas.
             self._engine.reset_prefix_counters()
+            self._spill_hits_pub = 0
         self._ttft_hist.reset()
         self._tpot_hist.reset()
         self._mfu.reset()
@@ -694,6 +704,15 @@ class _EngineService:
                           kv["kv_blocks_free"])
                 obs.gauge(metric_names.SERVING_KV_BLOCKS_SHARED,
                           kv["kv_blocks_shared"])
+                obs.gauge(metric_names.SERVING_KV_SPILL_BLOCKS,
+                          kv["kv_spill_blocks"])
+                hits = kv["kv_spill_hits"]
+                if hits > self._spill_hits_pub:
+                    obs.counter(metric_names.SERVING_KV_SPILL_HITS,
+                                inc=hits - self._spill_hits_pub)
+                self._spill_hits_pub = hits
+                for dt in self._engine.drain_rehydrate_events():
+                    self._rehydrate_hist.observe(dt)
             # Decode MFU (2·N FLOPs per active row per step; N =
             # the ACTIVE param count, so MoE's unrouted experts
             # don't inflate the ratio) and the HBM watermark sample

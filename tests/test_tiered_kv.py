@@ -1,0 +1,359 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Tiered paged KV: quantized int8/int4 arenas + host-RAM spill tier.
+
+The tier stack's correctness contract extends the paged pool's
+(test_paging.py): greedy streams through a QUANTIZED arena are
+token-identical to the matching quantized DENSE fallback (same
+quantization both sides — paging must add nothing), int4 stays within
+the deflaked echo-logprob tolerance of full precision, and the spill
+tier's evict -> rehydrate round trip is invisible to streams,
+refcounts, reservations, and COW isolation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import TransformerLM
+from container_engine_accelerators_tpu.models.decode import (
+    SlotDecodeEngine,
+    decode,
+    greedy_decode,
+    kv_token_bytes,
+)
+
+
+def _make_lm(**kw):
+    kwargs = dict(vocab_size=48, embed_dim=32, num_layers=2,
+                  num_heads=4, max_seq_len=32, dtype=jnp.float32)
+    kwargs.update(kw)
+    model = TransformerLM(**kwargs)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _make_lm()
+
+
+def _pool_is_clean(eng):
+    """Refcount exactness (test_paging's invariant): every non-pinned
+    block free, nothing shared, no outstanding reservation, tables
+    all-trash. The spill tier must never perturb it — host entries
+    hold COPIES, not references."""
+    pool = eng._pool
+    pinned = set(eng._pinned)
+    return (pool.free_count() == pool.usable - len(pinned)
+            and pool.shared_count() == 0
+            and pool.committed == 0
+            and bool((eng._tables == eng._trash).all())
+            and int(np.abs(pool.ref).sum()) == len(pinned))
+
+
+def _run_to(eng, prompt, plen, n, **admit_kw):
+    """Admit, decode n tokens total (first included), release.
+    Returns the token list."""
+    slot, first, _, _ = eng.admit(prompt, plen, **admit_kw)
+    out = [first]
+    for _ in range(n - 1):
+        toks, _ = eng.step()
+        out.append(int(toks[slot]))
+    eng.release(slot)
+    return out
+
+
+def test_int8_paged_token_identical_to_int8_dense(lm):
+    """Greedy decode through an int8 paged arena is token-identical
+    to the int8 DENSE fallback (kv_quant clones the same cache dtype
+    into both pools, so paging adds nothing to the quantization) —
+    and both match per-request decode on the int8-cache clone. The
+    byte-budget sizing hands the int8 arena ~2x+ the bf16 block
+    count at equal HBM."""
+    model, params = lm
+    prompt = np.array([5, 6, 7, 8, 9, 10], np.int32)
+    paged = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                             paged=True, kv_block_size=4,
+                             kv_quant="int8")
+    dense = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                             paged=False, kv_quant="int8")
+    o_p = _run_to(paged, prompt, 6, 6)
+    o_d = _run_to(dense, prompt, 6, 6)
+    assert o_p == o_d
+    ref = np.asarray(greedy_decode(
+        model.clone(kv_cache_dtype="int8"), params,
+        jnp.asarray(prompt[None]), 6))[0]
+    assert o_p == ref[6:12].tolist()
+    # Equal-HBM sizing: the quantized arena's resident bytes stay at
+    # (or under) the native budget while holding ~2x+ the blocks.
+    bf16 = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                            paged=True, kv_block_size=4)
+    assert paged._pool.usable >= 2 * bf16._pool.usable
+    assert paged.kv_arena_bytes <= bf16.kv_arena_bytes
+    stats = paged.kv_block_stats()
+    assert stats["kv_quant_mode"] == "int8"
+    assert stats["kv_arena_bytes"] == paged.kv_arena_bytes
+    assert paged.block_pool_state()["kv_quant_mode"] == "int8"
+    assert _pool_is_clean(paged)
+
+
+def test_int4_paged_matches_dense_and_fp_tolerance(lm):
+    """int4: the paged stream is token-identical to STEPWISE-prefill
+    decode on the int4 clone (the paged admission chunk attends the
+    quantized cache exactly like stepwise does), the dense fallback
+    is token-identical to fast-prefill decode (both attend the raw
+    prompt chunk), the byte-budget sizing hands ~3x+ the bf16 block
+    count, and int4 echo logprobs agree with full precision within
+    the deflaked teacher-forced tolerance (PR 6: atol 0.05; int4
+    observed ~0.045)."""
+    model, params = lm
+    prompt = np.array([2, 4, 6, 8, 10, 12], np.int32)
+    m4 = model.clone(kv_cache_dtype="int4")
+    paged = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                             paged=True, kv_block_size=4,
+                             kv_quant="int4")
+    dense = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                             paged=False, kv_quant="int4")
+    o_p = _run_to(paged, prompt, 6, 6)
+    o_d = _run_to(dense, prompt, 6, 6)
+    ref_step = np.asarray(decode(
+        m4, params, jnp.asarray(prompt[None]), 6,
+        fast_prefill=False))[0]
+    assert o_p == ref_step[6:12].tolist()
+    ref_fast = np.asarray(greedy_decode(
+        m4, params, jnp.asarray(prompt[None]), 6))[0]
+    assert o_d == ref_fast[6:12].tolist()
+    bf16 = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                            paged=True, kv_block_size=4)
+    assert paged._pool.usable >= 3 * bf16._pool.usable
+    assert paged.kv_arena_bytes <= bf16.kv_arena_bytes
+    assert paged.kv_block_stats()["kv_quant_mode"] == "int4"
+    # Teacher-forced agreement (the PR 6 deflake method): the paged
+    # echo must equal the SAME quantized-cache conditioning computed
+    # stepwise (scheduling adds nothing), and sit within the
+    # int4-scaled tolerance of full precision — 7-level symmetric
+    # quantization observes ~0.19 max echo-logprob delta on this
+    # model (int8's was ~0.009 against its 0.05 bound; int4 carries
+    # 4 fewer bits, so the bound scales to 0.25).
+    echo4 = paged.score(prompt, 6)
+    _, lps4 = decode(m4, params, jnp.asarray(prompt[None]), 1,
+                     fast_prefill=False, return_logprobs=True)
+    np.testing.assert_allclose(echo4[:6], np.asarray(lps4)[0][:6],
+                               atol=1e-4)
+    _, lps = decode(model, params, jnp.asarray(prompt[None]), 1,
+                    return_logprobs=True)
+    np.testing.assert_allclose(echo4[:6], np.asarray(lps)[0][:6],
+                               atol=0.25)
+    assert _pool_is_clean(paged)
+
+
+def test_spill_rehydrate_stream_bitexact_and_refcounts_exact(lm):
+    """Cold registered blocks evict to the host tier at reuse and
+    rehydrate on a content-key hit: the re-admitted stream is
+    token-identical to per-request decode (the round trip is byte-
+    preserving), refcounts/reservations return to exactly clean, and
+    turning spill OFF makes the same traffic re-prefill instead (no
+    hits, same stream)."""
+    model, params = lm
+    A = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    fillers = (np.array([9, 8, 7, 6, 5, 4], np.int32),
+               np.array([11, 12, 13, 14, 15, 16], np.int32))
+    ref = np.asarray(greedy_decode(
+        model, params, jnp.asarray(A[None]), 4))[0][6:10].tolist()
+    for spill in (True, False):
+        # One row's worth of blocks: every admission recycles the
+        # previous row's registered blocks.
+        eng = SlotDecodeEngine(model, params, slots=1, slot_len=12,
+                               paged=True, kv_block_size=4,
+                               kv_blocks=4, kv_spill=spill)
+        oa = _run_to(eng, A, 6, 4, max_new=4)
+        for f in fillers:
+            _run_to(eng, f, 6, 4, max_new=4)
+        oa2 = _run_to(eng, A, 6, 4, max_new=4)
+        assert oa == ref and oa2 == ref
+        stats = eng.kv_block_stats()
+        if spill:
+            assert stats["kv_spill_hits"] >= 1
+            assert stats["kv_rehydrated_blocks"] >= 1
+            assert stats["kv_spill_blocks"] >= 1
+            assert eng.drain_rehydrate_events()
+            assert eng.drain_rehydrate_events() == []
+        else:
+            assert stats["kv_spill_hits"] == 0
+            assert stats["kv_spill_blocks"] == 0
+        assert _pool_is_clean(eng)
+
+
+def test_cow_isolation_across_evict_rehydrate_fork(lm):
+    """COW isolation survives the spill round trip: a prefix whose
+    partial boundary block was evicted to the host tier and
+    rehydrated forks exactly like a resident one — the rehydrated
+    donor and a row forked from it decode independently to their own
+    per-request references, and a LATER fork taken directly from the
+    host tier (hydrate-into-destination, no resident donor) is exact
+    too."""
+    model, params = lm
+    shared = np.array([3, 1, 4, 1, 5, 9], np.int32)          # 1 full + 2
+    sa = np.concatenate([shared, [11]]).astype(np.int32)     # plen 7
+    sb = np.concatenate([shared, [17]]).astype(np.int32)
+    sc = np.concatenate([shared, [29]]).astype(np.int32)
+    fillers = (np.array([40, 41, 42, 43, 44, 45, 46], np.int32),
+               np.array([30, 31, 32, 33, 34, 35, 36], np.int32))
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                           paged=True, kv_block_size=4, kv_blocks=9,
+                           kv_spill=True)
+    # Seed the tier: admit/release the donor, then churn enough
+    # fillers that its blocks are recycled (spilled).
+    _run_to(eng, sa, 7, 3, max_new=3)
+    for f in fillers:
+        _run_to(eng, f, 7, 3, max_new=3)
+    assert eng.kv_block_stats()["kv_spill_blocks"] >= 1
+    # Rehydrate the donor and fork a second row off the rehydrated
+    # partial block while the donor keeps writing into it.
+    slot_a, fa, _, _ = eng.admit(sa, 7, max_new=6)
+    oa = [fa]
+    toks, _ = eng.step()
+    oa.append(int(toks[slot_a]))
+    slot_b, fb, _, _ = eng.admit(sb, 7, max_new=5)
+    ob = [fb]
+    for _ in range(4):
+        toks, _ = eng.step()
+        oa.append(int(toks[slot_a]))
+        ob.append(int(toks[slot_b]))
+    ref = np.asarray(greedy_decode(
+        model, params, jnp.asarray(np.stack([sa, sb])), 6))
+    assert oa == ref[0, 7:13].tolist()
+    assert ob == ref[1, 7:12].tolist()
+    eng.release(slot_a)
+    eng.release(slot_b)
+    # Recycle again, then fork DIRECTLY from the host tier.
+    for f in fillers:
+        _run_to(eng, f, 7, 3, max_new=3)
+    oc = _run_to(eng, sc, 7, 5, max_new=5)
+    ref_c = np.asarray(greedy_decode(
+        model, params, jnp.asarray(sc[None]), 5))[0]
+    assert oc == ref_c[7:12].tolist()
+    assert _pool_is_clean(eng)
+
+
+def test_exhaustion_with_full_spill_tier_queues_cleanly(lm):
+    """Block exhaustion with a saturated (byte-starved, constantly
+    evicting) spill tier still QUEUES admissions: can_admit False,
+    admit raises, the resident row's table/stream stay intact, and
+    the queued admission lands exactly after a release."""
+    model, params = lm
+    # Spill budget below one block's bytes: every capture is
+    # immediately evicted — the tier is permanently "full".
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=12,
+                           paged=True, kv_block_size=4, kv_blocks=4,
+                           kv_spill=True, kv_spill_bytes=64)
+    pa = np.array([1, 2, 3, 4], np.int32)
+    pb = np.array([9, 8, 7, 6], np.int32)
+    _run_to(eng, pb, 4, 3, max_new=4)    # registers, then recycles
+    slot_a, fa, _, _ = eng.admit(pa, 4, max_new=8)
+    assert not eng.can_admit(pb, 4, 8)
+    with pytest.raises(RuntimeError, match="KV block"):
+        eng.admit(pb, 4, max_new=8)
+    oa = [fa]
+    for _ in range(5):
+        toks, _ = eng.step()
+        oa.append(int(toks[slot_a]))
+    ref_a = np.asarray(greedy_decode(
+        model, params, jnp.asarray(pa[None]), 6))[0]
+    assert oa == ref_a[4:10].tolist()
+    # pa's block-boundary growth recycled pb's registered blocks —
+    # captures happened, but the 64-byte budget evicted them at
+    # once: the tier is permanently "full" and stays empty.
+    assert eng._pool.spill_captures >= 1
+    assert eng._pool.spill_evictions >= 1
+    assert eng.kv_block_stats()["kv_spill_blocks"] == 0
+    eng.release(slot_a)
+    assert eng.can_admit(pb, 4, 8)
+    ob = _run_to(eng, pb, 4, 6, max_new=8)
+    ref_b = np.asarray(greedy_decode(
+        model, params, jnp.asarray(pb[None]), 6))[0]
+    assert ob == ref_b[4:10].tolist()
+    assert _pool_is_clean(eng)
+
+
+def test_failed_admission_rolls_back_pool_state(lm, monkeypatch):
+    """A device-side failure mid-admission (hydrate/prefill/insert
+    raising) leaves the pool EXACTLY as it found it — no leaked
+    refs or allocations, tables all-trash, no stale slot_blocks —
+    because the serving loop catches admission errors and keeps
+    serving; the next admission of the same prompt must succeed and
+    stream exactly."""
+    from container_engine_accelerators_tpu.models import (
+        decode as decode_mod,
+    )
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=12,
+                           paged=True, kv_block_size=4, kv_blocks=7,
+                           kv_spill=True)
+    A = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    _run_to(eng, A, 6, 3, max_new=3)        # registers the prefix
+    real = decode_mod._paged_prefill_impl
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("synthetic device failure")
+
+    monkeypatch.setattr(decode_mod, "_paged_prefill_impl", boom)
+    with pytest.raises(RuntimeError, match="synthetic"):
+        eng.admit(A, 6, max_new=3)          # revival + fork path
+    monkeypatch.setattr(decode_mod, "_paged_prefill_impl", real)
+    assert _pool_is_clean(eng)
+    assert eng.free_slots() == 2
+    out = _run_to(eng, A, 6, 4, max_new=4)
+    ref = np.asarray(greedy_decode(
+        model, params, jnp.asarray(A[None]), 4))[0]
+    assert out == ref[6:10].tolist()
+    assert _pool_is_clean(eng)
+
+
+def test_spill_tier_lru_evicts_at_byte_budget(lm):
+    """The host tier is BOUNDED: a budget sized for roughly one
+    prefix's blocks keeps the LRU at/below it as distinct prefixes
+    churn through, and an evicted prefix is a true miss (re-prefill,
+    still exact)."""
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=1, slot_len=12,
+                           paged=True, kv_block_size=4, kv_blocks=4,
+                           kv_spill=True)
+    # Derive one block's spill payload bytes from a first capture.
+    A = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    B = np.array([9, 8, 7, 6, 5, 4], np.int32)
+    C = np.array([11, 12, 13, 14, 15, 16], np.int32)
+    _run_to(eng, A, 6, 3, max_new=3)
+    _run_to(eng, B, 6, 3, max_new=3)     # spills A's blocks
+    pool = eng._pool
+    assert pool.spill_bytes_used > 0
+    per_block = pool.spill_bytes_used // pool.spill_block_count()
+    # Rebuild with a budget of ~2 blocks: the 2-block prompts churn
+    # the tier and the LRU must hold the line.
+    eng = SlotDecodeEngine(model, params, slots=1, slot_len=12,
+                           paged=True, kv_block_size=4, kv_blocks=4,
+                           kv_spill=True,
+                           kv_spill_bytes=int(2 * per_block))
+    for row in (A, B, C, A, B, C):
+        out = _run_to(eng, row, 6, 4, max_new=4)
+        ref = np.asarray(greedy_decode(
+            model, params, jnp.asarray(row[None]), 4))[0]
+        assert out == ref[6:10].tolist()
+        assert eng._pool.spill_bytes_used <= int(2 * per_block)
+    assert eng._pool.spill_evictions >= 1
+    assert _pool_is_clean(eng)

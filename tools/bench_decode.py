@@ -118,10 +118,20 @@ def main(argv=None):
                         "--engine row")
     p.add_argument("--kv-block-size", type=int, default=16,
                    help="paged-pool block size (with --paged)")
+    p.add_argument("--paged-int8", action="store_true",
+                   help="with --engine --paged: int8-quantized block "
+                        "arena (CEA_TPU_KV_QUANT=int8 equivalent) — "
+                        "the row quantifies the dequant-gather tax "
+                        "of scale-block attention vs the bf16 paged "
+                        "row, the per-step cost of holding ~2x the "
+                        "blocks at equal HBM")
     args = p.parse_args(argv)
     if args.paged and not args.engine:
         p.error("--paged requires --engine (it is a slot-engine "
                 "pool layout)")
+    if args.paged_int8 and not args.paged:
+        p.error("--paged-int8 requires --engine --paged (it is a "
+                "paged-arena cache mode)")
     if args.prefix_len and args.speculative_k:
         p.error("--prefix-len does not compose with --speculative-k")
     if args.stream_chunk and (args.speculative_k or args.prefix_len):
@@ -270,17 +280,22 @@ def main(argv=None):
         engine_extra = {"engine": True, "paged": args.paged}
         if args.paged:
             engine_extra["kv_block_size"] = args.kv_block_size
+            engine_extra["kv_quant"] = ("int8" if args.paged_int8
+                                        else "bf16")
         engines = {}
 
         def run(prompt):
             b = prompt.shape[0]
             eng = engines.get(b)
             if eng is None:
+                # kv_quant pinned (never the env fallback): the row's
+                # recorded kv_quant must match what was timed.
                 eng = engines[b] = SlotDecodeEngine(
                     model, params, b,
                     args.prompt_len + args.new_tokens,
                     paged=args.paged,
-                    kv_block_size=args.kv_block_size)
+                    kv_block_size=args.kv_block_size,
+                    kv_quant=("int8" if args.paged_int8 else "bf16"))
             # allow_prefix=False: a repeat iteration would otherwise
             # prefix-hit the previous iteration's freed blocks and
             # swap in a 1-token-suffix prefill program mid-timing —

@@ -153,8 +153,13 @@ def run_engine(model, params, trace, args):
         SlotDecodeEngine,
     )
 
+    # kv_quant/kv_spill pinned: an ambient CEA_TPU_KV_QUANT must not
+    # quantize the replay's arena under the unquantized reference
+    # comparison, and the host tier stays out of the engine-vs-
+    # batcher policy measurement (run_spill isolates it).
     eng = SlotDecodeEngine(model, params, args.slots,
-                           args.prompt_len + args.server_max_new)
+                           args.prompt_len + args.server_max_new,
+                           kv_quant="bf16", kv_spill=False)
     t = 0.0
     queue = list(range(len(trace)))     # FIFO by arrival
     outputs = [[] for _ in trace]
@@ -328,14 +333,22 @@ def run_paging(model, params, args):
     results = {}
     exact = {}
     for kind in ("dense", "paged"):
+        # kv_quant/kv_spill pinned as in run_engine: the equal-HBM
+        # comparison and its decode() reference are defined at the
+        # native dtype, and handing the paged side a host-RAM tier
+        # the dense side lacks would break the equal-memory contract
+        # (and could mask a device-side revival regression behind
+        # spill hits). run_spill measures the tier on its own trace.
         if kind == "dense":
             eng = SlotDecodeEngine(model, params, args.slots,
-                                   slot_len, paged=False)
+                                   slot_len, paged=False,
+                                   kv_quant="bf16", kv_spill=False)
         else:
             eng = SlotDecodeEngine(
                 model, params, args.paged_slots, slot_len,
                 paged=True, kv_block_size=bs,
-                kv_blocks=usable + 1)
+                kv_blocks=usable + 1, kv_quant="bf16",
+                kv_spill=False)
         outputs, metrics = replay_pool(eng, trace)
         metrics["kv_hbm_bytes"] = (
             usable * bs * tok_bytes if kind == "paged"
@@ -363,6 +376,122 @@ def run_paging(model, params, args):
         "paged": results["paged"],
         "sustained_rows_ratio": round(ratio, 3),
         "greedy_exact": exact["dense"] and exact["paged"],
+    }
+
+
+def build_longtail_trace(args, rng):
+    """Long-tail prefix trace: round-robin over --spill-prefixes
+    DISTINCT system prompts (a multi-tenant population larger than
+    the arena), so each prefix's reuses are maximally spread out —
+    its blocks are recycled between uses and only the host spill
+    tier can save the re-prefill. Suffix widths are drawn from a
+    small set so the replay's compile budget stays honest."""
+    prefixes = [
+        rng.integers(1, args.vocab_size,
+                     size=(args.spill_prefix_len,)).astype(np.int32)
+        for _ in range(args.spill_prefixes)]
+    t = 0.0
+    trace = []
+    for i in range(args.spill_requests):
+        t += rng.exponential(1.0 / args.spill_arrival_rate)
+        s_len = 2 * int(rng.integers(1, 3))
+        sfx = rng.integers(1, args.vocab_size,
+                           size=(s_len,)).astype(np.int32)
+        prompt = np.concatenate([prefixes[i % len(prefixes)], sfx])
+        trace.append({"arrival": t, "p_len": int(prompt.size),
+                      "new": int(rng.integers(2, args.max_new + 1)),
+                      "prompt": prompt})
+    return trace
+
+
+def run_spill(model, params, args):
+    """Tiered-KV comparison on the long-tail prefix trace, three
+    replays through the paged engine at a DELIBERATELY small arena
+    (~2 worst-case rows, so residency churns):
+
+      * ``paged_spill``   — bf16 arena, host spill tier ON
+      * ``paged_nospill`` — bf16 arena, spill OFF (recycled prefixes
+        re-prefill from scratch)
+      * ``paged_int8``    — int8 arena at EQUAL HBM bytes (block
+        count derived from the same byte budget)
+
+    Work is counted in token-forwards (one step = ``slots`` row-
+    forwards of program width; one admission prefill = its width),
+    the unit re-prefill actually burns; goodput is requested tokens
+    per kilo-token-forward. Every greedy stream is verified
+    bit-identical to per-request decode on the MATCHING model (the
+    int8 replay against the int8-cache clone — the dense fallback's
+    quantization)."""
+    from container_engine_accelerators_tpu.models.decode import (
+        SlotDecodeEngine,
+        kv_token_bytes,
+    )
+
+    trace = build_longtail_trace(args,
+                                 np.random.default_rng(args.seed + 2))
+    bs = args.kv_block_size
+    slot_len = args.spill_prefix_len + args.prompt_len + args.max_new
+    slot_len = -(-slot_len // bs) * bs
+    n_blk = slot_len // bs
+    usable = 2 * n_blk + 2
+    tok_native = kv_token_bytes(model)
+    tok_int8 = kv_token_bytes(model, "int8")
+    tokens = sum(r["new"] for r in trace)
+    configs = (
+        ("paged_spill", "bf16", True),
+        ("paged_nospill", "bf16", False),
+        ("paged_int8", "int8", True),
+    )
+    results, exact = {}, {}
+    for kind, quant, spill in configs:
+        blocks = (usable if quant == "bf16"
+                  else int(usable * tok_native / tok_int8))
+        eng = SlotDecodeEngine(
+            model, params, args.paged_slots, slot_len, paged=True,
+            kv_block_size=bs, kv_blocks=blocks + 1, kv_quant=quant,
+            kv_spill=spill)
+        outs, metrics = replay_pool(eng, trace)
+        kv = eng.kv_block_stats()
+        prefill_tokens = sum(
+            w * n for w, n in eng.prefill_widths.items())
+        work = eng.steps * eng.slots + prefill_tokens
+        metrics.update({
+            "usable_blocks": blocks,
+            "kv_arena_bytes": kv["kv_arena_bytes"],
+            "kv_quant_mode": kv["kv_quant_mode"],
+            "prefill_token_forwards": prefill_tokens,
+            "work_token_forwards": work,
+            "goodput_tokens_per_kwork": round(
+                1000.0 * tokens / work, 3),
+            "spill_hits": kv["kv_spill_hits"],
+            "spill_blocks_final": kv["kv_spill_blocks"],
+            "rehydrated_blocks": kv["kv_rehydrated_blocks"],
+            "prefix_hit_rate": kv["prefix_hit_rate"],
+        })
+        ref_model = (model.clone(kv_cache_dtype="int8")
+                     if quant == "int8" else model)
+        ok, _ = verify_greedy(ref_model, params, trace, outs, args)
+        exact[kind] = ok
+        results[kind] = metrics
+    goodput_ratio = (
+        results["paged_spill"]["goodput_tokens_per_kwork"]
+        / max(results["paged_nospill"]["goodput_tokens_per_kwork"],
+              1e-9))
+    rows_ratio = (results["paged_int8"]["rows_per_step"]
+                  / max(results["paged_spill"]["rows_per_step"],
+                        1e-9))
+    return {
+        "trace": {"requests": args.spill_requests,
+                  "prefixes": args.spill_prefixes,
+                  "prefix_len": args.spill_prefix_len,
+                  "arrival_rate": args.spill_arrival_rate,
+                  "kv_block_size": bs, "slot_len": slot_len,
+                  "paged_slots": args.paged_slots,
+                  "usable_blocks_bf16": usable},
+        **results,
+        "spill_goodput_ratio": round(goodput_ratio, 3),
+        "int8_rows_ratio": round(rows_ratio, 3),
+        "greedy_exact": all(exact.values()),
     }
 
 
@@ -477,6 +606,22 @@ def main(argv=None):
                         "greedy stream bit-identical to decode() — "
                         "the CI gate behind `make paging-check`")
     p.add_argument("--paging-factor", type=float, default=2.0)
+    p.add_argument("--spill-check", action="store_true",
+                   help="run the tiered-KV long-tail prefix replay: "
+                        "exit 1 unless the host spill tier beats "
+                        "re-prefill on token-forward goodput, the "
+                        "int8 arena sustains >= --spill-factor x the "
+                        "bf16-paged rows/step at equal HBM bytes, "
+                        "and every greedy stream is bit-identical to "
+                        "its matching dense-fallback decode() — the "
+                        "CI gate behind `make spill-check`")
+    p.add_argument("--spill-factor", type=float, default=1.8)
+    p.add_argument("--spill-requests", type=int, default=36)
+    p.add_argument("--spill-prefixes", type=int, default=6,
+                   help="distinct system prompts in the long-tail "
+                        "trace (> what the small arena can hold)")
+    p.add_argument("--spill-prefix-len", type=int, default=16)
+    p.add_argument("--spill-arrival-rate", type=float, default=4.0)
     args = p.parse_args(argv)
 
     # Fail fast on a wedged accelerator tunnel (BENCH_r05) — probe
@@ -492,12 +637,56 @@ def main(argv=None):
     if args.paging or args.paging_check:
         bs = args.kv_block_size
         max_len = -(-(args.shared_prefix_len + max_len) // bs) * bs
+    if args.spill_check:
+        bs = args.kv_block_size
+        max_len = max(max_len, -(-(args.spill_prefix_len
+                                   + args.prompt_len
+                                   + args.max_new) // bs) * bs)
     model = TransformerLM(
         vocab_size=args.vocab_size, embed_dim=args.embed_dim,
         num_layers=args.num_layers, num_heads=args.num_heads,
         max_seq_len=max_len, dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+    if args.spill_check:
+        # Same tsan discipline as the paging gate: the spill tier's
+        # host bookkeeping (LRU, byte accounting, rehydrate pairs)
+        # rides the single-threaded engine contract.
+        from container_engine_accelerators_tpu.analysis import tsan
+
+        with tsan.session(force=True) as tsan_state:
+            summary = run_spill(model, params, args)
+            tsan_rep = tsan_state.report()
+        summary["tsan_clean"] = tsan.is_clean(tsan_rep)
+        summary["platform"] = jax.devices()[0].platform
+        print(json.dumps(summary))
+        if not summary["tsan_clean"]:
+            print(tsan.format_report(tsan_rep), file=sys.stderr)
+            print("[spill] FAIL: lock-order sanitizer reported "
+                  "findings during the replay", file=sys.stderr)
+            return 1
+        if not summary["greedy_exact"]:
+            print("[spill] FAIL: a greedy stream diverged from its "
+                  "matching per-request decode", file=sys.stderr)
+            return 1
+        if summary["paged_spill"]["spill_hits"] <= 0:
+            print("[spill] FAIL: the host tier never hit — the "
+                  "long-tail trace did not exercise spill",
+                  file=sys.stderr)
+            return 1
+        if summary["spill_goodput_ratio"] <= 1.0:
+            print(f"[spill] FAIL: spill goodput ratio "
+                  f"{summary['spill_goodput_ratio']:.3f} <= 1.0 — "
+                  f"rehydration did not beat re-prefill",
+                  file=sys.stderr)
+            return 1
+        if summary["int8_rows_ratio"] < args.spill_factor:
+            print(f"[spill] FAIL: int8-arena sustained-rows ratio "
+                  f"{summary['int8_rows_ratio']:.2f} < required "
+                  f"{args.spill_factor}", file=sys.stderr)
+            return 1
+        return 0
 
     if args.paging or args.paging_check:
         # The paged pool's host bookkeeping (refcounts, tables,

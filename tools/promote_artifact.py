@@ -129,7 +129,13 @@ def promote_serving(raw_path, stats_path, out_path):
         "kv_blocks_total", "kv_blocks_free", "kv_blocks_shared",
         "kv_block_size", "kv_block_utilization", "prefix_hits",
         "prefix_lookups", "prefix_hit_rate",
-        "prefix_tokens_shared") if k in stats}
+        "prefix_tokens_shared",
+        # Tiered KV (quantized arena + host spill tier): what backed
+        # the captured run's arena and how the two-level prefix
+        # cache performed.
+        "kv_quant_mode", "kv_arena_bytes", "kv_spill_blocks",
+        "kv_spill_hits", "kv_spill_hit_rate",
+        "kv_rehydrated_blocks") if k in stats}
     if engine_stats:
         out["server_stats"] = engine_stats
     _write_atomic(out_path, out)

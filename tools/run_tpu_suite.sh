@@ -136,6 +136,21 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "paging-check preflight"
 
+# Tiered-KV preflight (CPU fake backend, ~3 min): on one long-tail
+# prefix trace (more distinct system prompts than the arena holds)
+# the host spill tier must beat re-prefill on token-forward goodput
+# and an int8-quantized arena must sustain >= 1.8x the bf16-paged
+# rows/step at EQUAL HBM bytes, with every greedy stream
+# bit-identical to its matching dense-fallback decode. A regression
+# here means the tiered-KV capacity multipliers (quantized blocks,
+# host spill) are broken or, worse, quantize/rehydrate corrupts
+# streams.
+echo "[suite] spill-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/bench_serving_occupancy.py --spill-check \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "spill-check preflight"
+
 # Analysis preflight (CPU, ~3 min): zero lint findings on the tree
 # (with every seeded fixture violation firing), a clean lock-order
 # sanitizer pass over the engine/elastic/placement suites, and the
