@@ -36,6 +36,7 @@ presubmit:
 	./build/check_boilerplate.sh
 	python3 -m container_engine_accelerators_tpu.analysis
 	JAX_PLATFORMS=cpu python3 tools/program_manifest.py --check
+	python3 tools/perf_ledger.py check
 
 # Project-native analysis gate: the AST lint must report ZERO
 # findings over the tree while every seeded fixture violation fires;
@@ -129,6 +130,18 @@ spill-check:
 	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
 		--spill-check
 
+# Perf-ledger regression gate: validate every committed
+# PERF_LEDGER.json row (schema exact, field-level messages) and
+# compare each source's newest row against its newest SAME-RIG
+# baseline — direction-aware (throughput down OR latency up) with a
+# 10% tolerance, mirroring how program-check gates cost drift. A
+# source with only foreign-rig baselines is a DOCUMENTED skip, never
+# a silent pass; skipped_unmeasurable rows read as "no data".
+# Intentional level changes: `python3 tools/perf_ledger.py accept
+# --source <s> --note "<why>"`. Pure ledger read, no jax, ~1s.
+perf-check:
+	python3 tools/perf_ledger.py check
+
 bench:
 	python3 bench.py
 
@@ -155,4 +168,5 @@ clean:
 .PHONY: all native test test-native test-native-asan presubmit bench \
 	analysis-check program-check trace-check diagnose-check \
 	goodput-check chaos-check placement-check occupancy-check \
-	paging-check spill-check container partition-tpu push clean
+	paging-check spill-check perf-check container partition-tpu \
+	push clean

@@ -17,6 +17,7 @@
 from .env_rules import BareEnvReadRule, EnvRegistryRule
 from .hygiene_rules import TimeInJitRule
 from .import_rules import JaxFreeImportRule
+from .ledger_rules import LedgerWriterRule
 from .lock_rules import LockWithRule
 from .metric_rules import MetricRegistryRule
 from .registry_rules import ProgramRegistryRule
@@ -29,6 +30,7 @@ _ALL = (
     LockWithRule,
     TimeInJitRule,
     ProgramRegistryRule,
+    LedgerWriterRule,
 )
 
 
@@ -42,6 +44,6 @@ def rule_ids():
 
 
 __all__ = ["all_rules", "rule_ids", "BareEnvReadRule",
-           "EnvRegistryRule", "JaxFreeImportRule", "LockWithRule",
-           "MetricRegistryRule", "ProgramRegistryRule",
-           "TimeInJitRule"]
+           "EnvRegistryRule", "JaxFreeImportRule", "LedgerWriterRule",
+           "LockWithRule", "MetricRegistryRule",
+           "ProgramRegistryRule", "TimeInJitRule"]

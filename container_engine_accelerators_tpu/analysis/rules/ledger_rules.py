@@ -1,0 +1,106 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``ledger-writer``: the perf ledger has exactly ONE writer.
+
+Every perf-bearing harness appends its rows through
+``tools/perf_ledger.append_row`` — the seam that validates the row
+schema field-by-field, stamps the rig fingerprint, and journals the
+``perf.ledger_append`` event. A harness that opens PERF_LEDGER
+directly (or slides a staged file onto it via ``os.replace`` /
+``os.rename``) bypasses all three: its rows would be exactly the
+bad/legacy shapes the ``perf-check`` gate exists to reject, landed
+where the gate reads baselines from.
+
+Flagged: any ``open(...)`` in a WRITE mode ('w'/'a'/'x'/'+'), and any
+``replace``/``rename`` call, whose argument expression statically
+mentions the ledger (a string literal containing ``PERF_LEDGER``, or
+a name bound to one at module level). ``tools/perf_ledger.py`` itself
+is the writer and exempt. Read-only opens are legal — reports and
+checks read freely. Paths assembled at runtime from non-literal parts
+are the documented blind spot (the same one the env/metric rules
+accept for dynamic names).
+"""
+
+import ast
+
+from ..lint import Finding
+
+LEDGER_TOKEN = "PERF_LEDGER"
+_WRITER_REL = "tools/perf_ledger.py"
+_RENAME_CALLS = ("replace", "rename", "renames")
+
+
+def _call_tail(func):
+    """The called name's last component: open / replace / ..."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _open_mode(ctx, call):
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            return ctx.resolve_str(kw.value) or ""
+    if len(call.args) >= 2:
+        return ctx.resolve_str(call.args[1]) or ""
+    return "r"
+
+
+def _mentions_ledger(ctx, call):
+    """Does any argument expression statically name the ledger?"""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(arg):
+            value = None
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str):
+                value = node.value
+            elif isinstance(node, ast.Name):
+                value = ctx.constants.get(node.id)
+            if value and LEDGER_TOKEN in value:
+                return True
+    return False
+
+
+class LedgerWriterRule:
+    id = "ledger-writer"
+    hint = ("append through tools/perf_ledger.append_row — the one "
+            "writer that validates the row schema, stamps the rig "
+            "fingerprint, and journals perf.ledger_append")
+
+    def check(self, ctx, project):
+        if ctx.rel.replace("\\", "/") == _WRITER_REL:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_tail(node.func)
+            if tail == "open":
+                mode = _open_mode(ctx, node)
+                if not any(c in mode for c in "wax+"):
+                    continue
+                if _mentions_ledger(ctx, node):
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        "perf ledger opened for writing outside the "
+                        "shared writer", self.hint)
+            elif tail in _RENAME_CALLS:
+                if _mentions_ledger(ctx, node):
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        f"{tail}() targets the perf ledger — staged "
+                        "files must land through the shared writer",
+                        self.hint)

@@ -46,6 +46,10 @@ TRAIN_STEP_SKEW = "tpu_train_step_skew_ratio"
 TRAIN_RECOVERY = "tpu_train_recovery_total"
 TRAIN_CHECKPOINT_BLOCK = "tpu_train_checkpoint_block_seconds"
 
+# -- perf ledger ------------------------------------------------------
+# prometheus_client appends the `_total` suffix at exposition.
+PERF_LEDGER_APPENDS = "tpu_perf_ledger_appends"
+
 # -- memory / profiler ------------------------------------------------
 HBM_BYTES_IN_USE = "tpu_hbm_bytes_in_use"
 HBM_PEAK_BYTES = "tpu_hbm_peak_bytes"
@@ -85,6 +89,7 @@ METRICS = {
     TRAIN_STEP_SKEW: "per-host step-time skew vs fleet median",
     TRAIN_RECOVERY: "elastic-training recovery actions by reason",
     TRAIN_CHECKPOINT_BLOCK: "train-thread-blocking checkpoint time",
+    PERF_LEDGER_APPENDS: "perf-ledger rows appended, by source",
     HBM_BYTES_IN_USE: "allocator bytes in use per device",
     HBM_PEAK_BYTES: "allocator peak bytes per device",
     HBM_BYTES_LIMIT: "allocator byte limit per device",

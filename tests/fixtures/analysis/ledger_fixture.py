@@ -1,0 +1,57 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Seeded violations for the ledger-writer rule (linted, never
+imported)."""
+
+import json
+import os
+
+LEDGER = "PERF_LEDGER.json"
+
+
+def _direct_literal_write():
+    # The bypass the rule exists for: rows landed here skip schema
+    # validation, the rig fingerprint, and the journal event.
+    with open("PERF_LEDGER.json", "a") as f:  # EXPECT: ledger-writer
+        f.write("{}\n")
+
+
+def _resolved_name_write(rows):
+    with open(LEDGER, "w") as f:  # EXPECT: ledger-writer
+        json.dump(rows, f)
+
+
+def _joined_path_write(root, rows):
+    path = os.path.join(root, "PERF_LEDGER.json")
+    del path
+    with open(  # EXPECT: ledger-writer
+            os.path.join(root, "PERF_LEDGER.json"), mode="w") as f:
+        json.dump(rows, f)
+
+
+def _staged_rename(tmp):
+    # Sliding a staged file onto the ledger is the same bypass.
+    os.replace(tmp, LEDGER)  # EXPECT: ledger-writer
+
+
+def _read_only_is_legal():
+    # Reports and checks read freely; only writes need the seam.
+    with open("PERF_LEDGER.json") as f:
+        return json.load(f)
+
+
+def _escaped_write():
+    with open(LEDGER, "w") as f:  # lint: disable=ledger-writer
+        f.write("{}\n")

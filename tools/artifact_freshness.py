@@ -25,14 +25,37 @@ tests: an artifact is fresh iff it parses as a JSON object whose
 NOT retro-stamped (a block added after capture means the capture
 itself still wants a clean rerun), and is younger than max_age_days.
 
+The perf ledger (tools/perf_ledger.py) is a second freshness source:
+a PERF_LEDGER row for a section (``source``) that is schema-valid,
+``measured`` (never ``skipped_unmeasurable``), carries the SAME rig
+fingerprint as the caller, and is younger than max_age_days also
+lets the suite skip that section — a suite window that just appended
+a row IS the recent measurement, whatever the committed artifact's
+age.
+
 CLI: ``artifact_freshness.py <path> <max_age_days>`` — exit 0 fresh
-(skip the section), 1 stale (run it).
+(skip the section), 1 stale (run it). With a third positional
+``<ledger-source>``, ``<path>`` is read as the perf ledger and the
+current rig's fingerprint is derived in-process (this enumerates
+jax devices — the suite wraps the call in a ``timeout`` because a
+wedged tunnel can hang the probe).
 """
 
 import datetime
 import json
 import sys
 import time
+
+
+def _age_ok(generated_utc, max_age_days, now=None):
+    """Shared age window: 0 <= age < max_age_days (a timestamp from
+    the future is suspect, not fresh)."""
+    try:
+        ts = datetime.datetime.fromisoformat(generated_utc).timestamp()
+    except (TypeError, ValueError):
+        return False
+    age_days = ((time.time() if now is None else now) - ts) / 86400.0
+    return 0 <= age_days < float(max_age_days)
 
 
 def is_fresh(path, max_age_days, now=None):
@@ -50,20 +73,51 @@ def is_fresh(path, max_age_days, now=None):
         return False
     if prov.get("retro_stamped"):
         return False
+    return _age_ok(prov.get("generated_utc"), max_age_days, now=now)
+
+
+def ledger_is_fresh(path, source, max_age_days, fingerprint,
+                    now=None):
+    """True iff the ledger at ``path`` holds a measured, schema-valid
+    row for ``source`` on the SAME rig (fingerprint match — a foreign
+    rig's recency says nothing about this one) younger than
+    ``max_age_days``. Skipped-unmeasurable rows never count: a rig
+    that could not measure still owes the section a run."""
+    import perf_ledger
+
     try:
-        ts = datetime.datetime.fromisoformat(
-            prov["generated_utc"]).timestamp()
-    except (TypeError, ValueError):
+        doc = perf_ledger.load_ledger(path)
+    except perf_ledger.LedgerError:
         return False
-    age_days = ((time.time() if now is None else now) - ts) / 86400.0
-    return 0 <= age_days < float(max_age_days)
+    rows = doc.get("rows") if isinstance(doc, dict) else None
+    if not isinstance(rows, list):
+        return False
+    want = perf_ledger.fingerprint_key(fingerprint)
+    for row in reversed(rows):
+        if not isinstance(row, dict) or row.get("source") != source:
+            continue
+        if row.get("status") != perf_ledger.STATUS_MEASURED:
+            continue
+        if perf_ledger.validate_row(row):
+            continue
+        if perf_ledger.fingerprint_key(row["fingerprint"]) != want:
+            continue
+        return _age_ok(row["provenance"].get("generated_utc"),
+                       max_age_days, now=now)
+    return False
 
 
 def main(argv):
-    if len(argv) != 3:
-        print(__doc__, file=sys.stderr)
-        return 2
-    return 0 if is_fresh(argv[1], argv[2]) else 1
+    if len(argv) == 3:
+        return 0 if is_fresh(argv[1], argv[2]) else 1
+    if len(argv) == 4:
+        import perf_ledger
+
+        return 0 if ledger_is_fresh(
+            argv[1], argv[3], argv[2],
+            perf_ledger.rig_fingerprint()) else 1
+    print(__doc__, file=sys.stderr)
+    return 2
 
 
 if __name__ == "__main__":

@@ -96,14 +96,27 @@ def main(argv=None):
                         "report max abs error in the JSON (validates "
                         "the Pallas kernel on the real MXU, where "
                         "interpret-mode tests cannot)")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append per-schedule ms/TFLOPs to the perf "
+                        "ledger (tools/perf_ledger.py) as one row "
+                        "keyed bench_attention:<config-digest>; a "
+                        "dead backend appends a skipped_unmeasurable "
+                        "row instead of wedging")
     args = p.parse_args(argv)
 
     # Fail fast on a wedged accelerator tunnel (BENCH_r05) — probe
     # in a deadlined subprocess before any in-process dispatch.
     # After argparse, so --help/usage errors never pay the probe.
-    from bench_backend import ensure_backend
+    # With --ledger armed, a dead backend leaves one fingerprinted
+    # skipped_unmeasurable row (perf-check reads it as "no data").
+    import perf_ledger
 
-    ensure_backend()
+    ledger_config = {k: v for k, v in sorted(vars(args).items())
+                     if k != "ledger"}
+    ledger_source = ("bench_attention:"
+                     + perf_ledger.config_digest(ledger_config))
+    perf_ledger.ensure_backend_or_skip(
+        ledger_source, args.ledger, config=ledger_config)
 
     from container_engine_accelerators_tpu.ops.attention import (
         flash_attention,
@@ -199,6 +212,7 @@ def main(argv=None):
     overhead_s = _time(jax.jit(lambda x: x + 1), tiny,
                        iters=args.iters)
 
+    ledger_metrics = {}
     for name, fn in schedules.items():
         try:
             sec = _time(fn, q, k, v, iters=args.iters)
@@ -240,6 +254,15 @@ def main(argv=None):
                 err = float(jnp.max(jnp.abs(out - oracle)))
                 row["max_abs_err_vs_oracle"] = round(err, 6)
         print(json.dumps(row))
+        ledger_metrics[f"ms_per_call_{name}"] = row["ms_per_call"]
+        ledger_metrics[f"tflops_{name}"] = row["tflops"]
+        if row["tflops_net"] is not None:
+            ledger_metrics[f"tflops_net_{name}"] = row["tflops_net"]
+
+    if args.ledger and ledger_metrics:
+        perf_ledger.append_or_exit(
+            args.ledger, ledger_source, ledger_metrics,
+            devices=jax.devices(), config=ledger_config)
 
 
 if __name__ == "__main__":

@@ -48,24 +48,37 @@ _PROBE_CODE = (
 )
 
 
-def ensure_backend(timeout_s=PROBE_TIMEOUT_S):
-    """Exit the process with a clear message when the backend cannot
-    even enumerate devices within ``timeout_s``; return the platform
-    string ('cpu', 'tpu', ...) when it can."""
+def probe_backend(timeout_s=PROBE_TIMEOUT_S, env=None):
+    """Deadlined subprocess device probe; never exits the caller.
+
+    Returns ``(platform, None)`` when the backend enumerated devices
+    within the deadline, else ``(None, reason)`` — the seam bench.py
+    and the perf-ledger skip path share: a dead backend becomes a
+    fingerprinted ``skipped_unmeasurable`` row instead of a wedge.
+    """
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _PROBE_CODE],
             capture_output=True, text=True, timeout=timeout_s,
-            env=os.environ.copy())
+            env=dict(os.environ if env is None else env))
     except subprocess.TimeoutExpired:
-        sys.exit(
-            f"[bench] backend probe hung (limit {timeout_s}s): "
+        return None, (
+            f"backend probe hung (limit {timeout_s:.0f}s): "
             "jax.devices() never returned — the accelerator tunnel "
             "is down or wedged. Re-run when the chip window is up, "
             "or set JAX_PLATFORMS=cpu for a schedule-sanity run.")
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout or "").strip()[-1500:]
-        sys.exit(
-            f"[bench] backend probe failed (rc {proc.returncode}): "
-            f"{tail}")
-    return proc.stdout.strip().splitlines()[-1]
+        return None, (f"backend probe failed "
+                      f"(rc {proc.returncode}): {tail}")
+    return proc.stdout.strip().splitlines()[-1], None
+
+
+def ensure_backend(timeout_s=PROBE_TIMEOUT_S):
+    """Exit the process with a clear message when the backend cannot
+    even enumerate devices within ``timeout_s``; return the platform
+    string ('cpu', 'tpu', ...) when it can."""
+    platform, reason = probe_backend(timeout_s)
+    if reason is not None:
+        sys.exit(f"[bench] {reason}")
+    return platform

@@ -118,6 +118,12 @@ def main(argv=None):
                         "--engine row")
     p.add_argument("--kv-block-size", type=int, default=16,
                    help="paged-pool block size (with --paged)")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append this run's headline numbers to the "
+                        "perf ledger (tools/perf_ledger.py) as one "
+                        "row keyed bench_decode:<config-digest>; a "
+                        "dead backend appends a skipped_unmeasurable "
+                        "row instead of wedging")
     p.add_argument("--paged-int8", action="store_true",
                    help="with --engine --paged: int8-quantized block "
                         "arena (CEA_TPU_KV_QUANT=int8 equivalent) — "
@@ -147,9 +153,16 @@ def main(argv=None):
     # backend hangs jax.devices() in C, unkillable by SIGALRM) —
     # probe in a deadlined subprocess before any in-process dispatch.
     # After argparse, so --help/usage errors never pay the probe.
-    from bench_backend import ensure_backend
+    # With --ledger armed, a dead backend leaves one fingerprinted
+    # skipped_unmeasurable row (perf-check reads it as "no data").
+    import perf_ledger
 
-    ensure_backend()
+    ledger_config = {k: v for k, v in sorted(vars(args).items())
+                     if k != "ledger"}
+    ledger_source = ("bench_decode:"
+                     + perf_ledger.config_digest(ledger_config))
+    perf_ledger.ensure_backend_or_skip(
+        ledger_source, args.ledger, config=ledger_config)
 
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.models.decode import decode
@@ -312,6 +325,7 @@ def main(argv=None):
             return jnp.asarray(last if last is not None
                                else jnp.zeros((b,), jnp.int32))
 
+    ledger_metrics = {}
     for b in args.batch:
         prompt = jax.random.randint(
             jax.random.PRNGKey(1), (b, args.prompt_len), 0,
@@ -367,6 +381,15 @@ def main(argv=None):
             **stream_extra,
             **engine_extra,
         }))
+        ledger_metrics[f"decode_tokens_per_sec_b{b}"] = round(
+            tokens / sec, 1)
+        ledger_metrics[f"ms_per_token_b{b}"] = round(
+            sec / args.new_tokens * 1000, 3)
+
+    if args.ledger:
+        perf_ledger.append_or_exit(
+            args.ledger, ledger_source, ledger_metrics,
+            devices=jax.devices(), config=ledger_config)
 
 
 if __name__ == "__main__":

@@ -464,6 +464,7 @@ def run_spill(model, params, args):
             "goodput_tokens_per_kwork": round(
                 1000.0 * tokens / work, 3),
             "spill_hits": kv["kv_spill_hits"],
+            "kv_spill_hit_rate": kv.get("kv_spill_hit_rate"),
             "spill_blocks_final": kv["kv_spill_blocks"],
             "rehydrated_blocks": kv["kv_rehydrated_blocks"],
             "prefix_hit_rate": kv["prefix_hit_rate"],
@@ -622,14 +623,44 @@ def main(argv=None):
                         "trace (> what the small arena can hold)")
     p.add_argument("--spill-prefix-len", type=int, default=16)
     p.add_argument("--spill-arrival-rate", type=float, default=4.0)
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append the replay's headline numbers to the "
+                        "perf ledger (tools/perf_ledger.py) — source "
+                        "occupancy_check / paging_check / spill_check "
+                        "per mode; a dead backend appends a "
+                        "skipped_unmeasurable row instead of wedging")
     args = p.parse_args(argv)
+
+    ledger_source = ("spill_check" if args.spill_check
+                     else "paging_check"
+                     if (args.paging or args.paging_check)
+                     else "occupancy_check")
 
     # Fail fast on a wedged accelerator tunnel (BENCH_r05) — probe
     # in a deadlined subprocess before any in-process dispatch.
     # After argparse, so --help/usage errors never pay the probe.
-    from bench_backend import ensure_backend
+    # With --ledger armed, a dead backend leaves one fingerprinted
+    # skipped_unmeasurable row (perf-check reads it as "no data").
+    import perf_ledger
 
-    ensure_backend()
+    perf_ledger.ensure_backend_or_skip(ledger_source, args.ledger)
+
+    def ledger_append(metrics, config):
+        """One measured row per PASSING replay (a failed gate's
+        numbers must never become the next window's baseline). A
+        ledger that cannot take the row fails the run with a clean
+        message, not a traceback — a silently lost row would read as
+        a hole in the trend."""
+        if not args.ledger:
+            return
+        try:
+            perf_ledger.append_row(args.ledger, ledger_source,
+                                   metrics, devices=jax.devices(),
+                                   config=config)
+        except (perf_ledger.LedgerError, OSError) as e:
+            print(f"[{ledger_source}] FAIL: perf-ledger append: {e}",
+                  file=sys.stderr)
+            raise SystemExit(1)
 
     from container_engine_accelerators_tpu.models import TransformerLM
 
@@ -686,6 +717,16 @@ def main(argv=None):
                   f"{summary['int8_rows_ratio']:.2f} < required "
                   f"{args.spill_factor}", file=sys.stderr)
             return 1
+        ledger_append({
+            "spill_goodput_ratio": summary["spill_goodput_ratio"],
+            "int8_rows_ratio": summary["int8_rows_ratio"],
+            "goodput_tokens_per_kwork":
+                summary["paged_spill"]["goodput_tokens_per_kwork"],
+            "kv_spill_hit_rate":
+                summary["paged_spill"]["kv_spill_hit_rate"],
+            "prefix_hit_rate":
+                summary["paged_spill"]["prefix_hit_rate"],
+        }, summary["trace"])
         return 0
 
     if args.paging or args.paging_check:
@@ -722,6 +763,11 @@ def main(argv=None):
                   f"{summary['sustained_rows_ratio']:.2f} < required "
                   f"{args.paging_factor}", file=sys.stderr)
             return 1
+        ledger_append({
+            "sustained_rows_ratio": summary["sustained_rows_ratio"],
+            "rows_per_step": summary["paged"]["rows_per_step"],
+            "prefix_hit_rate": hit,
+        }, summary["trace"])
         return 0
 
     trace = build_trace(args, np.random.default_rng(args.seed))
@@ -750,6 +796,14 @@ def main(argv=None):
         print(f"[occupancy] FAIL: goodput ratio {ratio:.2f} < "
               f"required {args.check_factor}", file=sys.stderr)
         return 1
+    ledger_append({
+        "goodput_ratio": summary["goodput_ratio"],
+        "rows_per_step": engine["rows_per_step"],
+        "goodput_tokens_per_step":
+            engine["goodput_tokens_per_step"],
+        "p50_latency_steps": engine["p50_latency_steps"],
+        "p99_latency_steps": engine["p99_latency_steps"],
+    }, summary["config"])
     return 0
 
 

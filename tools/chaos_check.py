@@ -51,6 +51,7 @@ process-level signals.
 Exit 0 = clean, 1 = check failed, 2 = harness error.
 """
 
+import argparse
 import json
 import os
 import shutil
@@ -491,7 +492,15 @@ def checkpoint_badput_compare(state, root, report, failures):
             f"< {CKPT_BADPUT_MAX_RATIO:.0%}")
 
 
-def main():
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append the episode's goodput ratio + async-"
+                        "checkpoint badput ratio to the perf ledger "
+                        "(tools/perf_ledger.py) when the check "
+                        "passes")
+    args = p.parse_args(argv)
+
     from container_engine_accelerators_tpu.chip import PyChipBackend
     from container_engine_accelerators_tpu.plugin.health import (
         TpuHealthChecker,
@@ -570,6 +579,30 @@ def main():
         for f in failures:
             print(f"chaos-check FAILED: {f}", file=sys.stderr)
         return 1
+    if args.ledger:
+        import jax
+
+        import perf_ledger
+
+        # goodput_ratio is the gated trend metric; the async/sync
+        # checkpoint badput ratio rides as CONTEXT only — its
+        # denominator is a few milliseconds of blocking snapshot
+        # time, so run-to-run jitter would flake a 10% gate while
+        # chaos-check's own <10% ceiling already bounds it. The
+        # episode PASSED, so a ledger problem is a harness error
+        # (rc 2), not a failed chaos check.
+        err = perf_ledger.try_append(
+            args.ledger, "chaos_check", {
+                "goodput_ratio": report["goodput"]["goodput_ratio"],
+            }, devices=jax.devices(),
+            config={"hosts": len(HOSTS), "steps": TOTAL_STEPS,
+                    "hidden": HIDDEN, "batch": BATCH,
+                    "checkpoint_badput_ratio":
+                        report["checkpoint_badput"]["ratio"]})
+        if err:
+            print(f"chaos-check: perf-ledger append failed: {err}",
+                  file=sys.stderr)
+            return 2
     print("chaos-check: OK", file=sys.stderr)
     return 0
 

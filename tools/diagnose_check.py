@@ -28,6 +28,9 @@ journal and fails unless the bundle carries:
     cross-process timeline),
   - an ok /debug/varz snapshot with the RPC latency histogram,
   - the fake node's device state (chips + topology),
+  - the perf section: a seeded perf-ledger row rendered through the
+    trend report (series + fingerprint grouping), so incident
+    bundles always carry the node's performance history,
   - the elastic section: the child journal's eviction/reshape/
     recovery events, the recovery counter from the varz leg, and the
     newest finished checkpoint's provenance from --checkpoint-dir
@@ -184,6 +187,16 @@ def main():
                        "leaf_count": 4, "bytes": 1024,
                        "keys": ["['params']['w']"]}, f)
 
+        # A seeded perf ledger: one measured row through the shared
+        # writer — the bundle's perf section must render it.
+        sys.path.insert(1, os.path.join(REPO_ROOT, "tools"))
+        import perf_ledger
+
+        ledger = os.path.join(root, "PERF_LEDGER.json")
+        perf_ledger.append_row(
+            ledger, "paging_check", {"sustained_rows_ratio": 2.49},
+            devices=[], platform="cpu")
+
         # A second process's journal: the serving-replica stand-in.
         journal = os.path.join(root, "serving_journal.json")
         env = dict(os.environ, CEA_TPU_TRACE_FILE=journal,
@@ -206,6 +219,7 @@ def main():
              "--journal", journal,
              "--dev-dir", dev, "--state-dir", state,
              "--checkpoint-dir", ckpt_dir,
+             "--perf-ledger", ledger,
              "--out", bundle_path],
             capture_output=True, text=True, timeout=120,
             cwd=REPO_ROOT)
@@ -334,6 +348,21 @@ def main():
             failures.append(
                 f"placement events missing or out of timeline "
                 f"order: {pev_names}")
+        # Perf section: the seeded ledger row must come back as a
+        # rendered trend (rows counted, source present, series
+        # keyed under a rig fingerprint label).
+        perf = bundle.get("perf") or {}
+        if perf.get("rows") != 1 or "report" not in perf:
+            failures.append(f"perf section missing/empty: {perf!r}")
+        else:
+            rigs = (perf["report"].get("sources") or {}).get(
+                "paging_check") or {}
+            series = [hist.get("series") or {}
+                      for hist in rigs.values()]
+            if not any("sustained_rows_ratio" in s for s in series):
+                failures.append(
+                    f"perf report lost the seeded "
+                    f"sustained_rows_ratio series: {rigs!r}")
     finally:
         metrics.stop()
         manager.stop()

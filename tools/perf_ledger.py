@@ -1,0 +1,790 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Continuous perf ledger: every speed claim becomes a trended, gated row.
+
+The repo's perf story used to be point-in-time gates (paging-check's
+2.49x, occupancy-check's 2x, spill-check's 1.19x) with no history:
+a slow regression UNDER a gate threshold was invisible, and the
+committed bench trajectory (BENCH_r01-r05) was empty because wedged
+backend probes ate every window. This module is the fix — ONE
+schema-validating writer that every perf-bearing harness appends
+through, and a regression gate (``make perf-check``) that compares
+each source's newest row against its SAME-RIG last-known-good
+baseline (:func:`baseline_walk` — an unaccepted regression never
+becomes the next window's baseline, so a slow stepwise decay keeps
+failing until it is explicitly accepted), mirroring how
+program-check gates FLOPs/bytes drift.
+
+Row shape (validated field-by-field; a bad/legacy row is rejected
+with the exact field named, like the manifest differ):
+
+  {"source":      "paging_check" | "bench_decode:<cfg8>" | ...,
+   "status":      "measured" | "skipped_unmeasurable",
+   "metrics":     {name: finite number} ({} when skipped),
+   "fingerprint": {platform, device_kind, device_count, jax_version,
+                   knobs: {CEA_TPU_*: value, ...}},
+   "provenance":  utils.provenance.stamp() (generated_utc, git_sha,
+                   git_dirty, devices, ...),
+   + optional "config" (free-form context), "note", "accepted"}
+
+**Cross-rig refusal.** The fingerprint is the comparison key: a CPU
+schedule-sanity row must never be read as a regression against a TPU
+window (or vice versa). :func:`regressions` raises
+:class:`CrossRigError` on mismatched fingerprints — the same posture
+as promote_artifact refusing non-TPU promotion — and the gate
+documented-SKIPS (never silently passes) a source whose only
+baselines are foreign-rig.
+
+**Direction awareness.** Every metric name must resolve in
+:data:`METRIC_DIRECTIONS` (longest-prefix match): "up" metrics
+(throughput, ratios, hit rates, MFU) regress by dropping, "down"
+metrics (TTFT/TPOT, ms/token, program FLOPs/bytes) regress by
+rising. An unregistered name is an append-time error, not a
+silently ungated metric.
+
+**skipped_unmeasurable** rows record that a rig could not measure
+(wedged tunnel, CPU fallback) WITH its fingerprint — they are never
+baselines and never zero-valued regressions; the gate reports them
+as "no data".
+
+CLI (``--ledger`` defaults to the committed PERF_LEDGER.json):
+
+  perf_ledger.py check [--tolerance 0.10]   # the `make perf-check` gate
+  perf_ledger.py accept --source S --note "why"   # bless the newest row
+  perf_ledger.py append-manifest [--manifest PROGRAM_MANIFEST.json]
+  perf_ledger.py validate                   # schema pass only
+
+Exit 0 = clean (documented skips included), 1 = regression or bad
+row, 2 = usage error.
+"""
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.obs.metric_names import (  # noqa: E402
+    PERF_LEDGER_APPENDS,
+)
+from container_engine_accelerators_tpu.utils import env_str  # noqa: E402
+from container_engine_accelerators_tpu.utils.provenance import (  # noqa: E402
+    stamp,
+)
+
+DEFAULT_LEDGER = os.path.join(REPO, "PERF_LEDGER.json")
+SCHEMA_VERSION = 1
+TOLERANCE = 0.10
+
+STATUS_MEASURED = "measured"
+STATUS_SKIPPED = "skipped_unmeasurable"
+_STATUSES = (STATUS_MEASURED, STATUS_SKIPPED)
+_ROW_KEYS = frozenset({"source", "status", "metrics", "fingerprint",
+                       "provenance", "config", "note", "accepted"})
+_FP_KEYS = ("platform", "device_kind", "device_count", "jax_version",
+            "knobs")
+
+# CEA_TPU_* knobs that change what a measurement means: two rows with
+# different values here are different rigs, whatever the hardware.
+FINGERPRINT_KNOBS = (
+    "CEA_TPU_PAGED_KV", "CEA_TPU_KV_BLOCK", "CEA_TPU_KV_BLOCKS",
+    "CEA_TPU_KV_QUANT", "CEA_TPU_KV_SPILL", "CEA_TPU_KV_SPILL_BYTES",
+    "CEA_TPU_PEAK_FLOPS",
+)
+
+# Metric name (longest-prefix match) -> regression direction.
+# "up" = higher is better (drops regress); "down" = lower is better
+# (rises regress). Appending a metric that resolves to neither is an
+# error — an ungated number is a narrated number.
+METRIC_DIRECTIONS = {
+    # throughput / capacity / efficiency — higher is better
+    "rows_per_step": "up",
+    "rows_per_call": "up",
+    "peak_rows": "up",
+    "goodput_ratio": "up",
+    "goodput_tokens_per_step": "up",
+    "goodput_tokens_per_kwork": "up",
+    "sustained_rows_ratio": "up",
+    "spill_goodput_ratio": "up",
+    "int8_rows_ratio": "up",
+    "prefix_hit_rate": "up",
+    "kv_block_utilization": "up",
+    "kv_spill_hit_rate": "up",
+    "batch_occupancy_avg": "up",
+    "decode_tokens_per_sec": "up",
+    "tflops": "up",
+    "tflops_net": "up",
+    "images_per_sec_per_chip": "up",
+    "mfu": "up",
+    "qps": "up",
+    "largest_box_retention": "up",
+    # latency / cost — lower is better
+    "ttft": "down",
+    "tpot": "down",
+    "ms_per_token": "down",
+    "ms_per_call": "down",
+    "sec_per_call": "down",
+    "p50_latency_steps": "down",
+    "p99_latency_steps": "down",
+    "p50_ms": "down",
+    "p99_ms": "down",
+    "checkpoint_badput_ratio": "down",
+    "flops": "down",
+    "bytes_accessed": "down",
+}
+
+
+class LedgerError(Exception):
+    """A ledger row or file violates the contract."""
+
+
+class CrossRigError(LedgerError):
+    """Comparison across different rig fingerprints was refused."""
+
+
+# ---------------------------------------------------------------------------
+# Rig fingerprint
+# ---------------------------------------------------------------------------
+
+
+def _jax_version():
+    """jax's installed version WITHOUT importing jax (cheap, safe on
+    jax-free harness paths like placement_check)."""
+    try:
+        import importlib.metadata
+        return importlib.metadata.version("jax")
+    except Exception:
+        return "unknown"
+
+
+def _device_kind(dev):
+    # ALWAYS derived from str(dev) minus the trailing per-device
+    # index ("TPU v5 lite0" -> "TPU v5 lite"), never from the
+    # device_kind attribute: rows are appended from provenance
+    # device STRINGS as often as from live device objects (bench.py,
+    # promote_artifact), and a kind that differed by construction
+    # path would split one rig into two fingerprints — every
+    # same-rig baseline lookup and ledger-freshness check would
+    # silently never match.
+    return str(dev).rstrip("0123456789") or "unknown"
+
+
+def knob_values():
+    """The set fingerprint knobs, as {name: raw value}."""
+    knobs = {}
+    for name in FINGERPRINT_KNOBS:
+        value = env_str(name)
+        if value is not None:
+            knobs[name] = value
+    return knobs
+
+
+def rig_fingerprint(devices=None, platform=None, knobs=None):
+    """Build the comparison key for a measurement taken HERE.
+
+    ``devices``: jax device objects or their str()s; None with
+    ``platform`` also None probes ``jax.devices()`` in-process (only
+    do that where a wedged backend is already handled — the bench
+    entry points probe through ``bench_backend`` first).
+    """
+    if devices is None and platform is None:
+        import jax
+        devices = jax.devices()
+    devs = list(devices or [])
+    if platform is None and devs:
+        platform = getattr(devs[0], "platform", None)
+    return {
+        "platform": str(platform) if platform else "unknown",
+        "device_kind": _device_kind(devs[0]) if devs else "none",
+        "device_count": len(devs),
+        "jax_version": _jax_version(),
+        "knobs": dict(knobs) if knobs is not None else knob_values(),
+    }
+
+
+def fingerprint_key(fingerprint):
+    """Canonical comparison string for a fingerprint dict."""
+    return json.dumps({k: fingerprint.get(k) for k in _FP_KEYS},
+                      sort_keys=True)
+
+
+def fingerprint_label(fingerprint):
+    """Short human rig label + digest (for reports)."""
+    digest = hashlib.sha256(
+        fingerprint_key(fingerprint).encode()).hexdigest()[:8]
+    return (f"{fingerprint.get('platform')}:"
+            f"{fingerprint.get('device_kind')}"
+            f"x{fingerprint.get('device_count')}:"
+            f"jax{fingerprint.get('jax_version')}:{digest}")
+
+
+def config_digest(config):
+    """Stable 8-hex tag of a config dict — benches with differing
+    shapes/flags are different sources, never compared."""
+    return hashlib.sha256(json.dumps(
+        config, sort_keys=True, default=str).encode()).hexdigest()[:8]
+
+
+# ---------------------------------------------------------------------------
+# Schema
+# ---------------------------------------------------------------------------
+
+
+def metric_direction(name):
+    """"up" or "down" for a metric name (longest registered prefix
+    wins, so ``decode_tokens_per_sec_b8`` resolves via
+    ``decode_tokens_per_sec``); raises LedgerError when unresolved."""
+    if name in METRIC_DIRECTIONS:
+        return METRIC_DIRECTIONS[name]
+    best = None
+    for prefix in METRIC_DIRECTIONS:
+        if name.startswith(prefix) and (
+                best is None or len(prefix) > len(best)):
+            best = prefix
+    if best is None:
+        raise LedgerError(
+            f"metric {name!r} has no registered direction — add a "
+            "prefix to perf_ledger.METRIC_DIRECTIONS (is it "
+            "throughput-like or latency-like?)")
+    return METRIC_DIRECTIONS[best]
+
+
+def validate_row(row, where="row"):
+    """Field-level problems with one ledger row (empty list = exact)."""
+    problems = []
+    if not isinstance(row, dict):
+        return [f"{where}: not an object"]
+    for key in sorted(set(row) - _ROW_KEYS):
+        problems.append(f"{where}.{key}: unexpected field")
+    source = row.get("source")
+    if not (isinstance(source, str) and source):
+        problems.append(f"{where}.source: want a non-empty string, "
+                        f"got {source!r}")
+    status = row.get("status")
+    if status not in _STATUSES:
+        problems.append(f"{where}.status: want one of {_STATUSES}, "
+                        f"got {status!r}")
+    metrics = row.get("metrics")
+    if not isinstance(metrics, dict):
+        problems.append(f"{where}.metrics: want an object, got "
+                        f"{type(metrics).__name__}")
+    else:
+        if status == STATUS_SKIPPED and metrics:
+            problems.append(
+                f"{where}.metrics: a {STATUS_SKIPPED} row measured "
+                "nothing — metrics must be empty")
+        for name, value in metrics.items():
+            if not (isinstance(value, (int, float))
+                    and not isinstance(value, bool)
+                    and math.isfinite(value)):
+                problems.append(f"{where}.metrics.{name}: want a "
+                                f"finite number, got {value!r}")
+            try:
+                metric_direction(name)
+            except LedgerError as e:
+                problems.append(f"{where}.metrics.{name}: {e}")
+    fp = row.get("fingerprint")
+    if not isinstance(fp, dict):
+        problems.append(f"{where}.fingerprint: want an object, got "
+                        f"{type(fp).__name__}")
+    else:
+        for key in ("platform", "device_kind", "jax_version"):
+            if not (isinstance(fp.get(key), str) and fp.get(key)):
+                problems.append(f"{where}.fingerprint.{key}: want a "
+                                f"non-empty string, got "
+                                f"{fp.get(key)!r}")
+        count = fp.get("device_count")
+        if not (isinstance(count, int) and not isinstance(count, bool)
+                and count >= 0):
+            problems.append(f"{where}.fingerprint.device_count: want "
+                            f"an int >= 0, got {count!r}")
+        knobs = fp.get("knobs")
+        if not (isinstance(knobs, dict) and all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in knobs.items())):
+            problems.append(f"{where}.fingerprint.knobs: want "
+                            f"{{str: str}}, got {knobs!r}")
+    prov = row.get("provenance")
+    if not isinstance(prov, dict):
+        problems.append(f"{where}.provenance: want an object, got "
+                        f"{type(prov).__name__}")
+    else:
+        import datetime
+        try:
+            datetime.datetime.fromisoformat(prov.get("generated_utc"))
+        except (TypeError, ValueError):
+            problems.append(
+                f"{where}.provenance.generated_utc: not an ISO "
+                f"timestamp: {prov.get('generated_utc')!r}")
+        if not (isinstance(prov.get("git_sha"), str)
+                and prov.get("git_sha")):
+            problems.append(f"{where}.provenance.git_sha: want a "
+                            f"non-empty string, got "
+                            f"{prov.get('git_sha')!r}")
+    return problems
+
+
+def validate_doc(doc):
+    """Field-level problems with a whole ledger document."""
+    if not isinstance(doc, dict):
+        return ["ledger: not a JSON object"]
+    problems = []
+    if doc.get("schema_version") != SCHEMA_VERSION:
+        problems.append(f"ledger.schema_version: want "
+                        f"{SCHEMA_VERSION}, got "
+                        f"{doc.get('schema_version')!r}")
+    rows = doc.get("rows")
+    if not isinstance(rows, list):
+        return problems + ["ledger.rows: want a list"]
+    for i, row in enumerate(rows):
+        problems.extend(validate_row(row, where=f"rows[{i}]"))
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# The one writer
+# ---------------------------------------------------------------------------
+
+
+def load_ledger(path):
+    """The ledger document; a missing file is an empty ledger, an
+    unreadable one raises LedgerError."""
+    if not os.path.exists(path):
+        return {"schema_version": SCHEMA_VERSION, "rows": []}
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise LedgerError(f"cannot read {path}: {e}")
+
+
+def _write_ledger(path, doc):
+    tmp = path + ".ledger.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def append_row(path, source, metrics, status=STATUS_MEASURED,
+               devices=None, platform=None, config=None, note=None,
+               fingerprint=None):
+    """THE ledger writer: validate, append, journal. Every harness
+    lands its row through here (the ``ledger-writer`` lint rule
+    rejects direct writes) so a row can never skip schema validation,
+    the rig fingerprint, or the ``perf.ledger_append`` journal event.
+    Returns the appended row."""
+    row = {
+        "source": source,
+        "status": status,
+        "metrics": {k: v for k, v in (metrics or {}).items()
+                    if v is not None},
+        "fingerprint": (dict(fingerprint) if fingerprint is not None
+                        else rig_fingerprint(devices=devices,
+                                             platform=platform)),
+        "provenance": stamp(devices=[str(d) for d in (devices or [])]),
+    }
+    if config is not None:
+        row["config"] = config
+    if note is not None:
+        row["note"] = note
+    problems = validate_row(row)
+    if problems:
+        raise LedgerError("refusing to append a non-conforming row:\n  "
+                          + "\n  ".join(problems))
+    doc = load_ledger(path)
+    doc_problems = validate_doc(doc)
+    if doc_problems:
+        raise LedgerError(f"refusing to append to a non-conforming "
+                          f"ledger {path}:\n  "
+                          + "\n  ".join(doc_problems))
+    doc["rows"].append(row)
+    _write_ledger(path, doc)
+    obs.event("perf.ledger_append", source=source, status=status,
+              metrics=len(row["metrics"]),
+              rig=fingerprint_label(row["fingerprint"]))
+    obs.counter(PERF_LEDGER_APPENDS, 1, source=source)
+    return row
+
+
+def append_or_exit(path, source, metrics, devices=None, config=None):
+    """append_row for bench mains: the measurement rows are already
+    on stdout, so a ledger problem exits with a message — not a
+    traceback, and not a silently lost history row."""
+    try:
+        return append_row(path, source, metrics, devices=devices,
+                          config=config)
+    except (LedgerError, OSError) as e:
+        raise SystemExit(f"[bench] perf-ledger append failed: {e}")
+
+
+def ensure_backend_or_skip(source, ledger_path=None, config=None,
+                           timeout_s=None):
+    """bench_backend.ensure_backend with a ledger-aware failure path:
+    when the probe cannot reach a backend AND a ledger is armed, one
+    rig-fingerprinted ``skipped_unmeasurable`` row records the dead
+    window before the explained exit — the BENCH_r01-r05 pathology
+    becomes history instead of a hole."""
+    import bench_backend
+    plat, reason = bench_backend.probe_backend(
+        bench_backend.PROBE_TIMEOUT_S if timeout_s is None
+        else timeout_s)
+    if reason is None:
+        return plat
+    if ledger_path:
+        append_row(ledger_path, source, {}, status=STATUS_SKIPPED,
+                   devices=[], platform="unknown", note=reason,
+                   config=config)
+    raise SystemExit(f"[bench] {reason}")
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+
+def regressions(current, baseline, tolerance=TOLERANCE):
+    """Direction-aware regressions of ``current`` vs ``baseline``.
+
+    Returns a list of {metric, direction, baseline, current,
+    regression} for metrics past ``tolerance``; REFUSES (CrossRigError)
+    when the rows' rig fingerprints differ — a cross-rig delta is a
+    hardware comparison, not a regression.
+    """
+    cur_key = fingerprint_key(current.get("fingerprint") or {})
+    base_key = fingerprint_key(baseline.get("fingerprint") or {})
+    if cur_key != base_key:
+        raise CrossRigError(
+            "refusing cross-rig comparison: current rig "
+            f"{fingerprint_label(current['fingerprint'])} vs baseline "
+            f"{fingerprint_label(baseline['fingerprint'])} — a delta "
+            "across rigs measures the hardware, not the code")
+    found = []
+    cur_metrics = current.get("metrics") or {}
+    base_metrics = baseline.get("metrics") or {}
+    for name, value in cur_metrics.items():
+        base = base_metrics.get(name)
+        if base is None:
+            continue
+        direction = metric_direction(name)
+        worse = (base - value) if direction == "up" else (value - base)
+        if worse <= 0:
+            continue
+        rel = worse / abs(base) if base else math.inf
+        if rel > tolerance:
+            found.append({"metric": name, "direction": direction,
+                          "baseline": base, "current": value,
+                          "regression": rel})
+    # A gated metric that silently VANISHES is a regression too: the
+    # harness stopped measuring it (renamed key, None-dropped value)
+    # and the narrowed row must not become the standing baseline —
+    # otherwise the trend loses the series forever with every gate
+    # green. Accept is the escape for an intentional retirement.
+    for name, base in base_metrics.items():
+        if name not in cur_metrics:
+            found.append({"metric": name,
+                          "direction": metric_direction(name),
+                          "baseline": base, "current": None,
+                          "regression": "missing"})
+    return found
+
+
+def baseline_walk(rows, tolerance=TOLERANCE):
+    """Thread the last-known-good baseline through each (source, rig)
+    series, in ledger order.
+
+    A measured row becomes the standing baseline only when it was
+    explicitly ``accepted`` or did NOT regress against the baseline
+    standing before it — an unaccepted regression can never launder
+    itself into the next window's baseline by simply recurring, so a
+    slow stepwise decay keeps failing against the last good level
+    until someone runs the accept path. ``skipped_unmeasurable``
+    rows neither move nor reset the baseline. Returns one
+    ``{row, baseline, regressions}`` entry per measured row.
+    """
+    baselines = {}
+    entries = []
+    for row in rows:
+        if row.get("status") != STATUS_MEASURED:
+            continue
+        key = (row.get("source"),
+               fingerprint_key(row.get("fingerprint") or {}))
+        base = baselines.get(key)
+        found = (regressions(row, base, tolerance=tolerance)
+                 if base is not None else [])
+        if row.get("accepted") or not found:
+            baselines[key] = row
+        entries.append({"row": row, "baseline": base,
+                        "regressions": found})
+    return entries
+
+
+ACCEPT_HINT = (
+    "if this change is intentional, bless the new level with\n"
+    "    python tools/perf_ledger.py accept --source {source} "
+    "--note \"<why>\"\n"
+    "and commit the PERF_LEDGER.json diff (the note is the audit "
+    "trail for the accepted regression)")
+
+
+def run_check(path, tolerance=TOLERANCE, out=print):
+    """The ``make perf-check`` gate. Returns (failures, skips):
+    failures non-empty = exit 1. Skips are DOCUMENTED (printed with
+    the reason), never silent passes.
+
+    Gating is per (source, rig) SERIES, not per source: a newer row
+    from a different rig — or a ``skipped_unmeasurable`` row on the
+    same rig — annotates but never SHADOWS an unaccepted regression;
+    the last measured row of every series still owes the gate, so a
+    regression can only leave through the accept path (or by the
+    series genuinely recovering)."""
+    try:
+        doc = load_ledger(path)
+    except LedgerError as e:
+        out(f"[perf-check] FAIL: {e}")
+        return [str(e)], []
+    problems = validate_doc(doc)
+    if problems:
+        for p in problems:
+            out(f"  {p}")
+        out(f"[perf-check] FAIL: {len(problems)} schema problem(s) in "
+            f"{path} — fix or drop the bad row(s); the gate never "
+            "compares rows it cannot trust")
+        return problems, []
+    rows = doc["rows"]
+    entries = {id(e["row"]): e
+               for e in baseline_walk(rows, tolerance=tolerance)}
+    groups, order = {}, []
+    measured_per_source = {}
+    for row in rows:
+        gkey = (row["source"], fingerprint_key(row["fingerprint"]))
+        if gkey not in groups:
+            groups[gkey] = []
+            order.append(gkey)
+        groups[gkey].append(row)
+        if row["status"] == STATUS_MEASURED:
+            measured_per_source[row["source"]] = (
+                measured_per_source.get(row["source"], 0) + 1)
+    failures, skips = [], []
+    for gkey in sorted(order):
+        source, _ = gkey
+        series = groups[gkey]
+        rig = fingerprint_label(series[-1]["fingerprint"])
+        measured = [r for r in series
+                    if r["status"] == STATUS_MEASURED]
+        if series[-1]["status"] == STATUS_SKIPPED:
+            note = series[-1].get("note") or "no reason recorded"
+            out(f"[perf-check] SKIP {source}: newest row on {rig} is "
+                f"{STATUS_SKIPPED} ({note}) — no data, not a "
+                "zero-valued regression")
+            if not measured:
+                skips.append(source)
+                continue
+            # Fall through: the last measured row still owes the
+            # gate — a skip must never shadow an unaccepted
+            # regression out of presubmit.
+        row = measured[-1]
+        if row.get("accepted"):
+            out(f"[perf-check] ok   {source}: newest measured row on "
+                f"{rig} accepted as the new baseline "
+                f"({row.get('note') or 'no note'})")
+            continue
+        entry = entries[id(row)]
+        baseline = entry["baseline"]
+        if baseline is None:
+            foreign = measured_per_source[source] - len(measured)
+            skips.append(source)
+            out(f"[perf-check] SKIP {source}: no same-rig baseline "
+                f"on {rig}"
+                + (f" ({foreign} foreign-rig row(s) exist — refusing "
+                   "the cross-rig comparison)" if foreign
+                   else " (first window on this rig)"))
+            continue
+        found = entry["regressions"]
+        if not found:
+            out(f"[perf-check] ok   {source}: "
+                f"{len(row['metrics'])} metric(s) within "
+                f"{tolerance:.0%} of the "
+                f"{baseline['provenance'].get('generated_utc')} "
+                f"last-known-good baseline on {rig}")
+            continue
+        for r in found:
+            if r["regression"] == "missing":
+                out(f"[perf-check] FAIL {source}: {r['metric']} "
+                    f"vanished from the newest row (baseline had "
+                    f"{r['baseline']}) — a gated metric must retire "
+                    "through the accept path, not by disappearing")
+            else:
+                out(f"[perf-check] FAIL {source}: {r['metric']} "
+                    f"regressed {r['regression']:.1%} "
+                    f"({r['baseline']} -> {r['current']}, "
+                    f"direction={r['direction']}, tolerance "
+                    f"{tolerance:.0%})")
+        out("  current row:  " + json.dumps(row, sort_keys=True))
+        out("  baseline row: " + json.dumps(baseline, sort_keys=True))
+        out("  " + ACCEPT_HINT.format(source=source))
+        failures.append(source)
+    out(f"[perf-check] {len(order)} series: "
+        f"{len(order) - len(failures) - len(skips)} ok, "
+        f"{len(skips)} documented skip(s), "
+        f"{len(failures)} regression(s)")
+    return failures, skips
+
+
+def accept_newest(path, source, note, rig=None):
+    """The --update-style accept path: mark ``source``'s newest
+    MEASURED row as the intentional new baseline (with the audit
+    note). With multi-rig history, ``rig`` (a substring of the rig
+    label — platform, kind, or digest) pins WHICH series is being
+    blessed; without it the newest measured row wins, and the caller
+    sees its rig label, so a wrong-rig accept is visible, not
+    silent."""
+    doc = load_ledger(path)
+    problems = validate_doc(doc)
+    if problems:
+        raise LedgerError("refusing to accept on a non-conforming "
+                          "ledger:\n  " + "\n  ".join(problems))
+    seen_rigs = []
+    for row in reversed(doc["rows"]):
+        if row.get("source") != source:
+            continue
+        if row["status"] != STATUS_MEASURED:
+            continue
+        label = fingerprint_label(row["fingerprint"])
+        seen_rigs.append(label)
+        if rig is not None and rig not in label:
+            continue
+        row["accepted"] = True
+        row["note"] = note
+        _write_ledger(path, doc)
+        return row
+    if seen_rigs:
+        raise LedgerError(
+            f"no measured {source} row matches --rig {rig!r} "
+            f"(rigs seen: {sorted(set(seen_rigs))})")
+    raise LedgerError(f"no measured row with source {source!r} "
+                      f"in {path}")
+
+
+def try_append(path, source, metrics, devices=None, platform=None,
+               config=None):
+    """append_row returning an error string instead of raising — the
+    check harnesses' contract ("episode passed, history append
+    failed = harness error, rc 2") lives at one seam instead of
+    three hand-rolled try blocks."""
+    try:
+        append_row(path, source, metrics, devices=devices,
+                   platform=platform, config=config)
+        return None
+    except (LedgerError, OSError) as e:
+        return str(e)
+
+
+def append_manifest_costs(path, manifest_path):
+    """Lift the committed PROGRAM_MANIFEST.json cost figures into one
+    ledger row (source ``program_manifest``), so hot-program
+    FLOPs/bytes trend next to the wall-clock numbers they explain."""
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    metrics = {}
+    for name, entry in sorted((manifest.get("programs") or {}).items()):
+        cost = entry.get("cost") or {}
+        if isinstance(cost.get("flops"), (int, float)):
+            metrics[f"flops:{name}"] = cost["flops"]
+        if isinstance(cost.get("bytes_accessed"), (int, float)):
+            metrics[f"bytes_accessed:{name}"] = cost["bytes_accessed"]
+    if not metrics:
+        raise LedgerError(f"{manifest_path} carries no program costs")
+    return append_row(
+        path, "program_manifest", metrics, devices=[],
+        platform=manifest.get("platform") or "unknown",
+        fingerprint=rig_fingerprint(
+            devices=[], platform=manifest.get("platform") or "unknown",
+            knobs={}),
+        config={"manifest": os.path.basename(manifest_path),
+                "programs": len(manifest.get("programs") or {})})
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("command", nargs="?", default="check",
+                   choices=["check", "accept", "append-manifest",
+                            "validate"])
+    p.add_argument("--ledger", default=DEFAULT_LEDGER)
+    p.add_argument("--tolerance", type=float, default=TOLERANCE)
+    p.add_argument("--source", default=None,
+                   help="(accept) which source's newest row to bless")
+    p.add_argument("--note", default=None,
+                   help="(accept) the audit note for the new baseline")
+    p.add_argument("--rig", default=None,
+                   help="(accept) substring of the rig label pinning "
+                        "WHICH series' newest measured row to bless "
+                        "(multi-rig histories)")
+    p.add_argument("--manifest",
+                   default=os.path.join(REPO, "PROGRAM_MANIFEST.json"))
+    args = p.parse_args(argv)
+
+    try:
+        if args.command == "check":
+            failures, _ = run_check(args.ledger,
+                                    tolerance=args.tolerance)
+            return 1 if failures else 0
+        if args.command == "validate":
+            problems = validate_doc(load_ledger(args.ledger))
+            for problem in problems:
+                print("  " + problem)
+            print(f"[perf-ledger] {args.ledger}: "
+                  f"{'FAIL' if problems else 'ok'} "
+                  f"({len(problems)} problem(s))")
+            return 1 if problems else 0
+        if args.command == "accept":
+            if not args.source or not args.note:
+                print("[perf-ledger] accept needs --source and "
+                      "--note (the note is the audit trail)",
+                      file=sys.stderr)
+                return 2
+            row = accept_newest(args.ledger, args.source, args.note,
+                                rig=args.rig)
+            print(f"[perf-ledger] accepted {args.source} @ "
+                  f"{row['provenance'].get('generated_utc')} on "
+                  f"{fingerprint_label(row['fingerprint'])} as the "
+                  f"new baseline")
+            return 0
+        if args.command == "append-manifest":
+            row = append_manifest_costs(args.ledger, args.manifest)
+            print(f"[perf-ledger] appended program_manifest row "
+                  f"({len(row['metrics'])} cost metrics)")
+            return 0
+    except (LedgerError, OSError, ValueError) as e:
+        print(f"[perf-ledger] {args.command} failed: {e}",
+              file=sys.stderr)
+        return 1
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,181 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Render the perf ledger's trend: per-metric series, regressions,
+last-known-good per rig.
+
+Where ``perf_ledger.py check`` is the GATE (newest row vs its
+same-rig baseline, pass/fail), this tool is the TREND READER: it
+groups every source's rows by rig fingerprint (cross-rig series are
+never merged — same refusal as the gate), walks each rig's history
+pairwise to annotate where regressions landed, and reports the
+last-known-good row per rig (the newest measured row that did NOT
+regress against its predecessor, or was explicitly accepted).
+``tools/tpu_diagnose.py`` folds :func:`build_report` into its bundle
+as the ``perf`` section, so an incident capture carries the node's
+performance history next to its traces.
+
+Usage:
+  perf_report.py [--ledger PERF_LEDGER.json] [--source S]
+                 [--out report.json]
+
+Exit 0 whenever the report was produced (an empty ledger is an empty
+report, not an error); 1 on an unreadable/invalid ledger.
+"""
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import perf_ledger  # noqa: E402
+
+SERIES_TAIL = 12   # series points kept per metric (newest last)
+
+
+def _rig_history(rows, tolerance):
+    """One rig's report: series, regression annotations against the
+    threaded last-known-good baseline (perf_ledger.baseline_walk —
+    the same anchoring the gate uses), last-known-good."""
+    series = {}
+    annotations = []
+    last_good = None
+    entries = {id(e["row"]): e
+               for e in perf_ledger.baseline_walk(rows, tolerance)}
+    for row in rows:
+        utc = row["provenance"].get("generated_utc")
+        if row["status"] != perf_ledger.STATUS_MEASURED:
+            annotations.append({"utc": utc, "skipped": True,
+                                "note": row.get("note")})
+            continue
+        for name, value in sorted(row["metrics"].items()):
+            series.setdefault(name, []).append(
+                {"utc": utc, "value": value})
+        found = entries[id(row)]["regressions"]
+        for r in found:
+            annotations.append({"utc": utc, **r})
+        if row.get("accepted") or not found:
+            last_good = {"utc": utc, "metrics": row["metrics"],
+                         "git_sha": row["provenance"].get("git_sha"),
+                         "accepted": bool(row.get("accepted"))}
+    return {
+        "rows": sum(1 for r in rows
+                    if r["status"] == perf_ledger.STATUS_MEASURED),
+        "skipped_rows": sum(
+            1 for r in rows
+            if r["status"] == perf_ledger.STATUS_SKIPPED),
+        "series": {name: points[-SERIES_TAIL:]
+                   for name, points in series.items()},
+        "regressions": annotations,
+        "last_known_good": last_good,
+        "fingerprint": rows[-1]["fingerprint"],
+    }
+
+
+def build_report(doc, tolerance=perf_ledger.TOLERANCE, source=None):
+    """The trend report for a loaded ledger document. Raises
+    LedgerError on a non-conforming ledger (the reader trusts exactly
+    what the writer validated, nothing else)."""
+    problems = perf_ledger.validate_doc(doc)
+    if problems:
+        raise perf_ledger.LedgerError(
+            "ledger fails validation:\n  " + "\n  ".join(problems))
+    grouped = {}
+    for row in doc["rows"]:
+        if source is not None and row["source"] != source:
+            continue
+        rig = perf_ledger.fingerprint_label(row["fingerprint"])
+        grouped.setdefault(row["source"], {}).setdefault(
+            rig, []).append(row)
+    return {
+        "generated_utc": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "tolerance": tolerance,
+        "sources": {
+            src: {rig: _rig_history(rows, tolerance)
+                  for rig, rows in rigs.items()}
+            for src, rigs in sorted(grouped.items())},
+    }
+
+
+def format_report(report):
+    """Human trend lines (one per metric per rig, newest values
+    last)."""
+    lines = []
+    for src, rigs in report["sources"].items():
+        for rig, hist in rigs.items():
+            good = hist["last_known_good"]
+            lines.append(
+                f"[perf-report] {src} @ {rig}: {hist['rows']} row(s)"
+                + (f", {hist['skipped_rows']} skipped"
+                   if hist["skipped_rows"] else "")
+                + (f", last-known-good {good['utc']}" if good
+                   else ", no known-good row"))
+            for name, points in sorted(hist["series"].items()):
+                trail = " -> ".join(str(p["value"]) for p in points)
+                lines.append(f"    {name}: {trail}")
+            for ann in hist["regressions"]:
+                if ann.get("skipped"):
+                    lines.append(
+                        f"    ! {ann['utc']}: skipped_unmeasurable "
+                        f"({ann.get('note') or 'no reason'})")
+                elif ann.get("regression") == "missing":
+                    lines.append(
+                        f"    ! {ann['utc']}: {ann['metric']} "
+                        f"vanished from the row (baseline "
+                        f"{ann['baseline']})")
+                else:
+                    lines.append(
+                        f"    ! {ann['utc']}: {ann['metric']} "
+                        f"regressed {ann['regression']:.1%} "
+                        f"({ann['baseline']} -> {ann['current']})")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--ledger", default=perf_ledger.DEFAULT_LEDGER)
+    p.add_argument("--source", default=None)
+    p.add_argument("--tolerance", type=float,
+                   default=perf_ledger.TOLERANCE)
+    p.add_argument("--out", default=None,
+                   help="also write the full report JSON here")
+    args = p.parse_args(argv)
+    try:
+        doc = perf_ledger.load_ledger(args.ledger)
+        report = build_report(doc, tolerance=args.tolerance,
+                              source=args.source)
+    except perf_ledger.LedgerError as e:
+        print(f"[perf-report] {e}", file=sys.stderr)
+        return 1
+    print(format_report(report) or "[perf-report] empty ledger")
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.out)
+        print(f"[perf-report] wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,7 @@ Two scenarios on the fake-chip backend, pure CPU, seconds:
 Exit 0 = clean, 1 = check failed, 2 = harness error.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -204,7 +205,14 @@ def check_repartition(failures):
             "proposal": applied, "proposed_events": proposed}
 
 
-def main():
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append the scorer's largest-box retention "
+                        "trace to the perf ledger "
+                        "(tools/perf_ledger.py) when the check "
+                        "passes")
+    args = p.parse_args(argv)
     failures = []
     try:
         mixed = check_mixed_trace(failures)
@@ -220,6 +228,26 @@ def main():
         for f in failures:
             print(f"placement-check FAILED: {f}", file=sys.stderr)
         return 1
+    if args.ledger:
+        import perf_ledger
+
+        # This harness is deliberately jax-free (fake-chip plugin
+        # layer only): the rig fingerprint records the fake node, not
+        # an accelerator. The check PASSED, so a ledger problem is a
+        # harness error (rc 2), not a failed placement check.
+        err = perf_ledger.try_append(
+            args.ledger, "placement_check", {
+                "largest_box_retention_total": sum(mixed["scorer"]),
+                "largest_box_retention_ratio": round(
+                    sum(mixed["scorer"])
+                    / max(sum(mixed["first_fit"]), 1), 4),
+            }, devices=[], platform="fake-chip",
+            config={"trace": [list(s) for s in MIXED_TRACE],
+                    "first_fit_total": sum(mixed["first_fit"])})
+        if err:
+            print(f"placement-check: perf-ledger append failed: "
+                  f"{err}", file=sys.stderr)
+            return 2
     print("placement-check: OK", file=sys.stderr)
     return 0
 

@@ -29,13 +29,20 @@ Subcommands:
   decode  <rows.jsonl> <out.json>   wrap JSONL decode rows into one
                                     {provenance, rows} object;
                                     refuse empty/non-TPU rows.
-  serving <raw.json> <stats.json> <out.json>
+  serving <raw.json> <stats.json> <out.json> [--ledger PATH]
                                     build the stamped serving
                                     artifact from the cold+warm
                                     load-generator summaries and the
                                     server's /stats; refuse error or
                                     mostly-failed summaries and
-                                    non-TPU platforms.
+                                    non-TPU platforms. With --ledger,
+                                    the promoted server_stats land as
+                                    one perf-ledger row (source
+                                    ``serving_bench``) in the same
+                                    promotion: suite-window
+                                    promotions and bench runs share
+                                    ONE trend history, and a ledger
+                                    failure fails the promotion.
 
 Exit 0 = promoted (out written atomically); 1 = refused (out
 untouched; reason on stderr).
@@ -47,6 +54,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 from container_engine_accelerators_tpu.utils.provenance import (  # noqa: E402
     stamp,
@@ -83,7 +91,45 @@ def promote_decode(rows_path, out_path):
                              "rows": rows})
 
 
-def promote_serving(raw_path, stats_path, out_path):
+# The perf-bearing subset of server_stats + warm-summary keys that
+# land in the ledger row (every name resolves in
+# perf_ledger.METRIC_DIRECTIONS; counts/identifiers stay in config).
+_LEDGER_STAT_KEYS = (
+    "batch_occupancy_avg", "ttft_p50_ms", "ttft_p99_ms",
+    "tpot_p50_ms", "tpot_p99_ms", "kv_block_utilization",
+    "prefix_hit_rate", "kv_spill_hit_rate",
+)
+_LEDGER_WARM_KEYS = ("qps", "p50_ms", "p99_ms")
+
+
+def _append_serving_ledger(ledger_path, out):
+    """The promoted measurement's ledger row, through the one shared
+    writer. Raises Refused on any ledger problem so a promotion that
+    cannot land its history row fails loudly (same transaction, not
+    a best-effort side channel)."""
+    import perf_ledger
+
+    stats = out.get("server_stats") or {}
+    warm = out.get("steady_state") or {}
+    metrics = {k: stats[k] for k in _LEDGER_STAT_KEYS
+               if isinstance(stats.get(k), (int, float))}
+    metrics.update({k: warm[k] for k in _LEDGER_WARM_KEYS
+                    if isinstance(warm.get(k), (int, float))})
+    if not metrics:
+        raise Refused("serving capture carries no ledger-able "
+                      "metrics (no server_stats, no warm qps/p50/p99)")
+    try:
+        perf_ledger.append_row(
+            ledger_path, "serving_bench", metrics,
+            devices=out["provenance"].get("devices") or [],
+            platform=out.get("server_platform"),
+            config=dict(out["config"],
+                        requests=warm.get("requests")))
+    except perf_ledger.LedgerError as e:
+        raise Refused(f"perf-ledger append failed: {e}")
+
+
+def promote_serving(raw_path, stats_path, out_path, ledger_path=None):
     """cold+warm load summaries + /stats -> stamped artifact."""
     with open(raw_path) as f:
         raw = json.load(f)
@@ -138,16 +184,42 @@ def promote_serving(raw_path, stats_path, out_path):
         "kv_rehydrated_blocks") if k in stats}
     if engine_stats:
         out["server_stats"] = engine_stats
+    # Ledger row first, artifact second: a refused/unappendable row
+    # aborts before the committed artifact moves, and a subsequent
+    # artifact-write failure only leaves one extra (honest) history
+    # row behind — never an artifact without its history.
+    if ledger_path:
+        _append_serving_ledger(ledger_path, out)
     _write_atomic(out_path, out)
 
 
 def main(argv):
+    argv = list(argv)
+    ledger_path = None
+    if "--ledger" in argv:
+        i = argv.index("--ledger")
+        try:
+            ledger_path = argv[i + 1]
+        except IndexError:
+            print(__doc__, file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     try:
         if len(argv) >= 2 and argv[1] == "decode" and len(argv) == 4:
+            if ledger_path:
+                # No silent no-op: decode rows join the trend through
+                # bench_decode --ledger (per-config sources); a flag
+                # that drops on the floor would read as history
+                # landing when it is not.
+                print("[promote] --ledger is a serving-only flag "
+                      "(decode rows ledger through bench_decode "
+                      "--ledger)", file=sys.stderr)
+                return 2
             promote_decode(argv[2], argv[3])
         elif (len(argv) >= 2 and argv[1] == "serving"
               and len(argv) == 5):
-            promote_serving(argv[2], argv[3], argv[4])
+            promote_serving(argv[2], argv[3], argv[4],
+                            ledger_path=ledger_path)
         else:
             print(__doc__, file=sys.stderr)
             return 2

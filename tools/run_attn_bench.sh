@@ -20,15 +20,21 @@
 # kernel numbers; each row carries the platform it measured on.
 #
 # Usage: tools/run_attn_bench.sh [out.json]
+#
+# ATTN_BENCH_LEDGER=path arms the perf-ledger append on every
+# invocation (one row per config digest — tools/perf_ledger.py); the
+# TPU suite sets it so each window's kernel rates join the trend.
 
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-ATTN_BENCH.json}"
 TMP="$(mktemp)"
+LEDGER="${ATTN_BENCH_LEDGER:-}"
 
 for SEQ in 2048 4096 8192; do
   echo "[attn-bench] seq_len=${SEQ}" >&2
   timeout -k 30 900 python tools/bench_attention.py \
+    ${LEDGER:+--ledger "${LEDGER}"} \
     --seq-len "${SEQ}" --check-numerics >> "${TMP}" \
     || echo "{\"seq_len\": ${SEQ}, \"error\": \"run failed/timeout\"}" \
        >> "${TMP}"
@@ -42,6 +48,7 @@ done
 for SEQ in 16384 32768; do
   echo "[attn-bench] seq_len=${SEQ} (streaming)" >&2
   timeout -k 30 1500 python tools/bench_attention.py \
+    ${LEDGER:+--ledger "${LEDGER}"} \
     --seq-len "${SEQ}" --batch 1 --check-numerics >> "${TMP}" \
     || echo "{\"seq_len\": ${SEQ}, \"error\": \"run failed/timeout\"}" \
        >> "${TMP}"
@@ -54,6 +61,7 @@ done
 for BLK in 256 512; do
   echo "[attn-bench] seq_len=4096 block=${BLK}" >&2
   timeout -k 30 900 python tools/bench_attention.py \
+    ${LEDGER:+--ledger "${LEDGER}"} \
     --seq-len 4096 --block "${BLK}" >> "${TMP}" \
     || echo "{\"seq_len\": 4096, \"block\": ${BLK}, \
 \"error\": \"run failed/timeout\"}" >> "${TMP}"
@@ -61,6 +69,7 @@ done
 for BLK in 128 256; do
   echo "[attn-bench] seq_len=2048 block=${BLK}" >&2
   timeout -k 30 900 python tools/bench_attention.py \
+    ${LEDGER:+--ledger "${LEDGER}"} \
     --seq-len 2048 --block "${BLK}" >> "${TMP}" \
     || echo "{\"seq_len\": 2048, \"block\": ${BLK}, \
 \"error\": \"run failed/timeout\"}" >> "${TMP}"
@@ -74,6 +83,7 @@ done
 for SEQ in 16384 32768; do
   echo "[attn-bench] seq_len=${SEQ} block=1024 (streaming)" >&2
   timeout -k 30 1500 python tools/bench_attention.py \
+    ${LEDGER:+--ledger "${LEDGER}"} \
     --seq-len "${SEQ}" --batch 1 --block 1024 >> "${TMP}" \
     || echo "{\"seq_len\": ${SEQ}, \"block\": 1024, \
 \"error\": \"run failed/timeout\"}" >> "${TMP}"

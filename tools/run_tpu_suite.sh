@@ -67,6 +67,16 @@ is_fresh() {  # $1 = artifact path; rc 0 = fresh enough to skip
     2>/dev/null
 }
 
+# Perf-ledger freshness: a measured PERF_LEDGER row for the section
+# (same rig fingerprint, younger than the cap) also skips it — a
+# suite window that just appended a row IS the recent measurement.
+# Wrapped in timeout because deriving the current fingerprint
+# enumerates jax devices, which a wedged tunnel can hang.
+is_fresh_ledger() {  # $1 = ledger source name; rc 0 = skip
+  timeout -k 10 240 python tools/artifact_freshness.py \
+    PERF_LEDGER.json "${SKIP_FRESH_DAYS}" "$1" 2>/dev/null
+}
+
 # ---------------------------------------------------------------------
 # 0. Tracer preflight — `make trace-check` (~2s, pure CPU): fake-chip
 #    plugin + one Allocate; fails on an empty /debug/trace or a
@@ -94,7 +104,7 @@ sec_rc $? "diagnose-check preflight"
 # goodput/MFU numbers every later section reports are fiction.
 echo "[suite] goodput-check preflight" >&2
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
-  python tools/goodput_check.py \
+  python tools/goodput_check.py --ledger PERF_LEDGER.json \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "goodput-check preflight"
 
@@ -108,7 +118,7 @@ sec_rc $? "goodput-check preflight"
 # instead of recovering.
 echo "[suite] chaos-check preflight" >&2
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
-  python tools/chaos_check.py \
+  python tools/chaos_check.py --ledger PERF_LEDGER.json \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "chaos-check preflight"
 
@@ -120,6 +130,7 @@ sec_rc $? "chaos-check preflight"
 # boxes the benchmarks below depend on being allocatable.
 echo "[suite] placement-check preflight" >&2
 timeout -k 10 120 python tools/placement_check.py \
+  --ledger PERF_LEDGER.json \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "placement-check preflight"
 
@@ -133,6 +144,7 @@ sec_rc $? "placement-check preflight"
 echo "[suite] paging-check preflight" >&2
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
   python tools/bench_serving_occupancy.py --paging-check \
+  --ledger PERF_LEDGER.json \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "paging-check preflight"
 
@@ -148,8 +160,22 @@ sec_rc $? "paging-check preflight"
 echo "[suite] spill-check preflight" >&2
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
   python tools/bench_serving_occupancy.py --spill-check \
+  --ledger PERF_LEDGER.json \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "spill-check preflight"
+
+# Perf-ledger gate (pure ledger read, ~1s): every row appended so far
+# this window — and the whole committed history — is schema-checked,
+# and each source's newest row is held to within 10% of its newest
+# SAME-RIG baseline (direction-aware). A regression that every
+# individual gate above still passes (a slow 8% decay compounding
+# across windows, say, finally crossing 10% of baseline) fails HERE,
+# with both rows printed. Foreign-rig-only baselines are documented
+# skips, never silent passes.
+echo "[suite] perf-check gate" >&2
+timeout -k 10 120 python tools/perf_ledger.py check \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "perf-check gate"
 
 # Analysis preflight (CPU, ~3 min): zero lint findings on the tree
 # (with every seeded fixture violation firing), a clean lock-order
@@ -177,6 +203,14 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "program-check preflight"
 
+# Lift the (just-verified) committed program costs into the ledger so
+# hot-program FLOPs/bytes trend next to the wall-clock numbers they
+# explain; perf-check gates their drift from the NEXT window on.
+echo "[suite] program-manifest ledger append" >&2
+timeout -k 10 120 python tools/perf_ledger.py append-manifest \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "program-manifest ledger append"
+
 # Continuous-batching preflight (CPU fake backend, ~1 min): the slot
 # engine must beat the sequential-batch policy >= 2x in goodput on a
 # replayed Poisson trace with greedy outputs bit-identical to
@@ -185,6 +219,7 @@ sec_rc $? "program-check preflight"
 echo "[suite] occupancy-check preflight" >&2
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python tools/bench_serving_occupancy.py --check \
+  --ledger PERF_LEDGER.json \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "occupancy-check preflight"
 
@@ -196,8 +231,9 @@ sec_rc $? "occupancy-check preflight"
 # --warm + /healthz gating: "cold" below measures a replica that just
 # became Ready (the HPA join path), not a replica still compiling —
 # with the readiness gate no request ever pays a compile.
-if is_fresh SERVING_BENCH.json; then
-  echo "[suite] serving bench: SERVING_BENCH.json fresh, skipping" >&2
+if is_fresh SERVING_BENCH.json || is_fresh_ledger serving_bench; then
+  echo "[suite] serving bench: SERVING_BENCH.json or same-rig" \
+       "ledger row fresh, skipping" >&2
 else
   echo "[suite] serving bench (LM generate, cold + warm)" >&2
   # 9>&-: the backgrounded server must not inherit the suite lock fd —
@@ -265,7 +301,7 @@ else
       # every refusal path is unit-tested (tests/test_artifacts.py).
       python tools/promote_artifact.py serving \
         "${OUT}/SERVING_BENCH_RAW.json" "${OUT}/.srv_stats.json" \
-        SERVING_BENCH.json || \
+        SERVING_BENCH.json --ledger PERF_LEDGER.json || \
         sec_rc 1 "serving bench (capture refused / promotion failed)"
     fi
   else
@@ -298,7 +334,8 @@ dec2() {  # one retry after a pause: a transient tunnel drop mid-
   local buf rc
   buf="$(mktemp)"
   for attempt in 1 2; do
-    timeout -k 30 1800 python tools/bench_decode.py "$@" > "${buf}"
+    timeout -k 30 1800 python tools/bench_decode.py \
+      --ledger PERF_LEDGER.json "$@" > "${buf}"
     rc=$?
     if [ "${rc}" = 0 ]; then
       cat "${buf}"; rm -f "${buf}"; return 0
@@ -431,22 +468,28 @@ sec_rc $? "telemetry source probe"
 # BENCH_TOTAL_BUDGET_S is set just under the outer timeout so bench.py
 # itself finalizes (and prints its cumulative diagnostic) before
 # `timeout` kills it.
-if is_fresh TPU_BENCH_DEFAULT.json; then
-  echo "[suite] headline bench: TPU_BENCH_DEFAULT.json fresh, skipping" >&2
+if is_fresh TPU_BENCH_DEFAULT.json \
+    || is_fresh_ledger bench_headline; then
+  echo "[suite] headline bench: TPU_BENCH_DEFAULT.json or same-rig" \
+       "ledger row fresh, skipping" >&2
 else
   echo "[suite] headline bench (default batch)" >&2
   BENCH_ATTEMPTS=2 BENCH_BACKOFF_S=30 BENCH_TOTAL_BUDGET_S=5700 \
+    BENCH_PERF_LEDGER=PERF_LEDGER.json \
     timeout -k 30 6000 python bench.py \
     > "${OUT}/tpu_bench_default.out" 2>> "${OUT}/tpu_suite.log" 9>&-
   sec_rc $? "headline bench (default batch)"
   cat "${OUT}/tpu_bench_default.out" >&2
 fi
 
-if is_fresh TPU_BENCH_B256.json; then
-  echo "[suite] headline bench: TPU_BENCH_B256.json fresh, skipping" >&2
+if is_fresh TPU_BENCH_B256.json \
+    || is_fresh_ledger bench_headline_b256; then
+  echo "[suite] headline bench: TPU_BENCH_B256.json or same-rig" \
+       "ledger row fresh, skipping" >&2
 else
   echo "[suite] headline bench (batch 256/chip)" >&2
   BENCH_ATTEMPTS=1 BENCH_BATCH_PER_CHIP=256 BENCH_TOTAL_BUDGET_S=3300 \
+    BENCH_PERF_LEDGER=PERF_LEDGER.json \
     timeout -k 30 3600 python bench.py \
     > "${OUT}/tpu_bench_b256.out" 2>> "${OUT}/tpu_suite.log" 9>&-
   sec_rc $? "headline bench (batch 256)"
@@ -481,7 +524,8 @@ echo "[suite] attention sweep" >&2
 # Tracked artifact: write a sidecar and promote only on success, so a
 # timed-out sweep can't truncate the committed on-chip record (same
 # rule bench.py applies to TPU_BENCH_*.json).
-timeout -k 30 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json.tmp" \
+ATTN_BENCH_LEDGER=PERF_LEDGER.json \
+  timeout -k 30 5400 tools/run_attn_bench.sh "${OUT}/ATTN_BENCH.json.tmp" \
   2>> "${OUT}/tpu_suite.log" 9>&-
 ATTN_RC=$?
 # run_attn_bench.sh records a failed/timed-out config as a clean

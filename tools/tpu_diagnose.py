@@ -49,7 +49,13 @@ incident:
     ``allocate.decision``/``placement.decision`` events, and every
     ``placement.repartition_proposed/applied`` event in timeline
     order (did the policy see the fragmentation, what did it
-    propose, and was the drain gate honored).
+    propose, and was the drain gate honored);
+  - the node's performance history: the perf ledger
+    (``--perf-ledger``, default the committed PERF_LEDGER.json)
+    rendered through tools/perf_report.py — per-metric trend series
+    grouped by rig fingerprint, regression annotations, and the
+    last-known-good row per rig, so an incident bundle shows whether
+    the node was already slow BEFORE it broke.
 
 Endpoint failures are recorded in place (a structured error per
 surface), never raised: on a half-dead node the partial bundle IS the
@@ -73,6 +79,7 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 from container_engine_accelerators_tpu import obs  # noqa: E402
 from container_engine_accelerators_tpu.obs.straggler import (  # noqa: E402
@@ -333,6 +340,25 @@ def elastic_section(endpoints, snapshots, checkpoint_dirs):
     }
 
 
+def perf_section(ledger_path):
+    """The node's perf-ledger trend (tools/perf_report.py): series
+    per rig fingerprint, regression annotations, last-known-good. A
+    missing/invalid ledger is recorded in place — the bundle is never
+    voided by the history being absent."""
+    try:
+        import perf_ledger
+        import perf_report
+
+        doc = perf_ledger.load_ledger(ledger_path)
+        return {"ledger": ledger_path,
+                "rows": len(doc.get("rows") or []),
+                "report": perf_report.build_report(doc)}
+    except Exception as e:
+        return {"ledger": ledger_path,
+                "error_type": type(e).__name__,
+                "error": str(e)[:300]}
+
+
 def profile_captures(snapshots):
     """Profiler artifacts recorded in any collected journal."""
     captures = []
@@ -352,8 +378,13 @@ def profile_captures(snapshots):
     return captures
 
 
+DEFAULT_PERF_LEDGER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "PERF_LEDGER.json")
+
+
 def collect(urls, journal_paths, dev_dir, state_dir,
-            checkpoint_dirs=()):
+            checkpoint_dirs=(), perf_ledger_path=None):
     endpoints = sweep_endpoints(urls)
     journals = load_journals(journal_paths)
 
@@ -400,6 +431,8 @@ def collect(urls, journal_paths, dev_dir, state_dir,
         "elastic": elastic_section(endpoints, snapshots,
                                    checkpoint_dirs),
         "placement": placement_section(endpoints, snapshots),
+        "perf": perf_section(perf_ledger_path
+                             or DEFAULT_PERF_LEDGER),
         "provenance": stamp(
             devices=["host (diagnostics sweep; reads debug "
                      "endpoints and state files only)"]),
@@ -424,6 +457,10 @@ def main(argv=None):
                         "finished checkpoint's provenance to record "
                         "(where an elastic resume would restore "
                         "from)")
+    p.add_argument("--perf-ledger", default=None,
+                   help="perf-ledger path for the bundle's perf "
+                        "trend section (default: the committed "
+                        "PERF_LEDGER.json)")
     p.add_argument("--out", default="tpu_diagnose.json")
     args = p.parse_args(argv)
 
@@ -431,7 +468,8 @@ def main(argv=None):
         ([] if args.no_default_urls else list(DEFAULT_URLS))
         + args.url))
     bundle = collect(urls, args.journal, args.dev_dir, args.state_dir,
-                     checkpoint_dirs=args.checkpoint_dir)
+                     checkpoint_dirs=args.checkpoint_dir,
+                     perf_ledger_path=args.perf_ledger)
 
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
@@ -456,6 +494,7 @@ def main(argv=None):
         "profile_captures": len(bundle["profiles"]),
         "placement_decisions": bundle["placement"]["decisions_observed"],
         "repartition_proposals": bundle["placement"]["proposals"],
+        "perf_ledger_rows": bundle["perf"].get("rows"),
     }))
     return 0
 
