@@ -37,6 +37,7 @@ presubmit:
 	python3 -m container_engine_accelerators_tpu.analysis
 	JAX_PLATFORMS=cpu python3 tools/program_manifest.py --check
 	python3 tools/perf_ledger.py check
+	JAX_PLATFORMS=cpu python3 tools/slo_check.py --fast
 
 # Project-native analysis gate: the AST lint must report ZERO
 # findings over the tree while every seeded fixture violation fires;
@@ -130,6 +131,19 @@ spill-check:
 	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
 		--spill-check
 
+# Latency-attribution guard: replay a synthetic greedy trace with
+# INJECTED KV-block starvation through the instrumented serving loop
+# (_EngineService + paged engine, arena sized for ~2 of 4 slots);
+# fail unless every retired request's attribution buckets sum to its
+# wall time within 1%, the TTFT tail's top-ranked bucket is
+# block_wait (the injected cause comes back NAMED), the
+# tpu_serving_saturation plane read block-starved while the queue was
+# backed up, and every greedy stream is token-identical to
+# per-request decode() — the instrumentation must be
+# stream-invisible. Pure CPU, ~1 min.
+slo-check:
+	JAX_PLATFORMS=cpu python3 tools/slo_check.py
+
 # Perf-ledger regression gate: validate every committed
 # PERF_LEDGER.json row (schema exact, field-level messages) and
 # compare each source's newest row against its newest SAME-RIG
@@ -168,5 +182,5 @@ clean:
 .PHONY: all native test test-native test-native-asan presubmit bench \
 	analysis-check program-check trace-check diagnose-check \
 	goodput-check chaos-check placement-check occupancy-check \
-	paging-check spill-check perf-check container partition-tpu \
-	push clean
+	paging-check spill-check perf-check slo-check container \
+	partition-tpu push clean

@@ -2019,6 +2019,28 @@ class SlotDecodeEngine:
                 # never looked up (or vice versa).
                 "share_eligible": share}
 
+    def admission_block_cause(self, tokens, prompt_len, max_new=None,
+                              *, allow_prefix=True,
+                              repetition_penalty=1.0):
+        """What an ``admit`` with these arguments is blocked on NOW:
+        ``"slots"`` (no free slot), ``"kv_blocks"`` (free slot, but
+        the block budget — free minus other rows' reservations —
+        cannot cover the row's worst-case private span), or None
+        (admissible). This is the cause the serving loop's latency
+        attribution and the ``tpu_serving_saturation_cause`` gauges
+        report; the third admission blocker, the server's queue cap,
+        lives above the engine (a shed never reaches ``admit``)."""
+        if self.free_slots() == 0:
+            return "slots"
+        if not self.paged:
+            return None
+        plan = self._paged_plan(tokens, prompt_len, max_new,
+                                allow_prefix, repetition_penalty,
+                                count=False)
+        if self._pool.available() < plan["needed"]:
+            return "kv_blocks"
+        return None
+
     def can_admit(self, tokens, prompt_len, max_new=None, *,
                   allow_prefix=True, repetition_penalty=1.0):
         """Whether ``admit`` with these arguments would succeed NOW.
@@ -2026,15 +2048,20 @@ class SlotDecodeEngine:
         the block budget (free minus other rows' reservations) must
         cover the row's worst-case private span — the
         block-availability-driven admission gate the serving loop
-        checks before popping its queue."""
-        if self.free_slots() == 0:
-            return False
+        checks before popping its queue. ``admission_block_cause``
+        additionally names the starved resource."""
+        return self.admission_block_cause(
+            tokens, prompt_len, max_new, allow_prefix=allow_prefix,
+            repetition_penalty=repetition_penalty) is None
+
+    def block_availability(self):
+        """(available, usable) KV blocks — *available* nets out
+        admitted rows' growth reservations, the same budget
+        ``can_admit`` gates on (the kv_blocks saturation cause's
+        numerator). None on the dense pool."""
         if not self.paged:
-            return True
-        plan = self._paged_plan(tokens, prompt_len, max_new,
-                                allow_prefix, repetition_penalty,
-                                count=False)
-        return self._pool.available() >= plan["needed"]
+            return None
+        return self._pool.available(), self._pool.usable
 
     def _paged_prefill(self, suffix, shared_len, prefix_table,
                        temperature, top_k, top_p, min_p, rep_pen,

@@ -65,6 +65,13 @@ from .propagate import (
     format_traceparent,
     parse_traceparent,
 )
+from .reqledger import (
+    ATTRIBUTION_BUCKETS,
+    SATURATION_CAUSES,
+    RequestLedger,
+    RequestTimeline,
+    saturation,
+)
 from .trace import (
     DEFAULT_BUCKETS,
     NULL_SPAN,
@@ -107,14 +114,15 @@ def enabled():
 
 
 __all__ = [
-    "DEFAULT_BUCKETS", "FlopsLedger", "GoodputLedger", "Histogram",
-    "NULL_SPAN", "PROFILE_PATH", "Span", "TRACEPARENT_KEY", "TRACER",
-    "TRACE_PATH", "Tracer", "VARZ_PATH", "context_from_metadata",
-    "counter", "debug_response", "dump_json", "enabled", "event",
-    "flops_from_cost_analysis", "format_traceparent", "gauge",
-    "get_tracer", "histogram", "identity", "merge_perfetto",
-    "parse_traceparent", "peak_flops_per_chip", "perfetto_trace",
-    "process_label", "profile_response", "prometheus_text",
-    "report_from_snapshots", "set_role", "span", "varz",
-    "write_journal",
+    "ATTRIBUTION_BUCKETS", "DEFAULT_BUCKETS", "FlopsLedger",
+    "GoodputLedger", "Histogram", "NULL_SPAN", "PROFILE_PATH",
+    "RequestLedger", "RequestTimeline", "SATURATION_CAUSES", "Span",
+    "TRACEPARENT_KEY", "TRACER", "TRACE_PATH", "Tracer", "VARZ_PATH",
+    "context_from_metadata", "counter", "debug_response", "dump_json",
+    "enabled", "event", "flops_from_cost_analysis",
+    "format_traceparent", "gauge", "get_tracer", "histogram",
+    "identity", "merge_perfetto", "parse_traceparent",
+    "peak_flops_per_chip", "perfetto_trace", "process_label",
+    "profile_response", "prometheus_text", "report_from_snapshots",
+    "saturation", "set_role", "span", "varz", "write_journal",
 ]

@@ -30,6 +30,18 @@ TRACE_PATH = "/debug/trace"
 VARZ_PATH = "/debug/varz"
 
 
+def query_param(query, key, default=None):
+    """First ``key=value`` value in a raw query string, or
+    ``default``. The ONE ?key=value scanner every /debug/* endpoint
+    shares (typed parsing — int/float, junk policy — stays at the
+    call site, where the endpoint's error contract lives)."""
+    for part in (query or "").split("&"):
+        name, _, value = part.partition("=")
+        if name == key:
+            return value
+    return default
+
+
 def debug_response(tracer, path, query=""):
     """(content_type, body_bytes) for a debug path, or None when the
     path is not a debug endpoint."""

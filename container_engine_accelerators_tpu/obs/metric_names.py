@@ -69,6 +69,10 @@ SERVING_KV_BLOCKS_SHARED = "tpu_serving_kv_blocks_shared"
 SERVING_KV_SPILL_BLOCKS = "tpu_serving_kv_spill_blocks"
 SERVING_KV_SPILL_HITS = "tpu_serving_kv_spill_hits_total"
 SERVING_KV_REHYDRATE = "tpu_serving_kv_rehydrate_seconds"
+SERVING_LATENCY_ATTRIBUTION = (
+    "tpu_serving_latency_attribution_seconds")
+SERVING_SATURATION = "tpu_serving_saturation"
+SERVING_SATURATION_CAUSE = "tpu_serving_saturation_cause"
 
 # name -> one-line help. The authoritative set: the metric-registry
 # lint resolves every tpu_* literal in the tree against these keys
@@ -106,6 +110,10 @@ METRICS = {
     SERVING_KV_SPILL_BLOCKS: "prefix blocks parked in the host tier",
     SERVING_KV_SPILL_HITS: "admissions served from the spill tier",
     SERVING_KV_REHYDRATE: "spill-tier rehydrate upload latency",
+    SERVING_LATENCY_ATTRIBUTION:
+        "per-request latency by attribution bucket",
+    SERVING_SATURATION: "max cause-wise serving saturation (0..1)",
+    SERVING_SATURATION_CAUSE: "per-cause serving saturation (0..1)",
 }
 
 # tpu_-prefixed tokens that are NOT metric names (label keys, module

@@ -130,11 +130,10 @@ CAPTURE = ProfileCapture()
 
 
 def _parse_seconds(query):
-    for part in (query or "").split("&"):
-        key, _, value = part.partition("=")
-        if key == "seconds":
-            return float(value)
-    return DEFAULT_SECONDS
+    from .http import query_param
+
+    value = query_param(query, "seconds")
+    return DEFAULT_SECONDS if value is None else float(value)
 
 
 def profile_response(path, query=""):

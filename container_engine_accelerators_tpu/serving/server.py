@@ -50,6 +50,7 @@ from ..obs.efficiency import (
     transformer_decode_flops,
 )
 from ..obs.memory import get_monitor, install_postmortem_provider
+from ..obs.reqledger import RequestLedger, RequestTimeline, saturation
 from ..utils import env_number, env_str, get_logger
 
 log = get_logger("serving")
@@ -271,7 +272,7 @@ class _EngineWork:
                  "top_p", "min_p", "rep_pen", "eos_id", "want_lp",
                  "seed", "done", "stream_q", "ctx", "cancel", "slot",
                  "tokens", "lps", "score_only", "account",
-                 "submit_t", "last_tok_t", "no_prefix")
+                 "submit_t", "last_tok_t", "no_prefix", "timeline")
 
     def __init__(self, row, p_len, new, temperature, top_k, top_p,
                  min_p, rep_pen, eos_id, want_lp, seed, ctx,
@@ -307,6 +308,7 @@ class _EngineWork:
         self.no_prefix = no_prefix
         self.submit_t = None    # stamped at admission-queue entry
         self.last_tok_t = None  # previous token's delivery time
+        self.timeline = None    # attribution clock, set at submit
 
 
 class _EngineService:
@@ -368,6 +370,15 @@ class _EngineService:
         self._slo_ttft_s = _slo_threshold_s(SLO_TTFT_ENV)
         self._slo_tpot_s = _slo_threshold_s(SLO_TPOT_ENV)
         self._slo_violations = {"ttft": 0, "tpot": 0}
+        # Per-request latency attribution: the bounded ring of
+        # retired records behind /stats latency_attribution,
+        # /debug/requests, and the slo_report/slo_check tooling.
+        self._req_ledger = RequestLedger()
+        # Last step-boundary saturation snapshot (atomic swap; the
+        # loop thread writes, /stats reads) and the last admission
+        # blocker the loop observed (None / "slots" / "kv_blocks").
+        self._last_saturation = None
+        self._last_block_cause = None
         # Decode MFU: 2·N analytic FLOPs per active row per step,
         # rated against this process's device generation. The gauge
         # only appears when a peak is known (TPU generation table or
@@ -379,13 +390,19 @@ class _EngineService:
                 getattr(devices[0], "device_kind", None)),
             chips=len(devices), publish_every=32)
         self._memory = get_monitor()
+        from ..obs import postmortem
+        # Request-ledger flight-record state: a crash bundle then
+        # shows what the last retired requests spent their time on
+        # (the SLO postmortem's first question). Idempotent by name,
+        # like the block-pool provider below.
+        postmortem.register_state_provider(
+            "serving_requests", self._req_ledger.state)
         if getattr(engine, "paged", False):
             # Block-pool flight-record state: a crash/OOM bundle
             # (tpu_diagnose) then shows the tables and free list the
             # allocator died with. Idempotent by name — one provider
             # per process, last engine wins (servers are 1:1 with
             # engines in practice).
-            from ..obs import postmortem
             postmortem.register_state_provider(
                 "serving_kv_blocks", engine.block_pool_state)
         self._thread = threading.Thread(
@@ -406,12 +423,28 @@ class _EngineService:
                 return None
             for work in works:
                 work.submit_t = now  # TTFT clock starts at admission
+                # The attribution clock starts with it: everything
+                # until the admit call is queue_wait/block_wait.
+                work.timeline = RequestTimeline()
                 self._queue.put(work)
         return works
 
     def queue_depth(self):
         with self._lock:
             return self._queue.qsize() + len(self._pending)
+
+    def debug_requests(self, limit=64):
+        """The /debug/requests payload: the last ``limit`` retired
+        attribution records (newest first) plus the per-bucket
+        percentile summary — the live half of what the postmortem
+        ``serving_requests`` provider dumps at death."""
+        return {
+            "capacity": self._req_ledger.capacity,
+            "retired_total": self._req_ledger.retired_total(),
+            "latency_attribution":
+                self._req_ledger.attribution_stats(),
+            "records": self._req_ledger.records(limit),
+        }
 
     @staticmethod
     def _q_ms(hist, q):
@@ -452,6 +485,15 @@ class _EngineService:
                     "violations": violations,
                 },
                 "decode_mfu": self._mfu.mfu(),
+                # Per-request latency attribution (p50/p99 per
+                # bucket) + the cause-wise saturation signal plane
+                # the HPA/router scale and shed on.
+                "latency_attribution":
+                    self._req_ledger.attribution_stats(),
+                "saturation": (self._last_saturation
+                               or saturation(slots_active=active,
+                                             slots_total=eng.slots)),
+                "admission_blocked_on": self._last_block_cause,
                 # Paged-pool surface (absent on the dense fallback):
                 # block occupancy + prefix sharing effectiveness.
                 **(eng.kv_block_stats() or {}),
@@ -482,6 +524,14 @@ class _EngineService:
             # tpu_serving_kv_spill_hits_total deltas.
             self._engine.reset_prefix_counters()
             self._spill_hits_pub = 0
+            # Attribution/saturation state resets WITH the engine
+            # counters (the PR 11 spill-hit baseline bug class:
+            # stale state surviving a reset poisons the first
+            # post-reset window) — warm rows pass account=False and
+            # never enter the ledger, but belt-and-braces.
+            self._last_saturation = None
+            self._last_block_cause = None
+        self._req_ledger.reset()
         self._ttft_hist.reset()
         self._tpot_hist.reset()
         self._mfu.reset()
@@ -518,6 +568,19 @@ class _EngineService:
             self._slot_work.pop(work.slot, None)
             work.slot = None
         self._admission.release(1)
+        if work.timeline is not None and work.account:
+            # Close the attribution books: the residue (e.g. the gap
+            # between the last token and a cancel landing) laps into
+            # `other`, and the record's buckets sum to its wall time
+            # by construction. Warm rows (account=False) never enter
+            # the ledger — same discipline as the SLO histograms.
+            outcome = ("completed" if error is None
+                       else "cancelled" if error == "cancelled"
+                       else "error")
+            self._req_ledger.add(work.timeline.finish(
+                outcome, tokens=len(work.tokens),
+                stream=work.stream_q is not None,
+                prompt_len=work.p_len))
         with self._lock:
             self._retired += 1
         if work.stream_q is not None:
@@ -562,6 +625,21 @@ class _EngineService:
 
     def _deliver(self, work, tok, lp):
         work.tokens.append(tok)
+        if work.timeline is not None:
+            if len(work.tokens) == 1:
+                # TTFT endpoint; the time through here already lapped
+                # into prefill/rehydrate inside _admit.
+                work.timeline.note_first_token()
+            else:
+                # One token gap -> one bucket. A streaming row whose
+                # PREVIOUS tokens are still sitting unconsumed in its
+                # queue spent this gap bottlenecked on the client,
+                # not the engine (checked before this token's put).
+                work.timeline.lap(
+                    "stream_backpressure"
+                    if (work.stream_q is not None
+                        and work.stream_q.qsize() > 0)
+                    else "decode_gap")
         if work.account:
             # First token closes the TTFT clock (admission queue +
             # prefill); every later token is one TPOT observation
@@ -585,7 +663,46 @@ class _EngineService:
                 or len(work.tokens) >= work.new:
             self._finish(work)
 
+    def _publish_saturation(self, active):
+        """Compute + publish the cause-wise saturation signal at a
+        step boundary (loop thread only: _pending is the loop's).
+        The max-over-causes gauge (tpu_serving_saturation) is the
+        one HPA-ready number; the per-cause gauges name the starved
+        resource so a router can shed selectively."""
+        avail = self._engine.block_availability()
+        oldest = None
+        for waiting in self._pending:
+            t = waiting.timeline.submit_t
+            oldest = t if oldest is None else min(oldest, t)
+        sat = saturation(
+            slots_active=active, slots_total=self._engine.slots,
+            blocks_available=avail[0] if avail else None,
+            blocks_usable=avail[1] if avail else None,
+            oldest_wait_s=((time.perf_counter() - oldest)
+                           if oldest is not None else 0.0))
+        obs.gauge(metric_names.SERVING_SATURATION, sat["max"])
+        for cause, value in sat["causes"].items():
+            obs.gauge(metric_names.SERVING_SATURATION_CAUSE, value,
+                      cause=cause)
+        self._last_saturation = sat
+        return sat
+
+    def _attribute_rehydrate(self, timeline):
+        """Re-attribute the admission's spill-tier upload time out of
+        ``prefill`` into ``rehydrate``, fed from the engine's
+        ``drain_rehydrate_events()`` seam (rehydration only happens
+        inside admissions, so draining here catches every event; the
+        samples still feed the latency histogram)."""
+        events = self._engine.drain_rehydrate_events()
+        for dt in events:
+            self._rehydrate_hist.observe(dt)
+        if events:
+            timeline.move("prefill", "rehydrate", sum(events))
+
     def _admit(self, work):
+        # Close the final wait sliver (admissible since the last
+        # boundary lap) before the prefill clock opens.
+        work.timeline.lap("queue_wait")
         t0 = time.perf_counter()
         try:
             with obs.span("serving.prefill", parent=work.ctx,
@@ -593,6 +710,7 @@ class _EngineService:
                           phase="engine_admission"):
                 if work.score_only:
                     echo = self._engine.score(work.row, work.p_len)
+                    work.timeline.lap("prefill")
                     work.lps = list(echo[:work.p_len])
                     with self._lock:
                         self._admitted += 1
@@ -605,8 +723,16 @@ class _EngineService:
                     repetition_penalty=work.rep_pen, seed=work.seed,
                     max_new=work.new,
                     allow_prefix=self._allow_prefix(work))
+                work.timeline.lap("prefill")
+                self._attribute_rehydrate(work.timeline)
         except Exception as e:
             log.exception("engine admission failed")
+            work.timeline.lap("prefill")  # the failed attempt's time
+            # Drain here too: a failed admit may already have paid a
+            # rehydrate upload, and leaving its events in the seam
+            # would move the NEXT admission's prefill time into a
+            # rehydrate it never performed.
+            self._attribute_rehydrate(work.timeline)
             self._finish(work, error=str(e))
             return
         finally:
@@ -649,6 +775,7 @@ class _EngineService:
             # and slot-count-driven on the dense fallback. FIFO:
             # head-of-line waits rather than letting later small
             # requests starve a big one.
+            blocked_on = None
             while self._pending:
                 head = self._pending[0]
                 if head.cancel.is_set():
@@ -658,12 +785,26 @@ class _EngineService:
                 if head.score_only:
                     self._admit(self._pending.pop(0))
                     continue
-                if not self._engine.can_admit(
-                        head.row, head.p_len, head.new,
-                        allow_prefix=self._allow_prefix(head),
-                        repetition_penalty=head.rep_pen):
+                blocked_on = self._engine.admission_block_cause(
+                    head.row, head.p_len, head.new,
+                    allow_prefix=self._allow_prefix(head),
+                    repetition_penalty=head.rep_pen)
+                if blocked_on is not None:
                     break
                 self._admit(self._pending.pop(0))
+            self._last_block_cause = blocked_on
+            if self._pending:
+                # Wait-time attribution, sliced per boundary by the
+                # cause observed NOW: while the head is starved of KV
+                # blocks the whole FIFO is block-waiting (nothing
+                # behind it may pass, by design); any other wait is
+                # queue_wait. Successive laps time-slice a request's
+                # wait across changing causes.
+                bucket = ("block_wait" if blocked_on == "kv_blocks"
+                          else "queue_wait")
+                lap_now = time.perf_counter()
+                for waiting in self._pending:
+                    waiting.timeline.lap(bucket, lap_now)
             if not self._slot_work:
                 if self._pending:
                     # Head blocked on KV blocks with NOTHING active:
@@ -671,7 +812,12 @@ class _EngineService:
                     # external event (cancel, stop) changes
                     # admissibility — wait briefly instead of
                     # busy-re-planning the head's admission (a full
-                    # prefix-index lookup) in a zero-sleep spin.
+                    # prefix-index lookup) in a zero-sleep spin. The
+                    # saturation gauges must keep publishing HERE —
+                    # a fully wedged pool is their most-load-bearing
+                    # reading.
+                    self._publish_saturation(
+                        self._engine.active_count())
                     self._stop.wait(0.05)
                 continue
             active = self._engine.active_count()
@@ -695,6 +841,7 @@ class _EngineService:
             obs.gauge(metric_names.SERVING_SLOTS_ACTIVE, active)
             obs.gauge(metric_names.SERVING_SLOTS_FREE,
                       self._engine.slots - active)
+            self._publish_saturation(active)
             kv = self._engine.kv_block_stats()
             if kv is not None:
                 # Host-integer reads — no device sync rides on these.
@@ -820,6 +967,14 @@ class _BaseServer:
                                      str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif path == "/debug/requests":
+                    # Per-request latency attribution ring (engine-
+                    # mode generation servers; 404 elsewhere).
+                    payload = server._debug_requests(query)
+                    if payload is None:
+                        self._reply(404, {"error": "not found"})
+                    else:
+                        self._reply(200, payload)
                 elif self.path == "/healthz":
                     if server._ready.is_set():
                         self._reply(200, {"status": "ok",
@@ -920,6 +1075,12 @@ class _BaseServer:
         """Subclass hook: shape/config facts for the model-status
         endpoint."""
         return {}
+
+    def _debug_requests(self, query):
+        """Subclass hook for /debug/requests (None = 404): the
+        per-request latency-attribution ring. Only engine-mode
+        generation servers carry one."""
+        return None
 
     @property
     def port(self):
@@ -1983,6 +2144,19 @@ class GenerationServer(_BaseServer):
                     admission=self._admission)
                 self._batchers[key] = batcher
             return batcher
+
+    def _debug_requests(self, query):
+        """/debug/requests: the engine service's retired-record ring
+        (`?n=` caps the dump, default 64). Batch-mode servers have no
+        per-request attribution — they 404 like non-LM servers."""
+        if self._engine_service is None:
+            return None
+        from ..obs.http import query_param
+        try:
+            limit = max(0, int(query_param(query, "n", 64)))
+        except (TypeError, ValueError):
+            limit = 64  # keep the default on junk input
+        return self._engine_service.debug_requests(limit)
 
     def _extra_stats(self):
         """Decode-batch occupancy: rows served per compiled call —

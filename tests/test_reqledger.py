@@ -1,0 +1,192 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Per-request attribution ledger + saturation math (obs.reqledger).
+
+Pure host-clock unit tests (jax-free, like the module): the
+sum-to-wall partition under a fake clock, the record ring bound, the
+reset seam, and the saturation formula at the slots/blocks/queue
+corners the serving loop publishes from.
+"""
+
+import json
+
+import pytest
+
+from container_engine_accelerators_tpu.obs import Tracer
+from container_engine_accelerators_tpu.obs.reqledger import (
+    ATTRIBUTION_BUCKETS,
+    RequestLedger,
+    RequestTimeline,
+    saturation,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_timeline_laps_partition_wall_exactly():
+    clk = FakeClock()
+    tl = RequestTimeline(clock=clk)
+    clk.t = 0.5
+    tl.lap("queue_wait")
+    clk.t = 2.5
+    tl.lap("block_wait")
+    clk.t = 2.6
+    tl.lap("prefill")
+    tl.note_first_token()
+    clk.t = 3.0
+    tl.lap("decode_gap")
+    clk.t = 3.1
+    rec = tl.finish("completed", tokens=4, prompt_len=6)
+    assert rec["wall_s"] == pytest.approx(3.1)
+    assert rec["buckets"]["queue_wait"] == pytest.approx(0.5)
+    assert rec["buckets"]["block_wait"] == pytest.approx(2.0)
+    assert rec["buckets"]["prefill"] == pytest.approx(0.1)
+    assert rec["buckets"]["decode_gap"] == pytest.approx(0.4)
+    assert rec["buckets"]["other"] == pytest.approx(0.1)  # residue
+    # The serialized record honors the same invariant the floats do.
+    assert sum(rec["buckets"].values()) == pytest.approx(
+        rec["wall_s"], abs=1e-9)
+    assert rec["ttft_s"] == pytest.approx(2.6)
+    assert rec["outcome"] == "completed"
+    assert rec["tokens"] == 4 and rec["prompt_len"] == 6
+    assert set(rec["buckets"]) == set(ATTRIBUTION_BUCKETS)
+    json.dumps(rec)  # JSON-safe by contract
+
+
+def test_timeline_move_reattributes_and_clamps():
+    clk = FakeClock()
+    tl = RequestTimeline(clock=clk)
+    clk.t = 1.0
+    tl.lap("prefill")
+    # The rehydrate seam: measured upload time moves out of prefill.
+    assert tl.move("prefill", "rehydrate", 0.25) == pytest.approx(0.25)
+    assert tl.buckets["prefill"] == pytest.approx(0.75)
+    # Clamped: a mismeasured (too-large) move cannot break the
+    # partition.
+    assert tl.move("prefill", "rehydrate", 5.0) == pytest.approx(0.75)
+    rec = tl.finish("completed", now=1.0)
+    assert rec["buckets"]["rehydrate"] == pytest.approx(1.0)
+    assert sum(rec["buckets"].values()) == pytest.approx(1.0)
+
+
+def test_timeline_cancel_residue_lands_in_other():
+    clk = FakeClock()
+    tl = RequestTimeline(clock=clk)
+    clk.t = 0.2
+    tl.lap("prefill")
+    tl.note_first_token()
+    clk.t = 0.9  # cancel lands mid-stream, after the last token
+    rec = tl.finish("cancelled", tokens=1, stream=True)
+    assert rec["outcome"] == "cancelled" and rec["stream"]
+    assert rec["buckets"]["other"] == pytest.approx(0.7)
+    assert sum(rec["buckets"].values()) == pytest.approx(
+        rec["wall_s"])
+
+
+def _record(wall=1.0, **buckets):
+    clk = FakeClock()
+    tl = RequestTimeline(clock=clk)
+    for bucket, dt in buckets.items():
+        clk.t += dt
+        tl.lap(bucket)
+    clk.t = wall
+    return tl.finish("completed", now=clk.t)
+
+
+def test_ledger_ring_bound_and_totals():
+    led = RequestLedger(capacity=4, tracer=Tracer(enabled=False))
+    for i in range(7):
+        led.add(_record(wall=1.0 + i, queue_wait=0.5))
+    assert led.retired_total() == 7
+    records = led.records()
+    assert len(records) == 4  # the ring bound
+    # Newest first: the most recent wall is 7.0.
+    assert records[0]["wall_s"] == pytest.approx(7.0)
+    assert records[-1]["wall_s"] == pytest.approx(4.0)
+    assert len(led.records(limit=2)) == 2
+    state = led.state(max_rows=3)
+    assert state["capacity"] == 4
+    assert state["retired_total"] == 7
+    assert len(state["records"]) == 3
+
+
+def test_ledger_attribution_stats_and_reset():
+    led = RequestLedger(capacity=8, tracer=Tracer(enabled=False))
+    led.add(_record(wall=1.0, block_wait=0.8, prefill=0.1))
+    stats = led.attribution_stats()
+    assert set(stats) == set(ATTRIBUTION_BUCKETS)
+    assert stats["block_wait"]["count"] == 1
+    assert stats["block_wait"]["total_s"] == pytest.approx(0.8)
+    assert stats["block_wait"]["p99_ms"] is not None
+    # The reset seam (reset_counters rides it): ring, totals, and
+    # histograms all zero IN PLACE.
+    led.reset()
+    assert led.retired_total() == 0
+    assert led.records() == []
+    stats = led.attribution_stats()
+    assert all(s["count"] == 0 and s["p99_ms"] is None
+               for s in stats.values())
+
+
+def test_saturation_slots_corners():
+    empty = saturation(slots_active=0, slots_total=8,
+                       queue_horizon_s=1.0)
+    assert empty["causes"]["slots"] == 0.0
+    assert empty["max"] == 0.0
+    full = saturation(slots_active=8, slots_total=8,
+                      queue_horizon_s=1.0)
+    assert full["causes"]["slots"] == 1.0
+    assert full["max"] == 1.0
+    # Dense pool: no kv_blocks cause at all (absent, not 0 — a
+    # router must not read "not applicable" as "healthy samples").
+    assert "kv_blocks" not in empty["causes"]
+
+
+def test_saturation_block_corners_dominate_max():
+    # Block-starved at low slot occupancy: max-over-causes must
+    # surface the starvation an average would hide.
+    sat = saturation(slots_active=2, slots_total=16,
+                     blocks_available=0, blocks_usable=40,
+                     queue_horizon_s=1.0)
+    assert sat["causes"]["kv_blocks"] == 1.0
+    assert sat["causes"]["slots"] == pytest.approx(0.125)
+    assert sat["max"] == 1.0
+    idle = saturation(slots_active=0, slots_total=16,
+                      blocks_available=40, blocks_usable=40,
+                      queue_horizon_s=1.0)
+    assert idle["causes"]["kv_blocks"] == 0.0
+
+
+def test_saturation_queue_age_corners():
+    sat = saturation(slots_active=0, slots_total=1,
+                     oldest_wait_s=0.5, queue_horizon_s=1.0)
+    assert sat["causes"]["queue_age"] == pytest.approx(0.5)
+    # Clamped at the horizon; disarmed (<= 0 horizon) reads 0.
+    over = saturation(slots_active=0, slots_total=1,
+                      oldest_wait_s=9.0, queue_horizon_s=1.0)
+    assert over["causes"]["queue_age"] == 1.0
+    off = saturation(slots_active=0, slots_total=1,
+                     oldest_wait_s=9.0, queue_horizon_s=0.0)
+    assert off["causes"]["queue_age"] == 0.0
+    # Empty queue: 0 whatever the horizon.
+    none = saturation(slots_active=0, slots_total=1,
+                      oldest_wait_s=None, queue_horizon_s=1.0)
+    assert none["causes"]["queue_age"] == 0.0

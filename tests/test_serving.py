@@ -190,6 +190,41 @@ def test_generate_span_tree_on_debug_trace(lm_server):
     assert "tpu_serving_slot_occupancy_bucket" in text
 
 
+def test_debug_requests_endpoint(lm_server):
+    """/debug/requests over real HTTP: engine-mode servers dump the
+    retired attribution ring (balanced records, ?n= honored); /stats
+    carries latency_attribution + the saturation plane. The
+    service-level contracts live in test_slo_attribution.py."""
+    post(lm_server, "/v1/models/lm:generate",
+         {"prompts": [[2, 4, 6]], "max_new_tokens": 4})
+    with urllib.request.urlopen(
+            f"http://localhost:{lm_server.port}/debug/requests?n=1",
+            timeout=10) as resp:
+        payload = json.loads(resp.read())
+    assert payload["retired_total"] >= 1
+    assert len(payload["records"]) == 1
+    rec = payload["records"][0]
+    assert rec["outcome"] == "completed"
+    assert abs(sum(rec["buckets"].values()) - rec["wall_s"]) \
+        <= max(0.01 * rec["wall_s"], 2e-5)
+    with urllib.request.urlopen(
+            f"http://localhost:{lm_server.port}/stats",
+            timeout=10) as resp:
+        stats = json.loads(resp.read())
+    assert "latency_attribution" in stats
+    assert 0.0 <= stats["saturation"]["max"] <= 1.0
+
+
+def test_debug_requests_404_off_engine(server):
+    """Non-engine servers (here: the image InferenceServer) have no
+    attribution ring — the endpoint 404s instead of faking one."""
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(
+            f"http://localhost:{server.port}/debug/requests",
+            timeout=10)
+    assert err.value.code == 404
+
+
 def test_generate_cross_request_sharing_on_engine():
     """Concurrent generate requests — different temperatures,
     different true prompt lengths, different BUCKETS — share the one

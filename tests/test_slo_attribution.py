@@ -1,0 +1,283 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Per-request latency attribution on the REAL engine service.
+
+Drives _EngineService + SlotDecodeEngine directly (no HTTP; the
+serving loop's HTTP tests live in test_serving.py) and pins the
+reqledger contracts on real traffic: buckets sum to wall within 1%,
+injected KV-block starvation comes back attributed to block_wait
+(not smeared into queue_wait), cancel-mid-stream retires a balanced
+record, /debug/requests has its documented shape and ring bound, and
+reset_counters zeroes every piece of attribution/saturation state —
+all while greedy streams stay token-identical to per-request
+decode() (the instrumentation is host clocks only).
+"""
+
+import queue
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import TransformerLM
+from container_engine_accelerators_tpu.models.decode import (
+    SlotDecodeEngine,
+    decode,
+)
+from container_engine_accelerators_tpu.serving.server import (
+    _Admission,
+    _EngineService,
+    _EngineWork,
+)
+
+# The retired records round to microseconds; a sub-ms request's
+# rounding residue must not read as a sum-to-wall violation.
+SUM_TOL_ABS = 2e-5
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def service(lm):
+    """One warmed paged-engine service shared by the non-starved
+    tests (each compiles nothing beyond the module's first use)."""
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                           paged=True, kv_block_size=4, buckets=[8],
+                           kv_quant="bf16", kv_spill=False)
+    svc = _EngineService(eng, _Admission(0))
+    yield svc
+    svc.stop()
+
+
+def _work(prompt, p_len, new, **kw):
+    row = np.zeros((8,), np.int32)
+    row[:p_len] = prompt[:p_len]
+    return _EngineWork(row, p_len, new, 0.0, 0, 1.0, 0.0, 1.0, -1,
+                       False, 0, None, **kw)
+
+
+def _run(svc, works, timeout=300):
+    assert svc.submit_many(works) is not None
+    for w in works:
+        status, out = w.done.get(timeout=timeout)
+        assert status == "ok", out
+
+
+def _assert_balanced(record):
+    total = sum(record["buckets"].values())
+    assert abs(total - record["wall_s"]) <= max(
+        0.01 * record["wall_s"], SUM_TOL_ABS), record
+
+
+def test_attribution_sums_to_wall_on_real_traffic(lm, service):
+    """Real engine traffic: every retired record is a partition of
+    its wall time, TTFT is inside the wall, and the greedy streams
+    are untouched by the instrumentation."""
+    model, params = lm
+    service.reset_counters()
+    prompts = [np.array([1, 2, 3, 4], np.int32),
+               np.array([9, 8, 7, 6, 5, 4], np.int32),
+               np.array([11, 12], np.int32)]
+    news = [5, 4, 6]
+    works = [_work(p, len(p), n) for p, n in zip(prompts, news)]
+    _run(service, works)
+
+    # Exactness oracle: per-request decode() at the widest horizon.
+    width = max(len(p) for p in prompts)
+    padded = np.zeros((len(prompts), width), np.int32)
+    p_lens = np.zeros((len(prompts),), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :len(p)] = p
+        p_lens[i] = len(p)
+    ref = np.asarray(decode(model, params, jnp.asarray(padded),
+                            max(news), prompt_len=p_lens,
+                            fast_prefill=False))
+    for i, (w, p, n) in enumerate(zip(works, prompts, news)):
+        assert w.tokens == ref[i, len(p):len(p) + n].tolist()
+
+    records = service.debug_requests()["records"]
+    assert len(records) == 3
+    for rec in records:
+        _assert_balanced(rec)
+        assert rec["outcome"] == "completed"
+        assert rec["ttft_s"] is not None
+        assert rec["ttft_s"] <= rec["wall_s"] + 1e-6
+    by_tokens = sorted(r["tokens"] for r in records)
+    assert by_tokens == sorted(news)
+
+    stats = service.stats()
+    attribution = stats["latency_attribution"]
+    assert attribution["prefill"]["count"] == 3
+    assert attribution["prefill"]["total_s"] > 0
+    sat = stats["saturation"]
+    assert 0.0 <= sat["max"] <= 1.0
+    assert "kv_blocks" in sat["causes"]  # the paged pool's cause
+
+
+def test_block_starvation_attributes_block_wait(lm):
+    """Injected starvation: an arena holding ONE worst-case row
+    under three free slots serializes admissions — the queued
+    requests' waits must land in block_wait (the engine names
+    kv_blocks, not slots), and the saturation plane must read it."""
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=3, slot_len=16,
+                           paged=True, kv_block_size=4, kv_blocks=5,
+                           buckets=[8], kv_quant="bf16",
+                           kv_spill=False)
+    assert eng.admission_block_cause(
+        np.arange(1, 5, dtype=np.int32), 4) is None
+    svc = _EngineService(eng, _Admission(0))
+    try:
+        # No max_new bound -> each row reserves the worst case
+        # (slot_len), which IS the whole arena: strict serialization.
+        works = [_work(np.arange(1, 5, dtype=np.int32) + i, 4, 12)
+                 for i in range(3)]
+        _run(svc, works)
+        records = svc.debug_requests()["records"]
+        assert len(records) == 3
+        for rec in records:
+            _assert_balanced(rec)
+        # Newest-first: the LAST retired request waited through both
+        # predecessors' full runs — block-starved, not slot-starved.
+        starved = records[0]
+        assert starved["buckets"]["block_wait"] > 0
+        assert (starved["buckets"]["block_wait"]
+                > starved["buckets"]["queue_wait"])
+        assert (starved["buckets"]["block_wait"]
+                > starved["buckets"]["prefill"])
+        sat = svc.stats()["saturation"]
+        # The arena stayed fully reserved through the drain.
+        assert sat["causes"]["kv_blocks"] >= 0.0
+        assert svc.stats()["admission_blocked_on"] in (
+            None, "kv_blocks")
+    finally:
+        svc.stop()
+
+
+def test_engine_names_the_starved_resource(lm):
+    """admission_block_cause: slots when the pool is full, kv_blocks
+    when slots are free but the arena cannot reserve the span."""
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=1, slot_len=16,
+                           paged=True, kv_block_size=4, buckets=[8],
+                           kv_quant="bf16", kv_spill=False)
+    row = np.arange(1, 5, dtype=np.int32)
+    eng.admit(row, 4, max_new=2)
+    assert eng.admission_block_cause(row, 4, 2) == "slots"
+    avail, usable = eng.block_availability()
+    assert 0 <= avail <= usable
+    eng2 = SlotDecodeEngine(model, params, slots=3, slot_len=16,
+                            paged=True, kv_block_size=4, kv_blocks=5,
+                            buckets=[8], kv_quant="bf16",
+                            kv_spill=False)
+    eng2.admit(row, 4)  # worst-case reservation takes the arena
+    assert eng2.admission_block_cause(row, 4) == "kv_blocks"
+    assert not eng2.can_admit(row, 4)
+    # Dense pool: no block cause, no availability surface.
+    eng3 = SlotDecodeEngine(model, params, slots=1, slot_len=16,
+                            paged=False)
+    assert eng3.block_availability() is None
+    assert eng3.admission_block_cause(row, 4) is None
+
+
+def test_cancel_mid_stream_retires_balanced_record(lm, service):
+    """A stream cancelled mid-flight still retires a record whose
+    buckets partition its wall (the residue lands in `other`), with
+    outcome `cancelled` and the stream flag set."""
+    service.reset_counters()
+    stream_q = queue.Queue()
+    prompt = np.array([3, 1, 4, 1], np.int32)
+    work = _EngineWork(
+        np.concatenate([prompt, np.zeros((4,), np.int32)]), 4, 12,
+        0.0, 0, 1.0, 0.0, 1.0, -1, False, 0, None, stream_q=stream_q)
+    assert service.submit_many([work]) is not None
+    got = 0
+    while got < 2:
+        item = stream_q.get(timeout=120)
+        assert item[0] == "tok", item
+        got += 1
+    work.cancel.set()
+    # Drain to the terminal item the retire pushes.
+    deadline = time.monotonic() + 120
+    while True:
+        item = stream_q.get(timeout=max(1, deadline - time.monotonic()))
+        if item[0] != "tok":
+            break
+    assert item == ("error", "cancelled")
+    rec = service.debug_requests()["records"][0]
+    assert rec["outcome"] == "cancelled"
+    assert rec["stream"] is True
+    assert rec["tokens"] >= 2
+    _assert_balanced(rec)
+
+
+def test_debug_requests_shape_and_ring_bound(lm, monkeypatch):
+    """The documented /debug/requests payload shape, the ?n= cap,
+    and the CEA_TPU_REQ_LEDGER_CAP ring bound."""
+    monkeypatch.setenv("CEA_TPU_REQ_LEDGER_CAP", "2")
+    model, params = lm
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                           paged=True, kv_block_size=4, buckets=[8],
+                           kv_quant="bf16", kv_spill=False)
+    svc = _EngineService(eng, _Admission(0))
+    try:
+        works = [_work(np.arange(1, 5, dtype=np.int32) + i, 4, 2)
+                 for i in range(3)]
+        _run(svc, works)
+        payload = svc.debug_requests()
+        assert payload["capacity"] == 2
+        assert payload["retired_total"] == 3
+        assert len(payload["records"]) == 2  # the ring bound
+        assert set(payload["latency_attribution"]) >= {
+            "queue_wait", "block_wait", "prefill", "rehydrate",
+            "decode_gap", "stream_backpressure", "other"}
+        for rec in payload["records"]:
+            assert {"submit_unix", "wall_s", "buckets", "outcome",
+                    "tokens", "stream", "ttft_s",
+                    "prompt_len"} <= set(rec)
+        assert len(svc.debug_requests(limit=1)["records"]) == 1
+    finally:
+        svc.stop()
+
+
+def test_reset_counters_zeroes_attribution_and_saturation(lm,
+                                                          service):
+    """The PR 11 bug class, pinned: reset_counters must zero the
+    attribution ring, the per-bucket histograms, and the saturation
+    snapshot alongside the engine counters."""
+    _run(service, [_work(np.array([7, 7, 2, 9], np.int32), 4, 3)])
+    assert service.debug_requests()["retired_total"] >= 1
+    service.reset_counters()
+    payload = service.debug_requests()
+    assert payload["retired_total"] == 0
+    assert payload["records"] == []
+    stats = service.stats()
+    assert all(v["count"] == 0 and v["total_s"] == 0.0
+               for v in stats["latency_attribution"].values())
+    assert stats["admission_blocked_on"] is None
+    # The snapshot dropped with the reset; stats falls back to a
+    # freshly computed slots-only view until the loop republishes.
+    assert 0.0 <= stats["saturation"]["max"] <= 1.0

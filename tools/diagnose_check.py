@@ -106,7 +106,26 @@ _CHILD_JOURNAL_CODE = (
     "obs.event('placement.repartition_proposed', proposal='2x2',\n"
     "          fragmentation=0.5, current_shape='4x1')\n"
     "obs.event('placement.repartition_applied', old_shape='4x1',\n"
-    "          new_shape='2x2', subslices=4)\n")
+    "          new_shape='2x2', subslices=4)\n"
+    # Requests-section fodder: one seeded SLOW request (2.0s of
+    # block_wait against 0.5s of everything else) retired into a
+    # RequestLedger whose state rides the serving_requests
+    # postmortem provider — the exact shape _EngineService registers.
+    # The bundle must rank the record's TTFT tail to block_wait.
+    "from container_engine_accelerators_tpu.obs import (\n"
+    "    postmortem, reqledger)\n"
+    "led = reqledger.RequestLedger(capacity=8)\n"
+    "t = [0.0]\n"
+    "tl = reqledger.RequestTimeline(clock=lambda: t[0])\n"
+    "t[0] = 2.0; tl.lap('block_wait')\n"
+    "t[0] = 2.1; tl.lap('prefill')\n"
+    "tl.note_first_token()\n"
+    "t[0] = 2.5; tl.lap('decode_gap')\n"
+    "led.add(tl.finish('completed', tokens=5, prompt_len=8,\n"
+    "                  now=t[0]))\n"
+    "postmortem.register_state_provider('serving_requests',\n"
+    "                                   led.state)\n"
+    "postmortem.capture('diagnose-check-seed')\n")
 
 
 def fake_node(root):
@@ -348,6 +367,26 @@ def main():
             failures.append(
                 f"placement events missing or out of timeline "
                 f"order: {pev_names}")
+        # Requests section: the child's seeded slow request must come
+        # back ATTRIBUTED — counted, sum-to-wall clean, and its TTFT
+        # tail ranked to the block_wait its timeline was stamped with.
+        requests_sec = bundle.get("requests") or {}
+        if requests_sec.get("records") != 1:
+            failures.append(
+                f"requests section lost the seeded record: "
+                f"{requests_sec!r}")
+        else:
+            rep = requests_sec.get("report") or {}
+            if (rep.get("sum_to_wall") or {}).get("violations"):
+                failures.append(
+                    f"seeded record violates sum-to-wall: "
+                    f"{rep['sum_to_wall']!r}")
+            ranked = ((rep.get("ttft") or {}).get("tail")
+                      or {}).get("ranked") or []
+            if not ranked or ranked[0].get("bucket") != "block_wait":
+                failures.append(
+                    f"seeded slow request not attributed to "
+                    f"block_wait: {ranked!r}")
         # Perf section: the seeded ledger row must come back as a
         # rendered trend (rows counted, source present, series
         # keyed under a rig fingerprint label).

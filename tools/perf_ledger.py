@@ -130,6 +130,11 @@ METRIC_DIRECTIONS = {
     "kv_block_utilization": "up",
     "kv_spill_hit_rate": "up",
     "batch_occupancy_avg": "up",
+    # slo_check: scale-free attribution/saturation trend metrics —
+    # a DROP means the injected starvation stopped being named
+    # (attribution leak) or sensed (signal-plane regression).
+    "block_wait_tail_share": "up",
+    "saturation_under_starvation": "up",
     "decode_tokens_per_sec": "up",
     "tflops": "up",
     "tflops_net": "up",

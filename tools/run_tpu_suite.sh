@@ -177,6 +177,20 @@ timeout -k 10 120 python tools/perf_ledger.py check \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "perf-check gate"
 
+# Latency-attribution preflight (CPU fake backend, ~1 min): an
+# injected KV-block starvation replay through the instrumented
+# serving loop must attribute its TTFT tail to block_wait, every
+# retired record's buckets must sum to its wall time within 1%, the
+# saturation plane must read block-starved, and greedy streams must
+# stay token-identical to decode(). A regression here means the
+# serving sections below would capture tail latencies nothing can
+# explain — and the HPA signal ROADMAP items 2-3 route on is blind.
+echo "[suite] slo-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/slo_check.py --ledger PERF_LEDGER.json \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "slo-check preflight"
+
 # Analysis preflight (CPU, ~3 min): zero lint findings on the tree
 # (with every seeded fixture violation firing), a clean lock-order
 # sanitizer pass over the engine/elastic/placement suites, and the

@@ -1,0 +1,315 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Latency-attribution gate (`make slo-check`).
+
+Replays a synthetic greedy trace through the REAL instrumented
+serving loop (_EngineService + SlotDecodeEngine) with **injected
+KV-block starvation**: the paged arena is sized for ~2 worst-case
+rows under 4 slots, so admission is block-bound, the queue backs up,
+and the TTFT tail is manufactured by exactly the cause the
+attribution ledger exists to name. Fails unless:
+
+  1. every request completes and every greedy stream is
+     token-identical to per-request ``decode()`` — the
+     instrumentation must not perturb the engine (host clocks only);
+  2. every retired record's buckets sum to its wall time within 1%
+     (the reqledger sum-to-wall contract, audited by
+     tools/slo_report.py over the real records);
+  3. the TTFT tail's top-ranked attribution bucket is ``block_wait``
+     — the injected starvation must come back NAMED, not smeared
+     into queue_wait/other;
+  4. the ``tpu_serving_saturation`` signal read block-starved
+     (kv_blocks cause >= --saturation-floor) while the queue was
+     backed up — the HPA/router gauge must fire exactly when the
+     resource it names is exhausted.
+
+The engine warms its three programs (one bucket) before the replay
+so compile time cannot masquerade as the tail cause; warm traffic is
+dropped via reset_counters (which this gate therefore also
+exercises).
+
+``--fast`` is the presubmit leg (fewer requests, same assertions);
+``--ledger`` appends scale-free trend metrics through
+tools/perf_ledger.py — shares and saturations, deliberately NOT
+wall-clock milliseconds, which on a CPU rig vary far past the
+perf-check tolerance and would gate on noise:
+
+  * ``block_wait_tail_share`` (up) — the injected cause's share of
+    the TTFT tail; a drop means attribution is leaking into other
+    buckets;
+  * ``saturation_under_starvation`` (up) — the max kv_blocks
+    saturation sampled while starved; a drop means the signal plane
+    stopped reading the exhaustion it was pointed at.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+import slo_report
+
+
+def build_trace(args, rng):
+    """Greedy requests with suffix widths from a small set (one
+    compiled prefill program via the engine bucket) and varied
+    budgets, all submitted at t=0 — the queue IS the experiment."""
+    trace = []
+    for _ in range(args.requests):
+        p_len = int(rng.choice((4, 6, args.prompt_len)))
+        new = int(rng.integers(2, args.max_new + 1))
+        prompt = rng.integers(1, args.vocab_size,
+                              size=(p_len,)).astype(np.int32)
+        trace.append({"p_len": p_len, "new": new, "prompt": prompt})
+    return trace
+
+
+def reference_streams(model, params, trace):
+    """Per-request greedy decode() reference — the exactness oracle
+    every engine/serving gate shares."""
+    from container_engine_accelerators_tpu.models.decode import decode
+
+    width = max(r["p_len"] for r in trace)
+    prompts = np.zeros((len(trace), width), np.int32)
+    p_lens = np.zeros((len(trace),), np.int32)
+    for i, r in enumerate(trace):
+        prompts[i, :r["p_len"]] = r["prompt"]
+        p_lens[i] = r["p_len"]
+    widest = max(r["new"] for r in trace)
+    ref = np.asarray(decode(model, params, jnp.asarray(prompts),
+                            widest, prompt_len=p_lens,
+                            fast_prefill=False))
+    return [ref[i, r["p_len"]:r["p_len"] + r["new"]].tolist()
+            for i, r in enumerate(trace)]
+
+
+def run_starved(model, params, trace, args):
+    """The instrumented replay: warm, reset, submit everything, and
+    sample the saturation plane while the works drain."""
+    from container_engine_accelerators_tpu.models.decode import (
+        SlotDecodeEngine,
+    )
+    from container_engine_accelerators_tpu.serving.server import (
+        _Admission,
+        _EngineService,
+        _EngineWork,
+    )
+
+    bs = args.kv_block_size
+    slot_len = -(-(args.prompt_len + args.max_new) // bs) * bs
+    n_blk = slot_len // bs
+    # The injection: usable blocks for ~2 worst-case rows under 4
+    # slots — free slots exist, the arena is the binding constraint,
+    # so every wait the tail accumulates is by construction
+    # block_wait.
+    kv_blocks = args.starved_rows * n_blk + 1
+    engine = SlotDecodeEngine(model, params, args.slots, slot_len,
+                              paged=True, kv_block_size=bs,
+                              kv_blocks=kv_blocks,
+                              buckets=[args.prompt_len],
+                              kv_quant="bf16", kv_spill=False)
+    svc = _EngineService(engine, _Admission(0))
+    try:
+        # Warm the three engine programs so compile time cannot pose
+        # as the tail's cause, then drop the warm traffic — the same
+        # discipline (and the same reset seam) GenerationServer uses.
+        warm = _EngineWork(np.zeros((args.prompt_len,), np.int32),
+                           args.prompt_len, 2, 0.0, 0, 1.0, 0.0, 1.0,
+                           -1, False, 0, None, account=False,
+                           no_prefix=True)
+        if svc.submit_many([warm]) is None:
+            raise RuntimeError("warm work shed")
+        status, out = warm.done.get(timeout=600)
+        if status != "ok":
+            raise RuntimeError(f"warm decode failed: {out}")
+        svc.reset_counters()
+
+        works = [
+            _EngineWork(r["prompt"], r["p_len"], r["new"], 0.0, 0,
+                        1.0, 0.0, 1.0, -1, False, i, None)
+            for i, r in enumerate(trace)]
+        if svc.submit_many(works) is None:
+            raise RuntimeError("trace shed by admission control")
+        outputs = [None] * len(works)
+        errors = []
+        pending = set(range(len(works)))
+        max_kv_sat = 0.0
+        max_sat = 0.0
+        deadline = time.monotonic() + 600
+        while pending:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"replay timed out with {len(pending)} requests "
+                    f"in flight")
+            sat = svc.stats()["saturation"]
+            max_sat = max(max_sat, sat["max"])
+            max_kv_sat = max(max_kv_sat,
+                             sat["causes"].get("kv_blocks", 0.0))
+            for i in list(pending):
+                try:
+                    status, out = works[i].done.get_nowait()
+                except Exception:
+                    continue
+                pending.discard(i)
+                if status != "ok":
+                    errors.append((i, out))
+                else:
+                    outputs[i] = works[i].tokens
+            time.sleep(0.002)
+        records = svc.debug_requests(limit=2 * len(works))["records"]
+        stats = svc.stats()
+    finally:
+        svc.stop()
+    return outputs, errors, records, {
+        "max_saturation": round(max_sat, 4),
+        "max_kv_blocks_saturation": round(max_kv_sat, 4),
+        "final_attribution": stats["latency_attribution"],
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--requests", type=int, default=None,
+                   help="trace size (default 16; 6 with --fast)")
+    p.add_argument("--fast", action="store_true",
+                   help="the presubmit leg: a smaller trace, same "
+                        "assertions")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--starved-rows", type=int, default=2,
+                   help="worst-case rows the injected arena holds "
+                        "(< slots: blocks, not slots, must bind)")
+    p.add_argument("--prompt-len", type=int, default=8,
+                   help="widest prompt = the one engine bucket")
+    p.add_argument("--max-new", type=int, default=8)
+    p.add_argument("--kv-block-size", type=int, default=4)
+    p.add_argument("--vocab-size", type=int, default=64)
+    p.add_argument("--embed-dim", type=int, default=32)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--saturation-floor", type=float, default=0.9)
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append the scale-free trend metrics to the "
+                        "perf ledger (source slo_check)")
+    args = p.parse_args(argv)
+    if args.requests is None:
+        args.requests = 6 if args.fast else 16
+    if args.starved_rows >= args.slots:
+        p.error("--starved-rows must be < --slots (the check injects "
+                "BLOCK starvation, not slot starvation)")
+
+    import perf_ledger
+
+    perf_ledger.ensure_backend_or_skip("slo_check", args.ledger)
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=args.vocab_size, embed_dim=args.embed_dim,
+        num_layers=args.num_layers, num_heads=args.num_heads,
+        max_seq_len=args.prompt_len + args.max_new + args.kv_block_size,
+        dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    trace = build_trace(args, np.random.default_rng(args.seed))
+    ref = reference_streams(model, params, trace)
+    outputs, errors, records, sat = run_starved(model, params, trace,
+                                                args)
+
+    report = slo_report.analyze(records)
+    ranked = ((report.get("ttft") or {}).get("tail") or {}).get(
+        "ranked") or []
+    summary = {
+        "platform": jax.devices()[0].platform,
+        "config": {k: getattr(args, k) for k in
+                   ("requests", "slots", "starved_rows", "prompt_len",
+                    "max_new", "kv_block_size", "seed", "fast")},
+        "records": len(records),
+        "sum_to_wall": report.get("sum_to_wall"),
+        "ttft_tail_ranked": ranked,
+        **sat,
+    }
+    print(json.dumps(summary))
+
+    if errors:
+        print(f"[slo] FAIL: {len(errors)} request(s) errored: "
+              f"{errors[:3]}", file=sys.stderr)
+        return 1
+    mismatched = [i for i, (out, want) in enumerate(zip(outputs, ref))
+                  if out != want]
+    if mismatched:
+        print(f"[slo] FAIL: greedy streams diverged from "
+              f"per-request decode() for requests {mismatched[:5]} — "
+              f"the attribution instrumentation must be "
+              f"stream-invisible", file=sys.stderr)
+        return 1
+    if len(records) != len(trace):
+        print(f"[slo] FAIL: {len(records)} retired records for "
+              f"{len(trace)} requests (warm traffic must be dropped, "
+              f"real traffic must all land)", file=sys.stderr)
+        return 1
+    violations = (report.get("sum_to_wall") or {}).get("violations")
+    if violations:
+        print(f"[slo] FAIL: {len(violations)} record(s) violate the "
+              f"buckets-sum-to-wall contract (1%): {violations[:3]}",
+              file=sys.stderr)
+        return 1
+    if not ranked or ranked[0]["bucket"] != "block_wait":
+        print(f"[slo] FAIL: TTFT tail attributed to "
+              f"{ranked[0]['bucket'] if ranked else 'nothing'}, want "
+              f"block_wait (the injected starvation) — full ranking: "
+              f"{ranked}", file=sys.stderr)
+        return 1
+    if sat["max_kv_blocks_saturation"] < args.saturation_floor:
+        print(f"[slo] FAIL: kv_blocks saturation peaked at "
+              f"{sat['max_kv_blocks_saturation']} < "
+              f"{args.saturation_floor} under an arena sized for "
+              f"{args.starved_rows} of {args.requests} queued rows",
+              file=sys.stderr)
+        return 1
+
+    if args.ledger:
+        try:
+            perf_ledger.append_row(
+                args.ledger, "slo_check",
+                {"block_wait_tail_share": ranked[0]["share"],
+                 "saturation_under_starvation":
+                     sat["max_kv_blocks_saturation"]},
+                devices=jax.devices(), config=summary["config"])
+        except (perf_ledger.LedgerError, OSError) as e:
+            print(f"[slo] FAIL: perf-ledger append: {e}",
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

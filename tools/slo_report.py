@@ -1,0 +1,275 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Offline SLO attribution report: WHY the latency tail is slow.
+
+Reads retired per-request attribution records (the
+obs.reqledger.RequestLedger shape) from any mix of:
+
+  - ``/debug/requests`` dumps (live serving replicas; ``--url``
+    fetches one directly),
+  - trace journals whose postmortem state carries the
+    ``serving_requests`` provider (processes that died),
+  - tpu_diagnose bundles (their journal legs are swept too),
+
+and prints ONE JSON report: per-bucket totals/percentiles, the
+TTFT tail ranked by which bucket put it there (queue_wait vs
+block_wait vs prefill vs rehydrate — the question the live
+histograms cannot answer), the token-gap (TPOT-side) tail ranked
+decode_gap vs stream_backpressure, and a sum-to-wall audit (every
+record's buckets must sum to its wall time within ``--tolerance``,
+default 1% — the contract ``make slo-check`` gates end to end).
+
+Usage:
+  python tools/slo_report.py journal.json requests.json
+  python tools/slo_report.py --url http://localhost:8500
+  python tools/slo_report.py bundle.json --ttft-slo-ms 250
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+# The attribution bucket names, mirrored from obs.reqledger (kept
+# import-free so this tool runs from a bare checkout next to a bundle
+# file; the shapes are contract-tested in tests/test_reqledger.py).
+ATTRIBUTION_BUCKETS = ("queue_wait", "block_wait", "prefill",
+                       "rehydrate", "decode_gap",
+                       "stream_backpressure", "other")
+TTFT_BUCKETS = ("queue_wait", "block_wait", "prefill", "rehydrate")
+GAP_BUCKETS = ("decode_gap", "stream_backpressure")
+
+DEFAULT_TOLERANCE = 0.01
+# Absolute floor under the relative sum-to-wall tolerance: records
+# round to microseconds, so a sub-millisecond request's legitimate
+# rounding residue must not read as a violation.
+SUM_ABS_FLOOR_S = 2e-5
+DEFAULT_TAIL_QUANTILE = 0.9
+
+
+def _is_record(obj):
+    return (isinstance(obj, dict) and "buckets" in obj
+            and "wall_s" in obj)
+
+
+def extract_records(payload):
+    """Every attribution record reachable in ``payload``, whatever
+    the container: a bare record list, a /debug/requests dump, a
+    journal with the serving_requests postmortem state, or a
+    tpu_diagnose bundle (endpoint + journal legs swept). Unknown
+    shapes yield [] rather than raising — a report over partial
+    inputs beats no report (the diagnose-bundle posture)."""
+    records = []
+    if isinstance(payload, list):
+        for item in payload:
+            if _is_record(item):
+                records.append(item)
+            else:
+                records.extend(extract_records(item))
+        return records
+    if not isinstance(payload, dict):
+        return records
+    if _is_record(payload):
+        return [payload]
+    for item in payload.get("records") or []:
+        if _is_record(item):
+            records.append(item)
+    state = (payload.get("postmortem_state") or {}).get(
+        "serving_requests")
+    if state:
+        records.extend(extract_records(state))
+    # tpu_diagnose bundle legs: endpoint sweeps + loaded journals.
+    for legs in (payload.get("endpoints") or {}).values():
+        leg = (legs or {}).get("requests")
+        if leg and leg.get("ok"):
+            records.extend(extract_records(leg.get("payload")))
+    for leg in (payload.get("journals") or {}).values():
+        if leg and leg.get("ok"):
+            records.extend(extract_records(leg.get("payload")))
+    return records
+
+
+def _percentile(values, q):
+    """Nearest-rank-with-interpolation percentile over a plain list
+    (numpy-free: the diagnose path must work from a bare host)."""
+    if not values:
+        return None
+    xs = sorted(values)
+    if len(xs) == 1:
+        return xs[0]
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
+def _ms(seconds):
+    return round(seconds * 1e3, 3) if seconds is not None else None
+
+
+def _rank_tail(tail, buckets):
+    """Mean per-request contribution of each candidate bucket over
+    the tail records, ranked largest first with its share of the
+    candidates' total — 'the p99 is slow BECAUSE of <bucket>'."""
+    if not tail:
+        return []
+    means = {b: sum((r["buckets"].get(b) or 0.0) for r in tail)
+             / len(tail) for b in buckets}
+    total = sum(means.values())
+    return [{"bucket": b, "mean_ms": _ms(means[b]),
+             "share": (round(means[b] / total, 4) if total else None)}
+            for b in sorted(means, key=means.get, reverse=True)]
+
+
+def analyze(records, ttft_slo_ms=None, tail_quantile=None,
+            tolerance=DEFAULT_TOLERANCE):
+    """The report body over retired records (the slo_check gate and
+    the diagnose bundle's ``requests`` section both call this)."""
+    tail_quantile = (DEFAULT_TAIL_QUANTILE if tail_quantile is None
+                     else tail_quantile)
+    out = {"requests": len(records)}
+    if not records:
+        return out
+    outcomes = {}
+    for r in records:
+        outcomes[r.get("outcome", "?")] = (
+            outcomes.get(r.get("outcome", "?"), 0) + 1)
+    out["outcomes"] = outcomes
+
+    wall_total = sum(r["wall_s"] for r in records)
+    buckets = {}
+    for b in ATTRIBUTION_BUCKETS:
+        vals = [(r["buckets"].get(b) or 0.0) for r in records]
+        total = sum(vals)
+        buckets[b] = {
+            "total_s": round(total, 6),
+            "share": (round(total / wall_total, 4) if wall_total
+                      else None),
+            "p50_ms": _ms(_percentile(vals, 0.5)),
+            "p99_ms": _ms(_percentile(vals, 0.99)),
+        }
+    out["buckets"] = buckets
+
+    # Sum-to-wall audit: the ledger's one structural invariant.
+    violations = []
+    max_rel = 0.0
+    for i, r in enumerate(records):
+        total = sum(r["buckets"].get(b) or 0.0
+                    for b in r["buckets"])
+        err = abs(total - r["wall_s"])
+        rel = err / r["wall_s"] if r["wall_s"] > 0 else 0.0
+        max_rel = max(max_rel, rel)
+        if err > max(tolerance * r["wall_s"], SUM_ABS_FLOOR_S):
+            violations.append({"index": i, "wall_s": r["wall_s"],
+                               "bucket_sum_s": round(total, 6)})
+    out["sum_to_wall"] = {"checked": len(records),
+                          "violations": violations,
+                          "max_rel_err": round(max_rel, 6)}
+
+    # TTFT tail: requests past the SLO threshold (when given) or the
+    # tail quantile, ranked by which pre-first-token bucket put them
+    # there.
+    with_ttft = [r for r in records
+                 if isinstance(r.get("ttft_s"), (int, float))]
+    if with_ttft:
+        ttfts = [r["ttft_s"] for r in with_ttft]
+        if ttft_slo_ms is not None:
+            threshold = ttft_slo_ms / 1e3
+        else:
+            threshold = _percentile(ttfts, tail_quantile)
+        tail = [r for r in with_ttft if r["ttft_s"] >= threshold]
+        out["ttft"] = {
+            "p50_ms": _ms(_percentile(ttfts, 0.5)),
+            "p99_ms": _ms(_percentile(ttfts, 0.99)),
+            "tail": {
+                "threshold_ms": _ms(threshold),
+                "count": len(tail),
+                "ranked": _rank_tail(tail, TTFT_BUCKETS),
+            },
+        }
+
+    # Token-gap (TPOT-side) tail: per-token gap over the post-first-
+    # token buckets, ranked engine gap vs client backpressure.
+    gappy = [r for r in with_ttft if r.get("tokens", 0) > 1]
+    if gappy:
+        per_tok = [sum(r["buckets"].get(b) or 0.0
+                       for b in GAP_BUCKETS) / (r["tokens"] - 1)
+                   for r in gappy]
+        threshold = _percentile(per_tok, tail_quantile)
+        tail = [r for r, g in zip(gappy, per_tok) if g >= threshold]
+        out["token_gap"] = {
+            "p50_ms": _ms(_percentile(per_tok, 0.5)),
+            "p99_ms": _ms(_percentile(per_tok, 0.99)),
+            "tail": {
+                "threshold_ms": _ms(threshold),
+                "count": len(tail),
+                "ranked": _rank_tail(tail, GAP_BUCKETS),
+            },
+        }
+    return out
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fetch(url):
+    with urllib.request.urlopen(url.rstrip("/") + "/debug/requests",
+                                timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="*",
+                   help="/debug/requests dumps, trace journals, or "
+                        "tpu_diagnose bundles")
+    p.add_argument("--url", action="append", default=[],
+                   help="serving base URL whose /debug/requests to "
+                        "fetch live")
+    p.add_argument("--ttft-slo-ms", type=float, default=None,
+                   help="rank the TTFT tail above this SLO instead "
+                        "of the tail quantile")
+    p.add_argument("--tail-quantile", type=float,
+                   default=DEFAULT_TAIL_QUANTILE)
+    p.add_argument("--tolerance", type=float,
+                   default=DEFAULT_TOLERANCE,
+                   help="relative sum-to-wall tolerance (default 1%%)")
+    args = p.parse_args(argv)
+    if not args.paths and not args.url:
+        p.error("need at least one input file or --url")
+
+    records = []
+    for path in args.paths:
+        records.extend(extract_records(_load(path)))
+    for url in args.url:
+        records.extend(extract_records(_fetch(url)))
+
+    report = analyze(records, ttft_slo_ms=args.ttft_slo_ms,
+                     tail_quantile=args.tail_quantile,
+                     tolerance=args.tolerance)
+    print(json.dumps(report, indent=1))
+    if report.get("sum_to_wall", {}).get("violations"):
+        print("[slo-report] WARNING: records violate the "
+              "sum-to-wall contract", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

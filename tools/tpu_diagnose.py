@@ -35,6 +35,11 @@ incident:
     wall-time attribution + combined ratio, obs.efficiency);
   - HBM memory watermarks (tpu_hbm_* gauges from each varz leg, plus
     any postmortem hbm_memory state the dead processes flushed);
+  - per-request latency attribution: every serving replica's
+    /debug/requests ring plus dead processes' ``serving_requests``
+    postmortem state, tail-ranked through tools/slo_report.py — the
+    bundle says WHY the incident's p99 was slow (queue wait vs
+    KV-block starvation vs rehydrate vs step gaps);
   - every profiler capture the journals record (``profiler.capture``
     events -> artifact paths), so the operator can grab the traces
     taken during the incident;
@@ -110,7 +115,9 @@ def _fetch(url, json_body=True):
 
 
 def sweep_endpoints(urls):
-    """{base_url: {trace, varz, metrics}} over every candidate."""
+    """{base_url: {trace, varz, metrics, requests}} over every
+    candidate (``requests`` = the serving latency-attribution ring;
+    a structured 404 on non-serving surfaces like the plugin)."""
     out = {}
     for base in urls:
         base = base.rstrip("/")
@@ -118,6 +125,7 @@ def sweep_endpoints(urls):
             "trace": _fetch(base + obs.TRACE_PATH),
             "varz": _fetch(base + obs.VARZ_PATH),
             "metrics": _fetch(base + "/metrics", json_body=False),
+            "requests": _fetch(base + "/debug/requests"),
         }
     return out
 
@@ -340,6 +348,41 @@ def elastic_section(endpoints, snapshots, checkpoint_dirs):
     }
 
 
+def requests_section(endpoints, journals):
+    """Per-request latency attribution: every /debug/requests ring a
+    live serving replica answered with, plus the ``serving_requests``
+    postmortem state of any dead process whose journal we loaded,
+    tail-ranked through tools/slo_report — an incident bundle then
+    says WHY the p99 was slow (queue wait vs KV-block starvation vs
+    rehydrate vs step gaps), not just that it was."""
+    import slo_report
+
+    records = []
+    sources = {}
+    for base, legs in endpoints.items():
+        leg = legs.get("requests")
+        if leg and leg.get("ok"):
+            got = slo_report.extract_records(leg["payload"])
+            if got:
+                sources[base] = len(got)
+                records.extend(got)
+    for path, leg in journals.items():
+        if not leg.get("ok"):
+            continue
+        got = slo_report.extract_records(leg["payload"])
+        if got:
+            sources[path] = len(got)
+            records.extend(got)
+    out = {"records": len(records), "sources": sources}
+    if records:
+        try:
+            out["report"] = slo_report.analyze(records)
+        except Exception as e:  # bad records must not void the bundle
+            out["error_type"] = type(e).__name__
+            out["error"] = str(e)[:300]
+    return out
+
+
 def perf_section(ledger_path):
     """The node's perf-ledger trend (tools/perf_report.py): series
     per rig fingerprint, regression annotations, last-known-good. A
@@ -427,6 +470,7 @@ def collect(urls, journal_paths, dev_dir, state_dir,
         "straggler_scan": straggler,
         "goodput": goodput,
         "memory": memory_section(endpoints, journals),
+        "requests": requests_section(endpoints, journals),
         "profiles": profile_captures(snapshots),
         "elastic": elastic_section(endpoints, snapshots,
                                    checkpoint_dirs),
@@ -492,6 +536,7 @@ def main(argv=None):
                           ).get("goodput_ratio")
         if isinstance(bundle["goodput"], dict) else None,
         "profile_captures": len(bundle["profiles"]),
+        "request_records": bundle["requests"]["records"],
         "placement_decisions": bundle["placement"]["decisions_observed"],
         "repartition_proposals": bundle["placement"]["proposals"],
         "perf_ledger_rows": bundle["perf"].get("rows"),
