@@ -38,6 +38,7 @@ presubmit:
 	JAX_PLATFORMS=cpu python3 tools/program_manifest.py --check
 	python3 tools/perf_ledger.py check
 	JAX_PLATFORMS=cpu python3 tools/slo_check.py --fast
+	JAX_PLATFORMS=cpu python3 tools/serving_chaos_check.py --fast
 
 # Project-native analysis gate: the AST lint must report ZERO
 # findings over the tree while every seeded fixture violation fires;
@@ -144,6 +145,18 @@ spill-check:
 slo-check:
 	JAX_PLATFORMS=cpu python3 tools/slo_check.py
 
+# Serving-survivability guard: inject device-side faults into the
+# engine's step/prefill/rehydrate sites (CEA_TPU_FAULT_PLAN) through
+# the real _EngineService; the quarantine-and-rebuild supervisor must
+# resume every greedy stream token-identical to uninterrupted
+# decode(), leak zero slots/blocks, attribute the stall to the
+# reqledger `recovery` bucket (sum-to-wall intact), emit exactly one
+# quarantine/recovered event pair per episode, and finish a
+# drain-under-fire inside the grace window with new admissions shed —
+# all tsan-clean. Pure CPU, ~2 min.
+serving-chaos-check:
+	JAX_PLATFORMS=cpu python3 tools/serving_chaos_check.py
+
 # Perf-ledger regression gate: validate every committed
 # PERF_LEDGER.json row (schema exact, field-level messages) and
 # compare each source's newest row against its newest SAME-RIG
@@ -182,5 +195,5 @@ clean:
 .PHONY: all native test test-native test-native-asan presubmit bench \
 	analysis-check program-check trace-check diagnose-check \
 	goodput-check chaos-check placement-check occupancy-check \
-	paging-check spill-check perf-check slo-check container \
-	partition-tpu push clean
+	paging-check spill-check perf-check slo-check \
+	serving-chaos-check container partition-tpu push clean

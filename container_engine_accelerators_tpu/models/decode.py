@@ -41,7 +41,7 @@ from flax import traverse_util
 from flax.core import unfreeze
 
 from ..analysis import tsan
-from ..utils import env_number, env_str
+from ..utils import env_number, env_str, faults
 
 
 def _decode_clone(model):
@@ -1723,6 +1723,14 @@ def _paged_step_impl(model, params, cache, row_pos, seen, rngs, tok,
             seen, rngs, nxt, lp)
 
 
+class EngineCapacityError(RuntimeError):
+    """An ``admit`` that the pool cannot hold RIGHT NOW (no free
+    slot / block budget short) — transient by definition: a release
+    frees capacity. A RuntimeError subclass so existing callers keep
+    working; the serving supervisor tells it apart from device-side
+    failures (which quarantine the engine, not the request)."""
+
+
 class SlotDecodeEngine:
     """Persistent decode slot pool with in-flight admission.
 
@@ -1936,6 +1944,7 @@ class SlotDecodeEngine:
 
     def _prefill(self, tokens, prompt_len, temperature, top_k, top_p,
                  min_p, repetition_penalty, seed):
+        faults.fire("prefill")
         row = jnp.asarray(tokens, jnp.int32)[None, :]
         self.prefills += 1
         self.prefill_widths[int(row.shape[1])] += 1
@@ -2066,6 +2075,7 @@ class SlotDecodeEngine:
     def _paged_prefill(self, suffix, shared_len, prefix_table,
                        temperature, top_k, top_p, min_p, rep_pen,
                        seed):
+        faults.fire("prefill")
         width = self._pick_width(max(len(suffix), 1), shared_len)
         row = np.zeros((width,), np.int32)
         row[:len(suffix)] = suffix
@@ -2113,6 +2123,7 @@ class SlotDecodeEngine:
         blocks: ONE _paged_hydrate_impl call per admission (fixed
         [n_blk]-row payload, sentinel-padded), timed into the
         tpu_serving_kv_rehydrate_seconds surface."""
+        faults.fire("hydrate")
         t0 = time.perf_counter()
         dests = np.full((self._n_blk,), self._num_blocks, np.int32)
         stacks = {}
@@ -2140,7 +2151,7 @@ class SlotDecodeEngine:
                      top_k, top_p, min_p, repetition_penalty, seed):
         pool, bs = self._pool, self._block_size
         if pool.available() < plan["needed"]:
-            raise RuntimeError(
+            raise EngineCapacityError(
                 f"insufficient free KV blocks "
                 f"(need {plan['needed']}, "
                 f"available {pool.available()}); queue the admission")
@@ -2431,7 +2442,7 @@ class SlotDecodeEngine:
         tsan.note_write("engine.slot_tables", self)
         free = np.flatnonzero(~self._active)
         if free.size == 0:
-            raise RuntimeError("no free slot; release one first")
+            raise EngineCapacityError("no free slot; release one first")
         slot = int(free[0])
         if self.paged:
             plan = self._paged_plan(tokens, prompt_len, max_new,
@@ -2503,7 +2514,13 @@ class SlotDecodeEngine:
             return None
         tsan.note_write("engine.slot_tables", self)
         if self.paged:
+            # The fault fires AFTER the host-side block upkeep:
+            # write-block allocations and COW bookkeeping have
+            # already mutated the tables, exactly the torn state a
+            # mid-step device failure leaves behind — what
+            # force_reclaim/quarantine-rebuild must survive.
             cow_src, cow_dst = self._paged_prestep()
+            faults.fire("step")
             (self._cache, self._row_pos, self._seen, self._rngs, nxt,
              lp) = _paged_step_impl(
                 self._step_model, self._params, self._cache,
@@ -2516,6 +2533,7 @@ class SlotDecodeEngine:
                 jnp.asarray(cow_dst))
             self._pos_host += self._active
         else:
+            faults.fire("step")
             (self._cache, self._row_pos, self._seen, self._rngs, nxt,
              lp) = _slot_step_impl(
                 self._step_model, self._params, self._cache,
@@ -2560,6 +2578,54 @@ class SlotDecodeEngine:
         self._top_ps[slot] = 1.0
         self._min_ps[slot] = 0.0
         self._rep_pens[slot] = 1.0
+
+    def pool_leak_report(self):
+        """Invariant audit for a pool that SHOULD be empty (every
+        row failed/released — the serving loop's post-step-failure
+        state): None when clean, else {violation: detail}. The
+        checks mirror the test suite's ``_pool_is_clean``: every
+        non-pinned block free, nothing shared, no outstanding growth
+        commitment, every table row all-trash, refcounts exactly the
+        pinned set. Dense pools only have the active-row check."""
+        problems = {}
+        if self._active.any():
+            problems["active_rows"] = [
+                int(s) for s in np.flatnonzero(self._active)]
+        if not self.paged:
+            return problems or None
+        pool = self._pool
+        pinned = len(self._pinned)
+        if pool.free_count() != pool.usable - pinned:
+            problems["free_blocks"] = {
+                "free": pool.free_count(),
+                "expected": pool.usable - pinned}
+        if pool.shared_count() != 0:
+            problems["shared_blocks"] = pool.shared_count()
+        if pool.committed != 0:
+            problems["committed"] = int(pool.committed)
+        if not bool((self._tables == self._trash).all()):
+            problems["tables"] = [
+                int(s) for s in range(self.slots)
+                if (self._tables[s] != self._trash).any()]
+        refsum = int(np.abs(pool.ref).sum())
+        if refsum != pinned:
+            problems["refcounts"] = {"held": refsum,
+                                     "pinned": pinned}
+        return problems or None
+
+    def force_reclaim(self):
+        """Best-effort pool repair after a device-side failure tore
+        a step/admission mid-flight: release EVERY slot (idempotent
+        — a free slot's release resets its knob row and decrefs
+        nothing) so blocks, growth reservations, and tables return
+        to the empty-pool state. Returns the residual
+        ``pool_leak_report()`` — None when the reclaim restored the
+        invariants, a leak dict when references outside the slot
+        bookkeeping were lost (the caller should rebuild or stop
+        rather than keep serving on a short arena)."""
+        for slot in range(self.slots):
+            self.release(slot)
+        return self.pool_leak_report()
 
 
 def beam_search(model, params, prompt, max_new_tokens, *,

@@ -73,6 +73,7 @@ SERVING_LATENCY_ATTRIBUTION = (
     "tpu_serving_latency_attribution_seconds")
 SERVING_SATURATION = "tpu_serving_saturation"
 SERVING_SATURATION_CAUSE = "tpu_serving_saturation_cause"
+SERVING_ENGINE_REBUILDS = "tpu_serving_engine_rebuilds_total"
 
 # name -> one-line help. The authoritative set: the metric-registry
 # lint resolves every tpu_* literal in the tree against these keys
@@ -114,6 +115,8 @@ METRICS = {
         "per-request latency by attribution bucket",
     SERVING_SATURATION: "max cause-wise serving saturation (0..1)",
     SERVING_SATURATION_CAUSE: "per-cause serving saturation (0..1)",
+    SERVING_ENGINE_REBUILDS:
+        "engine quarantine-and-rebuild episodes by fault reason",
 }
 
 # tpu_-prefixed tokens that are NOT metric names (label keys, module

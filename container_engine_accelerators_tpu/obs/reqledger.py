@@ -37,6 +37,10 @@ Buckets (:data:`ATTRIBUTION_BUCKETS`):
   - ``rehydrate`` — the spill-tier upload portion of the admission,
     re-attributed out of ``prefill`` from the engine's
     ``drain_rehydrate_events()`` seam;
+  - ``recovery`` — the engine-quarantine stall: from the device-side
+    fault that quarantined the engine through rebuild and the
+    request's replay re-admission (the serving supervisor laps it;
+    a recovered stream's client sees this bucket, not an error);
   - ``decode_gap`` — between consecutive delivered tokens at
     step-forwarding time (the TPOT integrand);
   - ``stream_backpressure`` — a token gap on a STREAMING row whose
@@ -74,16 +78,18 @@ from .trace import get_tracer
 
 # Every wall-second of a request lands in exactly one of these; the
 # order is the canonical display/report order (waits, admission,
-# steady-state, remainder).
+# recovery, steady-state, remainder).
 ATTRIBUTION_BUCKETS = ("queue_wait", "block_wait", "prefill",
-                       "rehydrate", "decode_gap",
+                       "rehydrate", "recovery", "decode_gap",
                        "stream_backpressure", "other")
 
 # The buckets that make up TTFT (submit -> first token); the rest is
 # the token-gap (TPOT) side. tools/slo_report.py ranks tails within
-# each group.
+# each group. ``recovery`` ranks on the gap side: the canonical
+# quarantine stall lands mid-stream, between delivered tokens (a
+# pre-first-token replay's recovery still sums to wall either way).
 TTFT_BUCKETS = ("queue_wait", "block_wait", "prefill", "rehydrate")
-GAP_BUCKETS = ("decode_gap", "stream_backpressure")
+GAP_BUCKETS = ("decode_gap", "stream_backpressure", "recovery")
 
 SATURATION_CAUSES = ("slots", "kv_blocks", "queue_age")
 

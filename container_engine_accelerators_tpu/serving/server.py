@@ -34,6 +34,7 @@ import json
 import queue
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
@@ -74,6 +75,18 @@ SLO_TPOT_ENV = "CEA_TPU_SLO_TPOT_MS"
 # HBM sampling cadence on the engine loop: allocator stats are a
 # runtime call per device — amortize across steps.
 MEMORY_SAMPLE_INTERVAL_S = 2.0
+# Engine-supervisor knobs: rebuild attempts per quarantine episode
+# and the initial inter-attempt backoff (doubling per attempt; the
+# exhausted-retries circuit breaker reopens on the same schedule).
+REBUILD_RETRIES_ENV = "CEA_TPU_ENGINE_REBUILD_RETRIES"
+DEFAULT_REBUILD_RETRIES = 3
+REBUILD_BACKOFF_ENV = "CEA_TPU_ENGINE_REBUILD_BACKOFF_MS"
+DEFAULT_REBUILD_BACKOFF_MS = 200.0
+# SIGTERM graceful-drain grace window: in-flight streams run to
+# completion inside it while new admissions 503.
+DRAIN_GRACE_ENV = "CEA_TPU_DRAIN_GRACE_S"
+DEFAULT_DRAIN_GRACE_S = 30.0
+REBUILD_COUNTER = metric_names.SERVING_ENGINE_REBUILDS
 
 
 def _slo_threshold_s(env_key):
@@ -272,12 +285,13 @@ class _EngineWork:
                  "top_p", "min_p", "rep_pen", "eos_id", "want_lp",
                  "seed", "done", "stream_q", "ctx", "cancel", "slot",
                  "tokens", "lps", "score_only", "account",
-                 "submit_t", "last_tok_t", "no_prefix", "timeline")
+                 "submit_t", "last_tok_t", "no_prefix", "timeline",
+                 "request_id")
 
     def __init__(self, row, p_len, new, temperature, top_k, top_p,
                  min_p, rep_pen, eos_id, want_lp, seed, ctx,
                  stream_q=None, score_only=False, account=True,
-                 no_prefix=False):
+                 no_prefix=False, request_id=None):
         self.row = row
         self.p_len = p_len
         self.new = new
@@ -309,6 +323,9 @@ class _EngineWork:
         self.submit_t = None    # stamped at admission-queue entry
         self.last_tok_t = None  # previous token's delivery time
         self.timeline = None    # attribution clock, set at submit
+        # Client-visible correlation id: rides the streaming error
+        # envelope so a client can tie a retry to the failed attempt.
+        self.request_id = request_id or uuid.uuid4().hex[:12]
 
 
 class _EngineService:
@@ -328,17 +345,56 @@ class _EngineService:
     longest-waiting admitted request's trace, mirroring the old batch
     span), the tpu_serving_slot_occupancy histogram, and
     slots_active/slots_free gauges through the process tracer.
+
+    **Survivability supervisor** (armed by ``engine_factory``): when
+    ``step()`` or an admission raises a device-side error, the loop
+    QUARANTINES the engine — readiness flips, new admissions queue —
+    snapshots every in-flight row's replayable state (prompt + tokens
+    generated so far + sampling knobs: host data this service already
+    holds), tears the engine down, rebuilds a fresh one through the
+    factory (the in-process jit cache and CEA_TPU_COMPILE_CACHE make
+    the re-warm cheap), and REPLAYS the in-flight rows by re-admitting
+    prompt+generated-prefix as forced tokens — greedy streams resume
+    token-identical mid-stream; clients see a stall (the reqledger
+    ``recovery`` bucket), not an error. Rebuild failures retry
+    ``CEA_TPU_ENGINE_REBUILD_RETRIES`` times with exponential backoff
+    (``CEA_TPU_ENGINE_REBUILD_BACKOFF_MS``); exhaustion trips a
+    circuit breaker that sheds everything (the server degrades to
+    503 + Retry-After) and probes the factory again on the same
+    doubling schedule. Exactly one ``serving.engine_quarantine`` /
+    ``serving.engine_recovered`` journal event pair per episode;
+    ``tpu_serving_engine_rebuilds_total{reason=}`` counts triggers.
+    Without a factory the loop keeps its bare behavior — fail the
+    in-flight work — but now also audits the pool invariants and
+    force-reclaims slots/blocks/reservations before continuing (a
+    poisoned arena must not keep serving).
     """
 
-    def __init__(self, engine, admission):
+    def __init__(self, engine, admission, engine_factory=None):
         self._engine = engine
         self._admission = admission
+        self._engine_factory = engine_factory
+        self._rebuild_retries = max(1, int(env_number(
+            REBUILD_RETRIES_ENV, DEFAULT_REBUILD_RETRIES, parse=int)))
+        self._rebuild_backoff_s = max(0.0, env_number(
+            REBUILD_BACKOFF_ENV, DEFAULT_REBUILD_BACKOFF_MS) / 1e3)
         self._queue = queue.Queue()
         self._pending = []          # popped but waiting for a slot
         self._slot_work = {}
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._stopping = False      # gates submit_many under _lock
+        self._draining = False      # SIGTERM drain: submissions shed
+        self._quarantined = False   # readiness; admissions queue
+        self._breaker_open = False  # rebuild gave up; submissions shed
+        self._breaker_until = 0.0   # monotonic reopen-probe deadline
+        self._breaker_backoff_s = max(self._rebuild_backoff_s, 0.05)
+        self._in_episode = False    # one quarantine/recovered pair
+        self._inflight = 0          # submitted-not-retired (drain)
+        self._rebuilds = 0          # successful rebuilds
+        self._episodes = 0          # quarantine triggers
+        self._replayed_rows = 0     # quarantine replays admitted
+        self._replayed_tokens = 0   # forced-prefix tokens re-prefilled
         self._admitted = 0
         self._retired = 0
         self._occ_hist = obs.histogram(
@@ -402,12 +458,22 @@ class _EngineService:
             # (tpu_diagnose) then shows the tables and free list the
             # allocator died with. Idempotent by name — one provider
             # per process, last engine wins (servers are 1:1 with
-            # engines in practice).
+            # engines in practice). Registered as a through-pointer
+            # method, not the bound engine method: a quarantine
+            # rebuild swaps self._engine and the provider must dump
+            # the LIVE pool, not the corpse's.
             postmortem.register_state_provider(
-                "serving_kv_blocks", engine.block_pool_state)
+                "serving_kv_blocks", self._kv_block_state)
+        from ..models.decode import EngineCapacityError
+        self._capacity_error = EngineCapacityError
         self._thread = threading.Thread(
             target=self._loop, name="serving-engine", daemon=True)
         self._thread.start()
+
+    def _kv_block_state(self):
+        eng = self._engine
+        return (eng.block_pool_state() if getattr(eng, "paged", False)
+                else {"paged": False})
 
     def submit_many(self, works):
         """Admit all rows or none (the all-or-nothing _Admission
@@ -417,10 +483,14 @@ class _EngineService:
         would leave its handler blocked on done.get() forever)."""
         now = time.perf_counter()
         with self._lock:
-            if self._stopping:
+            # Drain and breaker SHED (the server maps None to 503 +
+            # Retry-After); a mere quarantine only QUEUES — the
+            # rebuild is in flight and these rows will serve.
+            if self._stopping or self._draining or self._breaker_open:
                 return None
             if not self._admission.try_acquire(len(works)):
                 return None
+            self._inflight += len(works)
             for work in works:
                 work.submit_t = now  # TTFT clock starts at admission
                 # The attribution clock starts with it: everything
@@ -428,6 +498,75 @@ class _EngineService:
                 work.timeline = RequestTimeline()
                 self._queue.put(work)
         return works
+
+    # ----- survivability surface (any thread) ------------------------
+
+    def _engine_state_locked(self):
+        """The five-way lifecycle cascade — ONE copy, callers hold
+        self._lock (ready/engine_state/stats all derive from it)."""
+        if self._stopping:
+            return "stopping"
+        if self._breaker_open:
+            return "breaker_open"
+        if self._quarantined:
+            return "quarantined"
+        if self._draining:
+            return "draining"
+        return "serving"
+
+    def ready(self):
+        """The /readyz answer: False while stopping, draining,
+        quarantined, or breaker-open — exactly the states a router /
+        HPA must stop sending traffic for."""
+        with self._lock:
+            return self._engine_state_locked() == "serving"
+
+    def engine_state(self):
+        """One-word lifecycle state for /stats and diagnostics."""
+        with self._lock:
+            return self._engine_state_locked()
+
+    def retry_after_s(self):
+        """Retry-After seconds for a shed/unready reply: the
+        breaker's reopen-probe deadline when open (the honest
+        recovery horizon), else a saturation-derived hint — a nearly
+        idle server (or one with no published snapshot yet) says
+        "1", a wedged one stretches to 5."""
+        with self._lock:
+            if self._breaker_open:
+                return max(1, int(self._breaker_until
+                                  - time.monotonic() + 1))
+            sat = self._last_saturation
+        level = sat["max"] if sat else 0.0
+        return max(1, int(round(1 + 4 * min(1.0, max(0.0, level)))))
+
+    def begin_drain(self):
+        """Flip into drain: submissions shed from this instant;
+        in-flight work keeps stepping to completion."""
+        with self._lock:
+            self._draining = True
+
+    def drain(self, grace_s=None):
+        """SIGTERM graceful drain: shed new admissions and wait up
+        to ``grace_s`` (default CEA_TPU_DRAIN_GRACE_S) for every
+        in-flight request — queued or decoding — to retire. Returns
+        True when the service drained inside the grace window; the
+        caller then captures/stops (stop() fails any stragglers with
+        a retryable error)."""
+        if grace_s is None:
+            grace_s = max(0.0, env_number(DRAIN_GRACE_ENV,
+                                          DEFAULT_DRAIN_GRACE_S))
+        self.begin_drain()
+        deadline = time.monotonic() + grace_s
+        while True:
+            with self._lock:
+                if self._inflight == 0:
+                    return True
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        with self._lock:
+            return self._inflight == 0
 
     def queue_depth(self):
         with self._lock:
@@ -485,6 +624,18 @@ class _EngineService:
                     "violations": violations,
                 },
                 "decode_mfu": self._mfu.mfu(),
+                # Survivability surface: lifecycle state, rebuild
+                # and quarantine-episode counts (the /readyz signal's
+                # machine-readable twin).
+                "engine_state": self._engine_state_locked(),
+                "engine_rebuilds": self._rebuilds,
+                "quarantine_episodes": self._episodes,
+                # Replay cost accounting: forced-prefix tokens the
+                # recovery re-prefilled — the deterministic
+                # numerator of the chaos gate's recovery-goodput
+                # trend (wall clocks are rig noise at this scale).
+                "replayed_rows": self._replayed_rows,
+                "replayed_tokens": self._replayed_tokens,
                 # Per-request latency attribution (p50/p99 per
                 # bucket) + the cause-wise saturation signal plane
                 # the HPA/router scale and shed on.
@@ -524,6 +675,8 @@ class _EngineService:
             # tpu_serving_kv_spill_hits_total deltas.
             self._engine.reset_prefix_counters()
             self._spill_hits_pub = 0
+            self._replayed_rows = 0
+            self._replayed_tokens = 0
             # Attribution/saturation state resets WITH the engine
             # counters (the PR 11 spill-hit baseline bug class:
             # stale state surviving a reset poisons the first
@@ -555,13 +708,14 @@ class _EngineService:
             while True:
                 item = self._queue.get_nowait()
                 if item is not None:
-                    self._finish(item, error="server stopping")
+                    self._finish(item, error="server stopping",
+                                 retryable=True)
         except queue.Empty:
             pass
 
     # ----- loop internals (service thread only) ----------------------
 
-    def _finish(self, work, error=None):
+    def _finish(self, work, error=None, retryable=False):
         if work.slot is not None:
             self._engine.release(work.slot)
             tsan.note_write("serving.slot_work", self)
@@ -583,8 +737,14 @@ class _EngineService:
                 prompt_len=work.p_len))
         with self._lock:
             self._retired += 1
+            self._inflight -= 1
         if work.stream_q is not None:
-            work.stream_q.put(("error", error) if error else ("end",))
+            # The streaming error carries its retryability: the HTTP
+            # layer turns it into the final ndjson error envelope so
+            # a client can tell retry-worthy engine recovery from a
+            # permanent reject.
+            work.stream_q.put(("error", error, bool(retryable))
+                              if error else ("end",))
         elif error is not None:
             work.done.put(("error", error))
         else:
@@ -699,55 +859,246 @@ class _EngineService:
         if events:
             timeline.move("prefill", "rehydrate", sum(events))
 
+    def _replay_view(self, work):
+        """The (row, p_len, max_new) an admission should use: the
+        original request, or — after a quarantine snapshot — the
+        prompt plus every already-delivered token as a FORCED prefix,
+        with the budget shrunk by what is already out. Prefilling the
+        forced prefix re-derives exactly the KV state the dead engine
+        held for this row, so the replay admission's sampled token is
+        the stream's NEXT token (greedy: token-identical resume; the
+        total span p_len + new is unchanged, so the block reservation
+        is too)."""
+        if not work.tokens:
+            return work.row, work.p_len, work.new
+        row = np.concatenate([
+            np.asarray(work.row[:work.p_len], np.int32),
+            np.asarray(work.tokens, np.int32)])
+        return (row, work.p_len + len(work.tokens),
+                work.new - len(work.tokens))
+
     def _admit(self, work):
+        """Admit one work row (or its quarantine replay). Returns
+        False when the attempt consumed the engine — a quarantine
+        fired, or capacity raced and the work was requeued — and the
+        caller must restart its step boundary."""
+        replay = bool(work.tokens)
         # Close the final wait sliver (admissible since the last
-        # boundary lap) before the prefill clock opens.
-        work.timeline.lap("queue_wait")
+        # boundary lap) before the prefill clock opens; a replay's
+        # whole stall — fault, rebuild, this re-prefill — reads as
+        # ONE named `recovery` bucket.
+        work.timeline.lap("recovery" if replay else "queue_wait")
+        row, p_len, max_new = self._replay_view(work)
         t0 = time.perf_counter()
+        fault = None
         try:
             with obs.span("serving.prefill", parent=work.ctx,
-                          bucket=int(work.row.shape[0]),
-                          phase="engine_admission"):
+                          bucket=int(row.shape[0]),
+                          phase=("engine_replay" if replay
+                                 else "engine_admission")):
                 if work.score_only:
-                    echo = self._engine.score(work.row, work.p_len)
+                    echo = self._engine.score(row, p_len)
                     work.timeline.lap("prefill")
-                    work.lps = list(echo[:work.p_len])
+                    work.lps = list(echo[:p_len])
                     with self._lock:
                         self._admitted += 1
                     self._finish(work)
-                    return
+                    return True
                 slot, first, first_lp, echo = self._engine.admit(
-                    work.row, work.p_len,
+                    row, p_len,
                     temperature=work.temperature, top_k=work.top_k,
                     top_p=work.top_p, min_p=work.min_p,
                     repetition_penalty=work.rep_pen, seed=work.seed,
-                    max_new=work.new,
+                    max_new=max_new,
                     allow_prefix=self._allow_prefix(work))
-                work.timeline.lap("prefill")
+                work.timeline.lap("recovery" if replay else "prefill")
                 self._attribute_rehydrate(work.timeline)
+        except self._capacity_error:
+            # The boundary gate said admissible but the pool
+            # disagreed (replay geometry vs the gate's original-row
+            # view, prefix-lookup drift): requeue at the head —
+            # transient by definition, a release frees capacity, and
+            # the wait keeps lapping queue/block_wait.
+            log.warning("admission raced pool capacity; requeued")
+            self._pending.insert(0, work)
+            return False
         except Exception as e:
-            log.exception("engine admission failed")
-            work.timeline.lap("prefill")  # the failed attempt's time
-            # Drain here too: a failed admit may already have paid a
-            # rehydrate upload, and leaving its events in the seam
-            # would move the NEXT admission's prefill time into a
-            # rehydrate it never performed.
-            self._attribute_rehydrate(work.timeline)
-            self._finish(work, error=str(e))
-            return
+            if self._supervised():
+                # A device-side admission failure quarantines the
+                # whole engine — the arena may be poisoned — and
+                # this row rides the replay set. Handled AFTER the
+                # finally, like the step path, so the prefill
+                # histogram records the failed attempt, not the
+                # rebuild (with its retries/backoff) that follows.
+                fault = e
+            else:
+                log.exception("engine admission failed")
+                # The failed attempt's time.
+                work.timeline.lap("prefill")
+                # Drain here too: a failed admit may already have
+                # paid a rehydrate upload, and leaving its events in
+                # the seam would move the NEXT admission's prefill
+                # time into a rehydrate it never performed.
+                self._attribute_rehydrate(work.timeline)
+                self._finish(work, error=str(e), retryable=True)
+                return True
         finally:
             self._prefill_hist.observe(time.perf_counter() - t0)
+        if fault is not None:
+            self._quarantine("prefill", fault, extra=[work])
+            return False
         work.slot = slot
         tsan.note_write("serving.slot_work", self)
         self._slot_work[slot] = work
         with self._lock:
             self._admitted += 1
-        if work.want_lp:
-            work.lps = list(echo[:work.p_len])
+            if replay:
+                self._replayed_rows += 1
+                self._replayed_tokens += p_len
+        if work.want_lp and not replay:
+            # A replay keeps its accumulated echo + per-token
+            # logprobs; overwriting from the extended-prompt echo
+            # would double-count the generated span.
+            work.lps = list(echo[:p_len])
         self._deliver(work, first, first_lp)
+        return True
+
+    # ----- quarantine-and-rebuild supervisor (loop thread only) ------
+
+    def _supervised(self):
+        return self._engine_factory is not None
+
+    def _quarantine(self, reason, error, extra=()):
+        """Quarantine the engine after a device-side failure: flip
+        readiness, snapshot every in-flight row's replayable state
+        (their slots die with the engine — never released into the
+        successor), journal exactly one quarantine event per episode,
+        and rebuild."""
+        victims = list(self._slot_work.values())
+        tsan.note_write("serving.slot_work", self)
+        self._slot_work.clear()
+        for work in victims:
+            work.slot = None
+        victims.extend(extra)
+        with self._lock:
+            self._quarantined = True
+            self._episodes += 1
+        if not self._in_episode:
+            self._in_episode = True
+            obs.event("serving.engine_quarantine", reason=reason,
+                      error=str(error)[:200], inflight=len(victims))
+        obs.counter(REBUILD_COUNTER, reason=reason)
+        log.error("engine quarantined after %s failure (%s); "
+                  "rebuilding with %d in-flight row(s) to replay",
+                  reason, error, len(victims))
+        self._rebuild(victims)
+
+    def _install_engine(self, engine):
+        # Under _lock: stats() reads engine fields through
+        # self._engine from request threads.
+        with self._lock:
+            self._engine = engine
+
+    def _rebuild(self, victims):
+        """Tear down and rebuild the engine, retrying with
+        exponential backoff; on success replay the victims from the
+        FIFO's head, on exhaustion trip the circuit breaker (the
+        server degrades to 503 + Retry-After instead of
+        crash-looping)."""
+        backoff = max(self._rebuild_backoff_s, 0.0)
+        for attempt in range(1, self._rebuild_retries + 1):
+            if self._stop.is_set():
+                break
+            try:
+                engine = self._engine_factory()
+            except Exception:
+                log.exception("engine rebuild attempt %d/%d failed",
+                              attempt, self._rebuild_retries)
+                if attempt < self._rebuild_retries:
+                    self._stop.wait(backoff)
+                    backoff = backoff * 2 if backoff else 0.05
+                continue
+            self._recover(engine, victims, attempt)
+            return
+        retry_after = max(self._breaker_backoff_s, 0.05)
+        self._breaker_backoff_s = retry_after * 2
+        with self._lock:
+            self._breaker_open = True
+            self._breaker_until = time.monotonic() + retry_after
+        log.error("engine rebuild failed %d time(s); circuit "
+                  "breaker open, reprobe in %.2fs",
+                  self._rebuild_retries, retry_after)
+        for work in victims:
+            self._finish(work, error="engine rebuild failed; "
+                         "retry later", retryable=True)
+        self._shed_queued("engine rebuild failed; retry later")
+
+    def _recover(self, engine, victims, attempt):
+        self._install_engine(engine)
+        now = time.perf_counter()
+        for work in victims:
+            # Close the quarantine stall into the `recovery` bucket
+            # (fault -> rebuild done); the replay prefill laps there
+            # too, so the whole outage reads as ONE named stall.
+            if work.timeline is not None:
+                work.timeline.lap("recovery", now)
+        # Replay ahead of newly queued work: these rows were already
+        # mid-service when the engine died.
+        self._pending[:0] = victims
+        with self._lock:
+            self._quarantined = False
+            self._breaker_open = False
+            self._rebuilds += 1
+        self._breaker_backoff_s = max(self._rebuild_backoff_s, 0.05)
+        self._in_episode = False
+        obs.event("serving.engine_recovered", attempt=attempt,
+                  replayed=len(victims))
+        log.info("engine rebuilt (attempt %d); replaying %d "
+                 "in-flight row(s)", attempt, len(victims))
+
+    def _breaker_tick(self):
+        """Breaker-open loop body: wait out the reopen deadline,
+        then probe the factory once — success closes the breaker
+        (ending the episode with its one recovered event), failure
+        doubles the backoff."""
+        if time.monotonic() < self._breaker_until:
+            self._stop.wait(0.05)
+            return
+        obs.counter(REBUILD_COUNTER, reason="breaker_probe")
+        try:
+            engine = self._engine_factory()
+        except Exception:
+            log.exception("breaker reopen probe failed")
+            retry_after = self._breaker_backoff_s
+            self._breaker_backoff_s = retry_after * 2
+            with self._lock:
+                self._breaker_until = time.monotonic() + retry_after
+            return
+        self._recover(engine, [], 0)
+
+    def _shed_queued(self, error):
+        """Fail everything waiting (queue + pending) with a
+        retryable error — breaker-trip cleanup; nothing may block on
+        a service that cannot serve."""
+        try:
+            while True:
+                item = self._queue.get_nowait()
+                if item is not None:
+                    self._finish(item, error=error, retryable=True)
+        except queue.Empty:
+            pass
+        for work in self._pending:
+            self._finish(work, error=error, retryable=True)
+        self._pending.clear()
 
     def _loop(self):
         while not self._stop.is_set():
+            if self._breaker_open:
+                # Degraded: no working engine. Probe the factory on
+                # the breaker's schedule; submissions shed meanwhile
+                # (the server answers 503 + Retry-After).
+                self._breaker_tick()
+                continue
             # Drain arrivals; block only when the pool is idle.
             while True:
                 try:
@@ -756,6 +1107,20 @@ class _EngineService:
                     break
                 if item is not None:
                     self._pending.append(item)
+            # Purge cancelled rows from the WHOLE admission FIFO, not
+            # just its head: a client that disconnected while queued
+            # must release its admission budget NOW and never be
+            # prefilled — under exactly the starvation conditions
+            # where a head-blocked FIFO would otherwise hold dead
+            # rows' budget (and later waste prefills) for their full
+            # queue transit.
+            cancelled = [w for w in self._pending
+                         if w.cancel.is_set()]
+            if cancelled:
+                self._pending[:] = [w for w in self._pending
+                                    if not w.cancel.is_set()]
+                for work in cancelled:
+                    self._finish(work, error="cancelled")
             if not self._pending and not self._slot_work:
                 try:
                     item = self._queue.get(timeout=0.2)
@@ -783,15 +1148,28 @@ class _EngineService:
                     self._finish(head, error="cancelled")
                     continue
                 if head.score_only:
-                    self._admit(self._pending.pop(0))
+                    if not self._admit(self._pending.pop(0)):
+                        blocked_on = None
+                        break
                     continue
+                # Gate on the same geometry the admit will use: a
+                # quarantine replay's forced prefix shifts prompt_len
+                # (total span unchanged), and gating on the original
+                # row could say "admissible" for a plan the pool then
+                # refuses — a stuck retry loop.
+                g_row, g_plen, g_new = self._replay_view(head)
                 blocked_on = self._engine.admission_block_cause(
-                    head.row, head.p_len, head.new,
+                    g_row, g_plen, g_new,
                     allow_prefix=self._allow_prefix(head),
                     repetition_penalty=head.rep_pen)
                 if blocked_on is not None:
                     break
-                self._admit(self._pending.pop(0))
+                if not self._admit(self._pending.pop(0)):
+                    # Quarantine fired or capacity raced: the engine
+                    # (and _pending) changed under us — restart the
+                    # step boundary.
+                    blocked_on = None
+                    break
             self._last_block_cause = blocked_on
             if self._pending:
                 # Wait-time attribution, sliced per boundary by the
@@ -824,19 +1202,43 @@ class _EngineService:
             parent = next((w.ctx for w in self._slot_work.values()
                            if w.ctx is not None), None)
             t0 = time.perf_counter()
+            fault = None
             try:
                 with obs.span("serving.engine_step", parent=parent,
                               slots_active=active,
                               slots_free=self._engine.slots - active):
                     out = self._engine.step()
             except Exception as e:
-                log.exception("engine step failed")
-                for work in list(self._slot_work.values()):
-                    self._finish(work, error=str(e))
-                continue
+                # Handled AFTER the finally so the step histogram
+                # records the failed step, not the rebuild that
+                # follows it.
+                fault = e
             finally:
                 step_dt = time.perf_counter() - t0
                 self._step_hist.observe(step_dt)
+            if fault is not None:
+                if self._supervised():
+                    self._quarantine("step", fault)
+                    continue
+                log.error("engine step failed: %s", fault,
+                          exc_info=fault)
+                for work in list(self._slot_work.values()):
+                    self._finish(work, error=str(fault),
+                                 retryable=True)
+                # The failed step may have torn mid-flight (write
+                # blocks allocated, positions not advanced): audit
+                # the pool invariants and reclaim slots/blocks/
+                # reservations before serving on — a poisoned arena
+                # must not quietly shrink every future admission.
+                leaks = self._engine.pool_leak_report()
+                if leaks:
+                    log.error("pool invariants violated after step "
+                              "failure: %s; force-reclaiming", leaks)
+                    residue = self._engine.force_reclaim()
+                    if residue:
+                        log.error("force_reclaim residue: %s (arena "
+                                  "capacity lost)", residue)
+                continue
             self._occ_hist.observe(active / self._engine.slots)
             obs.gauge(metric_names.SERVING_SLOTS_ACTIVE, active)
             obs.gauge(metric_names.SERVING_SLOTS_FREE,
@@ -880,7 +1282,8 @@ class _EngineService:
         # so it also answers them — exactly once each.
         for work in (self._pending
                      + list(self._slot_work.values())):
-            self._finish(work, error="server stopping")
+            self._finish(work, error="server stopping",
+                         retryable=True)
         self._pending.clear()
 
 
@@ -906,6 +1309,12 @@ class _BaseServer:
         # only receives traffic once its programs are built.
         self._ready = threading.Event()
         self._ready.set()
+        # Graceful drain (the SIGTERM path): POSTs 503 with a
+        # Retry-After while in-flight work runs to completion.
+        # /healthz stays live through a drain (the pod is healthy,
+        # just leaving); /readyz goes unready immediately — the
+        # signal a router/HPA needs to stop sending traffic.
+        self._draining = False
         # Captured once, outside the stats lock: jax caches the device
         # list at backend init anyway, and calling jax.devices() under
         # _stats_lock could block every request thread on a dead
@@ -934,11 +1343,13 @@ class _BaseServer:
             def log_message(self, *args):
                 pass
 
-            def _reply(self, code, payload):
+            def _reply(self, code, payload, headers=None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for key, value in (headers or {}).items():
+                    self.send_header(key, str(value))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -976,6 +1387,9 @@ class _BaseServer:
                     else:
                         self._reply(200, payload)
                 elif self.path == "/healthz":
+                    # LIVENESS: stays 200 through drains and engine
+                    # quarantines (restarting the pod would not
+                    # help); only a never-warmed replica reads 503.
                     if server._ready.is_set():
                         self._reply(200, {"status": "ok",
                                           "model": server._name})
@@ -983,6 +1397,20 @@ class _BaseServer:
                         # Readiness gate: warm-up still compiling.
                         self._reply(503, {"status": "warming",
                                           "model": server._name})
+                elif self.path == "/readyz":
+                    # READINESS: the router/HPA signal — unready the
+                    # instant a drain starts or the engine
+                    # quarantines, ready again once recovered.
+                    if server._is_ready():
+                        self._reply(200, {"status": "ready",
+                                          "model": server._name})
+                    else:
+                        self._reply(
+                            503,
+                            {"status": server._unready_reason(),
+                             "model": server._name},
+                            headers={"Retry-After": str(
+                                server._overload_retry_after())})
                 elif self.path == "/stats":
                     self._reply(200, server.stats())
                 elif self.path == f"/v1/models/{server._name}":
@@ -1012,6 +1440,8 @@ class _BaseServer:
 
             def _serve_post(self, req_span):
                 t0 = time.perf_counter()
+                rid = uuid.uuid4().hex[:12]
+                req_span.set(request_id=rid)
                 try:
                     length = int(self.headers.get("Content-Length",
                                                   "0"))
@@ -1019,8 +1449,24 @@ class _BaseServer:
                 except (ValueError, TypeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
+                if server._draining:
+                    # Drain rejects at the door: in-flight work runs
+                    # to completion, arrivals go elsewhere.
+                    self._reply(
+                        503,
+                        {"error": "server draining; retry",
+                         "request_id": rid},
+                        headers={"Retry-After": str(
+                            server._overload_retry_after())})
+                    return
+                headers = None
                 try:
-                    code, resp = server._handle_post(payload)
+                    out = server._handle_post(payload,
+                                              request_id=rid)
+                    if len(out) == 3:
+                        code, resp, headers = out
+                    else:
+                        code, resp = out
                 except (KeyError, TypeError, ValueError) as e:
                     code, resp = 400, {"error": f"bad request: {e}"}
                 except Exception as e:  # model/runtime failure
@@ -1050,9 +1496,18 @@ class _BaseServer:
                             self.wfile.flush()
                     except Exception as e:
                         log.exception("stream failed")
+                        # Streaming error envelope: a final ndjson
+                        # line instead of a dropped socket, so the
+                        # client can tell a retry-worthy failure
+                        # from a permanent one (generator-emitted
+                        # errors carry their own retryable flag;
+                        # raising here means the stream machinery
+                        # itself broke — not retryable-by-default).
                         try:
                             self.wfile.write((json.dumps(
-                                {"error": str(e)}) + "\n").encode())
+                                {"error": str(e),
+                                 "retryable": False,
+                                 "request_id": rid}) + "\n").encode())
                         except OSError:
                             pass  # client went away
                     finally:
@@ -1061,15 +1516,55 @@ class _BaseServer:
                     return
                 if code == 200:
                     server._record(time.perf_counter() - t0)
-                self._reply(code, resp)
+                self._reply(code, resp, headers=headers)
 
         self._httpd = ThreadingHTTPServer(("", port), Handler)
 
     def _post_path(self):
         raise NotImplementedError
 
-    def _handle_post(self, payload):
+    def _handle_post(self, payload, request_id=None):
+        """Returns (code, resp) or (code, resp, extra headers)."""
         raise NotImplementedError
+
+    # -- readiness / drain (the k8s lifecycle surface) ---------------
+
+    def _service_ready(self):
+        """Subclass hook: backend readiness beyond warm-up (engine
+        quarantine / circuit breaker)."""
+        return True
+
+    def _is_ready(self):
+        return (self._ready.is_set() and not self._draining
+                and self._service_ready())
+
+    def _unready_reason(self):
+        if not self._ready.is_set():
+            return "warming"
+        if self._draining:
+            return "draining"
+        return "unready"
+
+    def _overload_retry_after(self):
+        """Retry-After seconds for 503 replies (overload shed, drain,
+        breaker). Subclasses derive it from live saturation; the base
+        answer is the minimal honest hint."""
+        return 1
+
+    def begin_drain(self):
+        """Start rejecting POSTs (503 + Retry-After) while keeping
+        /healthz live and in-flight work running. /readyz flips
+        unready immediately."""
+        self._draining = True
+
+    def drain(self, grace_s=None):
+        """Graceful drain for SIGTERM: reject new admissions and wait
+        for in-flight work (default grace CEA_TPU_DRAIN_GRACE_S).
+        Returns True when everything retired inside the window. The
+        base server has no tracked in-flight set — subclasses with
+        one override."""
+        self.begin_drain()
+        return True
 
     def _model_metadata(self):
         """Subclass hook: shape/config facts for the model-status
@@ -1251,7 +1746,7 @@ class InferenceServer(_BaseServer):
                 "input_shape": list(self._input_shape),
                 "max_batch": self._max_batch}
 
-    def _handle_post(self, payload):
+    def _handle_post(self, payload, request_id=None):
         try:
             instances = payload["instances"]
         except (KeyError, TypeError) as e:
@@ -1270,7 +1765,10 @@ class InferenceServer(_BaseServer):
         if pending is None:
             with self._stats_lock:
                 self._shed += 1
-            return 503, {"error": "server overloaded; retry"}
+            # Deliberate backpressure carries its retry hint: a 503
+            # without Retry-After reads as "gone", not "busy".
+            return (503, {"error": "server overloaded; retry"},
+                    {"Retry-After": str(self._overload_retry_after())})
         predictions = []
         for done in pending:
             try:
@@ -1535,19 +2033,32 @@ class GenerationServer(_BaseServer):
             # warm=False servers honor the env var too, not only the
             # warm-up path.
             _maybe_enable_compile_cache()
-            engine = SlotDecodeEngine(
-                model, params, max_batch,
-                self._prefix_len + self._buckets[-1] + max_new_tokens,
-                buckets=self._buckets,
-                pin_reserve_tokens=self._prefix_len)
-            if self._prefix_len:
-                # Pin the system prompt's blocks before the loop
-                # thread exists (engine methods are single-threaded
-                # by contract); every admission then prefix-hits and
-                # prefills only its suffix.
-                engine.pin_prefix(self._prefix_arr)
-            self._engine_service = _EngineService(engine,
-                                                  self._admission)
+            slot_len = (self._prefix_len + self._buckets[-1]
+                        + max_new_tokens)
+
+            def build_engine():
+                # THE engine recipe — construction and every
+                # quarantine rebuild share it, so a rebuilt engine
+                # (fresh arena/pool, re-pinned prefix) can never
+                # drift from the original. Rebuilds re-warm through
+                # the in-process jit cache (same traced shapes) and
+                # CEA_TPU_COMPILE_CACHE across restarts.
+                engine = SlotDecodeEngine(
+                    model, params, max_batch, slot_len,
+                    buckets=self._buckets,
+                    pin_reserve_tokens=self._prefix_len)
+                if self._prefix_len:
+                    # Pin the system prompt's blocks before the loop
+                    # thread steps it (engine methods are
+                    # single-threaded by contract; rebuilds run on
+                    # the loop thread itself); every admission then
+                    # prefix-hits and prefills only its suffix.
+                    engine.pin_prefix(self._prefix_arr)
+                return engine
+
+            self._engine_service = _EngineService(
+                build_engine(), self._admission,
+                engine_factory=build_engine)
         # Cross-request batching (legacy batch mode): one _Batcher
         # per (bucket, sampling mode, effective top_k) — rows from
         # concurrent requests with the same key share one decode
@@ -2188,6 +2699,31 @@ class GenerationServer(_BaseServer):
                 round(self._decode_rows / calls, 3) if calls else None),
         }
 
+    def _service_ready(self):
+        """Readiness beyond warm-up: a quarantined / breaker-open /
+        draining engine service makes /readyz 503 while /healthz
+        stays live."""
+        if self._engine_service is not None:
+            return self._engine_service.ready()
+        with self._batchers_lock:
+            return not self._stopping
+
+    def _overload_retry_after(self):
+        if self._engine_service is not None:
+            return self._engine_service.retry_after_s()
+        return 1
+
+    def drain(self, grace_s=None):
+        """SIGTERM graceful drain: reject new POSTs immediately
+        (503 + Retry-After; /readyz unready, /healthz live) and wait
+        up to the grace window for in-flight streams to finish.
+        Returns True when everything retired in time — the caller
+        then fires postmortem capture and stop() as usual."""
+        self.begin_drain()
+        if self._engine_service is not None:
+            return self._engine_service.drain(grace_s)
+        return True
+
     def stop(self):
         super().stop()
         with self._batchers_lock:
@@ -2199,7 +2735,7 @@ class GenerationServer(_BaseServer):
         if self._engine_service is not None:
             self._engine_service.stop()
 
-    def _handle_post(self, payload):
+    def _handle_post(self, payload, request_id=None):
         try:
             texts = payload.get("text")
             if texts is not None:
@@ -2311,7 +2847,8 @@ class GenerationServer(_BaseServer):
         if self._engine_service is not None:
             return self._engine_post(padded, p_lens, new, temperature,
                                      top_k, top_p, min_p, eos_id,
-                                     rep_pen, want_lp, stream, texts)
+                                     rep_pen, want_lp, stream, texts,
+                                     request_id)
         if stream:
             if arr.shape[0] != 1:
                 return 400, {"error": "stream requires exactly one "
@@ -2322,7 +2859,9 @@ class GenerationServer(_BaseServer):
             if not self._admission.try_acquire(1):
                 with self._stats_lock:
                     self._shed += 1
-                return 503, {"error": "server overloaded; retry"}
+                return (503, {"error": "server overloaded; retry"},
+                        {"Retry-After":
+                         str(self._overload_retry_after())})
             # Anything raising between acquire and the body reaching
             # the caller (tokenizer access; generator construction)
             # would be swallowed by the generic 500 handler with the
@@ -2346,7 +2885,9 @@ class GenerationServer(_BaseServer):
                 plain=self._default_knobs(rep_pen),
                 filtered=self._filtered_knobs(top_p, min_p))
             if batcher is None:
-                return 503, {"error": "server is shutting down"}
+                return (503, {"error": "server is shutting down"},
+                        {"Retry-After":
+                         str(self._overload_retry_after())})
             pending = batcher.submit_many(
                 [(row, temperature, int(pl), top_p, eos_id, rep_pen,
                   min_p)
@@ -2355,7 +2896,9 @@ class GenerationServer(_BaseServer):
                 adm.set(shed=True)
                 with self._stats_lock:
                     self._shed += 1
-                return 503, {"error": "server overloaded; retry"}
+                return (503, {"error": "server overloaded; retry"},
+                        {"Retry-After":
+                         str(self._overload_retry_after())})
         rows = []
         with obs.span("serving.wait", rows=len(pending)):
             for done in pending:
@@ -2395,7 +2938,7 @@ class GenerationServer(_BaseServer):
 
     def _engine_post(self, padded, p_lens, new, temperature, top_k,
                      top_p, min_p, eos_id, rep_pen, want_lp, stream,
-                     texts):
+                     texts, request_id=None):
         """Route one validated request onto the slot engine: every
         row takes (at most) one slot, admitted by the engine loop at
         the next step boundary with a free slot; scoring rows
@@ -2426,11 +2969,14 @@ class GenerationServer(_BaseServer):
             work = _EngineWork(rows[0], row_lens[0], new,
                                temperature, top_k, top_p, min_p,
                                rep_pen, eos_id, False, seed, ctx,
-                               stream_q=stream_q)
+                               stream_q=stream_q,
+                               request_id=request_id)
             if self._engine_service.submit_many([work]) is None:
                 with self._stats_lock:
                     self._shed += 1
-                return 503, {"error": "server overloaded; retry"}
+                return (503, {"error": "server overloaded; retry"},
+                        {"Retry-After":
+                         str(self._overload_retry_after())})
             decode_text = (self._tokenizer.decode
                            if texts is not None else None)
             # close() cancels the work; the engine loop retires the
@@ -2442,7 +2988,8 @@ class GenerationServer(_BaseServer):
         works = [
             _EngineWork(row, pl, new, temperature, top_k, top_p,
                         min_p, rep_pen, eos_id, want_lp, seed + i,
-                        ctx, score_only=(new == 0))
+                        ctx, score_only=(new == 0),
+                        request_id=request_id)
             for i, (row, pl) in enumerate(zip(rows, row_lens))]
         with obs.span("serving.admission", bucket=padded.shape[1],
                       rows=len(works)) as adm:
@@ -2450,7 +2997,9 @@ class GenerationServer(_BaseServer):
                 adm.set(shed=True)
                 with self._stats_lock:
                     self._shed += 1
-                return 503, {"error": "server overloaded; retry"}
+                return (503, {"error": "server overloaded; retry"},
+                        {"Retry-After":
+                         str(self._overload_retry_after())})
         results = []
         with obs.span("serving.wait", rows=len(works)):
             for work in works:
@@ -2472,12 +3021,18 @@ class GenerationServer(_BaseServer):
     def _engine_stream(self, work, decode_text, eos_id):
         """ndjson generator over the engine's per-step token queue:
         one {"tokens": [t]} line per decode step — tokens reach the
-        client as each step lands — then {"done": true}."""
+        client as each step lands — then {"done": true}. A mid-stream
+        failure ends with the error ENVELOPE instead of a dropped
+        socket: {"error", "retryable", "request_id"} — retryable
+        means the service is recovering (drain, rebuild, shutdown)
+        and the same request replayed verbatim should succeed."""
         while True:
             try:
                 item = work.stream_q.get(timeout=120)
             except queue.Empty:
-                yield {"error": "decode timed out"}
+                yield {"error": "decode timed out",
+                       "retryable": True,
+                       "request_id": work.request_id}
                 return
             if item[0] == "tok":
                 tok = item[1]
@@ -2491,5 +3046,8 @@ class GenerationServer(_BaseServer):
                 yield {"done": True}
                 return
             else:
-                yield {"error": item[1]}
+                yield {"error": item[1],
+                       "retryable": (bool(item[2])
+                                     if len(item) > 2 else False),
+                       "request_id": work.request_id}
                 return

@@ -364,12 +364,28 @@ def main(argv=None):
             name, make_apply_fn(model), variables,
             (args.image_size, args.image_size, 3),
             port=args.port, max_batch=args.max_batch)
-    # K8s terminates pods with SIGTERM; stop the HTTP server and
-    # batchers cleanly so in-flight requests get answered (or a 503)
-    # instead of connection resets during rollouts.
+    # K8s terminates pods with SIGTERM; the shutdown is a GRACEFUL
+    # DRAIN: new admissions 503 (Retry-After) while /readyz flips
+    # unready and /healthz stays live, in-flight streams run to
+    # completion within CEA_TPU_DRAIN_GRACE_S, THEN the postmortem
+    # capture fires (the drained requests are already retired into
+    # the serving_requests flight record), then the server stops —
+    # no mid-token connection resets during rollouts.
+    from container_engine_accelerators_tpu.obs import postmortem
+
+    def _drain_and_stop(signum):
+        drained = server.drain()
+        if not drained:
+            print("drain grace expired with requests in flight",
+                  file=sys.stderr)
+        postmortem.capture("signal:" + signal.Signals(signum).name)
+        server.stop()
+
     def _shutdown(signum, frame):
-        print(f"signal {signum}; stopping", file=sys.stderr)
-        threading.Thread(target=server.stop, daemon=True).start()
+        print(f"signal {signum}; draining then stopping",
+              file=sys.stderr)
+        threading.Thread(target=_drain_and_stop, args=(signum,),
+                         daemon=True).start()
 
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)

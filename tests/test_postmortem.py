@@ -171,6 +171,73 @@ _CLIENT_CODE = textwrap.dedent("""
 """)
 
 
+def test_drain_then_capture_attributes_inflight_request(tmp_path):
+    """The serving SIGTERM path, ordering pinned: a request IN
+    FLIGHT when the drain starts runs to completion inside the grace
+    window, and the postmortem bundle captured AFTER the drain
+    carries the `serving_requests` provider with that request's
+    retired record fully attributed (buckets summing to wall) — not
+    a half-open timeline snapshotted mid-token."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from container_engine_accelerators_tpu.models import (
+        TransformerLM,
+    )
+    from container_engine_accelerators_tpu.models.decode import (
+        SlotDecodeEngine,
+    )
+    from container_engine_accelerators_tpu.serving.server import (
+        _Admission,
+        _EngineService,
+        _EngineWork,
+    )
+
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def factory():
+        return SlotDecodeEngine(model, params, slots=2, slot_len=20,
+                                paged=True, kv_block_size=4,
+                                buckets=[8], kv_quant="bf16",
+                                kv_spill=False)
+
+    svc = _EngineService(factory(), _Admission(0),
+                         engine_factory=factory)
+    try:
+        row = np.zeros((8,), np.int32)
+        row[:4] = [5, 6, 7, 8]
+        work = _EngineWork(row, 4, 6, 0.0, 0, 1.0, 0.0, 1.0, -1,
+                           False, 0, None)
+        assert svc.submit_many([work]) is not None
+        # Drain FIRST (the in-flight request retires inside the
+        # grace window), capture SECOND — the k8s shutdown ordering.
+        assert svc.drain(grace_s=120) is True
+        status, out = work.done.get(timeout=10)
+        assert status == "ok", out
+        path = tmp_path / "drain_pm.json"
+        out_path = postmortem.capture("signal:SIGTERM",
+                                      path=str(path))
+        assert out_path == str(path)
+        doc = json.loads(path.read_text())
+        state = doc["postmortem_state"]["serving_requests"]
+        assert state["retired_total"] >= 1
+        rec = state["records"][0]
+        assert rec["outcome"] == "completed"
+        assert rec["tokens"] == 6
+        total = sum(rec["buckets"].values())
+        assert abs(total - rec["wall_s"]) <= max(
+            0.01 * rec["wall_s"], 2e-5), rec
+    finally:
+        svc.stop()
+        postmortem.unregister_state_provider("serving_requests")
+        postmortem.unregister_state_provider("serving_kv_blocks")
+
+
 def test_sigterm_mid_allocate_writes_postmortem_journal(fake_node,
                                                         tmp_path):
     for i in range(2):

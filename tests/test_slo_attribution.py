@@ -226,7 +226,8 @@ def test_cancel_mid_stream_retires_balanced_record(lm, service):
         item = stream_q.get(timeout=max(1, deadline - time.monotonic()))
         if item[0] != "tok":
             break
-    assert item == ("error", "cancelled")
+    # Cancels are permanent: the envelope's retryable flag is False.
+    assert item == ("error", "cancelled", False)
     rec = service.debug_requests()["records"][0]
     assert rec["outcome"] == "cancelled"
     assert rec["stream"] is True

@@ -1,0 +1,516 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Serving survivability: quarantine-and-rebuild, mid-stream replay,
+circuit breaker, graceful drain, FIFO cancel purge, and the /readyz +
+error-envelope HTTP contracts.
+
+Drives the real ``_EngineService`` (and one real GenerationServer
+over HTTP) with faults injected through the ``CEA_TPU_FAULT_PLAN``
+seam — the same seam `make serving-chaos-check` uses, pinned here at
+tier-1 granularity.
+"""
+
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu import obs
+from container_engine_accelerators_tpu.models import TransformerLM
+from container_engine_accelerators_tpu.models.decode import (
+    SlotDecodeEngine,
+    decode,
+)
+from container_engine_accelerators_tpu.serving.server import (
+    _Admission,
+    _EngineService,
+    _EngineWork,
+)
+from container_engine_accelerators_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    # Same shape as test_slo_attribution's model: the engine
+    # programs are already in the process jit cache by the time this
+    # module runs in a full tier-1 pass.
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _factory(model, params, slots=3, slot_len=20):
+    def build():
+        return SlotDecodeEngine(model, params, slots=slots,
+                                slot_len=slot_len, paged=True,
+                                kv_block_size=4, buckets=[8, 16],
+                                kv_quant="bf16", kv_spill=False)
+    return build
+
+
+def _work(prompt, p_len, new, seed=0, **kw):
+    row = np.zeros((max(8, p_len),), np.int32)
+    row[:p_len] = prompt[:p_len]
+    return _EngineWork(row, p_len, new, 0.0, 0, 1.0, 0.0, 1.0, -1,
+                       False, seed, None, **kw)
+
+
+def _pool_is_clean(eng):
+    pool = eng._pool
+    pinned = set(eng._pinned)
+    return (pool.free_count() == pool.usable - len(pinned)
+            and pool.shared_count() == 0
+            and pool.committed == 0
+            and bool((eng._tables == eng._trash).all())
+            and int(np.abs(pool.ref).sum()) == len(pinned))
+
+
+def _events(name):
+    return [e for e in obs.TRACER.snapshot()["events"]
+            if e["name"] == name]
+
+
+def _greedy_ref(model, params, prompts, news):
+    width = max(len(p) for p in prompts)
+    padded = np.zeros((len(prompts), width), np.int32)
+    p_lens = np.zeros((len(prompts),), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, :len(p)] = p
+        p_lens[i] = len(p)
+    ref = np.asarray(decode(model, params, jnp.asarray(padded),
+                            max(news), prompt_len=p_lens,
+                            fast_prefill=False))
+    return [ref[i, len(p):len(p) + n].tolist()
+            for i, (p, n) in enumerate(zip(prompts, news))]
+
+
+def _warm(svc, width=8):
+    work = _work(np.zeros((width,), np.int32), width, 2,
+                 account=False, no_prefix=True)
+    assert svc.submit_many([work]) is not None
+    status, out = work.done.get(timeout=600)
+    assert status == "ok", out
+    svc.reset_counters()
+
+
+def test_step_fault_quarantines_rebuilds_and_replays(lm):
+    """The tentpole contract: a device-side step failure quarantines
+    the engine, rebuilds it through the factory, and REPLAYS every
+    in-flight row as a forced prefix — greedy streams resume
+    token-identical, the stall lands in the `recovery` bucket, the
+    rebuilt pool is leak-free, and exactly one quarantine/recovered
+    event pair is journaled."""
+    model, params = lm
+    q0, r0 = len(_events("serving.engine_quarantine")), len(
+        _events("serving.engine_recovered"))
+    svc = _EngineService(_factory(model, params)(), _Admission(0),
+                         engine_factory=_factory(model, params))
+    try:
+        _warm(svc)
+        prompts = [np.array([5, 6, 7, 8], np.int32),
+                   np.array([9, 8, 7, 6, 5], np.int32),
+                   np.array([11, 12, 13], np.int32)]
+        news = [6, 5, 6]
+        faults.install({"step": [2]})
+        works = [_work(p, len(p), n, seed=i)
+                 for i, (p, n) in enumerate(zip(prompts, news))]
+        assert svc.submit_many(works) is not None
+        for w in works:
+            status, out = w.done.get(timeout=600)
+            assert status == "ok", out
+        faults.reset()
+        ref = _greedy_ref(model, params, prompts, news)
+        for w, want in zip(works, ref):
+            assert w.tokens == want
+        stats = svc.stats()
+        assert stats["engine_state"] == "serving"
+        assert stats["engine_rebuilds"] == 1
+        assert stats["quarantine_episodes"] == 1
+        assert len(_events("serving.engine_quarantine")) - q0 == 1
+        assert len(_events("serving.engine_recovered")) - r0 == 1
+        records = svc.debug_requests()["records"]
+        assert sum(r["buckets"]["recovery"] for r in records) > 0
+        for rec in records:
+            total = sum(rec["buckets"].values())
+            assert abs(total - rec["wall_s"]) <= max(
+                0.01 * rec["wall_s"], 2e-5), rec
+        assert _pool_is_clean(svc._engine)
+    finally:
+        svc.stop()
+
+
+def test_prefill_fault_replays_with_zero_generated_tokens(lm):
+    """An admission-time device failure rides the same episode shape:
+    the failing row (no tokens yet) replays as a plain admission and
+    still matches decode()."""
+    model, params = lm
+    svc = _EngineService(_factory(model, params)(), _Admission(0),
+                         engine_factory=_factory(model, params))
+    try:
+        _warm(svc)
+        faults.install({"prefill": [0]})
+        prompt = np.array([3, 1, 4, 1], np.int32)
+        work = _work(prompt, 4, 5)
+        assert svc.submit_many([work]) is not None
+        status, out = work.done.get(timeout=600)
+        assert status == "ok", out
+        faults.reset()
+        assert work.tokens == _greedy_ref(model, params, [prompt],
+                                          [5])[0]
+        assert svc.stats()["engine_rebuilds"] == 1
+        assert _pool_is_clean(svc._engine)
+    finally:
+        svc.stop()
+
+
+def test_circuit_breaker_trips_sheds_and_reopens(lm, monkeypatch):
+    """Repeated rebuild failures: retries with backoff, then the
+    breaker opens (submissions shed, retry_after advertised, streams
+    failed RETRYABLE), and a later successful factory probe closes
+    it — one quarantine/recovered pair for the whole episode."""
+    monkeypatch.setenv("CEA_TPU_ENGINE_REBUILD_RETRIES", "2")
+    monkeypatch.setenv("CEA_TPU_ENGINE_REBUILD_BACKOFF_MS", "20")
+    model, params = lm
+    q0, r0 = len(_events("serving.engine_quarantine")), len(
+        _events("serving.engine_recovered"))
+    good = _factory(model, params)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if 2 <= calls["n"] <= 4:  # both retries + the first probe
+            raise RuntimeError("factory down")
+        return good()
+
+    svc = _EngineService(flaky(), _Admission(0),
+                         engine_factory=flaky)
+    try:
+        _warm(svc)
+        faults.install({"step": [0]})
+        stream_q = queue.Queue()
+        work = _work(np.array([5, 6, 7, 8], np.int32), 4, 6,
+                     stream_q=stream_q)
+        assert svc.submit_many([work]) is not None
+        while True:
+            item = stream_q.get(timeout=120)
+            if item[0] != "tok":
+                break
+        faults.reset()
+        # The in-flight stream failed with the RETRYABLE envelope.
+        assert item[0] == "error"
+        assert item[2] is True
+        assert svc.engine_state() == "breaker_open"
+        assert svc.retry_after_s() >= 1
+        assert not svc.ready()
+        # Degraded: submissions shed while the breaker is open.
+        assert svc.submit_many([_work(np.arange(1, 4), 3, 2)]) is None
+        # The reopen probe (20ms-scale backoff) closes the breaker.
+        deadline = time.monotonic() + 30
+        while (svc.engine_state() != "serving"
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert svc.engine_state() == "serving"
+        work2 = _work(np.array([9, 8, 7], np.int32), 3, 3)
+        assert svc.submit_many([work2]) is not None
+        status, out = work2.done.get(timeout=600)
+        assert status == "ok", out
+        # One episode end to end: exactly one event pair.
+        assert len(_events("serving.engine_quarantine")) - q0 == 1
+        assert len(_events("serving.engine_recovered")) - r0 == 1
+    finally:
+        svc.stop()
+
+
+def test_cancelled_queued_request_purged_before_prefill(lm):
+    """A client that disconnects while QUEUED is dropped from the
+    FIFO without being admitted or prefilled, releasing its
+    admission budget immediately — not after its whole queue
+    transit."""
+    model, params = lm
+    # One slot + a 3-deep admission budget: w2/w3 queue behind w1.
+    factory = _factory(model, params, slots=1)
+    svc = _EngineService(factory(), _Admission(3),
+                         engine_factory=factory)
+    try:
+        _warm(svc)
+        prefills_before = None
+        w1 = _work(np.array([5, 6, 7, 8], np.int32), 4, 12, seed=0)
+        w2 = _work(np.array([1, 2, 3], np.int32), 3, 4, seed=1)
+        w3 = _work(np.array([9, 9, 9], np.int32), 3, 4, seed=2)
+        assert svc.submit_many([w1]) is not None
+        assert svc.submit_many([w2]) is not None
+        assert svc.submit_many([w3]) is not None
+        # Budget exhausted: a fourth submission sheds...
+        assert svc.submit_many(
+            [_work(np.arange(1, 4), 3, 2)]) is None
+        prefills_before = svc.stats()["engine_prefills"]
+        # ...until the queued w3 cancels: its budget frees NOW,
+        # while w1 is still decoding and w2 still queued.
+        w3.cancel.set()
+        status, out = w3.done.get(timeout=120)
+        assert status == "error" and out == "cancelled"
+        w4 = _work(np.array([4, 4, 4], np.int32), 3, 2, seed=3)
+        assert svc.submit_many([w4]) is not None
+        for w in (w1, w2, w4):
+            status, out = w.done.get(timeout=600)
+            assert status == "ok", out
+        # The cancelled row was never prefilled (purged at the FIFO,
+        # not admitted-and-retired): exactly w1 + w2 + w4 prefills.
+        assert (svc.stats()["engine_prefills"] - prefills_before
+                <= 3)
+        rec = [r for r in svc.debug_requests()["records"]
+               if r["outcome"] == "cancelled"]
+        assert len(rec) == 1
+        assert rec[0]["buckets"]["prefill"] == 0.0
+    finally:
+        svc.stop()
+
+
+def test_drain_completes_inflight_and_sheds_new(lm):
+    """Graceful drain: in-flight work runs to completion within the
+    grace window; submissions after begin_drain shed; readiness
+    flips immediately."""
+    model, params = lm
+    factory = _factory(model, params)
+    svc = _EngineService(factory(), _Admission(0),
+                         engine_factory=factory)
+    try:
+        _warm(svc)
+        work = _work(np.array([7, 7, 2, 9], np.int32), 4, 8)
+        assert svc.submit_many([work]) is not None
+        assert svc.drain(grace_s=120) is True
+        assert not svc.ready()
+        assert svc.engine_state() == "draining"
+        status, out = work.done.get(timeout=10)
+        assert status == "ok", out
+        assert svc.submit_many([_work(np.arange(1, 4), 3, 2)]) is None
+    finally:
+        svc.stop()
+
+
+def test_bare_step_failure_releases_and_audits_pool(lm):
+    """Satellite: WITHOUT a factory, a step failure fails the
+    in-flight work (retryable), releases every slot/block/
+    reservation, and the pool invariants hold — a poisoned arena
+    does not keep serving with leaked capacity."""
+    model, params = lm
+    eng = _factory(model, params)()
+    svc = _EngineService(eng, _Admission(0))  # unsupervised
+    try:
+        _warm(svc)
+        faults.install({"step": [1]})
+        stream_q = queue.Queue()
+        work = _work(np.array([5, 6, 7, 8], np.int32), 4, 6,
+                     stream_q=stream_q)
+        assert svc.submit_many([work]) is not None
+        while True:
+            item = stream_q.get(timeout=120)
+            if item[0] != "tok":
+                break
+        faults.reset()
+        assert item[0] == "error"
+        assert item[2] is True  # transient device fault: retryable
+        # Same engine (no rebuild), pool back to clean.
+        assert svc._engine is eng
+        assert eng.pool_leak_report() is None
+        assert _pool_is_clean(eng)
+        assert svc.stats()["engine_rebuilds"] == 0
+        # And the service keeps serving.
+        work2 = _work(np.array([1, 2, 3], np.int32), 3, 3)
+        assert svc.submit_many([work2]) is not None
+        status, out = work2.done.get(timeout=600)
+        assert status == "ok", out
+    finally:
+        svc.stop()
+
+
+def test_force_reclaim_restores_torn_pool(lm):
+    """Engine-level: a row abandoned mid-flight (the torn state a
+    device fault leaves) is fully reclaimed — blocks, reservations,
+    tables — by force_reclaim, and pool_leak_report names the tear
+    first."""
+    model, params = lm
+    eng = _factory(model, params)()
+    eng.admit(np.array([5, 6, 7, 8], np.int32), 4, max_new=4)
+    leaks = eng.pool_leak_report()
+    assert leaks is not None and "active_rows" in leaks
+    assert eng.force_reclaim() is None
+    assert _pool_is_clean(eng)
+
+
+def test_fault_plan_parsing_and_counting(monkeypatch):
+    """The CEA_TPU_FAULT_PLAN seam: env JSON parse, validation, and
+    deterministic index counting."""
+    with pytest.raises(ValueError):
+        faults.FaultPlan({"bogus_op": [1]})
+    with pytest.raises(ValueError):
+        faults.FaultPlan({"step": [-1]})
+    plan = faults.install({"step": [1]})
+    faults.fire("step")                    # index 0: clean
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("step")                # index 1: fires
+    faults.fire("step")                    # index 2: clean again
+    assert plan.fired() == {"step": [1]}
+    assert plan.counts()["step"] == 3
+    faults.reset()
+    monkeypatch.setenv("CEA_TPU_FAULT_PLAN",
+                       json.dumps({"hydrate": [0]}))
+    assert faults.active().pending() == {"hydrate": [0]}
+    faults.reset()
+
+
+# ---------------------------------------------------------------------
+# HTTP lifecycle: /readyz transitions, Retry-After, error envelope.
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gen_server(lm):
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model, params = lm
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=2,
+                           buckets=[8], warm=True)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(server, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://localhost:{server.port}{path}",
+                timeout=30) as resp:
+            return resp.status, dict(resp.headers), json.loads(
+                resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read())
+
+
+def _post(server, payload):
+    req = urllib.request.Request(
+        f"http://localhost:{server.port}/v1/models/lm:generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), err.read()
+
+
+def test_readyz_transitions_and_drain_contract(gen_server):
+    """/readyz mirrors the service lifecycle while /healthz stays
+    live: ready -> drain flips /readyz to 503 (Retry-After attached)
+    the same instant, /healthz keeps answering 200, and POSTs 503."""
+    code, _, body = _get(gen_server, "/readyz")
+    assert code == 200 and body["status"] == "ready"
+    code, _, _ = _get(gen_server, "/healthz")
+    assert code == 200
+    stats = gen_server.stats()
+    assert stats["engine_state"] == "serving"
+    gen_server.begin_drain()
+    try:
+        code, headers, body = _get(gen_server, "/readyz")
+        assert code == 503
+        assert body["status"] == "draining"
+        assert int(headers["Retry-After"]) >= 1
+        # Liveness unchanged: restarting the pod would not help.
+        code, _, _ = _get(gen_server, "/healthz")
+        assert code == 200
+        code, headers, raw = _post(gen_server,
+                                   {"prompts": [[1, 2, 3]],
+                                    "max_new_tokens": 2})
+        assert code == 503
+        assert int(headers["Retry-After"]) >= 1
+        assert "request_id" in json.loads(raw)
+    finally:
+        gen_server._draining = False
+        if gen_server._engine_service is not None:
+            with gen_server._engine_service._lock:
+                gen_server._engine_service._draining = False
+    code, _, _ = _get(gen_server, "/readyz")
+    assert code == 200
+
+
+def test_stream_error_envelope_over_http(gen_server):
+    """Satellite: a mid-stream engine failure emits a final ndjson
+    error ENVELOPE — {"error", "retryable", "request_id"} — instead
+    of dropping the socket. (Supervision is disabled for the request
+    so the fault surfaces as a stream error, not a recovery.)"""
+    svc = gen_server._engine_service
+    saved = svc._engine_factory
+    svc._engine_factory = None
+    faults.install({"step": [1]})
+    try:
+        code, _, raw = _post(gen_server,
+                             {"prompts": [[5, 6, 7]],
+                              "max_new_tokens": 6, "stream": True})
+        assert code == 200
+        lines = [json.loads(l) for l in raw.decode().splitlines()]
+        assert lines, "empty stream body"
+        last = lines[-1]
+        assert "error" in last
+        assert last["retryable"] is True
+        assert last["request_id"]
+    finally:
+        faults.reset()
+        svc._engine_factory = saved
+        # The bare-path failure released everything; service serves.
+    code, _, raw = _post(gen_server, {"prompts": [[5, 6, 7]],
+                                      "max_new_tokens": 2})
+    assert code == 200
+
+
+def test_stream_resumes_through_quarantine_over_http(gen_server):
+    """End to end over HTTP: with supervision on, a mid-stream fault
+    is INVISIBLE to the client — the stream stalls, resumes, and the
+    tokens match the same request served fault-free."""
+    payload = {"prompts": [[4, 2, 4, 2]], "max_new_tokens": 6,
+               "stream": True}
+    code, _, raw = _post(gen_server, payload)
+    assert code == 200
+    clean = [t for line in raw.decode().splitlines()
+             for t in json.loads(line).get("tokens", [])]
+    faults.install({"step": [2]})
+    try:
+        code, _, raw = _post(gen_server, payload)
+    finally:
+        faults.reset()
+    assert code == 200
+    lines = [json.loads(l) for l in raw.decode().splitlines()]
+    assert not any("error" in l for l in lines), lines
+    faulted = [t for l in lines for t in l.get("tokens", [])]
+    assert faulted == clean

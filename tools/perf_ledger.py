@@ -135,6 +135,7 @@ METRIC_DIRECTIONS = {
     # (attribution leak) or sensed (signal-plane regression).
     "block_wait_tail_share": "up",
     "saturation_under_starvation": "up",
+    "recovery_goodput_ratio": "up",
     "decode_tokens_per_sec": "up",
     "tflops": "up",
     "tflops_net": "up",

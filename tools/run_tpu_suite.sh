@@ -191,6 +191,23 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "slo-check preflight"
 
+# Serving-survivability preflight (CPU fake backend, ~2 min):
+# injected step/prefill/rehydrate faults through the real engine
+# service must quarantine, rebuild, and REPLAY every in-flight
+# stream token-identical to uninterrupted decode(), with zero
+# slot/block leaks, the stall attributed to the reqledger `recovery`
+# bucket, exactly one quarantine/recovered event pair per episode,
+# and a drain-under-fire finishing inside the grace window. A
+# regression here means a real device fault during this window's
+# serving sections would fail streams (or worse, keep stepping a
+# poisoned arena) instead of recovering. Appends the recovery
+# goodput row (clean-wall / faulted-wall) when the gate passes.
+echo "[suite] serving-chaos-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/serving_chaos_check.py --ledger PERF_LEDGER.json \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "serving-chaos-check preflight"
+
 # Analysis preflight (CPU, ~3 min): zero lint findings on the tree
 # (with every seeded fixture violation firing), a clean lock-order
 # sanitizer pass over the engine/elastic/placement suites, and the

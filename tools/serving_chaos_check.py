@@ -1,0 +1,510 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Serving survivability gate (`make serving-chaos-check`).
+
+Injects device-side failures into the engine's step, admission
+prefill, and spill-tier rehydrate sites — through the REAL
+``_EngineService`` via the ``CEA_TPU_FAULT_PLAN`` seam — and holds
+the quarantine-and-rebuild supervisor to its contract. One episode
+per fault op, plus a drain-under-fire episode, all under the
+lock-order sanitizer. Fails unless, for every fault episode:
+
+  1. every planned fault actually FIRED (an episode whose injection
+     never landed tested nothing) and every request still completed;
+  2. every greedy stream is token-identical to an uninterrupted
+     per-request ``decode()`` — the quarantine snapshot + forced-
+     prefix replay must resume streams mid-token, bit-exact;
+  3. exactly ONE ``serving.engine_quarantine`` /
+     ``serving.engine_recovered`` journal event pair was emitted;
+  4. the recovered engine's pool shows ZERO slot/block leaks (every
+     block free, nothing shared, no reservations, tables all-trash);
+  5. every retired reqledger record's buckets sum to its wall time
+     within 1% AND the outage shows up in the ``recovery`` bucket —
+     the stall is attributed, not smeared;
+
+and, for the drain episode: a drain started while a fault was
+mid-recovery still finishes every in-flight stream inside the grace
+window (token-identical), with new admissions shed (the server's
+503 + Retry-After); and the whole run is tsan-clean.
+
+``--fast`` is the presubmit leg (smaller traces, no clean-reference
+episode); ``--ledger`` (the suite leg) appends a recovery row:
+``recovery_goodput_ratio`` ("up") = useful token-work / (useful +
+replayed forced-prefix token-work) of the step episode — a
+TOKEN-work ratio, deliberately not wall clock, which on a loaded CPU
+rig swings far past the perf-check tolerance (the goodput_check
+precedent); ``time_to_recover_s`` and episode walls ride as config
+context.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["CEA_TPU_TRACE"] = "1"  # events are the acceptance surface
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+import slo_report
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.analysis import tsan  # noqa: E402
+from container_engine_accelerators_tpu.utils import faults  # noqa: E402
+
+SUM_TOL_ABS = 2e-5
+
+
+def build_model(args):
+    from container_engine_accelerators_tpu.models import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=args.vocab_size, embed_dim=args.embed_dim,
+        num_layers=args.num_layers, num_heads=args.num_heads,
+        max_seq_len=2 * (args.prompt_len + args.max_new),
+        dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def build_trace(args, rng):
+    """Greedy requests, widths within the small bucket, varied
+    budgets — replay widths (prompt + generated prefix) stay within
+    the wide bucket, so recovery rides the existing prefill/insert
+    program buckets (no new program beyond the registered set)."""
+    trace = []
+    for _ in range(args.requests):
+        p_len = int(rng.choice((4, 6, args.prompt_len)))
+        new = int(rng.integers(2, args.max_new + 1))
+        prompt = rng.integers(1, args.vocab_size,
+                              size=(p_len,)).astype(np.int32)
+        trace.append({"p_len": p_len, "new": new, "prompt": prompt})
+    return trace
+
+
+def reference_streams(model, params, trace):
+    from container_engine_accelerators_tpu.models.decode import decode
+
+    width = max(r["p_len"] for r in trace)
+    prompts = np.zeros((len(trace), width), np.int32)
+    p_lens = np.zeros((len(trace),), np.int32)
+    for i, r in enumerate(trace):
+        prompts[i, :r["p_len"]] = r["prompt"]
+        p_lens[i] = r["p_len"]
+    widest = max(r["new"] for r in trace)
+    ref = np.asarray(decode(model, params, jnp.asarray(prompts),
+                            widest, prompt_len=p_lens,
+                            fast_prefill=False))
+    return [ref[i, r["p_len"]:r["p_len"] + r["new"]].tolist()
+            for i, r in enumerate(trace)]
+
+
+def make_service(model, params, args, spill=False):
+    from container_engine_accelerators_tpu.models.decode import (
+        SlotDecodeEngine,
+    )
+    from container_engine_accelerators_tpu.serving.server import (
+        _Admission,
+        _EngineService,
+    )
+
+    def factory():
+        if spill:
+            # The hydrate episode's geometry mirrors the registered
+            # hydrate program's capture episode: a one-slot engine
+            # whose tiny arena recycles a retired row's registered
+            # blocks into the host tier, so a repeat prompt
+            # rehydrates at admission.
+            return SlotDecodeEngine(
+                model, params, slots=1, slot_len=16, paged=True,
+                kv_block_size=4, kv_blocks=5, buckets=[8],
+                kv_quant="bf16", kv_spill=True,
+                kv_spill_bytes=1 << 20)
+        return SlotDecodeEngine(
+            model, params, slots=args.slots,
+            slot_len=args.prompt_len + args.max_new, paged=True,
+            kv_block_size=4,
+            buckets=[args.prompt_len,
+                     args.prompt_len + args.max_new],
+            kv_quant="bf16", kv_spill=False)
+
+    return _EngineService(factory(), _Admission(0),
+                          engine_factory=factory)
+
+
+def make_work(prompt, p_len, new, seed=0, **kw):
+    from container_engine_accelerators_tpu.serving.server import (
+        _EngineWork,
+    )
+
+    return _EngineWork(np.asarray(prompt, np.int32), p_len, new, 0.0,
+                       0, 1.0, 0.0, 1.0, -1, False, seed, None, **kw)
+
+
+def warm(svc, *widths):
+    """Warm every bucket the episode can touch — including the wide
+    bucket replay admissions select (prompt + generated prefix) — so
+    no compile lands inside a measured episode."""
+    for width in widths:
+        work = make_work(np.zeros((width,), np.int32), width, 2,
+                         account=False, no_prefix=True)
+        if svc.submit_many([work]) is None:
+            raise RuntimeError("warm work shed")
+        status, out = work.done.get(timeout=600)
+        if status != "ok":
+            raise RuntimeError(f"warm decode failed: {out}")
+    svc.reset_counters()
+
+
+def journal_events(name):
+    return [e for e in obs.TRACER.snapshot()["events"]
+            if e["name"] == name]
+
+
+def pool_leaks(svc):
+    """Zero-slot/block-leak audit of the (possibly rebuilt) engine —
+    the engine's own invariant report, post-retirement."""
+    return svc._engine.pool_leak_report()
+
+
+def run_episode(name, svc, trace, plan=None, drain=False,
+                grace_s=120.0):
+    """Submit the trace through ``svc`` (faults armed per ``plan``),
+    wait everything out, and return the episode report. ``drain``
+    additionally starts a graceful drain WHILE the fault plan is
+    mid-flight and requires completion inside the grace window."""
+    q0 = len(journal_events("serving.engine_quarantine"))
+    r0 = len(journal_events("serving.engine_recovered"))
+    active_plan = faults.install(plan) if plan else None
+    failures = []
+    works = [make_work(r["prompt"], r["p_len"], r["new"], seed=i)
+             for i, r in enumerate(trace)]
+    t0 = time.perf_counter()
+    try:
+        if svc.submit_many(works) is None:
+            raise RuntimeError("trace shed by admission control")
+        drained = None
+        shed_during_drain = None
+        if drain:
+            # Under fire: the step fault lands while the drain is in
+            # progress; recovery must finish the streams inside the
+            # grace window with new admissions shed.
+            drained = svc.drain(grace_s=grace_s)
+            probe = make_work(trace[0]["prompt"], trace[0]["p_len"],
+                              2)
+            shed_during_drain = svc.submit_many([probe]) is None
+        errors = []
+        for i, work in enumerate(works):
+            try:
+                status, out = work.done.get(
+                    timeout=5 if drain else 600)
+            except Exception:
+                errors.append((i, "timed out"))
+                continue
+            if status != "ok":
+                errors.append((i, out))
+        wall = time.perf_counter() - t0
+        records = svc.debug_requests(
+            limit=2 * len(works))["records"]
+        stats = svc.stats()
+    finally:
+        faults.reset()
+    if errors:
+        failures.append(f"{len(errors)} request(s) errored: "
+                        f"{errors[:3]}")
+    if active_plan is not None:
+        fired, planned = active_plan.fired(), plan
+        want = {op: sorted(v) for op, v in planned.items() if v}
+        got = {op: sorted(v) for op, v in fired.items()}
+        if got != want:
+            failures.append(
+                f"planned faults did not all fire: planned {want}, "
+                f"fired {got} (counts {active_plan.counts()}) — the "
+                f"episode tested nothing")
+    quarantines = len(journal_events("serving.engine_quarantine")) - q0
+    recoveries = len(journal_events("serving.engine_recovered")) - r0
+    want_pairs = 1 if plan else 0
+    if quarantines != want_pairs or recoveries != want_pairs:
+        failures.append(
+            f"expected exactly {want_pairs} quarantine/recovered "
+            f"event pair(s), saw {quarantines}/{recoveries}")
+    leaks = pool_leaks(svc)
+    if leaks:
+        failures.append(f"slot/block leaks after recovery: {leaks}")
+    report = slo_report.analyze(records)
+    violations = (report.get("sum_to_wall") or {}).get("violations")
+    if len(records) != len(trace):
+        failures.append(f"{len(records)} retired records for "
+                        f"{len(trace)} requests")
+    if violations:
+        failures.append(
+            f"{len(violations)} record(s) violate sum-to-wall (1%): "
+            f"{violations[:3]}")
+    recovery_s = sum(r["buckets"].get("recovery", 0.0)
+                     for r in records)
+    if plan and recovery_s <= 0.0:
+        failures.append("no request carries recovery-bucket time — "
+                        "the outage stall is unattributed")
+    if stats["engine_state"] != ("draining" if drain else "serving"):
+        failures.append(f"engine_state {stats['engine_state']!r} "
+                        f"after the episode")
+    if drain:
+        if drained is not True:
+            failures.append("drain-under-fire did not finish "
+                            "in-flight streams inside the grace "
+                            "window")
+        if shed_during_drain is not True:
+            failures.append("admissions were NOT shed during drain")
+    return {
+        "episode": name,
+        "wall_s": round(wall, 3),
+        "requests": len(trace),
+        "recovery_s": round(recovery_s, 6),
+        "rebuilds": stats["engine_rebuilds"],
+        "replayed_rows": stats["replayed_rows"],
+        "replayed_tokens": stats["replayed_tokens"],
+        "quarantine_events": quarantines,
+        "recovered_events": recoveries,
+        "tokens": [w.tokens for w in works],
+        "failures": failures,
+    }
+
+
+def check_tokens(episode, ref, failures):
+    mismatched = [i for i, (out, want)
+                  in enumerate(zip(episode["tokens"], ref))
+                  if out != want]
+    if mismatched:
+        failures.append(
+            f"[{episode['episode']}] greedy streams diverged from "
+            f"uninterrupted decode() for requests {mismatched[:5]} "
+            f"— replay must be token-identical")
+
+
+def time_to_recover():
+    """Seconds from the LAST quarantine event to its recovered event
+    (journal unix stamps) — the suite's trend metric context."""
+    quar = journal_events("serving.engine_quarantine")
+    rec = journal_events("serving.engine_recovered")
+    if not quar or not rec:
+        return None
+    return round(rec[-1]["unix"] - quar[-1]["unix"], 6)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--requests", type=int, default=None,
+                   help="trace size (default 8; 4 with --fast)")
+    p.add_argument("--fast", action="store_true",
+                   help="the presubmit leg: smaller traces, no "
+                        "clean-reference episode")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--prompt-len", type=int, default=8,
+                   help="widest prompt = the narrow engine bucket")
+    p.add_argument("--max-new", type=int, default=8)
+    p.add_argument("--vocab-size", type=int, default=48)
+    p.add_argument("--embed-dim", type=int, default=32)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--step-at", type=int, default=3,
+                   help="step invocation index the step episode "
+                        "faults at")
+    p.add_argument("--prefill-at", type=int, default=2,
+                   help="prefill invocation index the prefill "
+                        "episode faults at")
+    p.add_argument("--drain-grace-s", type=float, default=120.0)
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append the recovery trend row to the perf "
+                        "ledger (source serving_chaos_check)")
+    args = p.parse_args(argv)
+    if args.requests is None:
+        args.requests = 4 if args.fast else 8
+
+    import perf_ledger
+
+    perf_ledger.ensure_backend_or_skip("serving_chaos_check",
+                                       args.ledger)
+
+    model, params = build_model(args)
+    rng = np.random.default_rng(args.seed)
+    trace = build_trace(args, rng)
+    ref = reference_streams(model, params, trace)
+
+    # The whole run steps real engines under the lock-order
+    # sanitizer: the supervisor's rebuild path crosses the loop
+    # thread, request threads, and the drain waiter — exactly where
+    # an inversion would hide.
+    tsan_state = tsan.install(force=True)
+    failures = []
+    episodes = []
+    faults.reset()
+    try:
+        if not args.fast:
+            svc = make_service(model, params, args)
+            try:
+                warm(svc, args.prompt_len,
+                     args.prompt_len + args.max_new)
+                ep = run_episode("clean", svc, trace)
+                episodes.append(ep)
+                failures.extend(ep.pop("failures"))
+                check_tokens(ep, ref, failures)
+            finally:
+                svc.stop()
+
+        for name, plan in (
+                ("step", {"step": [args.step_at]}),
+                ("prefill", {"prefill": [args.prefill_at]})):
+            svc = make_service(model, params, args)
+            try:
+                warm(svc, args.prompt_len,
+                     args.prompt_len + args.max_new)
+                ep = run_episode(name, svc, trace, plan=plan)
+                episodes.append(ep)
+                failures.extend(ep.pop("failures"))
+                check_tokens(ep, ref, failures)
+            finally:
+                svc.stop()
+
+        # Hydrate episode: serial A -> fillers (recycle A's blocks
+        # into the host tier) -> A again, whose admission rehydrates
+        # and faults mid-upload; the replay re-prefills on the
+        # rebuilt (empty) arena, token-identical.
+        hyd_trace = [
+            {"p_len": 6, "new": 2,
+             "prompt": np.array([1, 2, 3, 4, 5, 6], np.int32)},
+            {"p_len": 6, "new": 2,
+             "prompt": np.array([9, 8, 7, 6, 5, 4], np.int32)},
+            {"p_len": 6, "new": 2,
+             "prompt": np.array([11, 12, 13, 14, 15, 16], np.int32)},
+        ]
+        hyd_ref = reference_streams(model, params, hyd_trace)
+        svc = make_service(model, params, args, spill=True)
+        try:
+            warm(svc, 8)
+            # Serialize the spill setup (1 slot makes this FIFO
+            # anyway), then fire the fault on the repeat admission.
+            for i, r in enumerate(hyd_trace):
+                w = make_work(r["prompt"], r["p_len"], r["new"],
+                              seed=i)
+                if svc.submit_many([w]) is None:
+                    raise RuntimeError("hydrate setup shed")
+                status, out = w.done.get(timeout=600)
+                if status != "ok":
+                    raise RuntimeError(f"hydrate setup failed: {out}")
+                if w.tokens != hyd_ref[i]:
+                    failures.append(
+                        "[hydrate] setup stream diverged from "
+                        "decode()")
+            svc.reset_counters()
+            ep = run_episode("hydrate", svc, [hyd_trace[0]],
+                             plan={"hydrate": [0]})
+            episodes.append(ep)
+            failures.extend(ep.pop("failures"))
+            check_tokens(dict(ep, tokens=ep["tokens"]),
+                         [hyd_ref[0]], failures)
+        finally:
+            svc.stop()
+
+        # Drain-under-fire: the fault lands while the drain runs.
+        svc = make_service(model, params, args)
+        try:
+            warm(svc, args.prompt_len,
+                 args.prompt_len + args.max_new)
+            ep = run_episode("drain", svc, trace,
+                             plan={"step": [args.step_at]},
+                             drain=True,
+                             grace_s=args.drain_grace_s)
+            episodes.append(ep)
+            failures.extend(ep.pop("failures"))
+            check_tokens(ep, ref, failures)
+        finally:
+            svc.stop()
+        ttr = time_to_recover()
+    finally:
+        faults.reset()
+        tsan_rep = tsan_state.report()
+        tsan.uninstall()
+
+    if not tsan.is_clean(tsan_rep):
+        print(tsan.format_report(tsan_rep), file=sys.stderr)
+        failures.append(
+            "lock-order sanitizer reported findings over the "
+            "serving chaos episodes")
+
+    by_name = {e["episode"]: e for e in episodes}
+    goodput_ratio = None
+    if "step" in by_name:
+        # Recovery goodput across the step episode, in TOKEN-work
+        # units (deterministic given seed + fault index — wall
+        # clocks at this scale are rig noise): the useful work an
+        # uninterrupted run pays (prompt prefill + generated steps)
+        # over useful + the replay's re-prefilled forced prefixes.
+        useful = sum(r["p_len"] + r["new"] for r in trace)
+        replayed = by_name["step"]["replayed_tokens"]
+        goodput_ratio = round(useful / (useful + replayed), 4)
+    summary = {
+        "platform": jax.devices()[0].platform,
+        "config": {k: getattr(args, k) for k in
+                   ("requests", "slots", "prompt_len", "max_new",
+                    "step_at", "prefill_at", "seed", "fast")},
+        "episodes": [{k: v for k, v in e.items() if k != "tokens"}
+                     for e in episodes],
+        "recovery_goodput_ratio": goodput_ratio,
+        "time_to_recover_s": ttr,
+        "tsan": {"locks": tsan_rep["locks_created"],
+                 "edges": tsan_rep["edges"]},
+    }
+    print(json.dumps(summary))
+
+    if failures:
+        for f in failures:
+            print(f"[serving-chaos] FAIL: {f}", file=sys.stderr)
+        return 1
+
+    if args.ledger and goodput_ratio is not None:
+        err = perf_ledger.try_append(
+            args.ledger, "serving_chaos_check",
+            {"recovery_goodput_ratio": goodput_ratio},
+            devices=jax.devices(),
+            config=dict(summary["config"],
+                        time_to_recover_s=ttr))
+        if err:
+            # Episode passed, history append failed: harness error.
+            print(f"[serving-chaos] HARNESS ERROR: perf-ledger "
+                  f"append: {err}", file=sys.stderr)
+            return 2
+    print("[serving-chaos] PASS: faulted streams token-identical, "
+          "pool clean, stalls attributed, drain-under-fire inside "
+          "grace, tsan clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

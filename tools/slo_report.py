@@ -48,10 +48,10 @@ import urllib.request
 # import-free so this tool runs from a bare checkout next to a bundle
 # file; the shapes are contract-tested in tests/test_reqledger.py).
 ATTRIBUTION_BUCKETS = ("queue_wait", "block_wait", "prefill",
-                       "rehydrate", "decode_gap",
+                       "rehydrate", "recovery", "decode_gap",
                        "stream_backpressure", "other")
 TTFT_BUCKETS = ("queue_wait", "block_wait", "prefill", "rehydrate")
-GAP_BUCKETS = ("decode_gap", "stream_backpressure")
+GAP_BUCKETS = ("decode_gap", "stream_backpressure", "recovery")
 
 DEFAULT_TOLERANCE = 0.01
 # Absolute floor under the relative sum-to-wall tolerance: records
