@@ -39,6 +39,8 @@ presubmit:
 	python3 tools/perf_ledger.py check
 	JAX_PLATFORMS=cpu python3 tools/slo_check.py --fast
 	JAX_PLATFORMS=cpu python3 tools/serving_chaos_check.py --fast
+	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
+		--spec-check
 
 # Project-native analysis gate: the AST lint must report ZERO
 # findings over the tree while every seeded fixture violation fires;
@@ -132,6 +134,17 @@ spill-check:
 	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
 		--spill-check
 
+# Speculative-decode guard: replay the occupancy Poisson trace
+# through the engine with a self-draft configured (--spec-k chunks)
+# and again with speculation off; fail unless the speculative replay
+# retains >= 2x the batcher baseline's goodput with the draft's
+# device calls on the ledger, self-draft acceptance holds its floor,
+# every greedy stream is bit-identical to per-request decode(), and
+# both arenas (target + draft) release clean. Pure CPU, ~1 min.
+spec-check:
+	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
+		--spec-check
+
 # Latency-attribution guard: replay a synthetic greedy trace with
 # INJECTED KV-block starvation through the instrumented serving loop
 # (_EngineService + paged engine, arena sized for ~2 of 4 slots);
@@ -195,5 +208,5 @@ clean:
 .PHONY: all native test test-native test-native-asan presubmit bench \
 	analysis-check program-check trace-check diagnose-check \
 	goodput-check chaos-check placement-check occupancy-check \
-	paging-check spill-check perf-check slo-check \
+	paging-check spill-check spec-check perf-check slo-check \
 	serving-chaos-check container partition-tpu push clean

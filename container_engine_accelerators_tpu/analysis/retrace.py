@@ -131,6 +131,30 @@ def engine_programs(paged=True):
     )
 
 
+def spec_engine_programs(paged=True):
+    """(name, fn) pairs a DRAFT-CONFIGURED engine adds to the bound:
+    ONE draft-step scan, ONE verify (the batch-1 -> k widening of the
+    step program — gate-off rows ride it single-token), and ONE
+    draft-insert program. The draft's admission prefill rides the
+    dense prefill program at the admission bucket width, so it adds
+    no program of its own. Watch these (budget 1 each) alongside
+    :func:`engine_programs` when replaying speculative traffic."""
+    from ..models import decode
+
+    if paged:
+        return (
+            ("engine.paged_draft", decode._paged_draft_impl),
+            ("engine.paged_verify", decode._paged_verify_impl),
+            ("engine.paged_draft_insert",
+             decode._paged_draft_insert_impl),
+        )
+    return (
+        ("engine.dense_draft", decode._slot_draft_impl),
+        ("engine.dense_verify", decode._slot_verify_impl),
+        ("engine.dense_draft_insert", decode._draft_insert_impl),
+    )
+
+
 def engine_guard(paged=True, prefill_budget=1):
     """A guard preloaded with the engine bound: ``prefill_budget``
     programs for admission prefill (= number of distinct admission

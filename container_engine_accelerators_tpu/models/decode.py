@@ -787,11 +787,18 @@ def stream_decode(model, params, prompt, max_new_tokens, *,
             return
 
 
-# Unregistered: offline/batch beam search, not a serving hot path.
-@functools.partial(jax.jit,  # lint: disable=program-registry
+@functools.lru_cache(maxsize=1)
+def _beam_jit():
+    """Call-site jit for the offline/batch beam-search path: not a
+    serving hot program, so it stays OUT of the module-scope jit set
+    the program-registry lint holds against hot_program_specs() —
+    the manifest pins serving programs only."""
+    return jax.jit(_beam_impl,
                    static_argnames=("model", "max_new_tokens",
                                     "num_beams", "use_eos",
                                     "use_lp"))
+
+
 def _beam_impl(model, params, prompt, max_new_tokens, eos_id, alpha,
                *, num_beams, use_eos=False, use_lp=False):
     b, p = prompt.shape
@@ -1135,6 +1142,7 @@ KV_BLOCKS_ENV = "CEA_TPU_KV_BLOCKS"
 KV_QUANT_ENV = "CEA_TPU_KV_QUANT"
 KV_SPILL_ENV = "CEA_TPU_KV_SPILL"
 KV_SPILL_BYTES_ENV = "CEA_TPU_KV_SPILL_BYTES"
+SPEC_KV_BLOCKS_ENV = "CEA_TPU_SPEC_KV_BLOCKS"
 
 # Host-RAM spill tier default byte budget (256 MiB): bounded so a
 # long-tail prefix population can't grow host residency without
@@ -1569,10 +1577,12 @@ def _arena_to_dense(dense, arena, table, shared_len):
     block_table leaves and [slots]-shaped index vectors, so ndim
     heuristics don't apply — data leaves gather+reshape through
     ``table`` (logical position p comes back at dense index p), index
-    leaves become the traced chunk offset ``shared_len``. Entries of
-    ``table`` beyond the shared span point at the trash block; their
-    junk sits at positions >= shared_len, where the chunk's causal
-    mask never reaches before the chunk's own writes land."""
+    leaves become the traced chunk offset ``shared_len`` (broadcast
+    to the dense leaf's shape: scalar for the ring-path prefill,
+    ``[1]`` for the per-row windowed prefill). Entries of ``table``
+    beyond the shared span point at the trash block; their junk sits
+    at positions >= shared_len, where the chunk's causal mask never
+    reaches before the chunk's own writes land."""
     flat_d = traverse_util.flatten_dict(unfreeze(dense))
     flat_a = traverse_util.flatten_dict(unfreeze(arena))
     out = {}
@@ -1581,8 +1591,9 @@ def _arena_to_dense(dense, arena, table, shared_len):
             aval = flat_a[path]
             g = aval[table].reshape((1, -1) + aval.shape[2:])
             out[path] = g[:, :dval.shape[1]].astype(dval.dtype)
-        else:  # cache_index / pos_index scalars
-            out[path] = jnp.asarray(shared_len, jnp.int32)
+        else:  # cache_index / pos_index scalars (or [1] per-row)
+            out[path] = jnp.broadcast_to(
+                jnp.asarray(shared_len, jnp.int32), dval.shape)
     return traverse_util.unflatten_dict(out)
 
 
@@ -1603,7 +1614,12 @@ def _paged_prefill_impl(model, params, arena, prefix_table, row,
     seen_row [V] bool, rng [2])."""
     decode_model, cache = init_cache(model, 1, slot_len)
     cache = _arena_to_dense(cache, arena, prefix_table, shared_len)
-    chunk_model = decode_model.clone(chunk_attends_cache=True)
+    # Per-row (windowed) prefill models attend the cache by default;
+    # the scalar-index path needs the explicit chunk_attends_cache
+    # clone to reach back past the chunk's own writes.
+    chunk_model = (decode_model
+                   if getattr(decode_model, "per_row_index", False)
+                   else decode_model.clone(chunk_attends_cache=True))
     outputs, updated = chunk_model.apply(
         {"params": params, "cache": cache}, row,
         train=False, mutable=["cache"])
@@ -1723,6 +1739,210 @@ def _paged_step_impl(model, params, cache, row_pos, seen, rngs, tok,
             seen, rngs, nxt, lp)
 
 
+def _verify_commit(cache, row_pos, seen, rngs, raw, proposals, active,
+                   spec_gate, temps, top_ks, top_ps, min_ps,
+                   rep_pens):
+    """Shared tail of the dense/paged verify programs: turn the
+    chunk's raw logits [slots, k, V] into per-row accepted prefixes.
+
+    Column 0 goes through the full ``_slot_sample`` chain under the
+    row's own knobs — for a gate-off row that IS the single-token
+    step, bit-identical sampling, penalties, and rng discipline (one
+    split per step per row). Columns 1..k-1 are greedy-scored;
+    ``m[row]`` counts the longest matched draft prefix (forced 0
+    where the gate is off, so gate-off rows advance exactly one
+    position). The host consumes ``counts[row] = m + 1`` tokens per
+    active row; rejected-tail K/V left in the cache beyond
+    ``row_pos + counts`` is dead weight the next chunk's writes
+    overwrite before any mask admits it — acceptance rollback is a
+    per-row position rewind, not a cache edit."""
+    k = proposals.shape[1] + 1
+    slots, vocab = raw.shape[0], raw.shape[-1]
+    tok0, lp0, rngs = _slot_sample(raw[:, 0], seen, temps, top_ks,
+                                   top_ps, min_ps, rep_pens, rngs)
+    greedy = jnp.argmax(raw, axis=-1).astype(jnp.int32)  # [slots, k]
+    toks = jnp.concatenate([tok0[:, None], greedy[:, 1:]], axis=1)
+    match = (proposals == toks[:, :k - 1]).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    m = jnp.where(spec_gate, m, 0)
+    counts = jnp.where(active, m + 1, 0).astype(jnp.int32)
+    lsm = jax.nn.log_softmax(raw.astype(jnp.float32), axis=-1)
+    lp_all = jnp.take_along_axis(
+        lsm, toks[..., None].astype(jnp.int32), axis=2)[..., 0]
+    lps = jnp.concatenate([lp0[:, None], lp_all[:, 1:]], axis=1)
+    cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+    rows = jnp.broadcast_to(
+        jnp.arange(slots, dtype=jnp.int32)[:, None], (slots, k))
+    # Mark only the CONSUMED tokens seen (col 0 unconditionally —
+    # exact parity with the single-token step's update).
+    idx = jnp.where(cols <= m[:, None], toks, vocab)
+    seen = seen.at[rows, idx].set(True, mode="drop")
+    return (cache, row_pos + counts, seen, rngs, toks, lps, counts)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "k"),
+                   donate_argnums=(2,))
+def _slot_draft_impl(model, params, cache, row_pos, tok, *, k):
+    """ONE draft step over every slot: k-1 greedy micro-steps of the
+    draft model through its own dense slot pool, seeded with each
+    row's last committed token. A ``lax.scan`` keeps it one compiled
+    program regardless of k — the engine's program bound gains
+    exactly one draft-step program, never one per micro-step.
+    Returns (draft cache, proposals [slots, k-1])."""
+    slot_len = next(leaf for leaf in jax.tree_util.tree_leaves(cache)
+                    if leaf.ndim >= 2).shape[1]
+
+    def micro(carry, j):
+        cache, tok = carry
+        pos = jnp.minimum(row_pos + j, slot_len - 1)
+        outputs, updated = model.apply(
+            {"params": params, "cache": _with_row_index(cache, pos)},
+            tok[:, None], train=False, mutable=["cache"])
+        nxt = jnp.argmax(_logits_of(outputs)[:, 0],
+                         axis=-1).astype(jnp.int32)
+        return (updated["cache"], nxt), nxt
+
+    (cache, _), props = jax.lax.scan(
+        micro, (cache, tok), jnp.arange(k - 1, dtype=jnp.int32))
+    return cache, props.T
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnums=(2, 3, 4, 5))
+def _slot_verify_impl(model, params, cache, row_pos, seen, rngs, tok,
+                      proposals, active, spec_gate, temps, top_ks,
+                      top_ps, min_ps, rep_pens):
+    """ONE speculative decode step over every slot: feed each row's
+    [last token | k-1 draft proposals] chunk at its own position and
+    commit per-row accepted prefixes (see ``_verify_commit``). This
+    is the batch-1 -> k widening of ``_slot_step_impl``: rows with
+    the gate off (sampling rows, near-budget rows, plain traffic)
+    take the single-token path through this SAME program. Returns
+    (cache, row_pos + counts, seen, rngs, toks [slots, k],
+    lps [slots, k], counts [slots])."""
+    slot_len = next(leaf for leaf in jax.tree_util.tree_leaves(cache)
+                    if leaf.ndim >= 2).shape[1]
+    pos = jnp.minimum(row_pos, slot_len - 1)
+    chunk = jnp.concatenate([tok[:, None], proposals], axis=1)
+    outputs, updated = model.apply(
+        {"params": params, "cache": _with_row_index(cache, pos)},
+        chunk, train=False, mutable=["cache"])
+    raw = _logits_of(outputs)                       # [slots, k, V]
+    return _verify_commit(updated["cache"], row_pos, seen, rngs, raw,
+                          proposals, active, spec_gate, temps,
+                          top_ks, top_ps, min_ps, rep_pens)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _draft_insert_impl(cache, pre_cache, slot):
+    """Scatter a batch-1 draft prefill into draft pool row ``slot``.
+
+    Cache data only: the engine's per-row sampling state (seen/rngs)
+    belongs to the TARGET stream — the draft stream is greedy by
+    construction and owns no sampling state."""
+    return jax.tree_util.tree_map(
+        lambda eng, pre: (eng.at[slot].set(pre[0])
+                         if pre.ndim >= 2 else eng),
+        cache, pre_cache)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "k"),
+                   donate_argnums=(2,))
+def _paged_draft_impl(model, params, cache, row_pos, tok, tables, *,
+                      k):
+    """The draft step on the draft block arena: inject the draft
+    block tables once, then run the same k-1 greedy scan as the
+    dense draft step. Rows without speculation keep all-trash draft
+    tables, so their micro-step writes land on junk no mask admits.
+    Returns (draft cache, proposals [slots, k-1])."""
+    flat = traverse_util.flatten_dict(unfreeze(cache))
+    block_size = next(leaf.shape[1] for path, leaf in flat.items()
+                      if path[-1] in _PAGED_DATA_LEAVES)
+    span = tables.shape[1] * block_size
+    for path in list(flat):
+        if path[-1] == "block_table":
+            flat[path] = tables
+    cache = traverse_util.unflatten_dict(flat)
+
+    def micro(carry, j):
+        cache, tok = carry
+        pos = jnp.minimum(row_pos + j, span - 1)
+        outputs, updated = model.apply(
+            {"params": params, "cache": _with_row_index(cache, pos)},
+            tok[:, None], train=False, mutable=["cache"])
+        nxt = jnp.argmax(_logits_of(outputs)[:, 0],
+                         axis=-1).astype(jnp.int32)
+        return (updated["cache"], nxt), nxt
+
+    (cache, _), props = jax.lax.scan(
+        micro, (cache, tok), jnp.arange(k - 1, dtype=jnp.int32))
+    return cache, props.T
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnums=(2, 3, 4, 5))
+def _paged_verify_impl(model, params, cache, row_pos, seen, rngs,
+                       tok, proposals, active, spec_gate, temps,
+                       top_ks, top_ps, min_ps, rep_pens, tables,
+                       cow_src, cow_dst):
+    """The speculative step on the paged arena: apply the span's COW
+    forks first (``cow_src``/``cow_dst`` are [slots, F] — a chunk
+    span can cross a block boundary, so a row may fork more than one
+    shared block; sentinel num_blocks = no-op), inject tables and
+    positions, then run the same verify-and-commit chain as the
+    dense pool. Gate-off rows' junk proposal columns write through
+    their tables' trash/own-tail entries — overwritten before any
+    mask admits them."""
+    flat = traverse_util.flatten_dict(unfreeze(cache))
+    block_size = next(leaf.shape[1] for path, leaf in flat.items()
+                      if path[-1] in _PAGED_DATA_LEAVES)
+    cow_src = cow_src.reshape(-1)
+    cow_dst = cow_dst.reshape(-1)
+    for path, leaf in flat.items():
+        name = path[-1]
+        if name in _PAGED_DATA_LEAVES:
+            nb = leaf.shape[0]
+            flat[path] = leaf.at[cow_dst].set(
+                leaf[jnp.clip(cow_src, 0, nb - 1)], mode="drop")
+    pos = jnp.minimum(row_pos, tables.shape[1] * block_size - 1)
+    for path in list(flat):
+        name = path[-1]
+        if name in ("cache_index", "pos_index"):
+            flat[path] = pos
+        elif name == "block_table":
+            flat[path] = tables
+    chunk = jnp.concatenate([tok[:, None], proposals], axis=1)
+    outputs, updated = model.apply(
+        {"params": params,
+         "cache": traverse_util.unflatten_dict(flat)},
+        chunk, train=False, mutable=["cache"])
+    raw = _logits_of(outputs)                       # [slots, k, V]
+    return _verify_commit(updated["cache"], row_pos, seen, rngs, raw,
+                          proposals, active, spec_gate, temps,
+                          top_ks, top_ps, min_ps, rep_pens)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paged_draft_insert_impl(cache, pre_cache, dest_per_pos):
+    """Scatter a batch-1 draft prefill into the draft block arena.
+
+    Same position -> physical-destination convention as
+    ``_paged_insert_impl`` (sentinel rows drop), minus the COW fork
+    and row-state updates: draft blocks are never shared and the
+    draft stream owns no sampling state."""
+    flat_c = traverse_util.flatten_dict(unfreeze(cache))
+    flat_p = traverse_util.flatten_dict(unfreeze(pre_cache))
+    for path, leaf in flat_c.items():
+        if path[-1] not in _PAGED_DATA_LEAVES:
+            continue
+        pre = flat_p[path]
+        bs = leaf.shape[1]
+        offsets = jnp.arange(pre.shape[1], dtype=jnp.int32) % bs
+        flat_c[path] = leaf.at[dest_per_pos, offsets].set(
+            pre[0].astype(leaf.dtype), mode="drop")
+    return traverse_util.unflatten_dict(flat_c)
+
+
 class EngineCapacityError(RuntimeError):
     """An ``admit`` that the pool cannot hold RIGHT NOW (no free
     slot / block budget short) — transient by definition: a release
@@ -1743,9 +1963,29 @@ class SlotDecodeEngine:
     thread (the serving engine loop); the pool state is deliberately
     unsynchronized.
 
-    Requires a dense KV cache (``attention_window == 0``): a reused
-    ring slot's stale position metadata could leak stale keys into a
-    rewound row's window, so windowed models stay on the batch path.
+    **Windowed models** run in slots on FULL-LENGTH band-masked
+    caches (the per-row window band in ``transformer.py``), not
+    rings: a reused ring slot's stale position metadata could leak
+    stale keys into a rewound row's window, so the engine trades the
+    ring's memory saving for the slot pool's reuse-safety — the
+    admission prefill rides a ``per_row_index`` clone so its batch-1
+    cache has the same full-length layout.
+
+    **Speculative decoding** (``draft_model=``/``spec_k=``): greedy
+    rows draft k-1 proposal tokens through a per-slot draft cache
+    (its own, smaller, block arena in paged mode —
+    ``CEA_TPU_SPEC_KV_BLOCKS`` / ``spec_kv_blocks=`` sizes it) and
+    verify them as ONE width-k chunk through the verify program —
+    the batch-1 -> k widening of the step program. Acceptance is
+    per-row (``counts[row]`` tokens commit; rejection is a position
+    rewind, never a cache edit), and rows with speculation off —
+    sampling rows, near-budget rows, plain traffic — take the
+    single-token path through the SAME program, so the program bound
+    stays: buckets + insert + hydrate + ONE step + ONE draft-step.
+    With a draft model configured, ``step()`` returns
+    ``(toks [slots, k], lps [slots, k], counts [slots])`` — the
+    caller consumes ``counts[row]`` tokens per row; without one, the
+    two-tuple contract is unchanged.
 
     **Paged mode** (default; ``CEA_TPU_PAGED_KV=0`` or ``paged=False``
     restores the dense pool bit-for-bit): the per-slot cache rows
@@ -1782,18 +2022,44 @@ class SlotDecodeEngine:
     def __init__(self, model, params, slots, slot_len, *, paged=None,
                  kv_block_size=None, kv_blocks=None, buckets=None,
                  pin_reserve_tokens=0, kv_quant=None, kv_spill=None,
-                 kv_spill_bytes=None):
-        if getattr(model, "attention_window", 0):
-            raise ValueError(
-                "SlotDecodeEngine requires a dense cache "
-                "(attention_window=0); windowed models use the "
-                "run-to-completion batch path")
+                 kv_spill_bytes=None, draft_model=None,
+                 draft_params=None, spec_k=0, spec_kv_blocks=None):
         if slot_len > model.max_seq_len:
             raise ValueError(
                 f"slot_len {slot_len} exceeds max_seq_len "
                 f"{model.max_seq_len}")
         if slots < 1 or slot_len < 2:
             raise ValueError("need slots >= 1 and slot_len >= 2")
+        if draft_model is not None:
+            if draft_params is None:
+                raise ValueError(
+                    "draft_model requires draft_params")
+            if int(spec_k) < 2:
+                raise ValueError(
+                    f"spec_k must be >= 2 (the verify chunk width; "
+                    f"k-1 draft proposals per step): {spec_k}")
+            if getattr(draft_model, "attention_window", 0):
+                raise ValueError(
+                    "draft model must use a dense cache "
+                    "(attention_window=0); only the TARGET model "
+                    "may be windowed")
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab_size} != "
+                    f"target vocab {model.vocab_size}")
+            if slot_len > draft_model.max_seq_len:
+                raise ValueError(
+                    f"slot_len {slot_len} exceeds draft "
+                    f"max_seq_len {draft_model.max_seq_len}")
+            for m, which in ((model, "target"),
+                             (draft_model, "draft")):
+                experts = int(getattr(m, "num_experts", 0) or 0)
+                if experts and (m.capacity_factor * m.top_k
+                                < experts):
+                    raise ValueError(
+                        f"{which} MoE model can drop tokens "
+                        f"(capacity_factor * top_k < num_experts); "
+                        "verify logits would not be reproducible")
         # Tiered-KV quantization (CEA_TPU_KV_QUANT / kv_quant=):
         # int8/int4 clone the whole model family's cache dtype, so
         # prefill/insert/step — and the dense fallback — all
@@ -1808,6 +2074,13 @@ class SlotDecodeEngine:
             model = model.clone(kv_cache_dtype=quant)
         self.kv_quant = _model_quant_mode(model)
         self._base_model = model
+        # Windowed models need the batch-1 admission prefill to build
+        # the slot pool's full-length band-masked cache layout, so it
+        # rides a per_row_index clone; window == 0 keeps the scalar-
+        # index prefill model (and its compiled programs) unchanged.
+        self._prefill_model = (
+            model.clone(per_row_index=True)
+            if getattr(model, "attention_window", 0) else model)
         self._params = params
         # Parameter counts: the 2·N-FLOPs-per-token analytic basis
         # the serving loop's tpu_decode_mfu gauge rates against
@@ -1932,6 +2205,71 @@ class SlotDecodeEngine:
         # programs than distinct widths is a silent-retrace leak —
         # the occupancy bench derives its prefill budget from this.
         self.prefill_widths = collections.Counter()
+        # Speculative counters exist on every engine (stats readers
+        # do not branch on configuration); they only move when a
+        # draft model is configured.
+        self.spec_steps = 0      # step() calls with >= 1 gated row
+        self.spec_row_steps = 0  # gated row-steps (rows that verified)
+        self.spec_proposed = 0   # draft proposals offered (k-1/row)
+        self.spec_accepted = 0   # draft proposals accepted
+        self.draft_prefills = 0
+        self._draft_model = None
+        self._spec_k = 0
+        if draft_model is not None:
+            self._spec_k = int(spec_k)
+            if quant != "bf16" and (_model_quant_mode(draft_model)
+                                    != quant):
+                draft_model = draft_model.clone(kv_cache_dtype=quant)
+            self._draft_model = draft_model
+            self._draft_params = draft_params
+            if self.paged:
+                # The draft arena is its OWN (smaller) block pool: a
+                # plain free list — draft blocks are never shared
+                # (no prefix index, no COW, no spill) and a row's
+                # whole span is allocated at admission, so the draft
+                # step never allocates. Default = every slot can
+                # hold a full row (+1 trash block); the knob exists
+                # to shrink it when spec traffic is a minority.
+                dnb = (spec_kv_blocks
+                       or env_number(SPEC_KV_BLOCKS_ENV, None,
+                                     parse=int))
+                if dnb is not None:
+                    dnb = int(dnb)
+                else:
+                    dnb = self.slots * self._n_blk + 1
+                if dnb < self._n_blk + 1:
+                    raise ValueError(
+                        f"spec_kv_blocks {dnb} cannot hold even one "
+                        f"full row ({self._n_blk} blocks) plus the "
+                        "trash block")
+                self._draft_num_blocks = dnb
+                self._draft_trash = dnb - 1
+                self._draft_free = collections.deque(range(dnb - 1))
+                self._draft_tables = np.full(
+                    (self.slots, self._n_blk), self._draft_trash,
+                    np.int32)
+                self._draft_blocks = [[] for _ in range(self.slots)]
+                self._draft_step_model = _decode_clone(
+                    draft_model).clone(per_row_index=True,
+                                       kv_pages=(dnb,
+                                                 self._block_size))
+            else:
+                self._draft_step_model = _decode_clone(
+                    draft_model).clone(per_row_index=True)
+            self._draft_cache = _slot_cache_init(
+                self._draft_step_model, self.slots, self.slot_len)
+            self.spec_kv_arena_bytes = int(sum(
+                leaf.size * leaf.dtype.itemsize
+                for path, leaf in traverse_util.flatten_dict(
+                    unfreeze(self._draft_cache)).items()
+                if path[-1] in _PAGED_DATA_LEAVES))
+            # Per-row speculation gate state. _pos_host mirrors the
+            # device row positions (the paged pool keeps one anyway;
+            # a dense pool grows one only when drafting).
+            self._spec_row = np.zeros((self.slots,), bool)
+            self._span_limit = np.zeros((self.slots,), np.int64)
+            if not self.paged:
+                self._pos_host = np.zeros((self.slots,), np.int64)
 
     def free_slots(self):
         return int((~self._active).sum())
@@ -1949,7 +2287,7 @@ class SlotDecodeEngine:
         self.prefills += 1
         self.prefill_widths[int(row.shape[1])] += 1
         return _slot_prefill_impl(
-            self._base_model, self._params, row,
+            self._prefill_model, self._params, row,
             jnp.asarray(prompt_len, jnp.int32),
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(top_k, jnp.int32),
@@ -2028,17 +2366,39 @@ class SlotDecodeEngine:
                 # never looked up (or vice versa).
                 "share_eligible": share}
 
+    def _spec_eligible(self, temperature, repetition_penalty):
+        """Whether a row with these knobs drafts: speculation is a
+        greedy-stream optimization — a sampled row's verify column
+        would need full per-proposal acceptance sampling, and a
+        penalized row's draft stream would need the target's seen
+        state — so both take the single-token path in the SAME
+        program instead."""
+        return (self._draft_model is not None
+                and float(temperature) == 0.0
+                and float(repetition_penalty) == 1.0)
+
+    def _draft_span_blocks(self, prompt_len, max_new):
+        """Draft blocks a row's whole span needs (allocated at
+        admission — the draft step never allocates)."""
+        if max_new is None:
+            max_new = self.slot_len - prompt_len
+        limit = min(prompt_len + int(max_new), self.slot_len)
+        return -(-limit // self._block_size)
+
     def admission_block_cause(self, tokens, prompt_len, max_new=None,
                               *, allow_prefix=True,
-                              repetition_penalty=1.0):
+                              repetition_penalty=1.0,
+                              temperature=0.0):
         """What an ``admit`` with these arguments is blocked on NOW:
         ``"slots"`` (no free slot), ``"kv_blocks"`` (free slot, but
         the block budget — free minus other rows' reservations —
-        cannot cover the row's worst-case private span), or None
-        (admissible). This is the cause the serving loop's latency
-        attribution and the ``tpu_serving_saturation_cause`` gauges
-        report; the third admission blocker, the server's queue cap,
-        lives above the engine (a shed never reaches ``admit``)."""
+        cannot cover the row's worst-case private span),
+        ``"spec_kv_blocks"`` (a drafting row's span does not fit the
+        draft arena's free list), or None (admissible). This is the
+        cause the serving loop's latency attribution and the
+        ``tpu_serving_saturation_cause`` gauges report; the third
+        admission blocker, the server's queue cap, lives above the
+        engine (a shed never reaches ``admit``)."""
         if self.free_slots() == 0:
             return "slots"
         if not self.paged:
@@ -2048,10 +2408,15 @@ class SlotDecodeEngine:
                                 count=False)
         if self._pool.available() < plan["needed"]:
             return "kv_blocks"
+        if (self._spec_eligible(temperature, repetition_penalty)
+                and len(self._draft_free)
+                < self._draft_span_blocks(prompt_len, max_new)):
+            return "spec_kv_blocks"
         return None
 
     def can_admit(self, tokens, prompt_len, max_new=None, *,
-                  allow_prefix=True, repetition_penalty=1.0):
+                  allow_prefix=True, repetition_penalty=1.0,
+                  temperature=0.0):
         """Whether ``admit`` with these arguments would succeed NOW.
         Dense pool: a free slot suffices. Paged pool: additionally
         the block budget (free minus other rows' reservations) must
@@ -2061,7 +2426,8 @@ class SlotDecodeEngine:
         additionally names the starved resource."""
         return self.admission_block_cause(
             tokens, prompt_len, max_new, allow_prefix=allow_prefix,
-            repetition_penalty=repetition_penalty) is None
+            repetition_penalty=repetition_penalty,
+            temperature=temperature) is None
 
     def block_availability(self):
         """(available, usable) KV blocks — *available* nets out
@@ -2082,7 +2448,7 @@ class SlotDecodeEngine:
         self.prefills += 1
         self.prefill_widths[int(width)] += 1
         return _paged_prefill_impl(
-            self._base_model, self._params, self._cache,
+            self._prefill_model, self._params, self._cache,
             jnp.asarray(prefix_table), jnp.asarray(row[None]),
             jnp.asarray(shared_len, jnp.int32),
             jnp.asarray(len(suffix), jnp.int32),
@@ -2354,7 +2720,7 @@ class SlotDecodeEngine:
             return None
         pool = self._pool
         used = pool.usable - pool.free_count()
-        return {
+        stats = {
             "kv_blocks_total": pool.usable,
             "kv_blocks_free": pool.free_count(),
             "kv_blocks_shared": pool.shared_count(),
@@ -2380,6 +2746,12 @@ class SlotDecodeEngine:
                 if pool.spill_probes else None),
             "kv_rehydrated_blocks": int(pool.rehydrated_blocks),
         }
+        if self._draft_model is not None:
+            stats["spec_kv_blocks_total"] = (
+                self._draft_num_blocks - 1)
+            stats["spec_kv_blocks_free"] = len(self._draft_free)
+            stats["spec_kv_arena_bytes"] = self.spec_kv_arena_bytes
+        return stats
 
     def reset_prefix_counters(self):
         """Zero the prefix-sharing telemetry counters (no-op on the
@@ -2444,6 +2816,17 @@ class SlotDecodeEngine:
         if free.size == 0:
             raise EngineCapacityError("no free slot; release one first")
         slot = int(free[0])
+        spec = self._spec_eligible(temperature, repetition_penalty)
+        if spec and self.paged:
+            # Gate on the draft arena BEFORE any pool mutation: an
+            # exhausted draft free list queues the admission cleanly
+            # (transient — a release frees a whole span at once).
+            d_need = self._draft_span_blocks(prompt_len, max_new)
+            if len(self._draft_free) < d_need:
+                raise EngineCapacityError(
+                    f"insufficient free draft KV blocks "
+                    f"(need {d_need}, free {len(self._draft_free)});"
+                    " queue the admission")
         if self.paged:
             plan = self._paged_plan(tokens, prompt_len, max_new,
                                     allow_prefix, repetition_penalty)
@@ -2460,6 +2843,19 @@ class SlotDecodeEngine:
                                   jnp.asarray(slot, jnp.int32),
                                   jnp.asarray(prompt_len, jnp.int32),
                                   seen_row, rng_row))
+        if self._draft_model is not None:
+            if not self.paged:
+                self._pos_host[slot] = prompt_len
+            self._spec_row[slot] = spec
+            if spec:
+                limit = min(
+                    prompt_len + (int(max_new) if max_new is not None
+                                  else self.slot_len - prompt_len),
+                    self.slot_len)
+                self._span_limit[slot] = limit
+                self._admit_draft(slot, tokens, prompt_len)
+            else:
+                self._span_limit[slot] = 0
         first_tok = int(first[0])
         self._tok[slot] = first_tok
         self._active[slot] = True
@@ -2469,6 +2865,50 @@ class SlotDecodeEngine:
         self._min_ps[slot] = min_p
         self._rep_pens[slot] = repetition_penalty
         return slot, first_tok, float(first_lp[0]), np.asarray(echo)
+
+    def _admit_draft(self, slot, tokens, prompt_len):
+        """Mirror an admitted greedy row into the draft pool: claim
+        its whole-span draft blocks (paged — checked up front in
+        ``admit``, so this cannot run short), prefill the FULL prompt
+        through the draft model (no prefix sharing: draft blocks are
+        private by construction), and scatter it into the row's
+        draft cache. Draft-block bookkeeping lands in
+        ``_draft_blocks`` BEFORE the device calls so a torn
+        admission's ``release``/``force_reclaim`` reclaims them."""
+        row = np.asarray(tokens, np.int32).reshape(-1)
+        if self.paged:
+            bs = self._block_size
+            d_need = -(-int(self._span_limit[slot]) // bs)
+            blocks = [self._draft_free.popleft()
+                      for _ in range(d_need)]
+            self._draft_blocks[slot] = blocks
+            self._draft_tables[slot, :d_need] = blocks
+            width = self._pick_width(prompt_len, 0)
+            padded = np.zeros((width,), np.int32)
+            padded[:prompt_len] = row[:prompt_len]
+        else:
+            padded = row
+        self.draft_prefills += 1
+        pre, _, _, _, _, _ = _slot_prefill_impl(
+            self._draft_model, self._draft_params,
+            jnp.asarray(padded, jnp.int32)[None, :],
+            jnp.asarray(prompt_len, jnp.int32),
+            jnp.asarray(0.0, jnp.float32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(1.0, jnp.float32),
+            jnp.asarray(0.0, jnp.float32),
+            jnp.asarray(1.0, jnp.float32), jax.random.PRNGKey(0),
+            slot_len=self.slot_len)
+        if self.paged:
+            dest_per_pos = np.full((self.slot_len,),
+                                   self._draft_num_blocks, np.int32)
+            span = np.arange(prompt_len)
+            dest_per_pos[span] = self._draft_tables[slot,
+                                                    span // bs]
+            self._draft_cache = _paged_draft_insert_impl(
+                self._draft_cache, pre, jnp.asarray(dest_per_pos))
+        else:
+            self._draft_cache = _draft_insert_impl(
+                self._draft_cache, pre, jnp.asarray(slot, jnp.int32))
 
     def _paged_prestep(self):
         """Host-side block upkeep before a step: every active row is
@@ -2505,14 +2945,130 @@ class SlotDecodeEngine:
                 self._take_commit(slot)
         return cow_src, cow_dst
 
+    def _paged_spec_prestep(self, gate):
+        """Block upkeep for a verify step: a gated row writes its
+        whole [pos, pos + k) chunk span this step, so every trash
+        block in the span allocates (the admission reservation
+        guarantees success — the gate keeps the span inside the
+        reserved total) and every shared one copy-on-write-forks; a
+        span can cross a block boundary, so the fork vectors are
+        [slots, F]. Non-gated active rows write one position — the
+        single-token prestep; their junk proposal-column writes land
+        on trash/own-tail blocks no mask ever admits."""
+        sentinel = self._num_blocks
+        bs = self._block_size
+        forks = (self._spec_k + bs - 1) // bs + 1
+        cow_src = np.full((self.slots, forks), sentinel, np.int32)
+        cow_dst = np.full((self.slots, forks), sentinel, np.int32)
+        for slot in np.flatnonzero(self._active):
+            wp = int(self._pos_host[slot])
+            if wp >= self.slot_len:
+                continue  # clamped row; its writes rewrite junk
+            span = self._spec_k if gate[slot] else 1
+            hi = min(wp + span, self.slot_len)
+            nf = 0
+            for bi in range(wp // bs, (hi - 1) // bs + 1):
+                cur = int(self._tables[slot, bi])
+                if cur == self._trash:
+                    b = self._pool.alloc()
+                    self._tables[slot, bi] = b
+                    self._slot_blocks[slot].append(b)
+                    self._take_commit(slot)
+                elif self._pool.ref[cur] > 1:
+                    dst = self._pool.alloc()
+                    cow_src[slot, nf] = cur
+                    cow_dst[slot, nf] = dst
+                    nf += 1
+                    self._tables[slot, bi] = dst
+                    self._slot_blocks[slot].remove(cur)
+                    self._slot_blocks[slot].append(dst)
+                    self._pool.decref(cur)
+                    self._take_commit(slot)
+        return cow_src, cow_dst
+
+    def _spec_step(self):
+        """One speculative step: draft k-1 proposals for every gated
+        row (greedy + within budget), verify the width-k chunks, and
+        commit per-row accepted prefixes. Returns
+        (toks [slots, k], lps [slots, k], counts [slots]) — the
+        caller consumes counts[row] tokens of row `row`. The gate
+        turns speculation off per row near the span budget so the
+        chunk's writes stay inside the admission reservation; those
+        rows advance exactly one token through the same program."""
+        k = self._spec_k
+        gate = (self._active & self._spec_row
+                & (self._pos_host + k <= self._span_limit))
+        any_gated = bool(gate.any())
+        if self.paged:
+            cow_src, cow_dst = self._paged_spec_prestep(gate)
+            faults.fire("step")
+            if any_gated:
+                self._draft_cache, props = _paged_draft_impl(
+                    self._draft_step_model, self._draft_params,
+                    self._draft_cache, self._row_pos,
+                    jnp.asarray(self._tok),
+                    jnp.asarray(self._draft_tables), k=k)
+            else:
+                props = jnp.zeros((self.slots, k - 1), jnp.int32)
+            (self._cache, self._row_pos, self._seen, self._rngs,
+             toks, lps, counts) = _paged_verify_impl(
+                self._step_model, self._params, self._cache,
+                self._row_pos, self._seen, self._rngs,
+                jnp.asarray(self._tok), props,
+                jnp.asarray(self._active), jnp.asarray(gate),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps), jnp.asarray(self._min_ps),
+                jnp.asarray(self._rep_pens),
+                jnp.asarray(self._tables), jnp.asarray(cow_src),
+                jnp.asarray(cow_dst))
+        else:
+            faults.fire("step")
+            if any_gated:
+                self._draft_cache, props = _slot_draft_impl(
+                    self._draft_step_model, self._draft_params,
+                    self._draft_cache, self._row_pos,
+                    jnp.asarray(self._tok), k=k)
+            else:
+                props = jnp.zeros((self.slots, k - 1), jnp.int32)
+            (self._cache, self._row_pos, self._seen, self._rngs,
+             toks, lps, counts) = _slot_verify_impl(
+                self._step_model, self._params, self._cache,
+                self._row_pos, self._seen, self._rngs,
+                jnp.asarray(self._tok), props,
+                jnp.asarray(self._active), jnp.asarray(gate),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps), jnp.asarray(self._min_ps),
+                jnp.asarray(self._rep_pens))
+        toks = np.asarray(toks)
+        lps = np.asarray(lps)
+        counts = np.asarray(counts)
+        last = np.maximum(counts, 1) - 1
+        np.copyto(self._tok,
+                  toks[np.arange(self.slots), last],
+                  where=self._active)
+        self._pos_host += counts
+        self.steps += 1
+        self.row_steps += int(self._active.sum())
+        if any_gated:
+            self.spec_steps += 1
+            self.spec_row_steps += int(gate.sum())
+            self.spec_proposed += int(gate.sum()) * (k - 1)
+            self.spec_accepted += int((counts[gate] - 1).sum())
+        return toks, lps, counts
+
     def step(self):
         """Advance EVERY slot one token (one compiled program call).
         Returns (tokens [slots] i32, logprobs [slots] f32) — entries
         for free slots are scratch. No-op (returns None) when the
-        pool is empty."""
+        pool is empty. With a draft model configured the step is
+        speculative instead and returns
+        (toks [slots, k], lps [slots, k], counts [slots]) — see
+        ``_spec_step``."""
         if not self._active.any():
             return None
         tsan.note_write("engine.slot_tables", self)
+        if self._draft_model is not None:
+            return self._spec_step()
         if self.paged:
             # The fault fires AFTER the host-side block upkeep:
             # write-block allocations and COW bookkeeping have
@@ -2572,6 +3128,16 @@ class SlotDecodeEngine:
             self._pool.committed -= int(self._committed_slot[slot])
             self._committed_slot[slot] = 0
             self._pos_host[slot] = 0
+        if self._draft_model is not None:
+            if self.paged and self._draft_blocks[slot]:
+                self._draft_free.extend(self._draft_blocks[slot])
+                self._draft_blocks[slot] = []
+            if self.paged:
+                self._draft_tables[slot, :] = self._draft_trash
+            else:
+                self._pos_host[slot] = 0
+            self._spec_row[slot] = False
+            self._span_limit[slot] = 0
         self._active[slot] = False
         self._temps[slot] = 0.0
         self._top_ks[slot] = 0
@@ -2611,6 +3177,18 @@ class SlotDecodeEngine:
         if refsum != pinned:
             problems["refcounts"] = {"held": refsum,
                                      "pinned": pinned}
+        if self._draft_model is not None:
+            free_d = len(self._draft_free)
+            if free_d != self._draft_num_blocks - 1:
+                problems["draft_blocks"] = {
+                    "free": free_d,
+                    "expected": self._draft_num_blocks - 1}
+            if not bool((self._draft_tables
+                         == self._draft_trash).all()):
+                problems["draft_tables"] = [
+                    int(s) for s in range(self.slots)
+                    if (self._draft_tables[s]
+                        != self._draft_trash).any()]
         return problems or None
 
     def force_reclaim(self):
@@ -2681,12 +3259,12 @@ def beam_search(model, params, prompt, max_new_tokens, *,
         raise ValueError(
             "length_penalty applies to finished beams and therefore "
             "requires eos_id")
-    return _beam_impl(model, params, prompt, max_new_tokens,
-                      jnp.asarray(eos_id if use_eos else -1,
-                                  jnp.int32),
-                      jnp.asarray(length_penalty, jnp.float32),
-                      num_beams=int(num_beams), use_eos=use_eos,
-                      use_lp=use_lp)
+    return _beam_jit()(model, params, prompt, max_new_tokens,
+                       jnp.asarray(eos_id if use_eos else -1,
+                                   jnp.int32),
+                       jnp.asarray(length_penalty, jnp.float32),
+                       num_beams=int(num_beams), use_eos=use_eos,
+                       use_lp=use_lp)
 
 
 # ---------------------------------------------------------------------
@@ -2714,22 +3292,46 @@ def _hot_example_model():
     return model, params
 
 
-def _hot_example_engine(paged, kv_quant="bf16"):
+def _hot_example_draft():
+    """The canonical tiny DRAFT model: same vocab as the example
+    target (a spec pairing requirement), half the width and depth —
+    the cheap-proposer shape speculative serving runs."""
+    from .transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=48, embed_dim=16, num_layers=1,
+                          num_heads=2, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _hot_example_engine(paged, kv_quant="bf16", window=0,
+                        spec=False):
     """The canonical tiny engine the manifest derives against:
     deterministic init (fixed PRNG keys), one 8-wide bucket, block
     size 4 — small enough to lower in seconds, structurally identical
     to production (per-layer cache trees, block tables, the full
     sampling-knob signature). ``kv_quant`` selects the quantized-
     arena variants (int8/int4 buffers + scale blocks change the
-    program avals, so each mode fingerprints separately)."""
+    program avals, so each mode fingerprints separately); ``window``
+    clones a sliding-window target (the band-masked step/prefill
+    programs); ``spec`` attaches the example draft model (the
+    draft/verify program family)."""
     model, params = _hot_example_model()
+    if window:
+        model = model.clone(attention_window=window)
     kwargs = ({"paged": True, "kv_block_size": 4} if paged
               else {"paged": False})
+    if spec:
+        draft_model, draft_params = _hot_example_draft()
+        kwargs.update(draft_model=draft_model,
+                      draft_params=draft_params, spec_k=3)
     return SlotDecodeEngine(model, params, slots=4, slot_len=24,
                             buckets=[8], kv_quant=kv_quant, **kwargs)
 
 
-def _hot_engine_calls(paged, kv_quant="bf16"):
+def _hot_engine_calls(paged, kv_quant="bf16", window=0):
     """{program global name: (args, kwargs)} of each engine program's
     first REAL call, captured by swapping the module globals for
     recorders while one admission + one step runs on the canonical
@@ -2750,7 +3352,7 @@ def _hot_engine_calls(paged, kv_quant="bf16"):
     for name in names:
         globals()[name] = recorder(name)
     try:
-        eng = _hot_example_engine(paged, kv_quant)
+        eng = _hot_example_engine(paged, kv_quant, window=window)
         row = np.zeros((8,), np.int32)
         row[:6] = np.arange(4, 10, dtype=np.int32)
         eng.admit(row, 6)
@@ -2758,6 +3360,46 @@ def _hot_engine_calls(paged, kv_quant="bf16"):
     finally:
         for name in names:
             globals()[name] = real[name]
+    return calls
+
+
+def _hot_spec_calls(paged):
+    """{program global name: (args, kwargs)} of the speculative
+    programs' first real calls: the draft prefill rides the already-
+    registered admission prefill program, so the captures here are
+    the draft-arena insert, the k-1 draft-step scan, and the width-k
+    verify — one greedy admission + one speculative step on the
+    canonical engine + example draft model."""
+    names = (("_paged_draft_insert_impl", "_paged_draft_impl",
+              "_paged_verify_impl") if paged else
+             ("_draft_insert_impl", "_slot_draft_impl",
+              "_slot_verify_impl"))
+    real = {name: globals()[name] for name in names}
+    calls = {}
+
+    def recorder(name):
+        def wrapped(*args, **kwargs):
+            calls.setdefault(name, (args, kwargs))
+            return real[name](*args, **kwargs)
+        return wrapped
+
+    for name in names:
+        globals()[name] = recorder(name)
+    try:
+        eng = _hot_example_engine(paged, spec=True)
+        row = np.zeros((8,), np.int32)
+        row[:6] = np.arange(4, 10, dtype=np.int32)
+        eng.admit(row, 6)
+        eng.step()
+    finally:
+        for name in names:
+            globals()[name] = real[name]
+    missing = [name for name in names if name not in calls]
+    if missing:
+        raise RuntimeError(
+            f"spec capture episode never called {missing} — the "
+            "speculative step path changed; fix the scripted "
+            "episode")
     return calls
 
 
@@ -2800,17 +3442,28 @@ def _hot_hydrate_call():
 def hot_program_specs():
     """The slot engine's registered hot programs: the dense and paged
     prefill/insert/step trios (the paged trio additionally in its
-    int8 and int4 quantized-arena modes) plus the spill-tier
-    rehydrate upload, each bound to the args of a real call on the
-    canonical example engine. tools/program_manifest.py derives
-    PROGRAM_MANIFEST.json from this list and `make program-check`
-    re-derives and diffs."""
+    int8 and int4 quantized-arena modes), the windowed target's
+    band-masked prefill/step pair (its insert is aval-identical to
+    the dense one), the speculative draft/verify program family
+    (dense and paged), and the spill-tier rehydrate upload — each
+    bound to the args of a real call on the canonical example
+    engine. tools/program_manifest.py derives PROGRAM_MANIFEST.json
+    from this list and `make program-check` re-derives and diffs.
+
+    The serving program bound this registry pins: one prefill per
+    admission width (+ one draft prefill per width when drafting) +
+    insert (+ draft insert) + hydrate + ONE step + ONE draft-step —
+    speculation and windowed serving add programs per ENGINE
+    CONFIGURATION, never per step or per k."""
     from ..analysis.xprog import HotProgram
 
     dense = _hot_engine_calls(paged=False)
     paged = _hot_engine_calls(paged=True)
     int8 = _hot_engine_calls(paged=True, kv_quant="int8")
     int4 = _hot_engine_calls(paged=True, kv_quant="int4")
+    windowed = _hot_engine_calls(paged=False, window=8)
+    spec_dense = _hot_spec_calls(paged=False)
+    spec_paged = _hot_spec_calls(paged=True)
     hydrate = _hot_hydrate_call()
     return (
         HotProgram("engine.dense_prefill", _slot_prefill_impl,
@@ -2837,6 +3490,23 @@ def hot_program_specs():
                    *int4["_paged_insert_impl"]),
         HotProgram("engine.paged_int4_step", _paged_step_impl,
                    *int4["_paged_step_impl"]),
+        HotProgram("engine.windowed_prefill", _slot_prefill_impl,
+                   *windowed["_slot_prefill_impl"]),
+        HotProgram("engine.windowed_step", _slot_step_impl,
+                   *windowed["_slot_step_impl"]),
+        HotProgram("engine.dense_draft_insert", _draft_insert_impl,
+                   *spec_dense["_draft_insert_impl"]),
+        HotProgram("engine.dense_draft", _slot_draft_impl,
+                   *spec_dense["_slot_draft_impl"]),
+        HotProgram("engine.dense_verify", _slot_verify_impl,
+                   *spec_dense["_slot_verify_impl"]),
+        HotProgram("engine.paged_draft_insert",
+                   _paged_draft_insert_impl,
+                   *spec_paged["_paged_draft_insert_impl"]),
+        HotProgram("engine.paged_draft", _paged_draft_impl,
+                   *spec_paged["_paged_draft_impl"]),
+        HotProgram("engine.paged_verify", _paged_verify_impl,
+                   *spec_paged["_paged_verify_impl"]),
         HotProgram("engine.paged_hydrate", _paged_hydrate_impl,
                    *hydrate),
     )

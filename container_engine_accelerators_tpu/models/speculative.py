@@ -112,17 +112,22 @@ def _rewind(cache, position):
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
-# Not in the hot-program registry: the static flag set makes this a
-# per-config program FAMILY, and speculation still rides the legacy
-# batch path (ROADMAP item 1 folds it into the slot engine — its step
-# program joins the registry then).
-@functools.partial(  # lint: disable=program-registry
-    jax.jit, static_argnames=("model", "draft_model", "max_new_tokens",
-                              "k", "return_stats", "ragged",
-                              "use_eos", "sample", "use_active",
-                              "use_logprobs", "top_k", "use_top_p",
-                              "use_min_p", "use_prefix", "p0",
-                              "cache_fan"))
+@functools.lru_cache(maxsize=1)
+def _spec_jit():
+    """Call-site jit for the batch speculative path: serving
+    speculation now rides the slot engine's registered draft/verify
+    programs (models.decode.hot_program_specs), so this offline/
+    batch program family stays OUT of the module-scope jit set the
+    program-registry lint holds against the registry."""
+    return jax.jit(
+        _spec_impl,
+        static_argnames=("model", "draft_model", "max_new_tokens",
+                         "k", "return_stats", "ragged", "use_eos",
+                         "sample", "use_active", "use_logprobs",
+                         "top_k", "use_top_p", "use_min_p",
+                         "use_prefix", "p0", "cache_fan"))
+
+
 def _spec_impl(model, params, draft_model, draft_params, prompt,
                max_new_tokens, k, return_stats, ragged, prompt_len,
                use_eos, eos_id, sample, temperature, rng, use_active,
@@ -819,16 +824,17 @@ def _prepare_and_run_spec(model, params, draft_model, draft_params,
         act_arr = jnp.asarray(act_host)
     else:
         act_arr = jnp.ones((b,), bool)
-    return _spec_impl(model, params, draft_model, draft_params,
-                      jnp.asarray(prompt, jnp.int32), max_new_tokens,
-                      k, return_stats, ragged, plen_arr, use_eos,
-                      eos_arr, sample, jnp.asarray(t_host), rng,
-                      use_active, act_arr, bool(return_logprobs),
-                      top_k, use_top_p, tp_arr, use_min_p, mp_arr,
-                      use_prefix=use_prefix, p0=p0,
-                      cache_fan=cache_fan,
-                      t_prefix_cache=t_prefix_cache,
-                      d_prefix_cache=d_prefix_cache)
+    return _spec_jit()(model, params, draft_model, draft_params,
+                       jnp.asarray(prompt, jnp.int32),
+                       max_new_tokens, k, return_stats, ragged,
+                       plen_arr, use_eos, eos_arr, sample,
+                       jnp.asarray(t_host), rng, use_active, act_arr,
+                       bool(return_logprobs), top_k, use_top_p,
+                       tp_arr, use_min_p, mp_arr,
+                       use_prefix=use_prefix, p0=p0,
+                       cache_fan=cache_fan,
+                       t_prefix_cache=t_prefix_cache,
+                       d_prefix_cache=d_prefix_cache)
 
 
 def speculative_decode_with_prefix(model, params, draft_model,

@@ -220,8 +220,10 @@ class CausalSelfAttention(nn.Module):
     # row's K/V at its own slot-local offset and mask attention at
     # its own horizon. Changes the cache TREE (vector counters), so a
     # per-row cache is not interchangeable with a scalar-index cache.
-    # Dense caches only (no sliding-window ring), single-token steps
-    # after init.
+    # Never a ring: sliding-window models keep a full-length per-row
+    # cache with the window enforced as a band lower bound in the
+    # horizon mask. Steps may feed multi-token chunks (the engine's
+    # k-wide speculative verify); chunks always attend the cache.
     per_row_index: bool = False
     # Paged KV cache (the slot engine's block pool): a
     # (num_blocks, block_size) tuple replaces the per-row dense
@@ -323,19 +325,23 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(
                 f"kv_cache_dtype=\"int4\" packs value pairs along the "
                 f"head dim and needs it even, got {q.shape[-1]}")
-        if self.per_row_index and (self.window or self.ring_slack):
-            # A freed-then-reused ring slot's stale slot_pos could
+        if self.per_row_index and self.ring_slack:
+            # Slot-engine caches are never rings (see `ring` below):
+            # a freed-then-reused ring slot's stale slot_pos could
             # pass the window band for a row rewound to an earlier
-            # per-row position — the engine rejects windowed models
-            # instead of serving silently-corrupt attention.
+            # per-row position. Windowed models run in slots on a
+            # FULL-LENGTH arena with a per-row band mask instead, so
+            # ring_slack — a ring-shape concept — has no meaning here.
             raise ValueError(
-                "per_row_index requires a dense cache "
-                "(attention_window=0)")
+                "per_row_index does not take ring_slack (slot-engine "
+                "windowed caches are full-length and band-masked, "
+                "not rings)")
         if self.per_row_index and self.chunk_attends_cache:
             raise ValueError(
                 "per_row_index does not compose with "
                 "chunk_attends_cache (speculative verify chunks use "
-                "the shared scalar index)")
+                "the shared scalar index; per-row multi-token chunks "
+                "attend the cache by default)")
         paged = self.kv_pages is not None
         if paged and not self.per_row_index:
             raise ValueError(
@@ -351,8 +357,14 @@ class CausalSelfAttention(nn.Module):
         # instead of the full sequence: position p lives in slot
         # p % window, so cache residency is O(window) however long
         # generation runs — for a 32k-context model with a 4k window
-        # that is 8x less HBM than the full-length cache.
-        ring = bool(self.window)
+        # that is 8x less HBM than the full-length cache. The slot
+        # engine's per-row caches are the exception: rows rewind and
+        # slots are reused, so a ring's slot_pos staleness could leak
+        # evicted keys into a rewound row's band — per-row windowed
+        # caches stay FULL-LENGTH (dense or paged arena alike) and
+        # the window is enforced purely by the band lower bound in
+        # the horizon mask below.
+        ring = bool(self.window) and not self.per_row_index
         # Sizing only applies at variable creation (the full-length
         # init pass); later calls see k.shape[1] == 1 and must take
         # the ring length from the existing buffer instead.
@@ -403,33 +415,45 @@ class CausalSelfAttention(nn.Module):
             """Write a [B, Q, ...] update at positions i..i+Q-1
             (ring-aware; the prefill chunk's wrap split is static
             because Q and the ring length are static and i == 0 by
-            the one-shot-prefill contract). Per-row index: i is [B]
-            and Q == 1 — each row writes at its OWN offset (scatter;
-            rows are distinct, so update order is immaterial)."""
+            the one-shot-prefill contract). Per-row index: i is [B] —
+            each row writes at its OWN offsets (scatter; rows are
+            distinct and a row's Q positions are distinct, so update
+            order is immaterial). Q > 1 is the speculative verify
+            chunk: positions past the row's arena drop (OOB sentinel)
+            — a row that cannot hold the whole chunk simply loses the
+            optimistic tail, whose keys the engine never commits."""
             zeros = (0,) * (val.ndim - 2)
             if self.per_row_index:
-                if val.shape[1] != 1:
-                    raise ValueError(
-                        "per_row_index caches take single-token "
-                        "steps only after init (the slot engine "
-                        "prefills through a scalar-index cache and "
-                        "inserts)")
+                bq = val.shape[0]
+                # [B, Q] per-row positions i..i+Q-1.
+                p = (i[:, None]
+                     + jnp.arange(val.shape[1], dtype=jnp.int32))
                 if paged:
-                    # (block, offset) addressing: row b writes at
-                    # physical block table[b, i//bs], offset i%bs.
-                    # Active rows own their write block exclusively
-                    # (engine refcount/COW invariant), so the scatter
-                    # has no meaningful collisions; free rows' tables
-                    # all point at the trash block, whose junk no
-                    # horizon mask ever admits.
+                    # (block, offset) addressing: row b's position p
+                    # writes at physical block table[b, p//bs],
+                    # offset p%bs. Active rows own their write blocks
+                    # exclusively (engine refcount/COW invariant), so
+                    # the scatter has no meaningful collisions; free
+                    # rows' tables and unallocated logical tails all
+                    # point at the trash block, whose junk no horizon
+                    # mask ever admits. Positions past the table span
+                    # route to an OOB sentinel and DROP — clamping
+                    # them to the last block would overwrite the
+                    # row's own live tail.
                     tbl = block_table.value
                     bs = cached_k.value.shape[1]
-                    phys = tbl[jnp.arange(val.shape[0]),
-                               jnp.minimum(i // bs,
-                                           tbl.shape[1] - 1)]
-                    return buf.at[phys, i % bs].set(val[:, 0])
-                return buf.at[jnp.arange(val.shape[0]), i].set(
-                    val[:, 0])
+                    nb = cached_k.value.shape[0]
+                    in_span = p // bs < tbl.shape[1]
+                    phys = jnp.take_along_axis(
+                        tbl, jnp.minimum(p // bs, tbl.shape[1] - 1),
+                        axis=1)
+                    phys = jnp.where(in_span, phys, nb)
+                    return buf.at[phys, p % bs].set(val, mode="drop")
+                slot_cap = buf.shape[1]
+                rows = jnp.broadcast_to(
+                    jnp.arange(bq, dtype=jnp.int32)[:, None], p.shape)
+                rows = jnp.where(p < slot_cap, rows, bq)
+                return buf.at[rows, p].set(val, mode="drop")
             if not ring:
                 return jax.lax.dynamic_update_slice(
                     buf, val, (0, i) + zeros)
@@ -503,7 +527,8 @@ class CausalSelfAttention(nn.Module):
             slot_pos.value = cache_write(slot_pos.value, pos_vals)
         index.value = i + q.shape[1]
 
-        if q.shape[1] > 1 and not self.chunk_attends_cache:
+        if (q.shape[1] > 1 and not self.chunk_attends_cache
+                and not self.per_row_index):
             # Multi-token chunks normally occur only at one-shot
             # prefill, where the cache was empty (decode.py feeds
             # single tokens after prefill). Attention then reduces to
@@ -512,9 +537,12 @@ class CausalSelfAttention(nn.Module):
             # kernel on the raw chunk: O(P*block) score memory
             # instead of [B, H, P, S_max] against the cache, and no
             # int8 round-trip for the prefill tokens' own scores.
-            # Speculative verify steps clone the model with
-            # chunk_attends_cache=True and fall through to the
-            # general cached path below, whose position masks are
+            # Batch-path speculative verify steps clone the model
+            # with chunk_attends_cache=True; per-row multi-token
+            # chunks (the slot engine's k-wide verify, and windowed
+            # admission prefills whose band reaches back into the
+            # cache) ALWAYS attend the cache — both fall through to
+            # the general cached path below, whose position masks are
             # already chunk-correct at any offset.
             heads = q.shape[2]
             return flash_attention(q, _expand_kv(k, heads),
@@ -591,6 +619,15 @@ class CausalSelfAttention(nn.Module):
             k_pos = jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, dimension=4)
             keep = k_pos <= q_pos
+            if self.window:
+                # Per-row windowed (slot engine): the cache is a
+                # full-length arena, so the sliding window is pure
+                # masking — the same band lower bound the ring
+                # branch applies, minus the staleness term (nothing
+                # is ever evicted, every in-band key is live). Valid
+                # for dense and paged arenas alike: the paged gather
+                # above restores logical position order first.
+                keep = keep & (k_pos > q_pos - self.window)
         scores = jnp.where(keep, scores, -1e9)
         probs = jax.nn.softmax(scores, axis=-1)
         if quantized:

@@ -28,8 +28,6 @@ Endpoints:
   GET  /stats                     request count + latency summary
 """
 
-import contextlib
-import functools
 import json
 import queue
 import threading
@@ -397,6 +395,14 @@ class _EngineService:
         self._replayed_tokens = 0   # forced-prefix tokens re-prefilled
         self._admitted = 0
         self._retired = 0
+        # Speculation counters absorbed from engines a quarantine
+        # tore down: /stats reports base + live engine, so a rebuild
+        # neither loses nor double-counts accepted tokens (the
+        # replay re-prefills delivered tokens as a forced PREFIX —
+        # prefills never touch these counters).
+        self._spec_base = {"spec_steps": 0, "spec_row_steps": 0,
+                           "spec_proposed": 0, "spec_accepted": 0,
+                           "draft_prefills": 0}
         self._occ_hist = obs.histogram(
             OCCUPANCY_HISTOGRAM,
             "Decode-step slot occupancy (active/total)",
@@ -597,6 +603,12 @@ class _EngineService:
             active = eng.active_count()
             occ = (round(row_steps / steps, 3) if steps else None)
             violations = dict(self._slo_violations)
+            base = self._spec_base
+            spec_steps = base["spec_steps"] + eng.spec_steps
+            spec_rows = base["spec_row_steps"] + eng.spec_row_steps
+            proposed = base["spec_proposed"] + eng.spec_proposed
+            accepted = base["spec_accepted"] + eng.spec_accepted
+            drafts = base["draft_prefills"] + eng.draft_prefills
             return {
                 "slots": eng.slots,
                 "slots_active": active,
@@ -645,6 +657,24 @@ class _EngineService:
                                or saturation(slots_active=active,
                                              slots_total=eng.slots)),
                 "admission_blocked_on": self._last_block_cause,
+                # Speculation surface (counters exist on every
+                # engine; they only move with a draft configured).
+                # Cumulative across quarantine rebuilds via the
+                # absorbed base. acceptance_rate: fraction of draft
+                # proposals the verify committed — the alpha in the
+                # break-even model; accepted_tokens_per_step: mean
+                # tokens a speculating row commits per step (>= 1;
+                # the per-chip throughput multiplier).
+                "spec_steps": spec_steps,
+                "spec_proposed_tokens": proposed,
+                "spec_accepted_tokens": accepted,
+                "draft_prefills": drafts,
+                "speculative_acceptance_rate": (
+                    round(accepted / proposed, 4)
+                    if proposed else None),
+                "accepted_tokens_per_step": (
+                    round((accepted + spec_rows) / spec_rows, 3)
+                    if spec_rows else None),
                 # Paged-pool surface (absent on the dense fallback):
                 # block occupancy + prefix sharing effectiveness.
                 **(eng.kv_block_stats() or {}),
@@ -674,6 +704,18 @@ class _EngineService:
             # would swallow the first post-reset hits from the
             # tpu_serving_kv_spill_hits_total deltas.
             self._engine.reset_prefix_counters()
+            # Acceptance counters reset WITH the rest: warm-up's
+            # synthetic greedy rows gate real speculative steps (by
+            # design — they compile the draft/verify programs), and
+            # their degenerate acceptance must not stand as the
+            # traffic alpha.
+            self._engine.spec_steps = 0
+            self._engine.spec_row_steps = 0
+            self._engine.spec_proposed = 0
+            self._engine.spec_accepted = 0
+            self._engine.draft_prefills = 0
+            for key in self._spec_base:
+                self._spec_base[key] = 0
             self._spill_hits_pub = 0
             self._replayed_rows = 0
             self._replayed_tokens = 0
@@ -995,8 +1037,14 @@ class _EngineService:
 
     def _install_engine(self, engine):
         # Under _lock: stats() reads engine fields through
-        # self._engine from request threads.
+        # self._engine from request threads. The dead engine's
+        # speculation counters fold into the service-side base
+        # BEFORE the swap — acceptance accounting stays consistent
+        # across a rebuild (nothing lost, nothing double-counted).
         with self._lock:
+            for key in self._spec_base:
+                self._spec_base[key] += int(getattr(self._engine,
+                                                    key, 0))
             self._engine = engine
 
     def _rebuild(self, victims):
@@ -1161,7 +1209,8 @@ class _EngineService:
                 blocked_on = self._engine.admission_block_cause(
                     g_row, g_plen, g_new,
                     allow_prefix=self._allow_prefix(head),
-                    repetition_penalty=head.rep_pen)
+                    repetition_penalty=head.rep_pen,
+                    temperature=head.temperature)
                 if blocked_on is not None:
                     break
                 if not self._admit(self._pending.pop(0)):
@@ -1275,9 +1324,25 @@ class _EngineService:
                 min_interval_s=MEMORY_SAMPLE_INTERVAL_S)
             if out is None:
                 continue
-            toks, lps = out
-            for slot, work in list(self._slot_work.items()):
-                self._deliver(work, int(toks[slot]), float(lps[slot]))
+            if len(out) == 3:
+                # Speculative engine: one boundary commits 1..k
+                # tokens per row ([slots, k] + per-row counts).
+                # Delivery stops the moment a row retires mid-chunk
+                # (EOS / budget — _finish clears work.slot); the
+                # engine's positions advanced past the tail, but the
+                # slot dies with them at release.
+                toks, lps, counts = out
+                for slot, work in list(self._slot_work.items()):
+                    for j in range(int(counts[slot])):
+                        self._deliver(work, int(toks[slot, j]),
+                                      float(lps[slot, j]))
+                        if work.slot is None:
+                            break
+            else:
+                toks, lps = out
+                for slot, work in list(self._slot_work.items()):
+                    self._deliver(work, int(toks[slot]),
+                                  float(lps[slot]))
         # Loop exit (stop()): this thread OWNS _pending/_slot_work,
         # so it also answers them — exactly once each.
         for work in (self._pending
@@ -1825,28 +1890,37 @@ class GenerationServer(_BaseServer):
     `tpu_serving_kv_blocks_*` gauges track the pool per step. See
     docs/serving.md "Paged KV-cache block pool".
 
-    **Batch mode (legacy path).** Servers configured with
-    ``speculative_k`` or a sliding-window model keep the
-    run-to-completion cross-request batcher: one _Batcher per
-    (bucket, mode, effective top_k, logprobs, plain, filtered)
-    actually seen, top_k quantized to a power-of-two grid, decode
-    horizon always ``max_new_tokens``. Everything below about
-    speculation applies to that path.
+    **Speculative decoding (engine-native).** ``speculative_k`` +
+    a draft model turn every greedy default-knob row into a
+    draft/verify row INSIDE the engine: the draft proposes k-1
+    tokens per boundary and the target verifies the whole chunk in
+    one widened step program, committing 1..k tokens — identical
+    tokens to plain greedy decode, fewer target weight streams.
+    Rows that are not speculation-eligible (sampling, repetition
+    penalty) take the single-token path in the SAME program, so
+    the program set does not grow per knob. /stats adds
+    `speculative_acceptance_rate` / `accepted_tokens_per_step`;
+    counters survive quarantine rebuilds (absorbed into a
+    service-side base, never double-counted). The draft's KV lives
+    in its own smaller arena, sized by CEA_TPU_SPEC_KV_BLOCKS;
+    draft-arena exhaustion queues admissions exactly like the main
+    pool.
+
+    **Sliding-window models** run in the same slots: the engine's
+    per-row banded attention mask gives every row its own window
+    horizon, so windowed configs get continuous batching, paging,
+    and survivability like dense ones.
 
     ``prefix_tokens`` turns on system-prompt serving: clients send
     only the part AFTER the shared prefix and responses carry
     suffix-relative sequences (the prefix is never re-emitted);
     requests needing prefix-token visibility (repetition_penalty,
-    logprobs) are rejected with 400. With the paged pool the mode
-    rides the ENGINE: the prefix is pinned into shared arena blocks
-    at construction (SlotDecodeEngine.pin_prefix) and every
+    logprobs) are rejected with 400. The mode rides the ENGINE's
+    paged pool (it requires CEA_TPU_PAGED_KV on — construction
+    refuses otherwise): the prefix is pinned into shared arena
+    blocks at construction (SlotDecodeEngine.pin_prefix) and every
     admission prefix-hits the block index, prefilling only its
-    suffix. With paged KV off — or combined with speculative_k —
-    the legacy path prefills ONE KV cache at construction
-    (models.decode.prefill_prefix) and the draft prefills the same
-    prefix into its own state, default-knob traffic riding
-    speculative_decode_with_prefix (sliding-window models refuse the
-    combination at construction).
+    suffix.
     """
 
     def __init__(self, model_name, model, params, port=8500,
@@ -1858,33 +1932,25 @@ class GenerationServer(_BaseServer):
                  plugin_socket=None):
         super().__init__(model_name, port,
                          plugin_socket=plugin_socket)
-        from ..models.decode import decode
-        self._decode = decode
-        # Speculative decoding for default-knob traffic: a draft
-        # model proposes, the target verifies — identical tokens
-        # (greedy) or an identical output distribution (sampling,
-        # via the rejection-sampling accept test), fewer weight
-        # streams. Only requests without filters/penalties (no
-        # top_k/top_p/min_p, repetition_penalty 1.0) ride it —
-        # greedy and sampling each get their own stable spec program
-        # per bucket, and logprobs requests ride their own spec
-        # variant (the verify logits score committed tokens for
-        # free); everything else takes the ordinary decode program.
+        # Speculative decoding rides the ENGINE: a draft model
+        # proposes k-1 greedy tokens per step boundary and the
+        # target verifies the whole chunk in ONE widened step
+        # program — eligible rows (greedy, no repetition penalty)
+        # commit tokens identical to plain greedy decode with fewer
+        # target weight streams; every other row takes the
+        # single-token path in the SAME program. k=1 proposes zero
+        # drafts per step, so it degrades to the plain engine.
         self._spec_k = int(speculative_k)
         self._draft_model = draft_model
         self._draft_params = draft_params
         if self._spec_k:
-            from ..models.speculative import (
-                check_spec_models,
-                speculative_decode,
-            )
-            self._speculative = speculative_decode
+            from ..models.speculative import check_spec_models
             # Fail at CONSTRUCTION, not at request time (or, worse,
             # inside an async warm-up thread that leaves the replica
             # permanently unready): every structural precondition
-            # speculative_decode enforces per call is checked here,
-            # through the same shared helper so the two sites cannot
-            # drift.
+            # verification rests on is checked here, through the
+            # same shared helper as the per-call decode path, and
+            # re-checked by the engine when it builds.
             if self._spec_k < 1:
                 raise ValueError(
                     f"speculative_k must be >= 1: {speculative_k}")
@@ -1909,33 +1975,23 @@ class GenerationServer(_BaseServer):
         self._max_wait_ms = max_wait_ms
         self._max_queue = (8 * max_batch if max_queue is None
                            else max_queue)
-        # One admission budget across ALL program-variant batchers:
-        # the overload bound caps aggregate admitted rows, however
-        # clients spread requests over variants.
+        # One admission budget for the whole server: the overload
+        # bound caps aggregate admitted-but-unretired rows.
         self._admission = _Admission(self._max_queue)
         self._seed = 0
-        self._decode_calls = 0
-        self._decode_rows = 0
-        self._spec_calls = 0
-        self._spec_rounds = 0
-        self._spec_accepted = 0
-        self._prefix_state = None
         self._prefix_len = 0
         if prefix_tokens is not None:
-            if self._spec_k:
-                # Prefix + speculation compose via
-                # speculative_decode_with_prefix (the draft gets its
-                # own prefilled state below) — except on
-                # sliding-window models, whose prefix ring would
-                # need suffix + k extra slots. Fail at CONSTRUCTION,
-                # as every other unservable config does.
-                for m, which in ((model, "target"),
-                                 (draft_model, "draft")):
-                    if getattr(m, "attention_window", 0):
-                        raise ValueError(
-                            f"prefix_tokens + speculative_k does "
-                            f"not support sliding-window models "
-                            f"({which})")
+            from ..models.decode import paged_kv_enabled
+            if not paged_kv_enabled():
+                # Prefix serving rides the engine's paged prefix
+                # index (pinned shared blocks); the dense fallback
+                # has no block index to pin into. Fail at
+                # CONSTRUCTION, as every other unservable config
+                # does.
+                raise ValueError(
+                    "prefix_tokens requires the paged KV pool "
+                    "(CEA_TPU_PAGED_KV=0 disables the prefix "
+                    "index)")
             prefix_arr = np.asarray(prefix_tokens, np.int32)
             if prefix_arr.ndim != 1 or prefix_arr.size < 1:
                 raise ValueError(
@@ -1974,103 +2030,52 @@ class GenerationServer(_BaseServer):
             {b for b in buckets if 1 <= b <= max_prompt})
         if not self._buckets:
             raise ValueError("no valid prompt-length buckets")
-        # Engine eligibility: plain LM servers always; prefix-serving
-        # servers ride the engine's prefix INDEX when the paged KV
-        # pool is on (the pinned system prompt's blocks are shared
-        # refcounted across rows and admission prefills only the
-        # client suffix) — the legacy fixed-horizon batcher shrinks
-        # to speculative/windowed configs only. CEA_TPU_PAGED_KV=0
-        # restores the legacy prefix path too.
-        from ..models.decode import paged_kv_enabled
+        # ONE decode path: every config — plain, speculative,
+        # sliding-window, prefix-serving — constructs the slot
+        # engine service (continuous batching, paged prefix
+        # sharing, quarantine-and-rebuild survivability). The old
+        # run-to-completion batcher and its CEA_TPU_PAGED_KV=0-era
+        # routing carve-outs are gone.
         self._prefix_arr = (prefix_arr if self._prefix_len else None)
-        engine_mode = not (
-            self._spec_k or getattr(model, "attention_window", 0)
-            or (self._prefix_len and not paged_kv_enabled()))
-        self._draft_prefix_state = None
-        if self._prefix_len and not engine_mode:
-            from ..models.decode import (
-                decode_with_prefix,
-                prefill_prefix,
-            )
-            self._decode_with_prefix = decode_with_prefix
-            # One state serves every bucket (smaller buckets need
-            # less than the sizing total); one compiled decode
-            # program per (bucket, mode) as usual — fan_out is the
-            # constant max_batch because _run always pads to it.
-            # With a draft configured, the states carry spec_k extra
-            # positions (speculation's optimistic-write slack) and
-            # the draft prefills the SAME prefix into its own state.
-            # Each state clamps to its model's max_seq_len; buckets
-            # whose spec headroom doesn't fit fall back to the plain
-            # prefix program at routing time (the state capacities
-            # ARE the routing check), mirroring the non-prefix path.
-            want = (self._prefix_len + self._buckets[-1]
-                    + max_new_tokens + self._spec_k)
-            self._prefix_state = prefill_prefix(
-                model, params, prefix_arr[None, :],
-                max_total_len=min(want, model.max_seq_len))
-            if self._spec_k:
-                from ..models.speculative import (
-                    speculative_decode_with_prefix,
-                )
-                self._speculative_with_prefix = (
-                    speculative_decode_with_prefix)
-                self._draft_prefix_state = prefill_prefix(
-                    draft_model, draft_params, prefix_arr[None, :],
-                    max_total_len=min(want, draft_model.max_seq_len))
-        # Continuous batching: plain LM servers — and, with the paged
-        # KV pool, prefix-serving servers — decode on the slot engine
-        # (one pool, in-flight admission, EOS slot recycling, block-
-        # availability-driven admission). Speculation and
-        # sliding-window models keep the run-to-completion batch path
-        # below — their decode programs are structurally
-        # whole-horizon (spec verify rounds) or need ring-cache
-        # metadata the pool's rewind would corrupt.
-        self._engine_service = None
-        if engine_mode:
-            from ..models.decode import SlotDecodeEngine
-            # Before the FIRST compile (the pool-cache init below) so
-            # warm=False servers honor the env var too, not only the
-            # warm-up path.
-            _maybe_enable_compile_cache()
-            slot_len = (self._prefix_len + self._buckets[-1]
-                        + max_new_tokens)
+        from ..models.decode import SlotDecodeEngine
+        # Before the FIRST compile (the pool-cache init below) so
+        # warm=False servers honor the env var too, not only the
+        # warm-up path.
+        _maybe_enable_compile_cache()
+        slot_len = (self._prefix_len + self._buckets[-1]
+                    + max_new_tokens)
+        # k=1 proposes zero drafts per step — structurally plain
+        # greedy — so it builds the draft-free engine rather than
+        # paying a draft arena that can never accelerate anything.
+        engine_spec_k = self._spec_k if self._spec_k >= 2 else 0
 
-            def build_engine():
-                # THE engine recipe — construction and every
-                # quarantine rebuild share it, so a rebuilt engine
-                # (fresh arena/pool, re-pinned prefix) can never
-                # drift from the original. Rebuilds re-warm through
-                # the in-process jit cache (same traced shapes) and
-                # CEA_TPU_COMPILE_CACHE across restarts.
-                engine = SlotDecodeEngine(
-                    model, params, max_batch, slot_len,
-                    buckets=self._buckets,
-                    pin_reserve_tokens=self._prefix_len)
-                if self._prefix_len:
-                    # Pin the system prompt's blocks before the loop
-                    # thread steps it (engine methods are
-                    # single-threaded by contract; rebuilds run on
-                    # the loop thread itself); every admission then
-                    # prefix-hits and prefills only its suffix.
-                    engine.pin_prefix(self._prefix_arr)
-                return engine
+        def build_engine():
+            # THE engine recipe — construction and every
+            # quarantine rebuild share it, so a rebuilt engine
+            # (fresh arena/pool, re-pinned prefix, fresh draft
+            # arena) can never drift from the original. Rebuilds
+            # re-warm through the in-process jit cache (same traced
+            # shapes) and CEA_TPU_COMPILE_CACHE across restarts.
+            engine = SlotDecodeEngine(
+                model, params, max_batch, slot_len,
+                buckets=self._buckets,
+                pin_reserve_tokens=self._prefix_len,
+                draft_model=(draft_model if engine_spec_k else None),
+                draft_params=(draft_params if engine_spec_k
+                              else None),
+                spec_k=engine_spec_k)
+            if self._prefix_len:
+                # Pin the system prompt's blocks before the loop
+                # thread steps it (engine methods are
+                # single-threaded by contract; rebuilds run on
+                # the loop thread itself); every admission then
+                # prefix-hits and prefills only its suffix.
+                engine.pin_prefix(self._prefix_arr)
+            return engine
 
-            self._engine_service = _EngineService(
-                build_engine(), self._admission,
-                engine_factory=build_engine)
-        # Cross-request batching (legacy batch mode): one _Batcher
-        # per (bucket, sampling mode, effective top_k) — rows from
-        # concurrent requests with the same key share one decode
-        # call. Rows carry per-row temperature, true prompt length,
-        # and top_p (decode accepts [B] vectors for all three), so
-        # clients differing only in those still batch together;
-        # greedy and sampling stay separate (different compiled
-        # programs), as does each power-of-two top_k. See the class
-        # docstring for the bound.
-        self._batchers = {}
-        self._batchers_lock = threading.Lock()
-        self._stopping = False
+        self._engine_service = _EngineService(
+            build_engine(), self._admission,
+            engine_factory=build_engine)
         self._warm_filters = list(warm_filters or [])
         if warm:
             self._ready.clear()
@@ -2098,133 +2103,59 @@ class GenerationServer(_BaseServer):
     def _warm_up(self):
         """Compile the program set before traffic.
 
-        Engine mode: one warm request per bucket compiles that
-        bucket's prefill program plus (on the first) the insert and
-        step programs — the COMPLETE engine set; every sampling
-        variant shares those programs, so ``warm_filters`` has
-        nothing left to precompile (accepted and ignored for config
-        compatibility). Warm traffic is dropped from the occupancy
-        telemetry afterwards.
-
-        Batch mode: both default programs per bucket (greedy and
-        plain sampling); each entry of ``warm_filters`` — a dict with
-        any of top_k, top_p, min_p, repetition_penalty, logprobs,
-        temperature — additionally compiles the variant that traffic
-        with those options would select (top_k quantizes to the same
-        power-of-two grid as request handling). VERDICT r2 weak #5:
-        warm previously skipped every sampling-filter variant, so
-        configs using them still paid first-request compiles.
+        One warm request per bucket compiles that bucket's prefill
+        program plus (on the first) the insert and step programs —
+        the COMPLETE engine set; every sampling variant shares those
+        programs, so ``warm_filters`` has nothing left to precompile
+        (accepted and ignored for config compatibility). With a
+        draft configured, warm rows are greedy and carry enough
+        budget to gate at least one speculative step (when max_new
+        allows one at all), so the draft prefill / draft-step /
+        verify programs build here too. Warm traffic is dropped from
+        the occupancy and acceptance telemetry afterwards.
         """
         _maybe_enable_compile_cache()
-        if self._engine_service is not None:
-            for b in self._buckets:
-                if self._prefix_len:
-                    # Prefix servers warm THROUGH the pinned prefix
-                    # (the real traffic shape: prefix-hit + suffix-
-                    # bucket prefill). Suffix content is distinct per
-                    # bucket so one warm row's registered blocks can
-                    # never prefix-match a later warm row and shrink
-                    # its compiled width.
-                    suffix = ((b + np.arange(b))
-                              % self._model.vocab_size)
-                    row = np.concatenate(
-                        [self._prefix_arr,
-                         suffix.astype(np.int32)])
-                    work = _EngineWork(
-                        row, self._prefix_len + b,
-                        min(2, self._max_new), 0.0, 0, 1.0, 0.0,
-                        1.0, -1, False, 0, None, account=False)
-                else:
-                    # no_prefix: warm zeros of different buckets
-                    # share leading tokens; an index hit would
-                    # compile a suffix-width program instead of this
-                    # bucket's.
-                    work = _EngineWork(
-                        np.zeros((b,), np.int32), b,
-                        min(2, self._max_new), 0.0, 0, 1.0, 0.0, 1.0,
-                        -1, False, 0, None, account=False,
-                        no_prefix=True)
-                if self._engine_service.submit_many([work]) is None:
-                    raise RuntimeError(
-                        "warm-up shed by admission control")
-                status, out = work.done.get(timeout=600)
-                if status != "ok":
-                    raise RuntimeError(f"warm-up decode failed: {out}")
-            self._engine_service.reset_counters()
-            self._ready.set()
-            log.info("warm-up complete: %d bucket prefill programs "
-                     "+ engine insert/step", len(self._buckets))
-            return
+        # Long enough that prompt + spec_k fits the warm row's span
+        # budget — the speculation gate's condition for running a
+        # verify chunk instead of a single-token step.
+        warm_new = min(max(2, self._spec_k), self._max_new)
         for b in self._buckets:
-            zeros = np.zeros((b,), np.int32)
-            # pad_temp selects greedy vs sampling mode. With a draft
-            # configured the two default calls ride the greedy and
-            # sampling SPECULATIVE programs.
-            self._run([(zeros, 0.0, b, 1.0, -1, 1.0, 0.0)], 0.0,
-                      account_spec=False)
-            self._run([(zeros, 1.0, b, 1.0, -1, 1.0, 0.0)], 1.0,
-                      account_spec=False)
-            if self._spec_k:
-                # Traffic with a repetition penalty still selects the
-                # PLAIN decode program in either mode (ADVICE r3:
-                # without these it paid a first-request compile after
-                # /healthz already reported ready). rep_pen 1.1, not
-                # 1.0: decode() specializes on use_rp = any(rp != 1)
-                # as a STATIC argument, and penalty traffic runs the
-                # use_rp=True program — warming with all-1.0 would
-                # build the wrong variant (and, on buckets without
-                # speculative headroom, just repeat the calls above).
-                self._run([(zeros, 0.0, b, 1.0, -1, 1.1, 0.0)], 0.0,
-                          force_plain=True, account_spec=False)
-                self._run([(zeros, 1.0, b, 1.0, -1, 1.1, 0.0)], 1.0,
-                          force_plain=True, account_spec=False)
-            for spec in self._warm_filters:
-                if spec.get("stream"):
-                    # Mirror request routing exactly (same rule as
-                    # the non-stream specs below): the spec's
-                    # mode/filter knobs select the compiled stream
-                    # variants, temperature defaulting to 1.0 like
-                    # every other warm spec — deployments with
-                    # greedy streams add {"stream": true,
-                    # "temperature": 0}.
-                    self._warm_stream(
-                        zeros, b,
-                        float(spec.get("temperature", 1.0)),
-                        self._quantize_top_k(
-                            int(spec.get("top_k", 0))),
-                        float(spec.get("top_p", 1.0)),
-                        float(spec.get("min_p", 0.0)))
-                    continue
-                temp = float(spec.get("temperature", 1.0))
-                top_k = self._quantize_top_k(int(spec.get("top_k", 0)))
-                tp_f = float(spec.get("top_p", 1.0))
-                mp_f = float(spec.get("min_p", 0.0))
-                rp_f = float(spec.get("repetition_penalty", 1.0))
-                inst = (zeros, temp, b, tp_f, -1, rp_f, mp_f)
-                # Mirror request routing exactly: penalty rows warm
-                # the plain program, filter rows the FILTERED spec
-                # program — a mismatch here would warm a variant
-                # traffic never selects.
-                self._run([inst], temp, top_k=top_k,
-                          want_lp=bool(spec.get("logprobs", False)),
-                          force_plain=not self._default_knobs(rp_f),
-                          filtered=self._filtered_knobs(tp_f, mp_f),
-                          account_spec=False)
+            if self._prefix_len:
+                # Prefix servers warm THROUGH the pinned prefix
+                # (the real traffic shape: prefix-hit + suffix-
+                # bucket prefill). Suffix content is distinct per
+                # bucket so one warm row's registered blocks can
+                # never prefix-match a later warm row and shrink
+                # its compiled width.
+                suffix = ((b + np.arange(b))
+                          % self._model.vocab_size)
+                row = np.concatenate(
+                    [self._prefix_arr,
+                     suffix.astype(np.int32)])
+                work = _EngineWork(
+                    row, self._prefix_len + b,
+                    warm_new, 0.0, 0, 1.0, 0.0,
+                    1.0, -1, False, 0, None, account=False)
+            else:
+                # no_prefix: warm zeros of different buckets
+                # share leading tokens; an index hit would
+                # compile a suffix-width program instead of this
+                # bucket's.
+                work = _EngineWork(
+                    np.zeros((b,), np.int32), b,
+                    warm_new, 0.0, 0, 1.0, 0.0, 1.0,
+                    -1, False, 0, None, account=False,
+                    no_prefix=True)
+            if self._engine_service.submit_many([work]) is None:
+                raise RuntimeError(
+                    "warm-up shed by admission control")
+            status, out = work.done.get(timeout=600)
+            if status != "ok":
+                raise RuntimeError(f"warm-up decode failed: {out}")
+        self._engine_service.reset_counters()
         self._ready.set()
-        log.info("warm-up complete: %d bucket(s) x (2 + %d) "
-                 "programs", len(self._buckets),
-                 len(self._warm_filters))
-
-    def _quantize_top_k(self, top_k):
-        """Power-of-two top_k grid (0 = off): the one authority for
-        both request handling and warm-up, so precompiled variants
-        always match what live traffic selects. Quantizing up (a
-        superset of the requested support) bounds distinct compiled
-        programs at log2(vocab) against untrusted clients."""
-        if not top_k:
-            return 0
-        return min(1 << (top_k - 1).bit_length(),
-                   self._model.vocab_size)
+        log.info("warm-up complete: %d bucket prefill programs "
+                 "+ engine insert/step", len(self._buckets))
 
     def _post_path(self):
         return f"/v1/models/{self._name}:generate"
@@ -2242,426 +2173,9 @@ class GenerationServer(_BaseServer):
             meta["prefix_len"] = self._prefix_len
         return meta
 
-    @staticmethod
-    def _default_knobs(rep_pen):
-        """The speculative-eligible knob shape — no repetition
-        penalty. Everything else rides speculation: logprobs and
-        top_k on their own batcher-key components/program variants,
-        top_p/min_p as per-row vectors inside the one spec-sampling
-        program (1.0/0.0 rows are exact no-ops in the mask helpers,
-        so mixed batches stay on one program). ONE authority for
-        both call sites: request routing (scalar -> batcher
-        ``plain`` key) and _run's batch-level safety check (vector).
-        Keeping them in sync matters: divergence either diverts
-        default traffic onto an unwarmed plain program (post-ready
-        compile stall) or lets a penalty row flip a spec batch."""
-        return bool(np.all(np.asarray(rep_pen) == 1.0))
-
-    @staticmethod
-    def _filtered_knobs(top_p, min_p):
-        """Whether a row (or warm spec) carries a stateless sampling
-        filter — the ``filtered`` batcher-key component. ONE
-        authority for request routing and warm-up: divergence would
-        warm a spec program variant live traffic never selects (and
-        vice versa), reintroducing the post-ready compile stall."""
-        return bool(np.any(np.asarray(top_p) < 1.0)
-                    or np.any(np.asarray(min_p) > 0.0))
-
-    @staticmethod
-    def _spec_filter_kwargs(pad_temp, top_k, filtered, top_ps,
-                            min_ps):
-        """Sampling-filter kwargs for a speculative call — ONE
-        assembly for the prefix and non-prefix routes. Filtered
-        sampling batchers always carry BOTH filter vectors (pad
-        rows are exact no-ops in the mask helpers) so their one
-        spec program stays stable; greedy batches carry none."""
-        fkw = {}
-        if pad_temp:
-            fkw["top_k"] = top_k
-            if filtered:
-                fkw["top_p"] = top_ps
-                fkw["min_p"] = min_ps
-        return fkw
-
-    @contextlib.contextmanager
-    def _decode_span(self, kind, bucket, rows, sampling, **attrs):
-        """Span + per-kind latency histogram around one decode call
-        — ONE shape for every _run variant (decode / speculative /
-        prefix_decode / prefix_speculative) so a Perfetto timeline
-        and the Prometheus scrape agree on naming."""
-        t0 = time.perf_counter()
-        try:
-            with obs.span("serving." + kind, bucket=bucket,
-                          rows=rows,
-                          mode=("sampling" if sampling
-                                else "greedy"), **attrs) as sp:
-                yield sp
-        finally:
-            obs.histogram(
-                DECODE_HISTOGRAM,
-                "Device decode-call latency by program kind",
-                labels={"kind": kind}).observe(
-                    time.perf_counter() - t0)
-
-    def _record_spec(self, spec_stats, account_spec):
-        """Acceptance telemetry — the alpha that decides whether the
-        configured draft pays off on this traffic (docs/benchmarks.md
-        "Speculation break-even"). The int() syncs BLOCK until the
-        decode finishes, so they run before _stats_lock (nothing
-        blockable may hold it — /stats and every request thread's
-        latency record wait on it). Warm-up's synthetic prompts pass
-        account_spec=False: their degenerate acceptance must not
-        pollute the traffic alpha, and traffic served concurrently
-        with an async warm-up keeps its own accounting (no resets to
-        race)."""
-        spec_rounds = int(spec_stats["rounds"])
-        spec_accepted = int(spec_stats["accepted_drafts"])
-        if account_spec:
-            # Per-call acceptance in the journal: the time-resolved
-            # signal behind /stats' cumulative alpha (a draft that
-            # pays off on average can still crater on one traffic
-            # shape; the journal shows WHEN).
-            obs.event("serving.speculation", rounds=spec_rounds,
-                      accepted_drafts=spec_accepted, k=self._spec_k)
-        with self._stats_lock:
-            self._spec_calls += 1
-            if account_spec:
-                self._spec_rounds += spec_rounds
-                self._spec_accepted += spec_accepted
-
-    def _run(self, instances, pad_temp, top_k=0, want_lp=False,
-             force_plain=False, filtered=False, account_spec=True):
-        """Decode a micro-batch of (row, temperature, prompt_len,
-        top_p, eos_id, rep_penalty) instances through the
-        (max_batch, bucket) padded program."""
-        n = len(instances)
-        bucket = instances[0][0].shape[0]
-        padded = np.zeros((self._max_batch, bucket), np.int32)
-        temps = np.full((self._max_batch,), pad_temp, np.float32)
-        plens = np.full((self._max_batch,), bucket, np.int32)
-        top_ps = np.ones((self._max_batch,), np.float32)
-        eos_ids = np.full((self._max_batch,), -1, np.int32)
-        rep_pens = np.ones((self._max_batch,), np.float32)
-        min_ps = np.zeros((self._max_batch,), np.float32)
-        for row, (tokens, temp, p_len, top_p, eos_id, rep_pen,
-                  min_p) in enumerate(instances):
-            padded[row] = tokens
-            temps[row] = temp
-            plens[row] = p_len
-            top_ps[row] = top_p
-            eos_ids[row] = eos_id
-            rep_pens[row] = rep_pen
-            min_ps[row] = min_p
-        with self._stats_lock:
-            self._seed += 1
-            seed = self._seed
-            self._decode_calls += 1
-            self._decode_rows += n
-        if self._prefix_state is not None:
-            # System-prompt mode: every request row continues the one
-            # prefilled prefix (fan_out = max_batch). Penalty and
-            # logprobs rows cannot reach here (_handle_post 400s
-            # them; construction rejects such warm_filters).
-            if (self._spec_k and not force_plain
-                    and self._default_knobs(rep_pens)
-                    and self._prefix_len + bucket + self._max_new
-                    + self._spec_k
-                    <= min(self._prefix_state[2],
-                           self._draft_prefix_state[2])):
-                # Prefix + speculation: the two serving levers
-                # composed — same stable-program and active-rows
-                # discipline as the non-prefix spec route below.
-                with self._decode_span("prefix_speculative", bucket,
-                                       n, pad_temp):
-                    out, spec_stats = self._speculative_with_prefix(
-                        self._model, self._params, self._draft_model,
-                        self._draft_params, self._prefix_state,
-                        self._draft_prefix_state, jnp.asarray(padded),
-                        self._max_new, k=self._spec_k,
-                        prompt_len=plens,
-                        eos_id=eos_ids, temperature=temps,
-                        rng=jax.random.PRNGKey(seed),
-                        active_rows=np.arange(self._max_batch) < n,
-                        return_stats=True,
-                        **self._spec_filter_kwargs(pad_temp, top_k,
-                                                   filtered, top_ps,
-                                                   min_ps))
-                    out = np.asarray(out)[:n]
-                self._record_spec(spec_stats, account_spec)
-                return out
-            # fast_prefill=False for the same reason as the plain
-            # path below: the auto-selected one-chunk-suffix variant
-            # would flip with batch composition (all-full-width vs
-            # ragged) and stall requests on compiles.
-            with self._decode_span("prefix_decode", bucket, n,
-                                   pad_temp,
-                                   phase="suffix_prefill+decode"):
-                out = self._decode_with_prefix(
-                    self._model, self._params, self._prefix_state,
-                    jnp.asarray(padded), self._max_new,
-                    temperature=temps if pad_temp else 0.0,
-                    rng=jax.random.PRNGKey(seed), prompt_len=plens,
-                    top_k=top_k, top_p=top_ps, min_p=min_ps,
-                    eos_id=eos_ids, fast_prefill=False)
-                return np.asarray(out)[:n]
-        if (self._spec_k and not force_plain
-                and self._default_knobs(rep_pens)
-                and bucket + self._max_new + self._spec_k
-                <= min(self._model.max_seq_len,
-                       self._draft_model.max_seq_len)):
-            # One stable spec program per (bucket, mode): prompt_len,
-            # eos_id and temperature ride as vectors regardless of
-            # batch composition (speculative_decode picks greedy vs
-            # rejection-sampling from the MODE — temps here are
-            # all-zero or all-positive by batcher construction, never
-            # mixed). Output is identical to (greedy) or distributed
-            # identically to (sampling) the decode() below.
-            # active_rows: only the n real rows gate the batch's
-            # uniform acceptance — pad rows' draft/target
-            # disagreement must not collapse speculation toward
-            # plain decode (their output is sliced away below).
-            # Filtered sampling batchers always carry BOTH filter
-            # vectors (pad/no-op rows are exact no-ops in the mask
-            # helpers), so their one spec program is stable across
-            # top_p-only / min_p-only compositions; default batchers
-            # carry none and keep the mask-free program (no vocab
-            # sort on the hot path). Greedy batches carry none —
-            # client filters are rejected at temperature 0.
-            with self._decode_span("speculative", bucket, n,
-                                   pad_temp, k=self._spec_k):
-                out, spec_stats = self._speculative(
-                    self._model, self._params, self._draft_model,
-                    self._draft_params, jnp.asarray(padded),
-                    self._max_new, k=self._spec_k, prompt_len=plens,
-                    eos_id=eos_ids, temperature=temps,
-                    rng=jax.random.PRNGKey(seed),
-                    active_rows=np.arange(self._max_batch) < n,
-                    return_logprobs=want_lp, return_stats=True,
-                    **self._spec_filter_kwargs(pad_temp, top_k,
-                                               filtered, top_ps,
-                                               min_ps))
-                if want_lp:
-                    seq, lps = out
-                    out = list(zip(np.asarray(seq)[:n],
-                                   np.asarray(lps)[:n]))
-                else:
-                    out = np.asarray(out)[:n]
-            self._record_spec(spec_stats, account_spec)
-            return out
-        # fast_prefill=False keeps the per-bucket program set fixed
-        # (warm=True precompiles exactly these programs; the
-        # auto-selected one-shot-prefill variant would flip in and
-        # out with batch composition and stall requests on compiles).
-        # Per-row top_p and eos_id ride as vectors in the same
-        # program (eos is ALWAYS on with -1 = never-matches padding,
-        # so batch composition can't flip program variants); any
-        # top_p < 1.0 in the batch selects the nucleus variant (one
-        # extra program per bucket, compiled on first use).
-        with self._decode_span("decode", bucket, n, pad_temp,
-                               phase="prefill+decode"):
-            out = self._decode(self._model, self._params,
-                               jnp.asarray(padded), self._max_new,
-                               temperature=temps if pad_temp else 0.0,
-                               rng=jax.random.PRNGKey(seed),
-                               prompt_len=plens, fast_prefill=False,
-                               top_k=top_k, top_p=top_ps,
-                               eos_id=eos_ids,
-                               repetition_penalty=rep_pens,
-                               min_p=min_ps,
-                               return_logprobs=want_lp)
-            if want_lp:
-                seq, lp = out
-                return list(zip(np.asarray(seq)[:n],
-                                np.asarray(lp)[:n]))
-            return np.asarray(out)[:n]
-
-    STREAM_CHUNK = 16
-
-    def _stream_call(self, state, feed, feed_plen, n, temperature,
-                     top_k, top_p, min_p, eos, rng):
-        """The ONE decode invocation shape behind streaming —
-        shared by the request path and warm-up so the warmed
-        programs are exactly what live streams select."""
-        from ..models.decode import decode_with_prefix
-
-        with self._stats_lock:
-            self._decode_calls += 1
-            self._decode_rows += 1
-        return decode_with_prefix(
-            self._model, self._params, state, feed, n,
-            temperature=temperature, rng=rng, top_k=top_k,
-            top_p=top_p, min_p=min_p, eos_id=eos,
-            prompt_len=feed_plen, fast_prefill=False,
-            return_state=True)
-
-    def _stream_fresh_state(self, bucket):
-        """Initial stream state for one request row: the shared
-        prefix state, or an untouched cache with the ONE stream
-        cache shape (prefix + bucket + max_new — the budget server
-        construction already guarantees fits max_seq_len)."""
-        from ..models.decode import init_cache
-
-        total = self._prefix_len + bucket + self._max_new
-        if self._prefix_state is not None:
-            return self._prefix_state
-        _, cache = init_cache(self._model, 1, total)
-        return (cache, 0, total)
-
-    def _warm_stream(self, row, bucket, temperature, top_k, top_p,
-                     min_p):
-        """Compile one bucket's COMPLETE stream program set in at
-        most six calls (three horizons x use_eos on/off) instead of
-        draining max_new tokens.
-
-        The request schedule's horizons are n = min(STREAM_CHUNK,
-        remaining budget), so the distinct programs are: the
-        (1, bucket) first call at n1 = min(chunk, max_new); the
-        (1, 1) remainder horizon (max_new % n1, when nonzero); and
-        the (1, 1) full-chunk horizon (only reachable when
-        max_new >= 2*chunk). Run in that order they fit the one
-        cache shape exactly: n1 + rem + chunk <= max_new whenever
-        the third program exists.
-        """
-        chunk = min(self.STREAM_CHUNK, self._max_new)
-        rem = self._max_new % chunk
-        rng = jax.random.PRNGKey(0)
-        # use_eos is a STATIC jit arg of the decode program: a stream
-        # that carries eos_id selects a different program than one
-        # that doesn't, so both variants of every horizon must warm
-        # or the first eos-bearing request stalls on a compile behind
-        # the readiness gate (ADVICE r4). The warm eos value is
-        # arbitrary — the program is specialized on use_eos, not the
-        # id; early EOS only pads the output, shapes are static.
-        for eos in (None, 0):
-            state = self._stream_fresh_state(bucket)
-            seq, state = self._stream_call(
-                state, jnp.asarray(row[None, :]), bucket, chunk,
-                temperature, top_k, top_p, min_p, eos, rng)
-            if rem:
-                seq, state = self._stream_call(
-                    state, seq[:, -1:], 1, rem, temperature, top_k,
-                    top_p, min_p, eos, rng)
-            if self._max_new >= 2 * chunk:
-                self._stream_call(
-                    state, seq[:, -1:], 1, chunk, temperature, top_k,
-                    top_p, min_p, eos, rng)
-
-    def _stream_response(self, row, p_len, new, temperature, top_k,
-                         top_p, min_p, eos_id, decode_text):
-        """Generator behind ``"stream": true``: one request row
-        decodes in STREAM_CHUNK-token program calls against a cache
-        carried across calls (decode_with_prefix(return_state=True)),
-        yielding {"tokens": [...]} ndjson lines as blocks land.
-
-        Program-set discipline: the per-call horizon follows SERVER
-        constants — n = STREAM_CHUNK for every call except a final
-        max_new % STREAM_CHUNK remainder — so per bucket at most
-        three extra programs ((1, bucket) feed + the two (1, 1)
-        horizons) and ONE cache shape, sized prefix + bucket +
-        max_new: exactly the budget server construction already
-        guarantees fits max_seq_len (and the shared prefix state),
-        however large the bucket. A right-padded row's generation
-        overwrites its padding (standard decode semantics), so the
-        generated region is contiguous from p_len and the host
-        cursor just slices it; the schedule may stop early once
-        ``new`` tokens (<= max_new) are out. Streaming rows do not
-        cross-request batch; they hold one admission slot for the
-        stream's lifetime (released by _StreamBody.close, not here —
-        a never-iterated generator runs no finally). The stream ends
-        at the first EOS (emitted), or after ``new`` tokens.
-        """
-        chunk = min(self.STREAM_CHUNK, self._max_new)
-        bucket = int(row.shape[0])
-        eos = None if eos_id < 0 else int(eos_id)
-        state = self._stream_fresh_state(bucket)
-        feed = jnp.asarray(row[None, :])
-        feed_plen = int(p_len)
-        emitted = 0
-        pending = []
-        call_budget = self._max_new
-        with self._stats_lock:
-            self._seed += 1
-            seed = self._seed
-        rng = jax.random.PRNGKey(seed)
-        while emitted < new:
-            # Each call yields >= n fresh tokens and call_budget
-            # only depletes by n, so emitted reaches new (<= max_new)
-            # no later than call_budget reaches 0. The guard is
-            # belt-and-braces against that invariant ever breaking —
-            # a 0-token decode call would loop forever.
-            n = min(chunk, call_budget)
-            if n <= 0:
-                break
-            call_budget -= n
-            rng, sub = jax.random.split(rng)
-            # The first call feeds the whole prompt row (the prompt
-            # prefill + first block); later calls are pure decode
-            # chunks — named apart so the span tree reads
-            # request -> prefill -> decode chunks.
-            phase = ("serving.prefill" if feed.shape[1] > 1
-                     else "serving.decode_chunk")
-            with obs.span(phase, bucket=bucket, horizon=n):
-                seq, state = self._stream_call(
-                    state, feed, feed_plen, n, temperature, top_k,
-                    top_p, min_p, eos, sub)
-            gen = np.asarray(seq[0, feed_plen:])
-            feed = seq[:, -1:]
-            feed_plen = 1
-            pending.extend(int(t) for t in gen)
-            take = min(len(pending), new - emitted)
-            block, pending = pending[:take], pending[take:]
-            if eos is not None and eos in block:
-                block = block[:block.index(eos) + 1]
-                emitted = new  # ends the loop after this yield
-            else:
-                emitted += len(block)
-            line = {"tokens": block}
-            if decode_text is not None:
-                ids = (block[:-1] if eos is not None
-                       and block and block[-1] == eos else block)
-                line["completion_delta"] = decode_text(ids)
-            yield line
-            if eos is not None and line["tokens"][-1:] == [eos]:
-                break
-        yield {"done": True}
-
-    def _batcher_for(self, bucket, sampling, top_k, want_lp=False,
-                     plain=True, filtered=False):
-        # ``plain`` keys penalty-free rows (the speculative-eligible
-        # shape) apart from penalty rows, and ``filtered`` keys
-        # top_p/min_p rows apart from default rows — so neither a
-        # penalty row nor a filter row can ever land in a default
-        # micro-batch and flip its compiled program: program choice
-        # is decided by the batcher key, not by batch composition
-        # (ADVICE r3). Default rows keep the sort-free programs
-        # (plain decode's use_top_p/use_min_p variants AND the
-        # mask-free speculative program); filtered batchers always
-        # carry both filter vectors so their spec program is stable
-        # across top_p-only/min_p-only compositions. Greedy and
-        # sampling stay separate via ``sampling``.
-        key = (bucket, sampling, top_k, want_lp, plain, filtered)
-        with self._batchers_lock:
-            if self._stopping:
-                return None
-            batcher = self._batchers.get(key)
-            if batcher is None:
-                batcher = _Batcher(
-                    functools.partial(
-                        self._run,
-                        pad_temp=1.0 if sampling else 0.0,
-                        top_k=top_k, want_lp=want_lp,
-                        force_plain=not plain, filtered=filtered),
-                    self._max_batch, self._max_wait_ms,
-                    admission=self._admission)
-                self._batchers[key] = batcher
-            return batcher
-
     def _debug_requests(self, query):
         """/debug/requests: the engine service's retired-record ring
-        (`?n=` caps the dump, default 64). Batch-mode servers have no
-        per-request attribution — they 404 like non-LM servers."""
-        if self._engine_service is None:
-            return None
+        (`?n=` caps the dump, default 64)."""
         from ..obs.http import query_param
         try:
             limit = max(0, int(query_param(query, "n", 64)))
@@ -2670,48 +2184,23 @@ class GenerationServer(_BaseServer):
         return self._engine_service.debug_requests(limit)
 
     def _extra_stats(self):
-        """Decode-batch occupancy: rows served per compiled call —
-        the batching-efficiency signal for load tests. Engine mode
-        reports the slot pool's live numbers (batch_occupancy_avg =
-        mean active slots per decode step, plus current
-        slots_active/slots_free and queue depth); avg_batch_occupancy
-        stays as an alias so existing load harnesses keep working."""
-        if self._engine_service is not None:
-            out = self._engine_service.stats()
-            out["avg_batch_occupancy"] = out["batch_occupancy_avg"]
-            return out
-        calls = self._decode_calls
-        # k=1 proposes zero drafts per round — no acceptance to
-        # rate, so None (0.0 would read as "every proposal
-        # rejected").
-        proposed = self._spec_rounds * (self._spec_k - 1)
-        return {
-            "decode_calls": calls,
-            "decode_rows": self._decode_rows,
-            "speculative_calls": self._spec_calls,
-            # Fraction of draft proposals the target accepted — the
-            # alpha in the break-even model; near 0 means the
-            # configured draft is wasted work on this traffic.
-            "speculative_acceptance_rate": (
-                round(self._spec_accepted / proposed, 4)
-                if proposed else None),
-            "avg_batch_occupancy": (
-                round(self._decode_rows / calls, 3) if calls else None),
-        }
+        """The slot pool's live numbers (batch_occupancy_avg = mean
+        active slots per decode step, plus slots_active/slots_free,
+        queue depth, and the speculation surface);
+        avg_batch_occupancy stays as an alias so existing load
+        harnesses keep working."""
+        out = self._engine_service.stats()
+        out["avg_batch_occupancy"] = out["batch_occupancy_avg"]
+        return out
 
     def _service_ready(self):
         """Readiness beyond warm-up: a quarantined / breaker-open /
         draining engine service makes /readyz 503 while /healthz
         stays live."""
-        if self._engine_service is not None:
-            return self._engine_service.ready()
-        with self._batchers_lock:
-            return not self._stopping
+        return self._engine_service.ready()
 
     def _overload_retry_after(self):
-        if self._engine_service is not None:
-            return self._engine_service.retry_after_s()
-        return 1
+        return self._engine_service.retry_after_s()
 
     def drain(self, grace_s=None):
         """SIGTERM graceful drain: reject new POSTs immediately
@@ -2720,20 +2209,11 @@ class GenerationServer(_BaseServer):
         Returns True when everything retired in time — the caller
         then fires postmortem capture and stop() as usual."""
         self.begin_drain()
-        if self._engine_service is not None:
-            return self._engine_service.drain(grace_s)
-        return True
+        return self._engine_service.drain(grace_s)
 
     def stop(self):
         super().stop()
-        with self._batchers_lock:
-            self._stopping = True
-            batchers = list(self._batchers.values())
-            self._batchers.clear()
-        for batcher in batchers:
-            batcher.stop()
-        if self._engine_service is not None:
-            self._engine_service.stop()
+        self._engine_service.stop()
 
     def _handle_post(self, payload, request_id=None):
         try:
@@ -2799,12 +2279,6 @@ class GenerationServer(_BaseServer):
         if self._prefix_len and want_lp:
             return 400, {"error": "logprobs is not supported on a "
                                   "prefix-serving server"}
-        if self._engine_service is None:
-            # Batch mode bounds compiled top_k variants by quantizing
-            # to a power-of-two grid; the engine's per-row top_k is
-            # traced data (one program for every k), so it honors the
-            # client's exact value.
-            top_k = self._quantize_top_k(top_k)
         if not prompts or len(prompts) > self._max_batch:
             return 400, {"error": f"need 1..{self._max_batch} prompts"}
         if texts is None and len({len(p) for p in prompts}) != 1:
@@ -2844,73 +2318,10 @@ class GenerationServer(_BaseServer):
                                   f"max {self._buckets[-1]}"}
         padded = np.zeros((arr.shape[0], bucket), np.int32)
         padded[:, :p_len] = arr
-        if self._engine_service is not None:
-            return self._engine_post(padded, p_lens, new, temperature,
-                                     top_k, top_p, min_p, eos_id,
-                                     rep_pen, want_lp, stream, texts,
-                                     request_id)
-        if stream:
-            if arr.shape[0] != 1:
-                return 400, {"error": "stream requires exactly one "
-                                      "prompt"}
-            if new < 1:
-                return 400, {"error": "stream requires "
-                                      "max_new_tokens >= 1"}
-            if not self._admission.try_acquire(1):
-                with self._stats_lock:
-                    self._shed += 1
-                return (503, {"error": "server overloaded; retry"},
-                        {"Retry-After":
-                         str(self._overload_retry_after())})
-            # Anything raising between acquire and the body reaching
-            # the caller (tokenizer access; generator construction)
-            # would be swallowed by the generic 500 handler with the
-            # slot still held — release before re-raising (ADVICE r4).
-            try:
-                decode_text = (self._tokenizer.decode
-                               if texts is not None else None)
-                body = _StreamBody(
-                    self._stream_response(
-                        padded[0], p_lens[0], new, temperature,
-                        top_k, top_p, min_p, eos_id, decode_text),
-                    functools.partial(self._admission.release, 1))
-            except BaseException:
-                self._admission.release(1)
-                raise
-            return 200, body
-        with obs.span("serving.admission", bucket=bucket,
-                      rows=len(padded)) as adm:
-            batcher = self._batcher_for(
-                bucket, temperature > 0.0, top_k, want_lp,
-                plain=self._default_knobs(rep_pen),
-                filtered=self._filtered_knobs(top_p, min_p))
-            if batcher is None:
-                return (503, {"error": "server is shutting down"},
-                        {"Retry-After":
-                         str(self._overload_retry_after())})
-            pending = batcher.submit_many(
-                [(row, temperature, int(pl), top_p, eos_id, rep_pen,
-                  min_p)
-                 for row, pl in zip(padded, p_lens)])
-            if pending is None:
-                adm.set(shed=True)
-                with self._stats_lock:
-                    self._shed += 1
-                return (503, {"error": "server overloaded; retry"},
-                        {"Retry-After":
-                         str(self._overload_retry_after())})
-        rows = []
-        with obs.span("serving.wait", rows=len(pending)):
-            for done in pending:
-                try:
-                    status, out = done.get(timeout=120)
-                except queue.Empty:
-                    return 500, {"error": "decode timed out"}
-                if status != "ok":
-                    return 500, {"error": out}
-                rows.append(out)
-        return 200, self._compose_response(rows, p_lens, new,
-                                           want_lp, texts, eos_id)
+        return self._engine_post(padded, p_lens, new, temperature,
+                                 top_k, top_p, min_p, eos_id,
+                                 rep_pen, want_lp, stream, texts,
+                                 request_id)
 
     def _compose_response(self, rows, p_lens, new, want_lp, texts,
                           eos_id):

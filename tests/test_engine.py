@@ -168,12 +168,22 @@ def test_repetition_penalty_and_logprobs_match_decode(lm):
 
 
 def test_engine_rejects_unsupported_configs():
-    model, params = _make_lm(attention_window=8)
-    with pytest.raises(ValueError, match="dense cache"):
-        SlotDecodeEngine(model, params, slots=2, slot_len=14)
     model, params = _make_lm()
     with pytest.raises(ValueError, match="max_seq_len"):
         SlotDecodeEngine(model, params, slots=2, slot_len=64)
+    # Windowed TARGETS run in slots now; a windowed DRAFT does not
+    # (its cache would have to be full-length anyway), and a draft
+    # model needs a chunk width.
+    wmodel, wparams = _make_lm(attention_window=8)
+    SlotDecodeEngine(wmodel, wparams, slots=2, slot_len=14)
+    with pytest.raises(ValueError, match="dense cache"):
+        SlotDecodeEngine(model, params, slots=2, slot_len=14,
+                         draft_model=wmodel, draft_params=wparams,
+                         spec_k=3)
+    with pytest.raises(ValueError, match="spec_k"):
+        SlotDecodeEngine(model, params, slots=2, slot_len=14,
+                         draft_model=model, draft_params=params,
+                         spec_k=1)
 
 
 def test_admit_requires_free_slot(lm):
@@ -182,6 +192,129 @@ def test_admit_requires_free_slot(lm):
     eng.admit(np.array([1, 2], np.int32), 2)
     with pytest.raises(RuntimeError, match="free slot"):
         eng.admit(np.array([3, 4], np.int32), 2)
+
+
+def test_windowed_staggered_admission_matches_decode():
+    """Ring-cache (sliding-window) models run in slots: two windowed
+    requests admitted mid-flight — prompts LONGER than the window,
+    so the per-row band lower bound is live — each emit exactly
+    their per-request decode() stream."""
+    model, params = _make_lm(attention_window=8)
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=20)
+
+    prompt_a = np.arange(1, 11, dtype=np.int32)      # 10 > window 8
+    slot_a, first_a, _, _ = eng.admit(prompt_a, 10)
+    out_a = [first_a] + _drain(eng, slot_a, 2)
+
+    prompt_b = np.array([7, 9, 4, 2, 8, 6, 1, 3, 5, 0], np.int32)
+    slot_b, first_b, _, _ = eng.admit(prompt_b, 9)   # ragged row
+    out_b = [first_b]
+    for _ in range(3):
+        toks, _ = eng.step()
+        out_a.append(int(toks[slot_a]))
+        out_b.append(int(toks[slot_b]))
+    eng.release(slot_a)
+    out_b += _drain(eng, slot_b, 2)
+    eng.release(slot_b)
+
+    ref_a = np.asarray(decode(
+        model, params, jnp.asarray(prompt_a[None]), 6,
+        prompt_len=np.array([10]), fast_prefill=False))[0]
+    assert out_a == ref_a[10:16].tolist()
+    ref_b = np.asarray(decode(
+        model, params, jnp.asarray(prompt_b[None]), 6,
+        prompt_len=np.array([9]), fast_prefill=False))[0]
+    assert out_b == ref_b[9:15].tolist()
+
+
+def _drain_spec(eng, want):
+    """Step a draft-configured engine until every tracked slot has
+    its requested token count; surplus accepted tokens in a row's
+    final chunk are discarded exactly as the serving loop discards
+    them. ``want`` maps slot -> (list to fill, target length)."""
+    pending = dict(want)
+    while pending:
+        toks, _, counts = eng.step()
+        for slot, (out, n) in list(pending.items()):
+            for j in range(int(counts[slot])):
+                out.append(int(toks[slot, j]))
+                if len(out) >= n:
+                    del pending[slot]
+                    break
+
+
+def test_spec_engine_matches_speculative_decode_on_reused_slot():
+    """Speculative decoding inside the slot engine: a greedy stream
+    through a self-draft engine is token-identical to the module's
+    ``speculative_decode`` (itself greedy-exact), and a SECOND
+    request admitted into the recycled slot — draft arena included —
+    is too, with acceptance telemetry moving."""
+    from container_engine_accelerators_tpu.models.speculative import (
+        speculative_decode,
+    )
+
+    model, params = _make_lm()
+    eng = SlotDecodeEngine(model, params, slots=1, slot_len=14,
+                           draft_model=model, draft_params=params,
+                           spec_k=3)
+    for prompt in (np.array([1, 2, 3, 4], np.int32),
+                   np.array([5, 6, 7, 8], np.int32)):
+        slot, first, _, _ = eng.admit(prompt, 4)
+        out = [first]
+        _drain_spec(eng, {slot: (out, 6)})
+        eng.release(slot)
+        ref = np.asarray(speculative_decode(
+            model, params, model, params, jnp.asarray(prompt[None]),
+            6, k=3))[0]
+        assert out == ref[4:10].tolist()
+    assert eng.spec_steps > 0 and eng.spec_accepted > 0
+    assert eng.spec_accepted <= eng.spec_proposed
+    assert eng.pool_leak_report() is None
+
+
+def test_draft_arena_exhaustion_queues_cleanly():
+    """A draft arena sized for ONE row: the second speculative
+    admission is named-blocked on ``spec_kv_blocks`` and ``admit``
+    raises EngineCapacityError BEFORE touching the pool; after the
+    resident row releases, the queued request admits into the
+    recycled draft blocks and its stream is exact."""
+    model, params = _make_lm()
+    eng = SlotDecodeEngine(model, params, slots=2, slot_len=16,
+                           paged=True, kv_block_size=4,
+                           spec_kv_blocks=5,      # one 4-block span
+                           draft_model=model, draft_params=params,
+                           spec_k=3)
+    prompt_a = np.array([1, 2, 3, 4], np.int32)
+    slot_a, first_a, _, _ = eng.admit(prompt_a, 4)
+
+    prompt_b = np.array([5, 6, 7, 8], np.int32)
+    assert eng.free_slots() == 1
+    assert eng.admission_block_cause(prompt_b, 4) == "spec_kv_blocks"
+    assert not eng.can_admit(prompt_b, 4)
+    from container_engine_accelerators_tpu.models.decode import (
+        EngineCapacityError,
+    )
+    with pytest.raises(EngineCapacityError, match="draft KV"):
+        eng.admit(prompt_b, 4)
+    # The refused admission mutated nothing: the free slot survives
+    # and the resident row's stream is unperturbed.
+    assert eng.free_slots() == 1
+    out_a = [first_a]
+    _drain_spec(eng, {slot_a: (out_a, 6)})
+    eng.release(slot_a)
+
+    assert eng.admission_block_cause(prompt_b, 4) is None
+    slot_b, first_b, _, _ = eng.admit(prompt_b, 4)
+    out_b = [first_b]
+    _drain_spec(eng, {slot_b: (out_b, 6)})
+    eng.release(slot_b)
+    ref_b = np.asarray(greedy_decode(
+        model, params, jnp.asarray(prompt_b[None]), 6))[0]
+    assert out_b == ref_b[4:10].tolist()
+    assert eng.pool_leak_report() is None
+    stats = eng.kv_block_stats()
+    assert stats["spec_kv_blocks_total"] == 4      # usable (- trash)
+    assert stats["spec_kv_blocks_free"] == 4
 
 
 def test_score_consumes_no_slot(lm):

@@ -464,8 +464,8 @@ def test_generate_validation(lm_server):
             {"prompts": [[1]], "top_k": 5},  # filters need temp > 0
             {"prompts": [[1]], "eos_id": 64},  # >= vocab
             {"prompts": [[1]], "eos_id": -2},
-            # Negative temp must 400 here — reaching a spec-enabled
-            # batcher it would 500 every co-batched request.
+            # Negative temp must 400 here — reaching the engine it
+            # would poison the step's per-row temperature vector.
             {"prompts": [[1]], "temperature": -1.0},
             {"prompts": [[1]], "temperature": float("nan")},
     ):
@@ -579,8 +579,8 @@ def test_scoring_mode(lm_server):
 def test_generate_mixed_traffic_stress(lm_server):
     """Concurrent requests spanning buckets, sampling modes,
     filters, penalties, logprobs, and scoring must all succeed with
-    correctly-shaped responses — the expanded batcher-key space under
-    real thread interleaving."""
+    correctly-shaped responses — the engine's full per-row knob
+    space under real thread interleaving."""
     payloads = [
         {"prompts": [[1, 2]], "max_new_tokens": 3},
         {"prompts": [[3, 4, 5, 6, 7]], "max_new_tokens": 4,
@@ -787,11 +787,12 @@ def test_admission_budget_shared_across_variant_batchers():
         b2.stop()
 
 
-def test_generation_server_batchers_share_admission():
-    """Batch mode (a windowed model keeps the legacy batcher path):
-    every program-variant batcher shares the server's one admission
-    budget. Engine mode shares the same budget by construction (one
-    service); a windowed model pins the batcher side."""
+def test_windowed_server_constructs_engine_service():
+    """ONE decode path: a sliding-window model builds the engine
+    service like every other config (the per-row band mask gives
+    each row its own window horizon) — the legacy run-to-completion
+    batcher route is gone, and the engine service shares the
+    server's one admission budget by construction."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -805,11 +806,11 @@ def test_generation_server_batchers_share_admission():
     srv = GenerationServer("lm", model, params, port=0,
                            max_new_tokens=8, max_batch=2, buckets=[8])
     try:
-        assert srv._engine_service is None  # windowed -> batch mode
-        b_greedy = srv._batcher_for(8, False, 0)
-        b_sample = srv._batcher_for(8, True, 0)
-        assert b_greedy._admission is srv._admission
-        assert b_sample._admission is srv._admission
+        assert srv._engine_service is not None
+        assert srv._engine_service._admission is srv._admission
+        # The per-variant batcher surface no longer exists on the
+        # server at all — nothing left to route around the engine.
+        assert not hasattr(srv, "_batcher_for")
     finally:
         # Never started: stop() must not deadlock in
         # ThreadingHTTPServer.shutdown() (regression: it used to wait
@@ -818,10 +819,11 @@ def test_generation_server_batchers_share_admission():
 
 
 def test_generate_speculative_greedy_path():
-    """With a draft configured, plain-greedy requests route through
-    speculative decoding and return EXACTLY what the plain path
-    returns; non-default options (repetition penalty, sampling,
-    logprobs) fall back to the ordinary decode program."""
+    """With a draft configured, plain-greedy requests draft/verify
+    INSIDE the engine and return EXACTLY what the plain engine
+    returns; sampled and penalized rows take the single-token lane
+    of the SAME step program, so their traffic moves no speculation
+    counters."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -862,47 +864,47 @@ def test_generate_speculative_greedy_path():
         with _u.urlopen(f"http://localhost:{spec.port}/stats",
                         timeout=10) as resp:
             stats = json.loads(resp.read())
-        assert stats["speculative_calls"] >= 3, stats
-        # SAMPLING rides speculation too — default knobs AND the
-        # stateless filters (top_p here; they transform p and q
-        # identically inside the spec program). Only the stateful
-        # repetition penalty falls back to plain decode.
+        # The greedy traffic drafted: the engine proposed chunks and
+        # mirrored one draft prefill per admission.
+        assert stats["spec_steps"] >= 3, stats
+        assert stats["spec_proposed_tokens"] > 0, stats
+        assert stats["draft_prefills"] >= 3, stats
+        # Sampling and the repetition penalty are NOT
+        # speculation-eligible (a sampled row's verify column would
+        # need per-proposal acceptance sampling; a penalized draft
+        # stream would need the target's seen state): those rows run
+        # single-token in the SAME step program, so their traffic
+        # must leave every speculation counter exactly where it was.
         for payload in (
                 {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
                  "temperature": 0.9},
                 {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
                  "temperature": 0.9, "top_p": 0.8},
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                 "repetition_penalty": 1.3},
         ):
             out = post(spec, "/v1/models/lm:generate", payload)
             assert len(out["sequences"][0]) == 7
         with _u.urlopen(f"http://localhost:{spec.port}/stats",
                         timeout=10) as resp:
-            stats_s = json.loads(resp.read())
-        assert (stats_s["speculative_calls"]
-                == stats["speculative_calls"] + 2), stats_s
-        out = post(spec, "/v1/models/lm:generate",
-                   {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
-                    "repetition_penalty": 1.3})
-        assert len(out["sequences"][0]) == 7
-        with _u.urlopen(f"http://localhost:{spec.port}/stats",
-                        timeout=10) as resp:
             stats2 = json.loads(resp.read())
-        assert (stats2["speculative_calls"]
-                == stats_s["speculative_calls"]), stats2
+        for key in ("spec_proposed_tokens", "spec_accepted_tokens",
+                    "draft_prefills"):
+            assert stats2[key] == stats[key], (key, stats2)
     finally:
         plain.stop()
         spec.stop()
 
 
-def test_generate_speculative_warm_compiles_plain_greedy():
-    """ADVICE r3 (medium): with speculative_k set, warm-up must also
-    build the PLAIN decode programs per bucket — traffic with a
-    repetition penalty (allowed in both modes) selects them, and
-    without the extra warm calls it paid a first-request compile
-    after /healthz already reported ready. Observable composition:
-    per bucket, warm-up runs spec-greedy + spec-sampling +
-    plain-greedy(rp) + plain-sampling(rp) = 4 decode calls, two of
-    them speculative."""
+def test_generate_speculative_warm_covers_every_knob():
+    """Warm-up on a speculative server compiles the COMPLETE program
+    set before /healthz reports ready: sampled and penalized rows
+    run single-token in the SAME widened step program the warm
+    greedy rows built, so post-ready traffic with any knob triggers
+    ZERO new compiles — measured directly on the engine's program
+    caches. Warm rows themselves are synthetic: reset_counters drops
+    them, so /stats opens with a zeroed speculation surface."""
+    from container_engine_accelerators_tpu.analysis import retrace
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -929,30 +931,50 @@ def test_generate_speculative_warm_compiles_plain_greedy():
         with _u.urlopen(f"http://localhost:{srv.port}/stats",
                         timeout=10) as resp:
             stats = json.loads(resp.read())
-        assert stats["decode_calls"] == 8, stats   # 4 per bucket
-        assert stats["speculative_calls"] == 4, stats  # 2 per bucket
-        # The plain program warm-up targeted: greedy + penalty.
-        out = post(srv, "/v1/models/lm:generate",
-                   {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
-                    "repetition_penalty": 1.3})
-        assert len(out["sequences"][0]) == 7
+        assert stats["spec_steps"] == 0, stats
+        assert stats["draft_prefills"] == 0, stats
+        assert stats["speculative_acceptance_rate"] is None, stats
+        paged = srv._engine_service._engine.paged
+        programs = (retrace.engine_programs(paged)
+                    + retrace.spec_engine_programs(paged))
+        sizes = {name: fn._cache_size() for name, fn in programs}
+        for payload in (
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                 "repetition_penalty": 1.3},
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+                 "temperature": 0.9, "top_k": 8},
+                {"prompts": [[1, 2, 3]], "max_new_tokens": 4},
+        ):
+            out = post(srv, "/v1/models/lm:generate", payload)
+            assert len(out["sequences"][0]) == 7
+        after = {name: fn._cache_size() for name, fn in programs}
+        assert after == sizes, (sizes, after)
+        # ... and the greedy request above did draft (same program
+        # set, gate on): the counters move only for real traffic.
         with _u.urlopen(f"http://localhost:{srv.port}/stats",
                         timeout=10) as resp:
             stats2 = json.loads(resp.read())
-        assert stats2["speculative_calls"] == 4, stats2
+        assert stats2["spec_proposed_tokens"] > 0, stats2
+        assert stats2["draft_prefills"] == 1, stats2
     finally:
         srv.stop()
 
 
-def test_generate_speculative_headroom_fallback():
-    """Buckets without max_seq_len headroom for the verify slack use
-    the plain decode path instead of failing."""
+def test_generate_speculative_tight_headroom_gates_per_row():
+    """A config with ZERO verify slack beyond the decode horizon
+    (max_seq_len == bucket + max_new) used to force a whole-server
+    plain fallback; the engine instead gates speculation PER ROW —
+    a row drafts while pos + k fits its span and finishes
+    single-token in the same program — so tight-headroom servers
+    keep the speedup and stay token-identical to decode()."""
     from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.models.decode import decode
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
     )
 
-    # max_seq_len 16 = bucket 8 + max_new 8: no room for k slack.
+    # max_seq_len 16 = bucket 8 + max_new 8: no slack for k anywhere
+    # but inside each row's own unconsumed span.
     model = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
                           num_heads=4, max_seq_len=16,
                           dtype=jnp.float32)
@@ -964,22 +986,30 @@ def test_generate_speculative_headroom_fallback():
                            speculative_k=4)
     srv.start()
     try:
+        # Full-horizon request: drafts early, must flip to the
+        # single-token lane when pos + k overruns the 16-token span.
+        prompt = [4, 5, 6, 7, 8, 9, 10, 11]
         out = post(srv, "/v1/models/lm:generate",
-                   {"prompts": [[1, 2, 3]], "max_new_tokens": 4})
-        assert len(out["sequences"][0]) == 7
+                   {"prompts": [prompt], "max_new_tokens": 8})
+        want = decode(model, params,
+                      jnp.asarray([prompt], jnp.int32), 8)
+        assert out["sequences"][0] == np.asarray(want)[0].tolist()
         import urllib.request as _u
         with _u.urlopen(f"http://localhost:{srv.port}/stats",
                         timeout=10) as resp:
             stats = json.loads(resp.read())
-        assert stats["speculative_calls"] == 0, stats
+        assert stats["spec_steps"] >= 1, stats
+        assert stats["spec_proposed_tokens"] > 0, stats
+        assert (stats["spec_accepted_tokens"]
+                <= stats["spec_proposed_tokens"]), stats
     finally:
         srv.stop()
 
 
 def test_generate_speculative_serves_logprobs():
-    """Default-knob logprobs requests ride the speculative program
-    (the verify logits score committed tokens for free) and return
-    exactly what the plain server returns for greedy."""
+    """Greedy logprobs requests still draft (the verify logits score
+    committed tokens for free) and return exactly what the plain
+    engine returns — same tokens, logprobs to float tolerance."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -1018,17 +1048,19 @@ def test_generate_speculative_serves_logprobs():
         with _u.urlopen(f"http://localhost:{spec.port}/stats",
                         timeout=10) as resp:
             stats = json.loads(resp.read())
-        assert stats["speculative_calls"] >= 1, stats
+        assert stats["spec_steps"] >= 1, stats
+        assert stats["spec_proposed_tokens"] > 0, stats
     finally:
         plain.stop()
         spec.stop()
 
 
 def test_generate_speculative_filtered_topk1_is_greedy():
-    """Filtered sampling rides speculation: with top_k=1 the filtered
-    distribution is a point mass, so the spec-sampling program must
-    reproduce plain greedy output exactly — an end-to-end proof the
-    filters reached the speculative path rather than being ignored."""
+    """Filtered sampling on a speculative server takes the
+    single-token lane of the SAME step program: with top_k=1 the
+    filtered distribution is a point mass, so it must reproduce the
+    drafted greedy output exactly — an end-to-end proof the sampling
+    lane stayed exact while greedy rows were drafting next to it."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -1052,15 +1084,23 @@ def test_generate_speculative_filtered_topk1_is_greedy():
     try:
         greedy = post(srv, "/v1/models/lm:generate",
                       {"prompts": [[1, 2, 3]], "max_new_tokens": 6})
-        topk1 = post(srv, "/v1/models/lm:generate",
-                     {"prompts": [[1, 2, 3]], "max_new_tokens": 6,
-                      "temperature": 1.0, "top_k": 1})
-        assert greedy["sequences"] == topk1["sequences"]
         import urllib.request as _u
         with _u.urlopen(f"http://localhost:{srv.port}/stats",
                         timeout=10) as resp:
             stats = json.loads(resp.read())
-        assert stats["speculative_calls"] >= 2, stats
+        assert stats["spec_proposed_tokens"] > 0, stats
+        topk1 = post(srv, "/v1/models/lm:generate",
+                     {"prompts": [[1, 2, 3]], "max_new_tokens": 6,
+                      "temperature": 1.0, "top_k": 1})
+        assert greedy["sequences"] == topk1["sequences"]
+        # The point-mass row sampled, so it neither drafted nor
+        # mirrored a draft prefill.
+        with _u.urlopen(f"http://localhost:{srv.port}/stats",
+                        timeout=10) as resp:
+            stats2 = json.loads(resp.read())
+        assert (stats2["spec_proposed_tokens"]
+                == stats["spec_proposed_tokens"]), stats2
+        assert stats2["draft_prefills"] == stats["draft_prefills"]
     finally:
         srv.stop()
 
@@ -1153,17 +1193,22 @@ def test_prefix_server_construction_errors():
                           dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
-    # Prefix + speculation compose now — except on sliding-window
-    # models, which refuse at construction.
+    # A sliding-window TARGET composes with prefix serving and
+    # speculation (the engine's per-row band mask handles it), but a
+    # sliding-window DRAFT has no dense cache for the k-1 micro-step
+    # scan: the engine refuses at construction, and the server
+    # surfaces that refusal instead of building an unservable
+    # replica.
     wmodel = TransformerLM(vocab_size=64, embed_dim=32, num_layers=2,
                            num_heads=4, max_seq_len=40,
                            attention_window=8, dtype=jnp.float32)
     wparams = wmodel.init(jax.random.PRNGKey(1),
                           jnp.zeros((1, 8), jnp.int32))["params"]
-    with pytest.raises(ValueError, match="sliding-window"):
+    with pytest.raises(ValueError, match="dense cache"):
         GenerationServer("x", wmodel, wparams, port=0,
-                         prefix_tokens=[1, 2], speculative_k=2,
-                         draft_model=wmodel, draft_params=wparams)
+                         max_new_tokens=8, prefix_tokens=[1, 2],
+                         speculative_k=2, draft_model=wmodel,
+                         draft_params=wparams)
     with pytest.raises(ValueError, match="0..63"):
         GenerationServer("x", model, params, port=0,
                          prefix_tokens=[1, 99])
@@ -1436,9 +1481,10 @@ def test_engine_eos_recycles_slot_under_load():
 
 
 def test_stream_on_spec_server_matches_plain_greedy():
-    """"stream": true on a speculative-enabled server rides the
-    plain stream chain (no spec for streams) and still returns
-    exactly the plain greedy tokens."""
+    """"stream": true on a speculative server rides the SAME engine
+    rows: a verify step commits 1..k tokens, so stream chunks may
+    carry several tokens at once, and the concatenated stream is
+    exactly the non-stream greedy sequence."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -1468,15 +1514,23 @@ def test_stream_on_spec_server_matches_plain_greedy():
         got = [t for line in lines[:-1] for t in line["tokens"]]
         assert got == one["sequences"][0][3:]
         assert lines[-1] == {"done": True}
+        import urllib.request as _u
+        with _u.urlopen(f"http://localhost:{srv.port}/stats",
+                        timeout=10) as resp:
+            stats = json.loads(resp.read())
+        # Both requests (stream and not) drafted.
+        assert stats["draft_prefills"] == 2, stats
+        assert stats["spec_proposed_tokens"] > 0, stats
     finally:
         srv.stop()
 
 
 def test_generate_speculative_windowed_model_routes_spec():
-    """Sliding-window target + draft: the server constructs (the old
-    check_spec_models window refusal is gone), default-knob traffic
-    rides the SPECULATIVE program, and output equals the plain
-    windowed server's exactly (VERDICT r4 item 5)."""
+    """Sliding-window TARGET + dense draft: the engine's per-row
+    band mask verifies chunks under the window, so default-knob
+    traffic drafts and the output equals the plain windowed
+    server's exactly. A windowed DRAFT stays refused at
+    construction (the k-1 micro-step scan needs a dense cache)."""
     from container_engine_accelerators_tpu.models import TransformerLM
     from container_engine_accelerators_tpu.serving import (
         GenerationServer,
@@ -1489,7 +1543,7 @@ def test_generate_speculative_windowed_model_routes_spec():
                         jnp.zeros((1, 8), jnp.int32))["params"]
     draft = TransformerLM(vocab_size=64, embed_dim=16, num_layers=1,
                           num_heads=2, max_seq_len=48,
-                          attention_window=8, dtype=jnp.float32)
+                          dtype=jnp.float32)
     dparams = draft.init(jax.random.PRNGKey(2),
                          jnp.zeros((1, 8), jnp.int32))["params"]
 
@@ -1498,6 +1552,8 @@ def test_generate_speculative_windowed_model_routes_spec():
                                 max_new_tokens=8, max_batch=2,
                                 buckets=[8], **kw)
 
+    with pytest.raises(ValueError, match="dense cache"):
+        make(draft_model=model, draft_params=params, speculative_k=4)
     plain = make()
     spec = make(draft_model=draft, draft_params=dparams,
                 speculative_k=4)
@@ -1518,7 +1574,8 @@ def test_generate_speculative_windowed_model_routes_spec():
         with _u.urlopen(f"http://localhost:{spec.port}/stats",
                         timeout=10) as resp:
             stats = json.loads(resp.read())
-        assert stats["speculative_calls"] >= 3, stats
+        assert stats["spec_steps"] >= 3, stats
+        assert stats["spec_proposed_tokens"] > 0, stats
     finally:
         plain.stop()
         spec.stop()
@@ -1543,18 +1600,28 @@ def test_generate_speculative_acceptance_telemetry():
         speculative_k=4, warm=True)
     srv.start()
     try:
-        # Warm-up's synthetic spec calls count as calls (program-
-        # compilation signal) but must NOT seed the acceptance rate:
-        # it reports TRAFFIC's alpha only.
+        # Warm-up's synthetic rows DID gate spec steps (they compile
+        # the draft/verify programs) but reset_counters drops them:
+        # the surface reports TRAFFIC's alpha only.
         stats0 = srv.stats()
-        assert stats0["speculative_calls"] >= 1, stats0
+        assert stats0["spec_steps"] == 0, stats0
         assert stats0["speculative_acceptance_rate"] is None, stats0
         post(srv, "/v1/models/lm:generate",
              {"prompts": [[1, 2, 3]], "max_new_tokens": 8})
         stats = srv.stats()
-        # Self-draft: every proposal matches, so the accumulated
-        # acceptance must be 1.0 exactly.
-        assert stats["speculative_acceptance_rate"] == 1.0, stats
+        # Self-draft: proposals re-derive the target's own argmax,
+        # so acceptance sits at/near 1.0 — "near" because the draft
+        # proposes through single-token micro-steps while verify
+        # scores the same positions through a width-k chunk, and the
+        # different reduction orders can flip argmax near-ties on a
+        # random tiny model. The floor matches the spec-check gate.
+        rate = stats["speculative_acceptance_rate"]
+        assert rate is not None and 0.5 <= rate <= 1.0, stats
+        assert (stats["spec_accepted_tokens"]
+                <= stats["spec_proposed_tokens"]), stats
+        # >= 1 by construction; > 1 iff any proposal landed — the
+        # per-chip throughput multiplier the break-even model rates.
+        assert stats["accepted_tokens_per_step"] > 1.0, stats
     finally:
         srv.stop()
 
@@ -1599,9 +1666,12 @@ def test_prefix_server_with_speculation_matches_plain_prefix():
             b = post(spec, "/v1/models/lm:generate", payload)
             assert a["sequences"] == b["sequences"], payload
         stats = spec.stats()
-        assert stats["speculative_calls"] >= 3, stats
-        # Self-draft over the same prefix states: full acceptance.
-        assert stats["speculative_acceptance_rate"] == 1.0, stats
+        assert stats["spec_steps"] >= 3, stats
+        # Self-draft over the same prefix states: at/near-full
+        # acceptance (width-k verify vs micro-step draft reduction
+        # orders can flip argmax near-ties; floor = spec-check's).
+        rate = stats["speculative_acceptance_rate"]
+        assert rate is not None and rate >= 0.5, stats
         # Penalty requests still get the prefix-mode 400 (they need
         # prefix-token visibility) — the composition does not widen
         # the accepted request surface.

@@ -58,13 +58,17 @@ def registry_facts(registry):
 
 def test_registry_names_the_hot_program_set(registry):
     assert sorted(s.name for s in registry) == [
+        "engine.dense_draft", "engine.dense_draft_insert",
         "engine.dense_insert", "engine.dense_prefill",
-        "engine.dense_step", "engine.paged_hydrate",
-        "engine.paged_insert", "engine.paged_int4_insert",
-        "engine.paged_int4_prefill", "engine.paged_int4_step",
-        "engine.paged_int8_insert", "engine.paged_int8_prefill",
-        "engine.paged_int8_step", "engine.paged_prefill",
-        "engine.paged_step", "train.step"]
+        "engine.dense_step", "engine.dense_verify",
+        "engine.paged_draft", "engine.paged_draft_insert",
+        "engine.paged_hydrate", "engine.paged_insert",
+        "engine.paged_int4_insert", "engine.paged_int4_prefill",
+        "engine.paged_int4_step", "engine.paged_int8_insert",
+        "engine.paged_int8_prefill", "engine.paged_int8_step",
+        "engine.paged_prefill", "engine.paged_step",
+        "engine.paged_verify", "engine.windowed_prefill",
+        "engine.windowed_step", "train.step"]
 
 
 def test_tree_programs_have_zero_ir_findings(registry,

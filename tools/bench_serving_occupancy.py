@@ -68,6 +68,27 @@ availability, so it sustains more concurrent rows from the same
 memory; the gate fails unless paged sustained rows/step >=
 --paging-factor x dense, prefix_hit_rate > 0, and every greedy
 stream (both pools) is bit-identical to per-request ``decode``.
+
+**Speculative replay (``--spec-check``, ``make spec-check``).** The
+SAME Poisson trace replays through the engine with a draft model
+configured (self-draft at ``--spec-k``: the draft proposes the
+target's own greedy tokens, so acceptance is a regression tripwire
+on the verify/commit path — the only legitimate losses are
+argmax near-ties flipped by the draft's single-token micro-steps
+reducing in a different float order than the width-k verify chunk,
+which on the bench's random tiny model costs ~20%; a DROP below
+--spec-accept-floor means true proposals are being rejected) and
+again with speculation off. Device calls now include the draft side
+(one draft prefill per spec admission, one draft scan per gated
+step) at FULL target-call cost — an upper bound; a production draft
+is a fraction of the target — so the goodput number pays for the
+work speculation adds in the unit it saves verify steps in. The
+gate fails unless the speculative replay retains >= --check-factor
+x the batcher baseline's goodput under that pricing, acceptance
+holds the floor, every greedy stream is bit-identical to
+per-request ``decode``, and the pools (target AND draft arenas)
+release clean. Passing appends ``spec_accept_ratio`` /
+``accepted_tokens_per_step`` rows to the perf ledger.
 """
 
 import argparse
@@ -218,6 +239,147 @@ def run_engine(model, params, trace, args):
         "p50_latency_steps": round(float(np.percentile(latency, 50)), 1),
         "p99_latency_steps": round(float(np.percentile(latency, 99)), 1),
         **honesty,
+    }
+
+
+def _spec_calls(eng):
+    """Device calls so far on a draft-configured engine: the plain
+    step/prefill ledger PLUS the draft side — one draft-scan call per
+    gated step (spec_steps) and one draft prefill per speculative
+    admission. Speculation pays for its draft work in the same unit
+    it saves verify steps in."""
+    return (eng.steps + eng.spec_steps + eng.prefills
+            + eng.draft_prefills)
+
+
+def replay_spec(eng, trace, args):
+    """Continuous-batching replay on a draft-configured engine:
+    ``step`` returns (toks [slots, k], lps, counts) and the loop
+    consumes ``counts[slot]`` committed tokens per slot per step —
+    rows retire MID-CHUNK at their own budgets, surplus accepted
+    tokens are discarded exactly as the serving loop discards them.
+    Runs under the retrace guard extended with the speculative
+    program set (ONE draft scan + ONE verify + ONE draft insert)."""
+    from container_engine_accelerators_tpu.analysis.retrace import (
+        spec_engine_programs,
+    )
+
+    t = 0.0
+    queue = list(range(len(trace)))
+    outputs = [[] for _ in trace]
+    latency = [None] * len(trace)
+    slot_req = {}
+
+    def admit_ready():
+        nonlocal t
+        while queue and eng.free_slots():
+            i = queue[0]
+            if trace[i]["arrival"] > t:
+                break
+            queue.pop(0)
+            row = np.zeros((args.prompt_len,), np.int32)
+            row[:trace[i]["p_len"]] = trace[i]["prompt"]
+            c0 = _spec_calls(eng)
+            slot, first, _, _ = eng.admit(row, trace[i]["p_len"])
+            t += _spec_calls(eng) - c0   # target + draft prefill
+            outputs[i].append(first)
+            if trace[i]["new"] == 1:
+                latency[i] = t - trace[i]["arrival"]
+                eng.release(slot)
+            else:
+                slot_req[slot] = i
+
+    # Same prefill-budget derivation as run_engine: every row pads
+    # into the one prompt bucket. The self-draft's admission prefill
+    # reuses the SAME dense prefill program at the same width, so it
+    # consumes no budget of its own.
+    budget = len(trace) if eng.paged else 1
+    guard = _replay_guard(eng.paged, budget)
+    for name, fn in spec_engine_programs(eng.paged):
+        guard.watch(name, fn, max_new=1)
+    with guard:
+        while queue or slot_req:
+            admit_ready()
+            if not slot_req:
+                if queue:
+                    t = max(t, trace[queue[0]]["arrival"])
+                continue
+            c0 = _spec_calls(eng)
+            toks, _, counts = eng.step()
+            t += _spec_calls(eng) - c0   # verify + gated draft scan
+            for slot, i in list(slot_req.items()):
+                for j in range(int(counts[slot])):
+                    outputs[i].append(int(toks[slot, j]))
+                    if len(outputs[i]) >= trace[i]["new"]:
+                        latency[i] = t - trace[i]["arrival"]
+                        eng.release(slot)
+                        del slot_req[slot]
+                        break
+        honesty = _prefill_honesty(eng, guard)
+
+    calls = _spec_calls(eng)
+    tokens = sum(r["new"] for r in trace)
+    accept = eng.spec_accepted / max(eng.spec_proposed, 1)
+    per_step = ((eng.spec_accepted + eng.spec_row_steps)
+                / max(eng.spec_row_steps, 1))
+    return outputs, {
+        "steps": eng.steps,
+        "spec_steps": eng.spec_steps,
+        "prefills": eng.prefills,
+        "draft_prefills": eng.draft_prefills,
+        "rows_per_step": round(eng.row_steps / max(eng.steps, 1), 3),
+        "goodput_tokens_per_step": round(tokens / calls, 3),
+        "spec_accept_ratio": round(accept, 4),
+        "accepted_tokens_per_step": round(per_step, 3),
+        "p50_latency_steps": round(float(np.percentile(latency, 50)), 1),
+        "p99_latency_steps": round(float(np.percentile(latency, 99)), 1),
+        **honesty,
+    }
+
+
+def run_spec(model, params, args):
+    """Speculation on vs off on the SAME trace as the occupancy
+    replay, against the same batcher baseline. Self-draft: the draft
+    IS the target, so a proposal misses only when an argmax near-tie
+    flips between the draft's single-token micro-step and the
+    width-k verify chunk (different float reduction orders) —
+    acceptance is high by construction and a drop below the floor is
+    a verify/commit bug, while the goodput comparison measures what
+    chunked commit buys once the draft's own device calls are on the
+    ledger at full target-call cost."""
+    from container_engine_accelerators_tpu.models.decode import (
+        SlotDecodeEngine,
+    )
+
+    trace = build_trace(args, np.random.default_rng(args.seed))
+    eng = SlotDecodeEngine(
+        model, params, args.slots,
+        args.prompt_len + args.server_max_new,
+        kv_quant="bf16", kv_spill=False,
+        draft_model=model, draft_params=params, spec_k=args.spec_k)
+    out_on, spec = replay_spec(eng, trace, args)
+    leaks = eng.pool_leak_report()
+    out_off, plain = run_engine(model, params, trace, args)
+    baseline = run_baseline(trace, args)
+    ok_on, bad_on = verify_greedy(model, params, trace, out_on, args)
+    ok_off, _ = verify_greedy(model, params, trace, out_off, args)
+    vs_base = (spec["goodput_tokens_per_step"]
+               / max(baseline["goodput_tokens_per_step"], 1e-9))
+    vs_plain = (spec["goodput_tokens_per_step"]
+                / max(plain["goodput_tokens_per_step"], 1e-9))
+    return {
+        "config": {k: getattr(args, k)
+                   for k in ("slots", "requests", "arrival_rate",
+                             "prompt_len", "max_new",
+                             "server_max_new", "spec_k", "seed")},
+        "spec": spec,
+        "plain": plain,
+        "baseline": baseline,
+        "goodput_ratio_spec": round(vs_base, 3),
+        "spec_vs_plain_goodput": round(vs_plain, 3),
+        "greedy_exact": ok_on and ok_off,
+        "diverged_request": bad_on,
+        "pool_leaks": leaks,
     }
 
 
@@ -616,6 +778,23 @@ def main(argv=None):
                         "and every greedy stream is bit-identical to "
                         "its matching dense-fallback decode() — the "
                         "CI gate behind `make spill-check`")
+    p.add_argument("--spec-check", action="store_true",
+                   help="replay the occupancy trace with speculation "
+                        "on (self-draft at --spec-k) and off: exit 1 "
+                        "unless the speculative replay retains >= "
+                        "--check-factor x baseline goodput WITH the "
+                        "draft's device calls on the ledger, "
+                        "acceptance >= --spec-accept-floor, every "
+                        "greedy stream is bit-identical to decode(), "
+                        "and both arenas release clean — the CI gate "
+                        "behind `make spec-check`")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="verify chunk width (k-1 draft proposals per "
+                        "speculative step)")
+    p.add_argument("--spec-accept-floor", type=float, default=0.5,
+                   help="minimum self-draft acceptance ratio — "
+                        "losses beyond float near-tie flips mean "
+                        "the verify step rejects true proposals")
     p.add_argument("--spill-factor", type=float, default=1.8)
     p.add_argument("--spill-requests", type=int, default=36)
     p.add_argument("--spill-prefixes", type=int, default=6,
@@ -631,7 +810,8 @@ def main(argv=None):
                         "skipped_unmeasurable row instead of wedging")
     args = p.parse_args(argv)
 
-    ledger_source = ("spill_check" if args.spill_check
+    ledger_source = ("spec_check" if args.spec_check
+                     else "spill_check" if args.spill_check
                      else "paging_check"
                      if (args.paging or args.paging_check)
                      else "occupancy_check")
@@ -679,6 +859,60 @@ def main(argv=None):
         max_seq_len=max_len, dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+    if args.spec_check:
+        # Same tsan discipline as the other replay gates: the draft
+        # arena's host bookkeeping (free list, span allocation,
+        # per-row span limits) rides the single-threaded engine
+        # contract.
+        from container_engine_accelerators_tpu.analysis import tsan
+
+        with tsan.session(force=True) as tsan_state:
+            summary = run_spec(model, params, args)
+            tsan_rep = tsan_state.report()
+        summary["tsan_clean"] = tsan.is_clean(tsan_rep)
+        summary["platform"] = jax.devices()[0].platform
+        print(json.dumps(summary))
+        if not summary["tsan_clean"]:
+            print(tsan.format_report(tsan_rep), file=sys.stderr)
+            print("[spec] FAIL: lock-order sanitizer reported "
+                  "findings during the replay", file=sys.stderr)
+            return 1
+        if not summary["greedy_exact"]:
+            print(f"[spec] FAIL: a greedy stream diverged from "
+                  f"per-request decode "
+                  f"(request {summary['diverged_request']})",
+                  file=sys.stderr)
+            return 1
+        if summary["pool_leaks"]:
+            print(f"[spec] FAIL: the speculative engine's pools did "
+                  f"not release clean: {summary['pool_leaks']}",
+                  file=sys.stderr)
+            return 1
+        if (summary["spec"]["spec_accept_ratio"]
+                < args.spec_accept_floor):
+            print(f"[spec] FAIL: spec_accept_ratio "
+                  f"{summary['spec']['spec_accept_ratio']:.4f} < "
+                  f"floor {args.spec_accept_floor} — the verify "
+                  f"step is rejecting true self-draft proposals",
+                  file=sys.stderr)
+            return 1
+        if summary["goodput_ratio_spec"] < args.check_factor:
+            print(f"[spec] FAIL: goodput ratio "
+                  f"{summary['goodput_ratio_spec']:.2f} < required "
+                  f"{args.check_factor} vs the batcher baseline",
+                  file=sys.stderr)
+            return 1
+        ledger_append({
+            "spec_accept_ratio":
+                summary["spec"]["spec_accept_ratio"],
+            "accepted_tokens_per_step":
+                summary["spec"]["accepted_tokens_per_step"],
+            "goodput_ratio_spec": summary["goodput_ratio_spec"],
+            "goodput_tokens_per_step":
+                summary["spec"]["goodput_tokens_per_step"],
+        }, summary["config"])
+        return 0
 
     if args.spill_check:
         # Same tsan discipline as the paging gate: the spill tier's

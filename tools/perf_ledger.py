@@ -124,6 +124,11 @@ METRIC_DIRECTIONS = {
     "goodput_tokens_per_step": "up",
     "goodput_tokens_per_kwork": "up",
     "sustained_rows_ratio": "up",
+    # spec_check: acceptance trends — a DROP means the verify step
+    # started rejecting true proposals (self-draft acceptance is 1.0
+    # by construction) or chunked commit stopped landing tokens.
+    "spec_accept_ratio": "up",
+    "accepted_tokens_per_step": "up",
     "spill_goodput_ratio": "up",
     "int8_rows_ratio": "up",
     "prefix_hit_rate": "up",

@@ -164,6 +164,22 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "spill-check preflight"
 
+# Speculative-decode preflight (CPU fake backend, ~1 min): the
+# occupancy trace replayed with a self-draft configured must retain
+# >= 2x the batcher baseline's goodput with the draft's device calls
+# on the ledger, hold the self-draft acceptance floor, keep every
+# greedy stream bit-identical to per-request decode, and release
+# both arenas clean. A regression here means the one decode path's
+# speculative mode is losing tokens (verify/commit bug) or its
+# draft arena leaks — exactly what would corrupt the serving
+# sections' spec traffic below.
+echo "[suite] spec-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/bench_serving_occupancy.py --spec-check \
+  --ledger PERF_LEDGER.json \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "spec-check preflight"
+
 # Perf-ledger gate (pure ledger read, ~1s): every row appended so far
 # this window — and the whole committed history — is schema-checked,
 # and each source's newest row is held to within 10% of its newest

@@ -41,6 +41,17 @@ mid-recovery still finishes every in-flight stream inside the grace
 window (token-identical), with new admissions shed (the server's
 503 + Retry-After); and the whole run is tsan-clean.
 
+The ``spec`` episode runs the same trace through a DRAFT-CONFIGURED
+service (self-draft, ``--spec-k`` chunks) and lands the fault
+mid-verify — after the speculative prestep has already torn the
+block tables for the chunk span. Beyond the shared contract
+(token-identical replay, clean pools, one event pair), it holds the
+acceptance counters consistent across the rebuild: accepted <=
+proposed, speculation actually engaged, and cumulative
+``draft_prefills`` == admissions + replayed rows — the absorbed-base
+accounting counted the torn engine's work exactly once (a lost base
+undercounts, a double absorption overcounts).
+
 ``--fast`` is the presubmit leg (smaller traces, no clean-reference
 episode); ``--ledger`` (the suite leg) appends a recovery row:
 ``recovery_goodput_ratio`` ("up") = useful token-work / (useful +
@@ -125,7 +136,7 @@ def reference_streams(model, params, trace):
             for i, r in enumerate(trace)]
 
 
-def make_service(model, params, args, spill=False):
+def make_service(model, params, args, spill=False, spec=False):
     from container_engine_accelerators_tpu.models.decode import (
         SlotDecodeEngine,
     )
@@ -146,13 +157,20 @@ def make_service(model, params, args, spill=False):
                 kv_block_size=4, kv_blocks=5, buckets=[8],
                 kv_quant="bf16", kv_spill=True,
                 kv_spill_bytes=1 << 20)
+        kw = {}
+        if spec:
+            # Self-draft: acceptance is high by construction, so the
+            # mid-verify fault lands on multi-token commits — the
+            # state a rebuild must snapshot/replay exactly.
+            kw = dict(draft_model=model, draft_params=params,
+                      spec_k=args.spec_k)
         return SlotDecodeEngine(
             model, params, slots=args.slots,
             slot_len=args.prompt_len + args.max_new, paged=True,
             kv_block_size=4,
             buckets=[args.prompt_len,
                      args.prompt_len + args.max_new],
-            kv_quant="bf16", kv_spill=False)
+            kv_quant="bf16", kv_spill=False, **kw)
 
     return _EngineService(factory(), _Admission(0),
                           engine_factory=factory)
@@ -167,12 +185,14 @@ def make_work(prompt, p_len, new, seed=0, **kw):
                        0, 1.0, 0.0, 1.0, -1, False, seed, None, **kw)
 
 
-def warm(svc, *widths):
+def warm(svc, *widths, new=2):
     """Warm every bucket the episode can touch — including the wide
     bucket replay admissions select (prompt + generated prefix) — so
-    no compile lands inside a measured episode."""
+    no compile lands inside a measured episode. Spec services warm
+    with ``new`` >= spec_k so at least one step GATES (compiling the
+    draft scan, not just the verify program's single-token path)."""
     for width in widths:
-        work = make_work(np.zeros((width,), np.int32), width, 2,
+        work = make_work(np.zeros((width,), np.int32), width, new,
                          account=False, no_prefix=True)
         if svc.submit_many([work]) is None:
             raise RuntimeError("warm work shed")
@@ -291,9 +311,45 @@ def run_episode(name, svc, trace, plan=None, drain=False,
         "replayed_tokens": stats["replayed_tokens"],
         "quarantine_events": quarantines,
         "recovered_events": recoveries,
+        "spec": {k: stats[k] for k in
+                 ("spec_steps", "spec_proposed_tokens",
+                  "spec_accepted_tokens", "draft_prefills",
+                  "speculative_acceptance_rate",
+                  "accepted_tokens_per_step")},
         "tokens": [w.tokens for w in works],
         "failures": failures,
     }
+
+
+def check_spec_counters(episode, failures):
+    """Acceptance-counter consistency across the rebuild: the
+    absorbed base must have counted the torn engine's speculative
+    work exactly once. ``draft_prefills`` is the exact tripwire —
+    every greedy admission mirrors one draft prefill, so cumulative
+    drafts == admissions + replayed rows; a lost base undercounts,
+    a double absorption overcounts."""
+    spec = episode["spec"]
+    name = episode["episode"]
+    if spec["spec_steps"] <= 0 or not spec["spec_accepted_tokens"]:
+        failures.append(
+            f"[{name}] speculation never engaged "
+            f"(spec_steps {spec['spec_steps']}, accepted "
+            f"{spec['spec_accepted_tokens']}) — the episode did not "
+            f"fault a speculative stream")
+        return
+    if (spec["spec_accepted_tokens"]
+            > spec["spec_proposed_tokens"]):
+        failures.append(
+            f"[{name}] accepted {spec['spec_accepted_tokens']} > "
+            f"proposed {spec['spec_proposed_tokens']} — acceptance "
+            f"counters double-counted across the rebuild")
+    want_drafts = episode["requests"] + episode["replayed_rows"]
+    if spec["draft_prefills"] != want_drafts:
+        failures.append(
+            f"[{name}] draft_prefills {spec['draft_prefills']} != "
+            f"admissions {episode['requests']} + replayed rows "
+            f"{episode['replayed_rows']} — the quarantine rebuild "
+            f"lost or double-absorbed the torn engine's counters")
 
 
 def check_tokens(episode, ref, failures):
@@ -339,6 +395,13 @@ def main(argv=None):
     p.add_argument("--prefill-at", type=int, default=2,
                    help="prefill invocation index the prefill "
                         "episode faults at")
+    p.add_argument("--spec-step-at", type=int, default=1,
+                   help="step invocation index the speculative "
+                        "episode faults at (early: chunked commit "
+                        "retires rows in few steps)")
+    p.add_argument("--spec-k", type=int, default=3,
+                   help="verify chunk width of the speculative "
+                        "episode's self-draft engine")
     p.add_argument("--drain-grace-s", type=float, default=120.0)
     p.add_argument("--ledger", default=None, metavar="PATH",
                    help="append the recovery trend row to the perf "
@@ -378,17 +441,25 @@ def main(argv=None):
             finally:
                 svc.stop()
 
-        for name, plan in (
-                ("step", {"step": [args.step_at]}),
-                ("prefill", {"prefill": [args.prefill_at]})):
-            svc = make_service(model, params, args)
+        for name, plan, spec in (
+                ("step", {"step": [args.step_at]}, False),
+                ("prefill", {"prefill": [args.prefill_at]}, False),
+                # Mid-verify: in a draft-configured engine the step
+                # fault site fires inside _spec_step, after the
+                # speculative prestep tore the chunk span's block
+                # tables — the worst state a rebuild can inherit.
+                ("spec", {"step": [args.spec_step_at]}, True)):
+            svc = make_service(model, params, args, spec=spec)
             try:
                 warm(svc, args.prompt_len,
-                     args.prompt_len + args.max_new)
+                     args.prompt_len + args.max_new,
+                     new=args.spec_k if spec else 2)
                 ep = run_episode(name, svc, trace, plan=plan)
                 episodes.append(ep)
                 failures.extend(ep.pop("failures"))
                 check_tokens(ep, ref, failures)
+                if spec:
+                    check_spec_counters(ep, failures)
             finally:
                 svc.stop()
 
@@ -473,7 +544,8 @@ def main(argv=None):
         "platform": jax.devices()[0].platform,
         "config": {k: getattr(args, k) for k in
                    ("requests", "slots", "prompt_len", "max_new",
-                    "step_at", "prefill_at", "seed", "fast")},
+                    "step_at", "prefill_at", "spec_step_at",
+                    "spec_k", "seed", "fast")},
         "episodes": [{k: v for k, v in e.items() if k != "tokens"}
                      for e in episodes],
         "recovery_goodput_ratio": goodput_ratio,
