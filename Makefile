@@ -39,6 +39,7 @@ presubmit:
 	python3 tools/perf_ledger.py check
 	JAX_PLATFORMS=cpu python3 tools/slo_check.py --fast
 	JAX_PLATFORMS=cpu python3 tools/serving_chaos_check.py --fast
+	JAX_PLATFORMS=cpu python3 tools/fleet_check.py --fast
 	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
 		--spec-check
 
@@ -170,6 +171,16 @@ slo-check:
 serving-chaos-check:
 	JAX_PLATFORMS=cpu python3 tools/serving_chaos_check.py
 
+# Fleet observability gate: three real fake-chip engines + the
+# jax-free observer; merged fleet p99s must EQUAL a pooled
+# recomputation bucket-for-bucket, a SIGKILL'd engine must produce
+# exactly one fleet.engine_down and leave the steer set in one poll,
+# a draining engine is steered around WITHOUT a down event, a fresh
+# SLO burst fires the fast burn window while the slow window holds,
+# and the scale signal rises under load then decays. Pure CPU.
+fleet-check:
+	JAX_PLATFORMS=cpu python3 tools/fleet_check.py
+
 # Perf-ledger regression gate: validate every committed
 # PERF_LEDGER.json row (schema exact, field-level messages) and
 # compare each source's newest row against its newest SAME-RIG
@@ -209,4 +220,5 @@ clean:
 	analysis-check program-check trace-check diagnose-check \
 	goodput-check chaos-check placement-check occupancy-check \
 	paging-check spill-check spec-check perf-check slo-check \
-	serving-chaos-check container partition-tpu push clean
+	serving-chaos-check fleet-check container partition-tpu push \
+	clean

@@ -56,6 +56,7 @@ from .export import (
     prometheus_text,
     varz,
 )
+from .fleet import FleetCollector, FleetView, histograms_from_text
 from .http import TRACE_PATH, VARZ_PATH, debug_response
 from .identity import identity, process_label, set_role
 from .profiler import PROFILE_PATH, profile_response
@@ -114,15 +115,16 @@ def enabled():
 
 
 __all__ = [
-    "ATTRIBUTION_BUCKETS", "DEFAULT_BUCKETS", "FlopsLedger",
-    "GoodputLedger", "Histogram", "NULL_SPAN", "PROFILE_PATH",
-    "RequestLedger", "RequestTimeline", "SATURATION_CAUSES", "Span",
-    "TRACEPARENT_KEY", "TRACER", "TRACE_PATH", "Tracer", "VARZ_PATH",
-    "context_from_metadata", "counter", "debug_response", "dump_json",
-    "enabled", "event", "flops_from_cost_analysis",
-    "format_traceparent", "gauge", "get_tracer", "histogram",
-    "identity", "merge_perfetto", "parse_traceparent",
-    "peak_flops_per_chip", "perfetto_trace", "process_label",
-    "profile_response", "prometheus_text", "report_from_snapshots",
-    "saturation", "set_role", "span", "varz", "write_journal",
+    "ATTRIBUTION_BUCKETS", "DEFAULT_BUCKETS", "FleetCollector",
+    "FleetView", "FlopsLedger", "GoodputLedger", "Histogram",
+    "NULL_SPAN", "PROFILE_PATH", "RequestLedger", "RequestTimeline",
+    "SATURATION_CAUSES", "Span", "TRACEPARENT_KEY", "TRACER",
+    "TRACE_PATH", "Tracer", "VARZ_PATH", "context_from_metadata",
+    "counter", "debug_response", "dump_json", "enabled", "event",
+    "flops_from_cost_analysis", "format_traceparent", "gauge",
+    "get_tracer", "histogram", "histograms_from_text", "identity",
+    "merge_perfetto", "parse_traceparent", "peak_flops_per_chip",
+    "perfetto_trace", "process_label", "profile_response",
+    "prometheus_text", "report_from_snapshots", "saturation",
+    "set_role", "span", "varz", "write_journal",
 ]

@@ -75,6 +75,16 @@ SERVING_SATURATION = "tpu_serving_saturation"
 SERVING_SATURATION_CAUSE = "tpu_serving_saturation_cause"
 SERVING_ENGINE_REBUILDS = "tpu_serving_engine_rebuilds_total"
 
+# -- fleet (the multi-engine collector, obs/fleet.py) ------------------
+FLEET_ENGINES = "tpu_fleet_engines"
+FLEET_SATURATION = "tpu_fleet_saturation"
+FLEET_TTFT = "tpu_fleet_ttft_seconds"
+FLEET_TPOT = "tpu_fleet_tpot_seconds"
+FLEET_SLO_BURN = "tpu_fleet_slo_burn_rate"
+FLEET_DESIRED_REPLICAS = "tpu_fleet_desired_replicas"
+FLEET_POLLS = "tpu_fleet_polls_total"
+FLEET_POLL_ERRORS = "tpu_fleet_poll_errors_total"
+
 # name -> one-line help. The authoritative set: the metric-registry
 # lint resolves every tpu_* literal in the tree against these keys
 # (accepting the prometheus_client `_total` exposition variant) and
@@ -117,6 +127,17 @@ METRICS = {
     SERVING_SATURATION_CAUSE: "per-cause serving saturation (0..1)",
     SERVING_ENGINE_REBUILDS:
         "engine quarantine-and-rebuild episodes by fault reason",
+    FLEET_ENGINES: "engines by liveness state (up/down/unready)",
+    FLEET_SATURATION:
+        "cause-wise fleet saturation, max and mean over engines",
+    FLEET_TTFT: "fleet-merged TTFT distribution (exact bucket merge)",
+    FLEET_TPOT: "fleet-merged TPOT distribution (exact bucket merge)",
+    FLEET_SLO_BURN:
+        "SLO error-budget burn rate per (slo, fast/slow window)",
+    FLEET_DESIRED_REPLICAS:
+        "HPA-shaped replica target from sustained fleet saturation",
+    FLEET_POLLS: "completed fleet poll cycles",
+    FLEET_POLL_ERRORS: "engine poll attempts that failed, by engine",
 }
 
 # tpu_-prefixed tokens that are NOT metric names (label keys, module

@@ -546,6 +546,19 @@ class _EngineService:
         level = sat["max"] if sat else 0.0
         return max(1, int(round(1 + 4 * min(1.0, max(0.0, level)))))
 
+    def saturation_cause(self):
+        """Name of the highest-pressure cause from the last
+        step-boundary saturation snapshot (None before any snapshot
+        or at zero pressure) — the /readyz 503 body's steer-around
+        hint."""
+        with self._lock:
+            sat = self._last_saturation
+        causes = (sat or {}).get("causes") or {}
+        if not causes:
+            return None
+        cause, level = max(causes.items(), key=lambda kv: kv[1])
+        return cause if level > 0 else None
+
     def begin_drain(self):
         """Flip into drain: submissions shed from this instant;
         in-flight work keeps stepping to completion."""
@@ -1443,6 +1456,22 @@ class _BaseServer:
                                      str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif path == "/metrics":
+                    # Prometheus exposition of the process-wide
+                    # tracer — histogram BUCKETS included, which
+                    # /debug/varz only summarizes: the fleet
+                    # collector (obs/fleet.py) de-cumulates these
+                    # back into per-bucket counts for the exact
+                    # fleet-wide merge.
+                    body = obs.prometheus_text(
+                        obs.get_tracer()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif path == "/debug/requests":
                     # Per-request latency attribution ring (engine-
                     # mode generation servers; 404 elsewhere).
@@ -1470,12 +1499,18 @@ class _BaseServer:
                         self._reply(200, {"status": "ready",
                                           "model": server._name})
                     else:
+                        # Structured steer-around body: the fleet
+                        # collector/router reads WHICH lifecycle
+                        # state 503'd (and the dominant saturation
+                        # cause) without a second /stats round trip;
+                        # "status" stays for pre-fleet consumers.
+                        detail = server._readyz_detail()
                         self._reply(
                             503,
-                            {"status": server._unready_reason(),
-                             "model": server._name},
+                            dict(detail, status=detail["state"],
+                                 model=server._name),
                             headers={"Retry-After": str(
-                                server._overload_retry_after())})
+                                detail["retry_after_s"])})
                 elif self.path == "/stats":
                     self._reply(200, server.stats())
                 elif self.path == f"/v1/models/{server._name}":
@@ -1616,6 +1651,17 @@ class _BaseServer:
         answer is the minimal honest hint."""
         return 1
 
+    def _readyz_detail(self):
+        """Structured body for /readyz 503s — the steer-around
+        contract ``{state, retry_after_s, saturation_cause}`` the
+        fleet collector and router consume. Base servers only know
+        warm-up and drain; engine-mode generation servers override
+        with the lifecycle cascade's state and the dominant
+        saturation cause."""
+        return {"state": self._unready_reason(),
+                "retry_after_s": self._overload_retry_after(),
+                "saturation_cause": None}
+
     def begin_drain(self):
         """Start rejecting POSTs (503 + Retry-After) while keeping
         /healthz live and in-flight work running. /readyz flips
@@ -1645,6 +1691,16 @@ class _BaseServer:
     @property
     def port(self):
         return self._httpd.server_address[1]
+
+    def engine_id(self):
+        """``role@host:port[pid]`` — the replica's stable identity
+        in /stats, fleet rollups, and fleet journal events (the
+        process_label idiom plus the one fact a PROCESS label lacks:
+        which listening port is this replica)."""
+        ident = obs.identity()
+        return "%s@%s:%s[%s]" % (ident.get("role", "serving"),
+                                 ident.get("host", "?"), self.port,
+                                 ident.get("pid", "?"))
 
     def _record(self, latency_s):
         self._latency_hist.observe(latency_s)
@@ -1721,6 +1777,13 @@ class _BaseServer:
         hbm = self._memory_monitor.totals()
         with self._stats_lock:
             out = {
+                # Stable fleet-wide identity: the journal's
+                # (host, pid, role) stamp plus the serving port, so
+                # fleet rollups and journal events label engines by
+                # something better than whatever URL a collector
+                # happened to dial.
+                "engine_id": self.engine_id(),
+                "identity": dict(obs.identity(), port=self.port),
                 "requests": self._requests,
                 "shed": self._shed,
                 # What this replica computes on (captured at init) —
@@ -2201,6 +2264,22 @@ class GenerationServer(_BaseServer):
 
     def _overload_retry_after(self):
         return self._engine_service.retry_after_s()
+
+    def _readyz_detail(self):
+        """Engine-mode 503 detail: the lifecycle cascade names the
+        state (draining / quarantined / breaker_open), the engine's
+        Retry-After horizon rides along, and the dominant saturation
+        cause says WHY a shed-worthy engine should be steered
+        around."""
+        svc = self._engine_service
+        state = svc.engine_state()
+        if state == "serving":
+            # The engine is fine, so the server-level gate (warming
+            # drain flag) is what 503'd.
+            state = self._unready_reason()
+        return {"state": state,
+                "retry_after_s": svc.retry_after_s(),
+                "saturation_cause": svc.saturation_cause()}
 
     def drain(self, grace_s=None):
         """SIGTERM graceful drain: reject new POSTs immediately

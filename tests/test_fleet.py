@@ -1,0 +1,481 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet collector contracts: Histogram.merge exactness, the
+Prometheus-exposition inverse parser, liveness hysteresis, burn
+windows, the routing contract, and the scale signal — all against an
+injected fake fleet (no sockets, no sleeps; tools/fleet_check.py
+drives the real-HTTP version)."""
+
+import json
+import math
+
+import pytest
+
+from container_engine_accelerators_tpu import obs
+from container_engine_accelerators_tpu.obs.fleet import (
+    BURN_EVENT,
+    DOWN_EVENT,
+    RECOVERED_EVENT,
+    FleetCollector,
+    histograms_from_text,
+)
+from container_engine_accelerators_tpu.obs.metric_names import (
+    SERVING_TPOT,
+    SERVING_TTFT,
+)
+from container_engine_accelerators_tpu.obs.trace import Tracer
+
+# ---------------------------------------------------------------------------
+# Histogram.merge
+# ---------------------------------------------------------------------------
+
+
+def test_merge_empty_and_nonempty():
+    full = obs.Histogram("a", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 9.0):
+        full.observe(v)
+    empty = obs.Histogram("b", buckets=(1.0, 2.0, 4.0))
+    empty.merge(full)
+    assert empty.counts == full.counts
+    assert empty.count == full.count
+    assert empty.sum == full.sum
+    assert empty.quantile(0.5) == full.quantile(0.5)
+    # The other direction: merging an empty histogram is a no-op.
+    before = (list(full.counts), full.count, full.sum)
+    full.merge(obs.Histogram("c", buckets=(1.0, 2.0, 4.0)))
+    assert (list(full.counts), full.count, full.sum) == before
+
+
+def test_merge_overflow_only_operands():
+    # Every observation past the largest finite bound on BOTH sides:
+    # the merge must pool the +Inf bucket, and the quantile must keep
+    # reporting the largest finite bound (the documented saturation).
+    a = obs.Histogram("a", buckets=(1.0, 2.0))
+    b = obs.Histogram("b", buckets=(1.0, 2.0))
+    for v in (5.0, 7.0):
+        a.observe(v)
+    b.observe(9.0)
+    a.merge(b)
+    assert a.counts == [0, 0, 3]
+    assert a.count == 3
+    assert a.quantile(0.99) == 2.0
+
+
+def test_merge_mismatched_boundaries_names_the_offender():
+    a = obs.Histogram("a", buckets=(0.5, 1.0, 2.0))
+    b = obs.Histogram("b", buckets=(0.5, 1.5, 2.0))
+    with pytest.raises(ValueError) as err:
+        a.merge(b)
+    msg = str(err.value)
+    assert "index 1" in msg and "'b'" in msg and "'a'" in msg
+    assert "1.0" in msg and "1.5" in msg
+    with pytest.raises(TypeError):
+        a.merge({"not": "a histogram"})
+
+
+def test_merge_then_quantile_equals_pooled_quantile():
+    # The whole point of bucket-wise merging: quantiles of the merge
+    # EQUAL quantiles over the pooled observations' histogram, which
+    # averaging per-shard percentiles never achieves.
+    values_a = [0.001 * i for i in range(1, 40)]
+    values_b = [0.05 * i for i in range(1, 25)]
+    a = obs.Histogram("a")
+    b = obs.Histogram("b")
+    pooled = obs.Histogram("pooled")
+    for v in values_a:
+        a.observe(v)
+        pooled.observe(v)
+    for v in values_b:
+        b.observe(v)
+        pooled.observe(v)
+    a.merge(b)
+    assert a.counts == pooled.counts
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert a.quantile(q) == pooled.quantile(q)
+
+
+# ---------------------------------------------------------------------------
+# histograms_from_text (the prometheus_text inverse)
+# ---------------------------------------------------------------------------
+
+
+def test_parser_roundtrips_prometheus_text_exactly():
+    tracer = Tracer(enabled=True)
+    h = tracer.histogram(SERVING_TTFT, "ttft")
+    for v in (0.002, 0.015, 0.11, 0.9, 4.2):
+        h.observe(v)
+    parsed = histograms_from_text(obs.prometheus_text(tracer))
+    got = parsed[(SERVING_TTFT, ())]
+    assert got.counts == h.counts
+    assert got.count == h.count
+    assert got.sum == pytest.approx(h.sum)
+    for q in (0.5, 0.99):
+        assert got.quantile(q) == h.quantile(q)
+    # And it merges exactly with a histogram on the same grid.
+    acc = obs.Histogram("acc", buckets=h.buckets)
+    acc.merge(got)
+    assert acc.counts == h.counts
+
+
+def test_parser_names_filter_and_labels():
+    tracer = Tracer(enabled=True)
+    tracer.histogram(SERVING_TTFT, "ttft",
+                     labels={"model": "lm"}).observe(0.01)
+    tracer.histogram(SERVING_TPOT, "tpot").observe(0.002)
+    tracer.histogram("other_latency_seconds", "noise").observe(1.0)
+    parsed = histograms_from_text(obs.prometheus_text(tracer),
+                                  names={SERVING_TTFT, SERVING_TPOT})
+    assert set(parsed) == {(SERVING_TTFT, (("model", "lm"),)),
+                           (SERVING_TPOT, ())}
+
+
+def test_parser_drops_malformed_families():
+    text = "\n".join([
+        # Overflow-only family: no finite bound can name a grid.
+        'x_seconds_bucket{le="+Inf"} 5',
+        'x_seconds_count 5',
+        # Non-monotone cumulative counts: poisoned, dropped.
+        'y_seconds_bucket{le="1.0"} 7',
+        'y_seconds_bucket{le="2.0"} 3',
+        'y_seconds_bucket{le="+Inf"} 7',
+        # A good family parses despite the bad neighbors.
+        'z_seconds_bucket{le="1.0"} 2',
+        'z_seconds_bucket{le="+Inf"} 4',
+        'z_seconds_sum 3.5',
+        'z_seconds_count 4',
+    ])
+    parsed = histograms_from_text(text)
+    assert set(parsed) == {("z_seconds", ())}
+    z = parsed[("z_seconds", ())]
+    assert z.counts == [2, 2]
+    assert z.count == 4 and z.sum == 3.5
+
+
+# ---------------------------------------------------------------------------
+# The collector against a fake fleet
+# ---------------------------------------------------------------------------
+
+
+class FakeFleet:
+    """Three fake engines behind an injected fetch/clock pair."""
+
+    def __init__(self, n=3):
+        self.now = 1000.0
+        self.urls = [f"http://e{i}" for i in range(n)]
+        self.engines = {}
+        for i, url in enumerate(self.urls):
+            tracer = Tracer(enabled=True)
+            self.engines[url] = {
+                "alive": True,
+                "ready": True,
+                "detail": None,       # structured 503 body when set
+                "engine_id": f"lm@host{i}:85{i:02d}[{i + 1}]",
+                "retired": 0,
+                "violations": {"ttft": 0, "tpot": 0},
+                "saturation": {"max": 0.0, "causes": {"slots": 0.0}},
+                "queue_depth": 0,
+                "tracer": tracer,
+            }
+
+    def clock(self):
+        return self.now
+
+    def hist(self, url, name=SERVING_TTFT):
+        return self.engines[url]["tracer"].histogram(name, "lat")
+
+    def fetch(self, url, timeout=3.0):
+        base = next(u for u in self.urls if url.startswith(u + "/"))
+        eng = self.engines[base]
+        if not eng["alive"]:
+            raise OSError("connection refused")
+        path = url[len(base):]
+        if path == "/stats":
+            return 200, {}, json.dumps({
+                "engine_id": eng["engine_id"],
+                "requests_retired": eng["retired"],
+                "queue_depth": eng["queue_depth"],
+                "slo": {"violations": dict(eng["violations"])},
+                "saturation": eng["saturation"],
+            }).encode()
+        if path == "/metrics":
+            return 200, {}, obs.prometheus_text(
+                eng["tracer"]).encode()
+        if path == "/readyz":
+            if eng["ready"]:
+                return 200, {}, b'{"status": "ok"}'
+            detail = eng["detail"] or {"state": "draining",
+                                       "retry_after_s": 5.0,
+                                       "saturation_cause": None}
+            return (503,
+                    {"Retry-After": str(detail["retry_after_s"])},
+                    json.dumps(detail).encode())
+        if path.startswith("/debug/requests"):
+            return 200, {}, json.dumps(
+                {"retired_total": eng["retired"],
+                 "records": []}).encode()
+        raise AssertionError(f"unexpected fetch {url}")
+
+
+def make_collector(fleet, tracer, **kw):
+    kw.setdefault("poll_ms", 1000.0)
+    kw.setdefault("down_after", 2)
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    kw.setdefault("burn_threshold", 10.0)
+    kw.setdefault("slo_budget", 0.01)
+    kw.setdefault("sat_target", 0.5)
+    kw.setdefault("sat_alpha", 0.5)
+    return FleetCollector(fleet.urls, tracer=tracer,
+                          fetch=fleet.fetch, clock=fleet.clock, **kw)
+
+
+def events(tracer, name):
+    return [e["fields"] for e in tracer.snapshot()["events"]
+            if e["name"] == name]
+
+
+def test_collector_rejects_bad_url_sets():
+    with pytest.raises(ValueError):
+        FleetCollector([])
+    with pytest.raises(ValueError):
+        FleetCollector(["http://a", "http://a/"])
+
+
+def test_merged_view_equals_pooled_and_routes_least_loaded():
+    fleet = FakeFleet()
+    pooled = obs.Histogram("pooled")
+    for i, url in enumerate(fleet.urls):
+        for k in range(4):
+            v = 0.01 * (i + 1) * (k + 1)
+            fleet.hist(url).observe(v)
+            pooled.observe(v)
+        fleet.engines[url]["saturation"] = {
+            "max": 0.2 * i, "causes": {"slots": 0.2 * i}}
+        fleet.engines[url]["retired"] = 4
+    tracer = Tracer(enabled=True)
+    view = make_collector(fleet, tracer).poll_once()
+
+    assert view.ttft.counts == pooled.counts
+    for q in (0.5, 0.99):
+        assert view.ttft.quantile(q) == pooled.quantile(q)
+    assert view.steer_set() == fleet.urls
+    assert view.pick_least_loaded() == fleet.urls[0]
+    assert view.pick_least_loaded(
+        exclude=[fleet.urls[0]]) == fleet.urls[1]
+    assert view.counts() == {"up": 3, "down": 0, "unready": 0}
+    # The rollup payload carries the engine identity, not just URLs.
+    engines = {e["url"]: e for e in view.to_dict()["engines"]}
+    assert engines[fleet.urls[0]]["engine_id"] \
+        == fleet.engines[fleet.urls[0]]["engine_id"]
+    assert not any(k.startswith("_")
+                   for e in view.to_dict()["engines"] for k in e)
+
+
+def test_unready_engine_steered_around_without_down_event():
+    fleet = FakeFleet()
+    draining = fleet.urls[1]
+    fleet.engines[draining]["ready"] = False
+    fleet.engines[draining]["detail"] = {
+        "state": "draining", "retry_after_s": 5.0,
+        "saturation_cause": "slots"}
+    tracer = Tracer(enabled=True)
+    view = make_collector(fleet, tracer).poll_once()
+    assert draining not in view.steer_set()
+    eng = next(e for e in view.engines if e["url"] == draining)
+    assert eng["state"] == "draining" and not eng["down"]
+    assert eng["retry_after_s"] == 5.0
+    assert eng["saturation_cause"] == "slots"
+    assert view.counts() == {"up": 3, "down": 0, "unready": 1}
+    assert not events(tracer, DOWN_EVENT)
+
+
+def test_down_hysteresis_exactly_one_episode():
+    fleet = FakeFleet()
+    tracer = Tracer(enabled=True)
+    collector = make_collector(fleet, tracer, down_after=2)
+    collector.poll_once()
+    victim = fleet.urls[0]
+    fleet.engines[victim]["alive"] = False
+
+    fleet.now += 1
+    view = collector.poll_once()
+    # One failed poll: steered out immediately, but not DOWN yet
+    # (down_after=2 rides out a single blip).
+    assert victim not in view.steer_set()
+    assert not events(tracer, DOWN_EVENT)
+
+    for _ in range(3):   # crossing the threshold fires exactly once
+        fleet.now += 1
+        view = collector.poll_once()
+    downs = events(tracer, DOWN_EVENT)
+    assert len(downs) == 1
+    assert downs[0]["url"] == victim
+    assert downs[0]["engine"] \
+        == fleet.engines[victim]["engine_id"]
+    assert view.counts()["down"] == 1
+
+    fleet.engines[victim]["alive"] = True
+    fleet.now += 1
+    view = collector.poll_once()
+    recovered = events(tracer, RECOVERED_EVENT)
+    assert len(recovered) == 1 and recovered[0]["url"] == victim
+    assert victim in view.steer_set()
+    assert (collector.event_counts()[0],
+            collector.event_counts()[1]) == (1, 1)
+
+
+def test_stale_snapshot_flips_down_before_the_failure_threshold():
+    fleet = FakeFleet()
+    tracer = Tracer(enabled=True)
+    collector = make_collector(fleet, tracer, down_after=5,
+                               stale_ms=3000.0)
+    collector.poll_once()
+    victim = fleet.urls[2]
+    fleet.engines[victim]["alive"] = False
+    fleet.now += 1
+    collector.poll_once()      # failure 1 of 5: not down
+    assert not events(tracer, DOWN_EVENT)
+    fleet.now += 10            # snapshot now stale (> 3s old)
+    collector.poll_once()
+    downs = events(tracer, DOWN_EVENT)
+    assert len(downs) == 1 and downs[0]["stale"] is True
+
+
+def test_burn_fast_fires_once_slow_holds_and_rearms():
+    fleet = FakeFleet()
+    tracer = Tracer(enabled=True)
+    collector = make_collector(fleet, tracer)   # thr 10, budget 1%
+
+    def advance(dt, retired, ttft_viol):
+        fleet.now += dt
+        for url in fleet.urls:
+            fleet.engines[url]["retired"] = retired
+            fleet.engines[url]["violations"]["ttft"] = ttft_viol
+        return collector.poll_once()
+
+    # Deep clean history (fleet sums are 3x the per-engine numbers),
+    # then a burst of 60 fleet-wide violations. Fast window (60s)
+    # baseline = the sample 90s back -> (60/360)/0.01 = 16.7 >= 10
+    # fires; slow window (600s) baseline = the whole history ->
+    # (60/1260)/0.01 ~= 4.8 < 10 stays diluted.
+    advance(0, 0, 0)
+    advance(30, 100, 0)
+    advance(30, 300, 0)
+    advance(60, 400, 0)
+    view = advance(30, 420, 20)
+    assert view.burn["ttft"]["fast"] >= 10.0
+    assert view.burn["ttft"]["slow"] < 10.0
+    burns = events(tracer, BURN_EVENT)
+    assert [(b["slo"], b["window"]) for b in burns
+            if b["slo"] == "ttft"].count(("ttft", "fast")) == 1
+    # Re-poll with the burst still inside the fast window: the open
+    # episode must NOT re-fire.
+    advance(10, 425, 20)
+    assert len(events(tracer, BURN_EVENT)) == len(burns)
+    # Quiet period slides the burst out of the fast window: the rate
+    # collapses under threshold/2 and the episode re-arms...
+    advance(120, 600, 20)
+    view = advance(10, 610, 20)
+    assert view.burn["ttft"]["fast"] <= 5.0
+    # ...so a SECOND burst opens a SECOND episode.
+    advance(10, 615, 40)
+    fast_burns = [b for b in events(tracer, BURN_EVENT)
+                  if (b["slo"], b["window"]) == ("ttft", "fast")]
+    assert len(fast_burns) == 2
+
+
+def test_burn_slow_window_stays_diluted_on_fresh_burst():
+    fleet = FakeFleet()
+    tracer = Tracer(enabled=True)
+    collector = make_collector(fleet, tracer)
+
+    def advance(dt, retired, ttft_viol):
+        fleet.now += dt
+        for url in fleet.urls:
+            fleet.engines[url]["retired"] = retired
+            fleet.engines[url]["violations"]["ttft"] = ttft_viol
+        return collector.poll_once()
+
+    # Deep clean history, then a fresh burst: 20 violations over the
+    # last 20 requests. Fast = (60/60)/0.01 = 100 >> 10; slow =
+    # (60/3060)/0.01 ~= 2 < 10.
+    advance(0, 0, 0)
+    advance(300, 1000, 0)
+    view = advance(300, 1020, 20)
+    assert view.burn["ttft"]["fast"] >= 10.0
+    assert view.burn["ttft"]["slow"] < 10.0
+    windows = {(b["slo"], b["window"])
+               for b in events(tracer, BURN_EVENT)}
+    assert windows == {("ttft", "fast")}
+
+
+def test_desired_replicas_rises_under_saturation_and_decays():
+    fleet = FakeFleet()
+    tracer = Tracer(enabled=True)
+    collector = make_collector(fleet, tracer,
+                               sat_target=0.5, sat_alpha=0.5)
+    view = collector.poll_once()
+    assert view.desired_replicas == 1   # idle fleet floors at 1
+
+    for url in fleet.urls:
+        fleet.engines[url]["saturation"] = {
+            "max": 1.0, "causes": {"slots": 1.0, "queue_age": 0.6}}
+    fleet.now += 1
+    assert collector.poll_once().desired_replicas == 3  # ewma 0.5
+    fleet.now += 1
+    view = collector.poll_once()                        # ewma 0.75
+    assert view.desired_replicas > 3
+    assert view.saturation["slots"]["max"] == 1.0
+    assert view.saturation["queue_age"]["mean"] == 0.6
+
+    for url in fleet.urls:
+        fleet.engines[url]["saturation"] = {
+            "max": 0.0, "causes": {"slots": 0.0}}
+    for _ in range(3):
+        fleet.now += 1
+        view = collector.poll_once()
+    assert view.desired_replicas <= 3   # EWMA decays after the burst
+
+
+def test_fleet_gauges_published_on_collector_tracer():
+    fleet = FakeFleet()
+    for url in fleet.urls:
+        fleet.hist(url).observe(0.05)
+        fleet.engines[url]["retired"] = 1
+    tracer = Tracer(enabled=True)
+    make_collector(fleet, tracer).poll_once()
+    text = obs.prometheus_text(tracer)
+    for series in ("tpu_fleet_engines", "tpu_fleet_saturation",
+                   "tpu_fleet_desired_replicas",
+                   "tpu_fleet_slo_burn_rate",
+                   "tpu_fleet_ttft_seconds_bucket",
+                   "tpu_fleet_polls_total"):
+        assert series in text, series
+    # The published fleet histogram is the exact merge, scrapeable:
+    # parsing the observer's own exposition returns the merged ttft.
+    parsed = histograms_from_text(text)
+    merged = parsed[("tpu_fleet_ttft_seconds", ())]
+    assert merged.count == 3
+
+
+def test_overhead_is_deterministic():
+    fleet = FakeFleet()
+    collector = make_collector(fleet, Tracer(enabled=True))
+    collector.poll_once()
+    fleet.now += 1
+    collector.poll_once()
+    overhead = collector.overhead()
+    assert overhead == {"polls": 2, "fetches": 24, "engines": 3,
+                        "fetches_per_engine_cycle": 4.0}

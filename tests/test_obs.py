@@ -560,8 +560,16 @@ def test_trace_dump_merge_mode(tmp_path):
                             "--out", str(raw_out)]) == 0
     assert json.loads(raw_out.read_text()) == {
         "journals": [snap_a, snap_b]}
-    # A missing merge operand is a clean error, not a traceback.
+    # Fleet semantics: a dead operand is skipped with a warning and
+    # the surviving journals still merge — one crashed engine must
+    # not sink a fleet-wide timeline. Only an ALL-dead merge fails.
     assert trace_dump.main(["--merge", str(a), "/nonexistent",
+                            "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    partial = {e["name"] for e in doc["traceEvents"]
+               if e["ph"] == "X"}
+    assert partial == {"proc_a.op"}
+    assert trace_dump.main(["--merge", "/nonexistent",
                             "--out", str(out)]) == 1
 
 

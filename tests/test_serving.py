@@ -1683,3 +1683,69 @@ def test_prefix_server_with_speculation_matches_plain_prefix():
     finally:
         plain.stop()
         spec.stop()
+
+
+def _get(server, path):
+    """GET returning (status, headers, body-bytes); an HTTP error
+    status is an answer here (the fleet collector's convention)."""
+    try:
+        with urllib.request.urlopen(
+                f"http://localhost:{server.port}{path}",
+                timeout=10) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), e.read()
+
+
+def test_metrics_endpoint_exposes_serving_histograms(lm_server):
+    from container_engine_accelerators_tpu.obs.fleet import (
+        histograms_from_text,
+    )
+    from container_engine_accelerators_tpu.obs.metric_names import (
+        SERVING_TTFT,
+    )
+
+    post(lm_server, "/v1/models/lm:generate",
+         {"prompts": [[1, 2, 3]], "max_new_tokens": 4})
+    status, headers, body = _get(lm_server, "/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    text = body.decode()
+    # Bucket lines (not just summaries) — the fleet collector
+    # de-cumulates these for the exact fleet-wide merge, so the
+    # exposition must round-trip through the inverse parser.
+    assert f"{SERVING_TTFT}_bucket{{" in text
+    parsed = histograms_from_text(text, names={SERVING_TTFT})
+    assert sum(h.count for h in parsed.values()) >= 1
+
+
+def test_stats_carries_engine_identity(lm_server):
+    status, _, body = _get(lm_server, "/stats")
+    assert status == 200
+    stats = json.loads(body)
+    assert stats["engine_id"] == lm_server.engine_id()
+    # role@host:port[pid]: the port distinguishes replicas that
+    # share a host and the journal's process identity rides along.
+    assert f":{lm_server.port}[" in stats["engine_id"]
+    assert stats["identity"]["port"] == lm_server.port
+
+
+def test_readyz_503_carries_structured_drain_body(lm_server):
+    lm_server.begin_drain()
+    try:
+        status, headers, body = _get(lm_server, "/readyz")
+        assert status == 503
+        detail = json.loads(body)
+        assert detail["state"] == "draining"
+        assert detail["status"] == "draining"  # pre-fleet consumers
+        assert isinstance(detail["retry_after_s"], (int, float))
+        assert "saturation_cause" in detail
+        assert float(headers["Retry-After"]) == pytest.approx(
+            detail["retry_after_s"])
+    finally:
+        # The module-scoped fixture outlives this test: un-drain so
+        # later tests can still POST.
+        lm_server._draining = False
+    ok_status, _, ok_body = _get(lm_server, "/readyz")
+    assert ok_status == 200
+    assert json.loads(ok_body)["status"] == "ready"

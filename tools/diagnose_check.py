@@ -107,6 +107,19 @@ _CHILD_JOURNAL_CODE = (
     "          fragmentation=0.5, current_shape='4x1')\n"
     "obs.event('placement.repartition_applied', old_shape='4x1',\n"
     "          new_shape='2x2', subslices=4)\n"
+    # Fleet-section fodder: the liveness-episode and burn event
+    # shapes obs/fleet.py's collector emits (fleet_check.py drives
+    # the real collector; the journal CONTRACT is what's guarded
+    # here) — one full down/recovered episode plus a fast-window
+    # burn, in timeline order.
+    "obs.event('fleet.engine_down', engine='lm@h1:8500[7]',\n"
+    "          url='http://h1:8500', consecutive_failures=2,\n"
+    "          stale=False, error='ConnectionRefusedError')\n"
+    "obs.event('fleet.slo_burn', slo='ttft', window='fast',\n"
+    "          burn=20.0, fast_burn=20.0, slow_burn=1.6,\n"
+    "          threshold=4.0, budget=0.05, window_s=3.0)\n"
+    "obs.event('fleet.engine_recovered', engine='lm@h1:8500[7]',\n"
+    "          url='http://h1:8500')\n"
     # Requests-section fodder: one seeded SLOW request (2.0s of
     # block_wait against 0.5s of everything else) retired into a
     # RequestLedger whose state rides the serving_requests
@@ -367,6 +380,25 @@ def main():
             failures.append(
                 f"placement events missing or out of timeline "
                 f"order: {pev_names}")
+        # Fleet section: the child's seeded liveness episode and burn
+        # event must come back counted and in timeline order (down ->
+        # burn -> recovered).
+        fleet_sec = bundle.get("fleet") or {}
+        if (fleet_sec.get("down_episodes") != 1
+                or fleet_sec.get("recoveries") != 1
+                or fleet_sec.get("slo_burns") != 1):
+            failures.append(
+                f"fleet section lost the child's episode events: "
+                f"{fleet_sec.get('down_episodes')}/"
+                f"{fleet_sec.get('recoveries')}/"
+                f"{fleet_sec.get('slo_burns')}")
+        fev_names = [e.get("name") for e in
+                     fleet_sec.get("events") or []]
+        if fev_names != ["fleet.engine_down", "fleet.slo_burn",
+                         "fleet.engine_recovered"]:
+            failures.append(
+                f"fleet events missing or out of timeline order: "
+                f"{fev_names}")
         # Requests section: the child's seeded slow request must come
         # back ATTRIBUTED — counted, sum-to-wall clean, and its TTFT
         # tail ranked to the block_wait its timeline was stamped with.

@@ -1,0 +1,649 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet-observability gate (`make fleet-check`).
+
+Spins up THREE real fake-chip CPU engine servers (subprocess workers,
+each a tiny TransformerLM behind GenerationServer on an ephemeral
+port), points obs.fleet.FleetCollector at them over real HTTP, and
+holds every fleet-view contract:
+
+  1. **exact merge**: after mixed traffic, the collector's merged
+     TTFT/TPOT quantiles must EQUAL an independent recomputation over
+     the pooled raw bucket counts scraped straight from the engines'
+     ``/metrics`` (same fixed grid -> bucket-wise pooling is exact;
+     averaged per-engine percentiles would not survive this assert);
+  2. **scale signal**: a saturating burst must push
+     ``desired_replicas`` above the engine count, and it must decay
+     back once the burst stops (EWMA, HPA-shaped);
+  3. **burn windows**: an SLO burst against ONE engine (its TTFT
+     threshold tightened via SIGUSR2) must fire the FAST burn window
+     fleet-wide — exactly one ``fleet.slo_burn`` event — while the
+     SLOW window stays diluted below threshold (the SRE multi-window
+     recipe: page fast, don't flap);
+  4. **drain steering**: a SIGUSR1 drain flips one engine's
+     ``/readyz`` to a structured 503 (state/retry_after_s/
+     saturation_cause body + Retry-After header) and the engine
+     leaves ``steer_set()`` with ZERO ``fleet.engine_down`` events —
+     unready is not down;
+  5. **liveness hysteresis**: SIGKILLing an engine removes it from
+     ``steer_set()`` within ONE poll and opens exactly ONE
+     ``fleet.engine_down`` episode (no event per subsequent failed
+     poll);
+  6. the observer's OWN surfaces (tools/fleet_observer.ObserverServer
+     run in-process): ``/metrics`` exposes every ``tpu_fleet_*``
+     series and ``/fleet/stats`` returns the JSON rollup consistent
+     with the in-process view.
+
+``--fast`` is the presubmit leg (smaller traffic volumes, tighter
+windows); ``--ledger`` (the suite leg) appends the deterministic
+collector-overhead row: ``fleet_fetches_per_engine_cycle`` ("down")
+— the GETs the collector costs every engine per cycle, a constant
+4.0 by construction until the collector grows another probe. Wall
+clocks ride as config context only (rig noise, the goodput_check
+precedent).
+
+Internal: ``--worker --port-file P`` is the engine-subprocess
+entrypoint (SIGUSR1 -> begin_drain, SIGUSR2 -> tighten the TTFT SLO
+threshold so every later request violates).
+"""
+
+import argparse
+import json
+import math
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ["CEA_TPU_TRACE"] = "1"  # events are the acceptance surface
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.obs.fleet import (  # noqa: E402
+    BURN_EVENT,
+    DOWN_EVENT,
+    FleetCollector,
+)
+from container_engine_accelerators_tpu.obs.metric_names import (  # noqa: E402
+    SERVING_TPOT,
+    SERVING_TTFT,
+)
+
+# The worker's TTFT SLO while clean: armed (so /stats carries the
+# violation counters) but unviolatable — ten minutes.
+CLEAN_SLO_TTFT_MS = 600000.0
+
+
+# ---------------------------------------------------------------------------
+# Worker: one real engine server in a subprocess
+# ---------------------------------------------------------------------------
+
+
+def worker_main(args):
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=32,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=8, max_batch=4, warm=True)
+    srv.start()
+
+    # SIGUSR1: the drain episode — /readyz flips to the structured
+    # 503 while /healthz stays live and in-flight streams finish.
+    signal.signal(signal.SIGUSR1, lambda *_: srv.begin_drain())
+
+    # SIGUSR2: the burn episode — tighten the live TTFT threshold so
+    # every subsequent request burns SLO. _record_slo reads the
+    # attribute per token, so this lands without a restart.
+    def tighten(*_):
+        srv._engine_service._slo_ttft_s = 1e-9
+
+    signal.signal(signal.SIGUSR2, tighten)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(srv.port))
+    os.replace(tmp, args.port_file)
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Driver helpers
+# ---------------------------------------------------------------------------
+
+
+class HarnessError(Exception):
+    """The rig broke (worker died, timeout), not the contract."""
+
+
+def spawn_worker(idx, tmpdir, log):
+    port_file = os.path.join(tmpdir, f"engine{idx}.port")
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               PYTHONPATH=REPO_ROOT,
+               CEA_TPU_TRACE="1",
+               CEA_TPU_SLO_TTFT_MS=str(CLEAN_SLO_TTFT_MS))
+    env.pop("CEA_TPU_SLO_TPOT_MS", None)  # only TTFT burns by design
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--port-file", port_file, "--seed", str(idx)],
+        stdout=log, stderr=log, env=env)
+    return proc, port_file
+
+
+def wait_for_port(proc, port_file, deadline):
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise HarnessError(
+                f"engine worker exited rc {proc.returncode} before "
+                f"serving (see worker log)")
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                return int(f.read().strip())
+        time.sleep(0.2)
+    raise HarnessError("timed out waiting for engine workers to warm")
+
+
+def http_get(url, timeout=10):
+    """(status, headers, body) with HTTP errors as answers."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), e.read()
+
+
+def generate(url, prompt, max_new, timeout=120):
+    req = urllib.request.Request(
+        url + "/v1/models/lm:generate",
+        data=json.dumps({"prompts": [prompt],
+                         "max_new_tokens": max_new}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# Independent pooled recompute for the exact-merge assert: a
+# deliberately separate ~20-line parser (NOT obs.fleet's) pools the
+# cumulative bucket counts across every engine scrape and label set.
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def pooled_histograms(texts):
+    pools = {SERVING_TTFT: {}, SERVING_TPOT: {}}
+    for text in texts:
+        for line in text.splitlines():
+            line = line.strip()
+            for name, cum in pools.items():
+                prefix = name + "_bucket{"
+                if not line.startswith(prefix):
+                    continue
+                m = _LE_RE.search(line)
+                if m is None:
+                    continue
+                le = m.group(1)
+                bound = math.inf if le == "+Inf" else float(le)
+                value = int(float(line.rsplit(" ", 1)[1]))
+                cum[bound] = cum.get(bound, 0) + value
+    out = {}
+    for name, cum in pools.items():
+        bounds = sorted(b for b in cum if b != math.inf)
+        if not bounds:
+            out[name] = None
+            continue
+        counts, prev = [], 0
+        for b in bounds:
+            counts.append(cum[b] - prev)
+            prev = cum[b]
+        counts.append(cum.get(math.inf, prev) - prev)
+        h = obs.Histogram(name + "_pooled", buckets=bounds)
+        h.counts = counts
+        h.count = cum.get(math.inf, prev)
+        out[name] = h
+    return out
+
+
+def journal_events(name):
+    return [e.get("fields", {})
+            for e in obs.TRACER.snapshot()["events"]
+            if e["name"] == name]
+
+
+def poll_until(collector, predicate, deadline_s, interval_s=0.25):
+    """Poll the collector until predicate(view) or deadline; returns
+    (view, ok)."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        view = collector.poll_once()
+        if predicate(view):
+            return view, True
+        if time.monotonic() >= deadline:
+            return view, False
+        time.sleep(interval_s)
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--fast", action="store_true",
+                   help="the presubmit leg: smaller traffic volumes")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append the collector-overhead row to the "
+                        "perf ledger (source fleet_check)")
+    p.add_argument("--worker", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--port-file", default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--seed", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return worker_main(args)
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fleet_observer
+    import perf_ledger
+
+    # A wedged backend must surface as an explained skip row, not a
+    # silent worker-warm-up hang.
+    perf_ledger.ensure_backend_or_skip("fleet_check", args.ledger)
+
+    per_engine = 4 if args.fast else 6
+    burst_threads = 4 if args.fast else 6
+    burst_reps = 2
+    fast_window_s = 2.0 if args.fast else 3.0
+
+    obs.set_role("fleet-check")
+    failures = []
+    t_start = time.monotonic()
+    tmpdir = tempfile.mkdtemp(prefix="fleet_check_")
+    log_path = os.path.join(tmpdir, "workers.log")
+    log = open(log_path, "ab")
+    procs = []
+    observer = None
+    try:
+        for i in range(3):
+            procs.append(spawn_worker(i, tmpdir, log))
+        deadline = time.monotonic() + 600
+        ports = [wait_for_port(proc, pf, deadline)
+                 for proc, pf in procs]
+        urls = [f"http://127.0.0.1:{port}" for port in ports]
+        eng_a, eng_b, eng_c = urls
+
+        collector = FleetCollector(
+            urls, poll_ms=250, down_after=2,
+            fast_window_s=fast_window_s, slow_window_s=600.0,
+            burn_threshold=4.0, slo_budget=0.05,
+            sat_target=0.4, sat_alpha=0.5)
+        observer = fleet_observer.ObserverServer(collector, port=0)
+        observer.start()
+        obs_url = f"http://127.0.0.1:{observer.port}"
+
+        # -- leg 1: mixed traffic, then the exact-merge assert ------
+        rng_prompts = [[(7 * i + j) % 48 for j in range(3 + i % 5)]
+                       for i in range(per_engine)]
+        for url in urls:
+            for i, prompt in enumerate(rng_prompts):
+                generate(url, prompt, 4 + i % 5)
+        view = collector.poll_once()
+
+        if sorted(view.steer_set()) != sorted(urls):
+            failures.append(
+                f"steer_set {view.steer_set()} != all 3 engines "
+                f"while everything is up")
+        texts = []
+        for url in urls:
+            status, _, body = http_get(url + "/metrics")
+            if status != 200:
+                failures.append(f"{url}/metrics HTTP {status}")
+            texts.append(body.decode())
+        pooled = pooled_histograms(texts)
+        for name, merged in ((SERVING_TTFT, view.ttft),
+                             (SERVING_TPOT, view.tpot)):
+            pool = pooled[name]
+            if pool is None or pool.count == 0:
+                failures.append(f"no pooled {name} observations — "
+                                f"traffic never landed")
+                continue
+            if pool.count != merged.count:
+                failures.append(
+                    f"{name}: merged count {merged.count} != pooled "
+                    f"count {pool.count}")
+            for q in (0.5, 0.9, 0.99):
+                got, want = merged.quantile(q), pool.quantile(q)
+                if got != want:
+                    failures.append(
+                        f"{name} p{int(q * 100)}: merged {got!r} != "
+                        f"pooled recomputation {want!r} — the fleet "
+                        f"merge is not exact")
+
+        # Observer surfaces: every tpu_fleet_* series on /metrics,
+        # and the /fleet/stats rollup consistent with the view.
+        status, _, body = http_get(obs_url + "/metrics")
+        text = body.decode() if status == 200 else ""
+        for series in ("tpu_fleet_engines", "tpu_fleet_saturation",
+                       "tpu_fleet_ttft_seconds_bucket",
+                       "tpu_fleet_tpot_seconds_bucket",
+                       "tpu_fleet_slo_burn_rate",
+                       "tpu_fleet_desired_replicas",
+                       "tpu_fleet_polls_total"):
+            if series not in text:
+                failures.append(
+                    f"observer /metrics missing {series}")
+        status, _, body = http_get(obs_url + "/fleet/stats")
+        if status != 200:
+            failures.append(f"observer /fleet/stats HTTP {status}")
+        else:
+            rollup = json.loads(body)
+            if sorted(rollup["steer_set"]) != sorted(urls):
+                failures.append(
+                    f"/fleet/stats steer_set {rollup['steer_set']} "
+                    f"disagrees with the in-process view")
+            if rollup["ttft"]["count"] != view.ttft.count:
+                failures.append(
+                    f"/fleet/stats ttft count "
+                    f"{rollup['ttft']['count']} != view "
+                    f"{view.ttft.count}")
+
+        # -- leg 2: the scale signal rises under saturation ---------
+        stop_burst = threading.Event()
+
+        def hammer(url):
+            k = 0
+            while not stop_burst.is_set():
+                try:
+                    generate(url, [1 + k % 40, 2, 3], 8)
+                except OSError:
+                    return
+                k += 1
+
+        threads = [threading.Thread(target=hammer, args=(url,),
+                                    daemon=True)
+                   for url in urls for _ in range(burst_threads)]
+        for t in threads:
+            t.start()
+        view, rose = poll_until(
+            collector, lambda v: v.desired_replicas > 3, 60.0)
+        stop_burst.set()
+        for t in threads:
+            t.join(timeout=120)
+        if not rose:
+            failures.append(
+                f"desired_replicas never rose above the engine "
+                f"count under a saturating burst (last "
+                f"{view.desired_replicas}, sat_ewma "
+                f"{view.sat_ewma:.3f})")
+        # One tiny request per engine parks each engine's last
+        # published saturation snapshot at its floor (the gauge
+        # publishes at step boundaries), then the EWMA must decay.
+        for url in urls:
+            generate(url, [5, 6, 7], 2)
+        view, decayed = poll_until(
+            collector, lambda v: v.desired_replicas <= 3, 30.0)
+        if not decayed:
+            failures.append(
+                f"desired_replicas stuck at {view.desired_replicas} "
+                f"(sat_ewma {view.sat_ewma:.3f}) after the burst "
+                f"stopped — the scale signal never decays")
+
+        # -- leg 3: fast burn fires, slow window holds --------------
+        # Lay clean baseline samples until the fast window is fully
+        # behind us, then burst SLO violations at engine C only.
+        for _ in range(4):
+            collector.poll_once()
+            time.sleep(fast_window_s / 3.0 + 0.1)
+        baseline_view = collector.poll_once()
+        retired_before = sum(e["requests_retired"] or 0
+                             for e in baseline_view.engines)
+        burst_n = 4
+        # Harness precondition, not a contract assert: the clean
+        # history must be deep enough that burst_n violations CANNOT
+        # cross the slow window's threshold ((V/dR)/budget < thr).
+        if (burst_n / max(1, retired_before)) / 0.05 >= 4.0:
+            raise HarnessError(
+                f"traffic volume too small to dilute the slow "
+                f"window ({retired_before} retired before burst)")
+        procs_by_url = dict(zip(urls, [pr for pr, _ in procs]))
+        os.kill(procs_by_url[eng_c].pid, signal.SIGUSR2)
+        time.sleep(0.2)  # let the worker's signal handler land
+        for i in range(burst_n):
+            generate(eng_c, [3 + i, 9, 27], 4)
+        view = collector.poll_once()
+        burn = view.burn["ttft"]
+        if burn["fast"] < 4.0:
+            failures.append(
+                f"fast-window burn {burn['fast']} did not reach the "
+                f"threshold 4.0 after an SLO burst")
+        if burn["slow"] >= 4.0:
+            failures.append(
+                f"slow-window burn {burn['slow']} crossed the "
+                f"threshold — the slow window is not diluting")
+        collector.poll_once()   # an open episode must not re-fire
+        burns = journal_events(BURN_EVENT)
+        if len(burns) != 1:
+            failures.append(
+                f"expected exactly one {BURN_EVENT} event, got "
+                f"{len(burns)}: "
+                f"{[(e.get('slo'), e.get('window')) for e in burns]}")
+        elif (burns[0].get("slo"), burns[0].get("window")) \
+                != ("ttft", "fast"):
+            failures.append(
+                f"burn event fired for "
+                f"({burns[0].get('slo')}, {burns[0].get('window')}) "
+                f"instead of (ttft, fast)")
+
+        # -- leg 4: a draining engine is steered around, not down ---
+        os.kill(procs_by_url[eng_b].pid, signal.SIGUSR1)
+        time.sleep(0.2)
+        status, headers, body = http_get(eng_b + "/readyz")
+        if status != 503:
+            failures.append(
+                f"draining engine /readyz HTTP {status}, want 503")
+        else:
+            detail = json.loads(body)
+            if detail.get("state") != "draining":
+                failures.append(
+                    f"structured 503 body state "
+                    f"{detail.get('state')!r}, want 'draining'")
+            if not isinstance(detail.get("retry_after_s"),
+                              (int, float)):
+                failures.append(
+                    f"structured 503 body lacks numeric "
+                    f"retry_after_s: {detail}")
+            if "saturation_cause" not in detail:
+                failures.append(
+                    "structured 503 body lacks saturation_cause")
+            if "Retry-After" not in headers:
+                failures.append(
+                    "draining 503 lacks the Retry-After header")
+        view = collector.poll_once()
+        if eng_b in view.steer_set():
+            failures.append(
+                "draining engine still in steer_set — unready "
+                "engines must be steered around")
+        drained = next(e for e in view.engines
+                       if e["url"] == eng_b)
+        if drained["state"] != "draining" or drained["down"]:
+            failures.append(
+                f"draining engine state={drained['state']!r} "
+                f"down={drained['down']} in the view, want "
+                f"('draining', False)")
+        if journal_events(DOWN_EVENT):
+            failures.append(
+                "a drain produced fleet.engine_down — drain is not "
+                "death")
+        if view.counts()["up"] != 3:
+            failures.append(
+                f"up count {view.counts()['up']} != 3 with one "
+                f"engine draining (drain must not count as down)")
+
+        # -- leg 5: SIGKILL -> steered out in ONE poll, ONE event ---
+        victim = procs_by_url[eng_a]
+        victim.kill()
+        victim.wait(timeout=30)
+        view = collector.poll_once()
+        if eng_a in view.steer_set():
+            failures.append(
+                "killed engine still in steer_set one poll after "
+                "SIGKILL")
+        collector.poll_once()   # failure #2 opens the DOWN episode
+        collector.poll_once()   # further failures must NOT re-fire
+        view = collector.view()
+        downs = journal_events(DOWN_EVENT)
+        if len(downs) != 1:
+            failures.append(
+                f"expected exactly one {DOWN_EVENT} event after "
+                f"SIGKILL, got {len(downs)}")
+        elif downs[0].get("url") != eng_a:
+            failures.append(
+                f"engine_down fired for {downs[0].get('url')}, "
+                f"want {eng_a}")
+        dead = next(e for e in view.engines if e["url"] == eng_a)
+        if not dead["down"]:
+            failures.append(
+                "killed engine not marked down after "
+                f"{collector.down_after} failed polls")
+        if view.counts() != {"up": 2, "down": 1, "unready": 1}:
+            failures.append(
+                f"fleet counts {view.counts()} != "
+                f"{{'up': 2, 'down': 1, 'unready': 1}} with one "
+                f"dead and one draining engine")
+        if view.pick_least_loaded() != eng_c:
+            failures.append(
+                f"pick_least_loaded {view.pick_least_loaded()} != "
+                f"the one remaining serving engine {eng_c}")
+
+        overhead = collector.overhead()
+    except HarnessError as e:
+        _teardown(procs, observer, log)
+        print(f"[fleet-check] HARNESS ERROR: {e}", file=sys.stderr)
+        _dump_log(log_path)
+        return 2
+    except Exception as e:
+        _teardown(procs, observer, log)
+        print(f"[fleet-check] HARNESS ERROR: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        _dump_log(log_path)
+        return 2
+    else:
+        _teardown(procs, observer, log)
+
+    wall_s = time.monotonic() - t_start
+    summary = {
+        "engines": 3,
+        "polls": overhead["polls"],
+        "fetches": overhead["fetches"],
+        "fetches_per_engine_cycle":
+            overhead["fetches_per_engine_cycle"],
+        "burn_fast": burn["fast"],
+        "burn_slow": burn["slow"],
+        "wall_s": round(wall_s, 1),
+        "failures": len(failures),
+    }
+    print(json.dumps(summary))
+
+    if failures:
+        for f in failures:
+            print(f"[fleet-check] FAIL: {f}", file=sys.stderr)
+        return 1
+
+    if args.ledger:
+        err = perf_ledger.try_append(
+            args.ledger, "fleet_check",
+            {"fleet_fetches_per_engine_cycle":
+                overhead["fetches_per_engine_cycle"]},
+            devices=[], platform="cpu",
+            config={"engines": 3, "polls": overhead["polls"],
+                    "wall_s": round(wall_s, 1)})
+        if err:
+            print(f"[fleet-check] HARNESS ERROR: perf-ledger "
+                  f"append: {err}", file=sys.stderr)
+            return 2
+    print("[fleet-check] PASS: merged quantiles exact, scale signal "
+          "rose and decayed, fast burn fired while slow held, drain "
+          "steered around, SIGKILL opened exactly one down episode",
+          file=sys.stderr)
+    return 0
+
+
+def _teardown(procs, observer, log):
+    if observer is not None:
+        try:
+            observer.stop()
+        except Exception:
+            pass
+    for proc, _ in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    deadline = time.monotonic() + 15
+    for proc, _ in procs:
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=max(0.1,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+    log.close()
+
+
+def _dump_log(log_path):
+    try:
+        with open(log_path) as f:
+            tail = f.read()[-4000:]
+        if tail:
+            print("[fleet-check] worker log tail:\n" + tail,
+                  file=sys.stderr)
+    except OSError:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

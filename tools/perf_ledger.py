@@ -151,6 +151,11 @@ METRIC_DIRECTIONS = {
     # latency / cost — lower is better
     "ttft": "down",
     "tpot": "down",
+    # fleet_check: GETs the collector costs each engine per poll
+    # cycle — deterministic by construction (4.0 until the collector
+    # grows another probe); a RISE means fleet observation got more
+    # expensive for every engine in the fleet.
+    "fleet_fetches_per_engine_cycle": "down",
     "ms_per_token": "down",
     "ms_per_call": "down",
     "sec_per_call": "down",

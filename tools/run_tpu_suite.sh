@@ -224,6 +224,22 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "serving-chaos-check preflight"
 
+# Fleet-observability preflight (CPU fake backend, ~1 min): three
+# real engine servers under the jax-free collector must yield
+# fleet p99s EQUAL to a pooled recomputation of their /metrics
+# text, one fleet.engine_down per SIGKILL with same-poll steer-set
+# removal, drain steered around without a down event, a fresh SLO
+# burst firing the fast burn window while the slow window holds,
+# and a scale signal that rises then decays. A regression here
+# means the fleet surface a router/HPA would consume is lying about
+# engine health or fleet latency. Appends the collector-overhead
+# row (GETs per engine per cycle) when the gate passes.
+echo "[suite] fleet-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/fleet_check.py --ledger PERF_LEDGER.json \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "fleet-check preflight"
+
 # Analysis preflight (CPU, ~3 min): zero lint findings on the tree
 # (with every seeded fixture violation firing), a clean lock-order
 # sanitizer pass over the engine/elastic/placement suites, and the

@@ -87,6 +87,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.obs import fleet as obs_fleet  # noqa: E402
 from container_engine_accelerators_tpu.obs.straggler import (  # noqa: E402
     scan_events,
 )
@@ -348,6 +349,45 @@ def elastic_section(endpoints, snapshots, checkpoint_dirs):
     }
 
 
+FLEET_EVENTS = (obs_fleet.DOWN_EVENT, obs_fleet.RECOVERED_EVENT,
+                obs_fleet.BURN_EVENT)
+FLEET_STATS_PATH = "/fleet/stats"
+
+
+def fleet_section(snapshots, fleet_urls):
+    """What the fleet collector saw: every liveness episode
+    (engine_down/engine_recovered) and SLO-burn event from the
+    collected journals in timeline order — the observer's own
+    /debug/trace or its CEA_TPU_TRACE_FILE journal carries them —
+    plus, per ``--fleet-url``, the live /fleet/stats rollup (merged
+    quantiles, steer_set, desired_replicas) at sweep time."""
+    events = []
+    for snap in snapshots:
+        ident = snap.get("identity") or {}
+        label = obs.process_label(ident) if ident else None
+        for ev in snap.get("events") or []:
+            name = ev.get("name")
+            if name in FLEET_EVENTS:
+                events.append({"name": name, "unix": ev.get("unix"),
+                               "fields": ev.get("fields") or {},
+                               "process": label})
+    events.sort(key=lambda e: e.get("unix") or 0.0)
+    rollups = {}
+    for url in fleet_urls:
+        base = url.rstrip("/")
+        rollups[base] = _fetch(base + FLEET_STATS_PATH)
+    return {
+        "events": events,
+        "down_episodes": sum(1 for e in events
+                             if e["name"] == obs_fleet.DOWN_EVENT),
+        "recoveries": sum(1 for e in events
+                          if e["name"] == obs_fleet.RECOVERED_EVENT),
+        "slo_burns": sum(1 for e in events
+                         if e["name"] == obs_fleet.BURN_EVENT),
+        "rollups": rollups,
+    }
+
+
 def requests_section(endpoints, journals):
     """Per-request latency attribution: every /debug/requests ring a
     live serving replica answered with, plus the ``serving_requests``
@@ -427,7 +467,8 @@ DEFAULT_PERF_LEDGER = os.path.join(
 
 
 def collect(urls, journal_paths, dev_dir, state_dir,
-            checkpoint_dirs=(), perf_ledger_path=None):
+            checkpoint_dirs=(), perf_ledger_path=None,
+            fleet_urls=()):
     endpoints = sweep_endpoints(urls)
     journals = load_journals(journal_paths)
 
@@ -475,6 +516,7 @@ def collect(urls, journal_paths, dev_dir, state_dir,
         "elastic": elastic_section(endpoints, snapshots,
                                    checkpoint_dirs),
         "placement": placement_section(endpoints, snapshots),
+        "fleet": fleet_section(snapshots, fleet_urls),
         "perf": perf_section(perf_ledger_path
                              or DEFAULT_PERF_LEDGER),
         "provenance": stamp(
@@ -505,6 +547,11 @@ def main(argv=None):
                    help="perf-ledger path for the bundle's perf "
                         "trend section (default: the committed "
                         "PERF_LEDGER.json)")
+    p.add_argument("--fleet-url", action="append", default=[],
+                   help="fleet-observer base URLs whose live "
+                        "/fleet/stats rollup to include in the "
+                        "bundle's fleet section (the observer's "
+                        "journal events ride --url as usual)")
     p.add_argument("--out", default="tpu_diagnose.json")
     args = p.parse_args(argv)
 
@@ -513,7 +560,8 @@ def main(argv=None):
         + args.url))
     bundle = collect(urls, args.journal, args.dev_dir, args.state_dir,
                      checkpoint_dirs=args.checkpoint_dir,
-                     perf_ledger_path=args.perf_ledger)
+                     perf_ledger_path=args.perf_ledger,
+                     fleet_urls=args.fleet_url)
 
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
@@ -539,6 +587,8 @@ def main(argv=None):
         "request_records": bundle["requests"]["records"],
         "placement_decisions": bundle["placement"]["decisions_observed"],
         "repartition_proposals": bundle["placement"]["proposals"],
+        "fleet_down_episodes": bundle["fleet"]["down_episodes"],
+        "fleet_slo_burns": bundle["fleet"]["slo_burns"],
         "perf_ledger_rows": bundle["perf"].get("rows"),
     }))
     return 0

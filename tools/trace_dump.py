@@ -65,7 +65,12 @@ from container_engine_accelerators_tpu.obs import (  # noqa: E402
 
 def load_snapshot(url=None, path=None, timeout=10):
     if url:
-        full = url.rstrip("/") + TRACE_PATH
+        # Accept both base URLs and full /debug/trace URLs (the
+        # fleet observer's journal lives at the same path as every
+        # engine's) — appending to an already-full URL would 404.
+        full = url.rstrip("/")
+        if not full.endswith(TRACE_PATH):
+            full += TRACE_PATH
         with urllib.request.urlopen(full, timeout=timeout) as resp:
             return json.load(resp), full
     with open(path) as f:
@@ -100,22 +105,35 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     snapshots, sources = [], []
-    try:
-        if args.merge:
-            for src_arg in args.merge:
+    if args.merge:
+        # Fleet semantics: a dead engine must not sink the whole
+        # merged timeline — warn and keep every journal that loads
+        # (fail only when NOTHING loads, which means the operator
+        # pointed at the wrong fleet entirely).
+        for src_arg in args.merge:
+            try:
                 snap, source = load_source(src_arg, args.timeout)
-                snapshots.append(snap)
-                sources.append(source)
-        else:
+            except (OSError, ValueError) as e:
+                print(f"warning: skipping {src_arg}: {e}",
+                      file=sys.stderr)
+                continue
+            snapshots.append(snap)
+            sources.append(source)
+        if not snapshots:
+            print("error: no --merge source could be loaded",
+                  file=sys.stderr)
+            return 1
+    else:
+        try:
             snap, source = load_snapshot(args.url, args.file,
                                          args.timeout)
             snapshots.append(snap)
             sources.append(source)
-    except (OSError, ValueError) as e:
-        failed = args.url or args.file or "/".join(args.merge or [])
-        print(f"error: could not load trace from {failed}: {e}",
-              file=sys.stderr)
-        return 1
+        except (OSError, ValueError) as e:
+            failed = args.url or args.file
+            print(f"error: could not load trace from {failed}: {e}",
+                  file=sys.stderr)
+            return 1
 
     spans = sum(len(s.get("spans", [])) for s in snapshots)
     open_spans = sum(len(s.get("open_spans", [])) for s in snapshots)
