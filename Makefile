@@ -40,6 +40,7 @@ presubmit:
 	JAX_PLATFORMS=cpu python3 tools/slo_check.py --fast
 	JAX_PLATFORMS=cpu python3 tools/serving_chaos_check.py --fast
 	JAX_PLATFORMS=cpu python3 tools/fleet_check.py --fast
+	JAX_PLATFORMS=cpu python3 tools/router_check.py --fast
 	JAX_PLATFORMS=cpu python3 tools/bench_serving_occupancy.py \
 		--spec-check
 
@@ -181,6 +182,18 @@ serving-chaos-check:
 fleet-check:
 	JAX_PLATFORMS=cpu python3 tools/fleet_check.py
 
+# Engine-fleet router gate: real engine servers (one model seed)
+# behind the jax-free serving.router front door; goodput must scale
+# >= 3.2x from 1 to 4 engines on a mixed Poisson trace (row-work
+# makespan), prefix-affinity routing must hold the fleet
+# prefix_hit_rate at the single-engine baseline while a round-robin
+# control degrades, a mid-stream SIGKILL must splice every greedy
+# stream token-identically onto siblings, survivors must quiesce
+# leak-free, and an empty steer set must shed 503 with a derived
+# Retry-After. Pure CPU.
+router-check:
+	JAX_PLATFORMS=cpu python3 tools/router_check.py
+
 # Perf-ledger regression gate: validate every committed
 # PERF_LEDGER.json row (schema exact, field-level messages) and
 # compare each source's newest row against its newest SAME-RIG
@@ -220,5 +233,5 @@ clean:
 	analysis-check program-check trace-check diagnose-check \
 	goodput-check chaos-check placement-check occupancy-check \
 	paging-check spill-check spec-check perf-check slo-check \
-	serving-chaos-check fleet-check container partition-tpu push \
-	clean
+	serving-chaos-check fleet-check router-check container \
+	partition-tpu push clean

@@ -31,7 +31,6 @@ batch to shard.
 
 import collections
 import functools
-import hashlib
 import time
 
 import jax
@@ -41,6 +40,7 @@ from flax import traverse_util
 from flax.core import unfreeze
 
 from ..analysis import tsan
+from ..serving.affinity import KV_BLOCK_ENV, chain_digest
 from ..utils import env_number, env_str, faults
 
 
@@ -1137,7 +1137,8 @@ def _slot_cache_init(model, slots, slot_len):
 # + one step. CEA_TPU_PAGED_KV=0 restores the dense pool bit-for-bit.
 
 PAGED_KV_ENV = "CEA_TPU_PAGED_KV"
-KV_BLOCK_ENV = "CEA_TPU_KV_BLOCK"
+# KV_BLOCK_ENV lives in serving.affinity (the jax-free end of the
+# affinity-key contract) and is re-exported at the top of this module.
 KV_BLOCKS_ENV = "CEA_TPU_KV_BLOCKS"
 KV_QUANT_ENV = "CEA_TPU_KV_QUANT"
 KV_SPILL_ENV = "CEA_TPU_KV_SPILL"
@@ -1436,22 +1437,11 @@ class _BlockPool:
 
     # -- content-keyed prefix index -----------------------------------
 
-    @staticmethod
-    def _chain(prev, payload):
-        # Running SHA-256 digest over the chain content: O(block) to
-        # extend one level, O(1) to hash/compare as a dict key (a
-        # nested-tuple key would re-hash the whole chain on every
-        # probe — quadratic in prompt length, paid per step while a
-        # queued head re-plans), and collisions are cryptographically
-        # infeasible (a bare hash() key could be forced to alias two
-        # prompts and silently share another request's KV blocks).
-        h = hashlib.sha256(b"" if prev is None else prev)
-        if (isinstance(payload, tuple) and payload
-                and payload[0] == "partial"):
-            h.update(b"partial")
-            payload = payload[1]
-        h.update(np.asarray(payload, np.int64).tobytes())
-        return h.digest()
+    # The chain function itself lives in serving.affinity (jax-free)
+    # so the fleet router computes the SAME keys without importing
+    # jax; test_affinity.py pins the byte-identity. Kept as a
+    # staticmethod alias because the pool is its canonical consumer.
+    _chain = staticmethod(chain_digest)
 
     def lookup(self, tokens, count=True):
         """Longest indexed prefix of ``tokens`` usable for sharing,

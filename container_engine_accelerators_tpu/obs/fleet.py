@@ -332,20 +332,33 @@ class FleetView:
         Retry-After horizon. The item-3 router's admission set."""
         return [e["url"] for e in self._eligible]
 
+    @staticmethod
+    def load_key(engine):
+        """The PINNED total order behind :meth:`pick_least_loaded` —
+        saturation, then queue depth (a missing/None depth sorts AS
+        zero, tied with an explicit 0), then URL. The URL leg makes
+        every tie deterministic: two collectors polling the same
+        fleet pick the same engine, and a router replaying a decision
+        log reproduces it exactly. Routers reuse this key to rank
+        failover siblings the same way the fallback pick does."""
+        return (engine["saturation"],
+                engine.get("queue_depth") or 0,
+                engine["url"])
+
     def pick_least_loaded(self, exclude=()):
-        """The eligible engine with the least saturation (queue depth
-        breaks ties, URL makes it deterministic); None when the whole
-        fleet is unroutable — the caller sheds, exactly like a single
-        engine's 503."""
+        """The eligible engine minimizing :meth:`load_key` —
+        saturation, queue depth (None == 0), then URL, so equal-load
+        ties always resolve to the lexicographically smallest URL
+        (and with it excluded, the next one — the exclude= chain is
+        part of the pinned order, see test_fleet). None when the
+        whole fleet is unroutable — the caller sheds, exactly like a
+        single engine's 503."""
         exclude = set(exclude)
         candidates = [e for e in self._eligible
                       if e["url"] not in exclude]
         if not candidates:
             return None
-        return min(candidates,
-                   key=lambda e: (e["saturation"],
-                                  e.get("queue_depth") or 0,
-                                  e["url"]))["url"]
+        return min(candidates, key=self.load_key)["url"]
 
     def counts(self):
         up = sum(1 for e in self.engines if not e["down"])

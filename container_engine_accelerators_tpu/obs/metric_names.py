@@ -85,6 +85,12 @@ FLEET_DESIRED_REPLICAS = "tpu_fleet_desired_replicas"
 FLEET_POLLS = "tpu_fleet_polls_total"
 FLEET_POLL_ERRORS = "tpu_fleet_poll_errors_total"
 
+# -- router (the fleet front door, serving/router.py) ------------------
+ROUTER_ROUTED = "tpu_router_routed_total"
+ROUTER_SHED = "tpu_router_shed_total"
+ROUTER_FAILOVER = "tpu_router_failover_total"
+ROUTER_AFFINITY_HIT_RATE = "tpu_router_affinity_hit_rate"
+
 # name -> one-line help. The authoritative set: the metric-registry
 # lint resolves every tpu_* literal in the tree against these keys
 # (accepting the prometheus_client `_total` exposition variant) and
@@ -138,6 +144,13 @@ METRICS = {
         "HPA-shaped replica target from sustained fleet saturation",
     FLEET_POLLS: "completed fleet poll cycles",
     FLEET_POLL_ERRORS: "engine poll attempts that failed, by engine",
+    ROUTER_ROUTED:
+        "requests placed, by reason "
+        "(affinity/least_loaded/hedge/spill)",
+    ROUTER_SHED: "requests shed at the router door, by reason",
+    ROUTER_FAILOVER: "streams resumed on a sibling engine, by kind",
+    ROUTER_AFFINITY_HIT_RATE:
+        "fraction of keyed requests landing on their affinity engine",
 }
 
 # tpu_-prefixed tokens that are NOT metric names (label keys, module

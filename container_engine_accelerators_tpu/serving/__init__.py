@@ -12,8 +12,29 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""JAX inference serving stack (TF-Serving demo analog)."""
+"""JAX inference serving stack (TF-Serving demo analog).
 
-from .server import GenerationServer, InferenceServer
+Lazy exports (PEP 562): ``serving.affinity`` and ``serving.router``
+are jax-free — the fleet front door imports them from a process with
+no jax at all — so this package must not drag ``serving.server``
+(and through it jax) in at import time. ``GenerationServer`` /
+``InferenceServer`` resolve on first attribute access instead.
+"""
 
-__all__ = ["GenerationServer", "InferenceServer"]
+import importlib
+
+_SERVER_EXPORTS = ("GenerationServer", "InferenceServer")
+
+__all__ = list(_SERVER_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _SERVER_EXPORTS:
+        server = importlib.import_module(".server", __name__)
+        return getattr(server, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SERVER_EXPORTS))

@@ -1,0 +1,114 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+# lint: jax-free
+
+"""Content-keyed prefix chain hashing — the ONE affinity-key function.
+
+The engine's paged block pool indexes prompt-prefix KV blocks by a
+running SHA-256 chain over block contents (``models/decode.py``
+``BlockPool``); the fleet router steers a request toward the engine
+already holding its prefix blocks by computing the SAME key without
+importing jax. This module is that shared function, hoisted here so
+router and engine can never disagree on affinity keys: ``BlockPool``
+delegates its ``_chain`` to :func:`chain_digest`, and
+``tests/test_affinity.py`` pins the byte-identity against a real
+pool's registered index.
+
+jax-free at import by construction (hashlib + numpy only) — the
+router front door runs in a process with no jax installed at all.
+"""
+
+import hashlib
+
+import numpy as np
+
+from ..utils import env_number
+
+# The paged pool's block size knob (docs/operations.md "Serving").
+# Defined here (the jax-free end) and re-exported by models/decode.py
+# so both ends of the affinity contract read the same knob.
+KV_BLOCK_ENV = "CEA_TPU_KV_BLOCK"
+DEFAULT_BLOCK_SIZE = 16
+
+
+def default_block_size():
+    """The engine's KV block size as the router would resolve it:
+    ``CEA_TPU_KV_BLOCK`` or the built-in default. Router and engine
+    must agree on this number or affinity keys diverge silently —
+    deployments that override the engine knob must override it on the
+    router too (same env row)."""
+    return int(env_number(KV_BLOCK_ENV, DEFAULT_BLOCK_SIZE, parse=int))
+
+
+def chain_digest(prev, payload):
+    """One link of the content chain: SHA-256 over the previous
+    link's digest then this block's token payload.
+
+    Running digest rather than nested tuples: O(block) to extend one
+    level, O(1) to hash/compare as a dict key, and collisions are
+    cryptographically infeasible (a bare ``hash()`` key could be
+    forced to alias two prompts and silently share another request's
+    KV blocks). A partial (prompt-tail) block is tagged
+    ``("partial", tokens)`` so a full block and a partial block with
+    the same leading tokens can never collide. Byte-identical to the
+    engine's prefix-index keying — ``BlockPool._chain`` IS this
+    function."""
+    h = hashlib.sha256(b"" if prev is None else prev)
+    if (isinstance(payload, tuple) and payload
+            and payload[0] == "partial"):
+        h.update(b"partial")
+        payload = payload[1]
+    h.update(np.asarray(payload, np.int64).tobytes())
+    return h.digest()
+
+
+def full_block_keys(tokens, block_size):
+    """The chain keys of every FULL ``block_size`` block of
+    ``tokens``, in order — exactly the keys ``BlockPool.register``
+    indexes for a prompt's full blocks."""
+    keys = []
+    chain = None
+    for i in range(len(tokens) // block_size):
+        chain = chain_digest(
+            chain, tuple(tokens[i * block_size:(i + 1) * block_size]))
+        keys.append(chain)
+    return keys
+
+
+def partial_key(chain, tokens):
+    """The chain key of a prompt-tail partial block (``tokens`` is
+    the partial content, ``chain`` the last full-block key or None)
+    — exactly ``BlockPool``'s ``("partial", ...)`` keying."""
+    return chain_digest(chain, ("partial", tuple(tokens)))
+
+
+def affinity_key(tokens, block_size, max_blocks=None):
+    """The router's placement key for a prompt: the chain key of its
+    leading full blocks (capped at ``max_blocks`` — the pinned /
+    system-prompt region a deployment expects to share), or None for
+    prompts shorter than one block (no shareable full block, nothing
+    to steer on).
+
+    Keyed on the LAST link of the chain: two prompts agree on it iff
+    they agree on every token of the covered region, so a map from
+    this key to an engine URL points at the engine whose block pool
+    already indexes those exact blocks."""
+    full = len(tokens) // block_size
+    if max_blocks is not None:
+        full = min(full, int(max_blocks))
+    if full < 1:
+        return None
+    keys = full_block_keys(tokens[:full * block_size], block_size)
+    return keys[-1]

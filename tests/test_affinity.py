@@ -1,0 +1,150 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The affinity-key contract: serving/affinity.py computes the SAME
+content-chain keys the engine's paged block pool indexes blocks by.
+The router steers on these keys from a jax-free process, so any drift
+between the two implementations silently turns every affinity hit
+into a miss — these tests pin the byte-identity against both an
+explicit sha256 recomputation and a real ``_BlockPool``'s registered
+index."""
+
+import hashlib
+
+import numpy as np
+
+from container_engine_accelerators_tpu.models.decode import _BlockPool
+from container_engine_accelerators_tpu.serving.affinity import (
+    DEFAULT_BLOCK_SIZE,
+    KV_BLOCK_ENV,
+    affinity_key,
+    chain_digest,
+    default_block_size,
+    full_block_keys,
+    partial_key,
+)
+
+BS = 4
+
+
+def _sha(prev, *chunks):
+    h = hashlib.sha256(b"" if prev is None else prev)
+    for c in chunks:
+        h.update(c)
+    return h.digest()
+
+
+def _tok_bytes(tokens):
+    return np.asarray(tokens, np.int64).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# chain_digest against an explicit recomputation
+# ---------------------------------------------------------------------------
+
+
+def test_chain_digest_matches_explicit_sha256():
+    b0 = chain_digest(None, (5, 6, 7, 8))
+    assert b0 == _sha(None, _tok_bytes([5, 6, 7, 8]))
+    b1 = chain_digest(b0, (1, 2, 3, 4))
+    assert b1 == _sha(b0, _tok_bytes([1, 2, 3, 4]))
+    # Order matters: the chain is positional, not a token multiset.
+    assert chain_digest(None, (6, 5, 7, 8)) != b0
+
+
+def test_partial_tag_prevents_full_partial_collision():
+    full = chain_digest(None, (9, 9, 9, 9))
+    part = chain_digest(None, ("partial", (9, 9, 9, 9)))
+    assert full != part
+    assert part == _sha(None, b"partial", _tok_bytes([9, 9, 9, 9]))
+    assert partial_key(None, (9, 9, 9, 9)) == part
+    # Chained partials hash the previous link too.
+    assert partial_key(full, (1,)) \
+        == _sha(full, b"partial", _tok_bytes([1]))
+
+
+def test_full_block_keys_chain_each_other():
+    tokens = list(range(1, 13))   # three BS=4 blocks
+    keys = full_block_keys(tokens, BS)
+    assert len(keys) == 3
+    chain = None
+    for i, key in enumerate(keys):
+        chain = _sha(chain, _tok_bytes(tokens[i * BS:(i + 1) * BS]))
+        assert key == chain
+
+
+# ---------------------------------------------------------------------------
+# byte-parity with the engine's block pool
+# ---------------------------------------------------------------------------
+
+
+def test_register_indexes_exactly_the_hoisted_keys():
+    pool = _BlockPool(num_blocks=8, block_size=BS)
+    tokens = [5, 6, 7, 8, 1, 2, 3, 4, 9, 9]
+    pool.register(tokens, plen=10, block_of_index=[0, 1, 2])
+    keys = full_block_keys(tokens[:8], BS)
+    assert pool._index[keys[0]] == 0
+    assert pool._index[keys[1]] == 1
+    # The prompt-tail partial block indexes every leading-prefix key.
+    for q in (1, 2):
+        assert pool._index[partial_key(keys[-1], tokens[8:8 + q])] == 2
+    assert len(pool._index) == 2 + 2
+
+
+def test_lookup_walks_the_same_chain():
+    pool = _BlockPool(num_blocks=8, block_size=BS)
+    tokens = [5, 6, 7, 8, 1, 2, 3, 4]
+    pool.register(tokens, plen=8, block_of_index=[0, 1])
+    shared, sources, fork = pool.lookup(tokens + [40], count=False)
+    assert (shared, sources, fork) == (8, [("dev", 0), ("dev", 1)],
+                                       None)
+    # The router's placement key IS the last link lookup() walked to.
+    assert affinity_key(tokens, BS) == full_block_keys(tokens, BS)[-1]
+    # A prompt diverging inside the covered region maps elsewhere.
+    other = [5, 6, 7, 8, 1, 2, 3, 40]
+    assert affinity_key(other, BS) != affinity_key(tokens, BS)
+    assert pool.lookup(other + [41], count=False)[0] == 4
+
+
+# ---------------------------------------------------------------------------
+# affinity_key semantics
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_key_none_below_one_block():
+    assert affinity_key([1, 2, 3], BS) is None
+    assert affinity_key([], BS) is None
+    assert affinity_key([1, 2, 3, 4], BS) is not None
+
+
+def test_affinity_key_caps_at_max_blocks():
+    shared = [7] * (3 * BS)
+    a = shared + [1, 2, 3, 4]
+    b = shared + [5, 6, 7, 8]
+    # Uncapped, the fourth (divergent) block splits the keys...
+    assert affinity_key(a, BS) != affinity_key(b, BS)
+    # ...capped at the pinned region, both steer to one engine.
+    assert affinity_key(a, BS, max_blocks=3) \
+        == affinity_key(b, BS, max_blocks=3) \
+        == full_block_keys(shared, BS)[-1]
+    # Trailing sub-block tokens never change the key.
+    assert affinity_key(a + [9, 9], BS, max_blocks=3) \
+        == affinity_key(a, BS, max_blocks=3)
+
+
+def test_default_block_size_reads_the_engine_knob(monkeypatch):
+    monkeypatch.delenv(KV_BLOCK_ENV, raising=False)
+    assert default_block_size() == DEFAULT_BLOCK_SIZE
+    monkeypatch.setenv(KV_BLOCK_ENV, "4")
+    assert default_block_size() == 4

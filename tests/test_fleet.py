@@ -29,6 +29,7 @@ from container_engine_accelerators_tpu.obs.fleet import (
     DOWN_EVENT,
     RECOVERED_EVENT,
     FleetCollector,
+    FleetView,
     histograms_from_text,
 )
 from container_engine_accelerators_tpu.obs.metric_names import (
@@ -281,6 +282,27 @@ def test_merged_view_equals_pooled_and_routes_least_loaded():
         == fleet.engines[fleet.urls[0]]["engine_id"]
     assert not any(k.startswith("_")
                    for e in view.to_dict()["engines"] for k in e)
+
+
+def test_load_key_tie_chain_is_pinned():
+    # The pinned total order routers and collectors share: None
+    # queue depth ties with an explicit 0, and the URL leg breaks
+    # every remaining tie deterministically.
+    a = {"url": "http://a", "saturation": 0.1, "queue_depth": None}
+    b = {"url": "http://b", "saturation": 0.1, "queue_depth": 0}
+    assert FleetView.load_key(a)[:2] == FleetView.load_key(b)[:2]
+    assert FleetView.load_key(a) < FleetView.load_key(b)
+    fleet = FakeFleet()
+    fleet.engines[fleet.urls[1]]["queue_depth"] = None
+    view = make_collector(fleet, Tracer(enabled=True)).poll_once()
+    # All-equal load: lexicographic URL order, and the exclude=
+    # chain walks that same order one engine at a time.
+    assert view.pick_least_loaded() == fleet.urls[0]
+    assert view.pick_least_loaded(
+        exclude=[fleet.urls[0]]) == fleet.urls[1]
+    assert view.pick_least_loaded(
+        exclude=fleet.urls[:2]) == fleet.urls[2]
+    assert view.pick_least_loaded(exclude=fleet.urls) is None
 
 
 def test_unready_engine_steered_around_without_down_event():

@@ -1,0 +1,575 @@
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet-router contracts: tenant deficit math, the placement order
+(affinity -> bounded-load spill -> hedge -> least-loaded with live
+in-flight counts), shed statuses with derived Retry-After, and the
+mid-stream failover splice — against the injected fake fleet from
+test_fleet plus scripted stdlib HTTP engines (tools/router_check.py
+drives the real-engine version at scale; the slow test here is the
+two-real-process kernel of it)."""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from container_engine_accelerators_tpu.obs.fleet import (
+    FleetCollector,
+)
+from container_engine_accelerators_tpu.obs.trace import Tracer
+from container_engine_accelerators_tpu.serving.router import (
+    REASON_AFFINITY,
+    REASON_HEDGE,
+    REASON_LEAST_LOADED,
+    REASON_SPILL,
+    SHED_NO_ENGINES,
+    SHED_SATURATED,
+    SHED_TENANT_RATE,
+    RouterCore,
+    RouterServer,
+    TenantLedger,
+    parse_weights,
+)
+from tests.test_fleet import FakeFleet, make_collector
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BS = 4
+KEYED = [1, 2, 3, 4, 5, 6, 7, 8]       # two full BS=4 blocks
+UNKEYED = [1, 2, 3]                    # below one block: no key
+
+
+def _sat(x):
+    return {"max": x, "causes": {"slots": x}}
+
+
+def make_core(fleet, **kw):
+    coll = make_collector(fleet, Tracer(enabled=True))
+    coll.poll_once()
+    kw.setdefault("block_size", BS)
+    kw.setdefault("shed_sat", 0.9)
+    kw.setdefault("tenants", TenantLedger(rate=0))
+    kw.setdefault("spill_bound", 2)
+    return coll, RouterCore(coll, **kw)
+
+
+# ---------------------------------------------------------------------------
+# tenant fairness
+# ---------------------------------------------------------------------------
+
+
+def test_parse_weights_tolerates_junk():
+    assert parse_weights("a=2, b=0.5") == {"a": 2.0, "b": 0.5}
+    assert parse_weights("a=2,,=3,c=x,d=-1,e") == {"a": 2.0}
+    assert parse_weights("") == {}
+    assert parse_weights(None) == {}
+
+
+def test_tenant_ledger_weighted_deficit_math():
+    now = [1000.0]
+    led = TenantLedger(rate=10.0, burst_s=2.0, weights={"big": 2.0},
+                       clock=lambda: now[0])
+    # New tenants start with a full burst: rate * weight * burst_s.
+    ok, wait = led.admit("small", 20)
+    assert ok and wait is None
+    ok, wait = led.admit("small", 1)
+    assert not ok and wait == 1
+    ok, wait = led.admit("big", 40)
+    assert ok and wait is None
+    # Refill is continuous: 1s at weight-1 rate 10 -> 10 tokens.
+    now[0] += 1.0
+    ok, _ = led.admit("small", 10)
+    assert ok
+    # A cost above the burst cap quotes the FULL-cap wait (it can
+    # never admit sooner), not the unreachable cost.
+    ok, wait = led.admit("small", 1000)
+    assert not ok and wait == 2
+    # rate <= 0 disables fairness entirely.
+    assert TenantLedger(rate=0).admit("anyone", 10 ** 9) == (True, None)
+
+
+def test_tenant_shed_is_429_with_retry_after():
+    fleet = FakeFleet()
+    now = [0.0]
+    _, core = make_core(fleet, tenants=TenantLedger(
+        rate=10.0, burst_s=1.0, clock=lambda: now[0]))
+    assert core.route(UNKEYED, 10)["action"] == "route"
+    decision = core.route(UNKEYED, 10)
+    assert decision == {"action": "shed", "status": 429,
+                        "reason": SHED_TENANT_RATE, "retry_after": 1}
+    assert core.stats()["shed"] == {SHED_TENANT_RATE: 1}
+
+
+# ---------------------------------------------------------------------------
+# placement order
+# ---------------------------------------------------------------------------
+
+
+def test_unkeyed_routes_least_loaded_and_inflight_spreads():
+    fleet = FakeFleet()
+    _, core = make_core(fleet)
+    d = core.route(UNKEYED, 10)
+    assert d["action"] == "route" and d["key"] is None
+    assert d["reason"] == REASON_LEAST_LOADED
+    assert d["url"] == fleet.urls[0]   # all-equal tie: URL order
+    # The router's own in-flight counts break the next tie: an
+    # untouched engine beats the one just aimed at.
+    core.inflight_begin(fleet.urls[0])
+    assert core.route(UNKEYED, 10)["url"] == fleet.urls[1]
+    core.inflight_end(fleet.urls[0])
+    assert core.route(UNKEYED, 10)["url"] == fleet.urls[0]
+
+
+def test_inflight_outranks_stale_saturation():
+    # An engine's published saturation PARKS at its last value when
+    # it idles; a poll-stale 0.25 must not outrank live placement.
+    fleet = FakeFleet()
+    fleet.engines[fleet.urls[0]]["saturation"] = _sat(0.25)
+    coll, core = make_core(fleet)
+    coll.poll_once()
+    assert core.route(UNKEYED, 10)["url"] == fleet.urls[1]
+    core.inflight_begin(fleet.urls[1])
+    core.inflight_begin(fleet.urls[2])
+    assert core.route(UNKEYED, 10)["url"] == fleet.urls[0]
+
+
+def test_affinity_seed_hit_and_lru_cap():
+    fleet = FakeFleet()
+    _, core = make_core(fleet, affinity_cap=2)
+    seed = core.route(KEYED, 10)
+    assert seed["reason"] == REASON_LEAST_LOADED
+    assert seed["key"] is not None
+    home = seed["url"]
+    # Load the fleet elsewhere: the pin must override least-loaded.
+    for url in fleet.urls:
+        if url != home:
+            continue
+        core.inflight_begin(url)
+    hit = core.route(KEYED, 10)
+    assert hit == {"action": "route", "url": home,
+                   "reason": REASON_AFFINITY, "key": seed["key"]}
+    stats = core.stats()["affinity"]
+    assert (stats["lookups"], stats["hits"]) == (2, 1)
+    assert stats["hit_rate"] == 0.5
+    # The map is LRU-bounded: a third distinct prefix evicts the
+    # oldest of the two when the cap is 2.
+    core.route([9] * 8, 10)
+    core.route([11] * 8, 10)
+    snap = core.affinity_snapshot()
+    assert len(snap) == 2 and seed["key"].hex() not in snap
+
+
+def test_hedge_repoints_when_home_is_hot():
+    fleet = FakeFleet()
+    coll, core = make_core(fleet)
+    home = core.route(KEYED, 10)["url"]
+    fleet.engines[home]["saturation"] = _sat(0.95)
+    coll.poll_once()
+    d = core.route(KEYED, 10)
+    assert d["reason"] == REASON_HEDGE and d["url"] != home
+    # The blocks will be rebuilt where the hedge landed: map follows.
+    assert core.affinity_snapshot()[d["key"].hex()] == d["url"]
+
+
+def test_spill_past_bound_without_repointing():
+    fleet = FakeFleet()
+    coll, core = make_core(fleet, spill_bound=2)
+    seed = core.route(KEYED, 10)
+    home, key = seed["url"], seed["key"]
+    fleet.engines[home]["queue_depth"] = 5   # bound(2) + best(0) < 5
+    coll.poll_once()
+    d = core.route(KEYED, 10)
+    assert d["reason"] == REASON_SPILL and d["url"] != home
+    # Spill is an overflow, not a migration: the map stays put and
+    # the request does NOT count as an affinity hit.
+    assert core.affinity_snapshot()[key.hex()] == home
+    assert core.stats()["affinity"]["hits"] == 0
+    # Load drains -> the pin resumes.
+    fleet.engines[home]["queue_depth"] = 1
+    coll.poll_once()
+    assert core.route(KEYED, 10)["reason"] == REASON_AFFINITY
+
+
+def test_spill_bound_zero_disables():
+    fleet = FakeFleet()
+    coll, core = make_core(fleet, spill_bound=0)
+    home = core.route(KEYED, 10)["url"]
+    fleet.engines[home]["queue_depth"] = 50
+    coll.poll_once()
+    assert core.route(KEYED, 10) == {
+        "action": "route", "url": home, "reason": REASON_AFFINITY,
+        "key": core.route(KEYED, 10)["key"]}
+
+
+# ---------------------------------------------------------------------------
+# shedding and siblings
+# ---------------------------------------------------------------------------
+
+
+def test_saturated_fleet_sheds_with_ramp_retry_after():
+    fleet = FakeFleet()
+    for url in fleet.urls:
+        fleet.engines[url]["saturation"] = _sat(0.95)
+    coll, core = make_core(fleet)
+    coll.poll_once()
+    d = core.route(UNKEYED, 10)
+    # No engine published a horizon: the single-engine overload ramp
+    # 1 + 4 * sat quotes the wait (min over engines, rounded).
+    assert d == {"action": "shed", "status": 503,
+                 "reason": SHED_SATURATED, "retry_after": 5}
+
+
+def test_dead_fleet_sheds_no_engines():
+    fleet = FakeFleet()
+    coll, core = make_core(fleet, shed_sat=2.0)
+    for url in fleet.urls:
+        fleet.engines[url]["alive"] = False
+    for _ in range(3):   # past the down hysteresis
+        fleet.now += 10.0
+        coll.poll_once()
+    d = core.route(UNKEYED, 10)
+    assert d["action"] == "shed" and d["status"] == 503
+    assert d["reason"] == SHED_NO_ENGINES and d["retry_after"] >= 1
+
+
+def test_draining_horizon_caps_retry_after():
+    fleet = FakeFleet()
+    for url in fleet.urls:
+        fleet.engines[url]["ready"] = False
+        fleet.engines[url]["detail"] = {"state": "draining",
+                                        "retry_after_s": 7.0,
+                                        "saturation_cause": None}
+    coll, core = make_core(fleet)
+    coll.poll_once()
+    d = core.route(UNKEYED, 10)
+    assert d["reason"] == SHED_NO_ENGINES and d["retry_after"] == 7
+
+
+def test_sibling_prefers_cold_falls_back_hot():
+    fleet = FakeFleet()
+    failed, hot, cold = fleet.urls
+    fleet.engines[hot]["saturation"] = _sat(0.95)
+    coll, core = make_core(fleet)
+    coll.poll_once()
+    assert core.sibling({failed}) == cold
+    # With every survivor hot, a hot sibling still beats a dropped
+    # stream.
+    assert core.sibling({failed, cold}) == hot
+    assert core.sibling(set(fleet.urls)) is None
+
+
+# ---------------------------------------------------------------------------
+# the stream splice against scripted HTTP engines
+# ---------------------------------------------------------------------------
+
+
+class ScriptedEngine:
+    """A stdlib HTTP engine that answers the collector's poll
+    surfaces and streams a scripted ndjson plan on POST. Plans:
+    ("tokens", [..]) lines, "die" (drop the connection mid-stream),
+    "done", or ("envelope", {...})."""
+
+    def __init__(self):
+        self.plan = []
+        self.requests = []       # payloads this engine received
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, body):
+                payload = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length",
+                                 str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                path = self.path.partition("?")[0]
+                if path == "/stats":
+                    self._json({
+                        "engine_id": f"fake@{outer.port}",
+                        "requests_retired": 0,
+                        "queue_depth": 0,
+                        "slo": {"violations": {}},
+                        "saturation": {"max": 0.0, "causes": {}},
+                    })
+                elif path == "/metrics":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                elif path in ("/readyz", "/healthz"):
+                    self._json({"status": "ok"})
+                elif path.startswith("/debug/requests"):
+                    self._json({"retired_total": 0, "records": []})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length))
+                outer.requests.append(payload)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.end_headers()
+                for step in outer.plan:
+                    if step == "die":
+                        self.wfile.flush()
+                        self.connection.close()
+                        return
+                    if step == "done":
+                        self.wfile.write(b'{"done": true}\n')
+                    elif step[0] == "tokens":
+                        self.wfile.write(json.dumps(
+                            {"tokens": step[1]}).encode() + b"\n")
+                    else:
+                        self.wfile.write(json.dumps(
+                            step[1]).encode() + b"\n")
+                    self.wfile.flush()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _stream_through_router(port, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", "/v1/models/lm:generate",
+                 body=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    lines = []
+    while True:
+        raw = resp.readline()
+        if not raw:
+            break
+        if raw.strip():
+            lines.append(json.loads(raw))
+    conn.close()
+    return resp.status, lines
+
+
+@pytest.fixture
+def scripted_pair():
+    engines = [ScriptedEngine(), ScriptedEngine()]
+    # The router breaks the all-idle tie lexicographically: the
+    # URL-smallest engine receives the first request.
+    first, second = sorted(engines, key=lambda e: e.url)
+    collector = FleetCollector([e.url for e in engines],
+                               poll_ms=10000.0)
+    core = RouterCore(collector, block_size=BS, shed_sat=2.0,
+                      tenants=TenantLedger(rate=0))
+    server = RouterServer(core, collector, port=0, timeout_s=10.0)
+    collector.poll_once()
+    server.start()
+    try:
+        yield first, second, core, server
+    finally:
+        server.stop()
+        for e in engines:
+            e.stop()
+
+
+def test_stream_splice_resubmits_prompt_plus_delivered(scripted_pair):
+    first, second, core, server = scripted_pair
+    first.plan = [("tokens", [10]), ("tokens", [11]), "die"]
+    second.plan = [("tokens", [12]), ("tokens", [13]), "done"]
+    status, lines = _stream_through_router(server.port, {
+        "prompts": [UNKEYED], "max_new_tokens": 4, "stream": True})
+    assert status == 200
+    assert lines == [{"tokens": [10]}, {"tokens": [11]},
+                     {"tokens": [12]}, {"tokens": [13]},
+                     {"done": True}]
+    # The cross-process replay contract: the sibling's prompt is
+    # prompt + every delivered token, its budget what remains.
+    (replay,) = second.requests
+    assert replay["prompts"] == [UNKEYED + [10, 11]]
+    assert replay["max_new_tokens"] == 2
+    assert core.stats()["failover"] == 1
+
+
+def test_stream_splice_closes_clean_when_budget_spent(scripted_pair):
+    first, second, core, server = scripted_pair
+    first.plan = [("tokens", [10]), ("tokens", [11]), "die"]
+    status, lines = _stream_through_router(server.port, {
+        "prompts": [UNKEYED], "max_new_tokens": 2, "stream": True})
+    # Everything owed was delivered before the death: the splice is
+    # a bare close, no sibling contacted.
+    assert status == 200
+    assert lines == [{"tokens": [10]}, {"tokens": [11]},
+                     {"done": True}]
+    assert second.requests == []
+
+
+def test_fatal_envelope_is_relayed_not_retried(scripted_pair):
+    first, second, core, server = scripted_pair
+    first.plan = [("tokens", [10]),
+                  ("envelope", {"error": "boom", "retryable": False})]
+    status, lines = _stream_through_router(server.port, {
+        "prompts": [UNKEYED], "max_new_tokens": 4, "stream": True})
+    assert status == 200   # headers were already streaming
+    assert lines[0] == {"tokens": [10]}
+    assert lines[-1]["error"] == "boom"
+    assert second.requests == []
+    assert core.stats()["failover"] == 0
+
+
+def test_failover_exhausted_surfaces_envelope(scripted_pair):
+    first, second, core, server = scripted_pair
+    first.plan = [("tokens", [10]), "die"]
+    second.plan = [("tokens", [11]), "die"]
+    status, lines = _stream_through_router(server.port, {
+        "prompts": [UNKEYED], "max_new_tokens": 8, "stream": True})
+    assert status == 200
+    assert lines[:2] == [{"tokens": [10]}, {"tokens": [11]}]
+    tail = lines[-1]
+    assert "failover exhausted" in tail["error"] and tail["retryable"]
+    # One hop spent (first -> second); a tried engine is never
+    # retried, so the second death exhausts the stream.
+    assert core.stats()["failover"] == 1
+    assert core.stats()["shed"] == {"failover_exhausted": 1}
+
+
+def test_unary_failover_retries_on_sibling(scripted_pair):
+    first, second, core, server = scripted_pair
+    # A dead-socket engine: stop it so the unary POST fails outright.
+    first.stop()
+    second.plan = [("tokens", [12]), "done"]
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=30)
+    conn.request("POST", "/v1/models/lm:generate",
+                 body=json.dumps({"prompts": [UNKEYED],
+                                  "max_new_tokens": 2}).encode())
+    resp = conn.getresponse()
+    assert resp.status == 200
+    conn.close()
+    assert len(second.requests) == 1
+    assert core.stats()["failover"] == 1
+
+
+# ---------------------------------------------------------------------------
+# two real engines: the failover splice is token-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_two_process_failover_stream_token_identical():
+    """The kernel of tools/router_check.py leg 3: two real
+    GenerationServer processes (ONE model seed), a mid-stream
+    SIGKILL, and the spliced stream must equal the sibling's
+    uninterrupted greedy decode."""
+    tmpdir = tempfile.mkdtemp(prefix="router_test_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs, urls = [], []
+    worker = os.path.join(REPO, "tools", "serve_fleet.py")
+    for i in range(2):
+        port_file = os.path.join(tmpdir, f"e{i}.port")
+        procs.append((subprocess.Popen(
+            [sys.executable, worker, "--worker",
+             "--port-file", port_file, "--seed", "0"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL), port_file))
+    collector = core = server = None
+    try:
+        deadline = time.monotonic() + 300
+        for proc, port_file in procs:
+            while not os.path.exists(port_file):
+                assert proc.poll() is None, "engine died warming up"
+                assert time.monotonic() < deadline, "warm-up timeout"
+                time.sleep(0.2)
+            with open(port_file) as f:
+                urls.append(f"http://127.0.0.1:{f.read().strip()}")
+        collector = FleetCollector(urls, poll_ms=250.0)
+        core = RouterCore(collector, shed_sat=2.0,
+                          tenants=TenantLedger(rate=0))
+        server = RouterServer(core, collector, port=0)
+        collector.start()
+        server.start()
+
+        prompt, max_new = [1, 2, 3, 4, 5], 20
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=120)
+        conn.request("POST", "/v1/models/lm:generate",
+                     body=json.dumps({"prompts": [prompt],
+                                      "max_new_tokens": max_new,
+                                      "stream": True}).encode())
+        # The in-flight ledger names the engine holding the stream
+        # the moment the router aims at it — kill the victim BEFORE
+        # it can finish the tiny decode, so the splice really runs.
+        kill_deadline = time.monotonic() + 60
+        while not core._inflight:
+            assert time.monotonic() < kill_deadline
+            time.sleep(0.001)
+        (victim,) = list(core._inflight)
+        sibling = next(u for u in urls if u != victim)
+        victim_proc = next(
+            p for (p, pf), u in zip(procs, urls) if u == victim)
+        victim_proc.kill()
+        resp = conn.getresponse()
+        assert resp.status == 200
+        tokens = []
+        # The sibling's uninterrupted greedy decode is the oracle
+        # (same seed -> same weights -> token-identical).
+        ref_conn = http.client.HTTPConnection(
+            sibling.split("//")[1].split(":")[0],
+            int(sibling.rsplit(":", 1)[1]), timeout=120)
+        ref_conn.request("POST", "/v1/models/lm:generate",
+                         body=json.dumps(
+                             {"prompts": [prompt],
+                              "max_new_tokens": max_new}).encode())
+        ref = json.loads(ref_conn.getresponse().read())
+        ref_conn.close()
+        reference = ref["sequences"][0][len(prompt):]
+        while True:
+            raw = resp.readline()
+            assert raw, "stream truncated without done"
+            line = json.loads(raw)
+            if line.get("done"):
+                break
+            assert "error" not in line, line
+            tokens.extend(line["tokens"])
+        conn.close()
+        assert tokens == reference
+        assert core.stats()["failover"] >= 1
+    finally:
+        if server is not None:
+            server.stop()
+        if collector is not None:
+            collector.stop()
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for proc, _ in procs:
+            proc.wait(timeout=15)

@@ -132,6 +132,12 @@ METRIC_DIRECTIONS = {
     "spill_goodput_ratio": "up",
     "int8_rows_ratio": "up",
     "prefix_hit_rate": "up",
+    # router_check: engine-step goodput scale 1 engine -> N engines
+    # through the front door, and the fraction of keyed requests the
+    # router lands on their affinity engine — a DROP means scale-out
+    # stopped scaling or prefix steering stopped steering.
+    "router_goodput_scale": "up",
+    "router_affinity_hit_rate": "up",
     "kv_block_utilization": "up",
     "kv_spill_hit_rate": "up",
     "batch_occupancy_avg": "up",

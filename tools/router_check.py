@@ -1,0 +1,758 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet-router gate (`make router-check`).
+
+Spins up real fake-chip CPU engine servers (subprocess workers, ONE
+model seed so cross-engine replay is token-identical), fronts them
+with the jax-free serving.router stack in-process, and holds the
+scale-out contracts end to end over real HTTP:
+
+  1. **goodput scales**: one mixed Poisson trace (prefix-heavy plus
+     unaffiliated traffic) replayed through the front door against 1
+     engine and against N engines must shrink the row-work makespan
+     — ``max`` over engines of the ``rows_decoded`` delta — by >=
+     3.2x at N=4 (>= 1.6x at the --fast N=2). Decoded-row work is
+     the rig-independent goodput unit (shared-nothing engines decode
+     concurrently in a real deployment, so the most-loaded engine is
+     the finish line); wall clocks ride as config context only, the
+     fleet-check precedent.
+  2. **affinity holds the hit rate**: the fleet-wide
+     ``prefix_hit_rate`` under router placement must stay within 10
+     points of the single-engine baseline on an identical-shape
+     trace, while a round-robin control on a third identical-shape
+     trace degrades below the affinity rate — proof the chain-hash
+     steering, not luck, is what preserves block reuse at fleet
+     scale.
+  3. **mid-stream failover**: SIGKILL the affinity engine while
+     greedy streams are mid-flight; every stream must still deliver
+     the EXACT token tail a surviving engine produces for its full
+     prompt (the PR 15 replay contract spliced cross-process), and
+     ``tpu_router_failover_total`` must move.
+  4. **no leaks on survivors**: after the kill episode every
+     surviving engine must quiesce to slots_active=0, queue_depth=0,
+     kv_blocks_free=kv_blocks_total, kv_blocks_shared=0.
+  5. **fleet-wide shed**: draining every survivor (SIGUSR1) empties
+     the steer set; the router must answer new work 503 with a
+     Retry-After derived from the engines' own recovery horizons,
+     and its /readyz must go 503.
+
+``--ledger`` (the suite leg) appends ``router_goodput_scale`` ("up")
+and ``router_affinity_hit_rate`` ("up").
+
+Internal: ``--worker --port-file P --seed S`` is the
+engine-subprocess entrypoint (the only place jax loads; the driver
+asserts it stayed jax-free).
+"""
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.obs.fleet import (  # noqa: E402
+    FleetCollector,
+)
+from container_engine_accelerators_tpu.serving.affinity import (  # noqa: E402
+    affinity_key,
+)
+from container_engine_accelerators_tpu.serving.router import (  # noqa: E402
+    RouterCore,
+    RouterServer,
+)
+
+# The whole gate runs on a tiny block size so 8-token prefixes span
+# two FULL blocks: the worker env pins CEA_TPU_KV_BLOCK=4 and the
+# driver passes block_size=4 explicitly (never via its own environ —
+# that env var is a perf-ledger fingerprint knob).
+BLOCK = 4
+PREFIX_LEN = 2 * BLOCK
+STREAM_NEW = 24          # == the workers' max_new_tokens budget
+
+
+# ---------------------------------------------------------------------------
+# Worker: one real engine server in a subprocess
+# ---------------------------------------------------------------------------
+
+
+def worker_main(args):
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    # max_queue=0 (unbounded admission): the gate measures the
+    # ROUTER's placement and shedding, so the engines must not add
+    # their own shed noise under the burst legs.
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=STREAM_NEW, max_batch=4,
+                           max_queue=0, warm=True)
+    srv.start()
+    signal.signal(signal.SIGUSR1, lambda *_: srv.begin_drain())
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(srv.port))
+    os.replace(tmp, args.port_file)
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Driver helpers
+# ---------------------------------------------------------------------------
+
+
+class HarnessError(Exception):
+    """The rig broke (worker died, timeout), not the contract."""
+
+
+def spawn_worker(idx, tmpdir, log):
+    port_file = os.path.join(tmpdir, f"engine{idx}.port")
+    # ONE model seed for every engine: shared weights are what makes
+    # cross-engine greedy replay token-identical (leg 3).
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               PYTHONPATH=REPO_ROOT,
+               CEA_TPU_TRACE="1",
+               CEA_TPU_KV_BLOCK=str(BLOCK))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--port-file", port_file, "--seed", "0"],
+        stdout=log, stderr=log, env=env)
+    return proc, port_file
+
+
+def wait_for_port(proc, port_file, deadline):
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise HarnessError(
+                f"engine worker exited rc {proc.returncode} before "
+                f"serving (see worker log)")
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                return int(f.read().strip())
+        time.sleep(0.2)
+    raise HarnessError("timed out waiting for engine workers to warm")
+
+
+def http_get(url, timeout=10):
+    """(status, headers, body) with HTTP errors as answers."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), e.read()
+
+
+def post_json(url, payload, timeout=120):
+    """(status, headers, parsed-json-body) with HTTP errors as
+    answers."""
+    req = urllib.request.Request(
+        url + "/v1/models/lm:generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            detail = json.loads(body)
+        except ValueError:
+            detail = {"error": body.decode("replace")}
+        return e.code, dict(e.headers or {}), detail
+
+
+def engine_stats(urls):
+    out = {}
+    for url in urls:
+        status, _, body = http_get(url + "/stats")
+        if status != 200:
+            raise HarnessError(f"{url}/stats HTTP {status}")
+        out[url] = json.loads(body)
+    return out
+
+
+def quiesce(url, deadline_s=60.0):
+    """Wait for one engine to go fully idle; returns the final /stats
+    snapshot and whether it got there."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        stats = engine_stats([url])[url]
+        idle = (stats["slots_active"] == 0
+                and stats["queue_depth"] == 0
+                and stats["kv_blocks_shared"] == 0
+                and stats["kv_blocks_free"] == stats[
+                    "kv_blocks_total"])
+        if idle or time.monotonic() >= deadline:
+            return stats, idle
+        time.sleep(0.25)
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: the mixed Poisson trace and the step-work makespan
+# ---------------------------------------------------------------------------
+
+
+def rng_prefixes(rng, n_prefixes):
+    """``n_prefixes`` random 2-full-block prefixes. Every leg draws
+    from its OWN rng seed: sequences of 8 draws over 40 symbols
+    never collide across legs, so no leg inherits another leg's
+    cached blocks (deterministic-stride prefixes would)."""
+    return [[rng.randrange(1, 41) for _ in range(PREFIX_LEN)]
+            for _ in range(n_prefixes)]
+
+
+def build_trace(n_keyed, n_free, n_prefixes, rng):
+    """One deterministic mixed trace: ``n_keyed`` requests spread
+    over ``n_prefixes`` shared 2-block prefixes (unique suffixes),
+    plus ``n_free`` short unaffiliated prompts (under one full block
+    — no affinity key), shuffled, with exponential inter-arrival
+    gaps."""
+    prefixes = rng_prefixes(rng, n_prefixes)
+    reqs = []
+    for i in range(n_keyed):
+        prompt = prefixes[i % n_prefixes] + [
+            rng.randrange(1, 41), rng.randrange(1, 41)]
+        reqs.append({"prompts": [prompt],
+                     "max_new_tokens": 4 + i % 5})
+    for i in range(n_free):
+        # Disjoint token alphabet (41..46 vs the keyed 1..40): a
+        # sub-block prompt registers chain-None partial keys for its
+        # leading tokens, and a later leg's first-sighting lookup
+        # probes exactly those — a shared alphabet would hand the
+        # affinity legs single-token fork hits by accident.
+        reqs.append({"prompts": [[rng.randrange(41, 47)
+                                  for _ in range(3)]],
+                     "max_new_tokens": 4})
+    rng.shuffle(reqs)
+    return [(req, rng.expovariate(1.0 / 0.004)) for req in reqs]
+
+
+def run_trace(router_url, trace, max_outstanding=24):
+    """Replay the trace through the front door; returns the list of
+    per-request failures (empty on a clean run)."""
+    failures = []
+    lock = threading.Lock()
+    sem = threading.Semaphore(max_outstanding)
+    threads = []
+
+    def fire(payload):
+        try:
+            status, _, body = post_json(router_url, payload)
+            if status != 200:
+                with lock:
+                    failures.append(
+                        f"HTTP {status}: {body.get('error')}")
+        except OSError as e:
+            with lock:
+                failures.append(f"transport: {e}")
+        finally:
+            sem.release()
+
+    for payload, gap in trace:
+        if not sem.acquire(timeout=300):
+            with lock:
+                failures.append("trace stalled: no slot freed in 300s")
+            break
+        t = threading.Thread(target=fire, args=(payload,),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(gap)
+    for t in threads:
+        t.join(timeout=300)
+    return failures
+
+
+def makespan(urls, before, after):
+    """Work makespan of one run: the max over engines of the
+    ``rows_decoded`` delta (token-rows actually decoded — concurrent
+    shared-nothing engines, so the most-loaded engine IS the finish
+    line). Rows, not ``engine_steps``: step counts fold in batch
+    occupancy, and on this single-CPU rig a 4-engine fleet cannot be
+    FED at full per-engine concurrency — steps would charge the
+    router for the harness's batching physics, rows charge it for
+    exactly what it controls: how evenly the work spread."""
+    return max(after[u]["rows_decoded"] - before[u]["rows_decoded"]
+               for u in urls)
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: prefix-hit-rate under three placement policies
+# ---------------------------------------------------------------------------
+
+
+def hit_rate_delta(urls, before, after):
+    hits = sum(after[u]["prefix_hits"] - before[u]["prefix_hits"]
+               for u in urls)
+    lookups = sum(
+        after[u]["prefix_lookups"] - before[u]["prefix_lookups"]
+        for u in urls)
+    if lookups <= 0:
+        raise HarnessError("affinity leg produced zero prefix "
+                           "lookups — traffic never landed")
+    return hits / lookups, lookups
+
+
+def affinity_trace(rng, n_prefixes, per_prefix):
+    """Identical-SHAPE traces per policy (per-policy rng seeds so no
+    policy inherits another's cached blocks), PREFIX-major: all of a
+    prefix's requests are consecutive, so the round-robin control's
+    ``i % n_engines`` placement alternates engines WITHIN each
+    prefix (request-major order would alias request index onto
+    prefix index whenever n_engines divides n_prefixes, turning the
+    control into accidental affinity)."""
+    prefixes = rng_prefixes(rng, n_prefixes)
+    reqs = []
+    for prefix in prefixes:
+        for _ in range(per_prefix):
+            reqs.append(prefix + [rng.randrange(1, 41),
+                                  rng.randrange(1, 41)])
+    return reqs
+
+
+def run_affinity_policy(urls_for, prompts):
+    """Sequential replay (deterministic hit accounting: no two
+    same-prefix admissions race into one batch)."""
+    for i, prompt in enumerate(prompts):
+        status, _, body = post_json(
+            urls_for(i), {"prompts": [prompt], "max_new_tokens": 2})
+        if status != 200:
+            raise HarnessError(
+                f"affinity-leg request {i} HTTP {status}: "
+                f"{body.get('error')}")
+
+
+# ---------------------------------------------------------------------------
+# Leg 3: mid-stream failover
+# ---------------------------------------------------------------------------
+
+
+def stream_tokens(router_url, prompt, results, idx, first_token):
+    """One streaming request through the router; accumulates tokens
+    into results[idx] and flags the first delivered token."""
+    req = urllib.request.Request(
+        router_url + "/v1/models/lm:generate",
+        data=json.dumps({"prompts": [prompt],
+                         "max_new_tokens": STREAM_NEW,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    tokens, err = [], None
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            for raw in resp:
+                line = json.loads(raw)
+                if "tokens" in line:
+                    tokens.extend(int(t) for t in line["tokens"])
+                    first_token.set()
+                elif line.get("error"):
+                    err = line["error"]
+                elif line.get("done"):
+                    break
+    except (OSError, ValueError) as e:
+        err = f"{type(e).__name__}: {e}"
+    results[idx] = {"tokens": tokens, "error": err}
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--fast", action="store_true",
+                   help="the presubmit leg: 2 engines, smaller trace")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="append the scaling + affinity rows to the "
+                        "perf ledger (source router_check)")
+    p.add_argument("--worker", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--port-file", default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--seed", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return worker_main(args)
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import perf_ledger
+
+    # A wedged backend must surface as an explained skip row, not a
+    # silent worker-warm-up hang.
+    perf_ledger.ensure_backend_or_skip("router_check", args.ledger)
+
+    n_engines = 2 if args.fast else 4
+    n_keyed = 40 if args.fast else 96
+    n_free = 16 if args.fast else 32
+    scale_floor = 1.6 if args.fast else 3.2
+    per_prefix = 10
+    n_prefixes = 6
+
+    obs.set_role("router-check")
+    failures = []
+    t_start = time.monotonic()
+    tmpdir = tempfile.mkdtemp(prefix="router_check_")
+    log_path = os.path.join(tmpdir, "workers.log")
+    log = open(log_path, "ab")
+    procs = []
+    servers = []        # (RouterServer, FleetCollector) to tear down
+    try:
+        for i in range(n_engines):
+            procs.append(spawn_worker(i, tmpdir, log))
+        deadline = time.monotonic() + 600
+        ports = [wait_for_port(proc, pf, deadline)
+                 for proc, pf in procs]
+        urls = [f"http://127.0.0.1:{port}" for port in ports]
+        procs_by_url = dict(zip(urls, [pr for pr, _ in procs]))
+
+        def front(url_subset, shed_sat=None):
+            collector = FleetCollector(url_subset, poll_ms=250)
+            core = RouterCore(collector, block_size=BLOCK,
+                              shed_sat=shed_sat)
+            server = RouterServer(core, collector, port=0)
+            collector.start()
+            server.start()
+            servers.append((server, collector))
+            return core, f"http://127.0.0.1:{server.port}"
+
+        def stop_front():
+            while servers:
+                server, collector = servers.pop()
+                server.stop()
+                collector.stop()
+
+        # -- leg 1: goodput scales through the front door -----------
+        # shed_sat above 1.0: a single saturated engine must KEEP
+        # absorbing the trace (throughput is what's under test here;
+        # the shed contract gets its own leg below).
+        trace = build_trace(n_keyed, n_free, n_prefixes=8,
+                            rng=random.Random(20260807))
+        _, solo_url = front(urls[:1], shed_sat=2.0)
+        before = engine_stats(urls)
+        errs = run_trace(solo_url, trace)
+        solo_rows = makespan(urls, before, engine_stats(urls))
+        stop_front()
+        if errs:
+            failures.append(
+                f"single-engine trace had {len(errs)} failed "
+                f"requests (first: {errs[0]})")
+
+        _, fleet_url = front(urls, shed_sat=2.0)
+        before = engine_stats(urls)
+        errs = run_trace(fleet_url, trace)
+        fleet_rows = makespan(urls, before, engine_stats(urls))
+        stop_front()
+        if errs:
+            failures.append(
+                f"fleet trace had {len(errs)} failed requests "
+                f"(first: {errs[0]})")
+        scale = solo_rows / max(1, fleet_rows)
+        if scale < scale_floor:
+            failures.append(
+                f"row-work makespan scaled {scale:.2f}x from 1 to "
+                f"{n_engines} engines (solo {solo_rows} vs fleet "
+                f"{fleet_rows} decoded rows on the most-loaded "
+                f"engine), want >= {scale_floor}x — the router is "
+                f"not spreading the trace")
+
+        # -- leg 2: affinity preserves the prefix hit rate ----------
+        core, router_url = front(urls)
+
+        before = engine_stats(urls)
+        run_affinity_policy(
+            lambda i: urls[0],
+            affinity_trace(random.Random(100),
+                           n_prefixes=n_prefixes,
+                           per_prefix=per_prefix))
+        rate_base, _ = hit_rate_delta(urls, before,
+                                      engine_stats(urls))
+
+        before = engine_stats(urls)
+        run_affinity_policy(
+            lambda i: router_url,
+            affinity_trace(random.Random(200),
+                           n_prefixes=n_prefixes,
+                           per_prefix=per_prefix))
+        rate_aff, aff_lookups = hit_rate_delta(urls, before,
+                                               engine_stats(urls))
+
+        before = engine_stats(urls)
+        run_affinity_policy(
+            lambda i: urls[i % n_engines],
+            affinity_trace(random.Random(300),
+                           n_prefixes=n_prefixes,
+                           per_prefix=per_prefix))
+        rate_rr, _ = hit_rate_delta(urls, before,
+                                    engine_stats(urls))
+
+        if rate_aff < rate_base - 0.10:
+            failures.append(
+                f"fleet prefix hit rate {rate_aff:.3f} under "
+                f"affinity routing fell more than 10 points below "
+                f"the single-engine baseline {rate_base:.3f}")
+        if rate_rr > rate_aff - 0.05:
+            failures.append(
+                f"round-robin control hit rate {rate_rr:.3f} did "
+                f"not degrade below the affinity rate "
+                f"{rate_aff:.3f} — the control is not a control")
+
+        # -- leg 3: SIGKILL mid-stream, token-identical splice ------
+        prefix = [(2 + 3 * j) % 40 + 1 for j in range(PREFIX_LEN)]
+        prompts = [prefix + [41 + i, 43] for i in range(6)]
+        key = affinity_key(prompts[0], BLOCK,
+                           core.affinity_blocks)
+        status, _, _ = post_json(
+            router_url,
+            {"prompts": [prompts[0]], "max_new_tokens": 2})
+        if status != 200:
+            raise HarnessError(f"affinity probe HTTP {status}")
+        victim = core.affinity_snapshot().get(key.hex())
+        if victim not in urls:
+            raise HarnessError(
+                f"affinity probe did not pin the prefix "
+                f"(map: {core.affinity_snapshot()})")
+        ref_url = next(u for u in urls if u != victim)
+
+        references = []
+        for prompt in prompts:
+            status, _, body = post_json(
+                ref_url, {"prompts": [prompt],
+                          "max_new_tokens": STREAM_NEW})
+            if status != 200:
+                raise HarnessError(
+                    f"reference generate HTTP {status}")
+            references.append(
+                [int(t) for t in body["sequences"][0][len(prompt):]])
+
+        results = [None] * len(prompts)
+        first_token = threading.Event()
+        threads = [threading.Thread(
+            target=stream_tokens,
+            args=(router_url, prompt, results, i, first_token),
+            daemon=True) for i, prompt in enumerate(prompts)]
+        failover_before = core.stats()["failover"]
+        for t in threads:
+            t.start()
+        if not first_token.wait(timeout=120):
+            raise HarnessError(
+                "no stream delivered a first token within 120s")
+        procs_by_url[victim].kill()
+        procs_by_url[victim].wait(timeout=30)
+        for t in threads:
+            t.join(timeout=300)
+
+        for i, (res, ref) in enumerate(zip(results, references)):
+            if res is None:
+                failures.append(f"stream {i} never finished")
+            elif res["error"]:
+                failures.append(
+                    f"stream {i} errored instead of splicing: "
+                    f"{res['error']}")
+            elif res["tokens"] != ref:
+                failures.append(
+                    f"stream {i} tokens diverged after failover: "
+                    f"got {res['tokens']} want {ref} — the replay "
+                    f"splice is not token-identical")
+        if core.stats()["failover"] <= failover_before:
+            failures.append(
+                "tpu_router_failover_total never moved — the kill "
+                "episode was not a failover")
+        status, _, body = http_get(router_url + "/metrics")
+        if status != 200 or b"tpu_router_failover_total" not in body:
+            failures.append(
+                "router /metrics does not expose "
+                "tpu_router_failover_total")
+
+        # -- leg 4: survivors quiesce with zero leaks ---------------
+        survivors = [u for u in urls if u != victim]
+        for url in survivors:
+            stats, idle = quiesce(url)
+            if not idle:
+                failures.append(
+                    f"survivor {url} never quiesced: "
+                    f"slots_active={stats['slots_active']} "
+                    f"queue_depth={stats['queue_depth']} "
+                    f"kv_blocks_free={stats['kv_blocks_free']}/"
+                    f"{stats['kv_blocks_total']} "
+                    f"kv_blocks_shared={stats['kv_blocks_shared']}")
+
+        # -- leg 5: empty steer set -> structured fleet-wide shed ---
+        for url in survivors:
+            os.kill(procs_by_url[url].pid, signal.SIGUSR1)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            view = core.view()
+            if not view.steer_set():
+                break
+            time.sleep(0.25)
+        else:
+            raise HarnessError(
+                "steer set never emptied after draining every "
+                "survivor")
+        status, headers, body = post_json(
+            router_url, {"prompts": [prompts[0]],
+                         "max_new_tokens": 2})
+        if status != 503:
+            failures.append(
+                f"router answered HTTP {status} with an empty "
+                f"steer set, want 503")
+        else:
+            retry = headers.get("Retry-After")
+            if retry is None or int(retry) < 1:
+                failures.append(
+                    f"router shed lacks a usable Retry-After "
+                    f"header: {retry!r}")
+        status, _, _ = http_get(router_url + "/readyz")
+        if status != 503:
+            failures.append(
+                f"router /readyz HTTP {status} with an empty steer "
+                f"set, want 503")
+
+        if "jax" in sys.modules:
+            raise HarnessError(
+                "the driver imported jax — the router stack must "
+                "stay jax-free")
+    except HarnessError as e:
+        _teardown(procs, servers, log)
+        print(f"[router-check] HARNESS ERROR: {e}", file=sys.stderr)
+        _dump_log(log_path)
+        return 2
+    except Exception as e:
+        _teardown(procs, servers, log)
+        print(f"[router-check] HARNESS ERROR: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        _dump_log(log_path)
+        return 2
+    else:
+        _teardown(procs, servers, log)
+
+    wall_s = time.monotonic() - t_start
+    summary = {
+        "engines": n_engines,
+        "trace_requests": n_keyed + n_free,
+        "goodput_scale": round(scale, 3),
+        "solo_rows": solo_rows,
+        "fleet_rows": fleet_rows,
+        "hit_rate_baseline": round(rate_base, 4),
+        "hit_rate_affinity": round(rate_aff, 4),
+        "hit_rate_round_robin": round(rate_rr, 4),
+        "wall_s": round(wall_s, 1),
+        "failures": len(failures),
+    }
+    print(json.dumps(summary))
+
+    if failures:
+        for f in failures:
+            print(f"[router-check] FAIL: {f}", file=sys.stderr)
+        return 1
+
+    if args.ledger:
+        err = perf_ledger.try_append(
+            args.ledger, "router_check",
+            {"router_goodput_scale": round(scale, 3),
+             "router_affinity_hit_rate": round(rate_aff, 4)},
+            devices=[], platform="cpu",
+            config={"engines": n_engines, "kv_block": BLOCK,
+                    "trace_requests": n_keyed + n_free,
+                    "affinity_lookups": aff_lookups,
+                    "hit_rate_baseline": round(rate_base, 4),
+                    "hit_rate_round_robin": round(rate_rr, 4),
+                    "wall_s": round(wall_s, 1)})
+        if err:
+            print(f"[router-check] HARNESS ERROR: perf-ledger "
+                  f"append: {err}", file=sys.stderr)
+            return 2
+    print("[router-check] PASS: goodput scaled "
+          f"{summary['goodput_scale']}x across {n_engines} engines, "
+          f"affinity held the prefix hit rate "
+          f"({summary['hit_rate_affinity']} vs baseline "
+          f"{summary['hit_rate_baseline']}, round-robin "
+          f"{summary['hit_rate_round_robin']}), mid-stream SIGKILL "
+          "spliced token-identically, survivors leak-free, empty "
+          "steer set shed with Retry-After", file=sys.stderr)
+    return 0
+
+
+def _teardown(procs, servers, log):
+    while servers:
+        server, collector = servers.pop()
+        try:
+            server.stop()
+            collector.stop()
+        except Exception:
+            pass
+    for proc, _ in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    deadline = time.monotonic() + 15
+    for proc, _ in procs:
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=max(0.1,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+    log.close()
+
+
+def _dump_log(log_path):
+    try:
+        with open(log_path) as f:
+            tail = f.read()[-4000:]
+        if tail:
+            print("[router-check] worker log tail:\n" + tail,
+                  file=sys.stderr)
+    except OSError:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -240,6 +240,24 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
   2>> "${OUT}/tpu_suite.log" 9>&-
 sec_rc $? "fleet-check preflight"
 
+# Fleet-router preflight (CPU fake backend, ~2 min): real engine
+# servers behind the jax-free serving.router front door. Goodput
+# must scale >= 3.2x from 1 to 4 engines on a mixed Poisson trace
+# (row-work makespan over /stats deltas), prefix-affinity routing
+# must hold the fleet prefix_hit_rate at the single-engine baseline
+# while a round-robin control degrades, a mid-stream SIGKILL must
+# splice every greedy stream token-identically onto siblings,
+# survivors must quiesce leak-free, and draining the whole fleet
+# must shed 503 with a derived Retry-After. A regression here means
+# scale-out stopped scaling, steering stopped steering, or the
+# replay splice broke. Appends the scaling + affinity rows when the
+# gate passes.
+echo "[suite] router-check preflight" >&2
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python tools/router_check.py --ledger PERF_LEDGER.json \
+  2>> "${OUT}/tpu_suite.log" 9>&-
+sec_rc $? "router-check preflight"
+
 # Analysis preflight (CPU, ~3 min): zero lint findings on the tree
 # (with every seeded fixture violation firing), a clean lock-order
 # sanitizer pass over the engine/elastic/placement suites, and the

@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+
+# Copyright 2026 The container-engine-accelerators-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Fleet front door: router + collector over N engine servers.
+
+    # Front EXISTING engines (the production shape; jax never
+    # imported in this process):
+    python tools/serve_fleet.py --port 8600 \
+        http://engine-a:8500 http://engine-b:8500
+
+    # Or spawn a local demo fleet of N tiny-model engines (jax only
+    # in the worker subprocesses) and front those:
+    python tools/serve_fleet.py --port 8600 --spawn 4
+
+One process runs the jax-free pair the ROADMAP item-3 scale-out
+story is built from: an ``obs.fleet.FleetCollector`` polling the
+engines' /stats /metrics /readyz surfaces, and a
+``serving.router.RouterServer`` placing requests by prefix affinity
+with least-loaded fallback, tenant token-rate fairness, fleet-wide
+shedding with saturation-derived Retry-After, and mid-stream
+failover splicing (docs/serving.md "Fleet routing").
+
+Front-door surfaces: the engines' ``POST /v1/models/<m>:generate``
+contract (proxied), plus /healthz /readyz /stats /metrics
+/fleet/stats and the obs debug pages. Router knobs:
+``CEA_TPU_ROUTER_*`` (docs/operations.md); the affinity block size
+follows the engines' ``CEA_TPU_KV_BLOCK``.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from container_engine_accelerators_tpu import obs  # noqa: E402
+from container_engine_accelerators_tpu.obs.fleet import (  # noqa: E402
+    FleetCollector,
+)
+from container_engine_accelerators_tpu.serving.router import (  # noqa: E402
+    RouterCore,
+    RouterServer,
+)
+
+
+def worker_main(args):
+    """One demo engine in a subprocess (the only place jax loads)."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        if jax.config.jax_platforms != os.environ["JAX_PLATFORMS"]:
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import TransformerLM
+    from container_engine_accelerators_tpu.serving import (
+        GenerationServer,
+    )
+
+    model = TransformerLM(vocab_size=48, embed_dim=32, num_layers=2,
+                          num_heads=4, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = GenerationServer("lm", model, params, port=0,
+                           max_new_tokens=24, max_batch=4, warm=True)
+    srv.start()
+    signal.signal(signal.SIGUSR1, lambda *_: srv.begin_drain())
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(srv.port))
+    os.replace(tmp, args.port_file)
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+def spawn_workers(count, seed, tmpdir):
+    """N demo engines, ALL from one model seed: shared weights are
+    what makes cross-engine failover token-identical."""
+    procs = []
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               PYTHONPATH=REPO_ROOT)
+    for i in range(count):
+        port_file = os.path.join(tmpdir, f"engine{i}.port")
+        procs.append((subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--port-file", port_file, "--seed", str(seed)],
+            env=env), port_file))
+    urls = []
+    deadline = time.monotonic() + 600
+    for proc, port_file in procs:
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"engine worker exited rc {proc.returncode} "
+                    f"before serving")
+            if time.monotonic() > deadline:
+                raise RuntimeError("timed out warming engine fleet")
+            time.sleep(0.2)
+        with open(port_file) as f:
+            urls.append(f"http://127.0.0.1:{int(f.read().strip())}")
+    return [p for p, _ in procs], urls
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("urls", nargs="*", metavar="ENGINE_URL",
+                   help="existing engine base URLs to front")
+    p.add_argument("--spawn", type=int, default=0, metavar="N",
+                   help="spawn N local demo engines instead of "
+                        "fronting existing URLs")
+    p.add_argument("--port", type=int, default=8600,
+                   help="router listen port (0 = ephemeral; the "
+                        "chosen port is printed as JSON on stdout)")
+    p.add_argument("--poll-ms", type=float, default=None,
+                   help="collector poll interval (default "
+                        "CEA_TPU_FLEET_POLL_MS or 1000)")
+    p.add_argument("--model-seed", type=int, default=0,
+                   help="demo-fleet model seed (one seed for ALL "
+                        "engines — failover replay depends on "
+                        "shared weights)")
+    p.add_argument("--worker", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--port-file", default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--seed", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return worker_main(args)
+    if bool(args.urls) == bool(args.spawn):
+        p.error("give engine URLs or --spawn N (exactly one)")
+
+    obs.set_role("router")
+    procs, urls = [], args.urls
+    if args.spawn:
+        tmpdir = tempfile.mkdtemp(prefix="serve_fleet_")
+        procs, urls = spawn_workers(args.spawn, args.model_seed,
+                                    tmpdir)
+
+    collector = FleetCollector(urls, poll_ms=args.poll_ms)
+    core = RouterCore(collector)
+    server = RouterServer(core, collector, port=args.port)
+    collector.start()
+    server.start()
+    print(json.dumps({"port": server.port, "engines": urls,
+                      "poll_ms": collector.poll_ms}), flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    collector.stop()
+    for proc in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    for proc in procs:
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
