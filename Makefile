@@ -190,7 +190,13 @@ fleet-check:
 # control degrades, a mid-stream SIGKILL must splice every greedy
 # stream token-identically onto siblings, survivors must quiesce
 # leak-free, and an empty steer set must shed 503 with a derived
-# Retry-After. Pure CPU.
+# Retry-After. The journey leg rides the same chaos run: every
+# chaos request must carry exactly ONE trace id end-to-end (router
+# span, engine spans, both journey ledgers joined by request id —
+# splice included), its router buckets must sum to wall within 1%,
+# slo_report must name a nonzero bucket-named router tax, and the
+# mean splice-free tax lands in the perf ledger as
+# router_overhead_ms. Pure CPU.
 router-check:
 	JAX_PLATFORMS=cpu python3 tools/router_check.py
 

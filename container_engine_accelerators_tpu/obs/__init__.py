@@ -61,13 +61,17 @@ from .http import TRACE_PATH, VARZ_PATH, debug_response
 from .identity import identity, process_label, set_role
 from .profiler import PROFILE_PATH, profile_response
 from .propagate import (
+    REQUEST_ID_KEY,
     TRACEPARENT_KEY,
     context_from_metadata,
+    extract_headers,
     format_traceparent,
+    inject_headers,
     parse_traceparent,
 )
 from .reqledger import (
     ATTRIBUTION_BUCKETS,
+    ROUTER_BUCKETS,
     SATURATION_CAUSES,
     RequestLedger,
     RequestTimeline,
@@ -117,14 +121,15 @@ def enabled():
 __all__ = [
     "ATTRIBUTION_BUCKETS", "DEFAULT_BUCKETS", "FleetCollector",
     "FleetView", "FlopsLedger", "GoodputLedger", "Histogram",
-    "NULL_SPAN", "PROFILE_PATH", "RequestLedger", "RequestTimeline",
-    "SATURATION_CAUSES", "Span", "TRACEPARENT_KEY", "TRACER",
-    "TRACE_PATH", "Tracer", "VARZ_PATH", "context_from_metadata",
-    "counter", "debug_response", "dump_json", "enabled", "event",
+    "NULL_SPAN", "PROFILE_PATH", "REQUEST_ID_KEY", "RequestLedger",
+    "RequestTimeline", "ROUTER_BUCKETS", "SATURATION_CAUSES", "Span",
+    "TRACEPARENT_KEY", "TRACER", "TRACE_PATH", "Tracer", "VARZ_PATH",
+    "context_from_metadata", "counter", "debug_response", "dump_json",
+    "enabled", "event", "extract_headers",
     "flops_from_cost_analysis", "format_traceparent", "gauge",
     "get_tracer", "histogram", "histograms_from_text", "identity",
-    "merge_perfetto", "parse_traceparent", "peak_flops_per_chip",
-    "perfetto_trace", "process_label", "profile_response",
-    "prometheus_text", "report_from_snapshots", "saturation",
-    "set_role", "span", "varz", "write_journal",
+    "inject_headers", "merge_perfetto", "parse_traceparent",
+    "peak_flops_per_chip", "perfetto_trace", "process_label",
+    "profile_response", "prometheus_text", "report_from_snapshots",
+    "saturation", "set_role", "span", "varz", "write_journal",
 ]

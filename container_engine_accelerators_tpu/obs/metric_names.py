@@ -90,6 +90,11 @@ ROUTER_ROUTED = "tpu_router_routed_total"
 ROUTER_SHED = "tpu_router_shed_total"
 ROUTER_FAILOVER = "tpu_router_failover_total"
 ROUTER_AFFINITY_HIT_RATE = "tpu_router_affinity_hit_rate"
+ROUTER_LATENCY_ATTRIBUTION = (
+    "tpu_router_latency_attribution_seconds")
+ROUTER_E2E_LATENCY = "tpu_router_e2e_seconds"
+ROUTER_UPSTREAM_TTFB = "tpu_router_upstream_ttfb_seconds"
+ROUTER_SLO_VIOLATIONS = "tpu_router_slo_violations_total"
 
 # name -> one-line help. The authoritative set: the metric-registry
 # lint resolves every tpu_* literal in the tree against these keys
@@ -151,6 +156,14 @@ METRICS = {
     ROUTER_FAILOVER: "streams resumed on a sibling engine, by kind",
     ROUTER_AFFINITY_HIT_RATE:
         "fraction of keyed requests landing on their affinity engine",
+    ROUTER_LATENCY_ATTRIBUTION:
+        "per-request router-side latency by journey bucket",
+    ROUTER_E2E_LATENCY:
+        "router receipt to final byte, end to end per request",
+    ROUTER_UPSTREAM_TTFB:
+        "router placement to first upstream body line",
+    ROUTER_SLO_VIOLATIONS:
+        "router-measured end-to-end SLO burns per (slo, tenant)",
 }
 
 # tpu_-prefixed tokens that are NOT metric names (label keys, module

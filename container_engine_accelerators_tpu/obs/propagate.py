@@ -12,24 +12,40 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""W3C-``traceparent``-style context propagation over gRPC metadata.
+"""W3C-``traceparent``-style context propagation over gRPC metadata
+and HTTP headers.
 
 The wire format is the traceparent header shape
 (``00-<trace-id-hex32>-<span-id-hex16>-01``) carried in gRPC
-invocation metadata under the lowercase key ``traceparent``; ids map
-onto the tracer's integer trace/span ids (which are seeded with a
-per-process random base, so ids from different processes never
-collide in a merged timeline — see Tracer._new_id).
+invocation metadata under the lowercase key ``traceparent`` — and,
+for the HTTP serving path (router -> engine), in the request headers
+under the same name plus a ``x-cea-request-id`` companion so one
+request id survives every hop (including a mid-stream failover
+splice, where the resubmitted sibling request must bill to the
+ORIGINAL request, not mint a fresh identity). Ids map onto the
+tracer's integer trace/span ids (which are seeded with a per-process
+random base, so ids from different processes never collide in a
+merged timeline — see Tracer._new_id); foreign 128-bit trace ids
+from non-cea peers round-trip as plain hex.
 
 This module is wire-format only (stdlib, no grpc import): the client
 interceptor lives in ``grpc_client`` and the server extract path in
 ``grpc_interceptor`` so the plugin can import the server side without
-pulling client machinery and vice versa.
+pulling client machinery and vice versa. The HTTP carrier is used by
+``serving/router.py`` (inject on every upstream call) and
+``serving/server.py`` (extract into the ``serving.request`` root
+span).
 """
 
 import re
 
 TRACEPARENT_KEY = "traceparent"
+REQUEST_ID_KEY = "x-cea-request-id"
+
+# Request ids on the wire: short printable tokens only — anything
+# else is dropped (a hostile or corrupted header must not flow into
+# logs/ledgers verbatim), mirroring parse_traceparent's posture.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 # version 00, 16-byte trace id, 8-byte parent id, flags byte.
 _TRACEPARENT_RE = re.compile(
@@ -70,3 +86,66 @@ def context_from_metadata(metadata):
         if key == TRACEPARENT_KEY:
             return parse_traceparent(value)
     return None
+
+
+# -- the HTTP header carrier ------------------------------------------
+
+def inject_headers(context, request_id=None, headers=None):
+    """Stamp the carrier onto an HTTP header dict and return it.
+
+    ``context`` is a (trace_id, span_id) tuple (None injects no
+    traceparent — an untraced caller still carries its request id);
+    ``headers`` is mutated in place when given, else a fresh dict
+    comes back, so callers can fold the carrier into an existing
+    header set: ``inject_headers(ctx, rid, {"Content-Type": ...})``.
+    """
+    if headers is None:
+        headers = {}
+    if context is not None:
+        headers[TRACEPARENT_KEY] = format_traceparent(context)
+    if request_id:
+        headers[REQUEST_ID_KEY] = str(request_id)
+    return headers
+
+
+def _header_get(headers, key):
+    """Case-insensitive single-header lookup over whatever mapping
+    the HTTP stack hands us (email.message.Message is already
+    case-insensitive; a plain dict is not)."""
+    if headers is None:
+        return None
+    getter = getattr(headers, "get", None)
+    if getter is not None:
+        value = getter(key)
+        if value is not None:
+            return value
+    try:
+        items = headers.items()
+    except (AttributeError, TypeError):
+        return None
+    for k, v in items:
+        if isinstance(k, str) and k.lower() == key:
+            return v
+    return None
+
+
+def extract_headers(headers):
+    """(parent context or None, request id or None) from HTTP request
+    headers.
+
+    The W3C restart-the-trace posture end to end: a malformed or
+    absent ``traceparent`` yields None (the server opens a fresh root
+    span), never a raise; a malformed request id is dropped the same
+    way (the server mints its own). ``headers`` may be any mapping —
+    ``BaseHTTPRequestHandler.headers``, a plain dict, or None.
+    """
+    context = None
+    value = _header_get(headers, TRACEPARENT_KEY)
+    if value is not None:
+        context = parse_traceparent(str(value))
+    request_id = _header_get(headers, REQUEST_ID_KEY)
+    if request_id is not None:
+        request_id = str(request_id).strip()
+        if not _REQUEST_ID_RE.match(request_id):
+            request_id = None
+    return context, request_id

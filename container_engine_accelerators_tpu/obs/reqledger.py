@@ -83,6 +83,28 @@ ATTRIBUTION_BUCKETS = ("queue_wait", "block_wait", "prefill",
                        "rehydrate", "recovery", "decode_gap",
                        "stream_backpressure", "other")
 
+# The fleet router's bucket set (serving/router.py), same sum-to-wall
+# contract over the router's submit -> final-byte wall:
+#
+#   - ``router_queue`` — receipt through the placement decision
+#     (fleet-view fetch, affinity lookup, admission bookkeeping);
+#   - ``fairness_wait`` — parked on the tenant deficit counter inside
+#     the bounded fairness-wait budget instead of shedding 429;
+#   - ``shed_backoff`` — parked re-polling an unroutable fleet inside
+#     the bounded shed-backoff budget before giving up 503;
+#   - ``upstream_ttfb`` — placement through the FIRST upstream body
+#     line (connect + engine queue + prefill as the router sees it);
+#   - ``stream`` — relaying upstream body lines to the client;
+#   - ``splice_resubmit`` — a mid-stream failover: from the upstream
+#     failure through the sibling's first spliced line;
+#   - ``other`` — the unattributed remainder (shed replies, client
+#     disconnect residue), keeping the sum honest.
+#
+# tools/slo_report.py mirrors these names for its router-tax report.
+ROUTER_BUCKETS = ("router_queue", "fairness_wait", "shed_backoff",
+                  "upstream_ttfb", "stream", "splice_resubmit",
+                  "other")
+
 # The buckets that make up TTFT (submit -> first token); the rest is
 # the token-gap (TPOT) side. tools/slo_report.py ranks tails within
 # each group. ``recovery`` ranks on the gap side: the canonical
@@ -121,17 +143,27 @@ class RequestTimeline:
 
     Not thread-safe; the serving loop owns each instance (the same
     single-writer contract as the engine's pool state).
+
+    ``bucket_names`` swaps the partition's vocabulary (default the
+    engine's :data:`ATTRIBUTION_BUCKETS`; the router passes
+    :data:`ROUTER_BUCKETS`) — any tuple ending in the ``other``
+    residue bucket works, and the sum-to-wall contract is identical.
     """
 
     __slots__ = ("submit_unix", "submit_t", "buckets", "first_token_t",
-                 "finished", "_mark", "_clock")
+                 "finished", "_mark", "_clock", "_bucket_names")
 
-    def __init__(self, clock=time.perf_counter):
+    def __init__(self, clock=time.perf_counter,
+                 bucket_names=ATTRIBUTION_BUCKETS):
         self._clock = clock
+        self._bucket_names = tuple(bucket_names)
+        if "other" not in self._bucket_names:
+            raise ValueError(
+                "bucket_names needs an 'other' residue bucket")
         self.submit_unix = time.time()
         self.submit_t = clock()
         self._mark = self.submit_t
-        self.buckets = dict.fromkeys(ATTRIBUTION_BUCKETS, 0.0)
+        self.buckets = dict.fromkeys(self._bucket_names, 0.0)
         self.first_token_t = None
         self.finished = False
 
@@ -169,7 +201,7 @@ class RequestTimeline:
         self.finished = True
         wall = round(now - self.submit_t, 6)
         rounded = {b: round(self.buckets[b], 6)
-                   for b in ATTRIBUTION_BUCKETS if b != "other"}
+                   for b in self._bucket_names if b != "other"}
         # The exact partition sums to wall; push the rounding residue
         # into `other` so the serialized record sums exactly too
         # (clamped: a -0.000001 other would fail its own contract).
@@ -178,7 +210,7 @@ class RequestTimeline:
         record = {
             "submit_unix": round(self.submit_unix, 6),
             "wall_s": wall,
-            "buckets": {b: rounded[b] for b in ATTRIBUTION_BUCKETS},
+            "buckets": {b: rounded[b] for b in self._bucket_names},
             "outcome": str(outcome),
             "tokens": int(tokens),
             "stream": bool(stream),
@@ -201,23 +233,31 @@ class RequestLedger:
     contribute" — zeros included deliberately: a bucket that rarely
     fires shows a near-zero p50 and a tail-only p99, which is exactly
     the shape an SLO postmortem needs.
+
+    ``bucket_names``/``metric`` retarget the ledger at a different
+    attribution vocabulary and histogram family — the fleet router
+    runs one with :data:`ROUTER_BUCKETS` behind
+    ``tpu_router_latency_attribution_seconds``.
     """
 
-    def __init__(self, capacity=None, tracer=None):
+    def __init__(self, capacity=None, tracer=None,
+                 bucket_names=ATTRIBUTION_BUCKETS,
+                 metric=SERVING_LATENCY_ATTRIBUTION):
         if capacity is None:
             capacity = env_number(REQ_LEDGER_CAP_ENV,
                                   DEFAULT_REQ_LEDGER_CAP, parse=int)
         self.capacity = max(1, int(capacity))
+        self.bucket_names = tuple(bucket_names)
         self._lock = threading.Lock()
         self._ring = collections.deque(maxlen=self.capacity)
         self._retired = 0
         tracer = tracer or get_tracer()
         self._hists = {
             b: tracer.histogram(
-                SERVING_LATENCY_ATTRIBUTION,
+                metric,
                 "Per-request latency attributed to each bucket",
                 labels={"bucket": b})
-            for b in ATTRIBUTION_BUCKETS}
+            for b in self.bucket_names}
 
     def add(self, record):
         with self._lock:
@@ -245,7 +285,7 @@ class RequestLedger:
         ``latency_attribution`` payload (bucket-interpolated
         estimates, same method as the TTFT/TPOT percentiles)."""
         out = {}
-        for b in ATTRIBUTION_BUCKETS:
+        for b in self.bucket_names:
             hist = self._hists[b]
             _, total, count = hist.snapshot()
             p50 = hist.quantile(0.5)

@@ -53,6 +53,22 @@ the continuation into the live response. The client sees one
 uninterrupted token stream; ``tools/router_check.py`` audits the
 splice token-identical against an uninterrupted decode.
 
+**Request journeys** — every proxied request runs under ONE trace:
+the router extracts any inbound ``traceparent``/``x-cea-request-id``
+carrier (obs.propagate), opens a ``router.request`` root span, and
+injects the SAME context + request id on every upstream call —
+admission, stream, hedge, and the splice resubmit — so the engine's
+``serving.request`` span (and a failover sibling's) parents under
+the original trace. A router-side :class:`RouterLedger` (the PR 14
+reqledger discipline over ``obs.reqledger.ROUTER_BUCKETS``)
+partitions each request's receipt -> final-byte wall into
+``router_queue`` / ``fairness_wait`` / ``shed_backoff`` /
+``upstream_ttfb`` / ``stream`` / ``splice_resubmit`` / ``other``,
+per tenant, at ``/debug/requests`` (summarized in ``/fleet/stats``);
+``tools/slo_report.py`` turns the records into the router-tax
+report and ``tools/router_check.py`` gates the one-trace-id and
+sum-to-wall contracts through a SIGKILL chaos run.
+
 jax-free end to end (the ``# lint: jax-free`` marker holds it): the
 front door must keep routing while every backend is wedged.
 Token-id prompts only — text prompts need a tokenizer, which lives
@@ -60,8 +76,12 @@ with the model, not the router.
 
 Metrics: ``tpu_router_routed_total{reason}``,
 ``tpu_router_shed_total{reason}``, ``tpu_router_failover_total``,
-``tpu_router_affinity_hit_rate`` — docs/operations.md "Fleet
-routing" has the family; docs/serving.md the semantics.
+``tpu_router_affinity_hit_rate``, and the journey plane
+(``tpu_router_latency_attribution_seconds{bucket}``,
+``tpu_router_e2e_seconds``, ``tpu_router_upstream_ttfb_seconds``,
+``tpu_router_slo_violations_total{slo,tenant}``) —
+docs/operations.md "Fleet routing" has the family; docs/serving.md
+"Request journeys" the semantics.
 """
 
 import http.client
@@ -78,9 +98,13 @@ from .. import obs
 from ..obs.fleet import FleetView
 from ..obs.metric_names import (
     ROUTER_AFFINITY_HIT_RATE,
+    ROUTER_E2E_LATENCY,
     ROUTER_FAILOVER,
+    ROUTER_LATENCY_ATTRIBUTION,
     ROUTER_ROUTED,
     ROUTER_SHED,
+    ROUTER_SLO_VIOLATIONS,
+    ROUTER_UPSTREAM_TTFB,
 )
 from ..utils import env_number, env_str, get_logger
 from .affinity import affinity_key, default_block_size
@@ -96,8 +120,21 @@ TENANT_BURST_ENV = "CEA_TPU_ROUTER_TENANT_BURST_S"
 TENANT_WEIGHTS_ENV = "CEA_TPU_ROUTER_TENANT_WEIGHTS"
 FAILOVER_MAX_ENV = "CEA_TPU_ROUTER_FAILOVER_MAX"
 SPILL_BOUND_ENV = "CEA_TPU_ROUTER_SPILL_BOUND"
+FAIRNESS_WAIT_ENV = "CEA_TPU_ROUTER_FAIRNESS_WAIT_MS"
+SHED_BACKOFF_ENV = "CEA_TPU_ROUTER_SHED_BACKOFF_MS"
+SLO_TTFB_ENV = "CEA_TPU_ROUTER_SLO_TTFB_MS"
+SLO_E2E_ENV = "CEA_TPU_ROUTER_SLO_E2E_MS"
 
 DEFAULT_TENANT = "default"
+
+# Episode-wise shed/failover journaling (the PR 2 health-transition
+# discipline): the FIRST occurrence opens an episode and emits ONE
+# journal event; repeats within the clear window re-arm nothing; a
+# quiet gap of at least the window closes the episode so the next
+# occurrence journals again. A 1000-request shed storm is one line.
+TENANT_SHED_EVENT = "router.tenant_shed"
+ENGINE_FAILOVER_EVENT = "router.engine_failover"
+EPISODE_CLEAR_S = 5.0
 
 # Routing reasons (the routed_total label set).
 REASON_AFFINITY = "affinity"
@@ -196,8 +233,10 @@ class RouterCore:
     def __init__(self, collector, block_size=None, shed_sat=None,
                  affinity_blocks=None, affinity_cap=None,
                  tenants=None, failover_max=None, spill_bound=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, episode_clear_s=EPISODE_CLEAR_S):
         self._collector = collector
+        self._clock = clock
+        self.episode_clear_s = float(episode_clear_s)
         self.block_size = (int(block_size) if block_size
                            else default_block_size())
         self.shed_sat = (float(env_number(SHED_SAT_ENV, 0.95))
@@ -224,6 +263,7 @@ class RouterCore:
         self._aff_lookups = 0
         self._aff_hits = 0
         self._inflight = {}              # url -> requests in proxy
+        self._episodes = {}              # (event, key) -> last-seen ts
 
     # -- fleet view ---------------------------------------------------
 
@@ -333,24 +373,32 @@ class RouterCore:
             return best
         return None
 
-    def route(self, prompt_tokens, cost_tokens, tenant=None):
+    def route(self, prompt_tokens, cost_tokens, tenant=None,
+              record_shed=True):
         """One placement decision. Returns
         ``{"action": "route", "url", "reason", "key"}`` or
         ``{"action": "shed", "status", "reason", "retry_after"}``.
         Fairness sheds first (cheapest check), then fleet health,
-        then the affinity map."""
+        then the affinity map. ``record_shed=False`` returns the shed
+        decision WITHOUT counting it — the proxy's bounded
+        fairness/backoff waits probe repeatedly and must count one
+        shed per request, not one per probe."""
         admitted, wait = self.tenants.admit(tenant, cost_tokens)
         if not admitted:
-            return self._shed_decision(429, SHED_TENANT_RATE, wait)
+            return self._shed_decision(429, SHED_TENANT_RATE, wait,
+                                       tenant=tenant,
+                                       record=record_shed)
         view = self.view()
         steer = set(view.steer_set())
         if not steer:
             return self._shed_decision(503, SHED_NO_ENGINES,
-                                       self.retry_after(view))
+                                       self.retry_after(view),
+                                       record=record_shed)
         hot = self.hot_set(view)
         if hot >= steer:
             return self._shed_decision(503, SHED_SATURATED,
-                                       self.retry_after(view))
+                                       self.retry_after(view),
+                                       record=record_shed)
         key = affinity_key(prompt_tokens, self.block_size,
                            self.affinity_blocks)
         if key is None:
@@ -406,15 +454,35 @@ class RouterCore:
         if key is not None and url is not None:
             self._remember(key, url)
 
-    def note_failover(self, kind):
+    def note_failover(self, kind, engine=None):
         with self._lock:
             self._failover += 1
         obs.counter(ROUTER_FAILOVER, kind=kind)
+        if engine:
+            self._note_episode(ENGINE_FAILOVER_EVENT, engine,
+                               engine=engine, kind=kind)
 
-    def note_shed(self, reason):
+    def note_shed(self, reason, tenant=None):
         with self._lock:
             self._shed[reason] = self._shed.get(reason, 0) + 1
         obs.counter(ROUTER_SHED, reason=reason)
+        if reason == SHED_TENANT_RATE:
+            self._note_episode(TENANT_SHED_EVENT,
+                               tenant or DEFAULT_TENANT,
+                               tenant=tenant or DEFAULT_TENANT,
+                               reason=reason)
+
+    def _note_episode(self, event, key, **fields):
+        """One journal event per (event, key) episode, with
+        hysteresis: occurrences within ``episode_clear_s`` of the
+        last extend the open episode silently; a quiet gap closes it
+        so the next occurrence journals a fresh episode."""
+        now = self._clock()
+        with self._lock:
+            last = self._episodes.get((event, key))
+            self._episodes[(event, key)] = now
+        if last is None or now - last >= self.episode_clear_s:
+            obs.event(event, **fields)
 
     # -- internals ----------------------------------------------------
 
@@ -444,8 +512,10 @@ class RouterCore:
         return {"action": "route", "url": url, "reason": reason,
                 "key": key}
 
-    def _shed_decision(self, status, reason, retry_after):
-        self.note_shed(reason)
+    def _shed_decision(self, status, reason, retry_after,
+                       tenant=None, record=True):
+        if record:
+            self.note_shed(reason, tenant=tenant)
         return {"action": "shed", "status": status, "reason": reason,
                 "retry_after": int(retry_after)}
 
@@ -478,6 +548,118 @@ class RouterCore:
         return out
 
 
+class RouterLedger:
+    """The router-side request ledger: one retired journey record per
+    proxied request, PR 14's sum-to-wall discipline applied to the
+    front door's own wall (receipt -> final byte) over
+    :data:`~..obs.reqledger.ROUTER_BUCKETS`.
+
+    Wraps a :class:`~..obs.reqledger.RequestLedger` (ring +
+    ``tpu_router_latency_attribution_seconds{bucket}`` histograms)
+    and adds the router-only planes: end-to-end/TTFB histograms,
+    per-tenant rollups, and router-measured SLO burn
+    (``tpu_router_slo_violations_total{slo,tenant}``; thresholds
+    ``CEA_TPU_ROUTER_SLO_TTFB_MS`` / ``CEA_TPU_ROUTER_SLO_E2E_MS``,
+    0 disarms). jax-free like everything else on this path."""
+
+    def __init__(self, capacity=None, tracer=None,
+                 slo_ttfb_ms=None, slo_e2e_ms=None):
+        self._tracer = tracer or obs.get_tracer()
+        self._ledger = obs.RequestLedger(
+            capacity=capacity, tracer=self._tracer,
+            bucket_names=obs.ROUTER_BUCKETS,
+            metric=ROUTER_LATENCY_ATTRIBUTION)
+        self.slo_ttfb_ms = float(
+            env_number(SLO_TTFB_ENV, 0.0)
+            if slo_ttfb_ms is None else slo_ttfb_ms)
+        self.slo_e2e_ms = float(
+            env_number(SLO_E2E_ENV, 0.0)
+            if slo_e2e_ms is None else slo_e2e_ms)
+        self._e2e = self._tracer.histogram(
+            ROUTER_E2E_LATENCY,
+            "Router receipt to final byte, per request")
+        self._ttfb = self._tracer.histogram(
+            ROUTER_UPSTREAM_TTFB,
+            "Router placement to first upstream body line")
+        self._lock = threading.Lock()
+        # tenant -> {"requests", "wall_s", "violations": {slo: n}}
+        self._tenants = {}
+
+    def timeline(self):
+        return obs.RequestTimeline(bucket_names=obs.ROUTER_BUCKETS)
+
+    def retire(self, timeline, outcome, *, tenant, request_id,
+               trace_id, engine, reason, hops, tokens, stream,
+               prompt_len=None):
+        """Close one journey and record it. ``trace_id`` is the
+        router.request span's trace id (hex string or None when
+        tracing is off) — the join key the trace gate and the
+        router-tax report stitch router and engine records with."""
+        record = timeline.finish(outcome, tokens=tokens,
+                                 stream=stream, prompt_len=prompt_len)
+        tenant = tenant or DEFAULT_TENANT
+        record.update(request_id=request_id, tenant=tenant,
+                      trace_id=trace_id, engine=engine,
+                      reason=reason, hops=int(hops))
+        self._e2e.observe(record["wall_s"])
+        if record["ttft_s"] is not None:
+            self._ttfb.observe(record["ttft_s"])
+        burned = []
+        if (self.slo_ttfb_ms > 0 and record["ttft_s"] is not None
+                and record["ttft_s"] * 1e3 > self.slo_ttfb_ms):
+            burned.append("ttfb")
+        if self.slo_e2e_ms > 0 \
+                and record["wall_s"] * 1e3 > self.slo_e2e_ms:
+            burned.append("e2e")
+        for slo in burned:
+            self._tracer.counter(ROUTER_SLO_VIOLATIONS, slo=slo,
+                                 tenant=tenant)
+        with self._lock:
+            roll = self._tenants.setdefault(
+                tenant, {"requests": 0, "wall_s": 0.0,
+                         "violations": {}})
+            roll["requests"] += 1
+            roll["wall_s"] = round(
+                roll["wall_s"] + record["wall_s"], 6)
+            for slo in burned:
+                roll["violations"][slo] = \
+                    roll["violations"].get(slo, 0) + 1
+        self._ledger.add(record)
+        return record
+
+    def tenant_burn(self):
+        """Per-tenant rollup: request count, total wall, SLO burns."""
+        with self._lock:
+            return {t: {"requests": r["requests"],
+                        "wall_s": r["wall_s"],
+                        "violations": dict(r["violations"])}
+                    for t, r in self._tenants.items()}
+
+    def debug_payload(self, limit=None):
+        """The router ``/debug/requests`` body — same shape as the
+        engine's (capacity / retired_total / latency_attribution /
+        records) plus the per-tenant burn rollup."""
+        return {
+            "capacity": self._ledger.capacity,
+            "retired_total": self._ledger.retired_total(),
+            "latency_attribution":
+                self._ledger.attribution_stats(),
+            "tenants": self.tenant_burn(),
+            "records": self._ledger.records(limit),
+        }
+
+    def summary(self):
+        """The compact rollup ``/fleet/stats`` and ``/stats`` embed."""
+        return {
+            "retired_total": self._ledger.retired_total(),
+            "latency_attribution":
+                self._ledger.attribution_stats(),
+            "tenants": self.tenant_burn(),
+            "slo_ttfb_ms": self.slo_ttfb_ms or None,
+            "slo_e2e_ms": self.slo_e2e_ms or None,
+        }
+
+
 class _ClientGone(Exception):
     """The DOWNSTREAM client dropped mid-stream — nothing to splice
     for; must not be mistaken for an engine failure."""
@@ -505,12 +687,27 @@ class RouterServer:
     with sheds answered at the door and failed streams resumed on a
     sibling. Read surfaces: ``/healthz``, ``/readyz`` (503 +
     Retry-After while the fleet is unroutable), ``/stats``,
-    ``/metrics``, ``/fleet/stats``, and the obs debug pages."""
+    ``/metrics``, ``/fleet/stats``, ``/debug/requests`` (the journey
+    ledger), and the obs debug pages."""
 
-    def __init__(self, core, collector, port=0, timeout_s=150.0):
+    def __init__(self, core, collector, port=0, timeout_s=150.0,
+                 ledger=None, fairness_wait_ms=None,
+                 shed_backoff_ms=None):
         self._core = core
         self._collector = collector
         self._timeout_s = float(timeout_s)
+        self._ledger = ledger if ledger is not None else RouterLedger()
+        # Bounded waits (both default 0 = shed immediately, the
+        # pre-journey behavior): how long a request may park on a
+        # tenant-deficit 429 / an unroutable-fleet 503 before the
+        # shed goes out. Time parked lands in the fairness_wait /
+        # shed_backoff journey buckets.
+        self._fairness_wait_ms = float(
+            env_number(FAIRNESS_WAIT_ENV, 0.0)
+            if fairness_wait_ms is None else fairness_wait_ms)
+        self._shed_backoff_ms = float(
+            env_number(SHED_BACKOFF_ENV, 0.0)
+            if shed_backoff_ms is None else shed_backoff_ms)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -561,10 +758,24 @@ class RouterServer:
                 elif path == "/readyz":
                     outer._readyz(self)
                 elif path == "/stats":
-                    self._send(200, outer._core.stats())
+                    self._send(200, dict(
+                        outer._core.stats(),
+                        requests=outer._ledger.summary()))
                 elif path == "/fleet/stats":
                     view = outer._core.view()
-                    self._send(200, view.to_dict())
+                    self._send(200, dict(
+                        view.to_dict(),
+                        router=outer._ledger.summary()))
+                elif path == "/debug/requests":
+                    params = urllib.parse.parse_qs(query)
+                    limit = None
+                    if params.get("limit"):
+                        try:
+                            limit = int(params["limit"][0])
+                        except ValueError:
+                            limit = None
+                    self._send(200,
+                               outer._ledger.debug_payload(limit))
                 else:
                     self._send(404, {"error": "not found"})
 
@@ -619,7 +830,8 @@ class RouterServer:
     # -- the proxy path ----------------------------------------------
 
     def _proxy(self, handler, payload):
-        rid = uuid.uuid4().hex[:12]
+        parent_ctx, rid = obs.extract_headers(handler.headers)
+        rid = rid or uuid.uuid4().hex[:12]
         tenant = payload.pop("tenant", None) \
             or handler.headers.get("X-Tenant")
         if "text" in payload:
@@ -635,9 +847,25 @@ class RouterServer:
                 "error": "prompts must be a non-empty list of "
                          "token-id lists", "request_id": rid})
             return
+        # ONE trace per journey: the router.request root span adopts
+        # any inbound carrier as parent, and its context + request id
+        # ride every upstream call — including the splice resubmit —
+        # so the whole router->engine(s) path shares a trace id.
+        timeline = self._ledger.timeline()
+        with obs.span("router.request", parent=parent_ctx,
+                      path=handler.path, request_id=rid,
+                      tenant=tenant or DEFAULT_TENANT) as sp:
+            ctx = sp.context() if sp else None
+            trace_id = ("%x" % ctx[0]) if ctx else None
+            self._proxy_journey(handler, payload, prompts, tenant,
+                                rid, ctx, trace_id, timeline)
+
+    def _proxy_journey(self, handler, payload, prompts, tenant, rid,
+                       ctx, trace_id, timeline):
         max_new = int(payload.get("max_new_tokens", 0) or 0)
         cost = sum(len(p) for p in prompts) + max_new * len(prompts)
-        decision = self._core.route(prompts[0], cost, tenant)
+        decision = self._route_with_waits(prompts[0], cost, tenant,
+                                          timeline)
         if decision["action"] == "shed":
             handler._send(
                 decision["status"],
@@ -646,34 +874,85 @@ class RouterServer:
                  "request_id": rid},
                 headers={"Retry-After":
                          str(decision["retry_after"])})
+            self._ledger.retire(
+                timeline, "shed_" + decision["reason"],
+                tenant=tenant, request_id=rid, trace_id=trace_id,
+                engine=None, reason=decision["reason"], hops=0,
+                tokens=0, stream=bool(payload.get("stream")),
+                prompt_len=len(prompts[0]))
             return
+        carrier = obs.inject_headers(ctx, request_id=rid)
         if payload.get("stream"):
-            self._proxy_stream(handler, payload, decision, rid)
+            self._proxy_stream(handler, payload, decision, rid,
+                               timeline, carrier, tenant, trace_id)
         else:
-            self._proxy_unary(handler, payload, decision, rid)
+            self._proxy_unary(handler, payload, decision, rid,
+                              timeline, carrier, tenant, trace_id)
 
-    def _post_upstream(self, url, path, payload):
+    def _route_with_waits(self, prompt, cost, tenant, timeline):
+        """One routing decision plus the bounded parking budgets: a
+        would-be shed re-probes inside its budget (fairness_wait for
+        tenant-rate 429s, shed_backoff for fleet 503s, both default
+        0 = shed immediately) before the shed actually goes out.
+        Probes never count sheds — the final decision counts exactly
+        once, so a parked-then-admitted request sheds nothing."""
+        decision = self._core.route(prompt, cost, tenant,
+                                    record_shed=False)
+        timeline.lap("router_queue")
+        if decision["action"] == "shed":
+            parked_429 = decision["status"] == 429
+            budget_s = (self._fairness_wait_ms if parked_429
+                        else self._shed_backoff_ms) / 1e3
+            if budget_s > 0:
+                deadline = time.monotonic() + budget_s
+                while decision["action"] == "shed" \
+                        and time.monotonic() < deadline:
+                    time.sleep(min(0.05, max(
+                        0.001, deadline - time.monotonic())))
+                    decision = self._core.route(
+                        prompt, cost, tenant, record_shed=False)
+                timeline.lap("fairness_wait" if parked_429
+                             else "shed_backoff")
+        if decision["action"] == "shed":
+            self._core.note_shed(decision["reason"], tenant=tenant)
+        return decision
+
+    def _post_upstream(self, url, path, payload, headers=None):
         """One upstream POST; returns the HTTPResponse (caller owns
-        the connection via resp) — connection errors raise OSError."""
+        the connection via resp) — connection errors raise OSError.
+        ``headers`` adds the trace carrier on top of Content-Type."""
         parsed = urllib.parse.urlsplit(url)
         conn = http.client.HTTPConnection(
             parsed.hostname, parsed.port, timeout=self._timeout_s)
         body = json.dumps(payload).encode()
-        conn.request("POST", path, body=body,
-                     headers={"Content-Type": "application/json"})
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request("POST", path, body=body, headers=hdrs)
         resp = conn.getresponse()
         resp._router_conn = conn   # keep the connection alive/owned
         return resp
 
-    def _proxy_unary(self, handler, payload, decision, rid):
+    def _proxy_unary(self, handler, payload, decision, rid,
+                     timeline, carrier, tenant, trace_id):
         tried = set()
         url, key = decision["url"], decision["key"]
         attempts_left = self._core.failover_max
+        hops = 0
+        prompt_len = len(payload["prompts"][0])
+
+        def retire(outcome, tokens=0):
+            self._ledger.retire(
+                timeline, outcome, tenant=tenant, request_id=rid,
+                trace_id=trace_id, engine=url,
+                reason=decision["reason"], hops=hops,
+                tokens=tokens, stream=False, prompt_len=prompt_len)
+
         while True:
             self._core.inflight_begin(url)
             try:
                 resp = self._post_upstream(url, handler.path,
-                                           payload)
+                                           payload, headers=carrier)
                 status = resp.status
                 body = resp.read()
                 resp._router_conn.close()
@@ -686,19 +965,31 @@ class RouterServer:
                 sib = (self._core.sibling(tried)
                        if attempts_left > 0 else None)
                 if sib is None:
-                    self._core.note_shed(SHED_FAILOVER_EXHAUSTED)
+                    self._core.note_shed(SHED_FAILOVER_EXHAUSTED,
+                                         tenant=tenant)
                     handler._send(
                         503,
                         {"error": f"no sibling after failure: {e}",
                          "retry_after_s": 1, "request_id": rid},
                         headers={"Retry-After": "1"})
+                    timeline.lap("upstream_ttfb" if hops == 0
+                                 else "splice_resubmit")
+                    retire("failover_exhausted")
                     return
                 attempts_left -= 1
-                self._core.note_failover("request")
+                self._core.note_failover("request", engine=url)
                 self._core.repoint(key, sib)
                 url = sib
+                hops += 1
                 continue
             self._core.inflight_end(url)
+            # The whole accepted attempt — headers through body —
+            # bills as time-to-first-byte (there is no stream side
+            # to a unary reply); a failed first attempt's time rides
+            # into the sibling's splice_resubmit lap.
+            timeline.note_first_token()
+            timeline.lap("upstream_ttfb" if hops == 0
+                         else "splice_resubmit")
             headers = {}
             # Engine sheds carry their own saturation-derived hint;
             # relay it untouched.
@@ -706,6 +997,18 @@ class RouterServer:
             if retry:
                 headers["Retry-After"] = retry
             self._raw_reply(handler, status, body, headers)
+            timeline.lap("stream")
+            tokens = 0
+            if status == 200:
+                try:
+                    reply = json.loads(body)
+                    tokens = sum(
+                        len(t) for t in reply.get("tokens", [])
+                        if isinstance(t, list))
+                except (ValueError, AttributeError):
+                    tokens = 0
+            retire("completed" if status == 200
+                   else f"upstream_{status}", tokens=tokens)
             return
 
     def _raw_reply(self, handler, status, body, headers):
@@ -720,16 +1023,36 @@ class RouterServer:
         except OSError:
             pass
 
-    def _proxy_stream(self, handler, payload, decision, rid):
+    def _proxy_stream(self, handler, payload, decision, rid,
+                      timeline, carrier, tenant, trace_id):
         """Stream with splice-on-failure. The ndjson headers go out
         lazily — before the first upstream line arrives, a total
-        failure can still answer with a clean 503."""
+        failure can still answer with a clean 503. The SAME carrier
+        (trace context + request id) rides every hop, splices
+        included: the sibling's engine-side span parents under the
+        original trace instead of minting a new journey."""
         prompt = list(payload["prompts"][0])
         max_new = int(payload.get("max_new_tokens", 0) or 0)
         url, key = decision["url"], decision["key"]
         tried = set()
         delivered = []       # tokens already written to the client
         headers_sent = [False]
+        hops = [0]
+        # The hop's pending attribution: time up to a hop's first
+        # body line bills to upstream_ttfb (hop 0) or splice_resubmit
+        # (a failover sibling); once lines flow, to ``stream``.
+        state = {"await": "upstream_ttfb"}
+
+        def lap_pending():
+            timeline.lap(state.pop("await", None) or "stream")
+
+        def retire(outcome):
+            self._ledger.retire(
+                timeline, outcome, tenant=tenant, request_id=rid,
+                trace_id=trace_id, engine=url,
+                reason=decision["reason"], hops=hops[0],
+                tokens=len(delivered), stream=True,
+                prompt_len=len(prompt))
 
         def send_line(line):
             try:
@@ -751,19 +1074,30 @@ class RouterServer:
             try:
                 self._relay_stream(url, handler.path,
                                    upstream_payload, delivered,
-                                   send_line)
+                                   send_line, timeline, state,
+                                   carrier)
+                retire("completed")
                 return   # clean {"done": true} reached the client
             except _ClientGone:
+                lap_pending()
+                retire("client_gone")
                 return   # nobody left to splice for
             except _FatalUpstream as e:
+                lap_pending()
                 envelope = dict(e.envelope, request_id=rid)
                 if headers_sent[0]:
                     self._try_line(send_line, envelope)
                 else:
                     handler._send(502, envelope)
+                retire("error")
                 return
             except (OSError, http.client.HTTPException,
                     _RetryableUpstream) as e:
+                # Bill the failed hop, then open the splice window:
+                # everything until the sibling's first line is
+                # splice_resubmit time.
+                lap_pending()
+                state["await"] = "splice_resubmit"
                 tried.add(url)
                 sib = (self._core.sibling(tried)
                        if attempts_left > 0 else None)
@@ -773,9 +1107,12 @@ class RouterServer:
                     # Everything owed was already delivered before
                     # the engine died — the splice is a bare close.
                     self._try_line(send_line, {"done": True})
+                    lap_pending()
+                    retire("completed")
                     return
                 if sib is None:
-                    self._core.note_shed(SHED_FAILOVER_EXHAUSTED)
+                    self._core.note_shed(SHED_FAILOVER_EXHAUSTED,
+                                         tenant=tenant)
                     envelope = {"error": f"stream failover "
                                          f"exhausted: {e}",
                                 "retryable": True,
@@ -786,10 +1123,13 @@ class RouterServer:
                         handler._send(
                             503, envelope,
                             headers={"Retry-After": "1"})
+                    lap_pending()
+                    retire("failover_exhausted")
                     return
                 attempts_left -= 1
-                self._core.note_failover("stream")
+                self._core.note_failover("stream", engine=url)
                 self._core.repoint(key, sib)
+                hops[0] += 1
                 log.info("stream %s: splicing onto %s after %d "
                          "delivered tokens (%s)", rid, sib,
                          len(delivered), e)
@@ -815,7 +1155,7 @@ class RouterServer:
             pass   # client went away mid-splice
 
     def _relay_stream(self, url, path, payload, delivered,
-                      send_line):
+                      send_line, timeline, state, carrier):
         """Forward one upstream ndjson stream, accounting every
         token line into ``delivered``. Raises _RetryableUpstream on
         anything the replay contract covers (transport death,
@@ -824,13 +1164,15 @@ class RouterServer:
         self._core.inflight_begin(url)
         try:
             self._relay_stream_inner(url, path, payload, delivered,
-                                     send_line)
+                                     send_line, timeline, state,
+                                     carrier)
         finally:
             self._core.inflight_end(url)
 
     def _relay_stream_inner(self, url, path, payload, delivered,
-                            send_line):
-        resp = self._post_upstream(url, path, payload)
+                            send_line, timeline, state, carrier):
+        resp = self._post_upstream(url, path, payload,
+                                   headers=carrier)
         conn = resp._router_conn
         try:
             if resp.status == 503:
@@ -853,6 +1195,10 @@ class RouterServer:
                 raw = raw.strip()
                 if not raw:
                     continue
+                # First body line of the hop closes its ttfb/splice
+                # window; relaying time is ``stream`` from here on.
+                if state.get("await"):
+                    timeline.lap(state.pop("await"))
                 try:
                     line = json.loads(raw)
                 except ValueError:
@@ -860,9 +1206,11 @@ class RouterServer:
                         f"undecodable stream line from {url}")
                 if "tokens" in line:
                     delivered.extend(line["tokens"])
+                    timeline.note_first_token()
                     send_line(line)
                 elif line.get("done"):
                     send_line(line)
+                    timeline.lap("stream")
                     return
                 elif "error" in line:
                     if line.get("retryable"):

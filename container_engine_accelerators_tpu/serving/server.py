@@ -786,10 +786,18 @@ class _EngineService:
             outcome = ("completed" if error is None
                        else "cancelled" if error == "cancelled"
                        else "error")
-            self._req_ledger.add(work.timeline.finish(
+            record = work.timeline.finish(
                 outcome, tokens=len(work.tokens),
                 stream=work.stream_q is not None,
-                prompt_len=work.p_len))
+                prompt_len=work.p_len)
+            # The journey join keys: the router stitches its own
+            # /debug/requests records to these by request_id (the
+            # router-tax report) and the trace gate asserts one
+            # trace id end to end, splices included.
+            record["request_id"] = work.request_id
+            if work.ctx:
+                record["trace_id"] = "%x" % work.ctx[0]
+            self._req_ledger.add(record)
         with self._lock:
             self._retired += 1
             self._inflight -= 1
@@ -1534,13 +1542,21 @@ class _BaseServer:
                 # The request's root span: every phase below —
                 # admission, the batcher's device work (parented
                 # across threads), stream chunks — nests under it.
-                with obs.span("serving.request",
+                # A router upstream carries its trace context and
+                # request id in the headers (obs.propagate's HTTP
+                # carrier); extracting both here is what makes one
+                # trace id span router -> engine -> retirement —
+                # across a mid-stream failover splice too, since the
+                # resubmitted sibling request arrives with the
+                # ORIGINAL carrier.
+                parent_ctx, rid = obs.extract_headers(self.headers)
+                with obs.span("serving.request", parent=parent_ctx,
                               path=self.path) as req_span:
-                    self._serve_post(req_span)
+                    self._serve_post(req_span, rid)
 
-            def _serve_post(self, req_span):
+            def _serve_post(self, req_span, rid=None):
                 t0 = time.perf_counter()
-                rid = uuid.uuid4().hex[:12]
+                rid = rid or uuid.uuid4().hex[:12]
                 req_span.set(request_id=rid)
                 try:
                     length = int(self.headers.get("Content-Length",

@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
+from container_engine_accelerators_tpu import obs
 from container_engine_accelerators_tpu.obs.fleet import (
     FleetCollector,
 )
@@ -291,6 +292,7 @@ class ScriptedEngine:
     def __init__(self):
         self.plan = []
         self.requests = []       # payloads this engine received
+        self.headers = []        # header dicts, parallel to requests
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -333,6 +335,8 @@ class ScriptedEngine:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length))
                 outer.requests.append(payload)
+                outer.headers.append(
+                    {k.lower(): v for k, v in self.headers.items()})
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
@@ -364,11 +368,12 @@ class ScriptedEngine:
         self.httpd.server_close()
 
 
-def _stream_through_router(port, payload):
+def _stream_through_router(port, payload, headers=None):
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
     conn.request("POST", "/v1/models/lm:generate",
                  body=json.dumps(payload).encode(),
-                 headers={"Content-Type": "application/json"})
+                 headers=dict({"Content-Type": "application/json"},
+                              **(headers or {})))
     resp = conn.getresponse()
     lines = []
     while True:
@@ -477,6 +482,120 @@ def test_unary_failover_retries_on_sibling(scripted_pair):
     conn.close()
     assert len(second.requests) == 1
     assert core.stats()["failover"] == 1
+
+
+# ---------------------------------------------------------------------------
+# request journeys: trace propagation + latency attribution
+# ---------------------------------------------------------------------------
+
+
+def _router_debug_requests(port):
+    import urllib.request
+
+    return json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/requests", timeout=10))
+
+
+def _event_count(name):
+    return sum(1 for e in obs.TRACER.snapshot()["events"]
+               if e.get("name") == name)
+
+
+def test_splice_preserves_trace_and_request_id(scripted_pair):
+    """One journey, one identity: the inbound carrier's trace id and
+    request id ride BOTH hops of a mid-stream failover splice, the
+    spliced stream stays token-identical, and the router's journey
+    record attributes the whole wall to named buckets including the
+    splice."""
+    first, second, core, server = scripted_pair
+    first.plan = [("tokens", [10]), ("tokens", [11]), "die"]
+    second.plan = [("tokens", [12]), ("tokens", [13]), "done"]
+    inbound_ctx = (0xfeedface12345678, 0xabcdef01)
+    failovers_before = _event_count("router.engine_failover")
+    status, lines = _stream_through_router(
+        server.port,
+        {"prompts": [UNKEYED], "max_new_tokens": 4, "stream": True},
+        headers=obs.inject_headers(inbound_ctx,
+                                   request_id="jrny-01"))
+    assert status == 200
+    assert lines == [{"tokens": [10]}, {"tokens": [11]},
+                     {"tokens": [12]}, {"tokens": [13]},
+                     {"done": True}]
+    # Both hops carried ONE carrier: same trace id (the inbound
+    # caller's), same request id — the sibling resubmit bills to the
+    # original request, not a fresh identity.
+    (h1,), (h2,) = first.headers, second.headers
+    for h in (h1, h2):
+        assert h["x-cea-request-id"] == "jrny-01"
+        ctx = obs.parse_traceparent(h["traceparent"])
+        assert ctx is not None and ctx[0] == inbound_ctx[0]
+    # The journey record: adopted identity, a splice hop, and
+    # buckets that partition the wall.
+    (rec,) = _router_debug_requests(server.port)["records"]
+    assert rec["request_id"] == "jrny-01"
+    assert rec["trace_id"] == "%x" % inbound_ctx[0]
+    assert rec["outcome"] == "completed"
+    assert rec["engine"] == second.url     # where the stream ended
+    assert rec["hops"] == 1
+    assert rec["tokens"] == 4
+    buckets = rec["buckets"]
+    assert buckets["splice_resubmit"] > 0
+    assert buckets["upstream_ttfb"] > 0
+    assert sum(buckets.values()) == pytest.approx(
+        rec["wall_s"], rel=0.01, abs=1e-4)
+    # The dead engine opened exactly one failover episode.
+    assert _event_count("router.engine_failover") \
+        == failovers_before + 1
+
+
+def test_shed_journey_retires_with_cause(scripted_pair):
+    """A shed is still a journey: the 429 retires a ledger record
+    with the shed outcome, zero hops, and the adopted request id."""
+    first, second, core, server = scripted_pair
+    core.tenants = TenantLedger(rate=0.001, burst_s=1.0)
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=30)
+    conn.request("POST", "/v1/models/lm:generate",
+                 body=json.dumps({"prompts": [UNKEYED],
+                                  "max_new_tokens": 4,
+                                  "tenant": "acme"}).encode(),
+                 headers={"x-cea-request-id": "shed-01"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 429
+    assert body["request_id"] == "shed-01"
+    recs = _router_debug_requests(server.port)["records"]
+    (rec,) = [r for r in recs if r["request_id"] == "shed-01"]
+    assert rec["outcome"] == "shed_tenant_rate"
+    assert rec["hops"] == 0 and rec["engine"] is None
+    assert rec["tenant"] == "acme"
+    payload = _router_debug_requests(server.port)
+    assert payload["tenants"]["acme"]["requests"] == 1
+
+
+def test_tenant_shed_episode_hysteresis():
+    """Episode-wise journaling: a burst of tenant sheds emits ONE
+    router.tenant_shed event; a quiet gap past episode_clear_s
+    re-arms it; distinct tenants are independent episodes."""
+    t = [0.0]
+    _, core = make_core(
+        FakeFleet(), tenants=TenantLedger(rate=1.0, burst_s=1.0),
+        clock=lambda: t[0], episode_clear_s=5.0)
+    before = _event_count("router.tenant_shed")
+    for _ in range(3):      # rapid burst: one open episode
+        d = core.route(UNKEYED, 100, tenant="acme")
+        assert d["action"] == "shed" \
+            and d["reason"] == SHED_TENANT_RATE
+        t[0] += 1.0
+    assert _event_count("router.tenant_shed") == before + 1
+    t[0] += 10.0            # quiet gap closes the episode
+    core.route(UNKEYED, 100, tenant="acme")
+    assert _event_count("router.tenant_shed") == before + 2
+    core.route(UNKEYED, 100, tenant="zeta")  # independent key
+    assert _event_count("router.tenant_shed") == before + 3
+    # The per-request shed counter saw every one of them.
+    assert core.stats()["shed"] == {SHED_TENANT_RATE: 5}
 
 
 # ---------------------------------------------------------------------------

@@ -64,6 +64,72 @@ def test_traceparent_rejects_malformed():
     assert obs.context_from_metadata(None) is None
 
 
+# -- the HTTP header carrier ------------------------------------------
+
+def test_http_carrier_round_trip():
+    ctx = (0xdeadbeefcafef00d, 0x1234)
+    headers = obs.inject_headers(ctx, request_id="req-01.a")
+    assert headers == {
+        "traceparent": obs.format_traceparent(ctx),
+        "x-cea-request-id": "req-01.a",
+    }
+    assert obs.extract_headers(headers) == (ctx, "req-01.a")
+
+
+def test_http_carrier_folds_into_existing_headers():
+    base = {"Content-Type": "application/json"}
+    out = obs.inject_headers((1, 2), request_id="r", headers=base)
+    assert out is base  # mutated in place, not replaced
+    assert base["Content-Type"] == "application/json"
+    assert obs.extract_headers(base) == ((1, 2), "r")
+
+
+def test_http_carrier_untraced_caller_keeps_request_id():
+    # No context -> no traceparent key, but the request id still
+    # rides (the splice resubmit from an untraced router must bill
+    # to the original request).
+    headers = obs.inject_headers(None, request_id="abc")
+    assert "traceparent" not in headers
+    assert obs.extract_headers(headers) == (None, "abc")
+
+
+def test_http_extract_malformed_or_absent_is_fresh_root():
+    assert obs.extract_headers(None) == (None, None)
+    assert obs.extract_headers({}) == (None, None)
+    assert obs.extract_headers({"traceparent": "junk"}) \
+        == (None, None)
+    # Zero ids are invalid per spec; the server restarts the trace.
+    assert obs.extract_headers(
+        {"traceparent": "00-" + "0" * 32 + "-" + "1" * 16 + "-01"}
+    ) == (None, None)
+
+
+def test_http_extract_drops_hostile_request_id():
+    for bad in ("", " ", "x" * 65, "a b", "a\nb", "a;rm -rf"):
+        headers = {"x-cea-request-id": bad}
+        assert obs.extract_headers(headers) == (None, None), bad
+    # Surrounding whitespace is trimmed, not fatal.
+    assert obs.extract_headers({"x-cea-request-id": " ok "}) \
+        == (None, "ok")
+
+
+def test_http_extract_is_case_insensitive_on_plain_dicts():
+    ctx = (0xabc, 0xdef)
+    headers = {"Traceparent": obs.format_traceparent(ctx),
+               "X-CEA-Request-Id": "rid"}
+    assert obs.extract_headers(headers) == (ctx, "rid")
+
+
+def test_http_carrier_foreign_128bit_trace_id():
+    # A non-cea peer's full 128-bit trace id must round-trip as
+    # plain hex — never truncated to the local 64-bit id space.
+    foreign = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+    ctx, _ = obs.extract_headers({"traceparent": foreign})
+    assert ctx == (0x4bf92f3577b34da6a3ce929d0e0e4736,
+                   0x00f067aa0ba902b7)
+    assert obs.inject_headers(ctx)["traceparent"] == foreign
+
+
 def test_process_ids_are_collision_resistant():
     # Two tracers (stand-ins for two processes) must not mint
     # overlapping span ids — merged timelines rely on it.

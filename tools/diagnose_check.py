@@ -35,19 +35,27 @@ journal and fails unless the bundle carries:
     recovery events, the recovery counter from the varz leg, and the
     newest finished checkpoint's provenance from --checkpoint-dir
     (postmortems must show what the supervisor DID, not just what it
-    saw).
+    saw),
+  - the router section (--router-url against a live RouterServer
+    fronting a fake engine): a completed journey record with a trace
+    id and sum-to-wall buckets, shed journeys retired with their
+    cause, the per-tenant burn rollup, and exactly ONE
+    router.tenant_shed episode event for a burst of rapid sheds (the
+    hysteresis contract — episodes, not per-request spam).
 
 Pure CPU, no jax, a few seconds: cheap enough to run before every
 suite next to trace-check. Exit 0 = clean, 1 = check failed,
 2 = harness error.
 """
 
+import http.client
 import json
 import os
 import subprocess
 import sys
 import tempfile
 import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -141,6 +149,85 @@ _CHILD_JOURNAL_CODE = (
     "postmortem.capture('diagnose-check-seed')\n")
 
 
+class FakeEngine:
+    """The smallest HTTP surface the fleet collector and router
+    proxy need: poll endpoints plus a one-line token stream on POST
+    (the journey the router section must attribute end to end)."""
+
+    def __init__(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, body):
+                payload = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length",
+                                 str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                path = self.path.partition("?")[0]
+                if path == "/stats":
+                    self._json({
+                        "engine_id": f"fake@{outer.port}",
+                        "requests_retired": 0,
+                        "queue_depth": 0,
+                        "slo": {"violations": {}},
+                        "saturation": {"max": 0.0, "causes": {}},
+                    })
+                elif path == "/metrics":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                elif path in ("/readyz", "/healthz"):
+                    self._json({"status": "ok"})
+                elif path.startswith("/debug/requests"):
+                    self._json({"retired_total": 0, "records": []})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.end_headers()
+                self.wfile.write(b'{"tokens": [7, 8]}\n')
+                self.wfile.write(b'{"done": true}\n')
+                self.wfile.flush()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _router_post(port, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", "/v1/models/lm:generate",
+                 body=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    resp.read()
+    status = resp.status
+    conn.close()
+    return status
+
+
 def fake_node(root):
     dev = os.path.join(root, "dev")
     state = os.path.join(root, "state")
@@ -172,6 +259,7 @@ def main():
         return 2
     metrics = MetricServer(manager, backend, port=0)
     metrics.start()
+    fake_engine = router_srv = None
     try:
         socks = [f for f in os.listdir(plugin_dir)
                  if f.startswith("tpu-") and f.endswith(".sock")]
@@ -242,6 +330,39 @@ def main():
                   + child.stderr[-2000:], file=sys.stderr)
             return 2
 
+        # A live fake fleet behind the REAL RouterCore/RouterServer:
+        # one routed journey (streamed, completed) plus a burst of
+        # tenant-rate sheds — the bundle's router section must carry
+        # the attributed journeys AND exactly one shed episode. The
+        # deficit cap (rate*burst = 10 tokens) admits the first
+        # request's cost (3 prompt + 4 max_new = 7) and sheds the
+        # immediate repeats.
+        from container_engine_accelerators_tpu.obs.fleet import (
+            FleetCollector,
+        )
+        from container_engine_accelerators_tpu.serving.router import (
+            RouterCore, RouterServer, TenantLedger,
+        )
+        fake_engine = FakeEngine()
+        router_coll = FleetCollector([fake_engine.url],
+                                     poll_ms=10000.0)
+        router_core = RouterCore(
+            router_coll, shed_sat=2.0,
+            tenants=TenantLedger(rate=5.0, burst_s=2.0))
+        router_srv = RouterServer(router_core, router_coll, port=0,
+                                  timeout_s=10.0)
+        router_coll.poll_once()
+        router_srv.start()
+        req = {"prompts": [[1, 2, 3]], "max_new_tokens": 4,
+               "stream": True, "tenant": "acme"}
+        statuses = [_router_post(router_srv.port, dict(req))
+                    for _ in range(3)]
+        if statuses != [200, 429, 429]:
+            print(f"diagnose-check: fake-fleet drive expected "
+                  f"[200, 429, 429], got {statuses}",
+                  file=sys.stderr)
+            return 2
+
         bundle_path = os.path.join(root, "bundle.json")
         proc = subprocess.run(
             [sys.executable,
@@ -252,6 +373,7 @@ def main():
              "--dev-dir", dev, "--state-dir", state,
              "--checkpoint-dir", ckpt_dir,
              "--perf-ledger", ledger,
+             "--router-url", f"http://127.0.0.1:{router_srv.port}",
              "--out", bundle_path],
             capture_output=True, text=True, timeout=120,
             cwd=REPO_ROOT)
@@ -434,7 +556,47 @@ def main():
                 failures.append(
                     f"perf report lost the seeded "
                     f"sustained_rows_ratio series: {rigs!r}")
+        # Router section: the driven journeys must come back
+        # attributed (ledger records with trace ids, the shed with
+        # its cause, per-tenant burn) and the shed burst must have
+        # collapsed into ONE episode event.
+        router_sec = bundle.get("router") or {}
+        rleg = (router_sec.get("routers") or {}).get(
+            f"http://127.0.0.1:{router_srv.port}") or {}
+        records = (((rleg.get("requests") or {}).get("payload")
+                    or {}).get("records")) or []
+        completed = [r for r in records
+                     if r.get("outcome") == "completed"]
+        if not (completed and completed[0].get("trace_id")
+                and completed[0].get("engine")):
+            failures.append(
+                f"router section lost the completed journey "
+                f"(trace_id + engine): {records!r}")
+        if sum(1 for r in records
+               if r.get("outcome") == "shed_tenant_rate") != 2:
+            failures.append(
+                f"router section lost the tenant-rate sheds: "
+                f"{[r.get('outcome') for r in records]}")
+        burn = (rleg.get("tenant_burn") or {}).get("acme") or {}
+        if burn.get("requests") != 3:
+            failures.append(
+                f"per-tenant burn rollup missing/wrong for 'acme': "
+                f"{rleg.get('tenant_burn')!r}")
+        if (rleg.get("summary") or {}).get("retired_total") != 3:
+            failures.append(
+                f"router /stats ledger summary missing: "
+                f"{rleg.get('summary')!r}")
+        if router_sec.get("shed_episodes") != 1:
+            failures.append(
+                f"shed burst must collapse into ONE "
+                f"router.tenant_shed episode, saw "
+                f"{router_sec.get('shed_episodes')}: "
+                f"{router_sec.get('events')!r}")
     finally:
+        if router_srv is not None:
+            router_srv.stop()
+        if fake_engine is not None:
+            fake_engine.stop()
         metrics.stop()
         manager.stop()
         serve_thread.join(timeout=10)

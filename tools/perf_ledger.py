@@ -138,6 +138,10 @@ METRIC_DIRECTIONS = {
     # stopped scaling or prefix steering stopped steering.
     "router_goodput_scale": "up",
     "router_affinity_hit_rate": "up",
+    # router_check journey leg: mean per-request router-tax ms over
+    # splice-free journeys (placement + bookkeeping, engine time
+    # excluded) — a RISE means the front door itself got slower.
+    "router_overhead_ms": "down",
     "kv_block_utilization": "up",
     "kv_spill_hit_rate": "up",
     "batch_occupancy_avg": "up",

@@ -45,13 +45,27 @@ scale-out contracts end to end over real HTTP:
   4. **no leaks on survivors**: after the kill episode every
      surviving engine must quiesce to slots_active=0, queue_depth=0,
      kv_blocks_free=kv_blocks_total, kv_blocks_shared=0.
-  5. **fleet-wide shed**: draining every survivor (SIGUSR1) empties
+  4. **request journeys hold across the chaos**: every chaos stream
+     (sent with its own ``x-cea-request-id``) must retire exactly ONE
+     router journey record whose buckets sum to its wall within 1%,
+     carrying ONE trace id that the surviving engines' own
+     ``serving.request`` spans and ledger records share — the
+     SIGKILL-spliced sibling parents under the ORIGINAL trace, and a
+     spliced journey bills ``splice_resubmit`` time. slo_report's
+     router section must name a nonzero router tax over the same
+     records.
+  5. **no leaks on survivors**: after the kill episode every
+     surviving engine must quiesce to slots_active=0, queue_depth=0,
+     kv_blocks_free=kv_blocks_total, kv_blocks_shared=0.
+  6. **fleet-wide shed**: draining every survivor (SIGUSR1) empties
      the steer set; the router must answer new work 503 with a
      Retry-After derived from the engines' own recovery horizons,
      and its /readyz must go 503.
 
-``--ledger`` (the suite leg) appends ``router_goodput_scale`` ("up")
-and ``router_affinity_hit_rate`` ("up").
+``--ledger`` (the suite leg) appends ``router_goodput_scale`` ("up"),
+``router_affinity_hit_rate`` ("up"), and ``router_overhead_ms``
+("down": mean per-request router-tax milliseconds over splice-free
+journeys — placement plus bookkeeping, the cost of fronting).
 
 Internal: ``--worker --port-file P --seed S`` is the
 engine-subprocess entrypoint (the only place jax loads; the driver
@@ -372,15 +386,21 @@ def run_affinity_policy(urls_for, prompts):
 # ---------------------------------------------------------------------------
 
 
-def stream_tokens(router_url, prompt, results, idx, first_token):
+def stream_tokens(router_url, prompt, results, idx, first_token,
+                  rid=None):
     """One streaming request through the router; accumulates tokens
-    into results[idx] and flags the first delivered token."""
+    into results[idx] and flags the first delivered token. ``rid``
+    rides the ``x-cea-request-id`` carrier so the journey leg can
+    find this request's records by a name the harness chose."""
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["x-cea-request-id"] = rid
     req = urllib.request.Request(
         router_url + "/v1/models/lm:generate",
         data=json.dumps({"prompts": [prompt],
                          "max_new_tokens": STREAM_NEW,
                          "stream": True}).encode(),
-        headers={"Content-Type": "application/json"})
+        headers=headers)
     tokens, err = [], None
     try:
         with urllib.request.urlopen(req, timeout=300) as resp:
@@ -396,6 +416,147 @@ def stream_tokens(router_url, prompt, results, idx, first_token):
     except (OSError, ValueError) as e:
         err = f"{type(e).__name__}: {e}"
     results[idx] = {"tokens": tokens, "error": err}
+
+
+# ---------------------------------------------------------------------------
+# Leg 4: request journeys across the chaos run
+# ---------------------------------------------------------------------------
+
+ROUTER_TAX_BUCKETS = ("router_queue", "fairness_wait",
+                      "shed_backoff", "splice_resubmit", "other")
+
+
+def fetch_json(url):
+    status, _, body = http_get(url)
+    if status != 200:
+        raise HarnessError(f"{url} HTTP {status}")
+    return json.loads(body)
+
+
+def journey_leg(router_url, survivor_urls, chaos_rids, slo_report):
+    """The one-trace-id / sum-to-wall / router-tax contracts over
+    the chaos run. Returns (failures, router_overhead_ms): the
+    mean per-request router-tax milliseconds over splice-free
+    journeys (hops == 0 — placement and bookkeeping, not failover
+    recovery), the perf-ledger row."""
+    failures = []
+    chaos = set(chaos_rids)
+    payload = fetch_json(router_url + "/debug/requests")
+    records = payload.get("records") or []
+    by_rid = {}
+    for r in records:
+        by_rid.setdefault(r.get("request_id"), []).append(r)
+
+    spliced = 0
+    for rid in chaos_rids:
+        mine = by_rid.get(rid, [])
+        if len(mine) != 1:
+            failures.append(
+                f"{rid}: {len(mine)} router journey records, want "
+                f"exactly 1")
+            continue
+        rec = mine[0]
+        if not rec.get("trace_id"):
+            failures.append(f"{rid}: journey record has no trace_id")
+        total = sum(rec["buckets"].values())
+        err = abs(total - rec["wall_s"])
+        if err > max(0.01 * rec["wall_s"],
+                     slo_report.SUM_ABS_FLOOR_S):
+            failures.append(
+                f"{rid}: buckets sum {total:.6f}s vs wall "
+                f"{rec['wall_s']:.6f}s — past the 1% sum-to-wall "
+                f"contract")
+        if rec.get("hops", 0) >= 1:
+            spliced += 1
+            if (rec["buckets"].get("splice_resubmit") or 0) <= 0:
+                failures.append(
+                    f"{rid}: {rec['hops']} hop(s) but zero "
+                    f"splice_resubmit time")
+    if spliced < 1:
+        failures.append(
+            "no chaos journey records a splice (hops >= 1) — the "
+            "SIGKILL episode left no journey evidence")
+
+    # One trace id end to end: every surviving engine record with a
+    # chaos request id must carry the router journey's trace id (the
+    # spliced sibling inherits the ORIGINAL trace; the victim's
+    # records died with it, so survivors are the testable half).
+    joins = 0
+    for url in survivor_urls:
+        eng = fetch_json(url + "/debug/requests")
+        for r in eng.get("records") or []:
+            rid = r.get("request_id")
+            if rid not in chaos or rid not in by_rid:
+                continue
+            joins += 1
+            want = by_rid[rid][0].get("trace_id")
+            if r.get("trace_id") != want:
+                failures.append(
+                    f"{rid}: engine record trace_id "
+                    f"{r.get('trace_id')} != router journey {want} "
+                    f"— the splice re-rooted the trace")
+    if joins < 1:
+        failures.append(
+            "no surviving engine record joined a chaos request id — "
+            "the header carrier never reached the engines")
+
+    # The spans agree: the router's (in-process) journal and each
+    # survivor's /debug/trace put a chaos rid's request spans on ONE
+    # trace — the same join `trace_dump --merge` renders as a single
+    # Perfetto timeline. merge_perfetto must also accept the mix.
+    snapshots = [obs.TRACER.snapshot()]
+    for url in survivor_urls:
+        snapshots.append(fetch_json(url + "/debug/trace"))
+    obs.merge_perfetto(snapshots)
+    span_traces = {}     # rid -> set of trace ids (hex)
+    span_procs = {}      # rid -> number of snapshots carrying it
+    for snap in snapshots:
+        seen_here = set()
+        for span in snap.get("spans") or []:
+            rid = (span.get("attrs") or {}).get("request_id")
+            if rid in chaos and span.get("name") in (
+                    "router.request", "serving.request"):
+                span_traces.setdefault(rid, set()).add(
+                    "%x" % span["trace_id"])
+                seen_here.add(rid)
+        for rid in seen_here:
+            span_procs[rid] = span_procs.get(rid, 0) + 1
+    for rid, traces in sorted(span_traces.items()):
+        if len(traces) != 1:
+            failures.append(
+                f"{rid}: request spans carry {len(traces)} trace "
+                f"ids across processes ({sorted(traces)}), want 1")
+        elif rid in by_rid \
+                and by_rid[rid][0].get("trace_id") not in traces:
+            failures.append(
+                f"{rid}: span trace id disagrees with the journey "
+                f"record's {by_rid[rid][0].get('trace_id')}")
+    if not any(n >= 2 for n in span_procs.values()):
+        failures.append(
+            "no chaos request's spans appear in two or more "
+            "processes — the merged timeline cannot stitch the hop")
+
+    # slo_report's router section over the same records: the tax
+    # must be named and nonzero.
+    report = slo_report.analyze(records)
+    tax = ((report.get("router") or {}).get("tax") or {})
+    if not tax.get("total_s"):
+        failures.append(
+            f"slo_report names no nonzero router tax over "
+            f"{len(records)} journey records")
+    if (report.get("sum_to_wall") or {}).get("violations"):
+        failures.append(
+            f"slo_report sum-to-wall violations over the journey "
+            f"records: {report['sum_to_wall']['violations'][:3]}")
+
+    clean = [r for r in records if not r.get("hops")]
+    overhead_ms = None
+    if clean:
+        overhead_ms = round(
+            sum(sum((r["buckets"].get(b) or 0.0)
+                    for b in ROUTER_TAX_BUCKETS)
+                for r in clean) / len(clean) * 1e3, 3)
+    return failures, overhead_ms
 
 
 # ---------------------------------------------------------------------------
@@ -423,6 +584,7 @@ def main(argv=None):
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import perf_ledger
+    import slo_report
 
     # A wedged backend must surface as an explained skip row, not a
     # silent worker-warm-up hang.
@@ -573,9 +735,11 @@ def main(argv=None):
 
         results = [None] * len(prompts)
         first_token = threading.Event()
+        chaos_rids = [f"chaos{i:02d}" for i in range(len(prompts))]
         threads = [threading.Thread(
             target=stream_tokens,
-            args=(router_url, prompt, results, i, first_token),
+            args=(router_url, prompt, results, i, first_token,
+                  chaos_rids[i]),
             daemon=True) for i, prompt in enumerate(prompts)]
         failover_before = core.stats()["failover"]
         for t in threads:
@@ -610,8 +774,17 @@ def main(argv=None):
                 "router /metrics does not expose "
                 "tpu_router_failover_total")
 
-        # -- leg 4: survivors quiesce with zero leaks ---------------
+        # -- leg 4: request journeys across the chaos run -----------
         survivors = [u for u in urls if u != victim]
+        journey_failures, overhead_ms = journey_leg(
+            router_url, survivors, chaos_rids, slo_report)
+        failures.extend(journey_failures)
+        if overhead_ms is None:
+            failures.append(
+                "no splice-free journey records — the router "
+                "overhead metric has nothing to measure")
+
+        # -- leg 5: survivors quiesce with zero leaks ---------------
         for url in survivors:
             stats, idle = quiesce(url)
             if not idle:
@@ -623,7 +796,7 @@ def main(argv=None):
                     f"{stats['kv_blocks_total']} "
                     f"kv_blocks_shared={stats['kv_blocks_shared']}")
 
-        # -- leg 5: empty steer set -> structured fleet-wide shed ---
+        # -- leg 6: empty steer set -> structured fleet-wide shed ---
         for url in survivors:
             os.kill(procs_by_url[url].pid, signal.SIGUSR1)
         deadline = time.monotonic() + 30
@@ -683,6 +856,7 @@ def main(argv=None):
         "hit_rate_baseline": round(rate_base, 4),
         "hit_rate_affinity": round(rate_aff, 4),
         "hit_rate_round_robin": round(rate_rr, 4),
+        "router_overhead_ms": overhead_ms,
         "wall_s": round(wall_s, 1),
         "failures": len(failures),
     }
@@ -697,7 +871,8 @@ def main(argv=None):
         err = perf_ledger.try_append(
             args.ledger, "router_check",
             {"router_goodput_scale": round(scale, 3),
-             "router_affinity_hit_rate": round(rate_aff, 4)},
+             "router_affinity_hit_rate": round(rate_aff, 4),
+             "router_overhead_ms": overhead_ms},
             devices=[], platform="cpu",
             config={"engines": n_engines, "kv_block": BLOCK,
                     "trace_requests": n_keyed + n_free,
@@ -715,8 +890,10 @@ def main(argv=None):
           f"({summary['hit_rate_affinity']} vs baseline "
           f"{summary['hit_rate_baseline']}, round-robin "
           f"{summary['hit_rate_round_robin']}), mid-stream SIGKILL "
-          "spliced token-identically, survivors leak-free, empty "
-          "steer set shed with Retry-After", file=sys.stderr)
+          "spliced token-identically under ONE trace id "
+          f"(router tax {summary['router_overhead_ms']}ms/request), "
+          "survivors leak-free, empty steer set shed with "
+          "Retry-After", file=sys.stderr)
     return 0
 
 

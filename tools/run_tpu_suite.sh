@@ -248,10 +248,15 @@ sec_rc $? "fleet-check preflight"
 # while a round-robin control degrades, a mid-stream SIGKILL must
 # splice every greedy stream token-identically onto siblings,
 # survivors must quiesce leak-free, and draining the whole fleet
-# must shed 503 with a derived Retry-After. A regression here means
-# scale-out stopped scaling, steering stopped steering, or the
-# replay splice broke. Appends the scaling + affinity rows when the
-# gate passes.
+# must shed 503 with a derived Retry-After. The journey leg rides
+# the same chaos run: each chaos request keeps ONE trace id across
+# the splice (router + engine spans and both ledgers joined by
+# request id), router buckets sum to wall within 1%, and slo_report
+# names a nonzero router tax. A regression here means scale-out
+# stopped scaling, steering stopped steering, the replay splice
+# broke, or a journey lost its identity mid-failover. Appends the
+# scaling + affinity + router_overhead_ms rows when the gate
+# passes.
 echo "[suite] router-check preflight" >&2
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
   python tools/router_check.py --ledger PERF_LEDGER.json \

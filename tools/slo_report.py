@@ -33,10 +33,23 @@ decode_gap vs stream_backpressure, and a sum-to-wall audit (every
 record's buckets must sum to its wall time within ``--tolerance``,
 default 1% — the contract ``make slo-check`` gates end to end).
 
+Router journey records (the fleet router's ``/debug/requests``,
+distinguished by their ``router_queue`` bucket) get their own
+section: per-journey-bucket totals, the per-tenant rollup, and the
+**router tax** — the end-to-end seconds the router itself added on
+top of engine time, named bucket by bucket (router_queue +
+fairness_wait + shed_backoff + splice_resubmit + other; the
+upstream_ttfb/stream buckets are engine + relay time, not tax).
+When engine records ride along in the same inputs, journeys are
+joined to them by ``request_id`` for a measured e2e-minus-engine
+comparison. The sum-to-wall audit covers BOTH vocabularies — each
+record is checked against its own bucket keys.
+
 Usage:
   python tools/slo_report.py journal.json requests.json
   python tools/slo_report.py --url http://localhost:8500
   python tools/slo_report.py bundle.json --ttft-slo-ms 250
+  python tools/slo_report.py --url http://router:8600 engines.json
 """
 
 import argparse
@@ -53,6 +66,17 @@ ATTRIBUTION_BUCKETS = ("queue_wait", "block_wait", "prefill",
 TTFT_BUCKETS = ("queue_wait", "block_wait", "prefill", "rehydrate")
 GAP_BUCKETS = ("decode_gap", "stream_backpressure", "recovery")
 
+# The fleet router's journey vocabulary (obs.reqledger.ROUTER_BUCKETS
+# mirrored import-free, same as above).
+ROUTER_BUCKETS = ("router_queue", "fairness_wait", "shed_backoff",
+                  "upstream_ttfb", "stream", "splice_resubmit",
+                  "other")
+# The router-tax side of the partition: buckets the router itself
+# owns. upstream_ttfb and stream are engine + relay time — what the
+# request would (mostly) have cost without a router in front.
+ROUTER_TAX_BUCKETS = ("router_queue", "fairness_wait",
+                      "shed_backoff", "splice_resubmit", "other")
+
 DEFAULT_TOLERANCE = 0.01
 # Absolute floor under the relative sum-to-wall tolerance: records
 # round to microseconds, so a sub-millisecond request's legitimate
@@ -64,6 +88,13 @@ DEFAULT_TAIL_QUANTILE = 0.9
 def _is_record(obj):
     return (isinstance(obj, dict) and "buckets" in obj
             and "wall_s" in obj)
+
+
+def _is_router_record(record):
+    """Router journeys carry the router vocabulary; the
+    ``router_queue`` bucket is its fingerprint (engine records can
+    never hold it — the vocabularies are disjoint by construction)."""
+    return "router_queue" in (record.get("buckets") or {})
 
 
 def extract_records(payload):
@@ -135,10 +166,101 @@ def _rank_tail(tail, buckets):
             for b in sorted(means, key=means.get, reverse=True)]
 
 
+def _bucket_stats(records, bucket_names):
+    """{bucket: total/share/p50/p99} over ``records`` — one
+    vocabulary at a time."""
+    wall_total = sum(r["wall_s"] for r in records)
+    out = {}
+    for b in bucket_names:
+        vals = [(r["buckets"].get(b) or 0.0) for r in records]
+        total = sum(vals)
+        out[b] = {
+            "total_s": round(total, 6),
+            "share": (round(total / wall_total, 4) if wall_total
+                      else None),
+            "p50_ms": _ms(_percentile(vals, 0.5)),
+            "p99_ms": _ms(_percentile(vals, 0.99)),
+        }
+    return out
+
+
+def _router_report(journeys, engine_records):
+    """The router section: journey buckets, the bucket-named router
+    tax, the per-tenant rollup, and (when engine records share the
+    inputs) the request_id-joined e2e-minus-engine comparison."""
+    out = {"requests": len(journeys),
+           "buckets": _bucket_stats(journeys, ROUTER_BUCKETS)}
+    wall_total = sum(r["wall_s"] for r in journeys)
+
+    # The router tax, named bucket by bucket: seconds the router
+    # itself added on top of engine + relay time.
+    tax_buckets = {}
+    for b in ROUTER_TAX_BUCKETS:
+        total = sum((r["buckets"].get(b) or 0.0) for r in journeys)
+        tax_buckets[b] = {
+            "total_s": round(total, 6),
+            "share_of_wall": (round(total / wall_total, 4)
+                              if wall_total else None),
+        }
+    tax_total = sum(v["total_s"] for v in tax_buckets.values())
+    out["tax"] = {
+        "total_s": round(tax_total, 6),
+        "share_of_wall": (round(tax_total / wall_total, 4)
+                          if wall_total else None),
+        "mean_ms_per_request": _ms(tax_total / len(journeys)),
+        "buckets": tax_buckets,
+    }
+
+    tenants = {}
+    for r in journeys:
+        t = r.get("tenant") or "default"
+        roll = tenants.setdefault(
+            t, {"requests": 0, "wall_s": 0.0, "tax_s": 0.0,
+                "hops": 0})
+        roll["requests"] += 1
+        roll["wall_s"] = round(roll["wall_s"] + r["wall_s"], 6)
+        roll["tax_s"] = round(
+            roll["tax_s"] + sum((r["buckets"].get(b) or 0.0)
+                                for b in ROUTER_TAX_BUCKETS), 6)
+        roll["hops"] += int(r.get("hops") or 0)
+    out["tenants"] = tenants
+
+    # Measured (not inferred) tax: join each journey to the engine
+    # record(s) of the SAME request_id and subtract engine-attributed
+    # wall from the router's end-to-end wall. Splices show up as one
+    # journey joined to several engine records — sum them all.
+    by_rid = {}
+    for r in engine_records:
+        rid = r.get("request_id")
+        if rid:
+            by_rid.setdefault(rid, []).append(r)
+    joined, deltas = 0, []
+    for r in journeys:
+        mates = by_rid.get(r.get("request_id"))
+        if not mates:
+            continue
+        joined += 1
+        deltas.append(r["wall_s"]
+                      - sum(m["wall_s"] for m in mates))
+    if joined:
+        out["joined_engine"] = {
+            "journeys_joined": joined,
+            "e2e_minus_engine_ms": {
+                "p50": _ms(_percentile(deltas, 0.5)),
+                "p99": _ms(_percentile(deltas, 0.99)),
+                "mean": _ms(sum(deltas) / joined),
+            },
+        }
+    return out
+
+
 def analyze(records, ttft_slo_ms=None, tail_quantile=None,
             tolerance=DEFAULT_TOLERANCE):
     """The report body over retired records (the slo_check gate and
-    the diagnose bundle's ``requests`` section both call this)."""
+    the diagnose bundle's ``requests`` section both call this).
+    Engine records and router journeys may arrive mixed; each
+    vocabulary gets its own sections and the sum-to-wall audit
+    covers every record against its own bucket keys."""
     tail_quantile = (DEFAULT_TAIL_QUANTILE if tail_quantile is None
                      else tail_quantile)
     out = {"requests": len(records)}
@@ -150,24 +272,18 @@ def analyze(records, ttft_slo_ms=None, tail_quantile=None,
             outcomes.get(r.get("outcome", "?"), 0) + 1)
     out["outcomes"] = outcomes
 
-    wall_total = sum(r["wall_s"] for r in records)
-    buckets = {}
-    for b in ATTRIBUTION_BUCKETS:
-        vals = [(r["buckets"].get(b) or 0.0) for r in records]
-        total = sum(vals)
-        buckets[b] = {
-            "total_s": round(total, 6),
-            "share": (round(total / wall_total, 4) if wall_total
-                      else None),
-            "p50_ms": _ms(_percentile(vals, 0.5)),
-            "p99_ms": _ms(_percentile(vals, 0.99)),
-        }
-    out["buckets"] = buckets
+    journeys = [r for r in records if _is_router_record(r)]
+    records = [r for r in records if not _is_router_record(r)]
+    if journeys:
+        out["router"] = _router_report(journeys, records)
+    if records:
+        out["buckets"] = _bucket_stats(records, ATTRIBUTION_BUCKETS)
+    all_records = records + journeys
 
     # Sum-to-wall audit: the ledger's one structural invariant.
     violations = []
     max_rel = 0.0
-    for i, r in enumerate(records):
+    for i, r in enumerate(all_records):
         total = sum(r["buckets"].get(b) or 0.0
                     for b in r["buckets"])
         err = abs(total - r["wall_s"])
@@ -176,7 +292,7 @@ def analyze(records, ttft_slo_ms=None, tail_quantile=None,
         if err > max(tolerance * r["wall_s"], SUM_ABS_FLOOR_S):
             violations.append({"index": i, "wall_s": r["wall_s"],
                                "bucket_sum_s": round(total, 6)})
-    out["sum_to_wall"] = {"checked": len(records),
+    out["sum_to_wall"] = {"checked": len(all_records),
                           "violations": violations,
                           "max_rel_err": round(max_rel, 6)}
 

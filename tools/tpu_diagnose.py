@@ -55,6 +55,12 @@ incident:
     ``placement.repartition_proposed/applied`` event in timeline
     order (did the policy see the fragmentation, what did it
     propose, and was the drain gate honored);
+  - the front door's request journeys (``--router-url``): the fleet
+    router's live ledger summary, its last journey records (trace
+    ids, per-bucket wall attribution, splice hops), the per-tenant
+    SLO-burn rollup, and every episode-wise
+    ``router.tenant_shed``/``router.engine_failover`` event the
+    collected journals carry, in timeline order;
   - the node's performance history: the perf ledger
     (``--perf-ledger``, default the committed PERF_LEDGER.json)
     rendered through tools/perf_report.py — per-metric trend series
@@ -388,6 +394,57 @@ def fleet_section(snapshots, fleet_urls):
     }
 
 
+# Mirrors serving/router.py TENANT_SHED_EVENT / ENGINE_FAILOVER_EVENT
+# (string literals so this tool never imports the serving package).
+ROUTER_EVENTS = ("router.tenant_shed", "router.engine_failover")
+ROUTER_DEBUG_LIMIT = 50
+
+
+def router_section(snapshots, router_urls):
+    """The front door's side of the incident: per ``--router-url``
+    the live ledger summary (/stats requests rollup), the last N
+    journey records (/debug/requests — trace ids, per-bucket wall
+    attribution, splice hops), the /fleet/stats per-tenant SLO-burn
+    rollup, plus every episode-wise shed/failover event
+    (router.tenant_shed / router.engine_failover) from the collected
+    journals in timeline order — WHO got shed and WHICH engine the
+    router failed over from, without per-request event spam."""
+    events = []
+    for snap in snapshots:
+        ident = snap.get("identity") or {}
+        label = obs.process_label(ident) if ident else None
+        for ev in snap.get("events") or []:
+            if ev.get("name") in ROUTER_EVENTS:
+                events.append({"name": ev.get("name"),
+                               "unix": ev.get("unix"),
+                               "fields": ev.get("fields") or {},
+                               "process": label})
+    events.sort(key=lambda e: e.get("unix") or 0.0)
+    routers = {}
+    for url in router_urls:
+        base = url.rstrip("/")
+        stats = _fetch(base + "/stats")
+        requests = _fetch(
+            base + "/debug/requests?limit=%d" % ROUTER_DEBUG_LIMIT)
+        fleet = _fetch(base + FLEET_STATS_PATH)
+        leg = {"stats": stats, "requests": requests, "fleet": fleet}
+        if stats.get("ok"):
+            leg["summary"] = (stats["payload"] or {}).get("requests")
+        if fleet.get("ok"):
+            leg["tenant_burn"] = ((fleet["payload"] or {})
+                                  .get("router") or {}).get("tenants")
+        routers[base] = leg
+    return {
+        "events": events,
+        "shed_episodes": sum(1 for e in events
+                             if e["name"] == "router.tenant_shed"),
+        "failover_episodes": sum(
+            1 for e in events
+            if e["name"] == "router.engine_failover"),
+        "routers": routers,
+    }
+
+
 def requests_section(endpoints, journals):
     """Per-request latency attribution: every /debug/requests ring a
     live serving replica answered with, plus the ``serving_requests``
@@ -468,7 +525,7 @@ DEFAULT_PERF_LEDGER = os.path.join(
 
 def collect(urls, journal_paths, dev_dir, state_dir,
             checkpoint_dirs=(), perf_ledger_path=None,
-            fleet_urls=()):
+            fleet_urls=(), router_urls=()):
     endpoints = sweep_endpoints(urls)
     journals = load_journals(journal_paths)
 
@@ -517,6 +574,7 @@ def collect(urls, journal_paths, dev_dir, state_dir,
                                    checkpoint_dirs),
         "placement": placement_section(endpoints, snapshots),
         "fleet": fleet_section(snapshots, fleet_urls),
+        "router": router_section(snapshots, router_urls),
         "perf": perf_section(perf_ledger_path
                              or DEFAULT_PERF_LEDGER),
         "provenance": stamp(
@@ -552,6 +610,16 @@ def main(argv=None):
                         "/fleet/stats rollup to include in the "
                         "bundle's fleet section (the observer's "
                         "journal events ride --url as usual)")
+    p.add_argument("--router-url", action="append", default=[],
+                   help="fleet-router base URLs whose request "
+                        "journeys to include: the live ledger "
+                        "summary (/stats), the last journey records "
+                        "(/debug/requests — trace ids, bucket "
+                        "attribution, splice hops) and the per-"
+                        "tenant SLO-burn rollup (/fleet/stats); add "
+                        "the same URL to --url to also fold the "
+                        "router's /debug/trace into the merged "
+                        "timeline")
     p.add_argument("--out", default="tpu_diagnose.json")
     args = p.parse_args(argv)
 
@@ -561,7 +629,8 @@ def main(argv=None):
     bundle = collect(urls, args.journal, args.dev_dir, args.state_dir,
                      checkpoint_dirs=args.checkpoint_dir,
                      perf_ledger_path=args.perf_ledger,
-                     fleet_urls=args.fleet_url)
+                     fleet_urls=args.fleet_url,
+                     router_urls=args.router_url)
 
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
@@ -589,6 +658,9 @@ def main(argv=None):
         "repartition_proposals": bundle["placement"]["proposals"],
         "fleet_down_episodes": bundle["fleet"]["down_episodes"],
         "fleet_slo_burns": bundle["fleet"]["slo_burns"],
+        "router_shed_episodes": bundle["router"]["shed_episodes"],
+        "router_failover_episodes":
+            bundle["router"]["failover_episodes"],
         "perf_ledger_rows": bundle["perf"].get("rows"),
     }))
     return 0
